@@ -1,0 +1,34 @@
+"""incubator_predictionio_tpu — a TPU-native machine-learning server.
+
+A ground-up rebuild of the capabilities of Apache PredictionIO
+(reference: fqc/incubator-predictionio — see /root/repo/SURVEY.md) on
+JAX/XLA instead of Scala/Spark:
+
+- DASE engine architecture (DataSource, Preparator, Algorithm, Serving
+  + Evaluation) as Python classes producing jax arrays/pytrees
+  (reference: core/src/main/scala/org/apache/predictionio/controller/).
+- Event Server with the PredictionIO REST ingestion API
+  (reference: data/src/main/scala/org/apache/predictionio/data/api/).
+- Pluggable storage registry driven by PIO_STORAGE_* env vars
+  (reference: data/.../data/storage/Storage.scala).
+- Training workflow that runs DASE pipelines as pjit'd XLA programs on
+  a TPU mesh (reference: core/.../workflow/CreateWorkflow.scala) —
+  no Spark executors; collectives over ICI replace shuffles.
+- Deployment server exposing trained models behind POST /queries.json
+  (reference: core/.../workflow/CreateServer.scala).
+- CLI `pio` with the familiar verb set
+  (reference: tools/.../tools/console/Console.scala).
+
+Subpackage map (SURVEY.md layer map in parentheses):
+  data/       storage + event model + event server + event stores (L1-L3)
+  controller/ DASE controller API (L4)
+  workflow/   train/eval/deploy runtime (L5)
+  tools/      CLI, admin, dashboard, export/import (L6)
+  e2/         ML helper lib (L7)
+  models/     bundled template algorithm families (L8 analog)
+  ops/        JAX/XLA numeric kernels (ALS solves, segment ops, top-k, LLR)
+  parallel/   mesh/sharding/collective helpers, multi-host init
+  utils/      config, logging, json
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+"""Data layer: event model, storage abstraction, event server, event stores.
+
+Reference layer map: SURVEY.md §2.1-2.3 (data/src/main/scala/org/apache/
+predictionio/data/ in the reference).
+"""

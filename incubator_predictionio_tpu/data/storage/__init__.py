@@ -1,0 +1,43 @@
+"""Storage layer public surface (reference: data/.../data/storage/)."""
+
+from .base import (
+    AccessKey,
+    AccessKeys,
+    App,
+    Apps,
+    BaseStorageClient,
+    Channel,
+    Channels,
+    EngineInstance,
+    EngineInstances,
+    EvaluationInstance,
+    EvaluationInstances,
+    LEvents,
+    Model,
+    Models,
+    PEvents,
+    StorageClientConfig,
+    aggregate_property_events,
+)
+from .datamap import DataMap, DataMapError, PropertyMap
+from .event import (
+    SPECIAL_EVENTS,
+    Event,
+    EventValidationError,
+    format_event_time,
+    new_event_id,
+    parse_event_time,
+    validate_event,
+)
+from .registry import Storage, StorageError, base_dir, register_backend
+
+__all__ = [
+    "AccessKey", "AccessKeys", "App", "Apps", "BaseStorageClient",
+    "Channel", "Channels", "DataMap", "DataMapError", "EngineInstance",
+    "EngineInstances", "EvaluationInstance", "EvaluationInstances", "Event",
+    "EventValidationError", "LEvents", "Model", "Models", "PEvents",
+    "PropertyMap", "SPECIAL_EVENTS", "Storage", "StorageClientConfig",
+    "StorageError", "aggregate_property_events", "base_dir",
+    "format_event_time", "new_event_id", "parse_event_time",
+    "register_backend", "validate_event",
+]

@@ -1,0 +1,4 @@
+"""Tools layer: CLI console, commands, admin API, dashboard, export/import.
+
+Reference layer map: SURVEY.md §2.6 (tools/ + bin/ in the reference).
+"""

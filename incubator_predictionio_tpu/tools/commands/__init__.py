@@ -1,0 +1,30 @@
+"""CLI verb registry (reference: tools/.../tools/commands/)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_VERBS: dict[str, tuple[Callable[[list[str]], int], str]] = {}
+
+
+def verb(name: str, help_text: str):
+    def deco(fn):
+        _VERBS[name] = (fn, help_text)
+        return fn
+
+    return deco
+
+
+def usage() -> str:
+    lines = ["usage: pio <command> [args]", "", "commands:"]
+    lines += [f"  {n:<14} {h}" for n, (_, h) in sorted(_VERBS.items())]
+    lines += ["  version        print version", ""]
+    return "\n".join(lines)
+
+
+def dispatch(name: str, args: list[str]) -> int:
+    if name not in _VERBS:
+        print(f"pio: unknown or not-yet-implemented command: {name}", file=__import__("sys").stderr)
+        print(usage(), file=__import__("sys").stderr)
+        return 1
+    return _VERBS[name][0](args)

@@ -1,0 +1,29 @@
+"""`pio` CLI console (reference: tools/.../console/Console.scala).
+
+Verbs are registered incrementally as subsystems land; unknown verbs get a
+clear not-yet-implemented error instead of a crash. See tools/commands/ for
+implementations.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from . import commands
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(commands.usage())
+        return 0
+    if argv[0] == "version":
+        from incubator_predictionio_tpu import __version__
+
+        print(__version__)
+        return 0
+    return commands.dispatch(argv[0], argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,59 @@
+"""Test bootstrap.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported
+anywhere — the moral equivalent of the reference's SharedSparkContext
+`local[*]` trick (SURVEY.md §4): distributed/sharding logic is exercised
+in-process without TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def memory_storage():
+    """Isolated all-in-memory Storage registry."""
+    from incubator_predictionio_tpu.data.storage import Storage
+
+    env = {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+    }
+    storage = Storage.reset_instance(env)
+    yield storage
+    Storage.reset_instance({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+    })
+
+
+@pytest.fixture()
+def sqlite_storage(tmp_path):
+    """Isolated SQLite-backed Storage registry in a temp dir."""
+    from incubator_predictionio_tpu.data.storage import Storage
+
+    env = {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "pio.sqlite"),
+    }
+    storage = Storage.reset_instance(env)
+    yield storage
+    storage.close()

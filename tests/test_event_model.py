@@ -1,0 +1,86 @@
+"""Event model + DataMap tests (reference: data/src/test/scala/.../storage/
+{DataMapSpec,EventJson4sSupportSpec}.scala test strategy)."""
+
+import datetime as dt
+
+import pytest
+
+from incubator_predictionio_tpu.data.storage import (
+    DataMap,
+    DataMapError,
+    Event,
+    EventValidationError,
+    format_event_time,
+    parse_event_time,
+    validate_event,
+)
+
+
+def test_datamap_require_and_opt():
+    d = DataMap({"a": 1, "b": "x", "ratings": [1, 2, 3]})
+    assert d.require("a") == 1
+    assert d.require("b", str) == "x"
+    assert d.get_opt("missing") is None
+    assert d.get_or_else("missing", 7) == 7
+    with pytest.raises(DataMapError):
+        d.require("missing")
+    with pytest.raises(DataMapError):
+        d.require("b", int)
+    # JSON numbers: int where float expected is fine
+    assert d.require("a", float) == 1.0
+
+
+def test_datamap_union_minus():
+    a = DataMap({"x": 1, "y": 2})
+    b = DataMap({"y": 3, "z": 4})
+    assert a.union(b) == {"x": 1, "y": 3, "z": 4}
+    assert a.minus(["x"]) == {"y": 2}
+
+
+def test_event_json_roundtrip():
+    e = Event.from_json(
+        {
+            "event": "rate",
+            "entityType": "user",
+            "entityId": "u1",
+            "targetEntityType": "item",
+            "targetEntityId": "i9",
+            "properties": {"rating": 4.5},
+            "eventTime": "2024-01-02T03:04:05.678Z",
+        }
+    )
+    j = e.to_json()
+    assert j["event"] == "rate"
+    assert j["entityId"] == "u1"
+    assert j["targetEntityId"] == "i9"
+    assert j["properties"] == {"rating": 4.5}
+    assert j["eventTime"] == "2024-01-02T03:04:05.678Z"
+    e2 = Event.from_json(j)
+    assert e2.event_time == e.event_time
+    assert e2.properties == e.properties
+
+
+def test_event_time_parsing_offsets():
+    t = parse_event_time("2024-01-02T03:04:05.678+02:00")
+    assert t.utcoffset() == dt.timedelta(hours=2)
+    assert format_event_time(t) == "2024-01-02T01:04:05.678Z"
+
+
+def test_event_validation_rules():
+    with pytest.raises(EventValidationError):
+        Event.from_json({"event": "", "entityType": "u", "entityId": "1"})
+    with pytest.raises(EventValidationError):
+        Event.from_json({"event": "$boom", "entityType": "u", "entityId": "1"})
+    with pytest.raises(EventValidationError):  # $unset needs properties
+        Event.from_json({"event": "$unset", "entityType": "u", "entityId": "1"})
+    with pytest.raises(EventValidationError):  # reserved prefix
+        Event.from_json({"event": "x", "entityType": "pio_user", "entityId": "1"})
+    with pytest.raises(EventValidationError):  # target fields must pair
+        Event.from_json(
+            {"event": "x", "entityType": "u", "entityId": "1", "targetEntityType": "i"}
+        )
+    # valid special event
+    e = Event.from_json(
+        {"event": "$set", "entityType": "u", "entityId": "1", "properties": {"a": 1}}
+    )
+    validate_event(e)

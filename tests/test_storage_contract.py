@@ -1,0 +1,280 @@
+"""Storage-backend contract tests, parametrized over backends — the analog
+of the reference's LEventsSpec/PEventsSpec run against HBase/JDBC/ES
+(SURVEY.md §4: same DAO behaviour across backends)."""
+
+import datetime as dt
+
+import pytest
+
+from incubator_predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    Channel,
+    DataMap,
+    EngineInstance,
+    EvaluationInstance,
+    Event,
+    Model,
+    Storage,
+)
+
+
+def _make_storage(kind, tmp_path):
+    if kind == "memory":
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+            "PIO_STORAGE_SOURCES_S_TYPE": "MEMORY",
+        }
+    elif kind == "sqlite":
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+            "PIO_STORAGE_SOURCES_S_TYPE": "SQLITE",
+            "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / f"{kind}.sqlite"),
+        }
+    elif kind == "mixed":  # metadata+events sqlite, models localfs
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+            "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "mixed.sqlite"),
+            "PIO_STORAGE_SOURCES_FS_TYPE": "LOCALFS",
+            "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+        }
+    return Storage(env)
+
+
+BACKENDS = ["memory", "sqlite", "mixed"]
+
+
+@pytest.fixture(params=BACKENDS)
+def storage(request, tmp_path):
+    s = _make_storage(request.param, tmp_path)
+    yield s
+    s.close()
+
+
+def _ts(i):
+    return dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc) + dt.timedelta(minutes=i)
+
+
+def test_apps_crud(storage):
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "myapp", "desc"))
+    assert app_id
+    assert apps.get(app_id).name == "myapp"
+    assert apps.get_by_name("myapp").id == app_id
+    assert apps.insert(App(0, "myapp")) is None  # duplicate name
+    apps.update(App(app_id, "myapp", "newdesc"))
+    assert apps.get(app_id).description == "newdesc"
+    assert len(apps.get_all()) == 1
+    apps.delete(app_id)
+    assert apps.get(app_id) is None
+
+
+def test_access_keys_crud(storage):
+    keys = storage.get_meta_data_access_keys()
+    k = keys.insert(AccessKey("", appid=3, events=("rate",)))
+    assert k
+    got = keys.get(k)
+    assert got.appid == 3 and tuple(got.events) == ("rate",)
+    assert keys.get_by_appid(3)[0].key == k
+    keys.delete(k)
+    assert keys.get(k) is None
+
+
+def test_channels_crud(storage):
+    channels = storage.get_meta_data_channels()
+    cid = channels.insert(Channel(0, "ch1", appid=7))
+    assert cid
+    assert channels.insert(Channel(0, "bad name!", appid=7)) is None
+    assert channels.get(cid).name == "ch1"
+    assert [c.id for c in channels.get_by_appid(7)] == [cid]
+    channels.delete(cid)
+    assert channels.get(cid) is None
+
+
+def test_engine_instances(storage):
+    dao = storage.get_meta_data_engine_instances()
+    i1 = EngineInstance(
+        id="", status="RUNNING", start_time=_ts(0), end_time=None,
+        engine_id="e", engine_version="1", engine_variant="default",
+        engine_factory="my.Factory",
+    )
+    iid = dao.insert(i1)
+    assert dao.get(iid).status == "RUNNING"
+    done = dao.get(iid).with_status("COMPLETED", _ts(1))
+    dao.update(done)
+    assert dao.get_latest_completed("e", "1", "default").id == iid
+    # a later completed run wins
+    iid2 = dao.insert(
+        EngineInstance(
+            id="", status="COMPLETED", start_time=_ts(5), end_time=_ts(6),
+            engine_id="e", engine_version="1", engine_variant="default",
+            engine_factory="my.Factory",
+        )
+    )
+    assert dao.get_latest_completed("e", "1", "default").id == iid2
+    assert len(dao.get_completed("e", "1", "default")) == 2
+    dao.delete(iid2)
+    assert dao.get(iid2) is None
+
+
+def test_evaluation_instances(storage):
+    dao = storage.get_meta_data_evaluation_instances()
+    iid = dao.insert(
+        EvaluationInstance(
+            id="", status="EVALCOMPLETED", start_time=_ts(0), end_time=_ts(1),
+            evaluation_class="my.Eval", engine_params_generator_class="my.Gen",
+            evaluator_results="mse=0.5",
+        )
+    )
+    assert dao.get(iid).evaluator_results == "mse=0.5"
+    assert dao.get_completed()[0].id == iid
+
+
+def test_models_blob(storage):
+    models = storage.get_model_data_models()
+    models.insert(Model("m1", b"\x00\x01binary"))
+    assert models.get("m1").models == b"\x00\x01binary"
+    models.delete("m1")
+    assert models.get("m1") is None
+
+
+def test_levents_crud_and_find(storage):
+    le = storage.get_l_events()
+    assert le.init(1)
+    events = [
+        Event("rate", "user", "u1", "item", "i1", DataMap({"rating": 3.0}), _ts(0)),
+        Event("rate", "user", "u1", "item", "i2", DataMap({"rating": 5.0}), _ts(1)),
+        Event("buy", "user", "u2", "item", "i1", DataMap(), _ts(2)),
+    ]
+    ids = [le.insert(e, 1) for e in events]
+    assert len(set(ids)) == 3
+    got = le.get(ids[0], 1)
+    assert got.properties.require("rating") == 3.0
+    assert got.event_id == ids[0]
+
+    assert len(list(le.find(1))) == 3
+    assert len(list(le.find(1, event_names=["rate"]))) == 2
+    assert len(list(le.find(1, entity_id="u1"))) == 2
+    assert len(list(le.find(1, target_entity_id="i1"))) == 2
+    assert len(list(le.find(1, start_time=_ts(1)))) == 2
+    assert len(list(le.find(1, until_time=_ts(1)))) == 1
+    assert len(list(le.find(1, limit=2))) == 2
+    rev = list(le.find(1, reversed_order=True))
+    assert rev[0].event == "buy"
+
+    assert le.delete(ids[2], 1)
+    assert not le.delete(ids[2], 1)
+    assert len(list(le.find(1))) == 2
+    # channels are isolated
+    le.init(1, 5)
+    le.insert(events[0], 1, 5)
+    assert len(list(le.find(1))) == 2
+    assert len(list(le.find(1, channel_id=5))) == 1
+    assert le.remove(1, 5)
+
+
+def test_aggregate_properties(storage):
+    le = storage.get_l_events()
+    le.init(2)
+    le.insert(Event("$set", "item", "i1", properties=DataMap({"a": 1, "b": 2}), event_time=_ts(0)), 2)
+    le.insert(Event("$set", "item", "i1", properties=DataMap({"b": 3, "c": 4}), event_time=_ts(1)), 2)
+    le.insert(Event("$unset", "item", "i1", properties=DataMap({"a": 0}), event_time=_ts(2)), 2)
+    le.insert(Event("$set", "item", "i2", properties=DataMap({"a": 9}), event_time=_ts(3)), 2)
+    le.insert(Event("$delete", "item", "i3", event_time=_ts(4)), 2)
+    le.insert(Event("$set", "item", "i3", properties=DataMap({"z": 1}), event_time=_ts(3)), 2)
+
+    props = le.aggregate_properties(2, "item")
+    assert set(props) == {"i1", "i2"}  # i3 deleted after its $set
+    assert props["i1"] == {"b": 3, "c": 4}
+    assert props["i1"].first_updated == _ts(0)
+    assert props["i1"].last_updated == _ts(2)
+    # required-field filter
+    assert set(le.aggregate_properties(2, "item", required=["c"])) == {"i1"}
+
+
+def test_pevents_write_and_find(storage):
+    pe = storage.get_p_events()
+    events = [
+        Event("view", "user", f"u{i}", "item", f"i{i % 3}", DataMap(), _ts(i))
+        for i in range(10)
+    ]
+    pe.write(events, 9)
+    assert len(list(pe.find(9))) == 10
+    assert len(list(pe.find(9, target_entity_id="i0"))) == 4
+
+
+def test_verify_all_data_objects(storage):
+    assert storage.verify_all_data_objects() == []
+
+
+def test_insert_without_init_autocreates(storage):
+    """Cross-backend contract: insert before init must work (review fix)."""
+    le = storage.get_l_events()
+    eid = le.insert(Event("view", "user", "u1", event_time=_ts(0)), 42)
+    assert le.get(eid, 42) is not None
+    assert not le.delete("nonexistent", 4242)  # missing table → False, no raise
+
+
+def test_empty_event_names_matches_nothing(storage):
+    """event_names=[] must match nothing on every backend (review fix)."""
+    le = storage.get_l_events()
+    le.init(43)
+    le.insert(Event("view", "user", "u1", event_time=_ts(0)), 43)
+    assert list(le.find(43, event_names=[])) == []
+    assert len(list(le.find(43, event_names=None))) == 1
+
+
+def test_namespace_isolation(tmp_path):
+    """Two configs with different _NAMEs must not collide (review fix)."""
+    def env(name):
+        return {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": name,
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": name + "_ev",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+            "PIO_STORAGE_SOURCES_S_TYPE": "SQLITE",
+            "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / "shared.sqlite"),
+        }
+
+    s1, s2 = Storage(env("ns_a")), Storage(env("ns_b"))
+    s1.get_meta_data_apps().insert(App(0, "only-in-a"))
+    assert s2.get_meta_data_apps().get_by_name("only-in-a") is None
+    s1.get_l_events().insert(Event("x", "u", "1", event_time=_ts(0)), 1)
+    assert list(s2.get_l_events().find(1)) == []
+    assert len(list(s1.get_l_events().find(1))) == 1
+    s1.close()  # shared connection-per-Storage; close both
+    s2.close()
+
+
+def test_creation_time_roundtrip():
+    """Export→import must preserve creationTime (review fix)."""
+    e = Event.from_json(
+        {"event": "x", "entityType": "u", "entityId": "1",
+         "eventTime": "2024-01-01T00:00:00.000Z",
+         "creationTime": "2024-01-01T00:00:01.000Z"}
+    )
+    assert e.to_json()["creationTime"] == "2024-01-01T00:00:01.000Z"
+
+
+def test_non_string_json_fields_rejected():
+    """Bad client types must raise EventValidationError, not crash (review fix)."""
+    from incubator_predictionio_tpu.data.storage import EventValidationError
+    import pytest as _pytest
+
+    for bad in (
+        {"event": 5, "entityType": "u", "entityId": "1"},
+        {"event": "x", "entityType": ["u"], "entityId": "1"},
+        {"event": "x", "entityType": "u", "entityId": "1", "eventTime": 12345},
+        {"event": "x", "entityType": "u", "entityId": "1", "targetEntityType": 3,
+         "targetEntityId": "4"},
+    ):
+        with _pytest.raises(EventValidationError):
+            Event.from_json(bad)
