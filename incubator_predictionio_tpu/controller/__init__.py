@@ -1,0 +1,66 @@
+"""DASE controller API — the public engine-developer surface.
+
+Re-design of the reference controller layer (reference:
+core/src/main/scala/org/apache/predictionio/controller/). The DASE mental
+model is preserved verbatim — DataSource → Preparator → Algorithm(s) →
+Serving, plus Evaluation — but components produce arrays/pytrees instead of
+RDDs, and "distributed" is expressed through jax.sharding on a device mesh
+rather than through a P/L class split.
+
+API-parity notes:
+- `PDataSource`/`LDataSource`, `PPreparator`/`LPreparator`,
+  `PAlgorithm`/`P2LAlgorithm`/`LAlgorithm` are provided as aliases of the
+  unified base classes. In the reference the trichotomy encodes *where*
+  data lives (RDD vs driver); on a TPU mesh every array is a jax.Array
+  whose sharding annotation carries that information instead
+  (reference: controller/{PAlgorithm,P2LAlgorithm,LAlgorithm}.scala).
+"""
+
+from .base import (
+    AbstractDoer,
+    CustomQuerySerializer,
+    EmptyParams,
+    Params,
+    SanityCheck,
+    doer,
+    params_from_dict,
+    params_to_dict,
+)
+from .datasource import DataSource, LDataSource, PDataSource
+from .preparator import (
+    IdentityPreparator,
+    LPreparator,
+    PIdentityPreparator,
+    PPreparator,
+    Preparator,
+)
+from .algorithm import Algorithm, LAlgorithm, P2LAlgorithm, PAlgorithm
+from .serving import AverageServing, FirstServing, LServing, Serving
+from .engine import Engine, EngineFactory, EngineParams, SimpleEngine
+from .evaluation import Evaluation, EngineParamsGenerator
+from .metric import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from .metric_evaluator import MetricEvaluator, MetricEvaluatorResult
+from .persistent_model import (
+    LocalFileSystemPersistentModel,
+    PersistentModel,
+    PersistentModelLoader,
+)
+
+__all__ = [
+    "AbstractDoer", "Algorithm", "AverageMetric", "AverageServing",
+    "CustomQuerySerializer", "DataSource", "EmptyParams", "Engine",
+    "EngineFactory", "EngineParams", "EngineParamsGenerator", "Evaluation",
+    "FirstServing", "IdentityPreparator", "LAlgorithm", "LDataSource",
+    "LPreparator", "LServing", "LocalFileSystemPersistentModel", "Metric",
+    "MetricEvaluator", "MetricEvaluatorResult", "OptionAverageMetric",
+    "P2LAlgorithm", "PAlgorithm", "PDataSource", "PIdentityPreparator",
+    "PPreparator", "Params", "PersistentModel", "PersistentModelLoader",
+    "Preparator", "SanityCheck", "Serving", "SimpleEngine", "SumMetric",
+    "ZeroMetric", "doer", "params_from_dict", "params_to_dict",
+]

@@ -1,0 +1,60 @@
+"""Algorithm — train a model, predict queries.
+
+Reference: core/.../controller/{PAlgorithm,P2LAlgorithm,LAlgorithm}.scala.
+The reference trichotomy (distributed-train/distributed-model,
+distributed-train/local-model, local) encodes where data lives on a Spark
+cluster. On a TPU mesh the model is a pytree of jax.Arrays whose shardings
+carry that information, so one base class suffices; the three names are
+kept as aliases so template code reads identically to upstream.
+
+TPU-first contract:
+- ``train`` should build a pjit'd/jitted step and return a model pytree.
+- ``predict`` is the serving hot path: implementations should route
+  through an AOT-compiled executable (see workflow/create_server.py).
+- ``batch_predict`` vectorizes eval-time scoring (reference:
+  batchPredict as RDD joins — here a single device sweep).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generic, Sequence, TypeVar
+
+from .base import AbstractDoer
+
+PD = TypeVar("PD")
+M = TypeVar("M")
+Q = TypeVar("Q")
+P = TypeVar("P")
+
+
+class Algorithm(AbstractDoer, Generic[PD, M, Q, P]):
+    def train(self, ctx, prepared_data: PD) -> M:
+        raise NotImplementedError
+
+    def predict(self, model: M, query: Q) -> P:
+        raise NotImplementedError
+
+    def batch_predict(self, model: M, queries: Sequence[Q]) -> list[P]:
+        """Default: loop over predict. Override with a vectorized sweep
+        for eval throughput (reference: batchPredict)."""
+        return [self.predict(model, q) for q in queries]
+
+    # -- model persistence hooks (reference: makeSerializableModels) ------
+    def prepare_model_for_persistence(self, model: M) -> Any:
+        """Convert device arrays → host (numpy) before pickling. Default
+        uses jax.device_get on the whole pytree."""
+        import jax
+
+        return jax.device_get(model)
+
+    def restore_model(self, stored: Any, ctx) -> M:
+        """Inverse of prepare_model_for_persistence; default identity —
+        jax ops consume numpy arrays directly, and re-device-put happens
+        lazily on first use."""
+        return stored
+
+
+# API-parity aliases.
+PAlgorithm = Algorithm
+P2LAlgorithm = Algorithm
+LAlgorithm = Algorithm

@@ -1,0 +1,113 @@
+"""Params, Doer instantiation, and cross-cutting controller contracts.
+
+Reference: core/.../controller/{Params,EmptyParams,SanityCheck,
+CustomQuerySerializer}.scala and core/.../core/{AbstractDoer,Doer}.scala.
+The reference instantiates user classes reflectively with a Params case
+class; here ``doer`` constructs the class with keyword arguments extracted
+from engine.json — the Python analog of JsonExtractor + Doer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Mapping, Optional, Type, TypeVar
+
+
+class Params:
+    """Marker base for component parameters (reference: Params trait).
+
+    Subclasses are usually @dataclass-es. Plain classes with keyword
+    __init__ args work too.
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class EmptyParams(Params):
+    """Reference: EmptyParams — components that need no configuration."""
+
+
+def params_from_dict(params_cls: Optional[Type], d: Mapping[str, Any]) -> Any:
+    """Build a Params instance from a JSON dict (JsonExtractor analog).
+
+    Unknown keys raise — the reference fails trains on bad engine.json keys
+    rather than silently ignoring typos.
+    """
+    if params_cls is None:
+        return EmptyParams() if not d else dict(d)
+    if dataclasses.is_dataclass(params_cls):
+        names = {f.name for f in dataclasses.fields(params_cls)}
+        unknown = set(d) - names
+        if unknown:
+            raise ValueError(
+                f"unknown parameter(s) {sorted(unknown)} for {params_cls.__name__};"
+                f" expected a subset of {sorted(names)}"
+            )
+        return params_cls(**d)
+    sig = inspect.signature(params_cls)
+    return params_cls(**{k: v for k, v in d.items() if k in sig.parameters})
+
+
+def params_to_dict(p: Any) -> dict[str, Any]:
+    if p is None:
+        return {}
+    if dataclasses.is_dataclass(p):
+        return dataclasses.asdict(p)
+    if isinstance(p, Mapping):
+        return dict(p)
+    return {k: v for k, v in vars(p).items() if not k.startswith("_")}
+
+
+class AbstractDoer:
+    """Base for all DASE components (reference: AbstractDoer — holds the
+    Params it was constructed with)."""
+
+    params_cls: Optional[Type] = None  # set by subclasses for extraction
+
+    def __init__(self, params: Any = None):
+        self.params = params if params is not None else EmptyParams()
+
+
+T = TypeVar("T", bound=AbstractDoer)
+
+
+def doer(cls: Type[T], params_json: Optional[Mapping[str, Any]] = None) -> T:
+    """Instantiate a DASE component from its JSON params
+    (reference: Doer.apply — reflective construction with Params).
+
+    ``cls.params_aliases`` maps engine.json spellings onto Params field
+    names (e.g. {"lambda": "reg", "numIterations": "num_iterations"}) so
+    reference engine.json files work verbatim."""
+    params_cls = getattr(cls, "params_cls", None)
+    if params_json is None:
+        params_json = {}
+    aliases = getattr(cls, "params_aliases", None)
+    if aliases and isinstance(params_json, Mapping):
+        params_json = {aliases.get(k, k): v for k, v in params_json.items()}
+    if params_cls is not None:
+        return cls(params_from_dict(params_cls, params_json))
+    # No declared params class: pass the raw dict (or nothing).
+    try:
+        return cls(dict(params_json)) if params_json else cls()
+    except TypeError:
+        return cls()
+
+
+class SanityCheck:
+    """Post-stage data asserts (reference: controller/SanityCheck.scala —
+    run after each DASE stage unless --skip-sanity-check)."""
+
+    def sanity_check(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class CustomQuerySerializer:
+    """Hook to override query/result JSON codecs (reference:
+    controller/CustomQuerySerializer.scala). Components may provide
+    ``query_from_json`` / ``result_to_json``."""
+
+    def query_from_json(self, obj: Mapping[str, Any]) -> Any:
+        return obj
+
+    def result_to_json(self, result: Any) -> Any:
+        return result

@@ -1,0 +1,36 @@
+"""DataSource — reads training/eval data from the event store.
+
+Reference: core/.../controller/{PDataSource,LDataSource}.scala. The
+reference returns RDD[TrainingData]; here TrainingData is whatever the
+engine defines — typically a columnar batch of numpy arrays produced via
+data.store.PEventStore, ready for device sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Generic, Iterable, Sequence, Tuple, TypeVar
+
+from .base import AbstractDoer
+
+TD = TypeVar("TD")  # TrainingData
+EI = TypeVar("EI")  # EvaluationInfo
+Q = TypeVar("Q")  # Query
+A = TypeVar("A")  # Actual result
+
+
+class DataSource(AbstractDoer, Generic[TD, EI, Q, A]):
+    """Unified DataSource. ``read_training`` feeds `pio train`;
+    ``read_eval`` yields (trainingData, evalInfo, [(query, actual)]) folds
+    for `pio eval` (reference: PDataSource.readTraining/readEval)."""
+
+    def read_training(self, ctx) -> TD:
+        raise NotImplementedError
+
+    def read_eval(self, ctx) -> Sequence[Tuple[TD, EI, Iterable[Tuple[Q, A]]]]:
+        """Default: no eval folds (reference: readEval default = empty)."""
+        return []
+
+
+# API-parity aliases (see controller/__init__ docstring).
+PDataSource = DataSource
+LDataSource = DataSource

@@ -1,0 +1,245 @@
+"""Engine — binds DASE component classes with their parameters.
+
+Reference: core/.../controller/Engine.scala (class maps + train/eval
+composition), EngineParams, SimpleEngine, EngineFactory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Mapping, Optional, Sequence, Type
+
+from .algorithm import Algorithm
+from .base import SanityCheck, doer
+from .datasource import DataSource
+from .preparator import IdentityPreparator, Preparator
+from .serving import FirstServing, Serving
+
+log = logging.getLogger("pio.engine")
+
+
+def _as_class_map(spec) -> dict[str, Type]:
+    """Accept a single class or a {name: class} map (reference: Engine
+    constructors take either; single class registers under "")."""
+    if spec is None:
+        return {}
+    if isinstance(spec, Mapping):
+        return dict(spec)
+    return {"": spec}
+
+
+@dataclasses.dataclass
+class EngineParams:
+    """Per-component parameter selection (reference: EngineParams).
+
+    ``algorithm_params_list`` is a list of (name, params_dict) pairs —
+    multiple algorithms blend through Serving.
+    """
+
+    data_source_params: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    preparator_params: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    algorithm_params_list: Sequence[tuple[str, Mapping[str, Any]]] = dataclasses.field(
+        default_factory=list
+    )
+    serving_params: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    data_source_name: str = ""
+    preparator_name: str = ""
+    serving_name: str = ""
+
+    @staticmethod
+    def from_json(obj: Mapping[str, Any]) -> "EngineParams":
+        """Parse the engine.json "params-style" dict:
+        {"datasource": {"params": {...}}, "algorithms": [{"name": ...,
+        "params": {...}}], ...} (reference: WorkflowUtils.getParamsFromJsonByFieldAndClass)."""
+
+        def unwrap(block):
+            if block is None:
+                return "", {}
+            if "params" in block or "name" in block:
+                return block.get("name", ""), block.get("params", {}) or {}
+            return "", block
+
+        ds_name, ds_params = unwrap(obj.get("datasource"))
+        p_name, p_params = unwrap(obj.get("preparator"))
+        s_name, s_params = unwrap(obj.get("serving"))
+        algos = []
+        for a in obj.get("algorithms", []) or []:
+            algos.append((a.get("name", ""), a.get("params", {}) or {}))
+        return EngineParams(
+            data_source_params=ds_params,
+            preparator_params=p_params,
+            algorithm_params_list=algos,
+            serving_params=s_params,
+            data_source_name=ds_name,
+            preparator_name=p_name,
+            serving_name=s_name,
+        )
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "datasource": {"name": self.data_source_name, "params": dict(self.data_source_params)},
+            "preparator": {"name": self.preparator_name, "params": dict(self.preparator_params)},
+            "algorithms": [
+                {"name": n, "params": dict(p)} for n, p in self.algorithm_params_list
+            ],
+            "serving": {"name": self.serving_name, "params": dict(self.serving_params)},
+        }
+
+
+class Engine:
+    """Reference: controller/Engine.scala. Composes DASE for train/eval."""
+
+    def __init__(
+        self,
+        data_source_class,
+        preparator_class=None,
+        algorithm_class_map=None,
+        serving_class=None,
+    ):
+        self.data_source_class_map = _as_class_map(data_source_class)
+        self.preparator_class_map = _as_class_map(preparator_class or IdentityPreparator)
+        self.algorithm_class_map = _as_class_map(algorithm_class_map)
+        self.serving_class_map = _as_class_map(serving_class or FirstServing)
+
+    # -- component instantiation -----------------------------------------
+    def _pick(self, class_map: dict[str, Type], name: str, what: str) -> Type:
+        if name in class_map:
+            return class_map[name]
+        if not name and len(class_map) == 1:
+            return next(iter(class_map.values()))
+        raise KeyError(
+            f"{what} {name!r} not registered; available: {sorted(class_map)}"
+        )
+
+    def make_components(self, engine_params: EngineParams):
+        ds = doer(
+            self._pick(self.data_source_class_map, engine_params.data_source_name, "datasource"),
+            engine_params.data_source_params,
+        )
+        prep = doer(
+            self._pick(self.preparator_class_map, engine_params.preparator_name, "preparator"),
+            engine_params.preparator_params,
+        )
+        algo_list = [
+            (
+                name,
+                doer(self._pick(self.algorithm_class_map, name, "algorithm"), params),
+            )
+            for name, params in (engine_params.algorithm_params_list or [("", {})])
+        ]
+        serving = doer(
+            self._pick(self.serving_class_map, engine_params.serving_name, "serving"),
+            engine_params.serving_params,
+        )
+        return ds, prep, algo_list, serving
+
+    @staticmethod
+    def _maybe_sanity_check(obj, label: str, enabled: bool) -> None:
+        if enabled and isinstance(obj, SanityCheck):
+            log.info("sanity check: %s", label)
+            obj.sanity_check()
+
+    # -- training (reference: Engine.train) -------------------------------
+    def train(self, ctx, engine_params: EngineParams, workflow_params=None) -> list[Any]:
+        from ..workflow.workflow_params import WorkflowParams
+
+        wp = workflow_params or WorkflowParams()
+        ds, prep, algo_list, _ = self.make_components(engine_params)
+
+        td = ds.read_training(ctx)
+        self._maybe_sanity_check(td, "training data", not wp.skip_sanity_check)
+        if wp.stop_after_read:
+            log.info("--stop-after-read: halting before prepare")
+            return []
+        pd = prep.prepare(ctx, td)
+        self._maybe_sanity_check(pd, "prepared data", not wp.skip_sanity_check)
+        if wp.stop_after_prepare:
+            log.info("--stop-after-prepare: halting before train")
+            return []
+        models = []
+        for name, algo in algo_list:
+            log.info("training algorithm %s (%s)", name or "<default>", type(algo).__name__)
+            model = algo.train(ctx, pd)
+            self._maybe_sanity_check(model, f"model[{name}]", not wp.skip_sanity_check)
+            models.append(model)
+        return models
+
+    # -- evaluation (reference: Engine.eval) ------------------------------
+    def eval(self, ctx, engine_params: EngineParams, workflow_params=None):
+        """Per-fold: train on fold TD, batch-predict fold queries.
+        Yields (eval_info, [(query, predicted, actual), ...]) per fold."""
+        ds, prep, algo_list, serving = self.make_components(engine_params)
+        results = []
+        for fold_i, (td, eval_info, qa) in enumerate(ds.read_eval(ctx)):
+            pd = prep.prepare(ctx, td)
+            models = [algo.train(ctx, pd) for _, algo in algo_list]
+            qa = list(qa)
+            queries = [serving.supplement(q) for q, _ in qa]
+            per_algo = [
+                algo.batch_predict(models[i], queries)
+                for i, (_, algo) in enumerate(algo_list)
+            ]
+            qpa = [
+                (q, serving.serve(q, [pred[j] for pred in per_algo]), a)
+                for j, (q, a) in enumerate(qa)
+            ]
+            results.append((eval_info, qpa))
+            log.info("eval fold %d: %d query/actual pairs", fold_i, len(qpa))
+        return results
+
+    # -- deployment (reference: Engine.prepareDeployment path) ------------
+    def prepare_deployment(self, ctx, engine_params: EngineParams, models: list[Any]):
+        """Re-bind stored models to live algorithm instances for serving."""
+        _, _, algo_list, serving = self.make_components(engine_params)
+        if len(models) != len(algo_list):
+            raise ValueError(
+                f"{len(models)} stored models but {len(algo_list)} algorithms"
+            )
+        restored = [
+            algo.restore_model(m, ctx) for (_, algo), m in zip(algo_list, models)
+        ]
+        return Deployment(self, algo_list, restored, serving)
+
+
+class Deployment:
+    """Live serving bundle: algorithms + restored models + serving."""
+
+    def __init__(self, engine: Engine, algo_list, models, serving: Serving):
+        self.engine = engine
+        self.algo_list = algo_list
+        self.models = models
+        self.serving = serving
+
+    def query(self, q) -> Any:
+        q = self.serving.supplement(q)
+        predictions = [
+            algo.predict(model, q)
+            for (_, algo), model in zip(self.algo_list, self.models)
+        ]
+        return self.serving.serve(q, predictions)
+
+
+class SimpleEngine(Engine):
+    """Reference: SimpleEngine — one DataSource + one Algorithm, identity
+    preparator, first serving."""
+
+    def __init__(self, data_source_class, algorithm_class):
+        super().__init__(
+            data_source_class,
+            IdentityPreparator,
+            {"": algorithm_class},
+            FirstServing,
+        )
+
+
+class EngineFactory:
+    """Reference: EngineFactory trait — ``apply()`` returns an Engine.
+    Subclass and override apply(), or pass a plain function returning an
+    Engine wherever a factory is accepted."""
+
+    def apply(self) -> Engine:
+        raise NotImplementedError
+
+    def __call__(self) -> Engine:
+        return self.apply()

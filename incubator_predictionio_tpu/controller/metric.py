@@ -1,0 +1,88 @@
+"""Metric hierarchy for evaluation (reference:
+core/.../controller/Metric.scala — AverageMetric, OptionAverageMetric,
+SumMetric, ZeroMetric; RDD means become vectorized host reductions)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Generic, Iterable, Optional, Tuple, TypeVar
+
+EI = TypeVar("EI")
+Q = TypeVar("Q")
+P = TypeVar("P")
+A = TypeVar("A")
+
+
+class Metric(Generic[EI, Q, P, A]):
+    """calculate() consumes the eval output: iterable of
+    (eval_info, [(query, predicted, actual), ...]) folds."""
+
+    #: larger-is-better by default (reference: Metric.comparator)
+    higher_is_better: bool = True
+
+    def header(self) -> str:
+        return type(self).__name__
+
+    def calculate(self, eval_data: Iterable[Tuple[EI, list]]) -> float:
+        raise NotImplementedError
+
+    def compare(self, a: float, b: float) -> int:
+        if a == b:
+            return 0
+        better = a > b if self.higher_is_better else a < b
+        return 1 if better else -1
+
+
+class AverageMetric(Metric):
+    """Mean of per-(q,p,a) scores over all folds (reference: AverageMetric)."""
+
+    def calculate_unit(self, q, p, a) -> float:
+        raise NotImplementedError
+
+    def calculate(self, eval_data) -> float:
+        total, n = 0.0, 0
+        for _info, qpa in eval_data:
+            for q, p, a in qpa:
+                total += self.calculate_unit(q, p, a)
+                n += 1
+        return total / n if n else float("nan")
+
+
+class OptionAverageMetric(AverageMetric):
+    """Mean over units that return a value; None units are excluded
+    (reference: OptionAverageMetric)."""
+
+    def calculate_unit(self, q, p, a) -> Optional[float]:  # type: ignore[override]
+        raise NotImplementedError
+
+    def calculate(self, eval_data) -> float:
+        total, n = 0.0, 0
+        for _info, qpa in eval_data:
+            for q, p, a in qpa:
+                u = self.calculate_unit(q, p, a)
+                if u is not None:
+                    total += u
+                    n += 1
+        return total / n if n else float("nan")
+
+
+class SumMetric(Metric):
+    """Sum of per-unit scores (reference: SumMetric)."""
+
+    def calculate_unit(self, q, p, a) -> float:
+        raise NotImplementedError
+
+    def calculate(self, eval_data) -> float:
+        return sum(
+            self.calculate_unit(q, p, a)
+            for _info, qpa in eval_data
+            for q, p, a in qpa
+        )
+
+
+class ZeroMetric(Metric):
+    """Always 0 (reference: ZeroMetric — placeholder for side-effect-only
+    evaluations)."""
+
+    def calculate(self, eval_data) -> float:
+        return 0.0

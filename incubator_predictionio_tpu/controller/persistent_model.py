@@ -1,0 +1,68 @@
+"""PersistentModel — models that persist themselves instead of being
+pickled into the Models DAO.
+
+Reference: core/.../controller/PersistentModel.scala (save to shared fs,
+reload with a live SparkContext via PersistentModelLoader). TPU analog:
+save() writes an orbax/np checkpoint directory keyed by engine-instance id;
+load() restores it (optionally re-sharding over the ctx mesh).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, ClassVar, Optional
+
+from ..data.storage.registry import base_dir
+
+
+class PersistentModel:
+    """Mixin: a model that handles its own persistence.
+
+    ``save`` returns True if the model persisted itself; returning False
+    falls back to default pickling (reference: PersistentModel.save's
+    contract).
+    """
+
+    def save(self, instance_id: str, params: Any) -> bool:
+        raise NotImplementedError
+
+
+class PersistentModelLoader:
+    """Companion loader (reference: PersistentModelLoader.apply)."""
+
+    @classmethod
+    def load(cls, instance_id: str, params: Any, ctx) -> Any:
+        raise NotImplementedError
+
+
+def model_dir(instance_id: str) -> str:
+    d = os.path.join(base_dir(), "persistent_models", instance_id)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class LocalFileSystemPersistentModel(PersistentModel):
+    """Reference: LocalFileSystemPersistentModel — np.savez checkpoint under
+    the PIO filesystem base dir. Subclasses implement to_arrays/from_arrays."""
+
+    def to_arrays(self) -> dict:
+        raise NotImplementedError
+
+    @classmethod
+    def from_arrays(cls, arrays: dict) -> "LocalFileSystemPersistentModel":
+        raise NotImplementedError
+
+    def save(self, instance_id: str, params: Any) -> bool:
+        import numpy as np
+
+        path = os.path.join(model_dir(instance_id), f"{type(self).__name__}.npz")
+        np.savez(path, **{k: np.asarray(v) for k, v in self.to_arrays().items()})
+        return True
+
+    @classmethod
+    def load(cls, instance_id: str, ctx=None):
+        import numpy as np
+
+        path = os.path.join(model_dir(instance_id), f"{cls.__name__}.npz")
+        with np.load(path, allow_pickle=False) as z:
+            return cls.from_arrays({k: z[k] for k in z.files})

@@ -1,0 +1,34 @@
+"""Preparator — transforms TrainingData into PreparedData.
+
+Reference: core/.../controller/{PPreparator,LPreparator,
+IdentityPreparator}.scala. The TPU-first role of prepare() is to build
+device-ready arrays: dense index mappings (BiMap), padded/blocked COO
+layouts, sharded jax.Arrays over the workflow mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Generic, TypeVar
+
+from .base import AbstractDoer
+
+TD = TypeVar("TD")
+PD = TypeVar("PD")
+
+
+class Preparator(AbstractDoer, Generic[TD, PD]):
+    def prepare(self, ctx, training_data: TD) -> PD:
+        raise NotImplementedError
+
+
+class IdentityPreparator(Preparator):
+    """Pass-through (reference: IdentityPreparator/PIdentityPreparator)."""
+
+    def prepare(self, ctx, training_data):
+        return training_data
+
+
+# API-parity aliases.
+PPreparator = Preparator
+LPreparator = Preparator
+PIdentityPreparator = IdentityPreparator

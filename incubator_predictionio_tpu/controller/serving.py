@@ -1,0 +1,40 @@
+"""Serving — combine per-algorithm predictions into one result.
+
+Reference: core/.../controller/{LServing,FirstServing,LAverageServing}.scala.
+"""
+
+from __future__ import annotations
+
+from typing import Generic, Sequence, TypeVar
+
+from .base import AbstractDoer
+
+Q = TypeVar("Q")
+P = TypeVar("P")
+
+
+class Serving(AbstractDoer, Generic[Q, P]):
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        raise NotImplementedError
+
+    def supplement(self, query: Q) -> Q:
+        """Pre-predict query enrichment hook (reference:
+        LServing.supplement — e.g. inject serve-time context)."""
+        return query
+
+
+class FirstServing(Serving):
+    """Reference: FirstServing — single-algorithm passthrough."""
+
+    def serve(self, query, predictions):
+        return predictions[0]
+
+
+class AverageServing(Serving):
+    """Reference: LAverageServing — numeric mean of predictions."""
+
+    def serve(self, query, predictions):
+        return sum(predictions) / len(predictions)
+
+
+LServing = Serving

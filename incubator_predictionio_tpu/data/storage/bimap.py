@@ -1,0 +1,66 @@
+"""BiMap — bidirectional entity-id ↔ dense-index mapping.
+
+Reference: data/.../data/storage/BiMap.scala (stringInt/stringLong helpers
+used by every recommendation template to map entity ids onto matrix rows).
+The TPU build leans on it even harder: dense int32 indices are what XLA
+wants; strings stay on the host.
+"""
+
+from __future__ import annotations
+
+from typing import Hashable, Iterable, Mapping, Optional, Sequence
+
+import numpy as np
+
+
+class BiMap:
+    """Immutable bidirectional map key → value (both unique)."""
+
+    def __init__(self, forward: Mapping[Hashable, int]):
+        self._fwd = dict(forward)
+        self._inv = {v: k for k, v in self._fwd.items()}
+        if len(self._inv) != len(self._fwd):
+            raise ValueError("BiMap values must be unique")
+
+    @staticmethod
+    def string_int(keys: Iterable[str]) -> "BiMap":
+        """Assign consecutive int indices to (deduped) keys in first-seen
+        order (reference: BiMap.stringInt)."""
+        fwd: dict[str, int] = {}
+        for k in keys:
+            if k not in fwd:
+                fwd[k] = len(fwd)
+        return BiMap(fwd)
+
+    def __call__(self, key: Hashable) -> int:
+        return self._fwd[key]
+
+    def get(self, key: Hashable, default: Optional[int] = None) -> Optional[int]:
+        return self._fwd.get(key, default)
+
+    def inverse(self, value: int) -> Hashable:
+        return self._inv[value]
+
+    def inverse_get(self, value: int, default=None):
+        return self._inv.get(value, default)
+
+    def contains(self, key: Hashable) -> bool:
+        return key in self._fwd
+
+    __contains__ = contains
+
+    def __len__(self) -> int:
+        return len(self._fwd)
+
+    def keys(self):
+        return self._fwd.keys()
+
+    def to_dict(self) -> dict:
+        return dict(self._fwd)
+
+    def map_array(self, keys: Sequence[Hashable]) -> np.ndarray:
+        """Vectorized lookup → int32 numpy array (device-ready)."""
+        return np.fromiter((self._fwd[k] for k in keys), dtype=np.int32, count=len(keys))
+
+    def inverse_array(self, values: Sequence[int]) -> list:
+        return [self._inv[int(v)] for v in values]

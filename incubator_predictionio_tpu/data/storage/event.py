@@ -122,9 +122,20 @@ class Event:
             raise EventValidationError("targetEntityType must be a string")
         if obj.get("targetEntityId") is not None and not _id_ok(obj["targetEntityId"]):
             raise EventValidationError("targetEntityId must be a string")
-        props = obj.get("properties") or {}
+        props = obj.get("properties")
+        if props is None:
+            props = {}
         if not isinstance(props, Mapping):
             raise EventValidationError("properties must be a JSON object")
+        tags = obj.get("tags")
+        if tags is None:
+            tags = ()
+        elif not isinstance(tags, (list, tuple)) or not all(
+            isinstance(t, str) for t in tags
+        ):
+            raise EventValidationError("tags must be a list of strings")
+        if obj.get("prId") is not None and not isinstance(obj["prId"], str):
+            raise EventValidationError("prId must be a string")
         if "eventTime" in obj and obj["eventTime"] is not None:
             if not isinstance(obj["eventTime"], str):
                 raise EventValidationError("eventTime must be an ISO-8601 string")
@@ -151,7 +162,7 @@ class Event:
             ),
             properties=DataMap(props),
             event_time=event_time,
-            tags=tuple(obj.get("tags") or ()),
+            tags=tuple(tags),
             pr_id=obj.get("prId"),
             event_id=obj.get("eventId"),
             creation_time=creation_time,

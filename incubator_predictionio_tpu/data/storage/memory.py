@@ -50,7 +50,6 @@ def event_matches(
 class _Table:
     def __init__(self) -> None:
         self.events: dict[str, Event] = {}
-        self.order: list[str] = []  # insertion order; sorted lazily
 
 
 class MemoryLEvents(base.LEvents):
@@ -79,8 +78,6 @@ class MemoryLEvents(base.LEvents):
         eid = event.event_id or new_event_id()
         stored = event.with_event_id(eid)
         with self._lock:
-            if eid not in t.events:
-                t.order.append(eid)
             t.events[eid] = stored
         return eid
 
@@ -92,11 +89,7 @@ class MemoryLEvents(base.LEvents):
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
         t = self._table(app_id, channel_id)
         with self._lock:
-            if event_id in t.events:
-                del t.events[event_id]
-                t.order.remove(event_id)
-                return True
-        return False
+            return t.events.pop(event_id, None) is not None
 
     def find(
         self,

@@ -578,6 +578,12 @@ class SQLiteLEvents(base.LEvents, _Dao):
             pr_id=r[9], event_id=r[0], creation_time=_from_micros(r[10]),
         )
 
+    @staticmethod
+    def _missing_table(e: sqlite3.OperationalError) -> bool:
+        # Only "no such table" means an un-init()ed app/channel; every
+        # other OperationalError (locked db, disk I/O...) must surface.
+        return "no such table" in str(e)
+
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
         t = self._table(app_id, channel_id)
         with self._lock:
@@ -585,8 +591,10 @@ class SQLiteLEvents(base.LEvents, _Dao):
                 row = self._conn.execute(
                     f"SELECT * FROM {t} WHERE id=?", (event_id,)
                 ).fetchone()
-            except sqlite3.OperationalError:
-                return None
+            except sqlite3.OperationalError as e:
+                if self._missing_table(e):
+                    return None
+                raise
         return self._row_to_event(row) if row else None
 
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
@@ -594,8 +602,10 @@ class SQLiteLEvents(base.LEvents, _Dao):
         with self._lock, self._conn:
             try:
                 cur = self._conn.execute(f"DELETE FROM {t} WHERE id=?", (event_id,))
-            except sqlite3.OperationalError:
-                return False
+            except sqlite3.OperationalError as e:
+                if self._missing_table(e):
+                    return False
+                raise
             return cur.rowcount > 0
 
     def find(
@@ -646,7 +656,9 @@ class SQLiteLEvents(base.LEvents, _Dao):
         with self._lock:
             try:
                 rows = self._conn.execute(sql, params).fetchall()
-            except sqlite3.OperationalError:
+            except sqlite3.OperationalError as e:
+                if not self._missing_table(e):
+                    raise
                 rows = []
         for r in rows:
             yield self._row_to_event(r)

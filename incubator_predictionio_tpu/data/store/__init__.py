@@ -1,0 +1,6 @@
+"""Engine-facing event read APIs (reference: data/.../data/store/)."""
+
+from .l_event_store import LEventStore
+from .p_event_store import EventBatch, PEventStore
+
+__all__ = ["EventBatch", "LEventStore", "PEventStore"]

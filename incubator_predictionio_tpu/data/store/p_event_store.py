@@ -1,0 +1,147 @@
+"""PEventStore — bulk event reads for training DataSources.
+
+Reference: data/.../data/store/PEventStore.scala (find/aggregateProperties
+returning RDDs). The TPU-native analog returns *columnar batches*: entity
+ids and values as numpy arrays plus BiMaps, ready for device sharding —
+the "RDD[Event] → device array" bridge of SURVEY.md §7 step 4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..storage.bimap import BiMap
+from ..storage.datamap import PropertyMap
+from ..storage.event import Event
+from ..storage.registry import Storage
+
+
+@dataclasses.dataclass
+class EventBatch:
+    """Columnar view of an event scan (host side)."""
+
+    event: list[str]
+    entity_type: list[str]
+    entity_id: list[str]
+    target_entity_id: list[Optional[str]]
+    properties: list[dict]
+    event_time_us: np.ndarray  # int64 epoch micros
+
+    def __len__(self) -> int:
+        return len(self.event)
+
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+
+def _resolve_app(app_name: str, storage: Optional[Storage] = None,
+                 channel_name: Optional[str] = None):
+    """app name (+channel name) → ids (reference: Common.appNameToId)."""
+    s = storage or Storage.instance()
+    app = s.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        raise ValueError(f"App {app_name!r} does not exist; create it with `pio app new`")
+    channel_id = None
+    if channel_name:
+        chans = [c for c in s.get_meta_data_channels().get_by_appid(app.id)
+                 if c.name == channel_name]
+        if not chans:
+            raise ValueError(f"Channel {channel_name!r} not found for app {app_name!r}")
+        channel_id = chans[0].id
+    return s, app.id, channel_id
+
+
+class PEventStore:
+    """Static facade mirroring the reference object's API."""
+
+    @staticmethod
+    def find(
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        storage: Optional[Storage] = None,
+    ) -> Iterator[Event]:
+        s, app_id, channel_id = _resolve_app(app_name, storage, channel_name)
+        return s.get_p_events().find(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id,
+        )
+
+    @staticmethod
+    def find_batch(
+        app_name: str,
+        event_names: Optional[Sequence[str]] = None,
+        storage: Optional[Storage] = None,
+        **kwargs,
+    ) -> EventBatch:
+        """Columnar scan (the hot path for DataSources)."""
+        events = PEventStore.find(
+            app_name, event_names=event_names, storage=storage, **kwargs
+        )
+        ev, et, eid, tid, props, times = [], [], [], [], [], []
+        for e in events:
+            ev.append(e.event)
+            et.append(e.entity_type)
+            eid.append(e.entity_id)
+            tid.append(e.target_entity_id)
+            props.append(e.properties.to_dict())
+            times.append(
+                int((e.event_time - _EPOCH).total_seconds() * 1_000_000)
+            )
+        return EventBatch(
+            event=ev, entity_type=et, entity_id=eid, target_entity_id=tid,
+            properties=props,
+            event_time_us=np.asarray(times, dtype=np.int64),
+        )
+
+    @staticmethod
+    def aggregate_properties(
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+        storage: Optional[Storage] = None,
+    ) -> dict[str, PropertyMap]:
+        s, app_id, channel_id = _resolve_app(app_name, storage, channel_name)
+        return s.get_p_events().aggregate_properties(
+            app_id, entity_type, channel_id, start_time, until_time, required
+        )
+
+
+def ratings_matrix(
+    batch: EventBatch,
+    rating_from_props: bool = True,
+    default_rating: float = 1.0,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, BiMap, BiMap]:
+    """(user, item, rating) COO triple + id maps from a columnar batch —
+    the shared prep for every recommendation-family template."""
+    users = BiMap.string_int(batch.entity_id)
+    items = BiMap.string_int(t for t in batch.target_entity_id if t is not None)
+    u = users.map_array(batch.entity_id)
+    i = np.fromiter(
+        (items(t) if t is not None else -1 for t in batch.target_entity_id),
+        dtype=np.int32,
+        count=len(batch),
+    )
+    if rating_from_props:
+        r = np.fromiter(
+            (float(p.get("rating", default_rating)) for p in batch.properties),
+            dtype=np.float32,
+            count=len(batch),
+        )
+    else:
+        r = np.full(len(batch), default_rating, dtype=np.float32)
+    keep = i >= 0
+    return u[keep], i[keep], r[keep], users, items

@@ -1,0 +1,5 @@
+"""ML helper lib (reference: e2/ — SURVEY.md §2.7)."""
+
+from .cross_validation import k_fold_indices
+
+__all__ = ["k_fold_indices"]

@@ -1,0 +1,18 @@
+"""K-fold splitting (reference: e2/.../evaluation/CrossValidation.scala)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+
+def k_fold_indices(
+    n: int, k: int, seed: int = 0
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield (train_mask, test_mask) boolean pairs for k folds."""
+    rng = np.random.default_rng(seed)
+    fold = rng.integers(0, k, n)
+    for f in range(k):
+        test = fold == f
+        yield ~test, test

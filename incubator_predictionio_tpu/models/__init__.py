@@ -1,0 +1,2 @@
+"""Bundled template algorithm families (reference: the five engine
+templates of SURVEY.md §2.8, re-built TPU-first on ops/)."""

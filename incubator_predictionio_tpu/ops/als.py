@@ -1,0 +1,217 @@
+"""Alternating Least Squares on a TPU mesh.
+
+The reference's recommendation templates call MLlib's Spark ALS
+(reference: examples/scala-parallel-recommendation — mllib ALS.train /
+ALS.trainImplicit; the distributed in/out-block shuffle lives inside Spark,
+SURVEY.md §2.9). This is a ground-up TPU design instead, following the ALX
+recipe (PAPERS.md: arxiv 2112.02194):
+
+- Factor matrices are dense f32 arrays. The side being *solved* is
+  row-sharded over the mesh data axis; the counterpart factor matrix is
+  gathered (replicated) for the solve — the ICI all-gather replaces
+  MLlib's factor shuffle.
+- Ratings are laid out as blocked-COO tiles (ops/blocked.py), twice:
+  user-major and item-major. Per-tile Gram matrices are batched einsums
+  on the MXU; tile→row segment-sums are device-local by construction.
+- One half-step solves the regularized normal equations
+  (YᵀY + λ·c·I) x = Yᵀr per row with a batched Cholesky solve.
+- The whole iteration loop runs inside one jit under shard_map; the only
+  cross-device traffic is the all-gather of freshly solved factors.
+
+Regularization conventions (must match template behaviour — SURVEY.md §7
+"hard parts"): ``lambda_scaling='nratings'`` multiplies λ by the row's
+rating count (ALS-WR, classic MLlib); ``'plain'`` uses λ directly
+(Spark ≥1.4 default). Both supported; explicit and implicit feedback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from .blocked import BlockedRows, ShardedBlocked, build_blocked, shard_blocked
+from ..parallel.mesh import DATA_AXIS, default_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSParams:
+    rank: int = 10
+    num_iterations: int = 10
+    reg: float = 0.01  # "lambda" in engine.json (reserved word in Python)
+    lambda_scaling: str = "plain"  # 'plain' | 'nratings'
+    implicit_prefs: bool = False
+    alpha: float = 1.0  # implicit-feedback confidence weight
+    seed: int = 3
+    block_len: int = 32
+    compute_dtype: str = "float32"  # bf16 tiles on TPU, f32 on CPU tests
+
+
+@dataclasses.dataclass
+class ALSFactors:
+    user_factors: np.ndarray  # [n_users, k] f32 (host side after train)
+    item_factors: np.ndarray  # [n_items, k]
+    n_users: int
+    n_items: int
+
+
+def _half_step_local(y, col, val, mask, local_row, counts, yty, *,
+                     rows_per_shard, reg, lambda_scaling, implicit, alpha,
+                     compute_dtype):
+    """Solve one side's factors for one shard's rows (runs inside
+    shard_map; all arrays are the local shard)."""
+    k = y.shape[1]
+    cd = compute_dtype
+    p = y[col].astype(cd)  # [Bs, L, k] gather of counterpart factors
+    m = mask[..., None].astype(cd)
+    pm = p * m
+    if implicit:
+        # Hu-Koren-Volinsky: A = YᵀY + Yᵀ(C-I)Y + λ·c·I, b = YᵀCp where
+        # p=1 for observed. C-I = alpha·r on observed entries only.
+        cw = (alpha * val)[..., None].astype(cd)  # confidence-1 weights
+        grams = jnp.einsum("blk,blm->bkm", pm * cw, pm,
+                           preferred_element_type=jnp.float32)
+        rhs = jnp.einsum("blk,bl->bk", pm, (1.0 + alpha * val) * mask,
+                         preferred_element_type=jnp.float32)
+    else:
+        grams = jnp.einsum("blk,blm->bkm", pm, pm,
+                           preferred_element_type=jnp.float32)
+        rhs = jnp.einsum("blk,bl->bk", pm, (val * mask).astype(cd),
+                         preferred_element_type=jnp.float32)
+
+    a = jax.ops.segment_sum(grams, local_row, num_segments=rows_per_shard)
+    b = jax.ops.segment_sum(rhs, local_row, num_segments=rows_per_shard)
+    if implicit:
+        a = a + yty[None, :, :]  # shared YᵀY term (all items)
+
+    if lambda_scaling == "nratings":
+        lam = reg * jnp.maximum(counts.astype(jnp.float32), 1.0)
+    else:
+        lam = jnp.full(counts.shape, reg, dtype=jnp.float32)
+    # Rows with no ratings keep a well-conditioned system (solution 0).
+    lam = lam + jnp.where(counts == 0, 1e-6, 0.0)
+    a = a + lam[:, None, None] * jnp.eye(k, dtype=jnp.float32)
+
+    chol = jnp.linalg.cholesky(a)
+    x = jax.scipy.linalg.cho_solve((chol, True), b[..., None])[..., 0]
+    return x.astype(jnp.float32)
+
+
+def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
+                   items: ShardedBlocked):
+    """Build the jitted full training loop for fixed layouts."""
+    cd = jnp.bfloat16 if params.compute_dtype == "bfloat16" else jnp.float32
+    implicit = params.implicit_prefs
+
+    row_spec = P(DATA_AXIS)          # tiles / rows split over mesh
+    rep = P()                        # replicated
+
+    def one_side(y, blk_cols, blk_vals, blk_mask, blk_lrow, counts, rows_per_shard):
+        yty = (
+            jnp.einsum("nk,nm->km", y.astype(cd), y.astype(cd),
+                       preferred_element_type=jnp.float32)
+            if implicit
+            else jnp.zeros((params.rank, params.rank), jnp.float32)
+        )
+        fn = shard_map(
+            functools.partial(
+                _half_step_local,
+                rows_per_shard=rows_per_shard,
+                reg=params.reg,
+                lambda_scaling=params.lambda_scaling,
+                implicit=implicit,
+                alpha=params.alpha,
+                compute_dtype=cd,
+            ),
+            mesh=mesh,
+            in_specs=(rep, row_spec, row_spec, row_spec, row_spec, row_spec, rep),
+            out_specs=row_spec,
+        )
+        return fn(y, blk_cols, blk_vals, blk_mask, blk_lrow, counts, yty)
+
+    u_rps, i_rps = users.rows_per_shard, items.rows_per_shard
+
+    # The big tile arrays enter as jit args (not baked-in constants).
+    def loop(x0, y0, u_col, u_val, u_mask, u_lrow, u_counts,
+             i_col, i_val, i_mask, i_lrow, i_counts):
+        def body(_, carry):
+            x, y = carry
+            x = one_side(y, u_col, u_val, u_mask, u_lrow, u_counts, u_rps)
+            y = one_side(x, i_col, i_val, i_mask, i_lrow, i_counts, i_rps)
+            return (x, y)
+
+        return jax.lax.fori_loop(0, params.num_iterations, body, (x0, y0))
+
+    shardings = {
+        "row2": NamedSharding(mesh, P(DATA_AXIS, None)),
+        "row1": NamedSharding(mesh, P(DATA_AXIS)),
+        "rep": NamedSharding(mesh, P()),
+    }
+    in_shardings = (
+        shardings["rep"], shardings["rep"],
+        shardings["row2"], shardings["row2"], shardings["row2"],
+        shardings["row1"], shardings["row1"],
+        shardings["row2"], shardings["row2"], shardings["row2"],
+        shardings["row1"], shardings["row1"],
+    )
+    return jax.jit(
+        loop,
+        in_shardings=in_shardings,
+        out_shardings=(shardings["rep"], shardings["rep"]),
+    )
+
+
+def train_als(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    rating: np.ndarray,
+    n_users: int,
+    n_items: int,
+    params: ALSParams,
+    mesh: Optional[Mesh] = None,
+) -> ALSFactors:
+    """Train explicit/implicit ALS from a COO rating triple."""
+    mesh = mesh or default_mesh()
+    n_dev = int(np.prod(list(mesh.shape.values())))
+
+    by_user = shard_blocked(
+        build_blocked(user_idx, item_idx, rating, n_users, params.block_len), n_dev
+    )
+    by_item = shard_blocked(
+        build_blocked(item_idx, user_idx, rating, n_items, params.block_len), n_dev
+    )
+
+    rng = np.random.default_rng(params.seed)
+    k = params.rank
+    # MLlib-style init: scaled standard normal.
+    x0 = (rng.standard_normal((by_user.padded_rows, k)) / np.sqrt(k)).astype(np.float32)
+    y0 = (rng.standard_normal((by_item.padded_rows, k)) / np.sqrt(k)).astype(np.float32)
+
+    fn = _make_train_fn(mesh, params, by_user, by_item)
+    x, y = fn(
+        x0, y0,
+        by_user.col, by_user.val, by_user.mask, by_user.local_row, by_user.counts,
+        by_item.col, by_item.val, by_item.mask, by_item.local_row, by_item.counts,
+    )
+    x, y = jax.device_get((x, y))
+    return ALSFactors(
+        user_factors=np.asarray(x)[:n_users],
+        item_factors=np.asarray(y)[:n_items],
+        n_users=n_users,
+        n_items=n_items,
+    )
+
+
+def predict_rmse(factors: ALSFactors, user_idx, item_idx, rating) -> float:
+    """Host-side RMSE over a COO triple (eval helper)."""
+    x = factors.user_factors[np.asarray(user_idx)]
+    y = factors.item_factors[np.asarray(item_idx)]
+    pred = np.sum(x * y, axis=1)
+    err = pred - np.asarray(rating, dtype=np.float32)
+    return float(np.sqrt(np.mean(err**2)))

@@ -1,0 +1,77 @@
+"""Top-k scoring kernels for serving (the `recommendProducts` hot path).
+
+Reference behaviour: MLlib MatrixFactorizationModel.recommendProducts —
+driver-side BLAS dot products + sort (SURVEY.md §3.2 hot path). TPU-native:
+one fused matvec + lax.top_k per query, jitted once per (model-shape, k);
+the engine server calls the cached executable so per-query Python work is
+JSON parsing only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _topk_scores(user_vec, item_factors, exclude_mask, k: int):
+    scores = item_factors @ user_vec  # [n_items]
+    scores = jnp.where(exclude_mask, -jnp.inf, scores)
+    return jax.lax.top_k(scores, k)
+
+
+def top_k_items(user_vec, item_factors, k: int, exclude=None):
+    """Returns (scores[k], indices[k]) as host numpy arrays.
+
+    ``exclude``: optional bool mask [n_items] of items to suppress
+    (seen-item filtering for the e-commerce template).
+    """
+    n_items = item_factors.shape[0]
+    if exclude is None:
+        exclude = jnp.zeros((n_items,), dtype=bool)
+    k = min(int(k), n_items)
+    out = _topk_scores(
+        jnp.asarray(user_vec), jnp.asarray(item_factors), jnp.asarray(exclude), k
+    )
+    # Single host transfer: through a remote-PJRT tunnel each device_get is
+    # a round-trip, so fetching (scores, idx) together halves query latency.
+    return jax.device_get(out)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _batch_topk(user_vecs, item_factors, k: int):
+    scores = user_vecs @ item_factors.T  # [b, n_items]
+    return jax.lax.top_k(scores, k)
+
+
+def batch_top_k(user_vecs, item_factors, k: int):
+    """Vectorized top-k for batch_predict/eval sweeps."""
+    k = min(int(k), item_factors.shape[0])
+    return jax.device_get(
+        _batch_topk(jnp.asarray(user_vecs), jnp.asarray(item_factors), k)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _item_sim_topk(query_vecs, item_factors, exclude_mask, k: int):
+    """Cosine similarity of query items against the catalog, summed over
+    query items (similar-product semantics)."""
+    qn = query_vecs / (jnp.linalg.norm(query_vecs, axis=1, keepdims=True) + 1e-9)
+    fn = item_factors / (jnp.linalg.norm(item_factors, axis=1, keepdims=True) + 1e-9)
+    scores = (fn @ qn.T).sum(axis=1)  # [n_items]
+    scores = jnp.where(exclude_mask, -jnp.inf, scores)
+    return jax.lax.top_k(scores, k)
+
+
+def similar_items(query_vecs, item_factors, k: int, exclude=None):
+    n_items = item_factors.shape[0]
+    if exclude is None:
+        exclude = jnp.zeros((n_items,), dtype=bool)
+    k = min(int(k), n_items)
+    return jax.device_get(
+        _item_sim_topk(
+            jnp.asarray(query_vecs), jnp.asarray(item_factors), jnp.asarray(exclude), k
+        )
+    )

@@ -1,0 +1,20 @@
+"""Mesh/sharding/collective helpers — the TPU-native replacement for the
+reference's Spark cluster + shuffle layer (SURVEY.md §2.9-2.10)."""
+
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    default_mesh,
+    local_device_count,
+    mesh_from_devices,
+    replicated,
+    shard_rows,
+    with_mesh,
+)
+from .distributed import initialize_distributed, is_multi_host, process_count
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "default_mesh", "initialize_distributed",
+    "is_multi_host", "local_device_count", "mesh_from_devices",
+    "process_count", "replicated", "shard_rows", "with_mesh",
+]

@@ -1,0 +1,52 @@
+"""Multi-host initialization — the control-plane analog of Spark's
+driver/executor RPC (reference dependency; SURVEY.md §2.10).
+
+Single-host: no-op. Multi-host: `jax.distributed.initialize` connects every
+host to the coordination service over DCN; afterwards jax.devices() spans
+the pod and the same mesh/pjit code runs unchanged (single-controller SPMD
+per host — the workflow binary is simply launched once per host, the way
+the reference launches one executor JVM per node).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("pio.distributed")
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_multi_host() -> bool:
+    return jax.process_count() > 1
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize JAX multi-controller runtime from args or PIO_* env vars
+    (PIO_COORDINATOR_ADDRESS, PIO_NUM_PROCESSES, PIO_PROCESS_ID). Safe to
+    call when unset → single-process mode."""
+    coordinator_address = coordinator_address or os.environ.get("PIO_COORDINATOR_ADDRESS")
+    if not coordinator_address:
+        log.debug("single-process mode (no PIO_COORDINATOR_ADDRESS)")
+        return
+    num_processes = num_processes or int(os.environ.get("PIO_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(os.environ.get("PIO_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "jax.distributed initialized: process %d/%d, %d global devices",
+        process_id, num_processes, len(jax.devices()),
+    )

@@ -1,0 +1,92 @@
+"""Device-mesh construction + sharding helpers.
+
+The reference scales via Spark executors + shuffle (external dependency;
+SURVEY.md §2.10). The TPU-native equivalent: a `jax.sharding.Mesh` over all
+devices with named axes, NamedSharding annotations on arrays, and XLA
+emitting collectives over ICI from pjit/shard_map. Every algorithm in
+models/ trains against a mesh obtained here.
+
+Axis conventions:
+- ``DATA_AXIS`` ('d'): batch/entity-row sharding — users in the ALS user
+  solve, examples in NB/LR sufficient-stat reductions (psum over 'd').
+- ``MODEL_AXIS`` ('m'): reserved for factor/feature sharding when a factor
+  matrix exceeds one chip's HBM (ALX-style; 2-D meshes are constructed on
+  demand via mesh_from_devices(shape=(dp, mp))).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "d"
+MODEL_AXIS = "m"
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def mesh_from_devices(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    devices=None,
+) -> Mesh:
+    """Build a mesh over the given devices (default: all).
+
+    shape=None → 1-D mesh over every device on axis 'd'.
+    shape=(dp, mp) with axis_names=('d','m') → 2-D factor-sharded layouts.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),)
+    arr = np.array(devices).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names[: arr.ndim]))
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def default_mesh(refresh: bool = False) -> Mesh:
+    """Process-wide default 1-D mesh (cached)."""
+    global _default_mesh
+    if _default_mesh is None or refresh:
+        _default_mesh = mesh_from_devices()
+    return _default_mesh
+
+
+def shard_rows(mesh: Mesh, ndim: int = 1, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding that splits dim 0 over the data axis, replicating the rest."""
+    spec = P(axis, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def device_put_sharded_rows(x, mesh: Mesh, axis: str = DATA_AXIS):
+    """Host numpy → row-sharded device array. Row count must divide the
+    axis size (callers pad with pad_rows first)."""
+    x = np.asarray(x)
+    return jax.device_put(x, shard_rows(mesh, x.ndim, axis))
+
+
+def pad_rows(x: np.ndarray, multiple: int, fill=0) -> np.ndarray:
+    """Pad dim 0 up to a multiple (static shapes for XLA; masked later)."""
+    n = x.shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return x
+    pad_width = [(0, target - n)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_width, constant_values=fill)
+
+
+@contextlib.contextmanager
+def with_mesh(mesh: Mesh):
+    with mesh:
+        yield mesh

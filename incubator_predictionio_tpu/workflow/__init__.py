@@ -1,0 +1,13 @@
+"""Workflow runtime: train/eval/deploy orchestration.
+
+Reference layer map: SURVEY.md §2.5 (core/.../workflow/).
+"""
+
+from .workflow_params import WorkflowParams
+from .context import WorkflowContext
+from .json_extractor import load_engine_json, resolve_engine_factory
+
+__all__ = [
+    "WorkflowContext", "WorkflowParams", "load_engine_json",
+    "resolve_engine_factory",
+]

@@ -1,0 +1,35 @@
+"""WorkflowContext — what `ctx` means inside DASE components.
+
+The reference passes a SparkContext through every DASE call
+(reference: core/.../workflow/WorkflowContext.scala). The TPU-native
+context carries the device mesh + storage registry + app binding instead:
+everything a component needs to read events and place arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..data.storage.registry import Storage
+from ..workflow.workflow_params import WorkflowParams
+
+
+@dataclasses.dataclass
+class WorkflowContext:
+    app_name: str = ""
+    channel_name: Optional[str] = None
+    storage: Optional[Storage] = None
+    mesh: Any = None  # jax.sharding.Mesh; lazily built to keep import light
+    workflow_params: WorkflowParams = dataclasses.field(default_factory=WorkflowParams)
+    engine_instance_id: Optional[str] = None
+
+    def get_storage(self) -> Storage:
+        return self.storage or Storage.instance()
+
+    def get_mesh(self):
+        if self.mesh is None:
+            from ..parallel.mesh import default_mesh
+
+            self.mesh = default_mesh()
+        return self.mesh

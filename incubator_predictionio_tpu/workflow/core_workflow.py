@@ -1,0 +1,178 @@
+"""CoreWorkflow — run a training job and persist its results.
+
+Reference: core/.../workflow/{CoreWorkflow,CreateWorkflow}.scala: stamp an
+EngineInstance row RUNNING → COMPLETED, run engine.train, serialize models
+into the Models DAO (or let PersistentModel models save themselves).
+No spark-submit: the whole thing is one in-process call (SURVEY.md §7
+design stance).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import logging
+import pickle
+from typing import Any, Optional
+
+from ..controller.engine import Engine, EngineParams
+from ..controller.persistent_model import PersistentModel
+from ..data.storage.base import EngineInstance, Model
+from ..data.storage.event import new_event_id
+from .context import WorkflowContext
+from .workflow_params import WorkflowParams
+
+log = logging.getLogger("pio.workflow")
+
+
+def _utcnow():
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def serialize_models(algo_list, models: list[Any]) -> bytes:
+    """Device pytrees → host → pickle (reference: Engine.makeSerializableModels
+    + java serialization into the Models DAO). PersistentModel entries are
+    replaced by a marker — they saved themselves."""
+    prepared = []
+    for (name, algo), model in zip(algo_list, models):
+        if isinstance(model, PersistentModel):
+            prepared.append({"__persistent__": type(model).__module__ + "." + type(model).__qualname__})
+        else:
+            prepared.append(algo.prepare_model_for_persistence(model))
+    return pickle.dumps(prepared, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_models(blob: bytes, algo_list, instance_id: str, ctx) -> list[Any]:
+    import importlib
+
+    stored = pickle.loads(blob)
+    out = []
+    for (name, algo), item in zip(algo_list, stored):
+        if isinstance(item, dict) and "__persistent__" in item:
+            dotted = item["__persistent__"]
+            module_name, _, cls_name = dotted.rpartition(".")
+            cls = getattr(importlib.import_module(module_name), cls_name)
+            out.append(cls.load(instance_id, ctx))
+        else:
+            out.append(item)
+    return out
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    ctx: Optional[WorkflowContext] = None,
+    workflow_params: Optional[WorkflowParams] = None,
+    engine_factory_name: str = "",
+    engine_variant: str = "default",
+) -> str:
+    """Run the training workflow; returns the engine-instance id.
+
+    Call stack parity with SURVEY.md §3.1: Console→train lands here, then
+    Engine.train → DataSource.read_training → Preparator.prepare →
+    Algorithm.train (pjit'd hot loop) → model persistence.
+    """
+    ctx = ctx or WorkflowContext()
+    wp = workflow_params or WorkflowParams()
+    storage = ctx.get_storage()
+    instances = storage.get_meta_data_engine_instances()
+
+    instance = EngineInstance(
+        id=new_event_id(),
+        status="RUNNING",
+        start_time=_utcnow(),
+        end_time=None,
+        engine_id=engine_factory_name or "engine",
+        engine_version="1",
+        engine_variant=engine_variant,
+        engine_factory=engine_factory_name,
+        batch=wp.batch,
+        env={"appName": ctx.app_name},
+        data_source_params=json.dumps(dict(engine_params.data_source_params)),
+        preparator_params=json.dumps(dict(engine_params.preparator_params)),
+        algorithms_params=json.dumps(
+            [{"name": n, "params": dict(p)} for n, p in engine_params.algorithm_params_list]
+        ),
+        serving_params=json.dumps(dict(engine_params.serving_params)),
+    )
+    instance_id = instances.insert(instance)
+    ctx.engine_instance_id = instance_id
+    log.info("EngineInstance %s RUNNING", instance_id)
+
+    try:
+        models = engine.train(ctx, engine_params, wp)
+        if wp.stop_after_read or wp.stop_after_prepare:
+            instances.update(instance.with_status("ABORTED", _utcnow()))
+            return instance_id
+
+        _, _, algo_list, _ = engine.make_components(engine_params)
+        persistent = 0
+        for (name, algo), model in zip(algo_list, models):
+            if isinstance(model, PersistentModel):
+                if model.save(instance_id, algo.params):
+                    persistent += 1
+        blob = serialize_models(algo_list, models)
+        storage.get_model_data_models().insert(Model(instance_id, blob))
+        log.info(
+            "models persisted: %d bytes pickled, %d self-persisted",
+            len(blob), persistent,
+        )
+        done = EngineInstance(
+            **{**instance.__dict__, "id": instance_id}
+        ).with_status("COMPLETED", _utcnow())
+        instances.update(done)
+        log.info("EngineInstance %s COMPLETED", instance_id)
+        return instance_id
+    except Exception:
+        instances.update(
+            EngineInstance(**{**instance.__dict__, "id": instance_id}).with_status(
+                "ABORTED", _utcnow()
+            )
+        )
+        raise
+
+
+def load_deployment(
+    engine: Engine,
+    instance_id: Optional[str],
+    ctx: Optional[WorkflowContext] = None,
+    engine_factory_name: str = "",
+    engine_variant: str = "default",
+):
+    """Load a trained instance for serving (reference: CreateServer /
+    MasterActor prepareDeployment). instance_id None → latest COMPLETED."""
+    ctx = ctx or WorkflowContext()
+    storage = ctx.get_storage()
+    instances = storage.get_meta_data_engine_instances()
+    if instance_id is None:
+        latest = instances.get_latest_completed(
+            engine_factory_name or "engine", "1", engine_variant
+        )
+        if latest is None:
+            raise RuntimeError(
+                "No COMPLETED engine instance found; run `pio train` first"
+            )
+        instance = latest
+    else:
+        instance = instances.get(instance_id)
+        if instance is None:
+            raise RuntimeError(f"Engine instance {instance_id} not found")
+
+    engine_params = EngineParams(
+        data_source_params=json.loads(instance.data_source_params),
+        preparator_params=json.loads(instance.preparator_params),
+        algorithm_params_list=[
+            (a["name"], a["params"]) for a in json.loads(instance.algorithms_params)
+        ],
+        serving_params=json.loads(instance.serving_params),
+    )
+    ctx.engine_instance_id = instance.id
+    if not ctx.app_name:
+        ctx.app_name = instance.env.get("appName", "")
+    model_row = storage.get_model_data_models().get(instance.id)
+    if model_row is None:
+        raise RuntimeError(f"No model blob for engine instance {instance.id}")
+    _, _, algo_list, _ = engine.make_components(engine_params)
+    models = deserialize_models(model_row.models, algo_list, instance.id, ctx)
+    deployment = engine.prepare_deployment(ctx, engine_params, models)
+    return deployment, instance, engine_params

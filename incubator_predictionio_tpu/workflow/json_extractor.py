@@ -1,0 +1,80 @@
+"""engine.json parsing + engine-factory resolution.
+
+Reference: core/.../workflow/JsonExtractor.scala (JSON → Params) and the
+reflective EngineFactory loading in CreateWorkflow. The Python analog:
+``engineFactory`` is a dotted path ``package.module.ClassOrFunction``
+resolved via importlib; it may name an EngineFactory subclass, a function
+returning an Engine, or an Engine instance.
+
+engine.json shape (wire-compatible with the reference):
+{
+  "id": "default", "description": ..., "engineFactory": "mytpl.engine.RecommendationEngine",
+  "datasource": {"params": {...}},
+  "preparator": {"params": {...}},
+  "algorithms": [{"name": "als", "params": {...}}],
+  "serving": {"params": {...}}
+}
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+from typing import Any, Optional, Tuple
+
+from ..controller.engine import Engine, EngineFactory, EngineParams
+
+
+def load_engine_json(path: str, variant: Optional[str] = None) -> dict:
+    """Read engine.json; ``variant`` selects engine.json.<variant> the way
+    --engine-variant does upstream."""
+    if variant:
+        base, name = os.path.split(path)
+        path = os.path.join(base, f"{name}.{variant}") if not name.endswith(variant) else path
+    with open(path) as f:
+        return json.load(f)
+
+
+def resolve_engine_factory(dotted: str, engine_dir: Optional[str] = None):
+    """Dotted path → callable returning an Engine (Doer/reflection analog).
+
+    ``engine_dir`` is prepended to sys.path so template projects resolve
+    exactly like the reference's engine-jar classpath."""
+    if engine_dir and engine_dir not in sys.path:
+        sys.path.insert(0, engine_dir)
+    module_name, _, attr = dotted.rpartition(".")
+    if not module_name:
+        raise ValueError(f"engineFactory {dotted!r} must be module.ClassName")
+    module = importlib.import_module(module_name)
+    obj = getattr(module, attr)
+    return obj
+
+
+def engine_from_factory(factory_obj) -> Engine:
+    if isinstance(factory_obj, Engine):
+        return factory_obj
+    if isinstance(factory_obj, type) and issubclass(factory_obj, EngineFactory):
+        return factory_obj()()
+    if isinstance(factory_obj, EngineFactory):
+        return factory_obj()
+    if callable(factory_obj):
+        engine = factory_obj()
+        if isinstance(engine, Engine):
+            return engine
+    raise TypeError(
+        f"engineFactory resolved to {factory_obj!r}, which did not produce an Engine"
+    )
+
+
+def engine_and_params_from_json(
+    engine_json: dict, engine_dir: Optional[str] = None
+) -> Tuple[Engine, EngineParams, str]:
+    factory_path = engine_json.get("engineFactory")
+    if not factory_path:
+        raise ValueError("engine.json is missing engineFactory")
+    factory = resolve_engine_factory(factory_path, engine_dir)
+    engine = engine_from_factory(factory)
+    params = EngineParams.from_json(engine_json)
+    return engine, params, factory_path

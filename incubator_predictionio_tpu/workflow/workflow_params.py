@@ -1,0 +1,15 @@
+"""WorkflowParams (reference: core/.../workflow/WorkflowParams.scala —
+batch label, verbosity, sanity-check and pipeline-bisection flags)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class WorkflowParams:
+    batch: str = ""
+    verbose: int = 10
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False

@@ -9,7 +9,6 @@ in-process without TPU hardware.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +16,17 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The sandbox's axon PJRT plugin (sitecustomize) force-selects the TPU
+# backend regardless of JAX_PLATFORMS, so flip the default platform AFTER
+# import — jax.devices() then returns the 8 virtual CPU devices. Storage
+# tests don't need jax, so a missing install only skips this step.
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platform_name", "cpu")
+except ImportError:  # pragma: no cover - jax is bundled in this sandbox
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,134 @@
+"""End-to-end DASE slice: events in storage → train workflow → model
+persisted → deployment reload → query (the reference's quickstart
+lifecycle, SURVEY.md §3.1-3.2, without the HTTP layer)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.controller import EngineParams
+from incubator_predictionio_tpu.data.storage import App, DataMap, Event
+from incubator_predictionio_tpu.models.recommendation import (
+    RecommendationEngine,
+)
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import (
+    load_deployment,
+    run_train,
+)
+
+
+def _seed_ratings(storage, app_name="testapp", n_users=30, n_items=20, seed=0):
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, app_name))
+    le = storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(seed)
+    xu = rng.standard_normal((n_users, 3))
+    xi = rng.standard_normal((n_items, 3))
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    events = []
+    for u in range(n_users):
+        for i in range(n_items):
+            if rng.random() < 0.4:
+                r = float(np.clip(xu[u] @ xi[i] + 3.0, 1, 5))
+                events.append(
+                    Event(
+                        "rate", "user", str(u), "item", f"i{i}",
+                        DataMap({"rating": r}), t0 + dt.timedelta(seconds=len(events)),
+                    )
+                )
+    le.insert_batch(events, app_id)
+    return app_id, len(events)
+
+
+@pytest.fixture()
+def seeded(memory_storage):
+    app_id, n = _seed_ratings(memory_storage)
+    return memory_storage, app_id, n
+
+
+ENGINE_PARAMS = EngineParams.from_json(
+    {
+        "datasource": {"params": {"app_name": "testapp"}},
+        "algorithms": [
+            {"name": "als",
+             "params": {"rank": 8, "numIterations": 8, "lambda": 0.05}}
+        ],
+    }
+)
+
+
+def test_train_persist_reload_query(seeded):
+    storage, app_id, n_events = seeded
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=storage)
+
+    instance_id = run_train(
+        engine, ENGINE_PARAMS, ctx, engine_factory_name="rec.Engine"
+    )
+    instance = storage.get_meta_data_engine_instances().get(instance_id)
+    assert instance.status == "COMPLETED"
+    assert instance.end_time is not None
+
+    # model blob exists
+    assert storage.get_model_data_models().get(instance_id) is not None
+
+    # reload latest-completed (fresh ctx = new process simulation)
+    deployment, loaded_instance, _ = load_deployment(
+        engine, None, WorkflowContext(storage=storage),
+        engine_factory_name="rec.Engine",
+    )
+    assert loaded_instance.id == instance_id
+
+    result = deployment.query({"user": "0", "num": 5})
+    assert len(result["itemScores"]) == 5
+    scores = [s["score"] for s in result["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+    assert all(isinstance(s["item"], str) for s in result["itemScores"])
+
+    # unknown user → empty result, not a crash
+    assert deployment.query({"user": "nope", "num": 3}) == {"itemScores": []}
+
+
+def test_recommendations_reflect_ratings(seeded):
+    """Model quality: a user's top recommendations should score their
+    actually-highly-rated items above their low-rated ones."""
+    storage, _, _ = seeded
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=storage)
+    ds, prep, algo_list, _ = engine.make_components(ENGINE_PARAMS)
+    td = ds.read_training(ctx)
+    model = algo_list[0][1].train(ctx, prep.prepare(ctx, td))
+
+    # in-sample fit: predicted vs actual correlation is strongly positive
+    uf = model.factors.user_factors[td.user_idx]
+    itf = model.factors.item_factors[td.item_idx]
+    pred = np.sum(uf * itf, axis=1)
+    corr = np.corrcoef(pred, td.rating)[0, 1]
+    assert corr > 0.9, f"weak fit, corr={corr}"
+
+
+def test_stop_after_read_aborts(seeded):
+    from incubator_predictionio_tpu.workflow.workflow_params import WorkflowParams
+
+    storage, _, _ = seeded
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=storage)
+    iid = run_train(
+        engine, ENGINE_PARAMS, ctx,
+        workflow_params=WorkflowParams(stop_after_read=True),
+        engine_factory_name="rec.Engine",
+    )
+    assert storage.get_meta_data_engine_instances().get(iid).status == "ABORTED"
+
+
+def test_missing_app_is_clear_error(memory_storage):
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="ghost", storage=memory_storage)
+    with pytest.raises(ValueError, match="does not exist"):
+        run_train(engine, ENGINE_PARAMS.__class__.from_json(
+            {"datasource": {"params": {"app_name": "ghost"}},
+             "algorithms": [{"name": "als", "params": {"rank": 4}}]}
+        ), ctx)
