@@ -1,0 +1,203 @@
+"""Benchmark: `pio train` ALS throughput at MovieLens-20M shape.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "events/sec/chip", "vs_baseline": N}
+
+Metric definition (BASELINE.json north star): events/sec/chip for
+`pio train` on the Recommendation template = dataset ratings consumed per
+wall-second of the full training run (10 ALS iterations, rank from env).
+The timed run is the steady-state execution of the pre-compiled XLA
+program; compile time is reported separately on stderr.
+
+Baseline: the reference publishes no numbers (BASELINE.md) and Spark is
+not installable in this sandbox, so the recorded baseline is a measured
+single-core NumPy ALS on the same math (normal equations, Cholesky) —
+the "Spark local[1] MLlib" stand-in — extrapolated per-event from a
+subsample and cached in BASELINE.json under "published".
+
+Env knobs: PIO_BENCH_SCALE=ml20m|ml1m|ml100k (default ml20m),
+PIO_BENCH_RANK (default 32), PIO_BENCH_ITERS (default 10),
+PIO_BENCH_FORCE_CPU=1 for smoke-testing the harness off-TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SCALES = {
+    # name: (n_users, n_items, nnz)  — MovieLens dataset shapes
+    "ml100k": (943, 1682, 100_000),
+    "ml1m": (6040, 3706, 1_000_209),
+    "ml20m": (138_493, 26_744, 20_000_263),
+}
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def synth_ratings(n_users, n_items, nnz, seed=7):
+    """Zipf-ish synthetic ratings with MovieLens-like popularity skew."""
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    # popularity-skewed items: square a uniform to bias toward low ids
+    i = (n_items * rng.random(nnz) ** 2).astype(np.int32)
+    i = np.minimum(i, n_items - 1)
+    r = rng.integers(1, 11, nnz).astype(np.float32) / 2.0  # 0.5..5.0
+    return u, i, r
+
+
+def numpy_baseline_events_per_sec(rank, main_iters, iters=2, nnz_sub=200_000, seed=7):
+    """Single-core NumPy ALS on a subsample; returns events/sec in the
+    SAME unit as the main metric: dataset events consumed per wall-second
+    of a `main_iters`-iteration training run (measured per-iteration time
+    scaled to main_iters)."""
+    n_users, n_items = 2000, 1500
+    u, i, r = synth_ratings(n_users, n_items, nnz_sub, seed)
+    k = rank
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n_users, k)).astype(np.float64) / np.sqrt(k)
+    y = rng.standard_normal((n_items, k)).astype(np.float64) / np.sqrt(k)
+    order_u = np.argsort(u, kind="stable")
+    order_i = np.argsort(i, kind="stable")
+    t0 = time.time()
+    eye = 0.01 * np.eye(k)
+    for _ in range(iters):
+        for rows, cols, vals, n_rows, other in (
+            (u[order_u], i[order_u], r[order_u], n_users, y),
+            (i[order_i], u[order_i], r[order_i], n_items, x),
+        ):
+            starts = np.searchsorted(rows, np.arange(n_rows))
+            ends = np.searchsorted(rows, np.arange(n_rows) + 1)
+            solved = np.zeros((n_rows, k))
+            for rr in range(n_rows):
+                s, e = starts[rr], ends[rr]
+                if s == e:
+                    continue
+                yy = other[cols[s:e]]
+                a = yy.T @ yy + eye
+                b = yy.T @ vals[s:e]
+                solved[rr] = np.linalg.solve(a, b)
+            if n_rows == n_users:
+                x = solved
+            else:
+                y = solved
+    dt = time.time() - t0
+    per_iter = dt / iters
+    return nnz_sub / (per_iter * main_iters)
+
+
+def main() -> int:
+    scale = os.environ.get("PIO_BENCH_SCALE", "ml20m")
+    rank = int(os.environ.get("PIO_BENCH_RANK", "32"))
+    iters = int(os.environ.get("PIO_BENCH_ITERS", "10"))
+    n_users, n_items, nnz = SCALES[scale]
+
+    if os.environ.get("PIO_BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax
+
+    from incubator_predictionio_tpu.ops.als import (
+        ALSParams, _make_train_fn,
+    )
+    from incubator_predictionio_tpu.ops.blocked import build_blocked, shard_blocked
+    from incubator_predictionio_tpu.parallel.mesh import default_mesh
+
+    log(f"[bench] scale={scale} users={n_users} items={n_items} nnz={nnz} "
+        f"rank={rank} iters={iters} devices={jax.devices()}")
+
+    t0 = time.time()
+    u, i, r = synth_ratings(n_users, n_items, nnz)
+    mesh = default_mesh()
+    n_dev = len(mesh.devices.flatten().tolist())
+    params = ALSParams(
+        rank=rank, num_iterations=iters, reg=0.01, block_len=32,
+        compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
+        chunk_tiles=65536 if scale == "ml20m" else 0,
+    )
+    by_user = shard_blocked(build_blocked(u, i, r, n_users, params.block_len), n_dev)
+    by_item = shard_blocked(build_blocked(i, u, r, n_items, params.block_len), n_dev)
+    log(f"[bench] host prep {time.time()-t0:.1f}s "
+        f"(user tiles {by_user.col.shape}, item tiles {by_item.col.shape})")
+
+    rng = np.random.default_rng(params.seed)
+    x0 = (rng.standard_normal((by_user.padded_rows, rank)) / np.sqrt(rank)).astype(np.float32)
+    y0 = (rng.standard_normal((by_item.padded_rows, rank)) / np.sqrt(rank)).astype(np.float32)
+
+    fn = _make_train_fn(mesh, params, by_user, by_item)
+    args = (
+        x0, y0,
+        by_user.col, by_user.val, by_user.mask, by_user.local_row, by_user.counts,
+        by_item.col, by_item.val, by_item.mask, by_item.local_row, by_item.counts,
+    )
+    t0 = time.time()
+    args_dev = jax.device_put(args)
+    jax.block_until_ready(args_dev)
+    log(f"[bench] device upload {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    compiled = fn.lower(*args_dev).compile()
+    log(f"[bench] compile {time.time()-t0:.1f}s")
+
+    # timed steady-state run
+    t0 = time.time()
+    out = compiled(*args_dev)
+    jax.block_until_ready(out)
+    train_time = time.time() - t0
+    # per-chip: the unit is events/sec/chip, so divide aggregate by devices
+    events_per_sec = nnz / train_time / n_dev
+    log(f"[bench] train {train_time:.2f}s on {n_dev} device(s) → "
+        f"{events_per_sec:,.0f} events/sec/chip "
+        f"({iters} iters, {nnz*iters/train_time:,.0f} rating-updates/sec aggregate)")
+
+    # sanity: finite factors
+    xf = np.asarray(jax.device_get(out[0]))
+    assert np.isfinite(xf).all(), "non-finite factors"
+
+    # baseline: cached measured NumPy single-core ALS
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE.json")
+    baseline_key = f"numpy_single_core_als_rank{rank}_x{iters}iters_events_per_sec"
+    vs_baseline = None
+    try:
+        with open(baseline_path) as f:
+            baseline_doc = json.load(f)
+    except Exception:
+        baseline_doc = {"published": {}}
+    published = baseline_doc.setdefault("published", {})
+    if baseline_key not in published:
+        log("[bench] measuring NumPy single-core baseline (one-time)...")
+        t0 = time.time()
+        published[baseline_key] = numpy_baseline_events_per_sec(rank, iters)
+        published[baseline_key + "_note"] = (
+            "Measured single-core NumPy ALS (same normal-equation math) — "
+            "Spark-local stand-in; reference publishes no numbers and Spark "
+            "is not installable in this sandbox (BASELINE.md)."
+        )
+        log(f"[bench] baseline measured in {time.time()-t0:.1f}s: "
+            f"{published[baseline_key]:,.0f} events/sec")
+        try:
+            with open(baseline_path, "w") as f:
+                json.dump(baseline_doc, f, indent=2)
+        except Exception as e:
+            log(f"[bench] could not persist baseline: {e}")
+    vs_baseline = events_per_sec / published[baseline_key]
+
+    print(json.dumps({
+        "metric": f"pio train ALS {scale} rank{rank} x{iters}iters ({jax.default_backend()})",
+        "value": round(events_per_sec, 1),
+        "unit": "events/sec/chip",
+        "vs_baseline": round(vs_baseline, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+"""Event Server REST API (reference: data/.../data/api/)."""

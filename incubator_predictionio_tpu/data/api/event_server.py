@@ -1,0 +1,301 @@
+"""Event Server — the REST ingestion API on :7070.
+
+Reference: data/.../data/api/EventServer.scala (spray-can service). Wire
+compatibility targets the documented PredictionIO API so existing SDKs
+work unchanged:
+
+  POST   /events.json?accessKey=K[&channel=C]        → 201 {"eventId": id}
+  GET    /events/<id>.json?accessKey=K               → 200 event JSON
+  DELETE /events/<id>.json?accessKey=K               → 200 {"message": ...}
+  GET    /events.json?accessKey=K&<filters>          → 200 [event JSON...]
+  POST   /batch/events.json?accessKey=K              → 200 [per-event status]
+  GET    /                                           → {"status": "alive"}
+  GET    /stats.json?accessKey=K                     → ingestion counters (--stats)
+  POST   /webhooks/<connector>.json?accessKey=K      → 3rd-party adapters
+
+Auth: accessKey query param or Authorization header (basic user = key),
+checked against the AccessKeys DAO; per-key event whitelists enforced
+(reference: Common.withAccessKey / KeyAuthentication).
+
+The aiohttp handlers call synchronous storage DAOs via the default thread
+executor, preserving the reference's async-server/sync-store split.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import datetime as _dt
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..storage.base import AccessKey
+from ..storage.event import Event, EventValidationError, parse_event_time
+from ..storage.registry import Storage
+from ..webhooks import get_connector
+from .stats import Stats
+
+log = logging.getLogger("pio.eventserver")
+
+MAX_BATCH_SIZE = 50  # reference: /batch/events.json limit
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"message": message}, status=status)
+
+
+class EventServer:
+    def __init__(self, storage: Optional[Storage] = None, enable_stats: bool = False):
+        self.storage = storage or Storage.instance()
+        self.stats = Stats() if enable_stats else None
+        self.app = web.Application(client_max_size=16 * 1024 * 1024)
+        self.app.add_routes(
+            [
+                web.get("/", self.handle_root),
+                web.post("/events.json", self.handle_create),
+                web.get("/events.json", self.handle_find),
+                web.get("/events/{event_id}.json", self.handle_get),
+                web.delete("/events/{event_id}.json", self.handle_delete),
+                web.post("/batch/events.json", self.handle_batch),
+                web.get("/stats.json", self.handle_stats),
+                web.post("/webhooks/{connector}.json", self.handle_webhook),
+            ]
+        )
+
+    # -- auth -------------------------------------------------------------
+    def _access_key_str(self, request: web.Request) -> Optional[str]:
+        key = request.query.get("accessKey")
+        if key:
+            return key
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Basic "):
+            try:
+                decoded = base64.b64decode(auth[6:]).decode()
+                return decoded.split(":", 1)[0]
+            except Exception:
+                return None
+        return None
+
+    async def _authorize(self, request: web.Request) -> AccessKey:
+        key = self._access_key_str(request)
+        if not key:
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"message": "Missing accessKey."}),
+                content_type="application/json",
+            )
+        access_key = await asyncio.to_thread(
+            self.storage.get_meta_data_access_keys().get, key
+        )
+        if access_key is None:
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"message": "Invalid accessKey."}),
+                content_type="application/json",
+            )
+        return access_key
+
+    async def _channel_id(
+        self, request: web.Request, access_key: AccessKey
+    ) -> Optional[int]:
+        name = request.query.get("channel")
+        if not name:
+            return None
+        channels = await asyncio.to_thread(
+            self.storage.get_meta_data_channels().get_by_appid, access_key.appid
+        )
+        for c in channels:
+            if c.name == name:
+                return c.id
+        raise web.HTTPBadRequest(
+            text=json.dumps({"message": f"Invalid channel {name!r}."}),
+            content_type="application/json",
+        )
+
+    def _check_event_allowed(self, access_key: AccessKey, event_name: str) -> None:
+        if access_key.events and event_name not in access_key.events:
+            raise web.HTTPForbidden(
+                text=json.dumps(
+                    {"message": f"event {event_name!r} is not allowed for this access key"}
+                ),
+                content_type="application/json",
+            )
+
+    # -- handlers ---------------------------------------------------------
+    async def handle_root(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "alive"})
+
+    async def handle_create(self, request: web.Request) -> web.Response:
+        access_key = await self._authorize(request)
+        channel_id = await self._channel_id(request, access_key)
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _json_error(400, "invalid JSON body")
+        try:
+            body = dict(body) if isinstance(body, dict) else body
+            if isinstance(body, dict):
+                body.pop("creationTime", None)  # server-assigned on ingest
+            event = Event.from_json(body)
+            self._check_event_allowed(access_key, event.event)
+        except EventValidationError as e:
+            self._record(access_key.appid, body, 400)
+            return _json_error(400, str(e))
+        le = self.storage.get_l_events()
+        event_id = await asyncio.to_thread(
+            le.insert, event, access_key.appid, channel_id
+        )
+        self._record(access_key.appid, body, 201)
+        return web.json_response({"eventId": event_id}, status=201)
+
+    async def handle_batch(self, request: web.Request) -> web.Response:
+        access_key = await self._authorize(request)
+        channel_id = await self._channel_id(request, access_key)
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return _json_error(400, "invalid JSON body")
+        if not isinstance(body, list):
+            return _json_error(400, "batch body must be a JSON array")
+        if len(body) > MAX_BATCH_SIZE:
+            return _json_error(
+                400, f"Batch request must have less than or equal to {MAX_BATCH_SIZE} events"
+            )
+        le = self.storage.get_l_events()
+        results = []
+        for obj in body:
+            try:
+                if isinstance(obj, dict):
+                    obj = dict(obj)
+                    obj.pop("creationTime", None)
+                event = Event.from_json(obj)
+                self._check_event_allowed(access_key, event.event)
+                event_id = await asyncio.to_thread(
+                    le.insert, event, access_key.appid, channel_id
+                )
+                results.append({"status": 201, "eventId": event_id})
+                self._record(access_key.appid, obj, 201)
+            except (EventValidationError, web.HTTPForbidden) as e:
+                message = str(e) if isinstance(e, EventValidationError) else "forbidden"
+                results.append({"status": 400, "message": message})
+                self._record(access_key.appid, obj, 400)
+        return web.json_response(results)
+
+    async def handle_get(self, request: web.Request) -> web.Response:
+        access_key = await self._authorize(request)
+        channel_id = await self._channel_id(request, access_key)
+        event = await asyncio.to_thread(
+            self.storage.get_l_events().get,
+            request.match_info["event_id"],
+            access_key.appid,
+            channel_id,
+        )
+        if event is None:
+            return _json_error(404, "Event not found.")
+        return web.json_response(event.to_json())
+
+    async def handle_delete(self, request: web.Request) -> web.Response:
+        access_key = await self._authorize(request)
+        channel_id = await self._channel_id(request, access_key)
+        found = await asyncio.to_thread(
+            self.storage.get_l_events().delete,
+            request.match_info["event_id"],
+            access_key.appid,
+            channel_id,
+        )
+        if not found:
+            return _json_error(404, "Event not found.")
+        return web.json_response({"message": "Found"})
+
+    async def handle_find(self, request: web.Request) -> web.Response:
+        access_key = await self._authorize(request)
+        channel_id = await self._channel_id(request, access_key)
+        q = request.query
+
+        def parse_time(name):
+            v = q.get(name)
+            return parse_event_time(v) if v else None
+
+        try:
+            start_time = parse_time("startTime")
+            until_time = parse_time("untilTime")
+        except EventValidationError as e:
+            return _json_error(400, str(e))
+        try:
+            limit = int(q.get("limit", 20))
+        except ValueError:
+            return _json_error(400, "limit must be an integer")
+        if limit > 500 or limit == 0:
+            limit = 500  # reference caps scans
+        event_names = q.getall("event") if "event" in q else None
+        events = await asyncio.to_thread(
+            lambda: list(
+                self.storage.get_l_events().find(
+                    access_key.appid,
+                    channel_id=channel_id,
+                    start_time=start_time,
+                    until_time=until_time,
+                    entity_type=q.get("entityType"),
+                    entity_id=q.get("entityId"),
+                    event_names=event_names,
+                    target_entity_type=q.get("targetEntityType"),
+                    target_entity_id=q.get("targetEntityId"),
+                    limit=None if limit < 0 else limit,
+                    reversed_order=q.get("reversed", "false") == "true",
+                )
+            )
+        )
+        return web.json_response([e.to_json() for e in events])
+
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        access_key = await self._authorize(request)
+        if self.stats is None:
+            return _json_error(
+                404, "To see stats, launch Event Server with --stats argument."
+            )
+        return web.json_response(self.stats.to_json(access_key.appid))
+
+    async def handle_webhook(self, request: web.Request) -> web.Response:
+        access_key = await self._authorize(request)
+        channel_id = await self._channel_id(request, access_key)
+        name = request.match_info["connector"]
+        connector = get_connector(name)
+        if connector is None:
+            return _json_error(404, f"webhook connector {name!r} not found")
+        if request.content_type == "application/x-www-form-urlencoded":
+            payload = dict(await request.post())
+        else:
+            try:
+                payload = await request.json()
+            except json.JSONDecodeError:
+                return _json_error(400, "invalid JSON body")
+        try:
+            event_json = connector.to_event_json(payload)
+            event = Event.from_json(event_json)
+            self._check_event_allowed(access_key, event.event)
+        except EventValidationError as e:
+            return _json_error(400, str(e))
+        event_id = await asyncio.to_thread(
+            self.storage.get_l_events().insert, event, access_key.appid, channel_id
+        )
+        return web.json_response({"eventId": event_id}, status=201)
+
+    def _record(self, app_id: int, body, status: int) -> None:
+        if self.stats is None:
+            return
+        name = body.get("event", "?") if isinstance(body, dict) else "?"
+        etype = body.get("entityType", "?") if isinstance(body, dict) else "?"
+        self.stats.record(app_id, name, etype, status)
+
+
+def run_event_server(
+    host: str = "0.0.0.0",
+    port: int = 7070,
+    storage: Optional[Storage] = None,
+    enable_stats: bool = False,
+) -> None:
+    """Blocking entry point (reference: EventServer.createEventServer)."""
+    server = EventServer(storage, enable_stats)
+    log.info("Event Server listening on %s:%d", host, port)
+    web.run_app(server.app, host=host, port=port, print=None)

@@ -1,0 +1,34 @@
+"""Rolling ingestion stats (reference: data/.../data/api/Stats.scala —
+StatsActor counting by (appId, event, entityType, status))."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter
+
+
+class Stats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Counter = Counter()
+        self.start_time = time.time()
+
+    def record(self, app_id: int, event_name: str, entity_type: str, status: int) -> None:
+        with self._lock:
+            self._counts[(app_id, event_name, entity_type, status)] += 1
+
+    def to_json(self, app_id: int | None = None) -> dict:
+        with self._lock:
+            items = [
+                {
+                    "appId": k[0],
+                    "event": k[1],
+                    "entityType": k[2],
+                    "status": k[3],
+                    "count": v,
+                }
+                for k, v in sorted(self._counts.items())
+                if app_id is None or k[0] == app_id
+            ]
+        return {"uptime": time.time() - self.start_time, "counts": items}

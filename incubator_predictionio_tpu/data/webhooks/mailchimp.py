@@ -1,0 +1,42 @@
+"""MailChimp webhook connector.
+
+Reference: data/.../data/webhooks/mailchimp/MailChimpConnector.scala —
+form-encoded webhooks (subscribe/unsubscribe/profile/upemail/cleaned/
+campaign) flattened from "data[...]" form keys.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from ..storage.event import EventValidationError
+from .base import FormConnector
+
+_SUPPORTED = {"subscribe", "unsubscribe", "profile", "upemail", "cleaned", "campaign"}
+
+
+class MailChimpConnector(FormConnector):
+    def to_event_json(self, payload: Mapping[str, str]) -> dict:
+        event_type = payload.get("type")
+        if event_type not in _SUPPORTED:
+            raise EventValidationError(
+                f"mailchimp event type {event_type!r} is not supported"
+            )
+        data = {
+            k[5:-1]: v
+            for k, v in payload.items()
+            if k.startswith("data[") and k.endswith("]")
+        }
+        entity_id = data.get("id") or data.get("email")
+        if not entity_id:
+            raise EventValidationError("mailchimp payload has no data[id]/data[email]")
+        event_json = {
+            "event": event_type,
+            "entityType": "user",
+            "entityId": entity_id,
+            "properties": data,
+        }
+        if payload.get("fired_at"):
+            # "2009-03-26 21:35:57" → ISO
+            event_json["eventTime"] = payload["fired_at"].replace(" ", "T") + "Z"
+        return event_json

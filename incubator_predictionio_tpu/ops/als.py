@@ -51,6 +51,12 @@ class ALSParams:
     seed: int = 3
     block_len: int = 32
     compute_dtype: str = "float32"  # bf16 tiles on TPU, f32 on CPU tests
+    # Tiles processed per scan step inside a half-step. 0 = all at once
+    # (small data). At ML-20M scale the per-tile gram intermediate
+    # [B, k, k] would be ~10GB; chunking scans tile slabs and scatter-adds
+    # into the per-row normal equations, capping live memory at
+    # [chunk, L, k] + [chunk, k, k] + the [rows, k, k] accumulator.
+    chunk_tiles: int = 0
 
 
 @dataclasses.dataclass
@@ -61,14 +67,10 @@ class ALSFactors:
     n_items: int
 
 
-def _half_step_local(y, col, val, mask, local_row, counts, yty, *,
-                     rows_per_shard, reg, lambda_scaling, implicit, alpha,
-                     compute_dtype):
-    """Solve one side's factors for one shard's rows (runs inside
-    shard_map; all arrays are the local shard)."""
-    k = y.shape[1]
+def _tile_grams(y, col, val, mask, *, implicit, alpha, compute_dtype):
+    """Per-tile normal-equation contributions: grams [B,k,k], rhs [B,k]."""
     cd = compute_dtype
-    p = y[col].astype(cd)  # [Bs, L, k] gather of counterpart factors
+    p = y[col].astype(cd)  # [B, L, k] gather of counterpart factors
     m = mask[..., None].astype(cd)
     pm = p * m
     if implicit:
@@ -84,9 +86,60 @@ def _half_step_local(y, col, val, mask, local_row, counts, yty, *,
                            preferred_element_type=jnp.float32)
         rhs = jnp.einsum("blk,bl->bk", pm, (val * mask).astype(cd),
                          preferred_element_type=jnp.float32)
+    return grams, rhs
 
-    a = jax.ops.segment_sum(grams, local_row, num_segments=rows_per_shard)
-    b = jax.ops.segment_sum(rhs, local_row, num_segments=rows_per_shard)
+
+def _half_step_local(y, col, val, mask, local_row, counts, yty, *,
+                     rows_per_shard, reg, lambda_scaling, implicit, alpha,
+                     compute_dtype, chunk_tiles=0):
+    """Solve one side's factors for one shard's rows (runs inside
+    shard_map; all arrays are the local shard)."""
+    k = y.shape[1]
+    n_tiles = col.shape[0]
+    if chunk_tiles and n_tiles > chunk_tiles:
+        # Large data: scan tile slabs, scatter-adding into the [rows,k,k]
+        # accumulator so the [B,k,k] gram intermediate never materializes.
+        n_chunks = (n_tiles + chunk_tiles - 1) // chunk_tiles
+        pad = n_chunks * chunk_tiles - n_tiles
+        if pad:
+            col = jnp.pad(col, ((0, pad), (0, 0)))
+            val = jnp.pad(val, ((0, pad), (0, 0)))
+            mask = jnp.pad(mask, ((0, pad), (0, 0)))
+            local_row = jnp.pad(local_row, (0, pad))
+        cshape = (n_chunks, chunk_tiles)
+        col_c = col.reshape(*cshape, -1)
+        val_c = val.reshape(*cshape, -1)
+        mask_c = mask.reshape(*cshape, -1)
+        lrow_c = local_row.reshape(cshape)
+
+        def scan_body(carry, chunk):
+            a_acc, b_acc = carry
+            ccol, cval, cmask, clrow = chunk
+            grams, rhs = _tile_grams(
+                y, ccol, cval, cmask,
+                implicit=implicit, alpha=alpha, compute_dtype=compute_dtype,
+            )
+            a_acc = a_acc.at[clrow].add(grams)
+            b_acc = b_acc.at[clrow].add(rhs)
+            return (a_acc, b_acc), None
+
+        a0 = jnp.zeros((rows_per_shard, k, k), jnp.float32)
+        b0 = jnp.zeros((rows_per_shard, k), jnp.float32)
+        if hasattr(jax.lax, "pcast"):
+            # Inside shard_map the scatter-add output is device-varying;
+            # mark the zero carries to match (jax ≥0.8 VMA tracking).
+            a0 = jax.lax.pcast(a0, (DATA_AXIS,), to="varying")
+            b0 = jax.lax.pcast(b0, (DATA_AXIS,), to="varying")
+        (a, b), _ = jax.lax.scan(
+            scan_body, (a0, b0), (col_c, val_c, mask_c, lrow_c)
+        )
+    else:
+        grams, rhs = _tile_grams(
+            y, col, val, mask,
+            implicit=implicit, alpha=alpha, compute_dtype=compute_dtype,
+        )
+        a = jax.ops.segment_sum(grams, local_row, num_segments=rows_per_shard)
+        b = jax.ops.segment_sum(rhs, local_row, num_segments=rows_per_shard)
     if implicit:
         a = a + yty[None, :, :]  # shared YᵀY term (all items)
 
@@ -128,6 +181,7 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
                 implicit=implicit,
                 alpha=params.alpha,
                 compute_dtype=cd,
+                chunk_tiles=params.chunk_tiles,
             ),
             mesh=mesh,
             in_specs=(rep, row_spec, row_spec, row_spec, row_spec, row_spec, rep),

@@ -2,9 +2,13 @@
 
 from __future__ import annotations
 
+import importlib
+import sys
 from typing import Callable
 
 _VERBS: dict[str, tuple[Callable[[list[str]], int], str]] = {}
+_MODULES = ("app", "engine", "management", "evaluation")
+_loaded = False
 
 
 def verb(name: str, help_text: str):
@@ -15,7 +19,23 @@ def verb(name: str, help_text: str):
     return deco
 
 
+def _load_all() -> None:
+    global _loaded
+    if _loaded:
+        return
+    for m in _MODULES:
+        try:
+            importlib.import_module(f".{m}", __package__)
+        except ImportError:  # pragma: no cover - broken module
+            import traceback
+
+            print(f"[warn] command module {m} failed to import:", file=sys.stderr)
+            traceback.print_exc()
+    _loaded = True
+
+
 def usage() -> str:
+    _load_all()
     lines = ["usage: pio <command> [args]", "", "commands:"]
     lines += [f"  {n:<14} {h}" for n, (_, h) in sorted(_VERBS.items())]
     lines += ["  version        print version", ""]
@@ -23,8 +43,9 @@ def usage() -> str:
 
 
 def dispatch(name: str, args: list[str]) -> int:
+    _load_all()
     if name not in _VERBS:
-        print(f"pio: unknown or not-yet-implemented command: {name}", file=__import__("sys").stderr)
-        print(usage(), file=__import__("sys").stderr)
+        print(f"pio: unknown or not-yet-implemented command: {name}", file=sys.stderr)
+        print(usage(), file=sys.stderr)
         return 1
     return _VERBS[name][0](args)

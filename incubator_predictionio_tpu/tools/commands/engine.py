@@ -1,0 +1,163 @@
+"""`pio build/train/deploy/undeploy/batchpredict` (reference:
+tools/.../commands/Engine.scala + RunWorkflow/RunServer; no spark-submit —
+the workflow runs in-process, SURVEY.md §7)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ...data.storage.registry import Storage
+from ...workflow.context import WorkflowContext
+from ...workflow.json_extractor import engine_and_params_from_json, load_engine_json
+from ...workflow.workflow_params import WorkflowParams
+from . import verb
+
+
+def _load_engine(ns):
+    engine_json_path = os.path.join(ns.engine_dir, "engine.json")
+    engine_json = load_engine_json(engine_json_path, getattr(ns, "variant", None))
+    engine, params, factory = engine_and_params_from_json(engine_json, ns.engine_dir)
+    variant = engine_json.get("id", "default")
+    return engine, params, factory, variant, engine_json
+
+
+def _common_args(p: argparse.ArgumentParser):
+    p.add_argument("--engine-dir", default=".", help="template directory (with engine.json)")
+    p.add_argument("--variant", default=None, help="engine.json variant suffix")
+
+
+@verb("build", "validate the engine template (no compilation needed)")
+def build_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio build")
+    _common_args(p)
+    ns = p.parse_args(args)
+    try:
+        engine, params, factory, variant, _ = _load_engine(ns)
+    except Exception as e:  # noqa: BLE001
+        print(f"[error] engine build failed: {e}", file=sys.stderr)
+        return 1
+    n_algos = len(params.algorithm_params_list) or 1
+    print(f"[info] Engine {factory} (variant {variant}) is ready: "
+          f"{n_algos} algorithm(s) configured. No compilation needed.")
+    return 0
+
+
+@verb("train", "run the training workflow")
+def train_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio train")
+    _common_args(p)
+    p.add_argument("--batch", default="")
+    p.add_argument("--skip-sanity-check", action="store_true")
+    p.add_argument("--stop-after-read", action="store_true")
+    p.add_argument("--stop-after-prepare", action="store_true")
+    ns = p.parse_args(args)
+    from ...workflow.core_workflow import run_train
+
+    engine, params, factory, variant, engine_json = _load_engine(ns)
+    app_name = (
+        dict(params.data_source_params).get("app_name")
+        or dict(params.data_source_params).get("appName", "")
+    )
+    ctx = WorkflowContext(app_name=app_name, storage=Storage.instance())
+    wp = WorkflowParams(
+        batch=ns.batch,
+        skip_sanity_check=ns.skip_sanity_check,
+        stop_after_read=ns.stop_after_read,
+        stop_after_prepare=ns.stop_after_prepare,
+    )
+    instance_id = run_train(
+        engine, params, ctx, wp,
+        engine_factory_name=factory, engine_variant=variant,
+    )
+    print(f"[info] Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+@verb("deploy", "serve the trained engine over HTTP")
+def deploy_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio deploy")
+    _common_args(p)
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--engine-instance-id", default=None)
+    p.add_argument("--feedback", action="store_true")
+    ns = p.parse_args(args)
+    from ...workflow.create_server import EngineServer, run_engine_server
+
+    engine, params, factory, variant, _ = _load_engine(ns)
+    app_name = dict(params.data_source_params).get("app_name") or dict(
+        params.data_source_params
+    ).get("appName", "")
+    server = EngineServer(
+        engine,
+        engine_factory_name=factory,
+        engine_variant=variant,
+        instance_id=ns.engine_instance_id,
+        feedback=ns.feedback,
+        feedback_app_name=app_name,
+    )
+    print(f"[info] Engine is deployed and running. Listening on {ns.ip}:{ns.port}")
+    run_engine_server(server, ns.ip, ns.port)
+    return 0
+
+
+@verb("undeploy", "stop a running engine server")
+def undeploy_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio undeploy")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    ns = p.parse_args(args)
+    import requests
+
+    try:
+        r = requests.post(f"http://{ns.ip}:{ns.port}/stop", timeout=10)
+        print(f"[info] {r.json().get('message', r.status_code)}")
+        return 0
+    except Exception as e:  # noqa: BLE001
+        print(f"[error] {e}", file=sys.stderr)
+        return 1
+
+
+@verb("batchpredict", "bulk scoring: queries JSONL in, predictions JSONL out")
+def batchpredict_cmd(args: list[str]) -> int:
+    """Reference: tools/.../commands/BatchPredict.scala (0.13+)."""
+    p = argparse.ArgumentParser(prog="pio batchpredict")
+    _common_args(p)
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--engine-instance-id", default=None)
+    p.add_argument("--query-partitions", type=int, default=None, help="ignored (single process)")
+    ns = p.parse_args(args)
+    from ...workflow.core_workflow import load_deployment
+
+    engine, params, factory, variant, _ = _load_engine(ns)
+    ctx = WorkflowContext(storage=Storage.instance())
+    deployment, _, _ = load_deployment(
+        engine, ns.engine_instance_id, ctx,
+        engine_factory_name=factory, engine_variant=variant,
+    )
+    queries = []
+    with open(ns.input) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                queries.append(json.loads(line))
+    # Vectorized sweep through each algorithm's batch_predict when there is
+    # exactly one algorithm; otherwise per-query through serving.
+    if len(deployment.algo_list) == 1:
+        _, algo = deployment.algo_list[0]
+        supplemented = [deployment.serving.supplement(q) for q in queries]
+        preds = algo.batch_predict(deployment.models[0], supplemented)
+        results = [
+            deployment.serving.serve(q, [pr]) for q, pr in zip(supplemented, preds)
+        ]
+    else:
+        results = [deployment.query(q) for q in queries]
+    with open(ns.output, "w") as f:
+        for q, r in zip(queries, results):
+            f.write(json.dumps({"query": q, "prediction": r}) + "\n")
+    print(f"[info] Batch predict completed: {len(results)} predictions → {ns.output}")
+    return 0

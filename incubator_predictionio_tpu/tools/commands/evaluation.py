@@ -1,0 +1,45 @@
+"""`pio eval` — hyperparameter evaluation workflow.
+
+Reference: Console "eval" → EvaluationWorkflow (SURVEY.md §3.4). Takes the
+dotted names of an Evaluation and an EngineParamsGenerator, runs every
+candidate through Engine.eval, ranks with MetricEvaluator, persists an
+EvaluationInstance.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ...data.storage.registry import Storage
+from ...workflow.context import WorkflowContext
+from . import verb
+
+
+@verb("eval", "run evaluation: pio eval <Evaluation> <EngineParamsGenerator>")
+def eval_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio eval")
+    p.add_argument("evaluation", help="dotted path of the Evaluation class")
+    p.add_argument("generator", nargs="?", default=None,
+                   help="dotted path of the EngineParamsGenerator (optional if the Evaluation defines params)")
+    p.add_argument("--engine-dir", default=".")
+    p.add_argument("--batch", default="")
+    ns = p.parse_args(args)
+    from ...workflow.evaluation_workflow import run_evaluation
+    from ...workflow.json_extractor import resolve_engine_factory
+
+    evaluation_cls = resolve_engine_factory(ns.evaluation, ns.engine_dir)
+    generator_cls = (
+        resolve_engine_factory(ns.generator, ns.engine_dir) if ns.generator else None
+    )
+    ctx = WorkflowContext(storage=Storage.instance())
+    result, instance_id = run_evaluation(
+        evaluation_cls() if isinstance(evaluation_cls, type) else evaluation_cls,
+        generator_cls() if isinstance(generator_cls, type) else generator_cls,
+        ctx,
+        batch=ns.batch,
+        evaluation_name=ns.evaluation,
+        generator_name=ns.generator or "",
+    )
+    print(result.pretty())
+    print(f"[info] Evaluation completed. Instance ID: {instance_id}")
+    return 0

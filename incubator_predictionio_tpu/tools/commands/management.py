@@ -1,0 +1,116 @@
+"""`pio status/eventserver/export/import/dashboard/adminserver`
+(reference: tools/.../commands/{Management,Export,Import}.scala,
+tools/export/EventsToFile.scala, tools/imprt/FileToEvents.scala)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ...data.storage.event import Event
+from ...data.storage.registry import Storage, base_dir
+from . import verb
+
+
+@verb("status", "verify storage configuration and connectivity")
+def status_cmd(args: list[str]) -> int:
+    s = Storage.instance()
+    print("[info] Inspecting storage backend connections...")
+    errors = s.verify_all_data_objects()
+    if errors:
+        for e in errors:
+            print(f"[error] {e}", file=sys.stderr)
+        return 1
+    print(f"[info] Storage OK. Base dir: {base_dir()}")
+    apps = s.get_meta_data_apps().get_all()
+    print(f"[info] {len(apps)} app(s) registered.")
+    print("[info] Your system is all ready to go.")
+    return 0
+
+
+@verb("eventserver", "start the Event Server (REST ingestion, :7070)")
+def eventserver_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio eventserver")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7070)
+    p.add_argument("--stats", action="store_true")
+    ns = p.parse_args(args)
+    from ...data.api.event_server import run_event_server
+
+    run_event_server(ns.ip, ns.port, enable_stats=ns.stats)
+    return 0
+
+
+def _resolve_app_id(s: Storage, appid: int | None, app_name: str | None) -> int:
+    if appid is not None:
+        return appid
+    if app_name:
+        a = s.get_meta_data_apps().get_by_name(app_name)
+        if a:
+            return a.id
+        raise SystemExit(f"App {app_name!r} does not exist.")
+    raise SystemExit("Provide --appid or --app-name.")
+
+
+@verb("export", "export an app's events to JSONL")
+def export_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio export")
+    p.add_argument("--appid", type=int, default=None)
+    p.add_argument("--app-name", default=None)
+    p.add_argument("--channel", default=None)
+    p.add_argument("--output", required=True)
+    ns = p.parse_args(args)
+    s = Storage.instance()
+    app_id = _resolve_app_id(s, ns.appid, ns.app_name)
+    channel_id = None
+    if ns.channel:
+        chans = [c for c in s.get_meta_data_channels().get_by_appid(app_id)
+                 if c.name == ns.channel]
+        if not chans:
+            print(f"Channel {ns.channel!r} not found.", file=sys.stderr)
+            return 1
+        channel_id = chans[0].id
+    n = 0
+    with open(ns.output, "w") as f:
+        for e in s.get_p_events().find(app_id, channel_id):
+            f.write(json.dumps(e.to_json()) + "\n")
+            n += 1
+    print(f"[info] Exported {n} events to {ns.output}")
+    return 0
+
+
+@verb("import", "import events from JSONL into an app")
+def import_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio import")
+    p.add_argument("--appid", type=int, default=None)
+    p.add_argument("--app-name", default=None)
+    p.add_argument("--channel", default=None)
+    p.add_argument("--input", required=True)
+    ns = p.parse_args(args)
+    s = Storage.instance()
+    app_id = _resolve_app_id(s, ns.appid, ns.app_name)
+    channel_id = None
+    if ns.channel:
+        chans = [c for c in s.get_meta_data_channels().get_by_appid(app_id)
+                 if c.name == ns.channel]
+        if not chans:
+            print(f"Channel {ns.channel!r} not found.", file=sys.stderr)
+            return 1
+        channel_id = chans[0].id
+    le = s.get_l_events()
+    le.init(app_id, channel_id)
+    events, skipped = [], 0
+    with open(ns.input) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(Event.from_json(json.loads(line)))
+            except Exception as e:  # noqa: BLE001 - report and continue
+                skipped += 1
+                print(f"[warn] line {line_no}: {e}", file=sys.stderr)
+    le.insert_batch(events, app_id, channel_id)
+    print(f"[info] Imported {len(events)} events ({skipped} skipped).")
+    return 0

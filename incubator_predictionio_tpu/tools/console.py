@@ -7,10 +7,20 @@ implementations.
 
 from __future__ import annotations
 
+import os
 import sys
 
 
 def main(argv=None) -> int:
+    if os.environ.get("PIO_TEST_FORCE_CPU") == "1":
+        # Hermetic CI: run workflows on host CPU devices (the sandbox's
+        # PJRT plugin ignores JAX_PLATFORMS — see tests/conftest.py).
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except ImportError:
+            pass
     from . import commands
 
     argv = list(sys.argv[1:] if argv is None else argv)

@@ -1,0 +1,201 @@
+"""Engine (deploy) server — serves a trained engine on :8000.
+
+Reference: core/.../workflow/CreateServer.scala: MasterActor supervises a
+ServerActor; POST /queries.json is the hot path; GET / is the status page;
+/reload hot-swaps the latest engine instance; /stop shuts down; plugins
+observe query/result pairs; optional feedback loop self-logs prediction
+events.
+
+TPU-native: the deployment holds device-resident models with warmed-up
+compiled executables (ALSModel.warm_up), so the per-query Python work is
+JSON parse → host gather → one device dispatch → one host fetch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as _dt
+import json
+import logging
+import threading
+from typing import Any, Optional
+
+from aiohttp import web
+
+from ..controller.engine import Engine
+from ..data.storage.datamap import DataMap
+from ..data.storage.event import Event
+from ..data.storage.registry import Storage
+from .context import WorkflowContext
+from .core_workflow import load_deployment
+from .plugins import EngineServerPluginContext
+
+log = logging.getLogger("pio.engineserver")
+
+
+class EngineServer:
+    def __init__(
+        self,
+        engine: Engine,
+        engine_factory_name: str = "",
+        engine_variant: str = "default",
+        instance_id: Optional[str] = None,
+        storage: Optional[Storage] = None,
+        feedback: bool = False,
+        feedback_app_name: Optional[str] = None,
+        plugins: Optional[EngineServerPluginContext] = None,
+    ):
+        self.engine = engine
+        self.engine_factory_name = engine_factory_name
+        self.engine_variant = engine_variant
+        self.requested_instance_id = instance_id
+        self.storage = storage or Storage.instance()
+        self.feedback = feedback
+        self.feedback_app_name = feedback_app_name
+        self.plugins = plugins or EngineServerPluginContext()
+        self.start_time = _dt.datetime.now(_dt.timezone.utc)
+        self._lock = threading.Lock()
+        self._query_count = 0
+        self.deployment = None
+        self.instance = None
+        self._load(instance_id)
+
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/", self.handle_status),
+                web.post("/queries.json", self.handle_query),
+                web.get("/reload", self.handle_reload),
+                web.post("/reload", self.handle_reload),
+                web.get("/stop", self.handle_stop),
+                web.post("/stop", self.handle_stop),
+                web.get("/plugins.json", self.handle_plugins),
+            ]
+        )
+
+    # -- lifecycle --------------------------------------------------------
+    def _load(self, instance_id: Optional[str]) -> None:
+        ctx = WorkflowContext(storage=self.storage)
+        deployment, instance, _ = load_deployment(
+            self.engine,
+            instance_id,
+            ctx,
+            engine_factory_name=self.engine_factory_name,
+            engine_variant=self.engine_variant,
+        )
+        # Warm up every model that supports it (compile + device placement)
+        for model in deployment.models:
+            warm = getattr(model, "warm_up", None)
+            if callable(warm):
+                try:
+                    warm()
+                except Exception:  # pragma: no cover - warmup best-effort
+                    log.exception("model warm-up failed")
+        with self._lock:
+            self.deployment = deployment
+            self.instance = instance
+        log.info("deployed engine instance %s", instance.id)
+
+    # -- handlers ---------------------------------------------------------
+    async def handle_status(self, request: web.Request) -> web.Response:
+        """Reference: CreateServer status page — JSON here."""
+        with self._lock:
+            instance = self.instance
+        return web.json_response(
+            {
+                "status": "alive",
+                "engineInstanceId": instance.id if instance else None,
+                "engineFactory": self.engine_factory_name,
+                "engineVariant": self.engine_variant,
+                "startTime": self.start_time.isoformat(),
+                "queryCount": self._query_count,
+                "plugins": self.plugins.plugin_names(),
+            }
+        )
+
+    async def handle_query(self, request: web.Request) -> web.Response:
+        try:
+            query = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"message": "invalid JSON body"}, status=400)
+        with self._lock:
+            deployment = self.deployment
+        if deployment is None:
+            return web.json_response({"message": "no model deployed"}, status=503)
+        try:
+            query = self.plugins.before_query(query)
+            result = await asyncio.to_thread(deployment.query, query)
+            result = self.plugins.after_query(query, result)
+        except KeyError as e:
+            return web.json_response(
+                {"message": f"missing query field {e.args[0]!r}"}, status=400
+            )
+        except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500 w/ message
+            log.exception("query failed")
+            return web.json_response({"message": str(e)}, status=500)
+        self._query_count += 1
+        if self.feedback:
+            # sync DAO write runs in the default executor, never on the loop
+            asyncio.get_running_loop().run_in_executor(
+                None, self._log_feedback, query, result
+            )
+        return web.json_response(result)
+
+    def _log_feedback(self, query: Any, result: Any) -> None:
+        """Self-log the prediction as a "predict" event (reference:
+        CreateServer feedback loop → event server)."""
+        app_name = self.feedback_app_name
+        if not app_name:
+            return
+        try:
+            app = self.storage.get_meta_data_apps().get_by_name(app_name)
+            if app is None:
+                return
+            self.storage.get_l_events().insert(
+                Event(
+                    event="predict",
+                    entity_type="pio_pr",  # server-generated: prefix allowed internally
+                    entity_id=str(query.get("user", "")) if isinstance(query, dict) else "",
+                    properties=DataMap({"query": query, "result": result}),
+                ),
+                app.id,
+            )
+        except Exception:  # pragma: no cover
+            log.exception("feedback logging failed")
+
+    async def handle_reload(self, request: web.Request) -> web.Response:
+        """Hot-swap to the latest completed instance (reference: /reload →
+        MasterActor ! ReloadServer)."""
+        try:
+            await asyncio.to_thread(self._load, None)
+        except Exception as e:  # noqa: BLE001
+            return web.json_response({"message": str(e)}, status=500)
+        return web.json_response(
+            {"message": "Reloaded", "engineInstanceId": self.instance.id}
+        )
+
+    async def handle_stop(self, request: web.Request) -> web.Response:
+        log.info("stop requested")
+        asyncio.get_running_loop().call_later(0.1, request.app["stopper"])
+        return web.json_response({"message": "Shutting down."})
+
+    async def handle_plugins(self, request: web.Request) -> web.Response:
+        return web.json_response({"plugins": self.plugins.plugin_names()})
+
+
+def run_engine_server(server: EngineServer, host: str = "0.0.0.0", port: int = 8000):
+    """Blocking entry point (reference: CreateServer.main)."""
+    loop = asyncio.new_event_loop()
+    stop_event = asyncio.Event()
+    server.app["stopper"] = stop_event.set
+
+    async def main():
+        runner = web.AppRunner(server.app)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        log.info("Engine Server listening on %s:%d", host, port)
+        await stop_event.wait()
+        await runner.cleanup()
+
+    loop.run_until_complete(main())

@@ -1,0 +1,96 @@
+"""EvaluationWorkflow — run candidate EngineParams, rank by metric.
+
+Reference: core/.../workflow/EvaluationWorkflow.scala + CreateWorkflow's
+eval dispatch (SURVEY.md §3.4): iterate generator candidates, run
+engine.eval per candidate, feed MetricEvaluator, persist an
+EvaluationInstance with the pretty/JSON results.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+from typing import Optional
+
+from ..controller.evaluation import EngineParamsGenerator, Evaluation
+from ..controller.metric_evaluator import MetricEvaluator, MetricEvaluatorResult
+from ..data.storage.base import EvaluationInstance
+from ..data.storage.event import new_event_id
+from .context import WorkflowContext
+
+log = logging.getLogger("pio.evalworkflow")
+
+
+def _utcnow():
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    generator: Optional[EngineParamsGenerator],
+    ctx: Optional[WorkflowContext] = None,
+    batch: str = "",
+    evaluation_name: str = "",
+    generator_name: str = "",
+) -> tuple[MetricEvaluatorResult, str]:
+    ctx = ctx or WorkflowContext()
+    storage = ctx.get_storage()
+    dao = storage.get_meta_data_evaluation_instances()
+    engine, metric, other_metrics = evaluation.engine_metrics()
+    params_list = (
+        generator.params_list()
+        if generator is not None
+        else getattr(evaluation, "engine_params_list", None) or ()
+    )
+    if not params_list:
+        raise ValueError(
+            "no candidate EngineParams: pass an EngineParamsGenerator or set "
+            "engine_params_list on the Evaluation"
+        )
+
+    instance = EvaluationInstance(
+        id=new_event_id(),
+        status="EVALRUNNING",
+        start_time=_utcnow(),
+        end_time=None,
+        evaluation_class=evaluation_name or type(evaluation).__name__,
+        engine_params_generator_class=generator_name or (type(generator).__name__ if generator else ""),
+        batch=batch,
+    )
+    instance_id = dao.insert(instance)
+    log.info("EvaluationInstance %s EVALRUNNING (%d candidates)",
+             instance_id, len(params_list))
+    try:
+        candidates = []
+        for i, ep in enumerate(params_list):
+            log.info("evaluating candidate %d/%d", i + 1, len(params_list))
+            eval_data = engine.eval(ctx, ep, ctx.workflow_params)
+            candidates.append((ep, eval_data))
+        evaluator = MetricEvaluator(metric, other_metrics)
+        result = evaluator.evaluate_candidates(candidates)
+        done = EvaluationInstance(
+            id=instance_id,
+            status="EVALCOMPLETED",
+            start_time=instance.start_time,
+            end_time=_utcnow(),
+            evaluation_class=instance.evaluation_class,
+            engine_params_generator_class=instance.engine_params_generator_class,
+            batch=batch,
+            evaluator_results=result.pretty(),
+            evaluator_results_html="",
+            evaluator_results_json=result.to_json(),
+        )
+        dao.update(done)
+        log.info("EvaluationInstance %s EVALCOMPLETED", instance_id)
+        return result, instance_id
+    except Exception:
+        dao.update(
+            EvaluationInstance(
+                id=instance_id, status="EVALABORTED",
+                start_time=instance.start_time, end_time=_utcnow(),
+                evaluation_class=instance.evaluation_class,
+                engine_params_generator_class=instance.engine_params_generator_class,
+                batch=batch,
+            )
+        )
+        raise

@@ -1,0 +1,67 @@
+"""Engine/Event server plugin interfaces.
+
+Reference: core/.../workflow/EngineServerPlugin.scala (outputblocker /
+outputsniffer hooks discovered via ServiceLoader) and
+data/.../data/api/EventServerPlugin.scala. Python discovery: explicit
+registration or entry-point style dotted paths in env var
+PIO_ENGINE_SERVER_PLUGINS (comma separated).
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+from typing import Any, Optional
+
+log = logging.getLogger("pio.plugins")
+
+
+class EngineServerPlugin:
+    """Hooks around the query path. ``process`` may transform the result
+    (outputblocker role); ``sniff`` observes (outputsniffer role)."""
+
+    name: str = "plugin"
+
+    def start(self, context: "EngineServerPluginContext") -> None:
+        pass
+
+    def before_query(self, query: Any) -> Any:
+        return query
+
+    def process(self, query: Any, result: Any) -> Any:
+        return result
+
+
+class EventServerPlugin:
+    name: str = "plugin"
+
+    def on_event(self, event_json: dict) -> None:
+        pass
+
+
+class EngineServerPluginContext:
+    def __init__(self, plugins: Optional[list[EngineServerPlugin]] = None):
+        self.plugins = list(plugins or [])
+        for dotted in filter(None, os.environ.get("PIO_ENGINE_SERVER_PLUGINS", "").split(",")):
+            try:
+                module, _, cls = dotted.strip().rpartition(".")
+                plugin = getattr(importlib.import_module(module), cls)()
+                self.plugins.append(plugin)
+            except Exception:  # pragma: no cover - bad env entry
+                log.exception("failed to load plugin %s", dotted)
+        for p in self.plugins:
+            p.start(self)
+
+    def plugin_names(self) -> list[str]:
+        return [p.name for p in self.plugins]
+
+    def before_query(self, query: Any) -> Any:
+        for p in self.plugins:
+            query = p.before_query(query)
+        return query
+
+    def after_query(self, query: Any, result: Any) -> Any:
+        for p in self.plugins:
+            result = p.process(query, result)
+        return result

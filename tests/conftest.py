@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 try:
     import jax  # noqa: E402
 
-    jax.config.update("jax_platform_name", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 except ImportError:  # pragma: no cover - jax is bundled in this sandbox
     pass
 

@@ -143,3 +143,16 @@ def test_als_on_explicit_submesh():
     out = train_als(u, i, r, 60, 40, ALSParams(rank=4, num_iterations=3), mesh=mesh)
     assert out.user_factors.shape == (60, 4)
     assert np.isfinite(out.user_factors).all()
+
+
+def test_als_chunked_matches_unchunked():
+    """chunk_tiles must not change results (review: HBM-bounded path)."""
+    u, i, r = _toy_ratings(n_users=50, n_items=30, density=0.4, seed=9)
+    base = ALSParams(rank=6, num_iterations=3, reg=0.05, block_len=8)
+    chunked = ALSParams(rank=6, num_iterations=3, reg=0.05, block_len=8,
+                        chunk_tiles=4)
+    out_a = train_als(u, i, r, 50, 30, base)
+    out_b = train_als(u, i, r, 50, 30, chunked)
+    np.testing.assert_allclose(
+        out_a.user_factors, out_b.user_factors, rtol=1e-4, atol=1e-5
+    )

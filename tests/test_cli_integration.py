@@ -1,0 +1,114 @@
+"""Full-lifecycle CLI integration (reference: tests/pio_tests/scenarios/
+quickstart_test.py — drives the real `pio` binary against real storage).
+
+Subprocess-based: each command is a fresh process sharing a temp
+PIO_FS_BASEDIR (sqlite), exactly how a user runs the quickstart.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PIO = os.path.join(REPO, "bin", "pio")
+
+
+def run_pio(args, env, check=True):
+    r = subprocess.run(
+        [PIO, *args], capture_output=True, text=True, env=env, timeout=300
+    )
+    if check and r.returncode != 0:
+        raise AssertionError(
+            f"pio {' '.join(args)} failed ({r.returncode}):\n{r.stdout}\n{r.stderr}"
+        )
+    return r
+
+
+@pytest.fixture()
+def cli_env(tmp_path):
+    env = dict(os.environ)
+    env["PIO_FS_BASEDIR"] = str(tmp_path / "store")
+    # CPU platform for subprocesses (they don't load tests/conftest.py).
+    env["PIO_TEST_FORCE_CPU"] = "1"
+    return env
+
+
+def _write_events_file(path, n_users=25, n_items=15, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        k = 0
+        for u in range(n_users):
+            for i in range(n_items):
+                if rng.random() < 0.5:
+                    r = int(rng.integers(1, 6))
+                    f.write(json.dumps({
+                        "event": "rate", "entityType": "user", "entityId": str(u),
+                        "targetEntityType": "item", "targetEntityId": f"i{i}",
+                        "properties": {"rating": r},
+                        "eventTime": f"2024-01-01T00:{k // 60:02d}:{k % 60:02d}.000Z",
+                    }) + "\n")
+                    k += 1
+    return k
+
+
+def test_quickstart_lifecycle(cli_env, tmp_path):
+    # pio status
+    r = run_pio(["status"], cli_env)
+    assert "ready to go" in r.stdout
+
+    # pio app new
+    r = run_pio(["app", "new", "MyApp1"], cli_env)
+    assert "Access Key" in r.stdout
+
+    # duplicate app fails cleanly
+    r = run_pio(["app", "new", "MyApp1"], cli_env, check=False)
+    assert r.returncode == 1
+
+    # import events
+    events_file = tmp_path / "events.jsonl"
+    n = _write_events_file(events_file)
+    r = run_pio(["import", "--app-name", "MyApp1", "--input", str(events_file)], cli_env)
+    assert f"Imported {n} events" in r.stdout
+
+    # pio build (validation)
+    tpl = os.path.join(REPO, "templates", "recommendation")
+    r = run_pio(["build", "--engine-dir", tpl], cli_env)
+    assert "ready" in r.stdout
+
+    # pio train
+    r = run_pio(["train", "--engine-dir", tpl], cli_env)
+    assert "Training completed" in r.stdout
+
+    # pio export round-trips
+    out_file = tmp_path / "export.jsonl"
+    r = run_pio(["export", "--app-name", "MyApp1", "--output", str(out_file)], cli_env)
+    assert f"Exported {n} events" in r.stdout
+    lines = [json.loads(l) for l in open(out_file)]
+    assert len(lines) == n and all("eventId" in l for l in lines)
+
+    # pio batchpredict
+    queries = tmp_path / "queries.jsonl"
+    with open(queries, "w") as f:
+        for u in range(5):
+            f.write(json.dumps({"user": str(u), "num": 3}) + "\n")
+    preds = tmp_path / "preds.jsonl"
+    r = run_pio(
+        ["batchpredict", "--engine-dir", tpl, "--input", str(queries),
+         "--output", str(preds)],
+        cli_env,
+    )
+    out = [json.loads(l) for l in open(preds)]
+    assert len(out) == 5
+    assert all(len(o["prediction"]["itemScores"]) == 3 for o in out)
+
+    # app list shows the app
+    r = run_pio(["app", "list"], cli_env)
+    assert "MyApp1" in r.stdout
+
+    # unknown command → usage, exit 1
+    r = run_pio(["bogus"], cli_env, check=False)
+    assert r.returncode == 1 and "usage" in r.stderr

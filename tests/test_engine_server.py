@@ -1,0 +1,83 @@
+"""Engine (deploy) server over real HTTP: /queries.json hot path, status
+page, /reload hot-swap (reference: SURVEY.md §3.2)."""
+
+import requests
+
+from incubator_predictionio_tpu.controller import EngineParams
+from incubator_predictionio_tpu.models.recommendation import RecommendationEngine
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import run_train
+from incubator_predictionio_tpu.workflow.create_server import EngineServer
+
+from server_utils import ServerThread
+from test_dase_train_e2e import ENGINE_PARAMS, _seed_ratings
+
+
+def test_engine_server_query_and_reload(memory_storage):
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rec")
+
+    server = EngineServer(engine, engine_factory_name="rec", storage=memory_storage)
+    with ServerThread(server.app) as st:
+        # status page
+        r = requests.get(st.base + "/")
+        assert r.status_code == 200
+        status = r.json()
+        assert status["status"] == "alive"
+        first_instance = status["engineInstanceId"]
+
+        # the hot path
+        r = requests.post(st.base + "/queries.json", json={"user": "1", "num": 4})
+        assert r.status_code == 200, r.text
+        scores = r.json()["itemScores"]
+        assert len(scores) == 4
+        assert scores[0]["score"] >= scores[-1]["score"]
+
+        # malformed body / missing field
+        r = requests.post(st.base + "/queries.json", data="}{",
+                          headers={"Content-Type": "application/json"})
+        assert r.status_code == 400
+        r = requests.post(st.base + "/queries.json", json={"num": 4})
+        assert r.status_code == 400
+        assert "user" in r.json()["message"]
+
+        # train a second instance, /reload hot-swaps to it
+        iid2 = run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rec")
+        r = requests.get(st.base + "/reload")
+        assert r.status_code == 200
+        assert r.json()["engineInstanceId"] == iid2
+        assert requests.get(st.base + "/").json()["engineInstanceId"] != first_instance
+
+        # queries still served after reload
+        r = requests.post(st.base + "/queries.json", json={"user": "2", "num": 2})
+        assert r.status_code == 200
+        assert len(r.json()["itemScores"]) == 2
+
+
+def test_engine_server_plugins(memory_storage):
+    from incubator_predictionio_tpu.workflow.plugins import (
+        EngineServerPlugin,
+        EngineServerPluginContext,
+    )
+
+    class Capper(EngineServerPlugin):
+        name = "capper"
+
+        def process(self, query, result):
+            result["itemScores"] = result["itemScores"][:1]
+            return result
+
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rec")
+    server = EngineServer(
+        engine, engine_factory_name="rec", storage=memory_storage,
+        plugins=EngineServerPluginContext([Capper()]),
+    )
+    with ServerThread(server.app) as st:
+        assert requests.get(st.base + "/plugins.json").json() == {"plugins": ["capper"]}
+        r = requests.post(st.base + "/queries.json", json={"user": "1", "num": 5})
+        assert len(r.json()["itemScores"]) == 1

@@ -1,0 +1,167 @@
+"""Event Server REST semantics (reference test strategy: SURVEY.md §4
+eventserver_test.py scenario — real HTTP against the full stack)."""
+
+import requests
+
+from incubator_predictionio_tpu.data.api.event_server import EventServer
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Channel
+
+from server_utils import ServerThread
+
+
+def _setup(storage, events=()):
+    app_id = storage.get_meta_data_apps().insert(App(0, "evapp"))
+    key = storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, tuple(events))
+    )
+    storage.get_l_events().init(app_id)
+    return app_id, key
+
+
+def test_event_server_lifecycle(memory_storage):
+    app_id, key = _setup(memory_storage)
+    server = EventServer(memory_storage, enable_stats=True)
+    with ServerThread(server.app) as st:
+        # health
+        assert requests.get(st.base + "/").json() == {"status": "alive"}
+
+        # auth required / invalid
+        r = requests.post(st.base + "/events.json", json={})
+        assert r.status_code == 401
+        r = requests.post(st.base + "/events.json?accessKey=wrong", json={})
+        assert r.status_code == 401
+
+        # create
+        body = {
+            "event": "rate", "entityType": "user", "entityId": "u1",
+            "targetEntityType": "item", "targetEntityId": "i1",
+            "properties": {"rating": 5}, "eventTime": "2024-01-01T00:00:00.000Z",
+        }
+        r = requests.post(f"{st.base}/events.json?accessKey={key}", json=body)
+        assert r.status_code == 201, r.text
+        event_id = r.json()["eventId"]
+
+        # get
+        r = requests.get(f"{st.base}/events/{event_id}.json?accessKey={key}")
+        assert r.status_code == 200
+        assert r.json()["entityId"] == "u1"
+        assert r.json()["properties"] == {"rating": 5}
+
+        # find
+        r = requests.get(f"{st.base}/events.json?accessKey={key}&event=rate")
+        assert len(r.json()) == 1
+        r = requests.get(f"{st.base}/events.json?accessKey={key}&event=buy")
+        assert r.json() == []
+
+        # validation error → 400 with message
+        r = requests.post(
+            f"{st.base}/events.json?accessKey={key}",
+            json={"event": "$unset", "entityType": "u", "entityId": "1"},
+        )
+        assert r.status_code == 400
+        assert "properties" in r.json()["message"]
+
+        # malformed JSON → 400 not 500
+        r = requests.post(
+            f"{st.base}/events.json?accessKey={key}",
+            data="{not json", headers={"Content-Type": "application/json"},
+        )
+        assert r.status_code == 400
+
+        # batch
+        batch = [dict(body, entityId=f"u{j}") for j in range(3)] + [
+            {"event": "", "entityType": "u", "entityId": "x"}
+        ]
+        r = requests.post(f"{st.base}/batch/events.json?accessKey={key}", json=batch)
+        statuses = [x["status"] for x in r.json()]
+        assert statuses == [201, 201, 201, 400]
+
+        # batch size cap
+        r = requests.post(
+            f"{st.base}/batch/events.json?accessKey={key}",
+            json=[body] * 51,
+        )
+        assert r.status_code == 400
+
+        # delete
+        r = requests.delete(f"{st.base}/events/{event_id}.json?accessKey={key}")
+        assert r.status_code == 200
+        r = requests.get(f"{st.base}/events/{event_id}.json?accessKey={key}")
+        assert r.status_code == 404
+
+        # stats enabled
+        r = requests.get(f"{st.base}/stats.json?accessKey={key}")
+        assert r.status_code == 200
+        counts = r.json()["counts"]
+        assert any(c["event"] == "rate" and c["status"] == 201 for c in counts)
+
+
+def test_event_whitelist_and_channels(memory_storage):
+    app_id, key = _setup(memory_storage, events=("view",))
+    cid = memory_storage.get_meta_data_channels().insert(
+        Channel(0, "mobile", app_id)
+    )
+    memory_storage.get_l_events().init(app_id, cid)
+    server = EventServer(memory_storage)
+    with ServerThread(server.app) as st:
+        ok = {"event": "view", "entityType": "user", "entityId": "1"}
+        r = requests.post(f"{st.base}/events.json?accessKey={key}", json=ok)
+        assert r.status_code == 201
+        r = requests.post(
+            f"{st.base}/events.json?accessKey={key}",
+            json={"event": "buy", "entityType": "user", "entityId": "1"},
+        )
+        assert r.status_code == 403
+
+        # channel isolation
+        r = requests.post(
+            f"{st.base}/events.json?accessKey={key}&channel=mobile", json=ok
+        )
+        assert r.status_code == 201
+        r = requests.get(f"{st.base}/events.json?accessKey={key}&channel=mobile")
+        assert len(r.json()) == 1
+        r = requests.get(f"{st.base}/events.json?accessKey={key}")
+        assert len(r.json()) == 1  # default channel only has the first event
+        r = requests.post(
+            f"{st.base}/events.json?accessKey={key}&channel=ghost", json=ok
+        )
+        assert r.status_code == 400
+
+        # stats disabled → 404 with hint
+        r = requests.get(f"{st.base}/stats.json?accessKey={key}")
+        assert r.status_code == 404
+
+
+def test_webhooks(memory_storage):
+    app_id, key = _setup(memory_storage)
+    server = EventServer(memory_storage)
+    with ServerThread(server.app) as st:
+        # segmentio JSON
+        r = requests.post(
+            f"{st.base}/webhooks/segmentio.json?accessKey={key}",
+            json={"type": "track", "userId": "u9", "event": "Signed Up",
+                  "properties": {"plan": "Pro"},
+                  "timestamp": "2024-02-01T00:00:00.000Z"},
+        )
+        assert r.status_code == 201, r.text
+        # mailchimp form
+        r = requests.post(
+            f"{st.base}/webhooks/mailchimp.json?accessKey={key}",
+            data={"type": "subscribe", "fired_at": "2024-02-01 10:00:00",
+                  "data[id]": "8a25ff1d98", "data[email]": "api@mailchimp.com"},
+        )
+        assert r.status_code == 201, r.text
+        # unknown connector
+        r = requests.post(
+            f"{st.base}/webhooks/nope.json?accessKey={key}", json={}
+        )
+        assert r.status_code == 404
+        # bad segmentio type
+        r = requests.post(
+            f"{st.base}/webhooks/segmentio.json?accessKey={key}",
+            json={"type": "bogus", "userId": "x"},
+        )
+        assert r.status_code == 400
+
+        events = list(memory_storage.get_l_events().find(app_id))
+        assert {e.event for e in events} == {"track", "subscribe"}
