@@ -166,11 +166,17 @@ def main() -> int:
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE.json")
     baseline_key = f"numpy_single_core_als_rank{rank}_x{iters}iters_events_per_sec"
     vs_baseline = None
+    baseline_writable = True
     try:
         with open(baseline_path) as f:
             baseline_doc = json.load(f)
-    except Exception:
+    except FileNotFoundError:
         baseline_doc = {"published": {}}
+    except Exception as e:
+        # Unreadable/corrupt: never overwrite the metric contract file.
+        log(f"[bench] BASELINE.json unreadable ({e}); running without cache")
+        baseline_doc = {"published": {}}
+        baseline_writable = False
     published = baseline_doc.setdefault("published", {})
     if baseline_key not in published:
         log("[bench] measuring NumPy single-core baseline (one-time)...")
@@ -183,11 +189,12 @@ def main() -> int:
         )
         log(f"[bench] baseline measured in {time.time()-t0:.1f}s: "
             f"{published[baseline_key]:,.0f} events/sec")
-        try:
-            with open(baseline_path, "w") as f:
-                json.dump(baseline_doc, f, indent=2)
-        except Exception as e:
-            log(f"[bench] could not persist baseline: {e}")
+        if baseline_writable:
+            try:
+                with open(baseline_path, "w") as f:
+                    json.dump(baseline_doc, f, indent=2)
+            except Exception as e:
+                log(f"[bench] could not persist baseline: {e}")
     vs_baseline = events_per_sec / published[baseline_key]
 
     print(json.dumps({

@@ -22,11 +22,17 @@ class MailChimpConnector(FormConnector):
             raise EventValidationError(
                 f"mailchimp event type {event_type!r} is not supported"
             )
-        data = {
-            k[5:-1]: v
-            for k, v in payload.items()
-            if k.startswith("data[") and k.endswith("]")
-        }
+        # Flatten "data[a]" → {"a": v} and nest "data[a][b]" → {"a": {"b": v}}.
+        data: dict = {}
+        for k, v in payload.items():
+            if not (k.startswith("data[") and k.endswith("]")):
+                continue
+            path = k[5:-1].split("][")
+            node = data
+            for part in path[:-1]:
+                node = node.setdefault(part, {})
+            if isinstance(node, dict):
+                node[path[-1]] = v
         entity_id = data.get("id") or data.get("email")
         if not entity_id:
             raise EventValidationError("mailchimp payload has no data[id]/data[email]")

@@ -1,5 +1,9 @@
 """ML helper lib (reference: e2/ — SURVEY.md §2.7)."""
 
 from .cross_validation import k_fold_indices
+from .engine import BinaryVectorizer, CategoricalNaiveBayes, markov_chain
 
-__all__ = ["k_fold_indices"]
+__all__ = [
+    "BinaryVectorizer", "CategoricalNaiveBayes", "k_fold_indices",
+    "markov_chain",
+]

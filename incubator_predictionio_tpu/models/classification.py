@@ -1,0 +1,181 @@
+"""Classification template (attribute-based classifier).
+
+Reference: examples/scala-parallel-classification + upstream
+predictionio-template-attribute-based-classifier (SURVEY.md §2.8 row 2):
+$set events carry numeric attributes + a "plan" label on "user" entities;
+MLlib NaiveBayes (variant: LogisticRegressionWithLBFGS) trains on
+LabeledPoints; query = attribute vector → predicted label.
+
+TPU-native: aggregateProperties → dense [N,D] feature matrix;
+ops/linear kernels (mesh-sharded stats / L-BFGS).
+
+Wire format (template parity):
+  query  {"attr0": 2, "attr1": 0, "attr2": 0}
+  result {"label": 1.0}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    Params,
+    SanityCheck,
+)
+from ..data.store.p_event_store import PEventStore
+from ..ops.linear import (
+    LogisticRegressionModel,
+    NaiveBayesModel,
+    train_logistic_regression,
+    train_naive_bayes,
+)
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    features: np.ndarray  # [N, D] f32
+    labels: np.ndarray  # [N] int32
+    attribute_names: Sequence[str]
+    label_values: np.ndarray  # class index → original label value
+
+    def sanity_check(self):
+        assert len(self.features) > 0, "no labeled entities found"
+        assert len(self.features) == len(self.labels)
+
+
+PreparedData = TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    entity_type: str = "user"
+    attributes: Sequence[str] = ("attr0", "attr1", "attr2")
+    label: str = "plan"
+
+
+class ClassificationDataSource(DataSource):
+    params_cls = DataSourceParams
+    params_aliases = {"appName": "app_name", "entityType": "entity_type"}
+
+    def read_training(self, ctx) -> TrainingData:
+        p: DataSourceParams = self.params
+        app_name = p.app_name or ctx.app_name
+        props = PEventStore.aggregate_properties(
+            app_name,
+            p.entity_type,
+            channel_name=ctx.channel_name,
+            required=list(p.attributes) + [p.label],
+            storage=ctx.get_storage(),
+        )
+        feats, labels = [], []
+        for _eid, pm in props.items():
+            feats.append([float(pm.require(a)) for a in p.attributes])
+            labels.append(pm.require(p.label))
+        label_values, y = np.unique(np.asarray(labels), return_inverse=True)
+        return TrainingData(
+            features=np.asarray(feats, np.float32),
+            labels=y.astype(np.int32),
+            attribute_names=tuple(p.attributes),
+            label_values=label_values,
+        )
+
+    def read_eval(self, ctx):
+        from ..e2.cross_validation import k_fold_indices
+
+        td = self.read_training(ctx)
+        folds = []
+        for train_sel, test_sel in k_fold_indices(len(td.labels), k=3, seed=1):
+            train = TrainingData(
+                td.features[train_sel], td.labels[train_sel],
+                td.attribute_names, td.label_values,
+            )
+            queries = [
+                (
+                    dict(zip(td.attribute_names, td.features[j].tolist())),
+                    {"label": float(td.label_values[td.labels[j]])},
+                )
+                for j in np.nonzero(test_sel)[0]
+            ]
+            folds.append((train, None, queries))
+        return folds
+
+
+@dataclasses.dataclass
+class ClassifierModel:
+    inner: object  # NaiveBayesModel | LogisticRegressionModel
+    attribute_names: Sequence[str]
+    label_values: np.ndarray
+
+    def predict_label(self, features: np.ndarray) -> float:
+        x = np.asarray(features, np.float32)[None, :]
+        if isinstance(self.inner, NaiveBayesModel):
+            scores = self.inner.predict_log_joint(x)[0]
+        else:
+            scores = self.inner.predict_logits(x)[0]
+        return float(self.label_values[int(np.argmax(scores))])
+
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    # MLlib NaiveBayes additive smoothing; template engine.json: {"lambda": 1.0}
+    smoothing: float = 1.0
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    params_cls = NaiveBayesParams
+    params_aliases = {"lambda": "smoothing"}
+
+    def train(self, ctx, pd: PreparedData) -> ClassifierModel:
+        model = train_naive_bayes(
+            pd.features, pd.labels, n_classes=len(pd.label_values),
+            smoothing=self.params.smoothing,
+            mesh=ctx.get_mesh() if ctx else None,
+        )
+        return ClassifierModel(model, pd.attribute_names, pd.label_values)
+
+    def predict(self, model: ClassifierModel, query: dict) -> dict:
+        x = np.asarray(
+            [float(query[a]) for a in model.attribute_names], np.float32
+        )
+        return {"label": model.predict_label(x)}
+
+
+@dataclasses.dataclass(frozen=True)
+class LogisticRegressionParams(Params):
+    reg: float = 0.0
+    max_iters: int = 100
+
+
+class LogisticRegressionAlgorithm(Algorithm):
+    params_cls = LogisticRegressionParams
+    params_aliases = {"regParam": "reg", "maxIterations": "max_iters"}
+
+    def train(self, ctx, pd: PreparedData) -> ClassifierModel:
+        model = train_logistic_regression(
+            pd.features, pd.labels, n_classes=len(pd.label_values),
+            reg=self.params.reg, max_iters=self.params.max_iters,
+            mesh=ctx.get_mesh() if ctx else None,
+        )
+        return ClassifierModel(model, pd.attribute_names, pd.label_values)
+
+    predict = NaiveBayesAlgorithm.predict
+
+
+class ClassificationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class=ClassificationDataSource,
+            algorithm_class_map={
+                "naive": NaiveBayesAlgorithm,
+                "lr": LogisticRegressionAlgorithm,
+                "": NaiveBayesAlgorithm,
+            },
+        )

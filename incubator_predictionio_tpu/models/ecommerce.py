@@ -1,0 +1,225 @@
+"""E-Commerce Recommendation template.
+
+Reference: examples/scala-parallel-ecommercerecommendation (SURVEY.md
+§2.8 note): implicit ALS over view/buy events; at SERVE time the
+prediction filters out items the user has already seen (LEventStore read
+inside predict — the canonical serve-time-context template) and items
+$set as unavailable via a "constraint" entity.
+
+Wire format (template parity):
+  query  {"user": "u1", "num": 4, "categories": [...],
+          "whiteList": [...], "blackList": [...], "unseenOnly": true}
+  result {"itemScores": [{"item": ..., "score": ...}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..controller import Algorithm, Engine, EngineFactory, Params
+from ..data.store.l_event_store import LEventStore
+from ..data.store.p_event_store import PEventStore
+from ..data.storage.bimap import BiMap
+from ..ops.als import ALSFactors, ALSParams, train_als
+from ..ops.topk import top_k_items
+from .similar_product import (
+    SimilarProductDataSource,
+    DataSourceParams as SPDataSourceParams,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommerceDataSourceParams(SPDataSourceParams):
+    event_names: Sequence[str] = ("view", "buy")
+
+
+class ECommerceDataSource(SimilarProductDataSource):
+    params_cls = ECommerceDataSourceParams
+
+
+@dataclasses.dataclass
+class ECommerceModel:
+    factors: ALSFactors
+    users: BiMap
+    items: BiMap
+    item_categories: dict[str, set[str]]
+    app_name: str
+    seen_event_names: Sequence[str]
+    _dev_items: object = dataclasses.field(default=None, repr=False, compare=False)
+    _storage: object = dataclasses.field(default=None, repr=False, compare=False)
+
+    def device_item_factors(self):
+        if self._dev_items is None:
+            import jax
+
+            self._dev_items = jax.device_put(self.factors.item_factors)
+        return self._dev_items
+
+    def warm_up(self, num: int = 10):
+        self.device_item_factors()
+        if len(self.users):
+            self.recommend(next(iter(self.users.keys())), num)
+
+    def _seen_items(self, user: str) -> set[str]:
+        """Serve-time LEventStore read (reference: ECommAlgorithm.predict
+        querying recent view events)."""
+        try:
+            events = LEventStore.find_by_entity(
+                self.app_name, "user", user,
+                event_names=list(self.seen_event_names),
+                limit=200, storage=self._storage,
+            )
+        except Exception:
+            return set()
+        return {e.target_entity_id for e in events if e.target_entity_id}
+
+    def _unavailable_items(self) -> set[str]:
+        """$set constraint entity (reference: ECommAlgorithm
+        unavailableItems constraint)."""
+        try:
+            events = LEventStore.find_by_entity(
+                self.app_name, "constraint", "unavailableItems",
+                event_names=["$set"], limit=1, storage=self._storage,
+            )
+        except Exception:
+            return set()
+        for e in events:
+            return set(e.properties.get_or_else("items", []))
+        return set()
+
+    def recommend(
+        self,
+        user: str,
+        num: int,
+        categories: Optional[Sequence[str]] = None,
+        white_list: Optional[Sequence[str]] = None,
+        black_list: Optional[Sequence[str]] = None,
+        unseen_only: bool = True,
+    ):
+        uidx = self.users.get(user)
+        if uidx is None:
+            return []
+        n_items = len(self.items)
+        exclude = np.zeros(n_items, dtype=bool)
+        if unseen_only:
+            for item in self._seen_items(user):
+                j = self.items.get(item)
+                if j is not None:
+                    exclude[j] = True
+        for item in self._unavailable_items():
+            j = self.items.get(item)
+            if j is not None:
+                exclude[j] = True
+        if categories:
+            cset = set(categories)
+            for j in range(n_items):
+                if not (self.item_categories.get(self.items.inverse(j), set()) & cset):
+                    exclude[j] = True
+        if white_list:
+            allowed = {self.items.get(w) for w in white_list} - {None}
+            mask = np.ones(n_items, dtype=bool)
+            mask[list(allowed)] = False
+            exclude |= mask
+        if black_list:
+            for b in black_list:
+                j = self.items.get(b)
+                if j is not None:
+                    exclude[j] = True
+        scores, idx = top_k_items(
+            self.factors.user_factors[uidx], self.device_item_factors(),
+            num, exclude=exclude,
+        )
+        return [
+            (self.items.inverse(int(j)), float(s))
+            for s, j in zip(scores, idx)
+            if np.isfinite(s)
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommerceAlgoParams(Params):
+    app_name: str = ""
+    rank: int = 10
+    num_iterations: int = 20
+    reg: float = 0.01
+    alpha: float = 1.0
+    seen_events: Sequence[str] = ("view", "buy")
+    seed: Optional[int] = None
+
+
+class ECommerceAlgorithm(Algorithm):
+    params_cls = ECommerceAlgoParams
+    params_aliases = {
+        "appName": "app_name", "lambda": "reg",
+        "numIterations": "num_iterations", "seenEvents": "seen_events",
+    }
+
+    def train(self, ctx, pd) -> ECommerceModel:
+        p = self.params
+        factors = train_als(
+            pd.user_idx, pd.item_idx, pd.rating,
+            n_users=len(pd.users), n_items=len(pd.items),
+            params=ALSParams(
+                rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
+                implicit_prefs=True, alpha=p.alpha,
+                seed=p.seed if p.seed is not None else 3,
+            ),
+            mesh=ctx.get_mesh() if ctx else None,
+        )
+        model = ECommerceModel(
+            factors=factors, users=pd.users, items=pd.items,
+            item_categories=pd.item_categories,
+            app_name=p.app_name or ctx.app_name,
+            seen_event_names=tuple(p.seen_events),
+        )
+        model._storage = ctx.get_storage()
+        return model
+
+    def predict(self, model: ECommerceModel, query: dict) -> dict:
+        pairs = model.recommend(
+            str(query["user"]),
+            int(query.get("num", 10)),
+            categories=query.get("categories"),
+            white_list=query.get("whiteList"),
+            black_list=query.get("blackList"),
+            unseen_only=bool(query.get("unseenOnly", True)),
+        )
+        return {"itemScores": [{"item": i, "score": s} for i, s in pairs]}
+
+    def prepare_model_for_persistence(self, model: ECommerceModel):
+        return {
+            "user_factors": np.asarray(model.factors.user_factors),
+            "item_factors": np.asarray(model.factors.item_factors),
+            "users": model.users.to_dict(),
+            "items": model.items.to_dict(),
+            "item_categories": {k: sorted(v) for k, v in model.item_categories.items()},
+            "app_name": model.app_name,
+            "seen_event_names": list(model.seen_event_names),
+        }
+
+    def restore_model(self, stored, ctx) -> ECommerceModel:
+        if isinstance(stored, ECommerceModel):
+            stored._storage = ctx.get_storage()
+            return stored
+        uf, itf = stored["user_factors"], stored["item_factors"]
+        model = ECommerceModel(
+            factors=ALSFactors(uf, itf, uf.shape[0], itf.shape[0]),
+            users=BiMap(stored["users"]),
+            items=BiMap(stored["items"]),
+            item_categories={k: set(v) for k, v in stored["item_categories"].items()},
+            app_name=stored["app_name"],
+            seen_event_names=tuple(stored["seen_event_names"]),
+        )
+        model._storage = ctx.get_storage()
+        return model
+
+
+class ECommerceEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class=ECommerceDataSource,
+            algorithm_class_map={"ecomm": ECommerceAlgorithm, "": ECommerceAlgorithm},
+        )

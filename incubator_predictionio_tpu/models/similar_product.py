@@ -1,0 +1,200 @@
+"""Similar-Product template.
+
+Reference: predictionio-template-similar-product (SURVEY.md §2.8 row 3):
+"view" events → MLlib ALS.trainImplicit; serving returns top-k items
+cosine-similar to the query items' factor vectors, with
+whitelist/blacklist/category business-rule filters.
+
+TPU-native: implicit ALS via ops.als; item-item cosine top-k on device
+(ops.topk.similar_items); category metadata from aggregated $set events.
+
+Wire format (template parity):
+  query  {"items": ["i1"], "num": 4, "categories": ["c"],
+          "whiteList": [...], "blackList": [...]}
+  result {"itemScores": [{"item": ..., "score": ...}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..controller import Algorithm, DataSource, Engine, EngineFactory, Params, SanityCheck
+from ..data.storage.bimap import BiMap
+from ..data.store.p_event_store import PEventStore, ratings_matrix
+from ..ops.als import ALSFactors, ALSParams, train_als
+from ..ops.topk import similar_items
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_idx: np.ndarray
+    item_idx: np.ndarray
+    rating: np.ndarray  # implicit strength (view counts)
+    users: BiMap
+    items: BiMap
+    item_categories: dict[str, set[str]]  # item id → categories
+
+    def sanity_check(self):
+        assert len(self.user_idx) > 0, "no view events found"
+
+
+PreparedData = TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: Sequence[str] = ("view",)
+    item_entity_type: str = "item"
+
+
+class SimilarProductDataSource(DataSource):
+    params_cls = DataSourceParams
+    params_aliases = {"appName": "app_name", "eventNames": "event_names"}
+
+    def read_training(self, ctx) -> TrainingData:
+        p: DataSourceParams = self.params
+        app_name = p.app_name or ctx.app_name
+        batch = PEventStore.find_batch(
+            app_name,
+            event_names=list(p.event_names),
+            storage=ctx.get_storage(),
+            channel_name=ctx.channel_name,
+        )
+        u, i, r, users, items = ratings_matrix(batch, rating_from_props=False)
+        cats: dict[str, set[str]] = {}
+        for item_id, pm in PEventStore.aggregate_properties(
+            app_name, p.item_entity_type, storage=ctx.get_storage()
+        ).items():
+            c = pm.get_opt("categories")
+            if c:
+                cats[item_id] = set(c)
+        return TrainingData(u, i, r, users, items, cats)
+
+
+@dataclasses.dataclass
+class SimilarProductModel:
+    factors: ALSFactors
+    items: BiMap
+    item_categories: dict[str, set[str]]
+    _dev_items: object = dataclasses.field(default=None, repr=False, compare=False)
+
+    def device_item_factors(self):
+        if self._dev_items is None:
+            import jax
+
+            self._dev_items = jax.device_put(self.factors.item_factors)
+        return self._dev_items
+
+    def warm_up(self, num: int = 10):
+        self.device_item_factors()
+        if len(self.items):
+            self.similar([next(iter(self.items.keys()))], num)
+
+    def similar(
+        self,
+        query_items: Sequence[str],
+        num: int,
+        categories: Optional[Sequence[str]] = None,
+        white_list: Optional[Sequence[str]] = None,
+        black_list: Optional[Sequence[str]] = None,
+    ):
+        idxs = [self.items.get(q) for q in query_items]
+        idxs = [j for j in idxs if j is not None]
+        if not idxs:
+            return []
+        n_items = len(self.items)
+        exclude = np.zeros(n_items, dtype=bool)
+        exclude[idxs] = True  # never return the query items themselves
+        if categories:
+            cset = set(categories)
+            for j in range(n_items):
+                item_id = self.items.inverse(j)
+                if not (self.item_categories.get(item_id, set()) & cset):
+                    exclude[j] = True
+        if white_list:
+            allowed = {self.items.get(w) for w in white_list} - {None}
+            mask = np.ones(n_items, dtype=bool)
+            mask[list(allowed)] = False
+            exclude |= mask
+        if black_list:
+            for b in black_list:
+                j = self.items.get(b)
+                if j is not None:
+                    exclude[j] = True
+        qvecs = self.factors.item_factors[idxs]
+        scores, idx = similar_items(
+            qvecs, self.device_item_factors(), num, exclude=exclude
+        )
+        return [
+            (self.items.inverse(int(j)), float(s))
+            for s, j in zip(scores, idx)
+            if np.isfinite(s)
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarProductAlgoParams(Params):
+    rank: int = 10
+    num_iterations: int = 20
+    reg: float = 0.01
+    alpha: float = 1.0
+    seed: Optional[int] = None
+
+
+class SimilarProductAlgorithm(Algorithm):
+    params_cls = SimilarProductAlgoParams
+    params_aliases = {"lambda": "reg", "numIterations": "num_iterations"}
+
+    def train(self, ctx, pd: PreparedData) -> SimilarProductModel:
+        p = self.params
+        factors = train_als(
+            pd.user_idx, pd.item_idx, pd.rating,
+            n_users=len(pd.users), n_items=len(pd.items),
+            params=ALSParams(
+                rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
+                implicit_prefs=True, alpha=p.alpha,
+                seed=p.seed if p.seed is not None else 3,
+            ),
+            mesh=ctx.get_mesh() if ctx else None,
+        )
+        return SimilarProductModel(factors, pd.items, pd.item_categories)
+
+    def predict(self, model: SimilarProductModel, query: dict) -> dict:
+        pairs = model.similar(
+            [str(x) for x in query.get("items", [])],
+            int(query.get("num", 10)),
+            categories=query.get("categories"),
+            white_list=query.get("whiteList"),
+            black_list=query.get("blackList"),
+        )
+        return {"itemScores": [{"item": i, "score": s} for i, s in pairs]}
+
+    def prepare_model_for_persistence(self, model: SimilarProductModel):
+        return {
+            "user_factors": np.asarray(model.factors.user_factors),
+            "item_factors": np.asarray(model.factors.item_factors),
+            "items": model.items.to_dict(),
+            "item_categories": {k: sorted(v) for k, v in model.item_categories.items()},
+        }
+
+    def restore_model(self, stored, ctx) -> SimilarProductModel:
+        if isinstance(stored, SimilarProductModel):
+            return stored
+        uf, itf = stored["user_factors"], stored["item_factors"]
+        return SimilarProductModel(
+            factors=ALSFactors(uf, itf, uf.shape[0], itf.shape[0]),
+            items=BiMap(stored["items"]),
+            item_categories={k: set(v) for k, v in stored["item_categories"].items()},
+        )
+
+
+class SimilarProductEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class=SimilarProductDataSource,
+            algorithm_class_map={"als": SimilarProductAlgorithm, "": SimilarProductAlgorithm},
+        )

@@ -1,0 +1,183 @@
+"""Text-classification template (TF-IDF + NB / LR).
+
+Reference: predictionio-template-text-classifier (SURVEY.md §2.8 row 4):
+"documents" events carry {"text", "label"} properties; tokenize → TF-IDF
+→ MLlib NaiveBayes or LogisticRegression; query = raw text → category +
+confidence.
+
+Wire format (template parity):
+  query  {"text": "I like speed and fast motorcycles."}
+  result {"category": "motorcycles", "confidence": 0.87}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from ..controller import Algorithm, DataSource, Engine, EngineFactory, Params, SanityCheck
+from ..data.store.p_event_store import PEventStore
+from ..ops.linear import (
+    NaiveBayesModel,
+    train_logistic_regression,
+    train_naive_bayes,
+)
+from ..ops.tfidf import TfIdfVectorizer
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    texts: list[str]
+    labels: np.ndarray  # [N] int32
+    label_values: np.ndarray
+
+    def sanity_check(self):
+        assert len(self.texts) > 0, "no documents found"
+
+
+@dataclasses.dataclass
+class PreparedData:
+    features: np.ndarray  # [N, D] tf-idf
+    labels: np.ndarray
+    label_values: np.ndarray
+    vectorizer: TfIdfVectorizer
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: Sequence[str] = ("documents",)
+    entity_type: str = "content"
+    text_property: str = "text"
+    label_property: str = "label"
+
+
+class TextDataSource(DataSource):
+    params_cls = DataSourceParams
+    params_aliases = {"appName": "app_name", "eventNames": "event_names"}
+
+    def read_training(self, ctx) -> TrainingData:
+        p: DataSourceParams = self.params
+        batch = PEventStore.find_batch(
+            p.app_name or ctx.app_name,
+            event_names=list(p.event_names),
+            entity_type=p.entity_type,
+            storage=ctx.get_storage(),
+            channel_name=ctx.channel_name,
+        )
+        texts, labels = [], []
+        for props in batch.properties:
+            if p.text_property in props and p.label_property in props:
+                texts.append(str(props[p.text_property]))
+                labels.append(props[p.label_property])
+        label_values, y = np.unique(np.asarray(labels), return_inverse=True)
+        return TrainingData(texts, y.astype(np.int32), label_values)
+
+    def read_eval(self, ctx):
+        from ..e2.cross_validation import k_fold_indices
+
+        td = self.read_training(ctx)
+        folds = []
+        for train_sel, test_sel in k_fold_indices(len(td.texts), k=3, seed=2):
+            train = TrainingData(
+                [td.texts[j] for j in np.nonzero(train_sel)[0]],
+                td.labels[train_sel], td.label_values,
+            )
+            queries = [
+                ({"text": td.texts[j]},
+                 {"category": str(td.label_values[td.labels[j]])})
+                for j in np.nonzero(test_sel)[0]
+            ]
+            folds.append((train, None, queries))
+        return folds
+
+
+@dataclasses.dataclass(frozen=True)
+class PreparatorParams(Params):
+    n_features: int = 4096
+    ngram: int = 1
+
+
+class TextPreparator:
+    """TF-IDF fit (reference: template's Preparator builds the
+    HashingTF/IDF transform)."""
+
+    params_cls = PreparatorParams
+    params_aliases = {"numFeatures": "n_features", "nGram": "ngram"}
+
+    def __init__(self, params=None):
+        self.params = params or PreparatorParams()
+
+    def prepare(self, ctx, td: TrainingData) -> PreparedData:
+        vec = TfIdfVectorizer(
+            n_features=self.params.n_features, ngram=self.params.ngram
+        )
+        features = vec.fit_transform(td.texts)
+        return PreparedData(features, td.labels, td.label_values, vec)
+
+
+@dataclasses.dataclass
+class TextModel:
+    inner: object
+    vectorizer: TfIdfVectorizer
+    label_values: np.ndarray
+
+    def classify(self, text: str) -> tuple[str, float]:
+        x = self.vectorizer.transform([text])
+        if isinstance(self.inner, NaiveBayesModel):
+            scores = self.inner.predict_log_joint(x)[0]
+            z = scores - scores.max()
+            probs = np.exp(z) / np.exp(z).sum()
+        else:
+            probs = self.inner.predict_proba(x)[0]
+        c = int(np.argmax(probs))
+        return str(self.label_values[c]), float(probs[c])
+
+
+@dataclasses.dataclass(frozen=True)
+class TextAlgorithmParams(Params):
+    smoothing: float = 1.0  # NB
+    reg: float = 0.0  # LR
+    max_iters: int = 100  # LR
+
+
+class TextNBAlgorithm(Algorithm):
+    params_cls = TextAlgorithmParams
+    params_aliases = {"lambda": "smoothing", "regParam": "reg"}
+
+    def train(self, ctx, pd: PreparedData) -> TextModel:
+        inner = train_naive_bayes(
+            pd.features, pd.labels, len(pd.label_values),
+            smoothing=self.params.smoothing,
+            mesh=ctx.get_mesh() if ctx else None,
+        )
+        return TextModel(inner, pd.vectorizer, pd.label_values)
+
+    def predict(self, model: TextModel, query: dict) -> dict:
+        category, confidence = model.classify(str(query["text"]))
+        return {"category": category, "confidence": confidence}
+
+
+class TextLRAlgorithm(TextNBAlgorithm):
+    def train(self, ctx, pd: PreparedData) -> TextModel:
+        inner = train_logistic_regression(
+            pd.features, pd.labels, len(pd.label_values),
+            reg=self.params.reg, max_iters=self.params.max_iters,
+            mesh=ctx.get_mesh() if ctx else None,
+        )
+        return TextModel(inner, pd.vectorizer, pd.label_values)
+
+
+class TextClassificationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class=TextDataSource,
+            preparator_class=TextPreparator,
+            algorithm_class_map={
+                "nb": TextNBAlgorithm,
+                "lr": TextLRAlgorithm,
+                "": TextNBAlgorithm,
+            },
+        )

@@ -1,0 +1,275 @@
+"""Universal Recommender (CCO) template.
+
+Reference: ActionML universal-recommender (SURVEY.md §2.8 row 5):
+multi-event DataSource (primary "buy" + secondary "view",
+"category-pref", ...); Mahout SimilarityAnalysis builds LLR-thresholded
+cross-occurrence indicator matrices; indicators are indexed into
+Elasticsearch and queries run as ES boolean similarity queries with
+business rules (category filters/boosts, blacklists, date rules).
+
+TPU-native redesign: ops/llr.py computes the indicators as dense chunked
+MXU matmuls + vectorized G²; the "index" is a static [I, K] correlator
+array on device, and a query is a gather+dot + top_k — no Elasticsearch
+in the serving path. Business-rule filters (categories, white/black
+lists, exclude-purchased) are applied as device masks.
+
+Wire format (UR parity, core subset):
+  query  {"user": "u1", "num": 4, "fields": [{"name": "categories",
+          "values": ["c"], "bias": -1}], "blacklistItems": [...]}
+  result {"itemScores": [{"item": ..., "score": ...}]}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..controller import Algorithm, DataSource, Engine, EngineFactory, Params, SanityCheck
+from ..data.storage.bimap import BiMap
+from ..data.store.l_event_store import LEventStore
+from ..data.store.p_event_store import PEventStore
+from ..ops.llr import Indicators, cco_indicators, score_user
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    # per event name: (user_idx, item_idx) COO
+    events: dict[str, tuple[np.ndarray, np.ndarray]]
+    users: BiMap
+    items: BiMap
+    item_categories: dict[str, set[str]]
+
+    def sanity_check(self):
+        assert self.events, "no indicator events found"
+        primary = next(iter(self.events.values()))
+        assert len(primary[0]) > 0, "primary event has no data"
+
+
+PreparedData = TrainingData
+
+
+@dataclasses.dataclass(frozen=True)
+class URDataSourceParams(Params):
+    app_name: str = ""
+    # First name = the primary (conversion) event, like UR's eventNames.
+    event_names: Sequence[str] = ("buy", "view")
+    item_entity_type: str = "item"
+
+
+class URDataSource(DataSource):
+    params_cls = URDataSourceParams
+    params_aliases = {"appName": "app_name", "eventNames": "event_names"}
+
+    def read_training(self, ctx) -> TrainingData:
+        p: URDataSourceParams = self.params
+        app_name = p.app_name or ctx.app_name
+        batch = PEventStore.find_batch(
+            app_name,
+            event_names=list(p.event_names),
+            storage=ctx.get_storage(),
+            channel_name=ctx.channel_name,
+        )
+        users = BiMap.string_int(batch.entity_id)
+        items = BiMap.string_int(
+            t for t in batch.target_entity_id if t is not None
+        )
+        per_event: dict[str, tuple[list, list]] = {n: ([], []) for n in p.event_names}
+        for name, u, t in zip(batch.event, batch.entity_id, batch.target_entity_id):
+            if t is None:
+                continue
+            lu, li = per_event[name]
+            lu.append(users(u))
+            li.append(items(t))
+        events = {
+            n: (np.asarray(lu, np.int32), np.asarray(li, np.int32))
+            for n, (lu, li) in per_event.items()
+        }
+        cats: dict[str, set[str]] = {}
+        for item_id, pm in PEventStore.aggregate_properties(
+            app_name, p.item_entity_type, storage=ctx.get_storage()
+        ).items():
+            c = pm.get_opt("categories")
+            if c:
+                cats[item_id] = set(c)
+        return TrainingData(events, users, items, cats)
+
+
+@dataclasses.dataclass
+class URModel:
+    # event name → Indicators ([I,K] idx/LLR vs the primary item space)
+    indicators: dict[str, Indicators]
+    users: BiMap
+    items: BiMap
+    item_categories: dict[str, set[str]]
+    app_name: str
+    event_names: Sequence[str]
+    _storage: object = dataclasses.field(default=None, repr=False, compare=False)
+
+    def warm_up(self, num: int = 10):
+        if len(self.users):
+            self.recommend(next(iter(self.users.keys())), num)
+
+    def _history(self, user: str) -> dict[str, np.ndarray]:
+        """Realtime user history per event type (reference: UR queries the
+        event store at serve time so new events influence results
+        immediately)."""
+        n_items = len(self.items)
+        out = {}
+        for name in self.event_names:
+            membership = np.zeros(n_items, np.float32)
+            try:
+                events = LEventStore.find_by_entity(
+                    self.app_name, "user", user, event_names=[name],
+                    limit=500, storage=self._storage,
+                )
+            except Exception:
+                events = []
+            for e in events:
+                j = self.items.get(e.target_entity_id) if e.target_entity_id else None
+                if j is not None:
+                    membership[j] = 1.0
+            out[name] = membership
+        return out
+
+    def recommend(
+        self,
+        user: str,
+        num: int,
+        fields: Optional[Sequence[dict]] = None,
+        blacklist_items: Optional[Sequence[str]] = None,
+        exclude_primary_history: bool = True,
+    ):
+        history = self._history(user)
+        if not any(m.any() for m in history.values()):
+            return []  # unknown/cold user: UR would fall back to popularity
+        n_items = len(self.items)
+        exclude = np.zeros(n_items, dtype=bool)
+        if exclude_primary_history:
+            primary = self.event_names[0]
+            exclude |= history[primary] > 0
+        if blacklist_items:
+            for b in blacklist_items:
+                j = self.items.get(b)
+                if j is not None:
+                    exclude[j] = True
+        # UR "fields" biz rules: bias<0 = hard filter, bias>0 = boost.
+        boost_vec = np.ones(n_items, np.float32)
+        for f in fields or []:
+            values = set(f.get("values", []))
+            bias = float(f.get("bias", -1))
+            match = np.zeros(n_items, dtype=bool)
+            for j in range(n_items):
+                cats = self.item_categories.get(self.items.inverse(j), set())
+                if cats & values:
+                    match[j] = True
+            if bias < 0:
+                exclude |= ~match
+            else:
+                boost_vec = np.where(match, boost_vec * bias, boost_vec)
+
+        indicator_list = [
+            (self.indicators[name], history[name], 1.0)
+            for name in self.event_names
+            if name in self.indicators
+        ]
+        scores, idx = score_user(
+            indicator_list, num, exclude=exclude, item_boost=boost_vec
+        )
+        return [
+            (self.items.inverse(int(j)), float(s))
+            for s, j in zip(scores, idx)
+            if np.isfinite(s) and s > 0
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
+class URAlgorithmParams(Params):
+    app_name: str = ""
+    max_correlators_per_item: int = 50
+    llr_threshold: float = 0.0
+    user_chunk: int = 1024
+
+
+class URAlgorithm(Algorithm):
+    params_cls = URAlgorithmParams
+    params_aliases = {
+        "appName": "app_name",
+        "maxCorrelatorsPerItem": "max_correlators_per_item",
+        "minLLR": "llr_threshold",
+    }
+
+    def train(self, ctx, pd: PreparedData) -> URModel:
+        p = self.params
+        names = list(pd.events.keys())
+        primary_name = names[0]
+        pu, pi = pd.events[primary_name]
+        indicators = {}
+        for name in names:
+            su, si = pd.events[name]
+            if len(su) == 0:
+                continue
+            indicators[name] = cco_indicators(
+                pu, pi, su, si,
+                n_users=len(pd.users), n_items=len(pd.items),
+                max_correlators=p.max_correlators_per_item,
+                llr_threshold=p.llr_threshold,
+                u_chunk=p.user_chunk,
+            )
+        model = URModel(
+            indicators=indicators, users=pd.users, items=pd.items,
+            item_categories=pd.item_categories,
+            app_name=p.app_name or ctx.app_name,
+            event_names=tuple(names),
+        )
+        model._storage = ctx.get_storage()
+        return model
+
+    def predict(self, model: URModel, query: dict) -> dict:
+        pairs = model.recommend(
+            str(query["user"]),
+            int(query.get("num", 10)),
+            fields=query.get("fields"),
+            blacklist_items=query.get("blacklistItems"),
+        )
+        return {"itemScores": [{"item": i, "score": s} for i, s in pairs]}
+
+    def prepare_model_for_persistence(self, model: URModel):
+        return {
+            "indicators": {
+                n: {"idx": ind.idx, "score": ind.score}
+                for n, ind in model.indicators.items()
+            },
+            "users": model.users.to_dict(),
+            "items": model.items.to_dict(),
+            "item_categories": {k: sorted(v) for k, v in model.item_categories.items()},
+            "app_name": model.app_name,
+            "event_names": list(model.event_names),
+        }
+
+    def restore_model(self, stored, ctx) -> URModel:
+        if isinstance(stored, URModel):
+            stored._storage = ctx.get_storage()
+            return stored
+        model = URModel(
+            indicators={
+                n: Indicators(idx=v["idx"], score=v["score"])
+                for n, v in stored["indicators"].items()
+            },
+            users=BiMap(stored["users"]),
+            items=BiMap(stored["items"]),
+            item_categories={k: set(v) for k, v in stored["item_categories"].items()},
+            app_name=stored["app_name"],
+            event_names=tuple(stored["event_names"]),
+        )
+        model._storage = ctx.get_storage()
+        return model
+
+
+class UniversalRecommenderEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class=URDataSource,
+            algorithm_class_map={"ur": URAlgorithm, "": URAlgorithm},
+        )

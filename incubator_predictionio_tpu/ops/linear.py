@@ -1,0 +1,174 @@
+"""Linear-model kernels: multinomial Naive Bayes + logistic regression.
+
+The reference's classification templates call MLlib NaiveBayes /
+LogisticRegressionWithLBFGS (reference: examples/scala-parallel-
+classification, SURVEY.md §2.8 row 2; the distributed treeAggregate of
+sufficient stats / gradients lives inside MLlib). TPU-native design:
+
+- NB sufficient stats are one [C,N]×[N,D] matmul (one-hot labelsᵀ ×
+  features) — examples row-sharded over the mesh data axis, XLA emits the
+  psum over ICI from the sharding annotations (pjit, no manual
+  collectives).
+- LR is full-batch L-BFGS (optax) with the loss/grad pjit'd the same
+  way: per-device partial sums, psum'd gradients — the moral equivalent
+  of MLlib's treeAggregate pass, minus the shuffle.
+
+Numerical parity notes (SURVEY.md §7 hard parts): NB smoothing is MLlib's
+additive `lambda` (default 1.0); LR matches the template's L2-regularized
+multinomial softmax with intercept (regParam applied to weights only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, default_mesh, pad_rows
+
+
+# ---------------------------------------------------------------------------
+# Naive Bayes (multinomial, additive smoothing)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class NaiveBayesModel:
+    log_prior: np.ndarray  # [C]
+    log_likelihood: np.ndarray  # [C, D]
+    n_classes: int
+
+    def predict_log_joint(self, x: np.ndarray) -> np.ndarray:
+        return x @ self.log_likelihood.T + self.log_prior  # [B, C]
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def _nb_stats(x, y, w, n_classes: int):
+    onehot = jax.nn.one_hot(y, n_classes, dtype=x.dtype) * w[:, None]
+    feat = jnp.einsum("nc,nd->cd", onehot, x,
+                      preferred_element_type=jnp.float32)  # [C, D]
+    counts = onehot.sum(axis=0)  # [C]
+    return feat, counts
+
+
+def train_naive_bayes(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    smoothing: float = 1.0,
+    mesh: Optional[Mesh] = None,
+) -> NaiveBayesModel:
+    """x [N,D] nonneg features, y [N] int labels. Mesh-sharded stats."""
+    mesh = mesh or default_mesh()
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int32)
+    w = np.ones(x.shape[0], np.float32)
+    xp, yp, wp = pad_rows(x, n_dev), pad_rows(y, n_dev), pad_rows(w, n_dev)
+    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+    xp = jax.device_put(xp, shard2)
+    yp = jax.device_put(yp, shard1)
+    wp = jax.device_put(wp, shard1)
+    feat, counts = jax.device_get(_nb_stats(xp, yp, wp, n_classes))
+
+    total = counts.sum()
+    log_prior = np.log((counts + 1e-12) / max(total, 1e-12))
+    num = feat + smoothing
+    log_likelihood = np.log(num) - np.log(num.sum(axis=1, keepdims=True))
+    return NaiveBayesModel(
+        log_prior=log_prior.astype(np.float32),
+        log_likelihood=log_likelihood.astype(np.float32),
+        n_classes=n_classes,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Logistic regression (multinomial softmax, L2, L-BFGS)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LogisticRegressionModel:
+    weights: np.ndarray  # [D, C]
+    intercept: np.ndarray  # [C]
+    n_classes: int
+
+    def predict_logits(self, x: np.ndarray) -> np.ndarray:
+        return x @ self.weights + self.intercept
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        z = self.predict_logits(x)
+        z = z - z.max(axis=-1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=-1, keepdims=True)
+
+
+def train_logistic_regression(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    reg: float = 0.0,
+    max_iters: int = 100,
+    tol: float = 1e-6,
+    mesh: Optional[Mesh] = None,
+) -> LogisticRegressionModel:
+    """Full-batch multinomial LR via optax L-BFGS; data row-sharded over
+    the mesh, gradient psum inserted by XLA."""
+    import optax
+
+    mesh = mesh or default_mesh()
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int32)
+    n = x.shape[0]
+    mask = pad_rows(np.ones(n, np.float32), n_dev)
+    xp = pad_rows(x, n_dev)
+    yp = pad_rows(y, n_dev)
+    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+    xp = jax.device_put(xp, shard2)
+    yp = jax.device_put(yp, shard1)
+    maskp = jax.device_put(mask, shard1)
+    d = x.shape[1]
+
+    def loss_fn(params):
+        w, b = params
+        logits = xp @ w + b  # [Np, C] row-sharded
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, yp[:, None], axis=1)[:, 0]
+        data = jnp.sum(nll * maskp) / n
+        return data + 0.5 * reg * jnp.sum(w * w)
+
+    opt = optax.lbfgs()
+    params = (jnp.zeros((d, n_classes)), jnp.zeros((n_classes,)))
+    value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+    @jax.jit
+    def step(params, state):
+        value, grad = value_and_grad(params, state=state)
+        updates, state = opt.update(
+            grad, state, params, value=value, grad=grad, value_fn=loss_fn
+        )
+        params = optax.apply_updates(params, updates)
+        return params, state, value, optax.tree.norm(grad)
+
+    state = opt.init(params)
+    prev = np.inf
+    for it in range(max_iters):
+        params, state, value, gnorm = step(params, state)
+        v = float(value)
+        if abs(prev - v) < tol * max(1.0, abs(prev)) and float(gnorm) < 1e-4:
+            break
+        prev = v
+    w, b = jax.device_get(params)
+    return LogisticRegressionModel(
+        weights=np.asarray(w, np.float32),
+        intercept=np.asarray(b, np.float32),
+        n_classes=n_classes,
+    )

@@ -1,0 +1,193 @@
+"""Correlated Cross-Occurrence (CCO) with log-likelihood-ratio scoring.
+
+Reference behaviour: the Universal Recommender computes item-item
+cross-occurrence matrices per event type with Apache Mahout's
+SimilarityAnalysis.cooccurrencesIDSs (LLR-thresholded), then indexes the
+indicators into Elasticsearch (SURVEY.md §2.8 row 5). TPU-native design
+(SURVEY.md §7 step 10): co-occurrence counts are dense chunked matmuls on
+the MXU — user-interaction matrices are scattered into dense [U_chunk, I]
+slabs on device and C = Σ_chunks A_pᵀ A_s accumulates per primary/secondary
+pair; Dunning's G² LLR is evaluated vectorized over the full count matrix;
+top-k correlators per item are kept as static [I, K] index/score arrays
+(the "index" that replaces Elasticsearch — scoring is then a gather+dot,
+see models/universal_recommender.py).
+
+Catalog-size note: the dense co-occurrence block is [I, I] f32 — fine to
+~16k items on one chip (1GB); larger catalogs need item-axis chunking
+(future work, the layout already permits it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _xlogx(x):
+    return jnp.where(x > 0, x * jnp.log(jnp.maximum(x, 1e-30)), 0.0)
+
+
+def _entropy2(a, b):
+    return _xlogx(a + b) - _xlogx(a) - _xlogx(b)
+
+
+def llr_scores(k11, k12, k21, k22):
+    """Dunning's G² over contingency counts (vectorized).
+
+    Reference math: Mahout LogLikelihood.logLikelihoodRatio — G² =
+    2·(H(row)+H(col)−H(matrix)) in the xlogx formulation.
+    """
+    row = _entropy2(k11 + k12, k21 + k22)
+    col = _entropy2(k11 + k21, k12 + k22)
+    mat = (
+        _xlogx(k11 + k12 + k21 + k22)
+        - _xlogx(k11) - _xlogx(k12) - _xlogx(k21) - _xlogx(k22)
+    )
+    g2 = 2.0 * (row + col - mat)
+    # Guard tiny negatives from cancellation.
+    return jnp.maximum(g2, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("n_items", "u_chunk", "n_ranges"))
+def _cooccurrence_counts(pu, pi, su, si, n_items: int, u_chunk: int,
+                         n_ranges: int):
+    """C[i,j] = #users who interacted with primary item i and secondary
+    item j. COO inputs -1-padded; the scan covers exactly
+    ceil(n_users/u_chunk) user ranges. Dense per-user-chunk slabs keep the
+    matmul on the MXU."""
+
+    def body(c, k):
+        # Build dense binary slabs for user range [k*Uc, (k+1)*Uc).
+        def slab(uu, ii, lo):
+            ok = (uu >= lo) & (uu < lo + u_chunk) & (ii >= 0)
+            rows = jnp.where(ok, uu - lo, u_chunk)  # u_chunk = scratch row
+            a = jnp.zeros((u_chunk + 1, n_items), jnp.float32)
+            a = a.at[rows, jnp.maximum(ii, 0)].max(jnp.where(ok, 1.0, 0.0))
+            return a[:u_chunk]
+
+        lo = k * u_chunk
+        ap = slab(pu, pi, lo)
+        asec = slab(su, si, lo)
+        c = c + jnp.einsum("ui,uj->ij", ap, asec,
+                           preferred_element_type=jnp.float32)
+        return c, None
+
+    c0 = jnp.zeros((n_items, n_items), jnp.float32)
+    c, _ = jax.lax.scan(body, c0, jnp.arange(n_ranges))
+    return c
+
+
+@dataclasses.dataclass
+class Indicators:
+    """Top-K LLR correlators per primary item (static shapes)."""
+
+    idx: np.ndarray  # [I, K] int32, -1 = empty slot
+    score: np.ndarray  # [I, K] f32 LLR
+
+    @property
+    def max_correlators(self) -> int:
+        return self.idx.shape[1]
+
+
+def cco_indicators(
+    primary_u: np.ndarray,
+    primary_i: np.ndarray,
+    secondary_u: np.ndarray,
+    secondary_i: np.ndarray,
+    n_users: int,
+    n_items: int,
+    max_correlators: int = 50,
+    llr_threshold: float = 0.0,
+    u_chunk: int = 1024,
+) -> Indicators:
+    """Build the LLR-thresholded cross-occurrence indicator matrix between
+    a primary event's items and a secondary event's items (same item-id
+    space; self-co-occurrence when primary==secondary)."""
+
+    def pad_chunk(u, i):
+        u = np.asarray(u, np.int32)
+        i = np.asarray(i, np.int32)
+        # dedupe (user,item) pairs — binary interaction matrices
+        pairs = np.unique(np.stack([u, i], 1), axis=0)
+        u, i = pairs[:, 0], pairs[:, 1]
+        n = len(u)
+        target = max(((n + u_chunk - 1) // u_chunk) * u_chunk, u_chunk)
+        pu = np.full(target, -1, np.int32)
+        pi = np.full(target, -1, np.int32)
+        pu[:n], pi[:n] = u, i
+        return pu, pi
+
+    pu, pi = pad_chunk(primary_u, primary_i)
+    su, si = pad_chunk(secondary_u, secondary_i)
+    n_ranges = max((n_users + u_chunk - 1) // u_chunk, 1)
+
+    counts = _cooccurrence_counts(pu, pi, su, si, n_items, u_chunk, n_ranges)
+
+    # Dunning contingency over DISTINCT USERS (Mahout semantics):
+    # n_i = users who did the primary event on i, n_j = users who did the
+    # secondary event on j, N = total users.
+    n_i = np.bincount(pi[pi >= 0], minlength=n_items).astype(np.float32)
+    n_j = np.bincount(si[si >= 0], minlength=n_items).astype(np.float32)
+    n_total = float(n_users)
+
+    k11 = counts
+    k12 = jnp.maximum(jnp.asarray(n_i)[:, None] - counts, 0.0)
+    k21 = jnp.maximum(jnp.asarray(n_j)[None, :] - counts, 0.0)
+    k22 = jnp.maximum(n_total - k11 - k12 - k21, 0.0)
+    llr = llr_scores(k11, k12, k21, k22)
+    # No self-correlation on the diagonal and no score without counts.
+    llr = jnp.where(counts > 0, llr, 0.0)
+    llr = llr * (1.0 - jnp.eye(n_items, dtype=llr.dtype))
+    if llr_threshold > 0:
+        llr = jnp.where(llr >= llr_threshold, llr, 0.0)
+
+    k = min(max_correlators, n_items)
+    score, idx = jax.lax.top_k(llr, k)
+    score = np.array(jax.device_get(score))
+    idx = np.array(jax.device_get(idx), np.int32)
+    idx[score <= 0] = -1
+    return Indicators(idx=idx, score=score.astype(np.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _score_history(idx, score, membership, boost, k: int):
+    """score_i = Σ_slots score[i,s]·membership[idx[i,s]] (gather+dot) —
+    the ES similarity query replacement. membership: [I] 0/1 vector of the
+    user's history for this event type."""
+    m = jnp.where(idx >= 0, membership[jnp.maximum(idx, 0)], 0.0)
+    s = jnp.einsum("ik,ik->i", score, m) * boost
+    return s
+
+
+def score_user(
+    indicator_list: list[tuple[Indicators, np.ndarray, float]],
+    k: int,
+    exclude: Optional[np.ndarray] = None,
+    item_boost: Optional[np.ndarray] = None,
+):
+    """Combine per-event-type indicator scores for one user's history.
+
+    indicator_list: [(indicators, membership [I] f32, boost)] per event
+    type. ``item_boost`` [I] multiplies scores BEFORE top-k so boosted
+    items can enter the result set. Returns (scores[k], idx[k]) host
+    arrays.
+    """
+    total = None
+    for ind, membership, boost in indicator_list:
+        s = _score_history(
+            jnp.asarray(ind.idx), jnp.asarray(ind.score),
+            jnp.asarray(membership), jnp.float32(boost), ind.idx.shape[1],
+        )
+        total = s if total is None else total + s
+    if item_boost is not None:
+        total = total * jnp.asarray(item_boost, total.dtype)
+    if exclude is not None:
+        total = jnp.where(jnp.asarray(exclude), -jnp.inf, total)
+    kk = min(k, total.shape[0])
+    out = jax.lax.top_k(total, kk)
+    return jax.device_get(out)

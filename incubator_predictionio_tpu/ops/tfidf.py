@@ -1,0 +1,79 @@
+"""TF-IDF vectorization (host side) for the text-classification template.
+
+Reference behaviour: the text-classifier template tokenizes, builds TF-IDF
+vectors with Spark MLlib's HashingTF/IDF, then trains NB/LR
+(SURVEY.md §2.8 row 4). Host-side prep is the right split on TPU too:
+tokenization is string work (CPU), the [N,D] matrix then feeds the
+mesh-sharded linear kernels. Hashing keeps D static for XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"[A-Za-z0-9']+")
+
+
+def tokenize(text: str, ngram: int = 1) -> list[str]:
+    toks = [t.lower() for t in _TOKEN_RE.findall(text)]
+    if ngram <= 1:
+        return toks
+    out = list(toks)
+    for n in range(2, ngram + 1):
+        out += [" ".join(toks[j : j + n]) for j in range(len(toks) - n + 1)]
+    return out
+
+
+def _hash_token(tok: str, n_features: int) -> int:
+    # Deterministic (process-independent) FNV-1a, mirroring HashingTF's
+    # fixed-hash behaviour so models survive restarts.
+    h = 2166136261
+    for b in tok.encode():
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h % n_features
+
+
+@dataclasses.dataclass
+class TfIdfVectorizer:
+    n_features: int = 4096
+    ngram: int = 1
+    idf: Optional[np.ndarray] = None  # [D], set by fit
+
+    def term_frequencies(self, docs: Sequence[str]) -> np.ndarray:
+        x = np.zeros((len(docs), self.n_features), np.float32)
+        for row, doc in enumerate(docs):
+            for tok in tokenize(doc, self.ngram):
+                x[row, _hash_token(tok, self.n_features)] += 1.0
+        return x
+
+    def fit_transform(self, docs: Sequence[str]) -> np.ndarray:
+        tf = self.term_frequencies(docs)
+        df = (tf > 0).sum(axis=0)
+        n = len(docs)
+        # MLlib IDF: log((n+1)/(df+1))
+        self.idf = np.log((n + 1.0) / (df + 1.0)).astype(np.float32)
+        return tf * self.idf
+
+    def transform(self, docs: Sequence[str]) -> np.ndarray:
+        if self.idf is None:
+            raise ValueError("vectorizer is not fitted")
+        return self.term_frequencies(docs) * self.idf
+
+    def to_arrays(self) -> dict:
+        return {
+            "idf": self.idf,
+            "n_features": np.asarray(self.n_features),
+            "ngram": np.asarray(self.ngram),
+        }
+
+    @classmethod
+    def from_arrays(cls, arrays: dict) -> "TfIdfVectorizer":
+        return cls(
+            n_features=int(arrays["n_features"]),
+            ngram=int(arrays["ngram"]),
+            idf=np.asarray(arrays["idf"], np.float32),
+        )

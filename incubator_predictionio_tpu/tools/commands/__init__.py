@@ -26,7 +26,7 @@ def _load_all() -> None:
     for m in _MODULES:
         try:
             importlib.import_module(f".{m}", __package__)
-        except ImportError:  # pragma: no cover - broken module
+        except Exception:  # pragma: no cover — degrade to remaining verbs
             import traceback
 
             print(f"[warn] command module {m} failed to import:", file=sys.stderr)

@@ -1,0 +1,295 @@
+"""End-to-end tests for the four non-quickstart template families
+(SURVEY.md §2.8 rows 2-5): classification, text, similar-product,
+e-commerce, universal recommender — each through the full train →
+persist → reload → query workflow."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.controller import EngineParams
+from incubator_predictionio_tpu.data.storage import App, DataMap, Event
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import (
+    load_deployment,
+    run_train,
+)
+
+T0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+
+
+def _mk_app(storage, name):
+    app_id = storage.get_meta_data_apps().insert(App(0, name))
+    storage.get_l_events().init(app_id)
+    return app_id
+
+
+def _ts(i):
+    return T0 + dt.timedelta(seconds=i)
+
+
+# -- classification --------------------------------------------------------
+
+
+def test_classification_template(memory_storage):
+    from incubator_predictionio_tpu.models.classification import (
+        ClassificationEngine,
+    )
+
+    app_id = _mk_app(memory_storage, "clsapp")
+    le = memory_storage.get_l_events()
+    rng = np.random.default_rng(0)
+    events = []
+    for n in range(200):
+        attrs = rng.integers(0, 5, 3)
+        plan = int(attrs[0] >= 2) + int(attrs[0] >= 4)  # label from attr0
+        events.append(
+            Event("$set", "user", str(n),
+                  properties=DataMap({"attr0": int(attrs[0]), "attr1": int(attrs[1]),
+                                      "attr2": int(attrs[2]), "plan": plan}),
+                  event_time=_ts(n))
+        )
+    le.insert_batch(events, app_id)
+
+    engine = ClassificationEngine()()
+    ctx = WorkflowContext(app_name="clsapp", storage=memory_storage)
+    for algo in ("naive", "lr"):
+        ep = EngineParams.from_json({
+            "datasource": {"params": {"appName": "clsapp"}},
+            "algorithms": [{"name": algo, "params": {}}],
+        })
+        iid = run_train(engine, ep, ctx, engine_factory_name=f"cls-{algo}")
+        dep, _, _ = load_deployment(
+            engine, iid, WorkflowContext(storage=memory_storage),
+            engine_factory_name=f"cls-{algo}",
+        )
+        assert dep.query({"attr0": 0, "attr1": 1, "attr2": 0})["label"] == 0.0
+        assert dep.query({"attr0": 4, "attr1": 1, "attr2": 0})["label"] == 2.0
+
+
+# -- text classification ---------------------------------------------------
+
+
+def test_text_classification_template(memory_storage):
+    from incubator_predictionio_tpu.models.text_classification import (
+        TextClassificationEngine,
+    )
+
+    app_id = _mk_app(memory_storage, "txtapp")
+    le = memory_storage.get_l_events()
+    docs = [
+        ("fast motorcycles ride highway speed engine", "motorcycles"),
+        ("engine throttle motorcycles helmet speed", "motorcycles"),
+        ("ride motorcycles fast wheels", "motorcycles"),
+        ("graphics screen computer keyboard software", "computers"),
+        ("software computer cpu keyboard code", "computers"),
+        ("computer code screen programming", "computers"),
+    ] * 5
+    events = [
+        Event("documents", "content", str(j),
+              properties=DataMap({"text": t, "label": lab}), event_time=_ts(j))
+        for j, (t, lab) in enumerate(docs)
+    ]
+    le.insert_batch(events, app_id)
+
+    engine = TextClassificationEngine()()
+    ctx = WorkflowContext(app_name="txtapp", storage=memory_storage)
+    for algo in ("nb", "lr"):
+        ep = EngineParams.from_json({
+            "datasource": {"params": {"appName": "txtapp"}},
+            "preparator": {"params": {"numFeatures": 512}},
+            "algorithms": [{"name": algo, "params": {}}],
+        })
+        iid = run_train(engine, ep, ctx, engine_factory_name=f"txt-{algo}")
+        dep, _, _ = load_deployment(
+            engine, iid, WorkflowContext(storage=memory_storage),
+            engine_factory_name=f"txt-{algo}",
+        )
+        r = dep.query({"text": "I like speed and fast motorcycles"})
+        assert r["category"] == "motorcycles", r
+        assert 0 < r["confidence"] <= 1
+        r = dep.query({"text": "my computer software and keyboard"})
+        assert r["category"] == "computers", r
+
+
+# -- similar product -------------------------------------------------------
+
+
+def _seed_views(storage, app_name, groups=((0, 10), (10, 20)), n_users=40):
+    """Users view items only within their own group → within-group
+    similarity dominates."""
+    app_id = _mk_app(storage, app_name)
+    le = storage.get_l_events()
+    rng = np.random.default_rng(3)
+    events = []
+    for u in range(n_users):
+        lo, hi = groups[u % len(groups)]
+        for _ in range(12):
+            i = rng.integers(lo, hi)
+            events.append(
+                Event("view", "user", str(u), "item", f"i{i}",
+                      event_time=_ts(len(events)))
+            )
+    # item categories: group 0 items = "red", group 1 = "blue"
+    for i in range(groups[-1][1]):
+        cat = "red" if i < groups[0][1] else "blue"
+        events.append(
+            Event("$set", "item", f"i{i}",
+                  properties=DataMap({"categories": [cat]}),
+                  event_time=_ts(len(events)))
+        )
+    le.insert_batch(events, app_id)
+    return app_id
+
+
+def test_similar_product_template(memory_storage):
+    from incubator_predictionio_tpu.models.similar_product import (
+        SimilarProductEngine,
+    )
+
+    _seed_views(memory_storage, "simapp")
+    engine = SimilarProductEngine()()
+    ctx = WorkflowContext(app_name="simapp", storage=memory_storage)
+    ep = EngineParams.from_json({
+        "datasource": {"params": {"appName": "simapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 8, "numIterations": 10}}],
+    })
+    iid = run_train(engine, ep, ctx, engine_factory_name="sim")
+    dep, _, _ = load_deployment(
+        engine, iid, WorkflowContext(storage=memory_storage),
+        engine_factory_name="sim",
+    )
+    r = dep.query({"items": ["i0"], "num": 5})
+    items = [s["item"] for s in r["itemScores"]]
+    assert "i0" not in items  # query item excluded
+    in_group = sum(1 for it in items if int(it[1:]) < 10)
+    assert in_group >= 4, f"similar items leak across groups: {items}"
+
+    # category filter: only "blue" items
+    r = dep.query({"items": ["i0"], "num": 5, "categories": ["blue"]})
+    assert all(int(s["item"][1:]) >= 10 for s in r["itemScores"])
+
+    # whitelist/blacklist
+    r = dep.query({"items": ["i0"], "num": 5, "whiteList": ["i3", "i4"]})
+    assert set(s["item"] for s in r["itemScores"]) <= {"i3", "i4"}
+    r = dep.query({"items": ["i0"], "num": 5, "blackList": ["i1"]})
+    assert "i1" not in [s["item"] for s in r["itemScores"]]
+
+    # unknown query item → empty
+    assert dep.query({"items": ["nope"], "num": 3}) == {"itemScores": []}
+
+
+# -- e-commerce ------------------------------------------------------------
+
+
+def test_ecommerce_template(memory_storage):
+    from incubator_predictionio_tpu.models.ecommerce import ECommerceEngine
+
+    app_id = _seed_views(memory_storage, "ecapp")
+    le = memory_storage.get_l_events()
+    engine = ECommerceEngine()()
+    ctx = WorkflowContext(app_name="ecapp", storage=memory_storage)
+    ep = EngineParams.from_json({
+        "datasource": {"params": {"appName": "ecapp"}},
+        "algorithms": [{"name": "ecomm",
+                        "params": {"appName": "ecapp", "rank": 8,
+                                   "numIterations": 10}}],
+    })
+    iid = run_train(engine, ep, ctx, engine_factory_name="ec")
+    dep, _, _ = load_deployment(
+        engine, iid, WorkflowContext(storage=memory_storage),
+        engine_factory_name="ec",
+    )
+    # user 0 (group 0) has seen several items; unseenOnly filters them
+    seen = {
+        e.target_entity_id
+        for e in le.find(app_id, entity_type="user", entity_id="0",
+                         event_names=["view"])
+    }
+    r = dep.query({"user": "0", "num": 5})
+    rec_items = [s["item"] for s in r["itemScores"]]
+    assert not (set(rec_items) & seen), "seen items not filtered"
+
+    # mark an item unavailable via the constraint entity → excluded
+    candidate = rec_items[0]
+    le.insert(
+        Event("$set", "constraint", "unavailableItems",
+              properties=DataMap({"items": [candidate]}), event_time=_ts(99999)),
+        app_id,
+    )
+    r2 = dep.query({"user": "0", "num": 5})
+    assert candidate not in [s["item"] for s in r2["itemScores"]]
+
+    # unseenOnly=false returns seen items too
+    r3 = dep.query({"user": "0", "num": 10, "unseenOnly": False})
+    assert set(s["item"] for s in r3["itemScores"]) & seen
+
+
+# -- universal recommender -------------------------------------------------
+
+
+def test_universal_recommender_template(memory_storage):
+    from incubator_predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine,
+    )
+
+    app_id = _mk_app(memory_storage, "urapp")
+    le = memory_storage.get_l_events()
+    rng = np.random.default_rng(7)
+    events = []
+    # two taste groups of 12 items; buys concentrated in-group (few per
+    # user so the exclude-purchased rule leaves in-group candidates),
+    # views noisier
+    for u in range(40):
+        group = u % 2
+        lo, hi = (0, 12) if group == 0 else (12, 24)
+        for _ in range(4):
+            events.append(Event("buy", "user", str(u), "item",
+                                f"i{rng.integers(lo, hi)}",
+                                event_time=_ts(len(events))))
+        for _ in range(10):
+            # views mostly in-group with some cross-noise
+            if rng.random() < 0.85:
+                i = rng.integers(lo, hi)
+            else:
+                i = rng.integers(0, 24)
+            events.append(Event("view", "user", str(u), "item", f"i{i}",
+                                event_time=_ts(len(events))))
+    le.insert_batch(events, app_id)
+
+    engine = UniversalRecommenderEngine()()
+    ctx = WorkflowContext(app_name="urapp", storage=memory_storage)
+    ep = EngineParams.from_json({
+        "datasource": {"params": {"appName": "urapp",
+                                   "eventNames": ["buy", "view"]}},
+        "algorithms": [{"name": "ur",
+                        "params": {"appName": "urapp",
+                                   "maxCorrelatorsPerItem": 8,
+                                   "user_chunk": 64}}],
+    })
+    iid = run_train(engine, ep, ctx, engine_factory_name="ur")
+    dep, _, _ = load_deployment(
+        engine, iid, WorkflowContext(storage=memory_storage),
+        engine_factory_name="ur",
+    )
+    r = dep.query({"user": "0", "num": 4})  # group 0 user
+    assert r["itemScores"], "no recommendations"
+    items = [s["item"] for s in r["itemScores"]]
+    in_group = sum(1 for it in items if int(it[1:]) < 12)
+    assert in_group >= 3, f"CCO recommendations leak across groups: {items}"
+    # already-bought items excluded
+    bought = {
+        e.target_entity_id
+        for e in le.find(app_id, entity_type="user", entity_id="0",
+                         event_names=["buy"])
+    }
+    assert not (set(items) & bought)
+
+    # unknown user → empty (cold start)
+    assert dep.query({"user": "zzz", "num": 3}) == {"itemScores": []}
+
+    # blacklist honoured
+    r2 = dep.query({"user": "0", "num": 4, "blacklistItems": [items[0]]})
+    assert items[0] not in [s["item"] for s in r2["itemScores"]]
