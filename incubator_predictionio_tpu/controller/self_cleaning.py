@@ -1,0 +1,80 @@
+"""SelfCleaningDataSource — event-TTL compaction mixin.
+
+Reference: core/.../core/SelfCleaningDataSource.scala: optionally ages out
+events older than a TTL and compacts $set/$unset/$delete property streams
+into single $set snapshots, writing the cleaned stream back to the event
+store before training.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+from typing import Optional
+
+from ..data.storage.base import aggregate_property_events
+from ..data.storage.datamap import DataMap
+from ..data.storage.event import Event
+
+log = logging.getLogger("pio.selfclean")
+
+
+class SelfCleaningDataSource:
+    """Mixin for DataSources. Configure via attributes (reference trait
+    members): ``event_window_duration`` (timedelta or None = keep all),
+    ``event_window_remove`` (actually delete old events), and call
+    ``clean_persisted_data(ctx, app_name)`` at the top of read_training.
+    """
+
+    event_window_duration: Optional[_dt.timedelta] = None
+    event_window_remove: bool = False
+
+    def clean_persisted_data(self, ctx, app_name: str) -> int:
+        """Compact property events + drop aged-out events. Returns the
+        number of events removed."""
+        storage = ctx.get_storage()
+        app = storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            raise ValueError(f"App {app_name!r} does not exist")
+        le = storage.get_l_events()
+        removed = 0
+
+        cutoff = None
+        if self.event_window_duration is not None:
+            cutoff = _dt.datetime.now(_dt.timezone.utc) - self.event_window_duration
+
+        # 1) age out old non-property events
+        if cutoff is not None and self.event_window_remove:
+            old = list(le.find(app.id, until_time=cutoff))
+            for e in old:
+                if e.event not in ("$set", "$unset", "$delete"):
+                    le.delete(e.event_id, app.id)
+                    removed += 1
+
+        # 2) compact property-event streams per entity type into one $set
+        prop_events = list(
+            le.find(app.id, event_names=["$set", "$unset", "$delete"])
+        )
+        by_type: dict[str, list[Event]] = {}
+        for e in prop_events:
+            by_type.setdefault(e.entity_type, []).append(e)
+        for entity_type, events in by_type.items():
+            if len(events) <= len({e.entity_id for e in events}):
+                continue  # nothing to compact
+            snapshot = aggregate_property_events(events)
+            for e in events:
+                le.delete(e.event_id, app.id)
+                removed += 1
+            for entity_id, pm in snapshot.items():
+                le.insert(
+                    Event(
+                        "$set", entity_type, entity_id,
+                        properties=DataMap(pm.to_dict()),
+                        event_time=pm.last_updated,
+                    ),
+                    app.id,
+                )
+                removed -= 1
+        if removed:
+            log.info("self-cleaning removed %d events", removed)
+        return removed

@@ -30,9 +30,19 @@ class MailChimpConnector(FormConnector):
             path = k[5:-1].split("][")
             node = data
             for part in path[:-1]:
-                node = node.setdefault(part, {})
-            if isinstance(node, dict):
-                node[path[-1]] = v
+                nxt = node.get(part)
+                if nxt is None:
+                    nxt = node[part] = {}
+                elif not isinstance(nxt, dict):
+                    raise EventValidationError(
+                        f"conflicting mailchimp form keys around data[{part}]"
+                    )
+                node = nxt
+            if isinstance(node.get(path[-1]), dict):
+                raise EventValidationError(
+                    f"conflicting mailchimp form keys around {k}"
+                )
+            node[path[-1]] = v
         entity_id = data.get("id") or data.get("email")
         if not entity_id:
             raise EventValidationError("mailchimp payload has no data[id]/data[email]")

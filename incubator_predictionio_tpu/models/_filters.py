@@ -1,0 +1,80 @@
+"""Shared serving-time business-rule filters for the recommender
+templates (similar-product, e-commerce, universal recommender).
+
+One implementation of the category / whiteList / blackList exclude-mask
+(reference: each template's predict applies the same rules). Category
+membership is precomputed into per-category boolean masks at model
+build/restore time so the per-query cost is a few numpy vector ops, not a
+Python loop over the catalog.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..data.storage.bimap import BiMap
+
+
+class CategoryIndex:
+    """category name → bool mask [n_items] (lazily built, cached)."""
+
+    def __init__(self, items: BiMap, item_categories: Mapping[str, set]):
+        self._items = items
+        self._cats = item_categories
+        self._masks: dict[str, np.ndarray] = {}
+
+    def mask(self, category: str) -> np.ndarray:
+        m = self._masks.get(category)
+        if m is None:
+            n = len(self._items)
+            m = np.zeros(n, dtype=bool)
+            for item_id, cats in self._cats.items():
+                if category in cats:
+                    j = self._items.get(item_id)
+                    if j is not None:
+                        m[j] = True
+            self._masks[category] = m
+        return m
+
+    def any_of(self, categories: Sequence[str]) -> np.ndarray:
+        out = np.zeros(len(self._items), dtype=bool)
+        for c in categories:
+            out |= self.mask(c)
+        return out
+
+
+def build_exclude_mask(
+    items: BiMap,
+    category_index: Optional[CategoryIndex] = None,
+    categories: Optional[Sequence[str]] = None,
+    white_list: Optional[Sequence[str]] = None,
+    black_list: Optional[Sequence[str]] = None,
+    extra_excluded_items: Optional[Sequence[str]] = None,
+) -> np.ndarray:
+    """True = suppressed. Combines the reference templates' rules:
+    category membership (must match one), whitelist (only these),
+    blacklist, plus arbitrary extra item ids (seen/unavailable/query
+    items)."""
+    n = len(items)
+    exclude = np.zeros(n, dtype=bool)
+    if categories and category_index is not None:
+        exclude |= ~category_index.any_of(categories)
+    if white_list:
+        allowed = {items.get(w) for w in white_list} - {None}
+        mask = np.ones(n, dtype=bool)
+        if allowed:
+            mask[list(allowed)] = False
+        exclude |= mask
+    if black_list:
+        for b in black_list:
+            j = items.get(b)
+            if j is not None:
+                exclude[j] = True
+    if extra_excluded_items:
+        for x in extra_excluded_items:
+            j = items.get(x)
+            if j is not None:
+                exclude[j] = True
+    return exclude

@@ -25,6 +25,7 @@ from ..data.store.p_event_store import PEventStore
 from ..data.storage.bimap import BiMap
 from ..ops.als import ALSFactors, ALSParams, train_als
 from ..ops.topk import top_k_items
+from ._filters import CategoryIndex, build_exclude_mask
 from .similar_product import (
     SimilarProductDataSource,
     DataSourceParams as SPDataSourceParams,
@@ -50,6 +51,12 @@ class ECommerceModel:
     seen_event_names: Sequence[str]
     _dev_items: object = dataclasses.field(default=None, repr=False, compare=False)
     _storage: object = dataclasses.field(default=None, repr=False, compare=False)
+    _cat_index: object = dataclasses.field(default=None, repr=False, compare=False)
+
+    def category_index(self) -> CategoryIndex:
+        if self._cat_index is None:
+            self._cat_index = CategoryIndex(self.items, self.item_categories)
+        return self._cat_index
 
     def device_item_factors(self):
         if self._dev_items is None:
@@ -102,32 +109,13 @@ class ECommerceModel:
         uidx = self.users.get(user)
         if uidx is None:
             return []
-        n_items = len(self.items)
-        exclude = np.zeros(n_items, dtype=bool)
+        extra = list(self._unavailable_items())
         if unseen_only:
-            for item in self._seen_items(user):
-                j = self.items.get(item)
-                if j is not None:
-                    exclude[j] = True
-        for item in self._unavailable_items():
-            j = self.items.get(item)
-            if j is not None:
-                exclude[j] = True
-        if categories:
-            cset = set(categories)
-            for j in range(n_items):
-                if not (self.item_categories.get(self.items.inverse(j), set()) & cset):
-                    exclude[j] = True
-        if white_list:
-            allowed = {self.items.get(w) for w in white_list} - {None}
-            mask = np.ones(n_items, dtype=bool)
-            mask[list(allowed)] = False
-            exclude |= mask
-        if black_list:
-            for b in black_list:
-                j = self.items.get(b)
-                if j is not None:
-                    exclude[j] = True
+            extra += list(self._seen_items(user))
+        exclude = build_exclude_mask(
+            self.items, self.category_index(), categories,
+            white_list, black_list, extra_excluded_items=extra,
+        )
         scores, idx = top_k_items(
             self.factors.user_factors[uidx], self.device_item_factors(),
             num, exclude=exclude,

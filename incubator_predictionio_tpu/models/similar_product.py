@@ -26,6 +26,7 @@ from ..data.storage.bimap import BiMap
 from ..data.store.p_event_store import PEventStore, ratings_matrix
 from ..ops.als import ALSFactors, ALSParams, train_als
 from ..ops.topk import similar_items
+from ._filters import CategoryIndex, build_exclude_mask
 
 
 @dataclasses.dataclass
@@ -81,6 +82,12 @@ class SimilarProductModel:
     items: BiMap
     item_categories: dict[str, set[str]]
     _dev_items: object = dataclasses.field(default=None, repr=False, compare=False)
+    _cat_index: object = dataclasses.field(default=None, repr=False, compare=False)
+
+    def category_index(self) -> CategoryIndex:
+        if self._cat_index is None:
+            self._cat_index = CategoryIndex(self.items, self.item_categories)
+        return self._cat_index
 
     def device_item_factors(self):
         if self._dev_items is None:
@@ -106,25 +113,11 @@ class SimilarProductModel:
         idxs = [j for j in idxs if j is not None]
         if not idxs:
             return []
-        n_items = len(self.items)
-        exclude = np.zeros(n_items, dtype=bool)
+        exclude = build_exclude_mask(
+            self.items, self.category_index(), categories,
+            white_list, black_list,
+        )
         exclude[idxs] = True  # never return the query items themselves
-        if categories:
-            cset = set(categories)
-            for j in range(n_items):
-                item_id = self.items.inverse(j)
-                if not (self.item_categories.get(item_id, set()) & cset):
-                    exclude[j] = True
-        if white_list:
-            allowed = {self.items.get(w) for w in white_list} - {None}
-            mask = np.ones(n_items, dtype=bool)
-            mask[list(allowed)] = False
-            exclude |= mask
-        if black_list:
-            for b in black_list:
-                j = self.items.get(b)
-                if j is not None:
-                    exclude[j] = True
         qvecs = self.factors.item_factors[idxs]
         scores, idx = similar_items(
             qvecs, self.device_item_factors(), num, exclude=exclude

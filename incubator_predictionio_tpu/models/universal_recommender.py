@@ -31,6 +31,7 @@ from ..data.storage.bimap import BiMap
 from ..data.store.l_event_store import LEventStore
 from ..data.store.p_event_store import PEventStore
 from ..ops.llr import Indicators, cco_indicators, score_user
+from ._filters import CategoryIndex, build_exclude_mask
 
 
 @dataclasses.dataclass
@@ -106,6 +107,12 @@ class URModel:
     app_name: str
     event_names: Sequence[str]
     _storage: object = dataclasses.field(default=None, repr=False, compare=False)
+    _cat_index: object = dataclasses.field(default=None, repr=False, compare=False)
+
+    def category_index(self) -> CategoryIndex:
+        if self._cat_index is None:
+            self._cat_index = CategoryIndex(self.items, self.item_categories)
+        return self._cat_index
 
     def warm_up(self, num: int = 10):
         if len(self.users):
@@ -114,23 +121,25 @@ class URModel:
     def _history(self, user: str) -> dict[str, np.ndarray]:
         """Realtime user history per event type (reference: UR queries the
         event store at serve time so new events influence results
-        immediately)."""
+        immediately). One combined store query, bucketed by event name."""
         n_items = len(self.items)
-        out = {}
-        for name in self.event_names:
-            membership = np.zeros(n_items, np.float32)
-            try:
-                events = LEventStore.find_by_entity(
-                    self.app_name, "user", user, event_names=[name],
-                    limit=500, storage=self._storage,
-                )
-            except Exception:
-                events = []
-            for e in events:
-                j = self.items.get(e.target_entity_id) if e.target_entity_id else None
-                if j is not None:
-                    membership[j] = 1.0
-            out[name] = membership
+        out = {name: np.zeros(n_items, np.float32) for name in self.event_names}
+        try:
+            events = LEventStore.find_by_entity(
+                self.app_name, "user", user,
+                event_names=list(self.event_names),
+                limit=500 * max(len(self.event_names), 1),
+                storage=self._storage,
+            )
+        except Exception:
+            events = []
+        for e in events:
+            membership = out.get(e.event)
+            if membership is None or not e.target_entity_id:
+                continue
+            j = self.items.get(e.target_entity_id)
+            if j is not None:
+                membership[j] = 1.0
         return out
 
     def recommend(
@@ -145,25 +154,18 @@ class URModel:
         if not any(m.any() for m in history.values()):
             return []  # unknown/cold user: UR would fall back to popularity
         n_items = len(self.items)
-        exclude = np.zeros(n_items, dtype=bool)
+        exclude = build_exclude_mask(
+            self.items, black_list=blacklist_items
+        )
         if exclude_primary_history:
             primary = self.event_names[0]
             exclude |= history[primary] > 0
-        if blacklist_items:
-            for b in blacklist_items:
-                j = self.items.get(b)
-                if j is not None:
-                    exclude[j] = True
-        # UR "fields" biz rules: bias<0 = hard filter, bias>0 = boost.
+        # UR "fields" biz rules: bias<0 = hard filter, bias>0 = boost —
+        # category masks precomputed (CategoryIndex), no per-item loop.
         boost_vec = np.ones(n_items, np.float32)
         for f in fields or []:
-            values = set(f.get("values", []))
+            match = self.category_index().any_of(f.get("values", []))
             bias = float(f.get("bias", -1))
-            match = np.zeros(n_items, dtype=bool)
-            for j in range(n_items):
-                cats = self.item_categories.get(self.items.inverse(j), set())
-                if cats & values:
-                    match[j] = True
             if bias < 0:
                 exclude |= ~match
             else:

@@ -1,0 +1,96 @@
+"""Admin API — REST app/key management on :7071.
+
+Reference: tools/.../tools/admin/{AdminServer,CommandClient}.scala
+(experimental REST admin: GET /, /cmd/app list/new/delete).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from aiohttp import web
+
+from ..data.storage.base import AccessKey, App
+from ..data.storage.registry import Storage
+
+
+class AdminServer:
+    def __init__(self, storage: Optional[Storage] = None):
+        self.storage = storage or Storage.instance()
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/", self.handle_root),
+                web.get("/cmd/app", self.handle_app_list),
+                web.post("/cmd/app", self.handle_app_new),
+                web.delete("/cmd/app/{name}", self.handle_app_delete),
+                web.delete("/cmd/app/{name}/data", self.handle_app_data_delete),
+            ]
+        )
+
+    async def handle_root(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "alive", "description": "PredictionIO-TPU Admin API"}
+        )
+
+    async def handle_app_list(self, request: web.Request) -> web.Response:
+        apps = self.storage.get_meta_data_apps().get_all()
+        keys = self.storage.get_meta_data_access_keys()
+        return web.json_response(
+            [
+                {
+                    "name": a.name,
+                    "id": a.id,
+                    "accessKeys": [k.key for k in keys.get_by_appid(a.id)],
+                }
+                for a in apps
+            ]
+        )
+
+    async def handle_app_new(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"message": "invalid JSON"}, status=400)
+        name = body.get("name")
+        if not name:
+            return web.json_response({"message": "name is required"}, status=400)
+        apps = self.storage.get_meta_data_apps()
+        app_id = apps.insert(App(int(body.get("id", 0)), name, body.get("description")))
+        if app_id is None:
+            return web.json_response(
+                {"message": f"App {name!r} already exists."}, status=409
+            )
+        self.storage.get_l_events().init(app_id)
+        key = self.storage.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ())
+        )
+        return web.json_response(
+            {"name": name, "id": app_id, "accessKey": key}, status=201
+        )
+
+    async def handle_app_delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        apps = self.storage.get_meta_data_apps()
+        a = apps.get_by_name(name)
+        if a is None:
+            return web.json_response({"message": "not found"}, status=404)
+        for k in self.storage.get_meta_data_access_keys().get_by_appid(a.id):
+            self.storage.get_meta_data_access_keys().delete(k.key)
+        self.storage.get_l_events().remove(a.id)
+        apps.delete(a.id)
+        return web.json_response({"message": f"App {name!r} deleted."})
+
+    async def handle_app_data_delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        a = self.storage.get_meta_data_apps().get_by_name(name)
+        if a is None:
+            return web.json_response({"message": "not found"}, status=404)
+        self.storage.get_l_events().remove(a.id)
+        self.storage.get_l_events().init(a.id)
+        return web.json_response({"message": f"App {name!r} data deleted."})
+
+
+def run_admin_server(host: str = "127.0.0.1", port: int = 7071,
+                     storage: Optional[Storage] = None) -> None:
+    web.run_app(AdminServer(storage).app, host=host, port=port, print=None)

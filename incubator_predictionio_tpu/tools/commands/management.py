@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from ...data.storage.event import Event
@@ -113,4 +114,92 @@ def import_cmd(args: list[str]) -> int:
                 print(f"[warn] line {line_no}: {e}", file=sys.stderr)
     le.insert_batch(events, app_id, channel_id)
     print(f"[info] Imported {len(events)} events ({skipped} skipped).")
+    return 0
+
+
+@verb("dashboard", "start the evaluation dashboard (:9000)")
+def dashboard_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio dashboard")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9000)
+    ns = p.parse_args(args)
+    from ..dashboard import run_dashboard
+
+    print(f"[info] Dashboard running on {ns.ip}:{ns.port}")
+    run_dashboard(ns.ip, ns.port)
+    return 0
+
+
+@verb("adminserver", "start the admin REST API (:7071)")
+def adminserver_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio adminserver")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7071)
+    ns = p.parse_args(args)
+    from ..admin import run_admin_server
+
+    print(f"[info] Admin server running on {ns.ip}:{ns.port}")
+    run_admin_server(ns.ip, ns.port)
+    return 0
+
+
+@verb("template", "list or copy bundled engine templates")
+def template_cmd(args: list[str]) -> int:
+    """Reference: `pio template get` cloned from GitHub; offline analog
+    copies a bundled template directory."""
+    import shutil
+
+    p = argparse.ArgumentParser(prog="pio template")
+    sub = p.add_subparsers(dest="sub", required=True)
+    sub.add_parser("list")
+    p_get = sub.add_parser("get")
+    p_get.add_argument("name")
+    p_get.add_argument("dest")
+    ns = p.parse_args(args)
+    import incubator_predictionio_tpu
+
+    base = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(incubator_predictionio_tpu.__file__))),
+        "templates",
+    )
+    if not os.path.isdir(base):
+        print("[error] bundled templates directory not found (run from a "
+              "source checkout, or pass a template path directly to "
+              "--engine-dir)", file=sys.stderr)
+        return 1
+    if ns.sub == "list":
+        for name in sorted(os.listdir(base)):
+            print(name)
+        return 0
+    src = os.path.join(base, ns.name)
+    if not os.path.isdir(src):
+        print(f"[error] unknown template {ns.name!r}; `pio template list`",
+              file=sys.stderr)
+        return 1
+    shutil.copytree(src, ns.dest)
+    print(f"[info] Template {ns.name!r} copied to {ns.dest}")
+    return 0
+
+
+@verb("run", "run an arbitrary main function with the pio environment")
+def run_cmd(args: list[str]) -> int:
+    """Reference: `pio run <main class>` — here: dotted path of a callable."""
+    p = argparse.ArgumentParser(prog="pio run")
+    p.add_argument("main", help="dotted path module.function")
+    p.add_argument("--engine-dir", default=".")
+    ns, rest = p.parse_known_args(args)
+    ns.rest = rest
+    from ...workflow.json_extractor import resolve_engine_factory
+
+    fn = resolve_engine_factory(ns.main, ns.engine_dir)
+    result = fn(*ns.rest) if ns.rest else fn()
+    if result is not None:
+        print(result)
+    return 0
+
+
+@verb("upgrade", "upgrade helper (storage schema is auto-migrating)")
+def upgrade_cmd(args: list[str]) -> int:
+    print("[info] Nothing to do: storage schemas are created on demand and "
+          "engine templates need no rebuild in this distribution.")
     return 0

@@ -1,0 +1,86 @@
+"""Dashboard — evaluation-instance leaderboard on :9000.
+
+Reference: tools/.../tools/dashboard/Dashboard.scala (spray + twirl HTML
+listing completed EvaluationInstances with their results; CORS support).
+Here: aiohttp serving a minimal HTML index + JSON API.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from ..data.storage.registry import Storage
+
+
+class Dashboard:
+    def __init__(self, storage: Optional[Storage] = None):
+        self.storage = storage or Storage.instance()
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/", self.handle_index),
+                web.get("/instances.json", self.handle_instances_json),
+                web.get("/instances/{iid}.json", self.handle_instance_json),
+            ]
+        )
+
+    async def handle_index(self, request: web.Request) -> web.Response:
+        rows = []
+        for i in self.storage.get_meta_data_evaluation_instances().get_completed():
+            rows.append(
+                "<tr><td>{id}</td><td>{cls}</td><td>{start}</td><td>{end}</td>"
+                "<td><pre>{res}</pre></td></tr>".format(
+                    id=html.escape(i.id[:13]),
+                    cls=html.escape(i.evaluation_class),
+                    start=html.escape(str(i.start_time)),
+                    end=html.escape(str(i.end_time)),
+                    res=html.escape(i.evaluator_results),
+                )
+            )
+        body = (
+            "<html><head><title>PredictionIO-TPU Dashboard</title></head><body>"
+            "<h1>Completed evaluations</h1>"
+            "<table border=1 cellpadding=4><tr><th>ID</th><th>Evaluation</th>"
+            "<th>Started</th><th>Finished</th><th>Results</th></tr>"
+            + "".join(rows)
+            + "</table></body></html>"
+        )
+        return web.Response(text=body, content_type="text/html")
+
+    async def handle_instances_json(self, request: web.Request) -> web.Response:
+        out = [
+            {
+                "id": i.id,
+                "evaluationClass": i.evaluation_class,
+                "engineParamsGeneratorClass": i.engine_params_generator_class,
+                "startTime": i.start_time.isoformat(),
+                "endTime": i.end_time.isoformat() if i.end_time else None,
+                "batch": i.batch,
+            }
+            for i in self.storage.get_meta_data_evaluation_instances().get_completed()
+        ]
+        return web.json_response(out, headers={"Access-Control-Allow-Origin": "*"})
+
+    async def handle_instance_json(self, request: web.Request) -> web.Response:
+        i = self.storage.get_meta_data_evaluation_instances().get(
+            request.match_info["iid"]
+        )
+        if i is None:
+            return web.json_response({"message": "not found"}, status=404)
+        try:
+            results = json.loads(i.evaluator_results_json or "{}")
+        except json.JSONDecodeError:
+            results = {}
+        return web.json_response(
+            {"id": i.id, "results": results, "pretty": i.evaluator_results},
+            headers={"Access-Control-Allow-Origin": "*"},
+        )
+
+
+def run_dashboard(host: str = "127.0.0.1", port: int = 9000,
+                  storage: Optional[Storage] = None) -> None:
+    web.run_app(Dashboard(storage).app, host=host, port=port, print=None)

@@ -1,0 +1,67 @@
+"""FakeWorkflow — minimal in-process engine for workflow tests.
+
+Reference: core/.../workflow/FakeWorkflow.scala (FakeEngine/FakeRun used by
+unit tests to exercise workflow plumbing without a real engine). Paired
+with the MEMORY storage backend this gives fully hermetic workflow tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from ..controller import Algorithm, DataSource, Engine, FirstServing, IdentityPreparator
+
+
+@dataclasses.dataclass
+class FakeTrainingData:
+    values: list
+
+
+class FakeDataSource(DataSource):
+    """Yields the values it was constructed with; records calls."""
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self.read_count = 0
+        self.values = (params or {}).get("values", [1, 2, 3]) if isinstance(params, dict) else [1, 2, 3]
+
+    def read_training(self, ctx) -> FakeTrainingData:
+        self.read_count += 1
+        return FakeTrainingData(list(self.values))
+
+    def read_eval(self, ctx):
+        td = self.read_training(ctx)
+        qa = [({"q": v}, {"a": v}) for v in td.values]
+        return [(td, None, qa)]
+
+
+class FakeAlgorithm(Algorithm):
+    """model = sum of values; predict echoes query + model."""
+
+    def train(self, ctx, pd: FakeTrainingData):
+        return {"total": sum(pd.values)}
+
+    def predict(self, model, query):
+        return {"echo": query.get("q"), "total": model["total"]}
+
+
+def fake_engine() -> Engine:
+    return Engine(
+        data_source_class=FakeDataSource,
+        preparator_class=IdentityPreparator,
+        algorithm_class_map={"": FakeAlgorithm},
+        serving_class=FirstServing,
+    )
+
+
+def fake_run(ctx=None, run_fn: Callable[[Engine], Any] | None = None):
+    """Run a quick train through the real CoreWorkflow (reference:
+    FakeRun)."""
+    from ..controller.engine import EngineParams
+    from .context import WorkflowContext
+    from .core_workflow import run_train
+
+    engine = fake_engine()
+    ctx = ctx or WorkflowContext()
+    return run_train(engine, EngineParams(), ctx, engine_factory_name="fake")

@@ -1,0 +1,118 @@
+"""Evaluation workflow end-to-end, dashboard + admin HTTP, FakeWorkflow,
+SelfCleaningDataSource (SURVEY.md §2.5-2.6, §3.4)."""
+
+import datetime as dt
+
+import numpy as np
+import requests
+
+from incubator_predictionio_tpu.data.storage import DataMap, Event
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.evaluation_workflow import run_evaluation
+
+from server_utils import ServerThread
+from test_dase_train_e2e import _seed_ratings
+
+
+def test_evaluation_workflow_end_to_end(memory_storage):
+    from incubator_predictionio_tpu.models.recommendation_eval import (
+        ParamsList,
+        RecommendationEvaluation,
+    )
+
+    _seed_ratings(memory_storage, n_users=25, n_items=15)
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    evaluation = RecommendationEvaluation()
+    generator = ParamsList(app_name="testapp")
+    result, iid = run_evaluation(
+        evaluation, generator, ctx,
+        evaluation_name="RecommendationEvaluation",
+        generator_name="ParamsList",
+    )
+    assert len(result.all_results) == 4  # 2 ranks × 2 lambdas
+    assert 0.0 <= result.best_score <= 1.0
+    assert result.metric_header == "HitRate@10"
+    # leaderboard text mentions best params
+    assert "bestScore" in result.to_json()
+    inst = memory_storage.get_meta_data_evaluation_instances().get(iid)
+    assert inst.status == "EVALCOMPLETED"
+    assert "HitRate@10" in inst.evaluator_results
+
+    # dashboard serves it
+    from incubator_predictionio_tpu.tools.dashboard import Dashboard
+
+    with ServerThread(Dashboard(memory_storage).app) as st:
+        html = requests.get(st.base + "/").text
+        assert "RecommendationEvaluation" in html
+        listing = requests.get(st.base + "/instances.json").json()
+        assert listing[0]["id"] == iid
+        detail = requests.get(f"{st.base}/instances/{iid}.json").json()
+        assert detail["results"]["metricHeader"] == "HitRate@10"
+        assert requests.get(st.base + "/instances/nope.json").status_code == 404
+
+
+def test_admin_server(memory_storage):
+    from incubator_predictionio_tpu.tools.admin import AdminServer
+
+    with ServerThread(AdminServer(memory_storage).app) as st:
+        assert requests.get(st.base + "/").json()["status"] == "alive"
+        r = requests.post(st.base + "/cmd/app", json={"name": "adminapp"})
+        assert r.status_code == 201
+        key = r.json()["accessKey"]
+        assert key
+        # duplicate
+        assert requests.post(st.base + "/cmd/app", json={"name": "adminapp"}).status_code == 409
+        assert requests.post(st.base + "/cmd/app", json={}).status_code == 400
+        listing = requests.get(st.base + "/cmd/app").json()
+        assert listing[0]["name"] == "adminapp" and key in listing[0]["accessKeys"]
+        assert requests.delete(st.base + "/cmd/app/adminapp/data").json()["message"]
+        assert requests.delete(st.base + "/cmd/app/adminapp").status_code == 200
+        assert requests.delete(st.base + "/cmd/app/adminapp").status_code == 404
+        assert requests.get(st.base + "/cmd/app").json() == []
+
+
+def test_fake_workflow(memory_storage):
+    from incubator_predictionio_tpu.workflow.fake_workflow import fake_run
+
+    ctx = WorkflowContext(storage=memory_storage)
+    iid = fake_run(ctx)
+    inst = memory_storage.get_meta_data_engine_instances().get(iid)
+    assert inst.status == "COMPLETED"
+    assert memory_storage.get_model_data_models().get(iid) is not None
+
+
+def test_self_cleaning_data_source(memory_storage):
+    from incubator_predictionio_tpu.controller.self_cleaning import (
+        SelfCleaningDataSource,
+    )
+    from incubator_predictionio_tpu.data.storage import App
+
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "cleanapp"))
+    le = memory_storage.get_l_events()
+    le.init(app_id)
+    now = dt.datetime.now(dt.timezone.utc)
+    # 3 property events for one entity (compactable to 1) + 1 old view +
+    # 1 recent view
+    le.insert(Event("$set", "item", "i1", properties=DataMap({"a": 1}),
+                    event_time=now - dt.timedelta(days=30)), app_id)
+    le.insert(Event("$set", "item", "i1", properties=DataMap({"b": 2}),
+                    event_time=now - dt.timedelta(days=20)), app_id)
+    le.insert(Event("$unset", "item", "i1", properties=DataMap({"a": 0}),
+                    event_time=now - dt.timedelta(days=10)), app_id)
+    le.insert(Event("view", "user", "u1", "item", "i1",
+                    event_time=now - dt.timedelta(days=40)), app_id)
+    le.insert(Event("view", "user", "u1", "item", "i1",
+                    event_time=now - dt.timedelta(hours=1)), app_id)
+
+    class DS(SelfCleaningDataSource):
+        event_window_duration = dt.timedelta(days=7)
+        event_window_remove = True
+
+    removed = DS().clean_persisted_data(
+        WorkflowContext(storage=memory_storage), "cleanapp"
+    )
+    assert removed == 3  # 1 aged-out view + (3 property events → 1 $set)
+    remaining = list(le.find(app_id))
+    assert len(remaining) == 2
+    props = le.aggregate_properties(app_id, "item")
+    assert props["i1"] == {"b": 2}  # compaction preserved semantics
