@@ -134,6 +134,7 @@ def main() -> int:
 
     fn = _make_train_fn(mesh, params, by_user, by_item)
     args = (
+        np.int32(iters),
         x0, y0,
         by_user.col, by_user.val, by_user.mask, by_user.local_row, by_user.counts,
         by_item.col, by_item.val, by_item.mask, by_item.local_row, by_item.counts,

@@ -158,9 +158,28 @@ class Engine:
             log.info("--stop-after-prepare: halting before train")
             return []
         models = []
-        for name, algo in algo_list:
+        root_hook = getattr(ctx, "checkpoint_hook", None)
+        if root_hook is not None:
+            import os
+
+            from ..workflow.checkpoint import CheckpointHook
+        for idx, (name, algo) in enumerate(algo_list):
             log.info("training algorithm %s (%s)", name or "<default>", type(algo).__name__)
-            model = algo.train(ctx, pd)
+            if root_hook is not None:
+                # Per-algorithm subdirectory: without it, multiple
+                # algorithms in one engine would collide on orbax step
+                # numbers and restore each other's snapshots.
+                ctx.checkpoint_hook = CheckpointHook(
+                    os.path.join(root_hook.directory, f"algo_{idx}_{name or 'default'}"),
+                    every_n=root_hook.every_n,
+                    max_to_keep=root_hook.max_to_keep,
+                )
+            try:
+                model = algo.train(ctx, pd)
+            finally:
+                if root_hook is not None:
+                    ctx.checkpoint_hook.close()
+                    ctx.checkpoint_hook = root_hook
             self._maybe_sanity_check(model, f"model[{name}]", not wp.skip_sanity_check)
             models.append(model)
         return models

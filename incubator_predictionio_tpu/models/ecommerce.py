@@ -156,6 +156,8 @@ class ECommerceAlgorithm(Algorithm):
                 seed=p.seed if p.seed is not None else 3,
             ),
             mesh=ctx.get_mesh() if ctx else None,
+            checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
+            resume=bool(ctx and ctx.workflow_params.resume),
         )
         model = ECommerceModel(
             factors=factors, users=pd.users, items=pd.items,

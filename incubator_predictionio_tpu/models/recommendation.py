@@ -190,6 +190,8 @@ class ALSAlgorithm(Algorithm):
             pd.user_idx, pd.item_idx, pd.rating,
             n_users=len(pd.users), n_items=len(pd.items),
             params=als_params, mesh=ctx.get_mesh() if ctx else None,
+            checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
+            resume=bool(ctx and ctx.workflow_params.resume),
         )
         return ALSModel(factors=factors, users=pd.users, items=pd.items)
 

@@ -153,6 +153,8 @@ class SimilarProductAlgorithm(Algorithm):
                 seed=p.seed if p.seed is not None else 3,
             ),
             mesh=ctx.get_mesh() if ctx else None,
+            checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
+            resume=bool(ctx and ctx.workflow_params.resume),
         )
         return SimilarProductModel(factors, pd.items, pd.item_categories)
 

@@ -191,8 +191,11 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
 
     u_rps, i_rps = users.rows_per_shard, items.rows_per_shard
 
-    # The big tile arrays enter as jit args (not baked-in constants).
-    def loop(x0, y0, u_col, u_val, u_mask, u_lrow, u_counts,
+    # The big tile arrays enter as jit args (not baked-in constants), and
+    # n_iters is traced so one compilation serves full runs, checkpoint
+    # chunks, and resume remainders alike (fori_loop with a traced bound
+    # lowers to while_loop — fine on TPU, no unrolling wanted here).
+    def loop(n_iters, x0, y0, u_col, u_val, u_mask, u_lrow, u_counts,
              i_col, i_val, i_mask, i_lrow, i_counts):
         def body(_, carry):
             x, y = carry
@@ -200,7 +203,7 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
             y = one_side(x, i_col, i_val, i_mask, i_lrow, i_counts, i_rps)
             return (x, y)
 
-        return jax.lax.fori_loop(0, params.num_iterations, body, (x0, y0))
+        return jax.lax.fori_loop(0, n_iters, body, (x0, y0))
 
     shardings = {
         "row2": NamedSharding(mesh, P(DATA_AXIS, None)),
@@ -208,6 +211,7 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
         "rep": NamedSharding(mesh, P()),
     }
     in_shardings = (
+        shardings["rep"],
         shardings["rep"], shardings["rep"],
         shardings["row2"], shardings["row2"], shardings["row2"],
         shardings["row1"], shardings["row1"],
@@ -229,8 +233,19 @@ def train_als(
     n_items: int,
     params: ALSParams,
     mesh: Optional[Mesh] = None,
+    checkpoint_hook=None,
+    resume: bool = False,
 ) -> ALSFactors:
-    """Train explicit/implicit ALS from a COO rating triple."""
+    """Train explicit/implicit ALS from a COO rating triple.
+
+    ``checkpoint_hook`` (workflow.checkpoint.CheckpointHook): when enabled,
+    the loop runs in hook.every_n-iteration chunks through the SAME jitted
+    executable (n_iters is traced — zero recompiles) and snapshots the
+    factor pytree at each chunk boundary; ``resume=True`` restores the
+    latest snapshot and runs only the remaining iterations. Chunking is
+    bitwise-identical math to the single fori_loop. The reference cannot do
+    this at all — a failed Spark ALS job restarts from zero (SURVEY.md §5.4).
+    """
     mesh = mesh or default_mesh()
     n_dev = int(np.prod(list(mesh.shape.values())))
 
@@ -247,12 +262,72 @@ def train_als(
     x0 = (rng.standard_normal((by_user.padded_rows, k)) / np.sqrt(k)).astype(np.float32)
     y0 = (rng.standard_normal((by_item.padded_rows, k)) / np.sqrt(k)).astype(np.float32)
 
+    # Fingerprint of the exact COO triple: resume is only sound against the
+    # identical rating data (shape equality alone misses in-place rating
+    # updates that keep n_users/n_items fixed). Only computed when a hook
+    # is active — it's O(nnz) hashing that plain trains shouldn't pay.
+    fingerprint = None
+    if checkpoint_hook is not None:
+        import zlib
+
+        fingerprint = zlib.crc32(
+            rating.astype(np.float32, copy=False).tobytes(),
+            zlib.crc32(np.asarray(item_idx).tobytes(),
+                       zlib.crc32(np.asarray(user_idx).tobytes())))
+
+    start_iter = 0
+    if checkpoint_hook is not None and resume:
+        from ..workflow.checkpoint import CheckpointIncompatibleError
+
+        step = checkpoint_hook.latest_step()
+        if step is not None and step < params.num_iterations:
+            start_iter, tree = checkpoint_hook.restore(step)
+            rx, ry = np.asarray(tree["user_factors"]), np.asarray(tree["item_factors"])
+            if rx.shape != x0.shape or ry.shape != y0.shape:
+                raise CheckpointIncompatibleError(
+                    f"checkpoint shapes {rx.shape}/{ry.shape} do not match the "
+                    f"current data layout {x0.shape}/{y0.shape}; the event data "
+                    "changed since the interrupted run — retrain from scratch"
+                )
+            saved_fp = int(np.asarray(tree.get("fingerprint", -1)))
+            if saved_fp != fingerprint:
+                raise CheckpointIncompatibleError(
+                    "checkpoint was written against different rating data "
+                    "(fingerprint mismatch); the event store changed since "
+                    "the interrupted run — retrain from scratch"
+                )
+            x0, y0 = rx, ry
+        elif step is not None:
+            # Snapshots are never written at the final iteration, so a
+            # checkpoint at step >= num_iterations means the params changed
+            # (num_iterations lowered) since the interrupted run.
+            raise CheckpointIncompatibleError(
+                f"latest checkpoint is at iteration {step} but only "
+                f"{params.num_iterations} iterations were requested; the "
+                "snapshot is from a run with more iterations — retrain from "
+                "scratch or raise num_iterations"
+            )
+
     fn = _make_train_fn(mesh, params, by_user, by_item)
-    x, y = fn(
-        x0, y0,
+    blocks = (
         by_user.col, by_user.val, by_user.mask, by_user.local_row, by_user.counts,
         by_item.col, by_item.val, by_item.mask, by_item.local_row, by_item.counts,
     )
+    chunk = checkpoint_hook.every_n if checkpoint_hook is not None and checkpoint_hook.enabled else 0
+    if chunk and params.num_iterations - start_iter > chunk:
+        x, y = x0, y0
+        it = start_iter
+        while it < params.num_iterations:
+            n = min(chunk, params.num_iterations - it)
+            x, y = fn(n, x, y, *blocks)
+            it += n
+            if it < params.num_iterations:
+                checkpoint_hook.save(
+                    it, {"user_factors": x, "item_factors": y,
+                         "fingerprint": np.int64(fingerprint)}
+                )
+    else:
+        x, y = fn(params.num_iterations - start_iter, x0, y0, *blocks)
     x, y = jax.device_get((x, y))
     return ALSFactors(
         user_factors=np.asarray(x)[:n_users],

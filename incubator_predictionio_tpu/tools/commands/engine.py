@@ -53,6 +53,13 @@ def train_cmd(args: list[str]) -> int:
     p.add_argument("--skip-sanity-check", action="store_true")
     p.add_argument("--stop-after-read", action="store_true")
     p.add_argument("--stop-after-prepare", action="store_true")
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="N",
+                   help="snapshot algorithm state every N iterations (orbax)")
+    p.add_argument("--resume", action="store_true",
+                   help="continue the most recent interrupted run from its "
+                        "last checkpoint")
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax.profiler trace of the train stage here")
     ns = p.parse_args(args)
     from ...workflow.core_workflow import run_train
 
@@ -67,6 +74,9 @@ def train_cmd(args: list[str]) -> int:
         skip_sanity_check=ns.skip_sanity_check,
         stop_after_read=ns.stop_after_read,
         stop_after_prepare=ns.stop_after_prepare,
+        checkpoint_every=ns.checkpoint_every,
+        resume=ns.resume,
+        profile_dir=ns.profile_dir,
     )
     instance_id = run_train(
         engine, params, ctx, wp,

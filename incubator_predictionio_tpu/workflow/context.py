@@ -23,6 +23,10 @@ class WorkflowContext:
     mesh: Any = None  # jax.sharding.Mesh; lazily built to keep import light
     workflow_params: WorkflowParams = dataclasses.field(default_factory=WorkflowParams)
     engine_instance_id: Optional[str] = None
+    # workflow.checkpoint.CheckpointHook when `pio train --checkpoint-every`
+    # / `--resume` is active; algorithms with iterative loops snapshot
+    # through it (see ops/als.py train_als).
+    checkpoint_hook: Any = None
 
     def get_storage(self) -> Storage:
         return self.storage or Storage.instance()

@@ -9,10 +9,14 @@ design stance).
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 import datetime as _dt
 import json
 import logging
+import os
 import pickle
+import socket
 from typing import Any, Optional
 
 from ..controller.engine import Engine, EngineParams
@@ -74,6 +78,7 @@ def run_train(
     """
     ctx = ctx or WorkflowContext()
     wp = workflow_params or WorkflowParams()
+    ctx.workflow_params = wp
     storage = ctx.get_storage()
     instances = storage.get_meta_data_engine_instances()
 
@@ -87,7 +92,10 @@ def run_train(
         engine_variant=engine_variant,
         engine_factory=engine_factory_name,
         batch=wp.batch,
-        env={"appName": ctx.app_name},
+        # pid/host let `--resume` distinguish a SIGKILL'd RUNNING row from a
+        # train that is genuinely still alive on this machine.
+        env={"appName": ctx.app_name, "pid": str(os.getpid()),
+             "host": socket.gethostname()},
         data_source_params=json.dumps(dict(engine_params.data_source_params)),
         preparator_params=json.dumps(dict(engine_params.preparator_params)),
         algorithms_params=json.dumps(
@@ -95,14 +103,102 @@ def run_train(
         ),
         serving_params=json.dumps(dict(engine_params.serving_params)),
     )
-    instance_id = instances.insert(instance)
+    if wp.resume:
+        from .checkpoint import find_resumable_instance
+
+        prior = find_resumable_instance(
+            storage, engine_factory_name or "engine", "1", engine_variant,
+            data_source_params=instance.data_source_params,
+            preparator_params=instance.preparator_params,
+        )
+        if prior is not None and prior.algorithms_params != instance.algorithms_params:
+            # Same data, changed hyperparameters — resuming would blend
+            # them and falsify provenance. The superseded snapshots are
+            # useless under the new params: drop them and retire the row so
+            # a `--resume` months from now can't restore stale factors.
+            log.warning(
+                "--resume: interrupted instance %s has different algorithm "
+                "params than the current engine.json; discarding its "
+                "checkpoints and training from scratch",
+                prior.id,
+            )
+            from .checkpoint import CheckpointHook, instance_checkpoint_dir
+
+            CheckpointHook(instance_checkpoint_dir(prior.id)).delete_all()
+            if prior.status == "RUNNING":
+                instances.update(prior.with_status("ABORTED", _utcnow()))
+            prior = None
+        if prior is not None:
+            # Continue the interrupted run under its own instance id so the
+            # checkpoint directory and metadata row line up.
+            instance = EngineInstance(**{**instance.__dict__, "id": prior.id,
+                                         "start_time": prior.start_time})
+            instances.update(instance)
+            instance_id = prior.id
+            log.info("resuming interrupted EngineInstance %s", instance_id)
+        else:
+            log.info("--resume requested but no resumable instance found; "
+                     "training from scratch")
+            instance_id = instances.insert(instance)
+    else:
+        instance_id = instances.insert(instance)
     ctx.engine_instance_id = instance_id
     log.info("EngineInstance %s RUNNING", instance_id)
 
+    if wp.checkpoint_every > 0 or wp.resume:
+        from .checkpoint import CheckpointHook, instance_checkpoint_dir
+
+        ctx.checkpoint_hook = CheckpointHook(
+            instance_checkpoint_dir(instance_id), every_n=wp.checkpoint_every
+        )
+
+    def _profile_cm():
+        if wp.profile_dir:
+            # Device-level trace of the whole DASE train (XLA ops, HBM,
+            # collectives) — the TPU answer to the Spark web UI the
+            # reference leaned on (SURVEY.md §5.1). View with xprof/
+            # tensorboard pointed at the directory.
+            import jax
+
+            return jax.profiler.trace(wp.profile_dir)
+        return contextlib.nullcontext()
+
+    def _train_models():
+        from .checkpoint import CheckpointHook, CheckpointIncompatibleError
+
+        try:
+            with _profile_cm():
+                return engine.train(ctx, engine_params, wp)
+        except CheckpointIncompatibleError as e:
+            if ctx.checkpoint_hook is None or not wp.resume:
+                raise
+            # Stale snapshots can't continue this run (data/rank changed).
+            # Discard them and train from scratch — otherwise every future
+            # --resume re-selects the same instance and fails the same way.
+            log.warning(
+                "--resume: %s; discarding stale checkpoints and training "
+                "from scratch", e,
+            )
+            root = ctx.checkpoint_hook
+            root.delete_all()
+            ctx.checkpoint_hook = CheckpointHook(
+                root.directory, every_n=root.every_n,
+                max_to_keep=root.max_to_keep,
+            )
+            ctx.workflow_params = dataclasses.replace(wp, resume=False)
+            try:
+                with _profile_cm():
+                    return engine.train(ctx, engine_params, ctx.workflow_params)
+            finally:
+                ctx.workflow_params = wp
+
     try:
-        models = engine.train(ctx, engine_params, wp)
+        models = _train_models()
         if wp.stop_after_read or wp.stop_after_prepare:
             instances.update(instance.with_status("ABORTED", _utcnow()))
+            if ctx.checkpoint_hook is not None:
+                ctx.checkpoint_hook.close()
+                ctx.checkpoint_hook = None
             return instance_id
 
         _, _, algo_list, _ = engine.make_components(engine_params)
@@ -121,6 +217,9 @@ def run_train(
             **{**instance.__dict__, "id": instance_id}
         ).with_status("COMPLETED", _utcnow())
         instances.update(done)
+        if ctx.checkpoint_hook is not None:
+            ctx.checkpoint_hook.delete_all()  # snapshots superseded by the model
+            ctx.checkpoint_hook = None
         log.info("EngineInstance %s COMPLETED", instance_id)
         return instance_id
     except Exception:
@@ -129,6 +228,9 @@ def run_train(
                 "ABORTED", _utcnow()
             )
         )
+        if ctx.checkpoint_hook is not None:
+            ctx.checkpoint_hook.close()  # keep snapshots for --resume
+            ctx.checkpoint_hook = None
         raise
 
 

@@ -13,3 +13,11 @@ class WorkflowParams:
     skip_sanity_check: bool = False
     stop_after_read: bool = False
     stop_after_prepare: bool = False
+    # Mid-training checkpointing (no reference analog — SURVEY.md §5.4):
+    # snapshot algorithm state every N iterations; `--resume` continues the
+    # most recent interrupted instance from its last snapshot.
+    checkpoint_every: int = 0
+    resume: bool = False
+    # Tracing/profiling (reference relied on the external Spark web UI —
+    # SURVEY.md §5.1): write a jax.profiler trace of the train stage here.
+    profile_dir: str = ""

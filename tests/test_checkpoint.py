@@ -1,0 +1,363 @@
+"""Checkpoint/resume tests — hook roundtrip, chunked-loop equivalence,
+crash-resume exactness, and the workflow-level `--resume` discovery path.
+The reference has no analog (failed Spark trains restart from scratch,
+SURVEY.md §5.4), so these pin down the new subsystem's contract."""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.ops.als import ALSParams, train_als
+from incubator_predictionio_tpu.workflow.checkpoint import (
+    CheckpointHook,
+    find_resumable_instance,
+    instance_checkpoint_dir,
+)
+
+
+def _toy_ratings(n_users=40, n_items=25, density=0.4, seed=2):
+    rng = np.random.default_rng(seed)
+    mask = rng.random((n_users, n_items)) < density
+    u, i = np.nonzero(mask)
+    r = rng.uniform(1, 5, len(u)).astype(np.float32)
+    return u.astype(np.int32), i.astype(np.int32), r
+
+
+def test_hook_save_restore_roundtrip(tmp_path):
+    hook = CheckpointHook(str(tmp_path / "ckpt"), every_n=2)
+    tree = {"user_factors": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "item_factors": np.ones((2, 4), np.float32)}
+    assert hook.latest_step() is None
+    assert not hook.maybe_save(1, tree)   # off-cadence step: skipped
+    assert hook.maybe_save(2, tree)
+    hook.save(4, {k: v * 2 for k, v in tree.items()})
+    assert hook.latest_step() == 4
+    step, restored = hook.restore()
+    assert step == 4
+    np.testing.assert_array_equal(
+        restored["user_factors"], tree["user_factors"] * 2
+    )
+    step2, restored2 = hook.restore(2)
+    np.testing.assert_array_equal(restored2["user_factors"], tree["user_factors"])
+    hook.close()
+
+
+def test_hook_max_to_keep(tmp_path):
+    hook = CheckpointHook(str(tmp_path / "ckpt"), every_n=1, max_to_keep=2)
+    for s in (1, 2, 3):
+        hook.save(s, {"x": np.full(3, s, np.float32)})
+    hook.close()
+    hook2 = CheckpointHook(str(tmp_path / "ckpt"))
+    assert hook2.latest_step() == 3
+    with pytest.raises(Exception):
+        hook2.restore(1)  # pruned by max_to_keep
+    hook2.close()
+
+
+def test_als_checkpointed_matches_single_shot(tmp_path):
+    """Chunked checkpointing loop == one fori_loop, bitwise-same math."""
+    u, i, r = _toy_ratings()
+    params = ALSParams(rank=4, num_iterations=6, reg=0.05, block_len=8, seed=11)
+    plain = train_als(u, i, r, 40, 25, params)
+
+    hook = CheckpointHook(str(tmp_path / "ck"), every_n=2, max_to_keep=5)
+    ckpt = train_als(u, i, r, 40, 25, params, checkpoint_hook=hook)
+    np.testing.assert_allclose(plain.user_factors, ckpt.user_factors,
+                               rtol=1e-6, atol=1e-7)
+    # boundaries 2 and 4 snapshotted; 6 (completion) not
+    assert hook.latest_step() == 4
+    hook.close()
+
+
+def test_als_resume_after_crash_matches_uninterrupted(tmp_path):
+    """Kill after 4 of 6 iterations, resume → identical to a full run."""
+    u, i, r = _toy_ratings(seed=5)
+    full = train_als(u, i, r, 40, 25,
+                     ALSParams(rank=4, num_iterations=6, reg=0.05,
+                               block_len=8, seed=11))
+
+    # "crashed" run: only 4 iterations happened, snapshots at 2 (4 would be
+    # the final iteration of this truncated run and is not snapshotted) —
+    # so ask for 5 with every_n=2 and interrupt by training only 4.
+    hook = CheckpointHook(str(tmp_path / "ck"), every_n=2, max_to_keep=5)
+    train_als(u, i, r, 40, 25,
+              ALSParams(rank=4, num_iterations=4, reg=0.05,
+                        block_len=8, seed=11),
+              checkpoint_hook=hook)
+    assert hook.latest_step() == 2
+
+    resumed = train_als(u, i, r, 40, 25,
+                        ALSParams(rank=4, num_iterations=6, reg=0.05,
+                                  block_len=8, seed=11),
+                        checkpoint_hook=hook, resume=True)
+    np.testing.assert_allclose(full.user_factors, resumed.user_factors,
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(full.item_factors, resumed.item_factors,
+                               rtol=1e-6, atol=1e-7)
+    hook.close()
+
+
+def test_als_resume_rejects_changed_data(tmp_path):
+    u, i, r = _toy_ratings(seed=5)
+    hook = CheckpointHook(str(tmp_path / "ck"), every_n=1, max_to_keep=3)
+    train_als(u, i, r, 40, 25,
+              ALSParams(rank=4, num_iterations=3, block_len=8),
+              checkpoint_hook=hook)
+    with pytest.raises(ValueError, match="do not match"):
+        # rank changed since the interrupted run → snapshot is unusable
+        train_als(u, i, r, 40, 25,
+                  ALSParams(rank=6, num_iterations=5, block_len=8),
+                  checkpoint_hook=hook, resume=True)
+    # same shapes, different rating VALUES → fingerprint catches it
+    r2 = r.copy()
+    r2[0] += 1.0
+    with pytest.raises(ValueError, match="fingerprint"):
+        train_als(u, i, r2, 40, 25,
+                  ALSParams(rank=4, num_iterations=5, block_len=8),
+                  checkpoint_hook=hook, resume=True)
+    hook.close()
+
+
+def _seed_events(storage, app_name="ckptapp", n_users=30, n_items=20):
+    from incubator_predictionio_tpu.data.storage.base import App
+    from incubator_predictionio_tpu.data.storage.event import DataMap, Event
+
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(id=0, name=app_name))
+    events = storage.get_l_events()
+    rng = np.random.default_rng(0)
+    for _ in range(400):
+        u = int(rng.integers(0, n_users))
+        i = int(rng.integers(0, n_items))
+        events.insert(Event(
+            event="rate", entity_type="user", entity_id=str(u),
+            target_entity_type="item", target_entity_id=str(i),
+            properties=DataMap({"rating": float(rng.uniform(1, 5))}),
+        ), app_id)
+    return app_id
+
+
+def test_workflow_checkpoint_and_resume(memory_storage, tmp_path, monkeypatch):
+    """End-to-end: train --checkpoint-every aborts mid-run (injected fault),
+    train --resume picks up the same instance and completes."""
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine,
+    )
+    from incubator_predictionio_tpu.controller.engine import EngineParams
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import run_train
+    from incubator_predictionio_tpu.workflow.workflow_params import WorkflowParams
+    from incubator_predictionio_tpu.workflow import checkpoint as ckpt_mod
+
+    _seed_events(memory_storage)
+    engine = RecommendationEngine().apply()
+    ep = EngineParams(
+        data_source_params={"app_name": "ckptapp"},
+        algorithm_params_list=[("als", {
+            "rank": 4, "numIterations": 6, "lambda": 0.05, "seed": 11,
+            "block_len": 8,
+        })],
+    )
+
+    # Fault injection: crash the run right after the step-4 snapshot.
+    real_save = ckpt_mod.CheckpointHook.save
+
+    def crashing_save(self, step, tree):
+        real_save(self, step, tree)
+        if step == 4:
+            raise RuntimeError("injected mid-train crash")
+
+    monkeypatch.setattr(ckpt_mod.CheckpointHook, "save", crashing_save)
+    ctx = WorkflowContext(app_name="ckptapp", storage=memory_storage)
+    with pytest.raises(RuntimeError, match="injected"):
+        run_train(engine, ep, ctx, WorkflowParams(checkpoint_every=2),
+                  engine_factory_name="RecEngine")
+    monkeypatch.setattr(ckpt_mod.CheckpointHook, "save", real_save)
+
+    instances = memory_storage.get_meta_data_engine_instances()
+    aborted = [x for x in instances.get_all() if x.status == "ABORTED"]
+    assert len(aborted) == 1
+    found = find_resumable_instance(memory_storage, "RecEngine")
+    assert found is not None and found.id == aborted[0].id
+
+    # Resume: same instance id goes RUNNING → COMPLETED, checkpoints cleaned.
+    ctx2 = WorkflowContext(app_name="ckptapp", storage=memory_storage)
+    iid = run_train(engine, ep, ctx2, WorkflowParams(resume=True),
+                    engine_factory_name="RecEngine")
+    assert iid == aborted[0].id
+    assert instances.get(iid).status == "COMPLETED"
+    import os
+    assert not os.path.isdir(instance_checkpoint_dir(iid))
+
+    # The resumed model must equal an uninterrupted train on the same data.
+    from incubator_predictionio_tpu.workflow.core_workflow import load_deployment
+    dep, _, _ = load_deployment(engine, iid, ctx2, engine_factory_name="RecEngine")
+    res = dep.query({"user": "1", "num": 3})
+    assert len(res["itemScores"]) == 3
+
+
+def test_workflow_resume_with_changed_params_trains_fresh(
+    memory_storage, tmp_path, monkeypatch
+):
+    """--resume must NOT blend hyperparameters: params drift since the
+    interrupted run ⇒ a fresh instance, not a hijacked resume."""
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine,
+    )
+    from incubator_predictionio_tpu.controller.engine import EngineParams
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import run_train
+    from incubator_predictionio_tpu.workflow.workflow_params import WorkflowParams
+    from incubator_predictionio_tpu.workflow import checkpoint as ckpt_mod
+
+    _seed_events(memory_storage)
+    engine = RecommendationEngine().apply()
+
+    def params_with(reg):
+        return EngineParams(
+            data_source_params={"app_name": "ckptapp"},
+            algorithm_params_list=[("als", {
+                "rank": 4, "numIterations": 6, "lambda": reg, "seed": 11,
+                "block_len": 8,
+            })],
+        )
+
+    real_save = ckpt_mod.CheckpointHook.save
+
+    def crashing_save(self, step, tree):
+        real_save(self, step, tree)
+        raise RuntimeError("injected crash")
+
+    monkeypatch.setattr(ckpt_mod.CheckpointHook, "save", crashing_save)
+    with pytest.raises(RuntimeError, match="injected"):
+        run_train(engine, params_with(0.05),
+                  WorkflowContext(app_name="ckptapp", storage=memory_storage),
+                  WorkflowParams(checkpoint_every=2),
+                  engine_factory_name="RecEngine")
+    monkeypatch.setattr(ckpt_mod.CheckpointHook, "save", real_save)
+
+    instances = memory_storage.get_meta_data_engine_instances()
+    aborted_id = [x for x in instances.get_all() if x.status == "ABORTED"][0].id
+
+    # different lambda → new instance id, aborted row left untouched
+    iid = run_train(engine, params_with(0.5),
+                    WorkflowContext(app_name="ckptapp", storage=memory_storage),
+                    WorkflowParams(resume=True),
+                    engine_factory_name="RecEngine")
+    assert iid != aborted_id
+    assert instances.get(iid).status == "COMPLETED"
+    assert instances.get(aborted_id).status == "ABORTED"
+    # superseded snapshots are discarded, so the stale row can never be
+    # picked up by a later --resume
+    import os
+    assert not os.path.isdir(instance_checkpoint_dir(aborted_id))
+    assert find_resumable_instance(memory_storage, "RecEngine") is None
+
+
+def test_workflow_resume_with_changed_data_falls_back(memory_storage, tmp_path,
+                                                      monkeypatch):
+    """Event data changed after the crash ⇒ fingerprint mismatch ⇒ the
+    workflow discards the stale snapshots and completes from scratch
+    instead of erroring forever (poisoned-resume regression)."""
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine,
+    )
+    from incubator_predictionio_tpu.controller.engine import EngineParams
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import run_train
+    from incubator_predictionio_tpu.workflow.workflow_params import WorkflowParams
+    from incubator_predictionio_tpu.workflow import checkpoint as ckpt_mod
+
+    app_id = _seed_events(memory_storage)
+    engine = RecommendationEngine().apply()
+    ep = EngineParams(
+        data_source_params={"app_name": "ckptapp"},
+        algorithm_params_list=[("als", {
+            "rank": 4, "numIterations": 6, "lambda": 0.05, "seed": 11,
+            "block_len": 8,
+        })],
+    )
+
+    real_save = ckpt_mod.CheckpointHook.save
+
+    def crashing_save(self, step, tree):
+        real_save(self, step, tree)
+        raise RuntimeError("injected crash")
+
+    monkeypatch.setattr(ckpt_mod.CheckpointHook, "save", crashing_save)
+    with pytest.raises(RuntimeError, match="injected"):
+        run_train(engine, ep,
+                  WorkflowContext(app_name="ckptapp", storage=memory_storage),
+                  WorkflowParams(checkpoint_every=2),
+                  engine_factory_name="RecEngine")
+    monkeypatch.setattr(ckpt_mod.CheckpointHook, "save", real_save)
+
+    # the event store changes between crash and resume (same users/items,
+    # one more rating for an existing pair keeps all shapes identical)
+    from incubator_predictionio_tpu.data.storage.event import DataMap, Event
+    memory_storage.get_l_events().insert(Event(
+        event="rate", entity_type="user", entity_id="0",
+        target_entity_type="item", target_entity_id="0",
+        properties=DataMap({"rating": 5.0}),
+    ), app_id)
+
+    iid = run_train(engine, ep,
+                    WorkflowContext(app_name="ckptapp", storage=memory_storage),
+                    WorkflowParams(resume=True),
+                    engine_factory_name="RecEngine")
+    instances = memory_storage.get_meta_data_engine_instances()
+    assert instances.get(iid).status == "COMPLETED"
+    import os
+    assert not os.path.isdir(instance_checkpoint_dir(iid))
+
+
+def test_multi_algorithm_checkpoint_namespacing(memory_storage, tmp_path,
+                                                monkeypatch):
+    """Two algorithms in one engine must snapshot into separate
+    subdirectories (else orbax step numbers collide and --resume restores
+    the wrong algorithm's factors)."""
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+
+    from incubator_predictionio_tpu.models.recommendation import (
+        ALSAlgorithm,
+        RecommendationDataSource,
+    )
+    from incubator_predictionio_tpu.controller.engine import Engine, EngineParams
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import run_train
+    from incubator_predictionio_tpu.workflow.workflow_params import WorkflowParams
+    from incubator_predictionio_tpu.workflow import checkpoint as ckpt_mod
+
+    _seed_events(memory_storage)
+    engine = Engine(
+        data_source_class=RecommendationDataSource,
+        algorithm_class_map={"a1": ALSAlgorithm, "a2": ALSAlgorithm},
+    )
+    algo_params = {"rank": 4, "numIterations": 6, "lambda": 0.05, "seed": 11,
+                   "block_len": 8}
+    ep = EngineParams(
+        data_source_params={"app_name": "ckptapp"},
+        algorithm_params_list=[("a1", algo_params), ("a2", algo_params)],
+    )
+
+    saved_dirs = []
+    real_save = ckpt_mod.CheckpointHook.save
+
+    def spy_save(self, step, tree):
+        saved_dirs.append(self.directory)
+        real_save(self, step, tree)
+
+    monkeypatch.setattr(ckpt_mod.CheckpointHook, "save", spy_save)
+    ctx = WorkflowContext(app_name="ckptapp", storage=memory_storage)
+    iid = run_train(engine, ep, ctx, WorkflowParams(checkpoint_every=2),
+                    engine_factory_name="MultiEngine")
+    assert memory_storage.get_meta_data_engine_instances().get(iid).status == "COMPLETED"
+    assert saved_dirs, "checkpointing never ran"
+    # both algorithms snapshotted, into distinct subdirectories
+    assert len({d for d in saved_dirs}) == 2
+    assert all("algo_0_a1" in d or "algo_1_a2" in d for d in saved_dirs)
