@@ -1,0 +1,6 @@
+"""Shared server utilities (reference: common/src/main/scala/.../predictionio/
+{KeyAuthentication,SSLConfiguration}.scala)."""
+
+from .ssl_config import ssl_context_from_env
+
+__all__ = ["ssl_context_from_env"]

@@ -1,0 +1,31 @@
+"""TLS for the ops servers.
+
+Reference: common/.../SSLConfiguration.scala — a JKS keystore configured via
+`pio-env.sh` turns every spray server (event server, engine server, dashboard,
+admin) HTTPS. The TPU-native analog uses PEM files from the environment:
+
+  PIO_SSL_CERTFILE  path to a PEM certificate chain
+  PIO_SSL_KEYFILE   path to the PEM private key
+  PIO_SSL_KEY_PASSWORD  optional key passphrase
+
+When both files are set, every `run_*` server entry point serves HTTPS;
+otherwise plain HTTP (the reference's default is also off unless a keystore
+is configured).
+"""
+
+from __future__ import annotations
+
+import os
+import ssl
+from typing import Optional
+
+
+def ssl_context_from_env(env: Optional[dict] = None) -> Optional[ssl.SSLContext]:
+    e = os.environ if env is None else env
+    cert = e.get("PIO_SSL_CERTFILE")
+    key = e.get("PIO_SSL_KEYFILE")
+    if not cert or not key:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key, password=e.get("PIO_SSL_KEY_PASSWORD"))
+    return ctx

@@ -32,6 +32,8 @@ from typing import Optional
 
 from aiohttp import web
 
+from ...common import ssl_context_from_env
+from ...workflow.plugins import EventServerPluginContext
 from ..storage.base import AccessKey
 from ..storage.event import Event, EventValidationError, parse_event_time
 from ..storage.registry import Storage
@@ -48,9 +50,15 @@ def _json_error(status: int, message: str) -> web.Response:
 
 
 class EventServer:
-    def __init__(self, storage: Optional[Storage] = None, enable_stats: bool = False):
+    def __init__(
+        self,
+        storage: Optional[Storage] = None,
+        enable_stats: bool = False,
+        plugins: Optional[EventServerPluginContext] = None,
+    ):
         self.storage = storage or Storage.instance()
         self.stats = Stats() if enable_stats else None
+        self.plugins = plugins or EventServerPluginContext()
         self.app = web.Application(client_max_size=16 * 1024 * 1024)
         self.app.add_routes(
             [
@@ -279,9 +287,12 @@ class EventServer:
         event_id = await asyncio.to_thread(
             self.storage.get_l_events().insert, event, access_key.appid, channel_id
         )
+        self._record(access_key.appid, event_json, 201)
         return web.json_response({"eventId": event_id}, status=201)
 
     def _record(self, app_id: int, body, status: int) -> None:
+        if status < 400 and isinstance(body, dict):
+            self.plugins.on_event(body)
         if self.stats is None:
             return
         name = body.get("event", "?") if isinstance(body, dict) else "?"
@@ -298,4 +309,7 @@ def run_event_server(
     """Blocking entry point (reference: EventServer.createEventServer)."""
     server = EventServer(storage, enable_stats)
     log.info("Event Server listening on %s:%d", host, port)
-    web.run_app(server.app, host=host, port=port, print=None)
+    web.run_app(
+        server.app, host=host, port=port, print=None,
+        ssl_context=ssl_context_from_env(),
+    )

@@ -256,11 +256,16 @@ def train_als(
         build_blocked(item_idx, user_idx, rating, n_items, params.block_len), n_dev
     )
 
-    rng = np.random.default_rng(params.seed)
     k = params.rank
-    # MLlib-style init: scaled standard normal.
-    x0 = (rng.standard_normal((by_user.padded_rows, k)) / np.sqrt(k)).astype(np.float32)
-    y0 = (rng.standard_normal((by_item.padded_rows, k)) / np.sqrt(k)).astype(np.float32)
+    x_shape = (by_user.padded_rows, k)
+    y_shape = (by_item.padded_rows, k)
+
+    def _fresh_init():
+        # MLlib-style init: scaled standard normal.
+        rng = np.random.default_rng(params.seed)
+        x = (rng.standard_normal(x_shape) / np.sqrt(k)).astype(np.float32)
+        y = (rng.standard_normal(y_shape) / np.sqrt(k)).astype(np.float32)
+        return x, y
 
     # Fingerprint of the exact COO triple: resume is only sound against the
     # identical rating data (shape equality alone misses in-place rating
@@ -276,6 +281,7 @@ def train_als(
                        zlib.crc32(np.asarray(user_idx).tobytes())))
 
     start_iter = 0
+    x0 = y0 = None
     if checkpoint_hook is not None and resume:
         from ..workflow.checkpoint import CheckpointIncompatibleError
 
@@ -283,10 +289,10 @@ def train_als(
         if step is not None and step < params.num_iterations:
             start_iter, tree = checkpoint_hook.restore(step)
             rx, ry = np.asarray(tree["user_factors"]), np.asarray(tree["item_factors"])
-            if rx.shape != x0.shape or ry.shape != y0.shape:
+            if rx.shape != x_shape or ry.shape != y_shape:
                 raise CheckpointIncompatibleError(
                     f"checkpoint shapes {rx.shape}/{ry.shape} do not match the "
-                    f"current data layout {x0.shape}/{y0.shape}; the event data "
+                    f"current data layout {x_shape}/{y_shape}; the event data "
                     "changed since the interrupted run — retrain from scratch"
                 )
             saved_fp = int(np.asarray(tree.get("fingerprint", -1)))
@@ -308,6 +314,8 @@ def train_als(
                 "scratch or raise num_iterations"
             )
 
+    if x0 is None:
+        x0, y0 = _fresh_init()
     fn = _make_train_fn(mesh, params, by_user, by_item)
     blocks = (
         by_user.col, by_user.val, by_user.mask, by_user.local_row, by_user.counts,

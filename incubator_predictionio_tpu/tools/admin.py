@@ -93,4 +93,7 @@ class AdminServer:
 
 def run_admin_server(host: str = "127.0.0.1", port: int = 7071,
                      storage: Optional[Storage] = None) -> None:
-    web.run_app(AdminServer(storage).app, host=host, port=port, print=None)
+    from ..common import ssl_context_from_env
+
+    web.run_app(AdminServer(storage).app, host=host, port=port, print=None,
+                ssl_context=ssl_context_from_env())

@@ -83,4 +83,7 @@ class Dashboard:
 
 def run_dashboard(host: str = "127.0.0.1", port: int = 9000,
                   storage: Optional[Storage] = None) -> None:
-    web.run_app(Dashboard(storage).app, host=host, port=port, print=None)
+    from ..common import ssl_context_from_env
+
+    web.run_app(Dashboard(storage).app, host=host, port=port, print=None,
+                ssl_context=ssl_context_from_env())

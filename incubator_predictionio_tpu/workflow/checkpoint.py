@@ -9,7 +9,9 @@ granularity) the live factor pytree is snapshotted with orbax, and
 `pio train --resume` continues the most recent interrupted run from its
 last snapshot instead of restarting.
 
-Layout: ``$PIO_FS_BASEDIR/checkpoints/<engine-instance-id>/<step>/`` —
+Layout:
+``$PIO_FS_BASEDIR/checkpoints/<engine-instance-id>/algo_<idx>_<name>/<step>/``
+(Engine.train scopes each algorithm to its own subdirectory) —
 keyed by the same EngineInstance id the metadata repository tracks, so a
 crashed instance (status RUNNING/ABORTED) plus its checkpoint directory is
 all the state needed to resume on a fresh process or a different host

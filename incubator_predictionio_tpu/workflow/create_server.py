@@ -190,9 +190,11 @@ def run_engine_server(server: EngineServer, host: str = "0.0.0.0", port: int = 8
     server.app["stopper"] = stop_event.set
 
     async def main():
+        from ..common import ssl_context_from_env
+
         runner = web.AppRunner(server.app)
         await runner.setup()
-        site = web.TCPSite(runner, host, port)
+        site = web.TCPSite(runner, host, port, ssl_context=ssl_context_from_env())
         await site.start()
         log.info("Engine Server listening on %s:%d", host, port)
         await stop_event.wait()

@@ -40,6 +40,31 @@ class EventServerPlugin:
         pass
 
 
+class EventServerPluginContext:
+    """Reference: EventServerPluginContext — ServiceLoader-discovered
+    plugins observing ingested events. Python discovery: explicit list or
+    dotted paths in PIO_EVENT_SERVER_PLUGINS (comma separated)."""
+
+    def __init__(self, plugins: Optional[list[EventServerPlugin]] = None):
+        self.plugins = list(plugins or [])
+        for dotted in filter(None, os.environ.get("PIO_EVENT_SERVER_PLUGINS", "").split(",")):
+            try:
+                module, _, cls = dotted.strip().rpartition(".")
+                self.plugins.append(getattr(importlib.import_module(module), cls)())
+            except Exception:  # pragma: no cover - bad env entry
+                log.exception("failed to load event server plugin %s", dotted)
+
+    def plugin_names(self) -> list[str]:
+        return [p.name for p in self.plugins]
+
+    def on_event(self, event_json: dict) -> None:
+        for p in self.plugins:
+            try:
+                p.on_event(event_json)
+            except Exception:  # plugins must never break ingestion
+                log.exception("event server plugin %s failed", p.name)
+
+
 class EngineServerPluginContext:
     def __init__(self, plugins: Optional[list[EngineServerPlugin]] = None):
         self.plugins = list(plugins or [])

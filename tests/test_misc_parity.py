@@ -1,0 +1,106 @@
+"""SSL config, event-server plugins, pypio bridge (reference:
+common/SSLConfiguration.scala, data/.../api/EventServerPlugin.scala,
+python/pypio)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.common import ssl_context_from_env
+from incubator_predictionio_tpu.data.storage.registry import Storage
+from incubator_predictionio_tpu.workflow.plugins import (
+    EventServerPlugin,
+    EventServerPluginContext,
+)
+
+
+def test_ssl_context_absent_env():
+    assert ssl_context_from_env({}) is None
+    assert ssl_context_from_env({"PIO_SSL_CERTFILE": "/x"}) is None
+
+
+def test_ssl_context_self_signed(tmp_path):
+    # generate a throwaway self-signed cert with the stdlib-adjacent openssl
+    import subprocess
+
+    cert, key = tmp_path / "c.pem", tmp_path / "k.pem"
+    r = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=localhost"],
+        capture_output=True,
+    )
+    if r.returncode != 0:
+        pytest.skip("openssl unavailable")
+    ctx = ssl_context_from_env(
+        {"PIO_SSL_CERTFILE": str(cert), "PIO_SSL_KEYFILE": str(key)}
+    )
+    assert ctx is not None
+
+
+class _Recorder(EventServerPlugin):
+    name = "recorder"
+
+    def __init__(self):
+        self.seen = []
+
+    def on_event(self, event_json):
+        self.seen.append(event_json)
+
+
+def test_event_server_plugin_context():
+    rec = _Recorder()
+    ctx = EventServerPluginContext([rec])
+    assert ctx.plugin_names() == ["recorder"]
+    ctx.on_event({"event": "rate"})
+    assert rec.seen == [{"event": "rate"}]
+
+
+class _Exploder(EventServerPlugin):
+    name = "exploder"
+
+    def on_event(self, event_json):
+        raise RuntimeError("boom")
+
+
+def test_event_server_plugin_errors_swallowed():
+    ctx = EventServerPluginContext([_Exploder()])
+    ctx.on_event({"event": "rate"})  # must not raise
+
+
+def test_pypio_roundtrip(tmp_path):
+    from incubator_predictionio_tpu import pypio
+
+    env = {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+    }
+    pypio.init(Storage(env))
+    app_id, key = pypio.new_app("pypio-test")
+    assert app_id > 0 and key
+
+    jsonl = tmp_path / "events.jsonl"
+    with open(jsonl, "w") as f:
+        for u in range(5):
+            for i in range(4):
+                f.write(json.dumps({
+                    "event": "rate",
+                    "entityType": "user", "entityId": str(u),
+                    "targetEntityType": "item", "targetEntityId": f"i{i}",
+                    "properties": {"rating": float(1 + (u + i) % 5)},
+                    "eventTime": "2024-01-01T00:00:00.000Z",
+                }) + "\n")
+    assert pypio.import_events("pypio-test", str(jsonl)) == 20
+
+    batch = pypio.find_events("pypio-test", event_names=["rate"])
+    assert len(batch) == 20
+    u, i, r, users, items = pypio.find_ratings("pypio-test")
+    assert u.shape == (20,) and len(users) == 5 and len(items) == 4
+    assert np.all((r >= 1) & (r <= 5))
+
+    pypio.delete_app("pypio-test")
+    with pytest.raises(ValueError):
+        pypio.delete_app("pypio-test")
