@@ -1,0 +1,402 @@
+"""JSONL event-log backend — the `JSONL` source type (eventdata only).
+
+The scan-optimized event store of record, playing the role HBase plays in
+the reference (storage/hbase/.../{StorageClient,HBLEvents,HBPEvents}.scala:
+tables `pio_event_<appId>[_<channelId>]`, rowkeys laid out for bulk scans).
+TPU-first redesign: one append-only JSONL log per (app, channel); inserts
+and deletes are appends (deletes as ``{"__tombstone__": id}`` records), so
+ingest is sequential IO, and the bulk read feeding training is a single
+file scan decoded by the native columnar codec
+(native/src/event_codec.cc) straight into interned numpy columns — no
+per-event Python objects on the training path.
+
+Scans are cached per file and extended incrementally: the parser re-reads
+only the bytes appended since the previous scan (the moral equivalent of
+the reference's HBase block cache for repeated TableInputFormat scans).
+
+`aggregate_properties` ($set/$unset/$delete folding) and point lookups
+reconstruct full events lazily from the cached record spans.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import itertools
+import os
+import threading
+from typing import Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from ...native import ColumnarEvents, parse_events
+from . import base
+from .event import Event, new_event_id
+from .memory import event_matches
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+_TIME_ABSENT = np.iinfo(np.int64).min
+
+
+def _to_us(t: Optional[_dt.datetime]) -> Optional[int]:
+    if t is None:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int(round((t - _EPOCH).total_seconds() * 1e6))
+
+
+class _LogScan:
+    """Cached columnar scan of one log file, extended incrementally."""
+
+    def __init__(self) -> None:
+        self.size = 0
+        self.cols: Optional[ColumnarEvents] = None
+        self.tombstones: set[str] = set()
+
+    def refresh(self, path: str) -> None:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            self.size, self.cols, self.tombstones = 0, None, set()
+            return
+        if self.cols is not None and size == self.size:
+            return
+        if self.cols is not None and size > self.size:
+            with open(path, "rb") as f:
+                f.seek(self.size)
+                tail = f.read()
+            new = parse_events(tail)
+            self._extend(new)
+            self.size = size
+            return
+        with open(path, "rb") as f:
+            buf = f.read()
+        self.cols = parse_events(buf)
+        self.tombstones = set(self.cols.tombstones)
+        self.size = size
+
+    def _extend(self, new: ColumnarEvents) -> None:
+        old = self.cols
+        assert old is not None
+        # Remap new codes into the old tables (append-only interning).
+        remapped = {}
+        for which, attr in ((0, "event"), (1, "etype"), (2, "eid"),
+                            (3, "tetype"), (4, "teid"), (5, "event_id")):
+            old_table = old.table(which)
+            old_index = {s: i for i, s in enumerate(old_table)}
+            new_table = new.table(which)
+            lut = np.empty(len(new_table) + 1, np.int32)
+            lut[-1] = -1  # code -1 stays -1
+            for i, s in enumerate(new_table):
+                code = old_index.get(s)
+                if code is None:
+                    code = len(old_table)
+                    old_table.append(s)
+                    old_index[s] = code
+                lut[i] = code
+            remapped[attr] = lut[getattr(new, attr)]
+        base_off = len(old.raw)
+        shift = lambda a: np.where(a >= 0, a + base_off, a)  # noqa: E731
+        self.cols = ColumnarEvents(
+            raw=old.raw + new.raw,
+            event=np.concatenate([old.event, remapped["event"]]),
+            etype=np.concatenate([old.etype, remapped["etype"]]),
+            eid=np.concatenate([old.eid, remapped["eid"]]),
+            tetype=np.concatenate([old.tetype, remapped["tetype"]]),
+            teid=np.concatenate([old.teid, remapped["teid"]]),
+            event_id=np.concatenate([old.event_id, remapped["event_id"]]),
+            time_us=np.concatenate([old.time_us, new.time_us]),
+            rating=np.concatenate([old.rating, new.rating]),
+            props=np.concatenate([old.props, shift(new.props)]),
+            span=np.concatenate([old.span, shift(new.span)]),
+            _tables=[old.table(w) for w in range(6)],
+            tombstones=old.tombstones + new.tombstones,
+        )
+        self.tombstones.update(new.tombstones)
+
+    def live_mask(self) -> np.ndarray:
+        """Boolean mask of the effective view: per eventId only the LAST
+        record survives (re-insert with a client-supplied id overwrites,
+        matching the other backends' upsert semantics), and tombstoned ids
+        are dropped entirely."""
+        cols = self.cols
+        assert cols is not None
+        n = len(cols)
+        mask = np.ones(n, bool)
+        ids = cols.event_id
+        n_with_id = int((ids >= 0).sum())
+        if n and len(cols.table(ColumnarEvents.TABLE_EVENT_ID)) < n_with_id:
+            # duplicates exist: keep last occurrence of each code
+            rev_ids = ids[::-1]
+            _, first_in_rev = np.unique(rev_ids, return_index=True)
+            keep = np.zeros(n, bool)
+            keep[n - 1 - first_in_rev] = True
+            keep |= ids < 0  # records without ids are never deduped
+            mask &= keep
+        if self.tombstones:
+            table = cols.table(ColumnarEvents.TABLE_EVENT_ID)
+            dead_codes = {i for i, s in enumerate(table) if s in self.tombstones}
+            if dead_codes:
+                dead = np.fromiter((c in dead_codes for c in ids),
+                                   count=n, dtype=bool)
+                mask &= ~dead
+        return mask
+
+
+class JSONLEvents(base.LEvents):
+    """LEvents + bulk scan over append-only logs."""
+
+    def __init__(self, basedir: str) -> None:
+        self._dir = basedir
+        os.makedirs(basedir, exist_ok=True)
+        self._lock = threading.RLock()
+        self._scans: dict[str, _LogScan] = {}
+
+    # -- paths ------------------------------------------------------------
+    def _path(self, app_id: int, channel_id: Optional[int]) -> str:
+        suffix = f"_{channel_id}" if channel_id is not None else ""
+        return os.path.join(self._dir, f"events_{app_id}{suffix}.jsonl")
+
+    def _scan(self, app_id: int, channel_id: Optional[int]) -> _LogScan:
+        path = self._path(app_id, channel_id)
+        with self._lock:
+            scan = self._scans.setdefault(path, _LogScan())
+            scan.refresh(path)
+            return scan
+
+    def _append(self, path: str, lines: list[str]) -> None:
+        with self._lock:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write("".join(lines))
+
+    # -- LEvents contract -------------------------------------------------
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        path = self._path(app_id, channel_id)
+        with self._lock:
+            if not os.path.exists(path):
+                open(path, "a").close()
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        path = self._path(app_id, channel_id)
+        with self._lock:
+            self._scans.pop(path, None)
+            try:
+                os.remove(path)
+            except OSError:
+                return False
+        return True
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        import json
+
+        eid = event.event_id or new_event_id()
+        stored = event.with_event_id(eid)
+        self._append(self._path(app_id, channel_id),
+                     [json.dumps(stored.to_json()) + "\n"])
+        return eid
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> list[str]:
+        import json
+
+        ids, lines = [], []
+        for event in events:
+            eid = event.event_id or new_event_id()
+            ids.append(eid)
+            lines.append(json.dumps(event.with_event_id(eid).to_json()) + "\n")
+        self._append(self._path(app_id, channel_id), lines)
+        return ids
+
+    def _row_event(self, cols: ColumnarEvents, i: int) -> Event:
+        return Event.from_json(cols.record_dict(i))
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        scan = self._scan(app_id, channel_id)
+        if scan.cols is None or event_id in scan.tombstones:
+            return None
+        table = scan.cols.table(ColumnarEvents.TABLE_EVENT_ID)
+        try:
+            code = table.index(event_id)
+        except ValueError:
+            return None
+        rows = np.nonzero(scan.cols.event_id == code)[0]
+        if rows.size == 0:
+            return None
+        return self._row_event(scan.cols, int(rows[-1]))
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        import json
+
+        if self.get(event_id, app_id, channel_id) is None:
+            return False
+        self._append(self._path(app_id, channel_id),
+                     [json.dumps({"__tombstone__": event_id}) + "\n"])
+        return True
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]:
+        scan = self._scan(app_id, channel_id)
+        cols = scan.cols
+        if cols is None or len(cols) == 0:
+            return iter(())
+        mask = scan.live_mask()
+
+        # columnar pre-filter on interned codes (cheap numpy ops); the
+        # event_matches re-check below keeps exact reference semantics for
+        # whatever the columns can't express (absent times etc.)
+        def code_filter(which: int, col: np.ndarray, value: Optional[str]):
+            nonlocal mask
+            if value is None:
+                return
+            table = cols.table(which)
+            try:
+                code = table.index(value)
+            except ValueError:
+                mask &= False
+                return
+            mask = mask & (col == code)
+
+        code_filter(ColumnarEvents.TABLE_ETYPE, cols.etype, entity_type)
+        code_filter(ColumnarEvents.TABLE_EID, cols.eid, entity_id)
+        code_filter(ColumnarEvents.TABLE_TETYPE, cols.tetype, target_entity_type)
+        code_filter(ColumnarEvents.TABLE_TEID, cols.teid, target_entity_id)
+        if event_names is not None:
+            table = cols.table(ColumnarEvents.TABLE_EVENT)
+            codes = [table.index(n) for n in event_names if n in table]
+            mask = mask & np.isin(cols.event, np.asarray(codes, np.int32))
+        s_us, u_us = _to_us(start_time), _to_us(until_time)
+        if s_us is not None:
+            mask = mask & (cols.time_us != _TIME_ABSENT) & (cols.time_us >= s_us)
+        if u_us is not None:
+            mask = mask & (cols.time_us != _TIME_ABSENT) & (cols.time_us < u_us)
+
+        rows = np.nonzero(mask)[0]
+        order = np.argsort(cols.time_us[rows], kind="stable")
+        if reversed_order:
+            order = order[::-1]
+        rows = rows[order]
+
+        def gen():
+            for i in rows:
+                e = self._row_event(cols, int(i))
+                if event_matches(e, start_time, until_time, entity_type,
+                                 entity_id, event_names, target_entity_type,
+                                 target_entity_id):
+                    yield e
+
+        it = gen()
+        if limit is not None and limit >= 0:
+            it = itertools.islice(it, limit)
+        return it
+
+    # -- bulk/columnar API (used by JSONLPEvents + PEventStore fast path) --
+    def scan_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        event_names: Optional[Sequence[str]] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> tuple[ColumnarEvents, np.ndarray]:
+        """(columns, selected-row indices) for the training read path."""
+        scan = self._scan(app_id, channel_id)
+        cols = scan.cols
+        if cols is None:
+            empty = parse_events(b"")
+            return empty, np.empty(0, np.int64)
+        mask = scan.live_mask()
+        if event_names is not None:
+            table = cols.table(ColumnarEvents.TABLE_EVENT)
+            codes = [table.index(n) for n in event_names if n in table]
+            mask = mask & np.isin(cols.event, np.asarray(codes, np.int32))
+        s_us, u_us = _to_us(start_time), _to_us(until_time)
+        if s_us is not None:
+            mask = mask & (cols.time_us != _TIME_ABSENT) & (cols.time_us >= s_us)
+        if u_us is not None:
+            mask = mask & (cols.time_us != _TIME_ABSENT) & (cols.time_us < u_us)
+        return cols, np.nonzero(mask)[0]
+
+    def compact(self, app_id: int, channel_id: Optional[int] = None) -> int:
+        """Rewrite the log without tombstoned records; returns live count
+        (the reference's SelfCleaningDataSource writes a compacted stream
+        back — core/.../core/SelfCleaningDataSource.scala)."""
+        path = self._path(app_id, channel_id)
+        with self._lock:
+            scan = self._scan(app_id, channel_id)
+            cols = scan.cols
+            if cols is None:
+                return 0
+            mask = scan.live_mask()
+            rows = np.nonzero(mask)[0]
+            tmp = path + ".compact"
+            with open(tmp, "wb") as f:
+                for i in rows:
+                    s, e = cols.span[i]
+                    f.write(cols.raw[s:e] + b"\n")
+            os.replace(tmp, path)
+            self._scans.pop(path, None)
+            return int(rows.size)
+
+
+class JSONLPEvents(base.PEvents):
+    def __init__(self, l_events: JSONLEvents) -> None:
+        self._l = l_events
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None) -> Iterator[Event]:
+        return self._l.find(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id,
+        )
+
+    def write(self, events: Iterable[Event], app_id: int, channel_id: Optional[int] = None) -> None:
+        self._l.insert_batch(list(events), app_id, channel_id)
+
+    def delete(self, event_ids: Iterable[str], app_id: int, channel_id: Optional[int] = None) -> None:
+        for eid in event_ids:
+            self._l.delete(eid, app_id, channel_id)
+
+    def scan_columnar(self, app_id, channel_id=None, event_names=None,
+                      start_time=None, until_time=None):
+        return self._l.scan_columnar(
+            app_id, channel_id, event_names, start_time, until_time
+        )
+
+
+class JSONLClient(base.BaseStorageClient):
+    """`TYPE=JSONL`; property PATH = base directory for event logs."""
+
+    def __init__(self, config: base.StorageClientConfig):
+        super().__init__(config)
+        if "PATH" in config.properties:
+            self._path = config.properties["PATH"]
+        else:
+            from .registry import base_dir
+
+            self._path = os.path.join(base_dir(), "events")
+        self._l: dict[str, JSONLEvents] = {}
+        self._lock = threading.Lock()
+
+    def l_events(self, namespace: str = "pio_eventdata") -> JSONLEvents:
+        with self._lock:
+            if namespace not in self._l:
+                self._l[namespace] = JSONLEvents(os.path.join(self._path, namespace))
+            return self._l[namespace]
+
+    def p_events(self, namespace: str = "pio_eventdata") -> JSONLPEvents:
+        return JSONLPEvents(self.l_events(namespace))

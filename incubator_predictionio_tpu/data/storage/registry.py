@@ -25,6 +25,7 @@ import threading
 from typing import Callable, Optional
 
 from . import base
+from .jsonl import JSONLClient
 from .localfs import LocalFSClient
 from .memory import StorageClient as MemoryClient
 from .sqlite import SQLiteClient
@@ -38,6 +39,7 @@ _BACKENDS: dict[str, Callable[[base.StorageClientConfig], base.BaseStorageClient
     "MEMORY": MemoryClient,
     "SQLITE": SQLiteClient,
     "LOCALFS": LocalFSClient,
+    "JSONL": JSONLClient,
     # Placeholders for parity with the reference backend matrix; these are
     # separate services the sandbox cannot host. The registry raises a
     # clear error if selected (reference: hbase/elasticsearch/jdbc/s3/hdfs).

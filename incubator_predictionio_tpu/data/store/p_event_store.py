@@ -105,6 +105,97 @@ class PEventStore:
         )
 
     @staticmethod
+    def find_ratings(
+        app_name: str,
+        event_names: Optional[Sequence[str]] = None,
+        rating_from_props: bool = True,
+        default_rating: float = 1.0,
+        event_default_ratings: Optional[dict] = None,
+        storage: Optional[Storage] = None,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, BiMap, BiMap]:
+        """(user, item, rating) COO triple + id maps — the shared prep for
+        every recommendation-family template.
+
+        Fast path: when the event backend exposes a columnar scan (the
+        JSONL log decoded by the native codec — data/storage/jsonl.py),
+        the triple is assembled with pure numpy on interned codes, never
+        materializing per-event Python objects. Otherwise falls back to
+        the row-based scan + ``ratings_matrix``.
+
+        ``event_default_ratings`` assigns a rating to events of a given
+        name when properties carry none (e.g. the quickstart template's
+        implicit "buy" → 4.0).
+        """
+        s, app_id, channel_id = _resolve_app(app_name, storage, channel_name)
+        pe = s.get_p_events()
+        if hasattr(pe, "scan_columnar"):
+            cols, rows = pe.scan_columnar(
+                app_id, channel_id, event_names, start_time, until_time
+            )
+            rows = rows[cols.eid[rows] >= 0]  # malformed records: no entityId
+            # The row path iterates events time-sorted (LEvents.find
+            # semantics); order the selection the same way so BiMap
+            # first-seen index assignment matches bit-for-bit.
+            rows = rows[np.argsort(cols.time_us[rows], kind="stable")]
+            # BiMap membership and index order must match the row path
+            # exactly: users cover ALL scanned events (even target-less
+            # ones), items only events with a target; both indexed in
+            # first-seen order within the selection (BiMap.string_int).
+            keep_mask = cols.teid[rows] >= 0
+            keep = rows[keep_mask]
+            if rating_from_props:
+                r = cols.rating[keep].astype(np.float32, copy=True)
+                missing = np.isnan(r)
+                if missing.any():
+                    fill = np.full(keep.shape, np.float32(default_rating))
+                    if event_default_ratings:
+                        ev_table = cols.table(cols.TABLE_EVENT)
+                        ev = cols.event[keep]
+                        for name, val in event_default_ratings.items():
+                            if name in ev_table:
+                                fill = np.where(
+                                    ev == ev_table.index(name),
+                                    np.float32(val), fill,
+                                )
+                    r[missing] = fill[missing]
+            else:
+                r = np.full(keep.shape, default_rating, np.float32)
+
+            def densify(codes: np.ndarray, table: list[str]):
+                uniq, first_pos, inv = np.unique(
+                    codes, return_index=True, return_inverse=True
+                )
+                order = np.argsort(first_pos, kind="stable")
+                rank = np.empty(order.shape, np.int64)
+                rank[order] = np.arange(order.shape[0])
+                bimap = BiMap({table[c]: int(k)
+                               for k, c in enumerate(uniq[order])})
+                return rank[inv], bimap
+
+            u_all, users = densify(cols.eid[rows], cols.table(cols.TABLE_EID))
+            u = u_all[keep_mask]
+            i, items = densify(cols.teid[keep], cols.table(cols.TABLE_TEID))
+            return u.astype(np.int32), i.astype(np.int32), r, users, items
+
+        batch = PEventStore.find_batch(
+            app_name, event_names=event_names, storage=storage,
+            channel_name=channel_name, start_time=start_time,
+            until_time=until_time,
+        )
+        if rating_from_props and event_default_ratings:
+            for j, ev in enumerate(batch.event):
+                dflt = event_default_ratings.get(ev)
+                if dflt is not None and "rating" not in batch.properties[j]:
+                    batch.properties[j] = {**batch.properties[j], "rating": dflt}
+        return ratings_matrix(
+            batch, rating_from_props=rating_from_props,
+            default_rating=default_rating,
+        )
+
+    @staticmethod
     def aggregate_properties(
         app_name: str,
         entity_type: str,
@@ -136,8 +227,16 @@ def ratings_matrix(
         count=len(batch),
     )
     if rating_from_props:
+        def _coerce(v) -> float:
+            if isinstance(v, bool) or v is None:
+                return default_rating
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                return default_rating
+
         r = np.fromiter(
-            (float(p.get("rating", default_rating)) for p in batch.properties),
+            (_coerce(p.get("rating", default_rating)) for p in batch.properties),
             dtype=np.float32,
             count=len(batch),
         )

@@ -32,7 +32,7 @@ from ..controller import (
     Serving,
 )
 from ..data.storage.bimap import BiMap
-from ..data.store.p_event_store import PEventStore, ratings_matrix
+from ..data.store.p_event_store import PEventStore
 from ..ops.als import ALSFactors, ALSParams, train_als
 from ..ops.topk import batch_top_k, top_k_items
 
@@ -110,17 +110,14 @@ class RecommendationDataSource(DataSource):
     def read_training(self, ctx) -> TrainingData:
         p: DataSourceParams = self.params
         app_name = p.app_name or ctx.app_name
-        batch = PEventStore.find_batch(
+        # "buy" events carry no rating property → template assigns one.
+        u, i, r, users, items = PEventStore.find_ratings(
             app_name,
             event_names=list(p.event_names),
+            event_default_ratings={"buy": p.buy_rating},
             storage=ctx.get_storage(),
             channel_name=ctx.channel_name,
         )
-        # "buy" events carry no rating property → template assigns one.
-        for j, ev in enumerate(batch.event):
-            if ev == "buy" and "rating" not in batch.properties[j]:
-                batch.properties[j] = {**batch.properties[j], "rating": p.buy_rating}
-        u, i, r, users, items = ratings_matrix(batch)
         return TrainingData(u, i, r, users, items)
 
     def read_eval(self, ctx):

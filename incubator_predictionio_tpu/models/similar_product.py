@@ -23,7 +23,7 @@ import numpy as np
 
 from ..controller import Algorithm, DataSource, Engine, EngineFactory, Params, SanityCheck
 from ..data.storage.bimap import BiMap
-from ..data.store.p_event_store import PEventStore, ratings_matrix
+from ..data.store.p_event_store import PEventStore
 from ..ops.als import ALSFactors, ALSParams, train_als
 from ..ops.topk import similar_items
 from ._filters import CategoryIndex, build_exclude_mask
@@ -59,13 +59,13 @@ class SimilarProductDataSource(DataSource):
     def read_training(self, ctx) -> TrainingData:
         p: DataSourceParams = self.params
         app_name = p.app_name or ctx.app_name
-        batch = PEventStore.find_batch(
+        u, i, r, users, items = PEventStore.find_ratings(
             app_name,
             event_names=list(p.event_names),
+            rating_from_props=False,
             storage=ctx.get_storage(),
             channel_name=ctx.channel_name,
         )
-        u, i, r, users, items = ratings_matrix(batch, rating_from_props=False)
         cats: dict[str, set[str]] = {}
         for item_id, pm in PEventStore.aggregate_properties(
             app_name, p.item_entity_type, storage=ctx.get_storage()

@@ -1,0 +1,423 @@
+"""Native event-log codec bindings (ctypes over native/src/event_codec.cc).
+
+The C++ library is the scan path of the JSONL event store — the role the
+HBase client + TableInputFormat scan play in the reference (storage/hbase/
+.../HBPEvents.scala). ``parse_events_jsonl`` decodes a JSONL buffer into
+``ColumnarEvents``: interned id codes + timestamps + ratings as numpy
+arrays, the exact host-side layout the input pipeline uploads to device.
+
+Build strategy: the .so is compiled lazily on first use (one translation
+unit, ~1s with g++ -O3) into ``_lib/`` next to this file, keyed by an ABI
+version exported by the library; `make -C native` does the same for
+packaging. When no C++ toolchain is available ``parse_events_jsonl``
+raises ``NativeUnavailable`` and callers fall back to the pure-Python
+scan — behavior is identical, only slower (tests assert equality).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+_EXPECTED_VERSION = 5
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_error: Optional[str] = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+class EventParseError(ValueError):
+    pass
+
+
+def _src_path() -> str:
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo_root, "native", "src", "event_codec.cc")
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib", "libpioevent.so")
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.pio_codec_version.restype = ctypes.c_int32
+    lib.pio_parse_events_jsonl.restype = ctypes.c_void_p
+    lib.pio_parse_events_jsonl.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.pio_col_count.restype = ctypes.c_int64
+    lib.pio_col_count.argtypes = [ctypes.c_void_p]
+    for name in ("pio_col_event", "pio_col_etype", "pio_col_eid",
+                 "pio_col_tetype", "pio_col_teid", "pio_col_event_id"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.POINTER(ctypes.c_int32)
+        fn.argtypes = [ctypes.c_void_p]
+    for name in ("pio_col_time_us", "pio_col_props", "pio_col_span"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.POINTER(ctypes.c_int64)
+        fn.argtypes = [ctypes.c_void_p]
+    lib.pio_col_rating.restype = ctypes.POINTER(ctypes.c_float)
+    lib.pio_col_rating.argtypes = [ctypes.c_void_p]
+    lib.pio_table_size.restype = ctypes.c_int32
+    lib.pio_table_size.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.pio_table_get.restype = ctypes.POINTER(ctypes.c_char)
+    lib.pio_table_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.pio_table_blob.restype = ctypes.POINTER(ctypes.c_char)
+    lib.pio_table_blob.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.pio_table_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.pio_table_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.pio_tombstone_count.restype = ctypes.c_int64
+    lib.pio_tombstone_count.argtypes = [ctypes.c_void_p]
+    lib.pio_tombstone_get.restype = ctypes.POINTER(ctypes.c_char)
+    lib.pio_tombstone_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.pio_free.restype = None
+    lib.pio_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _build() -> str:
+    out = _lib_path()
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-shared",
+           "-o", tmp, _src_path()]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        raise NativeUnavailable(f"g++ build failed: {proc.stderr[-2000:]}")
+    os.replace(tmp, out)
+    return out
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _lib_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _lib_error is not None:
+            raise NativeUnavailable(_lib_error)
+        try:
+            path = _lib_path()
+            lib = None
+            if os.path.exists(path):
+                try:
+                    candidate = _bind(ctypes.CDLL(path))
+                    if candidate.pio_codec_version() == _EXPECTED_VERSION:
+                        lib = candidate
+                except (OSError, AttributeError):
+                    pass  # stale/corrupt cache → rebuild below
+            if lib is None:
+                lib = _bind(ctypes.CDLL(_build()))
+                if lib.pio_codec_version() != _EXPECTED_VERSION:
+                    raise NativeUnavailable(
+                        "built library ABI version mismatch — source/wrapper skew"
+                    )
+            _lib = lib
+            return _lib
+        except NativeUnavailable as e:
+            _lib_error = str(e)
+            raise
+        except Exception as e:  # toolchain/loader failures degrade cleanly
+            _lib_error = f"native codec unavailable: {e}"
+            raise NativeUnavailable(_lib_error) from e
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+@dataclass
+class ColumnarEvents:
+    """Interned columnar view of an event log scan.
+
+    Code -1 in ``tetype``/``teid``/``event_id`` = field absent;
+    ``time_us`` INT64_MIN = absent; ``rating`` NaN = absent. ``props`` and
+    ``span`` are [start, end) byte offsets into ``raw`` (-1 = absent) for
+    lazy per-event reparse of the full JSON.
+
+    String tables are materialized lazily per table via ``table(which)`` —
+    the eventId table of a big scan is as large as the scan itself, and the
+    training fast path never touches it.
+    """
+
+    raw: bytes
+    event: np.ndarray
+    etype: np.ndarray
+    eid: np.ndarray
+    tetype: np.ndarray
+    teid: np.ndarray
+    event_id: np.ndarray
+    time_us: np.ndarray
+    rating: np.ndarray
+    props: np.ndarray  # (n, 2) int64
+    span: np.ndarray  # (n, 2) int64
+    # per table: (concatenated utf-8 blob, size+1 end-offsets) or the
+    # already-built list
+    _tables: list
+    tombstones: list[str]
+
+    def __len__(self) -> int:
+        return int(self.event.shape[0])
+
+    TABLE_EVENT, TABLE_ETYPE, TABLE_EID = 0, 1, 2
+    TABLE_TETYPE, TABLE_TEID, TABLE_EVENT_ID = 3, 4, 5
+
+    def table(self, which: int) -> list[str]:
+        t = self._tables[which]
+        if isinstance(t, list):
+            return t
+        blob, offs = t
+        size = len(offs) - 1
+        text = blob.decode("utf-8")
+        if len(text) == len(blob):  # pure ASCII: str slicing == byte slicing
+            out = [text[offs[k]:offs[k + 1]] for k in range(size)]
+        else:
+            out = [blob[offs[k]:offs[k + 1]].decode("utf-8") for k in range(size)]
+        self._tables[which] = out
+        return out
+
+    @property
+    def tables(self) -> list[list[str]]:
+        return [self.table(w) for w in range(6)]
+
+    def properties_dict(self, i: int) -> dict:
+        s, e = self.props[i]
+        if s < 0:
+            return {}
+        return json.loads(self.raw[s:e])
+
+    def record_dict(self, i: int) -> dict:
+        s, e = self.span[i]
+        return json.loads(self.raw[s:e])
+
+
+def _np_copy(ptr, n, dtype):
+    if n == 0:
+        return np.empty(0, dtype=dtype)
+    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+def parse_events_jsonl(buf: bytes) -> ColumnarEvents:
+    """Parse a JSONL buffer of event objects (native fast path).
+
+    Raises NativeUnavailable when no toolchain/library, EventParseError on
+    malformed input. Pure-Python equivalent: ``parse_events_jsonl_py``.
+    """
+    lib = _load()
+    err = ctypes.create_string_buffer(512)
+    handle = lib.pio_parse_events_jsonl(buf, len(buf), err, len(err))
+    if not handle:
+        raise EventParseError(err.value.decode(errors="replace") or "parse failed")
+    try:
+        n = lib.pio_col_count(handle)
+        tables = []
+        for which in range(6):
+            size = lib.pio_table_size(handle, which)
+            if size == 0:
+                tables.append([])
+                continue
+            blob_len = ctypes.c_int64(0)
+            blob_ptr = lib.pio_table_blob(handle, which, ctypes.byref(blob_len))
+            blob = ctypes.string_at(blob_ptr, blob_len.value)
+            offs = _np_copy(lib.pio_table_offsets(handle, which), size + 1, np.int64)
+            tables.append((blob, offs))
+        tombstones = []
+        ln = ctypes.c_int32(0)
+        for idx in range(lib.pio_tombstone_count(handle)):
+            ptr = lib.pio_tombstone_get(handle, idx, ctypes.byref(ln))
+            tombstones.append(ctypes.string_at(ptr, ln.value).decode("utf-8"))
+        return ColumnarEvents(
+            raw=buf,
+            event=_np_copy(lib.pio_col_event(handle), n, np.int32),
+            etype=_np_copy(lib.pio_col_etype(handle), n, np.int32),
+            eid=_np_copy(lib.pio_col_eid(handle), n, np.int32),
+            tetype=_np_copy(lib.pio_col_tetype(handle), n, np.int32),
+            teid=_np_copy(lib.pio_col_teid(handle), n, np.int32),
+            event_id=_np_copy(lib.pio_col_event_id(handle), n, np.int32),
+            time_us=_np_copy(lib.pio_col_time_us(handle), n, np.int64),
+            rating=_np_copy(lib.pio_col_rating(handle), n, np.float32),
+            props=_np_copy(lib.pio_col_props(handle), 2 * n, np.int64).reshape(n, 2),
+            span=_np_copy(lib.pio_col_span(handle), 2 * n, np.int64).reshape(n, 2),
+            _tables=tables,
+            tombstones=tombstones,
+        )
+    finally:
+        lib.pio_free(handle)
+
+
+def _scan_object_bytes(rec: bytes, start: int) -> int:
+    """End index (exclusive) of the JSON object opening at rec[start] == '{'.
+    Structural bytes are ASCII, so scanning raw UTF-8 is safe."""
+    depth, j = 0, start
+    in_str = esc = False
+    while j < len(rec):
+        ch = rec[j:j + 1]
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == b"\\":
+                esc = True
+            elif ch == b'"':
+                in_str = False
+        elif ch == b'"':
+            in_str = True
+        elif ch == b"{":
+            depth += 1
+        elif ch == b"}":
+            depth -= 1
+            if depth == 0:
+                return j + 1
+        j += 1
+    raise EventParseError("unterminated properties object")
+
+
+def parse_events_jsonl_py(buf: bytes) -> ColumnarEvents:
+    """Pure-Python reference implementation (fallback + equality oracle).
+
+    Line-delimited only (one JSON object per line) — the format the JSONL
+    backend writes. The native parser additionally accepts arbitrary
+    inter-object whitespace.
+    """
+    import datetime as _dt
+
+    from ..data.storage.event import parse_event_time
+
+    tables: list[list[str]] = [[] for _ in range(6)]
+    interns: list[dict[str, int]] = [{} for _ in range(6)]
+
+    def intern(which: int, s: str) -> int:
+        m = interns[which]
+        code = m.get(s)
+        if code is None:
+            code = len(m)
+            m[s] = code
+            tables[which].append(s)
+        return code
+
+    cols = {k: [] for k in ("event", "etype", "eid", "tetype", "teid",
+                            "event_id", "time_us", "rating")}
+    props, span, tombstones = [], [], []
+    epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+    offset = 0
+    for raw_line in buf.split(b"\n"):
+        line = raw_line.strip()
+        if not line:
+            offset += len(raw_line) + 1
+            continue
+        lead = len(raw_line) - len(raw_line.lstrip())
+        start = offset + lead
+        stop = start + len(line)
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise EventParseError(f"{e} at byte {start}") from e
+        offset += len(raw_line) + 1
+        if not isinstance(obj, dict):
+            raise EventParseError(f"expected event object at byte {start}")
+        if "__tombstone__" in obj:
+            tombstones.append(obj["__tombstone__"])
+            continue
+        cols["event"].append(intern(0, obj["event"]) if "event" in obj else -1)
+        cols["etype"].append(intern(1, obj["entityType"]) if "entityType" in obj else -1)
+        cols["eid"].append(intern(2, obj["entityId"]) if "entityId" in obj else -1)
+        tet, tei = obj.get("targetEntityType"), obj.get("targetEntityId")
+        cols["tetype"].append(intern(3, tet) if tet is not None else -1)
+        cols["teid"].append(intern(4, tei) if tei is not None else -1)
+        eid = obj.get("eventId")
+        cols["event_id"].append(intern(5, eid) if eid is not None else -1)
+        t = obj.get("eventTime")
+        if t is None:
+            cols["time_us"].append(np.iinfo(np.int64).min)
+        else:
+            try:
+                dt = parse_event_time(t)
+                cols["time_us"].append(
+                    int(round((dt - epoch).total_seconds() * 1e6))
+                )
+            except Exception:
+                cols["time_us"].append(np.iinfo(np.int64).min)
+        p = obj.get("properties")
+        r = p.get("rating") if isinstance(p, dict) else None
+        if isinstance(r, (int, float)) and not isinstance(r, bool):
+            cols["rating"].append(float(r))
+        elif isinstance(r, str) and "_" not in r:
+            # string-typed numeric rating; "_" excluded to match strtod
+            try:
+                cols["rating"].append(float(r))
+            except ValueError:
+                cols["rating"].append(np.nan)
+        else:
+            cols["rating"].append(np.nan)
+        if isinstance(p, dict):
+            # locate the top-level "properties" key: preceding non-ws byte
+            # must be '{' or ',' (an occurrence inside a string value is
+            # always preceded by a backslash-escaped quote instead)
+            rel = -1
+            search = 0
+            while True:
+                cand = line.find(b'"properties"', search)
+                if cand < 0:
+                    break
+                k = cand - 1
+                while k >= 0 and line[k:k + 1] in b" \t":
+                    k -= 1
+                if k >= 0 and line[k:k + 1] in b"{,":
+                    rel = cand
+                    break
+                search = cand + 1
+            brace = line.index(b"{", rel) if rel >= 0 else -1
+            if brace >= 0:
+                pend = _scan_object_bytes(line, brace)
+                props.append((start + brace, start + pend))
+            else:
+                props.append((-1, -1))
+        else:
+            props.append((-1, -1))
+        span.append((start, stop))
+
+    count = len(cols["event"])
+    return ColumnarEvents(
+        raw=buf,
+        event=np.asarray(cols["event"], np.int32),
+        etype=np.asarray(cols["etype"], np.int32),
+        eid=np.asarray(cols["eid"], np.int32),
+        tetype=np.asarray(cols["tetype"], np.int32),
+        teid=np.asarray(cols["teid"], np.int32),
+        event_id=np.asarray(cols["event_id"], np.int32),
+        time_us=np.asarray(cols["time_us"], np.int64),
+        rating=np.asarray(cols["rating"], np.float32),
+        props=np.asarray(props, np.int64).reshape(count, 2),
+        span=np.asarray(span, np.int64).reshape(count, 2),
+        _tables=tables,
+        tombstones=tombstones,
+    )
+
+
+def parse_events(buf: bytes) -> ColumnarEvents:
+    """Native when possible, Python otherwise."""
+    try:
+        return parse_events_jsonl(buf)
+    except NativeUnavailable:
+        return parse_events_jsonl_py(buf)

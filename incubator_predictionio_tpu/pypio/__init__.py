@@ -17,7 +17,7 @@ from typing import Optional, Sequence
 from ..data.storage.base import AccessKey as _AccessKey
 from ..data.storage.base import App as _App
 from ..data.storage.registry import Storage
-from ..data.store.p_event_store import EventBatch, PEventStore, ratings_matrix
+from ..data.store.p_event_store import EventBatch, PEventStore
 
 _storage: Optional[Storage] = None
 
@@ -45,6 +45,9 @@ def new_app(name: str, access_key: str = "", description: Optional[str] = None):
         raise ValueError(f"App {name!r} already exists")
     s.get_l_events().init(app_id)
     key = s.get_meta_data_access_keys().insert(_AccessKey(access_key, app_id, ()))
+    if key is None:
+        apps.delete(app_id)
+        raise ValueError(f"Access key {access_key!r} already exists")
     return app_id, key
 
 
@@ -102,9 +105,14 @@ def find_events(
     )
 
 
-def find_ratings(app_name: str, event_names: Optional[Sequence[str]] = None):
-    """(user_idx, item_idx, rating, user_map, item_map) COO triple."""
-    return ratings_matrix(find_events(app_name, event_names=event_names))
+def find_ratings(app_name: str, event_names: Optional[Sequence[str]] = None, **kwargs):
+    """(user_idx, item_idx, rating, user_map, item_map) COO triple — the
+    same code path the training workflow uses (columnar fast path on
+    JSONL-backed event stores). kwargs pass through to
+    PEventStore.find_ratings (channel_name, event_default_ratings, ...)."""
+    return PEventStore.find_ratings(
+        app_name, event_names=event_names, storage=_require_storage(), **kwargs
+    )
 
 
 def train(engine_dir: str, variant: Optional[str] = None) -> str:

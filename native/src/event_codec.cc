@@ -1,0 +1,595 @@
+// libpioevent — native event-log codec: JSONL → columnar arrays.
+//
+// Plays the role the HBase client + Spark TableInputFormat scan play in the
+// reference (storage/hbase/.../HBPEvents.scala: the bulk "RDD[Event]" read
+// path): a scan-optimized event store of record. Here the store is an
+// append-only JSONL log and the scan is this parser, which decodes event
+// JSON straight into interned id codes + timestamps + ratings — the exact
+// columnar layout the TPU input pipeline uploads — without materializing
+// per-event Python objects.
+//
+// C ABI (ctypes-friendly); no external dependencies; C++17.
+//
+// Record layout produced per event:
+//   event/etype/eid/tetype/teid : int32 codes into interned string tables
+//                                 (tetype/teid = -1 when absent)
+//   time_us                     : int64 epoch microseconds (INT64_MIN absent)
+//   rating                      : float32 properties.rating (NaN absent)
+//   props[2n]                   : byte offsets [start,end) of the raw
+//                                 properties JSON object (-1,-1 absent)
+//   span[2n]                    : byte offsets [start,end) of the whole
+//                                 event object (lazy single-event reparse)
+//   event_id                    : int32 code into table 5 (-1 absent)
+//
+// Tombstone records {"__tombstone__": "<eventId>"} are collected separately
+// (append-only deletes; the Python side filters them out of scans).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Interner {
+  std::unordered_map<std::string, int32_t> map;
+  std::vector<std::string> table;
+
+  int32_t intern(std::string&& s) {
+    auto it = map.find(s);
+    if (it != map.end()) return it->second;
+    int32_t id = static_cast<int32_t>(table.size());
+    map.emplace(s, id);
+    table.push_back(std::move(s));
+    return id;
+  }
+};
+
+constexpr int kNumTables = 6;  // event, etype, eid, tetype, teid, eventId
+
+struct Columns {
+  std::vector<int32_t> event, etype, eid, tetype, teid, event_id;
+  std::vector<int64_t> time_us;
+  std::vector<float> rating;
+  std::vector<int64_t> props;  // 2n offsets
+  std::vector<int64_t> span;   // 2n offsets
+  Interner tables[kNumTables];
+  std::vector<std::string> tombstones;
+};
+
+struct Parser {
+  const char* base;
+  const char* p;
+  const char* end;
+  std::string err;
+  int64_t n_records = 0;
+
+  explicit Parser(const char* buf, int64_t len)
+      : base(buf), p(buf), end(buf + len) {}
+
+  bool fail(const char* msg) {
+    if (err.empty()) {
+      char tmp[160];
+      snprintf(tmp, sizeof tmp, "%s at byte %lld (record %lld)", msg,
+               static_cast<long long>(p - base),
+               static_cast<long long>(n_records));
+      err = tmp;
+    }
+    return false;
+  }
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool at_end() {
+    ws();
+    return p >= end;
+  }
+
+  // Decode a JSON string (cursor on opening quote) into out.
+  bool parse_string(std::string& out) {
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    out.clear();
+    while (p < end) {
+      unsigned char c = static_cast<unsigned char>(*p);
+      if (c == '"') {
+        ++p;
+        return true;
+      }
+      if (c == '\\') {
+        if (p + 1 >= end) return fail("bad escape");
+        ++p;
+        switch (*p) {
+          case '"': out += '"'; ++p; break;
+          case '\\': out += '\\'; ++p; break;
+          case '/': out += '/'; ++p; break;
+          case 'b': out += '\b'; ++p; break;
+          case 'f': out += '\f'; ++p; break;
+          case 'n': out += '\n'; ++p; break;
+          case 'r': out += '\r'; ++p; break;
+          case 't': out += '\t'; ++p; break;
+          case 'u': {
+            ++p;
+            unsigned cp;
+            if (!hex4(cp)) return false;
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // high surrogate
+              if (p + 1 < end && p[0] == '\\' && p[1] == 'u') {
+                p += 2;
+                unsigned lo;
+                if (!hex4(lo)) return false;
+                if (lo >= 0xDC00 && lo <= 0xDFFF)
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                else
+                  cp = 0xFFFD;
+              } else {
+                cp = 0xFFFD;
+              }
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              cp = 0xFFFD;
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default:
+            return fail("bad escape");
+        }
+      } else {
+        out += static_cast<char>(c);
+        ++p;
+      }
+    }
+    return fail("unterminated string");
+  }
+
+  bool hex4(unsigned& out) {
+    if (p + 4 > end) return fail("bad \\u escape");
+    out = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = *p++;
+      out <<= 4;
+      if (c >= '0' && c <= '9') out |= c - '0';
+      else if (c >= 'a' && c <= 'f') out |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') out |= c - 'A' + 10;
+      else return fail("bad \\u escape");
+    }
+    return true;
+  }
+
+  static void append_utf8(std::string& out, unsigned cp) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  bool skip_string() {
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    while (p < end) {
+      if (*p == '\\') {
+        p += 2;
+        continue;
+      }
+      if (*p == '"') {
+        ++p;
+        return true;
+      }
+      ++p;
+    }
+    return fail("unterminated string");
+  }
+
+  bool parse_number(double& out) {
+    char* q = nullptr;
+    out = strtod(p, &q);
+    if (q == p) return fail("bad number");
+    p = q;
+    return true;
+  }
+
+  bool skip_value() {
+    ws();
+    if (p >= end) return fail("unexpected end");
+    switch (*p) {
+      case '"':
+        return skip_string();
+      case '{': {
+        ++p;
+        ws();
+        if (p < end && *p == '}') { ++p; return true; }
+        while (true) {
+          ws();
+          if (!skip_string()) return false;
+          ws();
+          if (p >= end || *p != ':') return fail("expected ':'");
+          ++p;
+          if (!skip_value()) return false;
+          ws();
+          if (p < end && *p == ',') { ++p; continue; }
+          if (p < end && *p == '}') { ++p; return true; }
+          return fail("expected ',' or '}'");
+        }
+      }
+      case '[': {
+        ++p;
+        ws();
+        if (p < end && *p == ']') { ++p; return true; }
+        while (true) {
+          if (!skip_value()) return false;
+          ws();
+          if (p < end && *p == ',') { ++p; continue; }
+          if (p < end && *p == ']') { ++p; return true; }
+          return fail("expected ',' or ']'");
+        }
+      }
+      case 't':
+        if (end - p >= 4 && !memcmp(p, "true", 4)) { p += 4; return true; }
+        return fail("bad literal");
+      case 'f':
+        if (end - p >= 5 && !memcmp(p, "false", 5)) { p += 5; return true; }
+        return fail("bad literal");
+      case 'n':
+        if (end - p >= 4 && !memcmp(p, "null", 4)) { p += 4; return true; }
+        return fail("bad literal");
+      default: {
+        double d;
+        return parse_number(d);
+      }
+    }
+  }
+
+  // properties object: record raw span, extract top-level numeric "rating".
+  bool parse_properties(int64_t& start, int64_t& stop, float& rating) {
+    ws();
+    if (p >= end) return fail("unexpected end");
+    if (*p == 'n') {  // null
+      if (end - p >= 4 && !memcmp(p, "null", 4)) {
+        p += 4;
+        start = stop = -1;
+        return true;
+      }
+      return fail("bad literal");
+    }
+    if (*p != '{') return fail("properties must be an object");
+    start = p - base;
+    ++p;
+    ws();
+    if (p < end && *p == '}') {
+      ++p;
+      stop = p - base;
+      return true;
+    }
+    std::string key;
+    while (true) {
+      ws();
+      if (!parse_string(key)) return false;
+      ws();
+      if (p >= end || *p != ':') return fail("expected ':'");
+      ++p;
+      ws();
+      bool is_num = p < end && (*p == '-' || (*p >= '0' && *p <= '9'));
+      if (key == "rating" && is_num) {
+        double d;
+        if (!parse_number(d)) return false;
+        rating = static_cast<float>(d);
+      } else if (key == "rating" && p < end && *p == '"') {
+        // string-typed numeric rating (some SDK exports): coerce like the
+        // row path's float() — full-string parse or stays absent
+        std::string sval2;
+        if (!parse_string(sval2)) return false;
+        const char* b = sval2.c_str();
+        char* e2 = nullptr;
+        double d = strtod(b, &e2);
+        while (e2 && isspace(static_cast<unsigned char>(*e2))) ++e2;
+        if (e2 && e2 != b && *e2 == '\0') rating = static_cast<float>(d);
+      } else {
+        if (!skip_value()) return false;
+      }
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') {
+        ++p;
+        stop = p - base;
+        return true;
+      }
+      return fail("expected ',' or '}'");
+    }
+  }
+
+  // ISO-8601 → epoch micros; INT64_MIN on parse failure.
+  static int64_t parse_iso8601(const std::string& s) {
+    const char* q = s.c_str();
+    const char* qe = q + s.size();
+    auto digits = [&](int n, long& out) -> bool {
+      out = 0;
+      for (int i = 0; i < n; ++i) {
+        if (q >= qe || *q < '0' || *q > '9') return false;
+        out = out * 10 + (*q++ - '0');
+      }
+      return true;
+    };
+    long Y, M, D, h = 0, m = 0;
+    double sec = 0.0;
+    if (!digits(4, Y)) return INT64_MIN;
+    if (q >= qe || *q != '-') return INT64_MIN;
+    ++q;
+    if (!digits(2, M)) return INT64_MIN;
+    if (q >= qe || *q != '-') return INT64_MIN;
+    ++q;
+    if (!digits(2, D)) return INT64_MIN;
+    if (q < qe && (*q == 'T' || *q == ' ')) {
+      ++q;
+      if (!digits(2, h)) return INT64_MIN;
+      if (q >= qe || *q != ':') return INT64_MIN;
+      ++q;
+      if (!digits(2, m)) return INT64_MIN;
+      if (q < qe && *q == ':') {
+        ++q;
+        long ss;
+        if (!digits(2, ss)) return INT64_MIN;
+        sec = static_cast<double>(ss);
+        if (q < qe && *q == '.') {
+          ++q;
+          double scale = 0.1;
+          while (q < qe && *q >= '0' && *q <= '9') {
+            sec += (*q++ - '0') * scale;
+            scale *= 0.1;
+          }
+        }
+      }
+    }
+    long off_sec = 0;
+    if (q < qe) {
+      if (*q == 'Z') {
+        ++q;
+      } else if (*q == '+' || *q == '-') {
+        int sign = (*q == '-') ? -1 : 1;
+        ++q;
+        long oh, om = 0;
+        if (!digits(2, oh)) return INT64_MIN;
+        if (q < qe && *q == ':') ++q;
+        if (q < qe && *q >= '0' && *q <= '9') {
+          if (!digits(2, om)) return INT64_MIN;
+        }
+        off_sec = sign * (oh * 3600 + om * 60);
+      } else {
+        return INT64_MIN;
+      }
+    }
+    if (q != qe) return INT64_MIN;
+    if (M < 1 || M > 12 || D < 1 || D > 31) return INT64_MIN;
+    // days-from-civil (Howard Hinnant's algorithm, public domain)
+    long y = Y - (M <= 2);
+    long era = (y >= 0 ? y : y - 399) / 400;
+    unsigned long yoe = static_cast<unsigned long>(y - era * 400);
+    unsigned long doy = (153 * (M + (M > 2 ? -3 : 9)) + 2) / 5 + D - 1;
+    unsigned long doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    int64_t days = era * 146097 + static_cast<int64_t>(doe) - 719468;
+    // integral seconds exact in int64; only the fraction goes through double
+    int64_t whole = days * 86400 + h * 3600 + m * 60 - off_sec;
+    return whole * 1000000 + static_cast<int64_t>(llround(sec * 1e6));
+  }
+
+  bool parse_event(Columns& c) {
+    ws();
+    if (p >= end || *p != '{') return fail("expected event object");
+    int64_t rec_start = p - base;
+    ++p;
+    std::string key, sval;
+    int32_t ev = -1, et = -1, ei = -1, tet = -1, tei = -1, eid_code = -1;
+    int64_t t_us = INT64_MIN;
+    float rating = NAN;
+    int64_t pstart = -1, pstop = -1;
+    bool tombstone = false;
+    std::string tomb_id;
+
+    ws();
+    bool first = true;
+    if (p < end && *p == '}') {
+      ++p;
+    } else {
+      while (true) {
+        ws();
+        if (!parse_string(key)) return false;
+        ws();
+        if (p >= end || *p != ':') return fail("expected ':'");
+        ++p;
+        ws();
+        if (key == "event") {
+          if (!parse_string(sval)) return false;
+          ev = c.tables[0].intern(std::move(sval));
+        } else if (key == "entityType") {
+          if (!parse_string(sval)) return false;
+          et = c.tables[1].intern(std::move(sval));
+        } else if (key == "entityId") {
+          if (!parse_string(sval)) return false;
+          ei = c.tables[2].intern(std::move(sval));
+        } else if (key == "targetEntityType") {
+          if (p < end && *p == 'n') {
+            if (!skip_value()) return false;
+          } else {
+            if (!parse_string(sval)) return false;
+            tet = c.tables[3].intern(std::move(sval));
+          }
+        } else if (key == "targetEntityId") {
+          if (p < end && *p == 'n') {
+            if (!skip_value()) return false;
+          } else {
+            if (!parse_string(sval)) return false;
+            tei = c.tables[4].intern(std::move(sval));
+          }
+        } else if (key == "eventId") {
+          if (!parse_string(sval)) return false;
+          eid_code = c.tables[5].intern(std::move(sval));
+        } else if (key == "eventTime") {
+          if (!parse_string(sval)) return false;
+          t_us = parse_iso8601(sval);
+        } else if (key == "properties") {
+          if (!parse_properties(pstart, pstop, rating)) return false;
+        } else if (key == "__tombstone__") {
+          if (!parse_string(sval)) return false;
+          tombstone = true;
+          tomb_id = sval;
+        } else {
+          if (!skip_value()) return false;  // prId, creationTime, unknown
+        }
+        first = false;
+        ws();
+        if (p < end && *p == ',') { ++p; continue; }
+        if (p < end && *p == '}') { ++p; break; }
+        return fail("expected ',' or '}'");
+      }
+    }
+    (void)first;
+    int64_t rec_stop = p - base;
+    ++n_records;
+    if (tombstone) {
+      c.tombstones.push_back(std::move(tomb_id));
+      return true;
+    }
+    c.event.push_back(ev);
+    c.etype.push_back(et);
+    c.eid.push_back(ei);
+    c.tetype.push_back(tet);
+    c.teid.push_back(tei);
+    c.event_id.push_back(eid_code);
+    c.time_us.push_back(t_us);
+    c.rating.push_back(rating);
+    c.props.push_back(pstart);
+    c.props.push_back(pstop);
+    c.span.push_back(rec_start);
+    c.span.push_back(rec_stop);
+    return true;
+  }
+};
+
+struct Handle {
+  Columns cols;
+  std::string err;
+  // lazily materialized bulk exports (one ctypes call per table instead of
+  // one per string)
+  std::string table_blob[kNumTables];
+  std::vector<int64_t> table_offsets[kNumTables];
+  bool table_packed[kNumTables] = {};
+
+  void pack(int which) {
+    if (table_packed[which]) return;
+    auto& t = cols.tables[which].table;
+    auto& blob = table_blob[which];
+    auto& offs = table_offsets[which];
+    size_t total = 0;
+    for (auto& s : t) total += s.size();
+    blob.reserve(total);
+    offs.reserve(t.size() + 1);
+    offs.push_back(0);
+    for (auto& s : t) {
+      blob += s;
+      offs.push_back(static_cast<int64_t>(blob.size()));
+    }
+    table_packed[which] = true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Bump when the ABI or semantics change — the Python wrapper rebuilds the
+// cached .so when this does not match its expected version.
+int32_t pio_codec_version() { return 5; }
+
+void* pio_parse_events_jsonl(const char* buf, int64_t len, char* errbuf,
+                             int64_t errcap) {
+  auto* h = new Handle();
+  Parser parser(buf, len);
+  while (!parser.at_end()) {
+    if (!parser.parse_event(h->cols)) {
+      if (errbuf && errcap > 0) {
+        snprintf(errbuf, static_cast<size_t>(errcap), "%s",
+                 parser.err.c_str());
+      }
+      delete h;
+      return nullptr;
+    }
+  }
+  return h;
+}
+
+static Handle* H(void* h) { return static_cast<Handle*>(h); }
+
+int64_t pio_col_count(void* h) {
+  return static_cast<int64_t>(H(h)->cols.event.size());
+}
+const int32_t* pio_col_event(void* h) { return H(h)->cols.event.data(); }
+const int32_t* pio_col_etype(void* h) { return H(h)->cols.etype.data(); }
+const int32_t* pio_col_eid(void* h) { return H(h)->cols.eid.data(); }
+const int32_t* pio_col_tetype(void* h) { return H(h)->cols.tetype.data(); }
+const int32_t* pio_col_teid(void* h) { return H(h)->cols.teid.data(); }
+const int32_t* pio_col_event_id(void* h) { return H(h)->cols.event_id.data(); }
+const int64_t* pio_col_time_us(void* h) { return H(h)->cols.time_us.data(); }
+const float* pio_col_rating(void* h) { return H(h)->cols.rating.data(); }
+const int64_t* pio_col_props(void* h) { return H(h)->cols.props.data(); }
+const int64_t* pio_col_span(void* h) { return H(h)->cols.span.data(); }
+
+int32_t pio_table_size(void* h, int32_t which) {
+  if (which < 0 || which >= kNumTables) return -1;
+  return static_cast<int32_t>(H(h)->cols.tables[which].table.size());
+}
+
+const char* pio_table_get(void* h, int32_t which, int32_t idx,
+                          int32_t* len_out) {
+  if (which < 0 || which >= kNumTables) return nullptr;
+  auto& t = H(h)->cols.tables[which].table;
+  if (idx < 0 || static_cast<size_t>(idx) >= t.size()) return nullptr;
+  if (len_out) *len_out = static_cast<int32_t>(t[idx].size());
+  return t[idx].data();
+}
+
+// Bulk table export: concatenated UTF-8 strings + (size+1) end offsets.
+const char* pio_table_blob(void* h, int32_t which, int64_t* blob_len) {
+  if (which < 0 || which >= kNumTables) return nullptr;
+  Handle* hh = H(h);
+  hh->pack(which);
+  if (blob_len) *blob_len = static_cast<int64_t>(hh->table_blob[which].size());
+  return hh->table_blob[which].data();
+}
+
+const int64_t* pio_table_offsets(void* h, int32_t which) {
+  if (which < 0 || which >= kNumTables) return nullptr;
+  Handle* hh = H(h);
+  hh->pack(which);
+  return hh->table_offsets[which].data();
+}
+
+int64_t pio_tombstone_count(void* h) {
+  return static_cast<int64_t>(H(h)->cols.tombstones.size());
+}
+
+const char* pio_tombstone_get(void* h, int64_t idx, int32_t* len_out) {
+  auto& t = H(h)->cols.tombstones;
+  if (idx < 0 || static_cast<size_t>(idx) >= t.size()) return nullptr;
+  if (len_out) *len_out = static_cast<int32_t>(t[idx].size());
+  return t[idx].data();
+}
+
+void pio_free(void* h) { delete H(h); }
+
+}  // extern "C"

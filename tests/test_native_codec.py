@@ -1,0 +1,201 @@
+"""Native event codec + JSONL backend fast path.
+
+The C++ parser (native/src/event_codec.cc) must agree bit-for-bit with the
+pure-Python oracle, and PEventStore.find_ratings must give the same
+training triples through the columnar fast path (JSONL backend) as through
+the row-based slow path (memory backend)."""
+
+import datetime as dt
+import json
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu import native
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+from incubator_predictionio_tpu.data.storage.event import Event
+from incubator_predictionio_tpu.data.store.p_event_store import PEventStore
+
+EVENTS = [
+    {"event": "rate", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "item", "targetEntityId": "i1",
+     "properties": {"rating": 4.5, "note": 'café "q" \\ slash'},
+     "eventTime": "2014-09-09T16:17:42.937-08:00", "eventId": "e1"},
+    {"event": "$set", "entityType": "user", "entityId": "u2",
+     "properties": {"age": 3, "tags": ["a", "b"], "nested": {"x": 1}},
+     "eventTime": "2024-01-01T00:00:00Z", "eventId": "e2"},
+    {"event": "view", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "item", "targetEntityId": "i2",
+     "eventTime": "2024-02-29T12:00:00.5+05:30", "eventId": "e3"},
+    {"__tombstone__": "e1"},
+    {"event": "buy", "entityType": "user", "entityId": "emoji \U0001f600",
+     "targetEntityType": "item", "targetEntityId": "i1",
+     "properties": {"rating": 2}, "eventTime": "1999-12-31T23:59:59.999999Z",
+     "eventId": "e4"},
+]
+BUF = ("\n".join(json.dumps(e) for e in EVENTS) + "\n").encode()
+
+
+def _columns_equal(a, b):
+    for f in ("event", "etype", "eid", "tetype", "teid", "event_id",
+              "time_us", "props", "span"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+    assert np.array_equal(np.isnan(a.rating), np.isnan(b.rating))
+    assert np.allclose(np.nan_to_num(a.rating), np.nan_to_num(b.rating))
+    assert a.tables == b.tables
+    assert a.tombstones == b.tombstones
+
+
+def test_python_oracle_semantics():
+    c = native.parse_events_jsonl_py(BUF)
+    assert len(c) == 4
+    assert c.tombstones == ["e1"]
+    expect = int(dt.datetime(
+        2014, 9, 9, 16, 17, 42, 937000,
+        tzinfo=dt.timezone(dt.timedelta(hours=-8))).timestamp() * 1e6)
+    assert c.time_us[0] == expect
+    assert c.properties_dict(0)["note"] == 'café "q" \\ slash'
+    assert c.record_dict(3)["entityId"] == "emoji \U0001f600"
+    assert np.isnan(c.rating[1]) and c.rating[3] == 2.0
+    assert c.properties_dict(2) == {}  # no properties key
+
+
+def test_native_matches_oracle():
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    _columns_equal(native.parse_events_jsonl(BUF), native.parse_events_jsonl_py(BUF))
+
+
+def test_native_matches_oracle_fuzz():
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    import random
+
+    random.seed(42)
+    rows = []
+    for n in range(500):
+        e = {
+            "event": random.choice(["rate", "buy", "$set", "über-event"]),
+            "entityType": "user",
+            "entityId": "u%d" % random.randrange(50),
+            "eventTime": "20%02d-%02d-%02dT%02d:%02d:%02d.%03dZ" % (
+                random.randrange(100), random.randrange(1, 13),
+                random.randrange(1, 28), random.randrange(24),
+                random.randrange(60), random.randrange(60),
+                random.randrange(1000)),
+            "eventId": "id%d" % n,
+        }
+        if random.random() < 0.7:
+            e["targetEntityType"] = "item"
+            e["targetEntityId"] = "i%d" % random.randrange(30)
+        if random.random() < 0.6:
+            e["properties"] = {"rating": random.choice(
+                [1, 2.5, -3, 1e10, 0.1]),
+                "s": random.choice(["plain", 'esc"\\', "unié€"])}
+        if random.random() < 0.05:
+            e = {"__tombstone__": "id%d" % random.randrange(max(n, 1))}
+        rows.append(json.dumps(e, ensure_ascii=random.random() < 0.5))
+    buf = ("\n".join(rows) + "\n").encode()
+    _columns_equal(native.parse_events_jsonl(buf),
+                   native.parse_events_jsonl_py(buf))
+
+
+def test_native_parse_error():
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    with pytest.raises(native.EventParseError):
+        native.parse_events_jsonl(b'{"event": "x", \n')
+
+
+def _storage(kind, tmp_path):
+    if kind == "jsonl":
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+            "PIO_STORAGE_SOURCES_M_TYPE": "MEMORY",
+            "PIO_STORAGE_SOURCES_LOG_TYPE": "JSONL",
+            "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "events"),
+        }
+    else:
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+            "PIO_STORAGE_SOURCES_M_TYPE": "MEMORY",
+        }
+    return Storage(env)
+
+
+def _seed_app(s, ratings):
+    app_id = s.get_meta_data_apps().insert(App(0, "fastpath", None))
+    s.get_l_events().init(app_id)
+    s.get_meta_data_access_keys().insert(AccessKey("K", app_id, ()))
+    events = []
+    for n, (u, i, r) in enumerate(ratings):
+        props = {"rating": r} if r is not None else {}
+        obj = {
+            "event": "rate" if r is not None else "buy",
+            "entityType": "user", "entityId": u,
+            "properties": props,
+            "eventTime": "2024-01-%02dT00:00:00Z" % (1 + n % 28),
+        }
+        if i is not None:
+            obj["targetEntityType"] = "item"
+            obj["targetEntityId"] = i
+        events.append(Event.from_json(obj))
+    s.get_l_events().insert_batch(events, app_id)
+    return app_id
+
+
+def test_find_ratings_fast_equals_slow(tmp_path):
+    import random
+
+    random.seed(7)
+    ratings = [("u%d" % random.randrange(20), "i%d" % random.randrange(10),
+                random.choice([None, 1.0, 2.0, 5.0, "3.5"])) for _ in range(200)]
+    # a user whose only event has no target: must still get a BiMap slot
+    ratings.append(("u_lonely", None, 2.0))
+    out = {}
+    for kind in ("memory", "jsonl"):
+        s = _storage(kind, tmp_path)
+        _seed_app(s, ratings)
+        u, i, r, users, items = PEventStore.find_ratings(
+            "fastpath", event_names=["rate", "buy"],
+            event_default_ratings={"buy": 4.0}, storage=s,
+        )
+        triples = [
+            (users.inverse(int(a)), items.inverse(int(b)), float(c))
+            for a, b, c in zip(u, i, r)
+        ]
+        out[kind] = (sorted(triples), users.to_dict(), items.to_dict())
+        s.close()
+    # identical triples AND identical BiMap membership + index assignment
+    assert out["memory"] == out["jsonl"]
+    assert len(out["jsonl"][0]) == 200
+    assert "u_lonely" in out["jsonl"][1]
+
+
+def test_jsonl_delete_and_dedupe(tmp_path):
+    s = _storage("jsonl", tmp_path)
+    app_id = _seed_app(s, [("u1", "i1", 5.0), ("u2", "i2", 3.0)])
+    le = s.get_l_events()
+    events = list(le.find(app_id))
+    assert len(events) == 2
+    # delete via tombstone append
+    assert le.delete(events[0].event_id, app_id)
+    assert le.get(events[0].event_id, app_id) is None
+    assert len(list(le.find(app_id))) == 1
+    # client-supplied id overwrite: same eventId, new rating wins
+    e = events[1]
+    updated = Event.from_json({**e.to_json(), "properties": {"rating": 1.0}})
+    le.insert(updated, app_id)
+    got = le.get(e.event_id, app_id)
+    assert got.properties.get("rating") == 1.0
+    assert len(list(le.find(app_id))) == 1
+    # compaction drops tombstones and stale duplicates
+    live = le.compact(app_id)
+    assert live == 1
+    assert len(list(le.find(app_id))) == 1
+    s.close()

@@ -35,6 +35,16 @@ def _make_storage(kind, tmp_path):
             "PIO_STORAGE_SOURCES_S_TYPE": "SQLITE",
             "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / f"{kind}.sqlite"),
         }
+    elif kind == "jsonl":  # metadata/models sqlite, events JSONL log
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "LOG",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+            "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+            "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "meta.sqlite"),
+            "PIO_STORAGE_SOURCES_LOG_TYPE": "JSONL",
+            "PIO_STORAGE_SOURCES_LOG_PATH": str(tmp_path / "events"),
+        }
     elif kind == "mixed":  # metadata+events sqlite, models localfs
         env = {
             "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
@@ -48,7 +58,7 @@ def _make_storage(kind, tmp_path):
     return Storage(env)
 
 
-BACKENDS = ["memory", "sqlite", "mixed"]
+BACKENDS = ["memory", "sqlite", "mixed", "jsonl"]
 
 
 @pytest.fixture(params=BACKENDS)
