@@ -121,10 +121,14 @@ def main() -> int:
     params = ALSParams(
         rank=rank, num_iterations=iters, reg=0.01, block_len=32,
         compute_dtype="bfloat16" if jax.default_backend() != "cpu" else "float32",
-        chunk_tiles=65536 if scale == "ml20m" else 0,
+        chunk_tiles=int(os.environ.get("PIO_BENCH_CHUNK", "2048")) if scale == "ml20m" else 0,
     )
-    by_user = shard_blocked(build_blocked(u, i, r, n_users, params.block_len), n_dev)
-    by_item = shard_blocked(build_blocked(i, u, r, n_items, params.block_len), n_dev)
+    pad_items = -(-n_items // n_dev) * n_dev
+    pad_users = -(-n_users // n_dev) * n_dev
+    by_user = shard_blocked(
+        build_blocked(u, i, r, n_users, params.block_len, pad_col=pad_items), n_dev)
+    by_item = shard_blocked(
+        build_blocked(i, u, r, n_items, params.block_len, pad_col=pad_users), n_dev)
     log(f"[bench] host prep {time.time()-t0:.1f}s "
         f"(user tiles {by_user.col.shape}, item tiles {by_item.col.shape})")
 
@@ -136,8 +140,8 @@ def main() -> int:
     args = (
         np.int32(iters),
         x0, y0,
-        by_user.col, by_user.val, by_user.mask, by_user.local_row, by_user.counts,
-        by_item.col, by_item.val, by_item.mask, by_item.local_row, by_item.counts,
+        by_user.col, by_user.val, by_user.local_row, by_user.counts,
+        by_item.col, by_item.val, by_item.local_row, by_item.counts,
     )
     t0 = time.time()
     args_dev = jax.device_put(args)
@@ -148,10 +152,18 @@ def main() -> int:
     compiled = fn.lower(*args_dev).compile()
     log(f"[bench] compile {time.time()-t0:.1f}s")
 
-    # timed steady-state run
+    # Warm-up dispatch (n_iters is a traced arg: same executable, 0 work)
+    warm = compiled(np.int32(0), *args_dev[1:])
+    _ = jax.device_get(warm[0][:1, :1])
+
+    # Timed steady-state run. block_until_ready alone is NOT trusted as a
+    # completion barrier here: through the remote-PJRT tunnel it can return
+    # before the device finishes. Fetching a scalar slice of the result is
+    # a hard data dependency — the transfer cannot start until the whole
+    # loop has executed — and its 4-byte payload adds only a round-trip.
     t0 = time.time()
     out = compiled(*args_dev)
-    jax.block_until_ready(out)
+    _ = jax.device_get(out[0][:1, :1])
     train_time = time.time() - t0
     # per-chip: the unit is events/sec/chip, so divide aggregate by devices
     events_per_sec = nnz / train_time / n_dev

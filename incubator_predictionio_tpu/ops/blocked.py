@@ -39,6 +39,9 @@ class BlockedRows:
     block_row: np.ndarray
     n_rows: int
     counts: np.ndarray
+    # Column index stored in padding slots. 0 by default; ops/als.py points
+    # it at a sentinel zero-factor row so the device loop needs no mask.
+    pad_col: int = 0
 
     @property
     def n_blocks(self) -> int:
@@ -55,6 +58,7 @@ def build_blocked(
     val: np.ndarray,
     n_rows: int,
     block_len: int = 32,
+    pad_col: int = 0,
 ) -> BlockedRows:
     """Tile a COO triple by row. O(nnz log nnz) host time, vectorized."""
     row = np.asarray(row, dtype=np.int64)
@@ -81,7 +85,7 @@ def build_blocked(
     entry_block = block_offset[row_s] + pos_in_row // L
     entry_slot = pos_in_row % L
 
-    col_b = np.zeros((n_blocks, L), dtype=np.int32)
+    col_b = np.full((n_blocks, L), pad_col, dtype=np.int32)
     val_b = np.zeros((n_blocks, L), dtype=np.float32)
     mask_b = np.zeros((n_blocks, L), dtype=np.float32)
     flat = entry_block * L + entry_slot
@@ -97,7 +101,7 @@ def build_blocked(
 
     return BlockedRows(
         col=col_b, val=val_b, mask=mask_b, block_row=block_row,
-        n_rows=n_rows, counts=counts.astype(np.int32),
+        n_rows=n_rows, counts=counts.astype(np.int32), pad_col=pad_col,
     )
 
 
@@ -142,7 +146,7 @@ def shard_blocked(blocked: BlockedRows, n_shards: int) -> ShardedBlocked:
     Bs = max(int(per_shard.max()), 1)
 
     L = blocked.block_len
-    col_p = np.zeros((S, Bs, L), dtype=np.int32)
+    col_p = np.full((S, Bs, L), blocked.pad_col, dtype=np.int32)
     val_p = np.zeros((S, Bs, L), dtype=np.float32)
     mask_p = np.zeros((S, Bs, L), dtype=np.float32)
     lrow_p = np.zeros((S, Bs), dtype=np.int32)

@@ -1,0 +1,160 @@
+"""Pallas TPU kernels for the ALS hot loop.
+
+Profiling the ml20m half-step on a v5e chip (see bench.py) shows XLA's
+batched ``cholesky`` + ``cho_solve`` of the [n_rows, k, k] normal equations
+dominating the iteration (~575 ms for 138k rank-32 systems — the solver
+lowering is latency-bound on small matrices). The MXU/VPU-friendly
+replacement here solves all systems with one VMEM-resident Gauss-Jordan
+sweep:
+
+- The batch lives on the *lane* dimension: matrices are transposed to
+  [k, k, N] so every elimination step is a [k, C]-shaped vector op across
+  C systems at full lane width (C a multiple of 128).
+- Each grid step copies a C-wide slab into VMEM scratch and runs the
+  k-step elimination entirely on-chip — HBM traffic is exactly one read
+  of A/b and one write of x (the XLA formulation re-streams the whole
+  [N, k, k] array every elimination step).
+- No pivoting: every system is SPD by construction (normal equations
+  plus a λ·I ridge — ops/als.py adds 1e-6 even for empty rows).
+
+The reference has no analog: its solves happen inside MLlib's
+``CholeskyDecomposition.solve`` on the Spark executors (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _gauss_jordan_kernel(a_ref, b_ref, x_ref, a_s, b_s, *, k: int):
+    """Solve C systems: a_ref [k, k, C], b_ref [k, C] → x_ref [k, C].
+
+    a_s/b_s are VMEM scratch copies mutated in place by the elimination.
+    """
+    from jax.experimental import pallas as pl
+
+    a_s[...] = a_ref[...]
+    b_s[...] = b_ref[...]
+
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)  # [k, 1]
+
+    def step(j, _):
+        # Dynamic slicing happens on the refs (Mosaic lowers pl.ds ref
+        # indexing; dynamic_slice on values is not implemented).
+        rowj_raw = a_s[pl.ds(j, 1), :, :][0]                # [k, C]
+        piv = a_s[pl.ds(j, 1), pl.ds(j, 1), :][0]           # [1, C] a[j,j]
+        inv = 1.0 / piv                                     # [1, C]
+        rowj = rowj_raw * inv                               # [k, C]
+        bj = b_s[pl.ds(j, 1), :] * inv                      # [1, C]
+
+        f = a_s[:, pl.ds(j, 1), :][:, 0, :]                 # [k, C] column j
+        # Keep row j out of its own elimination (it is replaced below).
+        f = jnp.where(row_ids == j, 0.0, f)
+
+        # One masked store per ref per step: row j becomes the normalized
+        # pivot row / rhs, every other row is eliminated. (A dynamic row
+        # store after the full-block store miscompiled under Mosaic.)
+        is_j = row_ids == j                                  # [k, 1]
+        new_a = a_s[...] - f[:, None, :] * rowj[None, :, :]
+        a_s[...] = jnp.where(is_j[:, :, None], rowj[None, :, :], new_a)
+        new_b = b_s[...] - f * bj
+        b_s[...] = jnp.where(is_j, jnp.broadcast_to(bj, new_b.shape), new_b)
+        return 0
+
+    jax.lax.fori_loop(0, k, step, 0)
+    x_ref[...] = b_s[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "vma"))
+def _solve_lanes(a_t, b_t, *, interpret: bool = False, vma=None):
+    """a_t [k, k, Np], b_t [k, Np] (Np multiple of 128) → x_t [k, Np].
+
+    ``vma``: when called inside ``shard_map`` (check_vma=True), the mesh
+    axes the output varies over — forwarded to the out_shape aval.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, _, n = a_t.shape
+    if vma is not None:
+        out_shape = jax.ShapeDtypeStruct((k, n), jnp.float32, vma=vma)
+    else:
+        out_shape = jax.ShapeDtypeStruct((k, n), jnp.float32)
+    # Slab width: full lane utilization, capped so a f32 [k, k, C] slab
+    # (plus its scratch copy and double buffering) stays well under VMEM.
+    c = 512 if k <= 32 else (256 if k <= 48 else 128)
+    c = min(c, n)
+    grid = (n // c,)
+
+    kernel = functools.partial(_gauss_jordan_kernel, k=k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((k, k, c), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, c), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, c), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((k, k, c), jnp.float32),
+            pltpu.VMEM((k, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(a_t, b_t)
+
+
+def _solve_reference(a, b):
+    """XLA fallback: batched Cholesky solve (CPU and rank > 64)."""
+    chol = jnp.linalg.cholesky(a)
+    return jax.scipy.linalg.cho_solve((chol, True), b[..., None])[..., 0]
+
+
+def batched_spd_solve(a, b, *, use_pallas: bool | None = None,
+                      interpret: bool = False, vma=None):
+    """Solve N independent SPD systems a[i] @ x[i] = b[i].
+
+    a: [N, k, k] float32, b: [N, k] float32 → x [N, k] float32.
+
+    ``use_pallas=None`` auto-selects: the Pallas kernel on TPU for
+    k ≤ 64, the XLA Cholesky path otherwise. Traceable (jit/shard_map
+    safe): all shape logic is static.
+    """
+    n, k = b.shape
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and k <= 64
+    if not use_pallas:
+        return _solve_reference(a, b)
+
+    kp = _round_up(k, 8)
+    # Multiple of 512 so every slab width (512/256/128) divides the batch.
+    npad = _round_up(max(n, 1), 512)
+    a = a.astype(jnp.float32)
+    b = b.astype(jnp.float32)
+    if kp != k:
+        # Pad with identity diagonal: padded coords solve to x=0 and do
+        # not couple to the real ones.
+        eye_pad = jnp.eye(kp, dtype=jnp.float32)[k:]  # [kp-k, kp]
+        a = jnp.pad(a, ((0, 0), (0, kp - k), (0, kp - k)))
+        a = a.at[:, k:, :].set(eye_pad[None])
+        b = jnp.pad(b, ((0, 0), (0, kp - k)))
+    if npad != n:
+        pad = jnp.eye(kp, dtype=jnp.float32)[None].repeat(npad - n, axis=0)
+        a = jnp.concatenate([a, pad], axis=0)
+        b = jnp.concatenate([b, jnp.zeros((npad - n, kp), jnp.float32)], axis=0)
+
+    a_t = jnp.transpose(a, (1, 2, 0))  # [kp, kp, Np] — batch on lanes
+    b_t = jnp.transpose(b, (1, 0))     # [kp, Np]
+    x_t = _solve_lanes(a_t, b_t, interpret=interpret,
+                       vma=None if vma is None else frozenset(vma))
+    return jnp.transpose(x_t, (1, 0))[:n, :k]

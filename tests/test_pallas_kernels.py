@@ -1,0 +1,70 @@
+"""Pallas kernel parity tests (interpret mode on the CPU test platform).
+
+The compiled path is exercised on real TPU by bench.py; here the same
+kernel body runs under the Pallas interpreter against the XLA Cholesky
+reference (SURVEY.md §4: device-free CI via the forced-CPU platform).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from incubator_predictionio_tpu.ops.pallas_kernels import (  # noqa: E402
+    _solve_reference,
+    batched_spd_solve,
+)
+
+
+def _random_spd(n, k, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    m = rng.standard_normal((n, k, k)).astype(np.float32) * scale
+    a = np.einsum("nij,nkj->nik", m, m) + np.eye(k, dtype=np.float32)
+    b = rng.standard_normal((n, k)).astype(np.float32)
+    return a, b
+
+
+@pytest.mark.parametrize("n,k", [(5, 10), (300, 32), (130, 7), (1, 1), (513, 16)])
+def test_interpret_matches_cholesky(n, k):
+    a, b = _random_spd(n, k, seed=n + k)
+    x_ref = np.asarray(_solve_reference(jnp.asarray(a), jnp.asarray(b)))
+    x_pal = np.asarray(
+        batched_spd_solve(jnp.asarray(a), jnp.asarray(b),
+                          use_pallas=True, interpret=True)
+    )
+    np.testing.assert_allclose(x_pal, x_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_non_multiple_batch_padding():
+    # Batch sizes that straddle the 512-slab boundary (a silent-truncation
+    # regression guard: 138496 = 270.5 slabs of 512 once exposed exactly
+    # this bug on hardware).
+    for n in (511, 513, 1025):
+        a, b = _random_spd(n, 8, seed=n)
+        x_ref = np.asarray(_solve_reference(jnp.asarray(a), jnp.asarray(b)))
+        x_pal = np.asarray(
+            batched_spd_solve(jnp.asarray(a), jnp.asarray(b),
+                              use_pallas=True, interpret=True)
+        )
+        np.testing.assert_allclose(x_pal, x_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_auto_select_falls_back_off_tpu():
+    # On the CPU test platform the auto path must use the XLA reference.
+    a, b = _random_spd(64, 12, seed=3)
+    x = np.asarray(batched_spd_solve(jnp.asarray(a), jnp.asarray(b)))
+    x_ref = np.asarray(_solve_reference(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(x, x_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_solve_inside_jit_and_grad_free_context():
+    a, b = _random_spd(40, 16, seed=9)
+
+    @jax.jit
+    def f(a, b):
+        return batched_spd_solve(a, b, use_pallas=True, interpret=True)
+
+    x = np.asarray(f(jnp.asarray(a), jnp.asarray(b)))
+    x_ref = np.asarray(_solve_reference(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(x, x_ref, rtol=2e-4, atol=2e-4)
