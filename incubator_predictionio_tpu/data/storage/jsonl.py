@@ -51,13 +51,36 @@ class _LogScan:
     def __init__(self) -> None:
         self.size = 0
         self.cols: Optional[ColumnarEvents] = None
-        self.tombstones: set[str] = set()
+        # eventId string → last tombstone position (record count at the
+        # time the tombstone was appended). Deletes are positional: only
+        # records BEFORE the tombstone die; a later re-insert is live.
+        self.tombstones: dict[str, int] = {}
+        # Incrementally-built eventId string → interned code index (the
+        # table is append-only, so only new suffixes need indexing).
+        self._eid_index: dict[str, int] = {}
+        self._eid_indexed = 0
+
+    def eid_index(self) -> dict[str, int]:
+        assert self.cols is not None
+        table = self.cols.table(ColumnarEvents.TABLE_EVENT_ID)
+        if self._eid_indexed < len(table):
+            for i in range(self._eid_indexed, len(table)):
+                self._eid_index[table[i]] = i
+            self._eid_indexed = len(table)
+        return self._eid_index
+
+    @staticmethod
+    def _merge_tombstones(dest: dict[str, int], cols: ColumnarEvents,
+                          offset: int = 0) -> None:
+        for tid, pos in zip(cols.tombstones, cols.tombstone_pos):
+            dest[tid] = max(dest.get(tid, -1), int(pos) + offset)
 
     def refresh(self, path: str) -> None:
         try:
             size = os.path.getsize(path)
         except OSError:
-            self.size, self.cols, self.tombstones = 0, None, set()
+            self.size, self.cols, self.tombstones = 0, None, {}
+            self._eid_index, self._eid_indexed = {}, 0
             return
         if self.cols is not None and size == self.size:
             return
@@ -72,7 +95,9 @@ class _LogScan:
         with open(path, "rb") as f:
             buf = f.read()
         self.cols = parse_events(buf)
-        self.tombstones = set(self.cols.tombstones)
+        self.tombstones = {}
+        self._merge_tombstones(self.tombstones, self.cols)
+        self._eid_index, self._eid_indexed = {}, 0
         self.size = size
 
     def _extend(self, new: ColumnarEvents) -> None:
@@ -96,6 +121,7 @@ class _LogScan:
                 lut[i] = code
             remapped[attr] = lut[getattr(new, attr)]
         base_off = len(old.raw)
+        n_old = len(old)
         shift = lambda a: np.where(a >= 0, a + base_off, a)  # noqa: E731
         self.cols = ColumnarEvents(
             raw=old.raw + new.raw,
@@ -111,14 +137,18 @@ class _LogScan:
             span=np.concatenate([old.span, shift(new.span)]),
             _tables=[old.table(w) for w in range(6)],
             tombstones=old.tombstones + new.tombstones,
+            tombstone_pos=np.concatenate(
+                [old.tombstone_pos, new.tombstone_pos + n_old]
+            ),
         )
-        self.tombstones.update(new.tombstones)
+        self._merge_tombstones(self.tombstones, new, offset=n_old)
 
     def live_mask(self) -> np.ndarray:
         """Boolean mask of the effective view: per eventId only the LAST
         record survives (re-insert with a client-supplied id overwrites,
-        matching the other backends' upsert semantics), and tombstoned ids
-        are dropped entirely."""
+        matching the other backends' upsert semantics), and records older
+        than their id's latest tombstone are dropped (positional delete —
+        a record re-inserted AFTER the delete is live again)."""
         cols = self.cols
         assert cols is not None
         n = len(cols)
@@ -134,12 +164,19 @@ class _LogScan:
             keep |= ids < 0  # records without ids are never deduped
             mask &= keep
         if self.tombstones:
-            table = cols.table(ColumnarEvents.TABLE_EVENT_ID)
-            dead_codes = {i for i, s in enumerate(table) if s in self.tombstones}
-            if dead_codes:
-                dead = np.fromiter((c in dead_codes for c in ids),
-                                   count=n, dtype=bool)
-                mask &= ~dead
+            index = self.eid_index()
+            n_codes = len(cols.table(ColumnarEvents.TABLE_EVENT_ID))
+            last_ts = np.full(n_codes + 1, -1, np.int64)
+            # Snapshot: a concurrent delete_batch may grow the dict.
+            for tid, pos in list(self.tombstones.items()):
+                code = index.get(tid)
+                if code is not None:
+                    last_ts[code] = pos
+            # A record dies iff some tombstone for its id was appended
+            # after it (record index < tombstone position).
+            safe_ids = np.where(ids >= 0, ids, n_codes)
+            dead = np.arange(n) < last_ts[safe_ids]
+            mask &= ~dead
         return mask
 
 
@@ -214,26 +251,62 @@ class JSONLEvents(base.LEvents):
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
         scan = self._scan(app_id, channel_id)
-        if scan.cols is None or event_id in scan.tombstones:
+        if scan.cols is None:
             return None
-        table = scan.cols.table(ColumnarEvents.TABLE_EVENT_ID)
-        try:
-            code = table.index(event_id)
-        except ValueError:
+        code = scan.eid_index().get(event_id)
+        if code is None:
             return None
         rows = np.nonzero(scan.cols.event_id == code)[0]
         if rows.size == 0:
             return None
-        return self._row_event(scan.cols, int(rows[-1]))
+        last = int(rows[-1])
+        # Positional tombstone check: dead only if deleted after insertion.
+        if last < scan.tombstones.get(event_id, -1):
+            return None
+        return self._row_event(scan.cols, last)
 
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return self.delete_batch([event_id], app_id, channel_id)[0]
+
+    def delete_batch(
+        self, event_ids: Sequence[str], app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> list[bool]:
+        """One scan refresh + one O(n) pass + one append for any number of
+        deletes (the self-cleaning compaction path deletes in bulk)."""
         import json
 
-        if self.get(event_id, app_id, channel_id) is None:
-            return False
-        self._append(self._path(app_id, channel_id),
-                     [json.dumps({"__tombstone__": event_id}) + "\n"])
-        return True
+        event_ids = list(event_ids)
+        with self._lock:
+            scan = self._scan(app_id, channel_id)
+            if scan.cols is None:
+                return [False] * len(event_ids)
+            index = scan.eid_index()
+            ids_col = scan.cols.event_id
+            n = len(scan.cols)
+            # Last record position per event-id code, one vectorized pass.
+            n_codes = len(scan.cols.table(ColumnarEvents.TABLE_EVENT_ID))
+            last_occ = np.full(n_codes, -1, np.int64)
+            with_id = ids_col >= 0
+            np.maximum.at(last_occ, ids_col[with_id],
+                          np.nonzero(with_id)[0])
+            deleted, lines, new_dead = [], [], set()
+            for event_id in event_ids:
+                code = index.get(event_id)
+                ok = (code is not None
+                      and event_id not in new_dead
+                      and int(last_occ[code]) >= scan.tombstones.get(event_id, -1))
+                deleted.append(ok)
+                if ok:
+                    lines.append(json.dumps({"__tombstone__": event_id}) + "\n")
+                    new_dead.add(event_id)
+            if lines:
+                # Append BEFORE mutating scan state: if the write fails the
+                # cached view must keep matching the file.
+                self._append(self._path(app_id, channel_id), lines)
+                for event_id in new_dead:
+                    scan.tombstones[event_id] = n
+        return deleted
 
     def find(
         self,
@@ -285,9 +358,15 @@ class JSONLEvents(base.LEvents):
             mask = mask & (cols.time_us != _TIME_ABSENT) & (cols.time_us < u_us)
 
         rows = np.nonzero(mask)[0]
-        order = np.argsort(cols.time_us[rows], kind="stable")
         if reversed_order:
-            order = order[::-1]
+            # Stable DESCENDING: ties keep insertion order (matching the
+            # memory backend's `sort(reverse=True)`), which a plain
+            # reversal of the ascending permutation would flip.
+            t = cols.time_us[rows]
+            sa = np.argsort(t[::-1], kind="stable")
+            order = (len(rows) - 1 - sa)[::-1]
+        else:
+            order = np.argsort(cols.time_us[rows], kind="stable")
         rows = rows[order]
 
         def gen():
@@ -368,8 +447,7 @@ class JSONLPEvents(base.PEvents):
         self._l.insert_batch(list(events), app_id, channel_id)
 
     def delete(self, event_ids: Iterable[str], app_id: int, channel_id: Optional[int] = None) -> None:
-        for eid in event_ids:
-            self._l.delete(eid, app_id, channel_id)
+        self._l.delete_batch(list(event_ids), app_id, channel_id)
 
     def scan_columnar(self, app_id, channel_id=None, event_names=None,
                       start_time=None, until_time=None):

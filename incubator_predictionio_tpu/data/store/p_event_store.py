@@ -148,7 +148,14 @@ class PEventStore:
             keep = rows[keep_mask]
             if rating_from_props:
                 r = cols.rating[keep].astype(np.float32, copy=True)
+                # Codec sentinel semantics: NaN = "rating" key absent
+                # (event-default applies, like the row path injecting into
+                # properties), -inf = key present but not coercible
+                # (row path's _coerce → plain default_rating).
                 missing = np.isnan(r)
+                unusable = np.isneginf(r)
+                if unusable.any():
+                    r[unusable] = np.float32(default_rating)
                 if missing.any():
                     fill = np.full(keep.shape, np.float32(default_rating))
                     if event_default_ratings:
@@ -228,12 +235,19 @@ def ratings_matrix(
     )
     if rating_from_props:
         def _coerce(v) -> float:
+            # Must mirror the columnar codec exactly (fast/slow parity):
+            # bool/None, strings outside the common float()/strtod charset
+            # (hex, inf, nan, "1_0"), and values non-finite after the
+            # float32 cast all count as "present but unusable".
             if isinstance(v, bool) or v is None:
                 return default_rating
-            try:
-                return float(v)
-            except (TypeError, ValueError):
+            if isinstance(v, str) and set(v) - set("0123456789.+-eE \t\r\n"):
                 return default_rating
+            try:
+                f = np.float32(float(v))
+            except (TypeError, ValueError, OverflowError):
+                return default_rating
+            return float(f) if np.isfinite(f) else default_rating
 
         r = np.fromiter(
             (_coerce(p.get("rating", default_rating)) for p in batch.properties),

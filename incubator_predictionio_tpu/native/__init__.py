@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 5
+_EXPECTED_VERSION = 6
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -84,6 +84,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pio_table_offsets.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.pio_tombstone_count.restype = ctypes.c_int64
     lib.pio_tombstone_count.argtypes = [ctypes.c_void_p]
+    lib.pio_tombstone_pos.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.pio_tombstone_pos.argtypes = [ctypes.c_void_p]
     lib.pio_tombstone_get.restype = ctypes.POINTER(ctypes.c_char)
     lib.pio_tombstone_get.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
@@ -152,9 +154,12 @@ class ColumnarEvents:
     """Interned columnar view of an event log scan.
 
     Code -1 in ``tetype``/``teid``/``event_id`` = field absent;
-    ``time_us`` INT64_MIN = absent; ``rating`` NaN = absent. ``props`` and
-    ``span`` are [start, end) byte offsets into ``raw`` (-1 = absent) for
-    lazy per-event reparse of the full JSON.
+    ``time_us`` INT64_MIN = absent; ``rating`` NaN = key absent, -inf =
+    key present but not coercible to a finite number (the two fill
+    differently in find_ratings). ``props`` and ``span`` are [start, end)
+    byte offsets into ``raw`` (-1 = absent) for lazy per-event reparse of
+    the full JSON. ``tombstone_pos[i]`` = how many event records precede
+    tombstone i (deletes are positional: later re-inserts are live).
 
     String tables are materialized lazily per table via ``table(which)`` —
     the eventId table of a big scan is as large as the scan itself, and the
@@ -176,6 +181,7 @@ class ColumnarEvents:
     # already-built list
     _tables: list
     tombstones: list[str]
+    tombstone_pos: np.ndarray  # int64, record count before each tombstone
 
     def __len__(self) -> int:
         return int(self.event.shape[0])
@@ -244,9 +250,11 @@ def parse_events_jsonl(buf: bytes) -> ColumnarEvents:
             tables.append((blob, offs))
         tombstones = []
         ln = ctypes.c_int32(0)
-        for idx in range(lib.pio_tombstone_count(handle)):
+        n_tomb = lib.pio_tombstone_count(handle)
+        for idx in range(n_tomb):
             ptr = lib.pio_tombstone_get(handle, idx, ctypes.byref(ln))
             tombstones.append(ctypes.string_at(ptr, ln.value).decode("utf-8"))
+        tombstone_pos = _np_copy(lib.pio_tombstone_pos(handle), n_tomb, np.int64)
         return ColumnarEvents(
             raw=buf,
             event=_np_copy(lib.pio_col_event(handle), n, np.int32),
@@ -261,6 +269,7 @@ def parse_events_jsonl(buf: bytes) -> ColumnarEvents:
             span=_np_copy(lib.pio_col_span(handle), 2 * n, np.int64).reshape(n, 2),
             _tables=tables,
             tombstones=tombstones,
+            tombstone_pos=tombstone_pos,
         )
     finally:
         lib.pio_free(handle)
@@ -317,7 +326,7 @@ def parse_events_jsonl_py(buf: bytes) -> ColumnarEvents:
 
     cols = {k: [] for k in ("event", "etype", "eid", "tetype", "teid",
                             "event_id", "time_us", "rating")}
-    props, span, tombstones = [], [], []
+    props, span, tombstones, tombstone_pos = [], [], [], []
     epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 
     offset = 0
@@ -338,6 +347,7 @@ def parse_events_jsonl_py(buf: bytes) -> ColumnarEvents:
             raise EventParseError(f"expected event object at byte {start}")
         if "__tombstone__" in obj:
             tombstones.append(obj["__tombstone__"])
+            tombstone_pos.append(len(cols["event"]))
             continue
         cols["event"].append(intern(0, obj["event"]) if "event" in obj else -1)
         cols["etype"].append(intern(1, obj["entityType"]) if "entityType" in obj else -1)
@@ -359,15 +369,25 @@ def parse_events_jsonl_py(buf: bytes) -> ColumnarEvents:
             except Exception:
                 cols["time_us"].append(np.iinfo(np.int64).min)
         p = obj.get("properties")
-        r = p.get("rating") if isinstance(p, dict) else None
+        has_rating = isinstance(p, dict) and "rating" in p
+        r = p.get("rating") if has_rating else None
         if isinstance(r, (int, float)) and not isinstance(r, bool):
-            cols["rating"].append(float(r))
-        elif isinstance(r, str) and "_" not in r:
-            # string-typed numeric rating; "_" excluded to match strtod
             try:
-                cols["rating"].append(float(r))
-            except ValueError:
-                cols["rating"].append(np.nan)
+                f = np.float32(r)  # float32-range finiteness (codec parity)
+            except OverflowError:
+                f = np.float32(np.inf)
+            cols["rating"].append(float(f) if np.isfinite(f) else -np.inf)
+        elif isinstance(r, str) and not set(r) - set("0123456789.+-eE \t\r\n"):
+            # string-typed numeric rating; charset limited to what both
+            # float() and strtod parse identically (no hex/inf/nan/_)
+            try:
+                f = np.float32(float(r))
+                cols["rating"].append(float(f) if np.isfinite(f) else -np.inf)
+            except (ValueError, OverflowError):
+                cols["rating"].append(-np.inf)
+        elif has_rating:
+            # bool / null / list / dict / "1_0": present but unusable
+            cols["rating"].append(-np.inf)
         else:
             cols["rating"].append(np.nan)
         if isinstance(p, dict):
@@ -412,6 +432,7 @@ def parse_events_jsonl_py(buf: bytes) -> ColumnarEvents:
         span=np.asarray(span, np.int64).reshape(count, 2),
         _tables=tables,
         tombstones=tombstones,
+        tombstone_pos=np.asarray(tombstone_pos, np.int64),
     )
 
 

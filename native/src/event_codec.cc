@@ -14,7 +14,10 @@
 //   event/etype/eid/tetype/teid : int32 codes into interned string tables
 //                                 (tetype/teid = -1 when absent)
 //   time_us                     : int64 epoch microseconds (INT64_MIN absent)
-//   rating                      : float32 properties.rating (NaN absent)
+//   rating                      : float32 properties.rating
+//                                 (NaN = key absent; -inf = key present but
+//                                 not coercible to a finite number — the
+//                                 two cases fill differently upstream)
 //   props[2n]                   : byte offsets [start,end) of the raw
 //                                 properties JSON object (-1,-1 absent)
 //   span[2n]                    : byte offsets [start,end) of the whole
@@ -22,7 +25,9 @@
 //   event_id                    : int32 code into table 5 (-1 absent)
 //
 // Tombstone records {"__tombstone__": "<eventId>"} are collected separately
-// (append-only deletes; the Python side filters them out of scans).
+// together with their position (count of event records parsed before the
+// tombstone) so deletes only affect records appended BEFORE them — a
+// re-insert after a delete is live again, matching the upsert backends.
 
 #include <cmath>
 #include <cstdint>
@@ -59,6 +64,7 @@ struct Columns {
   std::vector<int64_t> span;   // 2n offsets
   Interner tables[kNumTables];
   std::vector<std::string> tombstones;
+  std::vector<int64_t> tombstone_pos;  // records parsed before each tombstone
 };
 
 struct Parser {
@@ -289,17 +295,41 @@ struct Parser {
       if (key == "rating" && is_num) {
         double d;
         if (!parse_number(d)) return false;
-        rating = static_cast<float>(d);
+        // Finiteness is judged AFTER the float32 cast (fast/slow parity:
+        // the row path's matrix is float32 too); 1e999-style overflow and
+        // float32-range overflow are both "present but unusable".
+        float f32 = static_cast<float>(d);
+        rating = std::isfinite(f32) ? f32 : -INFINITY;
       } else if (key == "rating" && p < end && *p == '"') {
         // string-typed numeric rating (some SDK exports): coerce like the
-        // row path's float() — full-string parse or stays absent
+        // row path's float() — full-string finite parse, else "present but
+        // unusable" (-inf), which upstream fills with default_rating.
+        // Charset pre-check: strtod accepts hex/inf/nan spellings that
+        // Python's float() rejects (or that parse to non-finite anyway).
         std::string sval2;
         if (!parse_string(sval2)) return false;
+        bool charset_ok = true;
+        for (char ch : sval2) {
+          if (!((ch >= '0' && ch <= '9') || ch == '.' || ch == '+' ||
+                ch == '-' || ch == 'e' || ch == 'E' ||
+                isspace(static_cast<unsigned char>(ch)))) {
+            charset_ok = false;
+            break;
+          }
+        }
         const char* b = sval2.c_str();
         char* e2 = nullptr;
-        double d = strtod(b, &e2);
+        double d = charset_ok ? strtod(b, &e2) : 0.0;
         while (e2 && isspace(static_cast<unsigned char>(*e2))) ++e2;
-        if (e2 && e2 != b && *e2 == '\0') rating = static_cast<float>(d);
+        float f32 = static_cast<float>(d);
+        if (charset_ok && e2 && e2 != b && *e2 == '\0' && std::isfinite(f32))
+          rating = f32;
+        else
+          rating = -INFINITY;
+      } else if (key == "rating") {
+        // bool / null / object / array rating: present but unusable.
+        if (!skip_value()) return false;
+        rating = -INFINITY;
       } else {
         if (!skip_value()) return false;
       }
@@ -463,6 +493,7 @@ struct Parser {
     ++n_records;
     if (tombstone) {
       c.tombstones.push_back(std::move(tomb_id));
+      c.tombstone_pos.push_back(static_cast<int64_t>(c.event.size()));
       return true;
     }
     c.event.push_back(ev);
@@ -514,7 +545,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 5; }
+int32_t pio_codec_version() { return 6; }
 
 void* pio_parse_events_jsonl(const char* buf, int64_t len, char* errbuf,
                              int64_t errcap) {
@@ -581,6 +612,10 @@ const int64_t* pio_table_offsets(void* h, int32_t which) {
 
 int64_t pio_tombstone_count(void* h) {
   return static_cast<int64_t>(H(h)->cols.tombstones.size());
+}
+
+const int64_t* pio_tombstone_pos(void* h) {
+  return H(h)->cols.tombstone_pos.data();
 }
 
 const char* pio_tombstone_get(void* h, int64_t idx, int32_t* len_out) {

@@ -45,12 +45,14 @@ def _columns_equal(a, b):
     assert np.allclose(np.nan_to_num(a.rating), np.nan_to_num(b.rating))
     assert a.tables == b.tables
     assert a.tombstones == b.tombstones
+    assert np.array_equal(a.tombstone_pos, b.tombstone_pos)
 
 
 def test_python_oracle_semantics():
     c = native.parse_events_jsonl_py(BUF)
     assert len(c) == 4
     assert c.tombstones == ["e1"]
+    assert c.tombstone_pos.tolist() == [3]  # three records precede it
     expect = int(dt.datetime(
         2014, 9, 9, 16, 17, 42, 937000,
         tzinfo=dt.timezone(dt.timedelta(hours=-8))).timestamp() * 1e6)
@@ -91,7 +93,8 @@ def test_native_matches_oracle_fuzz():
             e["targetEntityId"] = "i%d" % random.randrange(30)
         if random.random() < 0.6:
             e["properties"] = {"rating": random.choice(
-                [1, 2.5, -3, 1e10, 0.1]),
+                [1, 2.5, -3, 1e10, 0.1, "3.5", " 2 ", "n/a", "1_0",
+                 True, False, None, ["4"], {"v": 4}]),
                 "s": random.choice(["plain", 'esc"\\', "unié€"])}
         if random.random() < 0.05:
             e = {"__tombstone__": "id%d" % random.randrange(max(n, 1))}
@@ -153,8 +156,11 @@ def test_find_ratings_fast_equals_slow(tmp_path):
     import random
 
     random.seed(7)
+    # Includes present-but-unusable ratings (bool/"n/a"/underscore string):
+    # both paths must coerce those to default_rating, NOT the event default.
     ratings = [("u%d" % random.randrange(20), "i%d" % random.randrange(10),
-                random.choice([None, 1.0, 2.0, 5.0, "3.5"])) for _ in range(200)]
+                random.choice([None, 1.0, 2.0, 5.0, "3.5",
+                               True, "n/a", "1_0"])) for _ in range(200)]
     # a user whose only event has no target: must still get a BiMap slot
     ratings.append(("u_lonely", None, 2.0))
     out = {}
@@ -199,3 +205,64 @@ def test_jsonl_delete_and_dedupe(tmp_path):
     assert live == 1
     assert len(list(le.find(app_id))) == 1
     s.close()
+
+
+def test_jsonl_reinsert_after_delete(tmp_path):
+    """A delete only kills records appended before it: re-inserting the
+    same eventId afterwards must be visible (upsert-backend parity) and
+    must survive compaction."""
+    s = _storage("jsonl", tmp_path)
+    app_id = _seed_app(s, [("u1", "i1", 5.0)])
+    le = s.get_l_events()
+    e = next(iter(le.find(app_id)))
+    assert le.delete(e.event_id, app_id)
+    assert le.get(e.event_id, app_id) is None
+    # re-insert with the SAME eventId
+    le.insert(e, app_id)
+    got = le.get(e.event_id, app_id)
+    assert got is not None and got.entity_id == "u1"
+    assert len(list(le.find(app_id))) == 1
+    # compaction must keep the re-inserted record
+    assert le.compact(app_id) == 1
+    assert le.get(e.event_id, app_id) is not None
+    # ...and a fresh Storage over the same files agrees (cold scan path)
+    s2 = _storage("jsonl", tmp_path)
+    le2 = s2.get_l_events()
+    assert le2.get(e.event_id, app_id) is not None
+    s2.close()
+    s.close()
+
+
+def test_jsonl_batch_delete(tmp_path):
+    s = _storage("jsonl", tmp_path)
+    app_id = _seed_app(s, [("u%d" % n, "i1", 1.0) for n in range(10)])
+    le = s.get_l_events()
+    ids = [e.event_id for e in le.find(app_id)]
+    out = le.delete_batch(ids[:6] + ["missing-id"], app_id)
+    assert out == [True] * 6 + [False]
+    assert len(list(le.find(app_id))) == 4
+    # repeated delete of an already-dead id reports False
+    assert le.delete_batch([ids[0]], app_id) == [False]
+    s.close()
+
+
+def test_jsonl_reversed_order_tie_semantics(tmp_path):
+    """Equal-timestamp events in reversed_order must come back in
+    insertion order (stable descending), matching the memory backend."""
+    same_time = "2024-03-01T00:00:00Z"
+    events = [Event.from_json({
+        "event": "rate", "entityType": "user", "entityId": "u%d" % n,
+        "targetEntityType": "item", "targetEntityId": "i",
+        "properties": {"rating": 1.0}, "eventTime": same_time,
+    }) for n in range(5)]
+    orders = {}
+    for kind in ("memory", "jsonl"):
+        s = _storage(kind, tmp_path / kind)
+        app_id = s.get_meta_data_apps().insert(App(0, "ties", None))
+        le = s.get_l_events()
+        le.init(app_id)
+        le.insert_batch(events, app_id)
+        orders[kind] = [e.entity_id
+                        for e in le.find(app_id, reversed_order=True)]
+        s.close()
+    assert orders["memory"] == orders["jsonl"]
