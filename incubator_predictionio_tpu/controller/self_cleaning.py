@@ -43,20 +43,11 @@ class SelfCleaningDataSource:
         if self.event_window_duration is not None:
             cutoff = _dt.datetime.now(_dt.timezone.utc) - self.event_window_duration
 
-        def bulk_delete(ids: list[str]) -> None:
-            # One refresh + one append on backends with a batch path
-            # (JSONL log); per-event elsewhere.
-            if hasattr(le, "delete_batch"):
-                le.delete_batch(ids, app.id)
-            else:
-                for eid in ids:
-                    le.delete(eid, app.id)
-
         # 1) age out old non-property events
         if cutoff is not None and self.event_window_remove:
             doomed = [e.event_id for e in le.find(app.id, until_time=cutoff)
                       if e.event not in ("$set", "$unset", "$delete")]
-            bulk_delete(doomed)
+            le.delete_batch(doomed, app.id)
             removed += len(doomed)
 
         # 2) compact property-event streams per entity type into one $set
@@ -70,7 +61,7 @@ class SelfCleaningDataSource:
             if len(events) <= len({e.entity_id for e in events}):
                 continue  # nothing to compact
             snapshot = aggregate_property_events(events)
-            bulk_delete([e.event_id for e in events])
+            le.delete_batch([e.event_id for e in events], app.id)
             removed += len(events)
             for entity_id, pm in snapshot.items():
                 le.insert(

@@ -252,6 +252,14 @@ class LEvents(abc.ABC):
     @abc.abstractmethod
     def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool: ...
 
+    def delete_batch(
+        self, event_ids: Sequence[str], app_id: int,
+        channel_id: Optional[int] = None,
+    ) -> list[bool]:
+        """Bulk delete; backends with a cheaper-than-per-event path (the
+        JSONL log's one-refresh-one-append) override this default loop."""
+        return [self.delete(eid, app_id, channel_id) for eid in event_ids]
+
     @abc.abstractmethod
     def find(
         self,

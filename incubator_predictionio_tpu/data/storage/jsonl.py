@@ -55,19 +55,28 @@ class _LogScan:
         # time the tombstone was appended). Deletes are positional: only
         # records BEFORE the tombstone die; a later re-insert is live.
         self.tombstones: dict[str, int] = {}
-        # Incrementally-built eventId string → interned code index (the
-        # table is append-only, so only new suffixes need indexing).
-        self._eid_index: dict[str, int] = {}
-        self._eid_indexed = 0
+        # Incrementally-built string → interned-code index per table (the
+        # tables are append-only, so only new suffixes need indexing; the
+        # same dicts serve point lookups AND _extend's code remapping).
+        self._tbl_index: list[dict[str, int]] = [{} for _ in range(6)]
+        self._tbl_indexed = [0] * 6
+
+    def _reset_indexes(self) -> None:
+        self._tbl_index = [{} for _ in range(6)]
+        self._tbl_indexed = [0] * 6
+
+    def table_index(self, which: int) -> dict[str, int]:
+        assert self.cols is not None
+        table = self.cols.table(which)
+        if self._tbl_indexed[which] < len(table):
+            idx = self._tbl_index[which]
+            for i in range(self._tbl_indexed[which], len(table)):
+                idx[table[i]] = i
+            self._tbl_indexed[which] = len(table)
+        return self._tbl_index[which]
 
     def eid_index(self) -> dict[str, int]:
-        assert self.cols is not None
-        table = self.cols.table(ColumnarEvents.TABLE_EVENT_ID)
-        if self._eid_indexed < len(table):
-            for i in range(self._eid_indexed, len(table)):
-                self._eid_index[table[i]] = i
-            self._eid_indexed = len(table)
-        return self._eid_index
+        return self.table_index(ColumnarEvents.TABLE_EVENT_ID)
 
     @staticmethod
     def _merge_tombstones(dest: dict[str, int], cols: ColumnarEvents,
@@ -80,7 +89,7 @@ class _LogScan:
             size = os.path.getsize(path)
         except OSError:
             self.size, self.cols, self.tombstones = 0, None, {}
-            self._eid_index, self._eid_indexed = {}, 0
+            self._reset_indexes()
             return
         if self.cols is not None and size == self.size:
             return
@@ -97,18 +106,20 @@ class _LogScan:
         self.cols = parse_events(buf)
         self.tombstones = {}
         self._merge_tombstones(self.tombstones, self.cols)
-        self._eid_index, self._eid_indexed = {}, 0
+        self._reset_indexes()
         self.size = size
 
     def _extend(self, new: ColumnarEvents) -> None:
         old = self.cols
         assert old is not None
-        # Remap new codes into the old tables (append-only interning).
+        # Remap new codes into the old tables (append-only interning). The
+        # persistent per-table index dicts avoid an O(total-events) rebuild
+        # on every small append.
         remapped = {}
         for which, attr in ((0, "event"), (1, "etype"), (2, "eid"),
                             (3, "tetype"), (4, "teid"), (5, "event_id")):
             old_table = old.table(which)
-            old_index = {s: i for i, s in enumerate(old_table)}
+            old_index = self.table_index(which)
             new_table = new.table(which)
             lut = np.empty(len(new_table) + 1, np.int32)
             lut[-1] = -1  # code -1 stays -1
@@ -119,6 +130,7 @@ class _LogScan:
                     old_table.append(s)
                     old_index[s] = code
                 lut[i] = code
+            self._tbl_indexed[which] = len(old_table)
             remapped[attr] = lut[getattr(new, attr)]
         base_off = len(old.raw)
         n_old = len(old)

@@ -310,9 +310,11 @@ struct Parser {
         if (!parse_string(sval2)) return false;
         bool charset_ok = true;
         for (char ch : sval2) {
+          // Exact whitespace set " \t\r\n" (NOT isspace: \v and \f are
+          // accepted by strtod skipping but rejected by Python's float()).
           if (!((ch >= '0' && ch <= '9') || ch == '.' || ch == '+' ||
-                ch == '-' || ch == 'e' || ch == 'E' ||
-                isspace(static_cast<unsigned char>(ch)))) {
+                ch == '-' || ch == 'e' || ch == 'E' || ch == ' ' ||
+                ch == '\t' || ch == '\r' || ch == '\n')) {
             charset_ok = false;
             break;
           }

@@ -94,6 +94,7 @@ def test_native_matches_oracle_fuzz():
         if random.random() < 0.6:
             e["properties"] = {"rating": random.choice(
                 [1, 2.5, -3, 1e10, 0.1, "3.5", " 2 ", "n/a", "1_0",
+                 "1", "0x10", "inf", "1e999", 1e999,
                  True, False, None, ["4"], {"v": 4}]),
                 "s": random.choice(["plain", 'esc"\\', "unié€"])}
         if random.random() < 0.05:
