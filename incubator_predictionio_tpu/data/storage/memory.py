@@ -78,6 +78,11 @@ class MemoryLEvents(base.LEvents):
         eid = event.event_id or new_event_id()
         stored = event.with_event_id(eid)
         with self._lock:
+            # Upsert moves the event to the END of its equal-timestamp tie
+            # group (cross-backend contract: the JSONL log re-appends,
+            # SQLite's REPLACE assigns a new rowid; pop before assign so
+            # the dict's insertion order matches).
+            t.events.pop(eid, None)
             t.events[eid] = stored
         return eid
 

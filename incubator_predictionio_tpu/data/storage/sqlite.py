@@ -544,6 +544,11 @@ class SQLiteLEvents(base.LEvents, _Dao):
             event.pr_id, _to_micros(event.creation_time),
         )
 
+    # Upsert semantics across backends: re-inserting an existing eventId
+    # moves the event to the END of its equal-timestamp tie group (the
+    # JSONL log re-appends by construction; INSERT OR REPLACE is
+    # delete+insert so the new rowid sorts last; the memory backend
+    # pops+appends to match).
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         t = self._ensure_table(app_id, channel_id)
         eid = event.event_id or new_event_id()
@@ -650,7 +655,10 @@ class SQLiteLEvents(base.LEvents, _Dao):
             clauses.append("targetentityid = ?")
             params.append(target_entity_id)
         where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
-        order = " ORDER BY eventtime" + (" DESC" if reversed_order else "")
+        # Ties on eventtime keep insertion order either way (stable
+        # ascending / stable descending — matching the other backends).
+        order = (" ORDER BY eventtime DESC, rowid ASC" if reversed_order
+                 else " ORDER BY eventtime ASC, rowid ASC")
         lim = f" LIMIT {int(limit)}" if limit is not None and limit >= 0 else ""
         sql = f"SELECT * FROM {t}{where}{order}{lim}"
         with self._lock:

@@ -12,9 +12,14 @@ top-k correlators per item are kept as static [I, K] index/score arrays
 (the "index" that replaces Elasticsearch — scoring is then a gather+dot,
 see models/universal_recommender.py).
 
-Catalog-size note: the dense co-occurrence block is [I, I] f32 — fine to
-~16k items on one chip (1GB); larger catalogs need item-axis chunking
-(future work, the layout already permits it).
+Scale notes: events are pre-partitioned by user range on the host (sorted
+slabs, like ops/blocked.py), so each scan step scatters only its own
+events — the naive alternative of range-masking the whole event array per
+step is quadratic and ~40x slower on TPU at 1M events. Slabs are bf16
+(binary, so exact) for the MXU matmul with f32 accumulation. The
+co-occurrence matrix is computed in [item_block, I] stripes so catalogs
+far beyond the one-chip [I, I] limit stream through a bounded accumulator;
+LLR + top-k happen per stripe and only the [I, K] indicators materialize.
 """
 
 from __future__ import annotations
@@ -53,32 +58,64 @@ def llr_scores(k11, k12, k21, k22):
     return jnp.maximum(g2, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("n_items", "u_chunk", "n_ranges"))
-def _cooccurrence_counts(pu, pi, su, si, n_items: int, u_chunk: int,
-                         n_ranges: int):
-    """C[i,j] = #users who interacted with primary item i and secondary
-    item j. COO inputs -1-padded; the scan covers exactly
-    ceil(n_users/u_chunk) user ranges. Dense per-user-chunk slabs keep the
-    matmul on the MXU."""
+def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
+                       n_ranges: int):
+    """Host prep: sort (user, item) pairs by user range and lay them out
+    as [n_ranges, E] slabs (-1 padded), so the device scan step for range
+    k touches only range k's events."""
+    # Events whose user id falls outside [0, n_ranges*u_chunk) are dropped
+    # (contract: user ids < n_users; the pre-rewrite slab mask silently
+    # ignored them too, and a bad id must not corrupt the layout).
+    valid = (u >= 0) & (u < n_ranges * u_chunk)
+    u, i = u[valid], i[valid]
+    order = np.argsort(u, kind="stable")
+    us, is_ = u[order], i[order]
+    chunk_of = us // u_chunk
+    counts = np.bincount(chunk_of, minlength=n_ranges)
+    e = max(int(counts.max()), 1) if counts.size else 1
+    eu = np.full((n_ranges, e), -1, np.int32)
+    ei = np.full((n_ranges, e), -1, np.int32)
+    starts = np.zeros(n_ranges + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos = np.arange(len(us)) - starts[chunk_of]
+    eu[chunk_of, pos] = us
+    ei[chunk_of, pos] = is_
+    return eu, ei
 
-    def body(c, k):
-        # Build dense binary slabs for user range [k*Uc, (k+1)*Uc).
-        def slab(uu, ii, lo):
-            ok = (uu >= lo) & (uu < lo + u_chunk) & (ii >= 0)
-            rows = jnp.where(ok, uu - lo, u_chunk)  # u_chunk = scratch row
-            a = jnp.zeros((u_chunk + 1, n_items), jnp.float32)
-            a = a.at[rows, jnp.maximum(ii, 0)].max(jnp.where(ok, 1.0, 0.0))
-            return a[:u_chunk]
 
+@functools.partial(jax.jit, static_argnames=("n_items", "u_chunk", "block"))
+def _cooccurrence_stripe(peu, pei, seu, sei, lo_item, n_items: int,
+                         u_chunk: int, block: int):
+    """One stripe C[lo_item:lo_item+block, :] of the co-occurrence
+    matrix: Σ over user ranges of slab_p[:, stripe]ᵀ @ slab_s. Inputs are
+    the host-partitioned [n_ranges, E] event slabs; each scan step
+    scatters only its own range's events. Binary slabs are bf16 (exact)
+    so the matmul runs at full MXU rate with f32 accumulation."""
+
+    def slab(uu, ii, lo):
+        ok = uu >= 0
+        rows = jnp.where(ok, uu - lo, u_chunk)  # row u_chunk = scratch
+        a = jnp.zeros((u_chunk + 1, n_items), jnp.bfloat16)
+        a = a.at[rows, jnp.maximum(ii, 0)].max(
+            jnp.where(ok, 1.0, 0.0).astype(jnp.bfloat16))
+        return a[:u_chunk]
+
+    def body(c, chunk):
+        eu_p, ei_p, eu_s, ei_s, k = chunk
         lo = k * u_chunk
-        ap = slab(pu, pi, lo)
-        asec = slab(su, si, lo)
+        ap = jax.lax.dynamic_slice(
+            slab(eu_p, ei_p, lo), (0, lo_item), (u_chunk, block))
+        asec = slab(eu_s, ei_s, lo)
         c = c + jnp.einsum("ui,uj->ij", ap, asec,
                            preferred_element_type=jnp.float32)
         return c, None
 
-    c0 = jnp.zeros((n_items, n_items), jnp.float32)
-    c, _ = jax.lax.scan(body, c0, jnp.arange(n_ranges))
+    n_ranges = peu.shape[0]
+    c0 = jnp.zeros((block, n_items), jnp.float32)
+    c, _ = jax.lax.scan(
+        body, c0,
+        (peu, pei, seu, sei, jnp.arange(n_ranges, dtype=jnp.int32)),
+    )
     return c
 
 
@@ -94,6 +131,29 @@ class Indicators:
         return self.idx.shape[1]
 
 
+@functools.partial(jax.jit, static_argnames=("k", "llr_threshold"))
+def _stripe_topk(counts, n_i_stripe, n_j, lo_item, n_total,
+                 k: int, llr_threshold: float):
+    """LLR + top-k for one [block, I] stripe of counts. Dunning
+    contingency over DISTINCT USERS (Mahout semantics): n_i = users who
+    did the primary event on item i, n_j likewise for the secondary
+    event, N = total users."""
+    block, n_items = counts.shape
+    k11 = counts
+    k12 = jnp.maximum(n_i_stripe[:, None] - counts, 0.0)
+    k21 = jnp.maximum(n_j[None, :] - counts, 0.0)
+    k22 = jnp.maximum(n_total - k11 - k12 - k21, 0.0)
+    llr = llr_scores(k11, k12, k21, k22)
+    # No self-correlation on the diagonal and no score without counts.
+    row_ids = lo_item + jnp.arange(block, dtype=jnp.int32)
+    col_ids = jnp.arange(n_items, dtype=jnp.int32)
+    llr = jnp.where(counts > 0, llr, 0.0)
+    llr = jnp.where(row_ids[:, None] == col_ids[None, :], 0.0, llr)
+    if llr_threshold > 0:
+        llr = jnp.where(llr >= llr_threshold, llr, 0.0)
+    return jax.lax.top_k(llr, k)
+
+
 def cco_indicators(
     primary_u: np.ndarray,
     primary_i: np.ndarray,
@@ -104,52 +164,58 @@ def cco_indicators(
     max_correlators: int = 50,
     llr_threshold: float = 0.0,
     u_chunk: int = 1024,
+    item_block: int = 4096,
 ) -> Indicators:
     """Build the LLR-thresholded cross-occurrence indicator matrix between
     a primary event's items and a secondary event's items (same item-id
-    space; self-co-occurrence when primary==secondary)."""
+    space; self-co-occurrence when primary==secondary). Streams the
+    co-occurrence matrix in [item_block, I] stripes, so catalog size is
+    bounded by item_block·I, not I²."""
 
-    def pad_chunk(u, i):
-        u = np.asarray(u, np.int32)
-        i = np.asarray(i, np.int32)
-        # dedupe (user,item) pairs — binary interaction matrices
-        pairs = np.unique(np.stack([u, i], 1), axis=0)
-        u, i = pairs[:, 0], pairs[:, 1]
-        n = len(u)
-        target = max(((n + u_chunk - 1) // u_chunk) * u_chunk, u_chunk)
-        pu = np.full(target, -1, np.int32)
-        pi = np.full(target, -1, np.int32)
-        pu[:n], pi[:n] = u, i
-        return pu, pi
+    def dedupe(u, i):
+        # Packed-key unique: ~30x faster than np.unique(axis=0) (which
+        # lexsorts void-dtype rows) at 1M-event scale.
+        u = np.asarray(u, np.int64)
+        i = np.asarray(i, np.int64)
+        key = np.unique(u * n_items + i)
+        return ((key // n_items).astype(np.int32),
+                (key % n_items).astype(np.int32))
 
-    pu, pi = pad_chunk(primary_u, primary_i)
-    su, si = pad_chunk(secondary_u, secondary_i)
+    pu, pi = dedupe(primary_u, primary_i)
+    su, si = dedupe(secondary_u, secondary_i)
     n_ranges = max((n_users + u_chunk - 1) // u_chunk, 1)
+    peu, pei = _partition_by_user(pu, pi, u_chunk, n_ranges)
+    seu, sei = _partition_by_user(su, si, u_chunk, n_ranges)
 
-    counts = _cooccurrence_counts(pu, pi, su, si, n_items, u_chunk, n_ranges)
-
-    # Dunning contingency over DISTINCT USERS (Mahout semantics):
-    # n_i = users who did the primary event on i, n_j = users who did the
-    # secondary event on j, N = total users.
-    n_i = np.bincount(pi[pi >= 0], minlength=n_items).astype(np.float32)
-    n_j = np.bincount(si[si >= 0], minlength=n_items).astype(np.float32)
-    n_total = float(n_users)
-
-    k11 = counts
-    k12 = jnp.maximum(jnp.asarray(n_i)[:, None] - counts, 0.0)
-    k21 = jnp.maximum(jnp.asarray(n_j)[None, :] - counts, 0.0)
-    k22 = jnp.maximum(n_total - k11 - k12 - k21, 0.0)
-    llr = llr_scores(k11, k12, k21, k22)
-    # No self-correlation on the diagonal and no score without counts.
-    llr = jnp.where(counts > 0, llr, 0.0)
-    llr = llr * (1.0 - jnp.eye(n_items, dtype=llr.dtype))
-    if llr_threshold > 0:
-        llr = jnp.where(llr >= llr_threshold, llr, 0.0)
+    n_i = np.bincount(pi, minlength=n_items).astype(np.float32)
+    n_j = jnp.asarray(np.bincount(si, minlength=n_items).astype(np.float32))
+    n_total = jnp.float32(n_users)
 
     k = min(max_correlators, n_items)
-    score, idx = jax.lax.top_k(llr, k)
-    score = np.array(jax.device_get(score))
-    idx = np.array(jax.device_get(idx), np.int32)
+    block = min(item_block, n_items)
+    peu_d, pei_d, seu_d, sei_d = map(jnp.asarray, (peu, pei, seu, sei))
+
+    idx_parts, score_parts = [], []
+    for lo in range(0, n_items, block):
+        b = min(block, n_items - lo)
+        # Last stripe may be ragged: compute a full block ending at the
+        # catalog edge and slice the overlap off (same compiled shape).
+        lo_eff = min(lo, n_items - block)
+        counts = _cooccurrence_stripe(
+            peu_d, pei_d, seu_d, sei_d, jnp.int32(lo_eff),
+            n_items=n_items, u_chunk=u_chunk, block=block,
+        )
+        s, ix = _stripe_topk(
+            counts, jnp.asarray(n_i[lo_eff:lo_eff + block]), n_j,
+            jnp.int32(lo_eff), n_total, k=k, llr_threshold=llr_threshold,
+        )
+        s, ix = jax.device_get((s, ix))
+        skip = lo - lo_eff
+        score_parts.append(np.asarray(s)[skip:skip + b])
+        idx_parts.append(np.asarray(ix)[skip:skip + b])
+
+    score = np.concatenate(score_parts, axis=0)
+    idx = np.concatenate(idx_parts, axis=0).astype(np.int32)
     idx[score <= 0] = -1
     return Indicators(idx=idx, score=score.astype(np.float32))
 

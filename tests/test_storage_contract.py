@@ -190,6 +190,62 @@ def test_levents_crud_and_find(storage):
     assert le.remove(1, 5)
 
 
+def test_levents_reinsert_after_delete(storage):
+    """Delete only hides what came before it: re-inserting the same
+    eventId afterwards is visible on every backend (upsert parity)."""
+    le = storage.get_l_events()
+    le.init(9)
+    e = Event("rate", "user", "u1", "item", "i1", DataMap({"rating": 4.0}),
+              _ts(0), event_id="re-1")
+    le.insert(e, 9)
+    assert le.delete("re-1", 9)
+    assert le.get("re-1", 9) is None
+    le.insert(e, 9)
+    got = le.get("re-1", 9)
+    assert got is not None and got.properties.require("rating") == 4.0
+    assert len(list(le.find(9))) == 1
+
+
+def test_levents_delete_batch(storage):
+    le = storage.get_l_events()
+    le.init(10)
+    ids = [le.insert(
+        Event("view", "user", f"u{n}", "item", "i", DataMap(), _ts(n)), 10)
+        for n in range(6)]
+    out = le.delete_batch(ids[:4] + ["nope"], 10)
+    assert out == [True] * 4 + [False]
+    assert len(list(le.find(10))) == 2
+
+
+def test_levents_reversed_tie_order(storage):
+    """Equal-timestamp events come back in insertion order under
+    reversed_order (stable descending) on every backend."""
+    le = storage.get_l_events()
+    le.init(11)
+    for n in range(4):
+        le.insert(Event("e", "u", f"u{n}", None, None, DataMap(), _ts(0)), 11)
+    order = [e.entity_id for e in le.find(11, reversed_order=True)]
+    assert order == ["u0", "u1", "u2", "u3"]
+
+
+def test_levents_upsert_moves_to_tie_end(storage):
+    """Re-inserting an existing eventId moves it to the END of its
+    equal-timestamp tie group — identical on every backend (the JSONL log
+    re-appends; SQLite REPLACE re-inserts; memory pops+appends)."""
+    le = storage.get_l_events()
+    le.init(12)
+    le.insert(Event("e", "u", "a", None, None, DataMap({"v": 1}), _ts(0),
+                    event_id="ua"), 12)
+    le.insert(Event("e", "u", "b", None, None, DataMap(), _ts(0),
+                    event_id="ub"), 12)
+    le.insert(Event("e", "u", "a", None, None, DataMap({"v": 2}), _ts(0),
+                    event_id="ua"), 12)  # upsert
+    got = list(le.find(12))
+    assert [e.entity_id for e in got] == ["b", "a"]
+    assert got[1].properties.require("v") == 2
+    assert len(got) == 2
+
+
 def test_aggregate_properties(storage):
     le = storage.get_l_events()
     le.init(2)
