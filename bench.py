@@ -136,7 +136,7 @@ def main() -> int:
     x0 = (rng.standard_normal((by_user.padded_rows, rank)) / np.sqrt(rank)).astype(np.float32)
     y0 = (rng.standard_normal((by_item.padded_rows, rank)) / np.sqrt(rank)).astype(np.float32)
 
-    fn = _make_train_fn(mesh, params, by_user, by_item)
+    fn, _ = _make_train_fn(mesh, params, by_user, by_item)
     args = (
         np.int32(iters),
         x0, y0,

@@ -296,11 +296,12 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
         shardings["row2"], shardings["row2"],
         shardings["row1"], shardings["row1"],
     )
-    return jax.jit(
+    fitted = jax.jit(
         loop,
         in_shardings=in_shardings,
         out_shardings=(shardings["rep"], shardings["rep"]),
     )
+    return fitted, in_shardings
 
 
 def train_als(
@@ -401,11 +402,26 @@ def train_als(
 
     if x0 is None:
         x0, y0 = _fresh_init()
-    fn = _make_train_fn(mesh, params, by_user, by_item)
+    fn, in_shardings = _make_train_fn(mesh, params, by_user, by_item)
     blocks = (
         by_user.col, by_user.val, by_user.local_row, by_user.counts,
         by_item.col, by_item.val, by_item.local_row, by_item.counts,
     )
+    if jax.process_count() > 1:
+        # Multi-controller: every process holds the SAME full numpy
+        # arrays (the event store is shared), so build global jax.Arrays
+        # explicitly — jit refuses sharded numpy inputs across processes.
+        def _globalize(host, sharding):
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx]
+            )
+
+        x0 = _globalize(np.asarray(x0), in_shardings[1])
+        y0 = _globalize(np.asarray(y0), in_shardings[2])
+        blocks = tuple(
+            _globalize(np.asarray(b), s)
+            for b, s in zip(blocks, in_shardings[3:])
+        )
     chunk = checkpoint_hook.every_n if checkpoint_hook is not None and checkpoint_hook.enabled else 0
     if chunk and params.num_iterations - start_iter > chunk:
         x, y = x0, y0

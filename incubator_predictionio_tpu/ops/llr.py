@@ -61,8 +61,14 @@ def llr_scores(k11, k12, k21, k22):
 def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
                        n_ranges: int):
     """Host prep: sort (user, item) pairs by user range and lay them out
-    as [n_ranges, E] slabs (-1 padded), so the device scan step for range
-    k touches only range k's events."""
+    as [n_ranges, E] slabs (-1 padded) plus a per-row range base offset,
+    so the device scan step for slab row r touches only events of one
+    user range. A range's primary and secondary slabs must be COMPLETE
+    for the per-step product to count every cross pair, so ranges are
+    never split here — skewed heavy users are extracted beforehand (see
+    ``cco_indicators``) to keep E near the mean.
+
+    Returns (eu [n_ranges, E], ei [n_ranges, E], row_lo [n_ranges])."""
     # Events whose user id falls outside [0, n_ranges*u_chunk) are dropped
     # (contract: user ids < n_users; the pre-rewrite slab mask silently
     # ignored them too, and a bad id must not corrupt the layout).
@@ -70,27 +76,33 @@ def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
     u, i = u[valid], i[valid]
     order = np.argsort(u, kind="stable")
     us, is_ = u[order], i[order]
-    chunk_of = us // u_chunk
+    chunk_of = (us // u_chunk).astype(np.int64)
     counts = np.bincount(chunk_of, minlength=n_ranges)
     e = max(int(counts.max()), 1) if counts.size else 1
-    eu = np.full((n_ranges, e), -1, np.int32)
-    ei = np.full((n_ranges, e), -1, np.int32)
+
     starts = np.zeros(n_ranges + 1, np.int64)
     np.cumsum(counts, out=starts[1:])
     pos = np.arange(len(us)) - starts[chunk_of]
+    eu = np.full((n_ranges, e), -1, np.int32)
+    ei = np.full((n_ranges, e), -1, np.int32)
     eu[chunk_of, pos] = us
     ei[chunk_of, pos] = is_
-    return eu, ei
+    row_lo = np.arange(n_ranges, dtype=np.int32) * u_chunk
+    return eu, ei, row_lo
 
 
 @functools.partial(jax.jit, static_argnames=("n_items", "u_chunk", "block"))
-def _cooccurrence_stripe(peu, pei, seu, sei, lo_item, n_items: int,
-                         u_chunk: int, block: int):
+def _cooccurrence_stripe(peu, pei, plo, seu, sei, slo, lo_item,
+                         n_items: int, u_chunk: int, block: int):
     """One stripe C[lo_item:lo_item+block, :] of the co-occurrence
-    matrix: Σ over user ranges of slab_p[:, stripe]ᵀ @ slab_s. Inputs are
-    the host-partitioned [n_ranges, E] event slabs; each scan step
-    scatters only its own range's events. Binary slabs are bf16 (exact)
-    so the matmul runs at full MXU rate with f32 accumulation."""
+    matrix: Σ over slab rows of slab_p[:, stripe]ᵀ @ slab_s. Inputs are
+    the host-partitioned [n_rows, E] event slabs with per-row range base
+    offsets (plo/slo); each scan step scatters only its own row's events.
+    Binary slabs are bf16 (exact) so the matmul runs at full MXU rate
+    with f32 accumulation.
+
+    Heavy users are not in the slabs; their exact contribution is the
+    dense-membership matmul added by the caller (``_heavy_stripe``)."""
 
     def slab(uu, ii, lo):
         ok = uu >= 0
@@ -101,21 +113,16 @@ def _cooccurrence_stripe(peu, pei, seu, sei, lo_item, n_items: int,
         return a[:u_chunk]
 
     def body(c, chunk):
-        eu_p, ei_p, eu_s, ei_s, k = chunk
-        lo = k * u_chunk
+        eu_p, ei_p, lo_p, eu_s, ei_s, lo_s = chunk
         ap = jax.lax.dynamic_slice(
-            slab(eu_p, ei_p, lo), (0, lo_item), (u_chunk, block))
-        asec = slab(eu_s, ei_s, lo)
+            slab(eu_p, ei_p, lo_p), (0, lo_item), (u_chunk, block))
+        asec = slab(eu_s, ei_s, lo_s)
         c = c + jnp.einsum("ui,uj->ij", ap, asec,
                            preferred_element_type=jnp.float32)
         return c, None
 
-    n_ranges = peu.shape[0]
     c0 = jnp.zeros((block, n_items), jnp.float32)
-    c, _ = jax.lax.scan(
-        body, c0,
-        (peu, pei, seu, sei, jnp.arange(n_ranges, dtype=jnp.int32)),
-    )
+    c, _ = jax.lax.scan(body, c0, (peu, pei, plo, seu, sei, slo))
     return c
 
 
@@ -174,9 +181,13 @@ def cco_indicators(
 
     def dedupe(u, i):
         # Packed-key unique: ~30x faster than np.unique(axis=0) (which
-        # lexsorts void-dtype rows) at 1M-event scale.
+        # lexsorts void-dtype rows) at 1M-event scale. Out-of-range user
+        # AND item ids are dropped BEFORE packing (a bad id would alias
+        # into a different pair or break the bincounts downstream).
         u = np.asarray(u, np.int64)
         i = np.asarray(i, np.int64)
+        valid = (i >= 0) & (i < n_items) & (u >= 0) & (u < n_users)
+        u, i = u[valid], i[valid]
         key = np.unique(u * n_items + i)
         return ((key // n_items).astype(np.int32),
                 (key % n_items).astype(np.int32))
@@ -184,8 +195,47 @@ def cco_indicators(
     pu, pi = dedupe(primary_u, primary_i)
     su, si = dedupe(secondary_u, secondary_i)
     n_ranges = max((n_users + u_chunk - 1) // u_chunk, 1)
-    peu, pei = _partition_by_user(pu, pi, u_chunk, n_ranges)
-    seu, sei = _partition_by_user(su, si, u_chunk, n_ranges)
+
+    # Heavy-user extraction: a user with far more interactions than the
+    # mean would inflate every slab row's width E (user ranges cannot be
+    # split — a scan step's product needs the range's COMPLETE
+    # primary+secondary events to count every cross pair). Heavy users
+    # are renumbered onto a dense RANK space and processed through the
+    # SAME striped kernel with u_chunk-sized rank ranges: each rank range
+    # holds few (very active) users, so its slab width stays bounded
+    # while every heavy range fits the same [u_chunk+1, I] slab budget.
+    cnt_p = np.bincount(pu, minlength=n_users) if len(pu) else np.zeros(n_users, np.int64)
+    cnt_s = np.bincount(su, minlength=n_users) if len(su) else np.zeros(n_users, np.int64)
+    per_user = cnt_p + cnt_s
+    mean_pu = max(float(per_user.sum()) / max(n_users, 1), 1.0)
+    heavy_cap = max(int(16 * mean_pu), 256)
+    heavy_users = np.nonzero(per_user > heavy_cap)[0]
+    n_heavy = int(len(heavy_users))
+    if n_heavy:
+        rank = np.full(n_users, -1, np.int64)
+        rank[heavy_users] = np.arange(n_heavy)
+
+        def split_heavy(u, i):
+            hm = rank[u] >= 0
+            return (u[~hm], i[~hm],
+                    rank[u[hm]].astype(np.int32), i[hm].astype(np.int32))
+
+        pu_l, pi_l, hp_u, hp_i = split_heavy(pu, pi)
+        su_l, si_l, hs_u, hs_i = split_heavy(su, si)
+        # FEW heavy users per rank range (16), so one range's slab width
+        # stays ≈ 16 heavy histories, not u_chunk of them. The slab
+        # height is the range size, so heavy slabs are [17, I] — tiny.
+        h_per = 16
+        h_ranges = max((n_heavy + h_per - 1) // h_per, 1)
+        hpeu, hpei, hplo = _partition_by_user(hp_u, hp_i, h_per, h_ranges)
+        hseu, hsei, hslo = _partition_by_user(hs_u, hs_i, h_per, h_ranges)
+        heavy_dev = tuple(map(
+            jnp.asarray, (hpeu, hpei, hplo, hseu, hsei, hslo)))
+    else:
+        pu_l, pi_l, su_l, si_l = pu, pi, su, si
+
+    peu, pei, plo = _partition_by_user(pu_l, pi_l, u_chunk, n_ranges)
+    seu, sei, slo = _partition_by_user(su_l, si_l, u_chunk, n_ranges)
 
     n_i = np.bincount(pi, minlength=n_items).astype(np.float32)
     n_j = jnp.asarray(np.bincount(si, minlength=n_items).astype(np.float32))
@@ -193,7 +243,8 @@ def cco_indicators(
 
     k = min(max_correlators, n_items)
     block = min(item_block, n_items)
-    peu_d, pei_d, seu_d, sei_d = map(jnp.asarray, (peu, pei, seu, sei))
+    peu_d, pei_d, plo_d, seu_d, sei_d, slo_d = map(
+        jnp.asarray, (peu, pei, plo, seu, sei, slo))
 
     idx_parts, score_parts = [], []
     for lo in range(0, n_items, block):
@@ -202,9 +253,14 @@ def cco_indicators(
         # catalog edge and slice the overlap off (same compiled shape).
         lo_eff = min(lo, n_items - block)
         counts = _cooccurrence_stripe(
-            peu_d, pei_d, seu_d, sei_d, jnp.int32(lo_eff),
+            peu_d, pei_d, plo_d, seu_d, sei_d, slo_d, jnp.int32(lo_eff),
             n_items=n_items, u_chunk=u_chunk, block=block,
         )
+        if n_heavy:
+            counts = counts + _cooccurrence_stripe(
+                *heavy_dev, jnp.int32(lo_eff),
+                n_items=n_items, u_chunk=16, block=block,
+            )
         s, ix = _stripe_topk(
             counts, jnp.asarray(n_i[lo_eff:lo_eff + block]), n_j,
             jnp.int32(lo_eff), n_total, k=k, llr_threshold=llr_threshold,

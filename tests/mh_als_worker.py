@@ -1,0 +1,44 @@
+"""Worker for test_multihost.py: one training process of a 2-process
+jax.distributed run. Trains the same tiny ALS problem over the GLOBAL
+mesh and (process 0) writes the factors for the parent to compare."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from incubator_predictionio_tpu.parallel.distributed import (  # noqa: E402
+    initialize_distributed,
+)
+
+initialize_distributed()
+
+import numpy as np  # noqa: E402
+
+from incubator_predictionio_tpu.ops.als import ALSParams, train_als  # noqa: E402
+from incubator_predictionio_tpu.parallel.mesh import mesh_from_devices  # noqa: E402
+
+
+def main() -> int:
+    out_path = sys.argv[1]
+    rng = np.random.default_rng(11)
+    n_users, n_items, nnz = 40, 30, 600
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = (rng.integers(1, 11, nnz) / 2.0).astype(np.float32)
+
+    mesh = mesh_from_devices(devices=jax.devices())  # global: spans processes
+    params = ALSParams(rank=4, num_iterations=3, block_len=8, seed=5)
+    out = train_als(u, i, r, n_users, n_items, params, mesh=mesh)
+
+    if jax.process_index() == 0:
+        np.savez(out_path, user=out.user_factors, item=out.item_factors)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

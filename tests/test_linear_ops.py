@@ -122,6 +122,82 @@ def test_llr_contingency_uses_distinct_users():
     np.testing.assert_allclose(ind.score[0, slot], g, rtol=1e-4)
 
 
+def _dense_llr_reference(pu, pi, su, si, n_users, n_items):
+    A = np.zeros((n_users, n_items)); A[pu, pi] = 1
+    B = np.zeros((n_users, n_items)); B[su, si] = 1
+    C = A.T @ B
+    ni, nj, N = A.sum(0), B.sum(0), float(n_users)
+
+    def xlogx(x):
+        return np.where(x > 0, x * np.log(np.maximum(x, 1e-30)), 0.0)
+
+    def ent2(a, b):
+        return xlogx(a + b) - xlogx(a) - xlogx(b)
+
+    k11 = C
+    k12 = np.maximum(ni[:, None] - C, 0)
+    k21 = np.maximum(nj[None, :] - C, 0)
+    k22 = np.maximum(N - k11 - k12 - k21, 0)
+    llr = np.maximum(
+        2 * (ent2(k11 + k12, k21 + k22) + ent2(k11 + k21, k12 + k22)
+             - (xlogx(k11 + k12 + k21 + k22) - xlogx(k11) - xlogx(k12)
+                - xlogx(k21) - xlogx(k22))), 0.0)
+    llr = np.where(C > 0, llr, 0.0)
+    np.fill_diagonal(llr, 0.0)
+    return llr
+
+
+def test_cco_striped_matches_dense_reference():
+    """Item-axis striping + ragged last stripe must reproduce the dense
+    LLR matrix exactly (top-k score sets compared per item)."""
+    from incubator_predictionio_tpu.ops.llr import cco_indicators
+
+    rng = np.random.default_rng(3)
+    n_users, n_items, nnz = 150, 90, 2500
+    pu = rng.integers(0, n_users, nnz).astype(np.int32)
+    pi = rng.integers(0, n_items, nnz).astype(np.int32)
+    su = rng.integers(0, n_users, nnz).astype(np.int32)
+    si = rng.integers(0, n_items, nnz).astype(np.int32)
+    llr = _dense_llr_reference(pu, pi, su, si, n_users, n_items)
+    for blk in (90, 64):  # exact fit and ragged last stripe
+        ind = cco_indicators(pu, pi, su, si, n_users, n_items,
+                             max_correlators=5, u_chunk=32, item_block=blk)
+        for i in range(n_items):
+            exp = np.sort(llr[i])[::-1][:5]
+            got = np.sort(np.where(ind.idx[i] >= 0, ind.score[i], 0))[::-1][:5]
+            n = int((exp > 0).sum())
+            np.testing.assert_allclose(got[:n], exp[:n], atol=1e-2)
+
+
+def test_cco_heavy_user_extraction_is_exact():
+    """Bot users (far above mean activity) are computed via the dense
+    membership matmul path; results must still match the dense
+    reference, and out-of-range item/user ids are dropped."""
+    from incubator_predictionio_tpu.ops.llr import cco_indicators
+
+    rng = np.random.default_rng(7)
+    n_users, n_items = 200, 120
+    pu = rng.integers(0, n_users, 2000).astype(np.int32)
+    pi = rng.integers(0, n_items, 2000).astype(np.int32)
+    for bot in (5, 50, 199):
+        pu = np.concatenate([pu, np.full(400, bot, np.int32)])
+        pi = np.concatenate([pi, rng.integers(0, n_items, 400).astype(np.int32)])
+    su, si = pu[::-1].copy(), ((pi + 3) % n_items)[::-1].copy()
+    llr = _dense_llr_reference(pu, pi, su, si, n_users, n_items)
+
+    # out-of-range ids must be ignored, not aliased into other pairs
+    pu_bad = np.concatenate([pu, [3, 4]]).astype(np.int32)
+    pi_bad = np.concatenate([pi, [-1, n_items]]).astype(np.int32)
+
+    ind = cco_indicators(pu_bad, pi_bad, su, si, n_users, n_items,
+                         max_correlators=6, u_chunk=32, item_block=64)
+    for i in range(n_items):
+        exp = np.sort(llr[i])[::-1][:6]
+        got = np.sort(np.where(ind.idx[i] >= 0, ind.score[i], 0))[::-1][:6]
+        n = int((exp > 0).sum())
+        np.testing.assert_allclose(got[:min(n, 6)], exp[:min(n, 6)], atol=1e-2)
+
+
 def test_ur_boost_applied_before_topk(memory_storage):
     """Review fix: bias>0 field boosts must influence selection."""
     from incubator_predictionio_tpu.ops.llr import Indicators, score_user
