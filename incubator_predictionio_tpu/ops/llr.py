@@ -101,8 +101,9 @@ def _cooccurrence_stripe(peu, pei, plo, seu, sei, slo, lo_item,
     Binary slabs are bf16 (exact) so the matmul runs at full MXU rate
     with f32 accumulation.
 
-    Heavy users are not in the slabs; their exact contribution is the
-    dense-membership matmul added by the caller (``_heavy_stripe``)."""
+    Heavy users are not in the light slabs; ``cco_indicators`` routes
+    them through this same kernel with rank-renumbered ids and small
+    rank ranges."""
 
     def slab(uu, ii, lo):
         ok = uu >= 0

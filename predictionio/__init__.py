@@ -1,0 +1,258 @@
+"""Drop-in replacement for the `predictionio` Python SDK.
+
+Existing client code written against the official PredictionIO Python SDK
+(`pip install predictionio`: EventClient / EngineClient, apache/
+predictionio-sdk-python) runs unchanged against this framework's event
+server (:7070) and engine server (:8000) — the wire formats are
+compatible, so this module only needs a small HTTP client.
+
+Implements the SDK surface that real templates/quickstarts use:
+
+- ``EventClient(access_key, url)``: create_event, acreate_event,
+  get_event, delete_event, create_events (batch ≤ 50),
+  set_user/set_item (``$set`` sugar), record_user_action_on_item.
+- ``EngineClient(url)``: send_query, asend_query.
+- ``FileExporter``: write events to a JSONL file for `pio import`.
+- ``NotCreatedError`` / ``NotFoundError`` exception types.
+
+The a* variants are synchronous here (the upstream SDK's async returns
+an AsyncRequest whose .get_response() blocks; callers that immediately
+call get_response — the common pattern — behave identically via the
+small shim below).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+__version__ = "0.9.9-tpu"
+
+
+class PredictionIOError(Exception):
+    pass
+
+
+class NotCreatedError(PredictionIOError):
+    pass
+
+
+class NotFoundError(PredictionIOError):
+    pass
+
+
+def _event_time_str(t: Optional[_dt.datetime]) -> str:
+    t = t or _dt.datetime.now(_dt.timezone.utc)
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return t.isoformat(timespec="milliseconds")
+
+
+class _SyncResult:
+    """Stand-in for the upstream AsyncRequest: .get_response() returns
+    the already-computed result."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def get_response(self):
+        return self._value
+
+
+class BaseClient:
+    def __init__(self, url: str, threads: int = 1, qsize: int = 0,
+                 timeout: float = 5.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, params: dict,
+                 body: Optional[dict] = None) -> Any:
+        qs = urllib.parse.urlencode({k: v for k, v in params.items()
+                                     if v is not None})
+        url = f"{self.url}{path}" + (f"?{qs}" if qs else "")
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise NotFoundError(f"{e.code}: {detail}") from e
+            raise NotCreatedError(f"{e.code}: {detail}") from e
+        except (urllib.error.URLError, OSError) as e:
+            # Connection refused / DNS / timeout: keep the advertised
+            # exception hierarchy so `except PredictionIOError` works.
+            raise PredictionIOError(f"request to {url} failed: {e}") from e
+
+    def close(self) -> None:  # upstream API compat
+        pass
+
+
+class EventClient(BaseClient):
+    """Client for the Event Server (reference SDK: predictionio.EventClient)."""
+
+    def __init__(self, access_key: str, url: str = "http://localhost:7070",
+                 threads: int = 1, qsize: int = 0, timeout: float = 5.0,
+                 channel: Optional[str] = None):
+        super().__init__(url, threads, qsize, timeout)
+        self.access_key = access_key
+        self.channel = channel
+
+    def _params(self) -> dict:
+        return {"accessKey": self.access_key, "channel": self.channel}
+
+    # -- core event API ---------------------------------------------------
+    def create_event(self, event: str, entity_type: str, entity_id: str,
+                     target_entity_type: Optional[str] = None,
+                     target_entity_id: Optional[str] = None,
+                     properties: Optional[dict] = None,
+                     event_time: Optional[_dt.datetime] = None) -> dict:
+        body = {
+            "event": event,
+            "entityType": entity_type,
+            "entityId": entity_id,
+            "eventTime": _event_time_str(event_time),
+        }
+        if target_entity_type is not None:
+            body["targetEntityType"] = target_entity_type
+        if target_entity_id is not None:
+            body["targetEntityId"] = target_entity_id
+        if properties is not None:
+            body["properties"] = properties
+        return self._request("POST", "/events.json", self._params(), body)
+
+    def acreate_event(self, *args, **kwargs) -> _SyncResult:
+        return _SyncResult(self.create_event(*args, **kwargs))
+
+    def create_events(self, events: list[dict]) -> list[dict]:
+        """Batch endpoint (≤50 events per call, like the reference)."""
+        return self._request("POST", "/batch/events.json", self._params(),
+                             events)
+
+    def get_event(self, event_id: str) -> dict:
+        return self._request("GET", f"/events/{urllib.parse.quote(event_id)}.json",
+                             self._params())
+
+    def aget_event(self, event_id: str) -> _SyncResult:
+        return _SyncResult(self.get_event(event_id))
+
+    def delete_event(self, event_id: str) -> dict:
+        return self._request(
+            "DELETE", f"/events/{urllib.parse.quote(event_id)}.json",
+            self._params())
+
+    def adelete_event(self, event_id: str) -> _SyncResult:
+        return _SyncResult(self.delete_event(event_id))
+
+    # -- convenience sugar (upstream SDK parity) --------------------------
+    def set_user(self, uid: str, properties: Optional[dict] = None,
+                 event_time: Optional[_dt.datetime] = None) -> dict:
+        return self.create_event("$set", "user", uid,
+                                 properties=properties or {},
+                                 event_time=event_time)
+
+    def aset_user(self, *args, **kwargs) -> _SyncResult:
+        return _SyncResult(self.set_user(*args, **kwargs))
+
+    def unset_user(self, uid: str, properties: dict,
+                   event_time: Optional[_dt.datetime] = None) -> dict:
+        return self.create_event("$unset", "user", uid,
+                                 properties=properties,
+                                 event_time=event_time)
+
+    def delete_user(self, uid: str,
+                    event_time: Optional[_dt.datetime] = None) -> dict:
+        return self.create_event("$delete", "user", uid,
+                                 event_time=event_time)
+
+    def set_item(self, iid: str, properties: Optional[dict] = None,
+                 event_time: Optional[_dt.datetime] = None) -> dict:
+        return self.create_event("$set", "item", iid,
+                                 properties=properties or {},
+                                 event_time=event_time)
+
+    def aset_item(self, *args, **kwargs) -> _SyncResult:
+        return _SyncResult(self.set_item(*args, **kwargs))
+
+    def unset_item(self, iid: str, properties: dict,
+                   event_time: Optional[_dt.datetime] = None) -> dict:
+        return self.create_event("$unset", "item", iid,
+                                 properties=properties,
+                                 event_time=event_time)
+
+    def delete_item(self, iid: str,
+                    event_time: Optional[_dt.datetime] = None) -> dict:
+        return self.create_event("$delete", "item", iid,
+                                 event_time=event_time)
+
+    def record_user_action_on_item(self, action: str, uid: str, iid: str,
+                                   properties: Optional[dict] = None,
+                                   event_time: Optional[_dt.datetime] = None) -> dict:
+        return self.create_event(action, "user", uid,
+                                 target_entity_type="item",
+                                 target_entity_id=iid,
+                                 properties=properties,
+                                 event_time=event_time)
+
+    def arecord_user_action_on_item(self, *args, **kwargs) -> _SyncResult:
+        return _SyncResult(self.record_user_action_on_item(*args, **kwargs))
+
+
+class EngineClient(BaseClient):
+    """Client for a deployed engine (reference SDK:
+    predictionio.EngineClient)."""
+
+    def __init__(self, url: str = "http://localhost:8000", threads: int = 1,
+                 qsize: int = 0, timeout: float = 5.0):
+        super().__init__(url, threads, qsize, timeout)
+
+    def send_query(self, data: dict) -> dict:
+        return self._request("POST", "/queries.json", {}, data)
+
+    def asend_query(self, data: dict) -> _SyncResult:
+        return _SyncResult(self.send_query(data))
+
+
+class FileExporter:
+    """Write events to a JSONL file consumable by `pio import`
+    (reference SDK: predictionio.FileExporter)."""
+
+    def __init__(self, file_name: str):
+        self._f = open(file_name, "w", encoding="utf-8")
+
+    def create_event(self, event: str, entity_type: str, entity_id: str,
+                     target_entity_type: Optional[str] = None,
+                     target_entity_id: Optional[str] = None,
+                     properties: Optional[dict] = None,
+                     event_time: Optional[_dt.datetime] = None) -> None:
+        obj = {
+            "event": event,
+            "entityType": entity_type,
+            "entityId": entity_id,
+            "eventTime": _event_time_str(event_time),
+        }
+        if target_entity_type is not None:
+            obj["targetEntityType"] = target_entity_type
+        if target_entity_id is not None:
+            obj["targetEntityId"] = target_entity_id
+        if properties is not None:
+            obj["properties"] = properties
+        self._f.write(json.dumps(obj) + "\n")
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -170,27 +170,38 @@ def test_cco_striped_matches_dense_reference():
 
 
 def test_cco_heavy_user_extraction_is_exact():
-    """Bot users (far above mean activity) are computed via the dense
-    membership matmul path; results must still match the dense
-    reference, and out-of-range item/user ids are dropped."""
-    from incubator_predictionio_tpu.ops.llr import cco_indicators
+    """Bot users (far above mean activity) are routed through the
+    rank-renumbered heavy path; results must still match the dense
+    reference, and out-of-range item/user ids are dropped. The catalog
+    must be large enough that a bot's distinct-item count can exceed the
+    heavy_cap floor of 256 — assert the branch actually triggers."""
+    from incubator_predictionio_tpu.ops import llr as L
 
     rng = np.random.default_rng(7)
-    n_users, n_items = 200, 120
+    n_users, n_items = 200, 400
     pu = rng.integers(0, n_users, 2000).astype(np.int32)
     pi = rng.integers(0, n_items, 2000).astype(np.int32)
     for bot in (5, 50, 199):
-        pu = np.concatenate([pu, np.full(400, bot, np.int32)])
-        pi = np.concatenate([pi, rng.integers(0, n_items, 400).astype(np.int32)])
+        pu = np.concatenate([pu, np.full(900, bot, np.int32)])
+        pi = np.concatenate([pi, rng.integers(0, n_items, 900).astype(np.int32)])
     su, si = pu[::-1].copy(), ((pi + 3) % n_items)[::-1].copy()
     llr = _dense_llr_reference(pu, pi, su, si, n_users, n_items)
 
-    # out-of-range ids must be ignored, not aliased into other pairs
-    pu_bad = np.concatenate([pu, [3, 4]]).astype(np.int32)
-    pi_bad = np.concatenate([pi, [-1, n_items]]).astype(np.int32)
+    # the heavy branch must actually trigger for this data: replicate
+    # cco_indicators' cap computation on deduped pairs
+    key_p = np.unique(pu.astype(np.int64) * n_items + pi)
+    key_s = np.unique(su.astype(np.int64) * n_items + si)
+    cp = np.bincount((key_p // n_items).astype(int), minlength=n_users)
+    cs = np.bincount((key_s // n_items).astype(int), minlength=n_users)
+    cap = max(int(16 * max((cp + cs).sum() / n_users, 1.0)), 256)
+    assert ((cp + cs) > cap).any(), "test data no longer triggers heavy path"
 
-    ind = cco_indicators(pu_bad, pi_bad, su, si, n_users, n_items,
-                         max_correlators=6, u_chunk=32, item_block=64)
+    # out-of-range ids must be ignored, not aliased into other pairs
+    pu_bad = np.concatenate([pu, [3, 4, n_users + 7]]).astype(np.int32)
+    pi_bad = np.concatenate([pi, [-1, n_items, 2]]).astype(np.int32)
+
+    ind = L.cco_indicators(pu_bad, pi_bad, su, si, n_users, n_items,
+                           max_correlators=6, u_chunk=32, item_block=64)
     for i in range(n_items):
         exp = np.sort(llr[i])[::-1][:6]
         got = np.sort(np.where(ind.idx[i] >= 0, ind.score[i], 0))[::-1][:6]

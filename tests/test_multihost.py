@@ -51,9 +51,16 @@ def test_two_process_training_matches_single_process(tmp_path):
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         ))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out.decode(errors="replace"))
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        # A deadlocked collective must not leak workers pinning the
+        # coordinator port for the rest of the run.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
     assert os.path.exists(out_path), outs[0][-2000:]

@@ -1,0 +1,107 @@
+"""The drop-in `predictionio` SDK module against the REAL servers.
+
+Reference ecosystem: apache/predictionio-sdk-python — the code users
+already have. These tests exercise EventClient / EngineClient /
+FileExporter over actual HTTP end to end.
+"""
+
+import datetime as dt
+
+import pytest
+
+import predictionio
+
+from incubator_predictionio_tpu.controller import EngineParams
+from incubator_predictionio_tpu.data.api.event_server import EventServer
+from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+from incubator_predictionio_tpu.models.recommendation import RecommendationEngine
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import run_train
+from incubator_predictionio_tpu.workflow.create_server import EngineServer
+
+from server_utils import ServerThread
+
+
+@pytest.fixture
+def event_app(memory_storage):
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "sdkapp", None))
+    memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("SDKKEY", app_id, ()))
+    memory_storage.get_l_events().init(app_id)
+    server = EventServer(storage=memory_storage)
+    return server, app_id
+
+
+def test_event_client_lifecycle(event_app):
+    server, app_id = event_app
+    with ServerThread(server.app) as st:
+        client = predictionio.EventClient("SDKKEY", st.base)
+        r = client.create_event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i1",
+            properties={"rating": 4.5},
+            event_time=dt.datetime(2024, 6, 1, tzinfo=dt.timezone.utc),
+        )
+        eid = r["eventId"]
+        got = client.get_event(eid)
+        assert got["entityId"] == "u1"
+        assert got["properties"]["rating"] == 4.5
+
+        # async-style shim
+        r2 = client.arecord_user_action_on_item("buy", "u1", "i2").get_response()
+        assert "eventId" in r2
+
+        # $set sugar
+        client.set_user("u9", {"age": 33})
+        client.set_item("i9", {"categories": ["a"]})
+
+        # batch
+        out = client.create_events([
+            {"event": "view", "entityType": "user", "entityId": "u2",
+             "targetEntityType": "item", "targetEntityId": "i1",
+             "eventTime": "2024-06-02T00:00:00.000Z"},
+            {"event": "view", "entityType": "user", "entityId": "u3",
+             "targetEntityType": "item", "targetEntityId": "i1",
+             "eventTime": "2024-06-02T00:00:00.000Z"},
+        ])
+        assert isinstance(out, (list, dict))
+
+        client.delete_event(eid)
+        with pytest.raises(predictionio.NotFoundError):
+            client.get_event(eid)
+
+        # bad key rejected
+        bad = predictionio.EventClient("WRONG", st.base)
+        with pytest.raises(predictionio.PredictionIOError):
+            bad.create_event(event="x", entity_type="user", entity_id="u")
+
+
+def test_engine_client_query(memory_storage):
+    from test_dase_train_e2e import ENGINE_PARAMS, _seed_ratings
+
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rec")
+    server = EngineServer(engine, engine_factory_name="rec",
+                          storage=memory_storage)
+    with ServerThread(server.app) as st:
+        client = predictionio.EngineClient(st.base)
+        res = client.send_query({"user": "1", "num": 3})
+        assert len(res["itemScores"]) == 3
+        res2 = client.asend_query({"user": "2", "num": 1}).get_response()
+        assert len(res2["itemScores"]) == 1
+
+
+def test_file_exporter(tmp_path):
+    import json
+
+    path = str(tmp_path / "exported.jsonl")
+    with predictionio.FileExporter(path) as ex:
+        ex.create_event(event="rate", entity_type="user", entity_id="u1",
+                        target_entity_type="item", target_entity_id="i1",
+                        properties={"rating": 5})
+    rows = [json.loads(line) for line in open(path)]
+    assert rows[0]["event"] == "rate"
+    assert rows[0]["properties"]["rating"] == 5
+    assert "eventTime" in rows[0]
