@@ -99,7 +99,8 @@ def _tile_grams(y, col, val, mask, *, implicit, alpha, compute_dtype):
 
 def _half_step_local(y, col, val, local_row, counts, yty, *,
                      rows_per_shard, reg, lambda_scaling, implicit, alpha,
-                     compute_dtype, chunk_tiles=0, row_span=0):
+                     compute_dtype, chunk_tiles=0, row_span=0,
+                     platform=None):
     """Solve one side's factors for one shard's rows (runs inside
     shard_map; all arrays are the local shard). ``y`` includes a trailing
     all-zero sentinel row that padding column indices resolve to."""
@@ -196,7 +197,11 @@ def _half_step_local(y, col, val, local_row, counts, yty, *,
 
     # Batched SPD solve: Pallas VMEM Gauss-Jordan on TPU (43x the XLA
     # batched-Cholesky lowering at ml20m shape), XLA Cholesky elsewhere.
-    x = batched_spd_solve(a, b, vma=(DATA_AXIS,))
+    # platform is the MESH's device platform, threaded from the caller —
+    # jax.default_backend() is wrong here: the driver dry-runs a CPU mesh
+    # while a TPU is still the process default backend (and vice versa in
+    # tests), and pallas_call on CPU without interpret mode is an error.
+    x = batched_spd_solve(a, b, vma=(DATA_AXIS,), platform=platform)
     return x.astype(jnp.float32)
 
 
@@ -228,6 +233,10 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
     """Build the jitted full training loop for fixed layouts."""
     cd = jnp.bfloat16 if params.compute_dtype == "bfloat16" else jnp.float32
     implicit = params.implicit_prefs
+    # Kernel selection must follow the MESH's platform, not the process
+    # default backend: the driver validates multi-chip sharding on a
+    # virtual CPU mesh while the sandbox TPU stays the default backend.
+    mesh_platform = mesh.devices.flat[0].platform
 
     row_spec = P(DATA_AXIS)          # tiles / rows split over mesh
     rep = P()                        # replicated
@@ -260,6 +269,7 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
                 compute_dtype=cd,
                 chunk_tiles=params.chunk_tiles,
                 row_span=row_span,
+                platform=mesh_platform,
             ),
             mesh=mesh,
             in_specs=(rep, row_spec, row_spec, row_spec, row_spec, rep),

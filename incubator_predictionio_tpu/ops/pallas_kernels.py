@@ -121,18 +121,27 @@ def _solve_reference(a, b):
 
 
 def batched_spd_solve(a, b, *, use_pallas: bool | None = None,
+                      platform: str | None = None,
                       interpret: bool = False, vma=None):
     """Solve N independent SPD systems a[i] @ x[i] = b[i].
 
     a: [N, k, k] float32, b: [N, k] float32 → x [N, k] float32.
 
-    ``use_pallas=None`` auto-selects: the Pallas kernel on TPU for
-    k ≤ 64, the XLA Cholesky path otherwise. Traceable (jit/shard_map
-    safe): all shape logic is static.
+    ``use_pallas=None`` auto-selects: the Pallas kernel when ``platform``
+    is "tpu" and k ≤ 64 (the kernel's VMEM slab cap), the XLA Cholesky
+    path otherwise. ``platform`` must be the platform of the devices that
+    will EXECUTE this computation — pass the mesh's device platform when
+    calling under shard_map/jit-with-shardings; it defaults to
+    ``jax.default_backend()``, which is only correct outside any explicit
+    mesh (the driver dry-runs CPU meshes while a TPU stays the process
+    default backend). Traceable (jit/shard_map safe): all shape logic is
+    static.
     """
     n, k = b.shape
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu" and k <= 64
+        if platform is None:
+            platform = jax.default_backend()
+        use_pallas = platform == "tpu" and k <= 64
     if not use_pallas:
         return _solve_reference(a, b)
 
