@@ -7,9 +7,19 @@ SURVEY.md §2.9). This is a ground-up TPU design instead, following the ALX
 recipe (PAPERS.md: arxiv 2112.02194):
 
 - Factor matrices are dense f32 arrays. The side being *solved* is
-  row-sharded over the mesh data axis; the counterpart factor matrix is
-  gathered (replicated) for the solve — the ICI all-gather replaces
-  MLlib's factor shuffle.
+  row-sharded over the mesh data axis; on a 1-D mesh the counterpart
+  factor matrix is gathered (replicated) for the solve — the ICI
+  all-gather replaces MLlib's factor shuffle.
+- On a 2-D (d, m) mesh the counterpart is instead row-sharded over the
+  MODEL_AXIS (the ALX sharded layout): each device gathers only rows it
+  owns (zeros elsewhere) and the per-row normal equations — linear in
+  per-entry outer products — are psummed over 'm'. HBM budget: factor
+  storage per device is n_rows·k·4/m bytes instead of n_rows·k·4, so
+  catalog capacity scales linearly with the model axis; e.g. 20M items
+  at rank 128 is 10.2 GB replicated (over a v5e's 16 GB once both sides
+  plus tiles are resident) but 1.3 GB/device on an m=8 ring. The extra
+  cost is one [rows/d, k, k] psum per half-step plus the d↔m all-to-all
+  that re-shards freshly solved factors.
 - Ratings are laid out as blocked-COO tiles (ops/blocked.py), twice:
   user-major and item-major. Per-tile Gram matrices are batched einsums
   on the MXU; tile→row segment-sums are device-local by construction.
@@ -38,7 +48,7 @@ from jax import shard_map
 
 from .blocked import BlockedRows, ShardedBlocked, build_blocked, shard_blocked
 from .pallas_kernels import batched_spd_solve
-from ..parallel.mesh import DATA_AXIS, default_mesh
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,44 +78,77 @@ class ALSFactors:
     n_items: int
 
 
-def _tile_grams(y, col, val, mask, *, implicit, alpha, compute_dtype):
-    """Per-tile normal-equation contributions: grams [B,k,k], rhs [B,k].
+def _grams_from_p(p, val, *, implicit, alpha, compute_dtype):
+    """Per-tile normal-equation contributions from gathered counterpart
+    rows p [B, L, k]: grams [B, k, k], rhs [B, k].
 
-    ``mask=None`` selects sentinel mode: padding slots point their column
-    index at a guaranteed-zero factor row (see ``train_als``), so gathered
-    padding rows are exactly 0 and every mask multiply — plus the 4-byte-
-    per-entry mask read in the HBM-bound scan — disappears.
+    Padding / non-owned slots must already be zero rows in p. Both sums
+    are linear in per-entry outer products (each entry l contributes
+    p_l·p_lᵀ resp. w_l·p_l), so zero rows contribute nothing — and
+    shard-partial p's (each model shard zeroing rows it doesn't own)
+    psum to exactly the full-gather result.
     """
     cd = compute_dtype
-    p = y[col].astype(cd)  # [B, L, k] gather of counterpart factors
-    pm = p if mask is None else p * mask[..., None].astype(cd)
     if implicit:
         # Hu-Koren-Volinsky: A = YᵀY + Yᵀ(C-I)Y + λ·c·I, b = YᵀCp where
         # p=1 for observed. C-I = alpha·r on observed entries only.
         cw = (alpha * val)[..., None].astype(cd)  # confidence-1 weights
-        w = 1.0 + alpha * val if mask is None else (1.0 + alpha * val) * mask
-        grams = jnp.einsum("blk,blm->bkm", pm * cw, pm,
+        w = 1.0 + alpha * val
+        grams = jnp.einsum("blk,blm->bkm", p * cw, p,
                            preferred_element_type=jnp.float32)
-        rhs = jnp.einsum("blk,bl->bk", pm, w.astype(cd),
+        rhs = jnp.einsum("blk,bl->bk", p, w.astype(cd),
                          preferred_element_type=jnp.float32)
     else:
-        w = val if mask is None else val * mask
-        grams = jnp.einsum("blk,blm->bkm", pm, pm,
+        grams = jnp.einsum("blk,blm->bkm", p, p,
                            preferred_element_type=jnp.float32)
-        rhs = jnp.einsum("blk,bl->bk", pm, w.astype(cd),
+        rhs = jnp.einsum("blk,bl->bk", p, val.astype(cd),
                          preferred_element_type=jnp.float32)
     return grams, rhs
+
+
+def _gather_model_partial(y_local, col, compute_dtype):
+    """ALX sharded gather: rows this shard owns, zero rows elsewhere.
+
+    ``y_local`` is this device's row shard of the counterpart factor
+    matrix ([rows_total / m, k], MODEL_AXIS-sharded, contiguous blocks in
+    axis order). Column indices outside this shard's window — including
+    the out-of-range padding index — gather exact zeros, so psumming any
+    per-entry-linear reduction of the result over MODEL_AXIS equals the
+    full-gather reduction without ever materializing the full matrix on
+    one device (PAPERS.md ALX, arxiv 2112.02194 §3).
+    """
+    cd = compute_dtype
+    rows_local = y_local.shape[0]
+    off = jax.lax.axis_index(MODEL_AXIS) * rows_local
+    lc = col - off
+    valid = (lc >= 0) & (lc < rows_local)
+    p = jnp.take(y_local, jnp.clip(lc, 0, rows_local - 1), axis=0)
+    return p.astype(cd) * valid[..., None].astype(cd)
 
 
 def _half_step_local(y, col, val, local_row, counts, yty, *,
                      rows_per_shard, reg, lambda_scaling, implicit, alpha,
                      compute_dtype, chunk_tiles=0, row_span=0,
-                     platform=None):
+                     platform=None, model_sharded=False):
     """Solve one side's factors for one shard's rows (runs inside
-    shard_map; all arrays are the local shard). ``y`` includes a trailing
-    all-zero sentinel row that padding column indices resolve to."""
+    shard_map; all arrays are the local shard).
+
+    Replicated mode (``model_sharded=False``): ``y`` is the full
+    counterpart matrix plus a trailing all-zero sentinel row that padding
+    column indices resolve to.
+
+    Model-sharded mode: ``y`` is this device's MODEL_AXIS row shard; the
+    gather is partial (zeros for non-owned rows) and the per-row normal
+    equations are psummed over MODEL_AXIS before the solve — the ALX
+    sharded layout, so factor HBM scales with 1/m.
+    """
     k = y.shape[1]
     n_tiles = col.shape[0]
+
+    def gather(cols):
+        if model_sharded:
+            return _gather_model_partial(y, cols, compute_dtype)
+        return y[cols].astype(compute_dtype)
     if chunk_tiles and n_tiles > chunk_tiles:
         # Large data: scan tile slabs. Tiles are row-sorted, so each
         # slab's rows fall in a contiguous window of at most ``row_span``
@@ -116,9 +159,11 @@ def _half_step_local(y, col, val, local_row, counts, yty, *,
         n_chunks = (n_tiles + chunk_tiles - 1) // chunk_tiles
         pad = n_chunks * chunk_tiles - n_tiles
         if pad:
-            # Chunk padding points at the sentinel zero row of y.
-            col = jnp.pad(col, ((0, pad), (0, 0)),
-                          constant_values=y.shape[0] - 1)
+            # Chunk padding: sentinel zero row of y (replicated mode) or
+            # an index no model shard owns (sharded mode) — zeros either way.
+            pad_idx = (np.int32(2**31 - 1) if model_sharded
+                       else y.shape[0] - 1)
+            col = jnp.pad(col, ((0, pad), (0, 0)), constant_values=pad_idx)
             val = jnp.pad(val, ((0, pad), (0, 0)))
             local_row = jnp.pad(local_row, (0, pad))
         cshape = (n_chunks, chunk_tiles)
@@ -132,8 +177,8 @@ def _half_step_local(y, col, val, local_row, counts, yty, *,
         def scan_body(carry, chunk):
             a_acc, b_acc = carry
             ccol, cval, clrow = chunk
-            grams, rhs = _tile_grams(
-                y, ccol, cval, None,
+            grams, rhs = _grams_from_p(
+                gather(ccol), cval,
                 implicit=implicit, alpha=alpha, compute_dtype=cd,
             )
             # Window base: first tile's row. Tail padding tiles carry
@@ -169,21 +214,31 @@ def _half_step_local(y, col, val, local_row, counts, yty, *,
         b0 = jnp.zeros((rows_per_shard + span, k), jnp.float32)
         if hasattr(jax.lax, "pcast"):
             # Inside shard_map the scatter-add output is device-varying;
-            # mark the zero carries to match (jax ≥0.8 VMA tracking).
-            a0 = jax.lax.pcast(a0, (DATA_AXIS,), to="varying")
-            b0 = jax.lax.pcast(b0, (DATA_AXIS,), to="varying")
+            # mark the zero carries to match (jax ≥0.8 VMA tracking). In
+            # sharded mode partial grams also vary over MODEL_AXIS until
+            # the psum below.
+            vaxes = (DATA_AXIS,) + ((MODEL_AXIS,) if model_sharded else ())
+            a0 = jax.lax.pcast(a0, vaxes, to="varying")
+            b0 = jax.lax.pcast(b0, vaxes, to="varying")
         (a, b), _ = jax.lax.scan(
             scan_body, (a0, b0), (col_c, val_c, lrow_c)
         )
         a = a[:rows_per_shard]
         b = b[:rows_per_shard]
     else:
-        grams, rhs = _tile_grams(
-            y, col, val, None,
+        grams, rhs = _grams_from_p(
+            gather(col), val,
             implicit=implicit, alpha=alpha, compute_dtype=compute_dtype,
         )
         a = jax.ops.segment_sum(grams, local_row, num_segments=rows_per_shard)
         b = jax.ops.segment_sum(rhs, local_row, num_segments=rows_per_shard)
+    if model_sharded:
+        # Reconstruct the full per-row normal equations from the shard
+        # partials — the one collective of the sharded gather. Placed on
+        # the [rows/d, k, k] accumulators (cheaper than psumming gathered
+        # [chunk, L, k] factors every scan step at ml20m shapes).
+        a = jax.lax.psum(a, MODEL_AXIS)
+        b = jax.lax.psum(b, MODEL_AXIS)
     if implicit:
         a = a + yty[None, :, :]  # shared YᵀY term (all items)
 
@@ -237,21 +292,32 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
     # default backend: the driver validates multi-chip sharding on a
     # virtual CPU mesh while the sandbox TPU stays the default backend.
     mesh_platform = mesh.devices.flat[0].platform
+    # 2-D (d, m) mesh → ALX factor sharding: the counterpart factor
+    # matrix is row-sharded over MODEL_AXIS (HBM per device ∝ 1/m) and
+    # the per-row normal equations are psummed from shard partials.
+    model_sharded = MODEL_AXIS in mesh.axis_names
 
-    row_spec = P(DATA_AXIS)          # tiles / rows split over mesh
+    row_spec = P(DATA_AXIS)          # tiles / rows split over data axis
     rep = P()                        # replicated
+    y_spec = P(MODEL_AXIS, None) if model_sharded else rep
 
     u_span = _chunk_row_span(users, params.chunk_tiles)
     i_span = _chunk_row_span(items, params.chunk_tiles)
 
     def one_side(y, blk_cols, blk_vals, blk_lrow, counts,
                  rows_per_shard, row_span):
-        # Sentinel zero row appended so padding column indices gather 0s
-        # (mask-free hot loop); cast once here so the scan gathers
-        # half-width bf16 rows instead of f32.
-        y_cd = jnp.concatenate(
-            [y, jnp.zeros((1, y.shape[1]), y.dtype)], axis=0
-        ).astype(cd)
+        if model_sharded:
+            # No sentinel: the sharded gather masks by ownership window,
+            # and padded row counts already divide the model axis.
+            y_cd = jax.lax.with_sharding_constraint(
+                y.astype(cd), NamedSharding(mesh, y_spec))
+        else:
+            # Sentinel zero row appended so padding column indices gather
+            # 0s (mask-free hot loop); cast once here so the scan gathers
+            # half-width bf16 rows instead of f32.
+            y_cd = jnp.concatenate(
+                [y, jnp.zeros((1, y.shape[1]), y.dtype)], axis=0
+            ).astype(cd)
         yty = (
             jnp.einsum("nk,nm->km", y_cd, y_cd,
                        preferred_element_type=jnp.float32)
@@ -270,12 +336,20 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
                 chunk_tiles=params.chunk_tiles,
                 row_span=row_span,
                 platform=mesh_platform,
+                model_sharded=model_sharded,
             ),
             mesh=mesh,
-            in_specs=(rep, row_spec, row_spec, row_spec, row_spec, rep),
+            in_specs=(y_spec, row_spec, row_spec, row_spec, row_spec, rep),
             out_specs=row_spec,
         )
-        return fn(y_cd, blk_cols, blk_vals, blk_lrow, counts, yty)
+        x = fn(y_cd, blk_cols, blk_vals, blk_lrow, counts, yty)
+        if model_sharded:
+            # Solved rows leave the shard_map split over 'd'; re-shard to
+            # the MODEL_AXIS storage layout (XLA all-to-all over ICI) so
+            # the next half-step consumes it as a sharded counterpart.
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, y_spec))
+        return x
 
     u_rps, i_rps = users.rows_per_shard, items.rows_per_shard
 
@@ -297,19 +371,27 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
         "row2": NamedSharding(mesh, P(DATA_AXIS, None)),
         "row1": NamedSharding(mesh, P(DATA_AXIS)),
         "rep": NamedSharding(mesh, P()),
+        "factors": NamedSharding(mesh, y_spec),
     }
     in_shardings = (
         shardings["rep"],
-        shardings["rep"], shardings["rep"],
+        shardings["factors"], shardings["factors"],
         shardings["row2"], shardings["row2"],
         shardings["row1"], shardings["row1"],
         shardings["row2"], shardings["row2"],
         shardings["row1"], shardings["row1"],
     )
+    # Outputs stay MODEL_AXIS-sharded on a 2-D mesh — replicating here
+    # would all-gather both full factor matrices onto every device and
+    # defeat the 1/m HBM scaling (host device_get assembles from shards).
+    # Multi-controller runs need replicated outputs so every process can
+    # device_get its result.
+    out_s = (shardings["factors"] if jax.process_count() == 1
+             else shardings["rep"])
     fitted = jax.jit(
         loop,
         in_shardings=in_shardings,
-        out_shardings=(shardings["rep"], shardings["rep"]),
+        out_shardings=(out_s, out_s),
     )
     return fitted, in_shardings
 
@@ -336,20 +418,35 @@ def train_als(
     this at all — a failed Spark ALS job restarts from zero (SURVEY.md §5.4).
     """
     mesh = mesh or default_mesh()
-    n_dev = int(np.prod(list(mesh.shape.values())))
+    if DATA_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh must have a '{DATA_AXIS}' axis, "
+                         f"got {mesh.axis_names}")
+    # Tiles (and the rows being solved) split over the data axis; on a
+    # 2-D (d, m) mesh the factor matrices are additionally row-sharded
+    # over the model axis (ALX layout), so padded row counts must divide
+    # both axes.
+    d_size = mesh.shape[DATA_AXIS]
+    m_size = mesh.shape.get(MODEL_AXIS, 1)
 
+    def _rows_per_shard(n_rows: int) -> int:
+        rps = -(-n_rows // d_size)
+        return -(-rps // m_size) * m_size
+
+    rps_users = _rows_per_shard(n_users)
+    rps_items = _rows_per_shard(n_items)
     # Padding column indices point one past the counterpart's padded rows:
-    # one_side appends a zero sentinel row there, making the hot loop
-    # mask-free (padding gathers exact zeros).
-    pad_items = -(-n_items // n_dev) * n_dev
-    pad_users = -(-n_users // n_dev) * n_dev
+    # in replicated mode one_side appends a zero sentinel row there (mask-
+    # free hot loop); in sharded mode the index falls outside every
+    # shard's ownership window and gathers zeros via the validity mask.
+    pad_items = d_size * rps_items
+    pad_users = d_size * rps_users
     by_user = shard_blocked(
         build_blocked(user_idx, item_idx, rating, n_users, params.block_len,
-                      pad_col=pad_items), n_dev
+                      pad_col=pad_items), d_size, rows_per_shard=rps_users
     )
     by_item = shard_blocked(
         build_blocked(item_idx, user_idx, rating, n_items, params.block_len,
-                      pad_col=pad_users), n_dev
+                      pad_col=pad_users), d_size, rows_per_shard=rps_items
     )
 
     k = params.rank

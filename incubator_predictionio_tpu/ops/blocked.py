@@ -131,10 +131,23 @@ class ShardedBlocked:
         return self.n_shards * self.rows_per_shard
 
 
-def shard_blocked(blocked: BlockedRows, n_shards: int) -> ShardedBlocked:
-    """Partition tiles onto shards by row ownership."""
+def shard_blocked(blocked: BlockedRows, n_shards: int,
+                  rows_per_shard: int | None = None) -> ShardedBlocked:
+    """Partition tiles onto shards by row ownership.
+
+    ``rows_per_shard`` overrides the default ceil split — used by the
+    model-sharded ALS path, which needs the padded row count
+    (n_shards * rows_per_shard) to also divide the model axis so the same
+    factor matrix can be row-sharded over either mesh axis.
+    """
     S = int(n_shards)
-    rows_per_shard = (blocked.n_rows + S - 1) // S
+    if rows_per_shard is None:
+        rows_per_shard = (blocked.n_rows + S - 1) // S
+    elif rows_per_shard * S < blocked.n_rows:
+        raise ValueError(
+            f"rows_per_shard={rows_per_shard} x {S} shards cannot hold "
+            f"{blocked.n_rows} rows"
+        )
     shard_of_block = blocked.block_row // rows_per_shard
 
     order = np.argsort(shard_of_block, kind="stable")
@@ -161,11 +174,9 @@ def shard_blocked(blocked: BlockedRows, n_shards: int) -> ShardedBlocked:
         block_row - shard_sorted * rows_per_shard
     ).astype(np.int32)
 
+    # Row r lives at global slot r (shard-major layout == row order).
     counts_p = np.zeros(S * rows_per_shard, dtype=np.int32)
-    counts_p[: blocked.n_rows] = 0  # filled below shard-major
-    padded = np.zeros(S * rows_per_shard, dtype=np.int32)
-    padded[: blocked.counts.shape[0]] = blocked.counts
-    counts_p = padded  # row r lives at global slot r (shard-major == row order)
+    counts_p[: blocked.counts.shape[0]] = blocked.counts
 
     return ShardedBlocked(
         col=col_p.reshape(S * Bs, L),
