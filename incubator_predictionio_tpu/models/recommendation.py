@@ -155,7 +155,12 @@ class AlgorithmParams(Params):
     alpha: float = 1.0
     lambda_scaling: str = "plain"
     block_len: int = 32
-    compute_dtype: str = "float32"
+    # "auto" → bfloat16 on TPU meshes, float32 elsewhere; -1 → chunk the
+    # half-step scan automatically when the gram batch would exceed the
+    # HBM budget (ml20m trains at bench-identical settings out of the
+    # box — see ops.als._resolve_params).
+    compute_dtype: str = "auto"
+    chunk_tiles: int = -1
 
 
 class ALSAlgorithm(Algorithm):
@@ -168,11 +173,15 @@ class ALSAlgorithm(Algorithm):
         "numIterations": "num_iterations",
         "implicitPrefs": "implicit_prefs",
         "appName": "app_name",
+        "lambdaScaling": "lambda_scaling",
+        "blockLen": "block_len",
+        "computeDtype": "compute_dtype",
+        "chunkTiles": "chunk_tiles",
     }
 
-    def train(self, ctx, pd: PreparedData) -> ALSModel:
-        p: AlgorithmParams = self.params
-        als_params = ALSParams(
+    @staticmethod
+    def als_params(p: "AlgorithmParams") -> ALSParams:
+        return ALSParams(
             rank=p.rank,
             num_iterations=p.num_iterations,
             reg=p.reg,
@@ -182,13 +191,20 @@ class ALSAlgorithm(Algorithm):
             seed=p.seed if p.seed is not None else 3,
             block_len=p.block_len,
             compute_dtype=p.compute_dtype,
+            chunk_tiles=p.chunk_tiles,
         )
+
+    def train(self, ctx, pd: PreparedData) -> ALSModel:
         factors = train_als(
             pd.user_idx, pd.item_idx, pd.rating,
             n_users=len(pd.users), n_items=len(pd.items),
-            params=als_params, mesh=ctx.get_mesh() if ctx else None,
+            params=self.als_params(self.params),
+            mesh=ctx.get_mesh() if ctx else None,
             checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
             resume=bool(ctx and ctx.workflow_params.resume),
+            # bench.py measures the real product path by planting a
+            # timings dict on the context; absent in normal training.
+            timings=getattr(ctx, "bench_timings", None),
         )
         return ALSModel(factors=factors, users=pd.users, items=pd.items)
 

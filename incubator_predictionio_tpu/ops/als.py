@@ -61,13 +61,17 @@ class ALSParams:
     alpha: float = 1.0  # implicit-feedback confidence weight
     seed: int = 3
     block_len: int = 32
-    compute_dtype: str = "float32"  # bf16 tiles on TPU, f32 on CPU tests
+    # "auto" → bfloat16 on a TPU mesh, float32 elsewhere. Explicit
+    # "float32"/"bfloat16" override.
+    compute_dtype: str = "auto"
     # Tiles processed per scan step inside a half-step. 0 = all at once
     # (small data). At ML-20M scale the per-tile gram intermediate
     # [B, k, k] would be ~10GB; chunking scans tile slabs and scatter-adds
     # into the per-row normal equations, capping live memory at
     # [chunk, L, k] + [chunk, k, k] + the [rows, k, k] accumulator.
-    chunk_tiles: int = 0
+    # -1 = auto: chunk only when the unchunked gram batch would exceed
+    # the per-device budget (see _resolve_params).
+    chunk_tiles: int = -1
 
 
 @dataclasses.dataclass
@@ -283,9 +287,58 @@ def _chunk_row_span(sb: ShardedBlocked, chunk_tiles: int) -> int:
     return min(-(-span // 128) * 128, sb.rows_per_shard + 128)
 
 
+# Per-device budget for the unchunked [tiles, k, k] f32 gram batch plus
+# the gathered [tiles, L, k] factors; above it the scan-chunked path kicks
+# in. 1 GiB leaves headroom for factors + tiles + accumulators on a 16 GB
+# v5e chip.
+_AUTO_CHUNK_BUDGET_BYTES = 1 << 30
+# Measured sweet spot at ml20m/rank32 on v5e (bench.py sweeps): big enough
+# to keep the one-hot MXU reduction and DMA pipeline fed, small enough
+# that the [chunk, L, k] + [chunk, k, k] slabs stay cheap.
+_AUTO_CHUNK_TILES = 2048
+
+
+def _resolve_params(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
+                    items: ShardedBlocked) -> ALSParams:
+    """Materialize 'auto' knobs against the actual mesh + data layout.
+
+    Templates ship compute_dtype="auto" / chunk_tiles=-1 so a plain
+    `pio train` picks the TPU-optimal configuration the benchmarks use —
+    bf16 gathers on TPU meshes and scan-chunking whenever the unchunked
+    per-tile intermediates would blow the HBM budget (ml20m would
+    otherwise build a ~10 GB gram batch and OOM).
+    """
+    cd = params.compute_dtype
+    if cd == "auto":
+        platform = mesh.devices.flat[0].platform
+        cd = "bfloat16" if platform == "tpu" else "float32"
+    chunk = params.chunk_tiles
+    if chunk < 0:
+        k = params.rank
+        L = users.col.shape[1]
+        cd_bytes = 2 if cd == "bfloat16" else 4
+        per_tile = L * k * cd_bytes + k * k * 4
+        tiles_local = max(users.col.shape[0] // users.n_shards,
+                          items.col.shape[0] // items.n_shards)
+        if tiles_local * per_tile <= _AUTO_CHUNK_BUDGET_BYTES:
+            chunk = 0
+        else:
+            # Cap by the budget too: at extreme rank/block_len a 2048-tile
+            # slab can itself exceed the budget, and over-budget data
+            # guarantees budget//per_tile < tiles_local, so the chunked
+            # path (n_tiles > chunk_tiles) always engages.
+            chunk = max(1, min(_AUTO_CHUNK_TILES,
+                               _AUTO_CHUNK_BUDGET_BYTES // per_tile))
+    if cd != params.compute_dtype or chunk != params.chunk_tiles:
+        params = dataclasses.replace(
+            params, compute_dtype=cd, chunk_tiles=chunk)
+    return params
+
+
 def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
                    items: ShardedBlocked):
     """Build the jitted full training loop for fixed layouts."""
+    params = _resolve_params(mesh, params, users, items)
     cd = jnp.bfloat16 if params.compute_dtype == "bfloat16" else jnp.float32
     implicit = params.implicit_prefs
     # Kernel selection must follow the MESH's platform, not the process
@@ -406,6 +459,7 @@ def train_als(
     mesh: Optional[Mesh] = None,
     checkpoint_hook=None,
     resume: bool = False,
+    timings: Optional[dict] = None,
 ) -> ALSFactors:
     """Train explicit/implicit ALS from a COO rating triple.
 
@@ -416,6 +470,13 @@ def train_als(
     latest snapshot and runs only the remaining iterations. Chunking is
     bitwise-identical math to the single fori_loop. The reference cannot do
     this at all — a failed Spark ALS job restarts from zero (SURVEY.md §5.4).
+
+    ``timings``: pass a dict to get the bench-grade phase breakdown
+    (upload / compile / steady-state device seconds, with the scalar-
+    readback completion barrier that the remote-PJRT tunnel requires —
+    block_until_ready can return early through it). This is how bench.py
+    measures the REAL product path: `pio train` → Engine.train →
+    ALSAlgorithm → here. Single-process, non-checkpoint-chunked runs only.
     """
     mesh = mesh or default_mesh()
     if DATA_AXIS not in mesh.axis_names:
@@ -530,7 +591,36 @@ def train_als(
             for b, s in zip(blocks, in_shardings[3:])
         )
     chunk = checkpoint_hook.every_n if checkpoint_hook is not None and checkpoint_hook.enabled else 0
-    if chunk and params.num_iterations - start_iter > chunk:
+    if (timings is not None and jax.process_count() == 1
+            and not (chunk and params.num_iterations - start_iter > chunk)):
+        import time as _time
+
+        t0 = _time.perf_counter()
+        dx0 = jax.device_put(np.asarray(x0), in_shardings[1])
+        dy0 = jax.device_put(np.asarray(y0), in_shardings[2])
+        dev_blocks = tuple(
+            jax.device_put(np.asarray(b), s)
+            for b, s in zip(blocks, in_shardings[3:])
+        )
+        jax.block_until_ready((dx0, dy0, dev_blocks))
+        timings["upload_seconds"] = _time.perf_counter() - t0
+
+        n = np.int32(params.num_iterations - start_iter)
+        t0 = _time.perf_counter()
+        compiled = fn.lower(n, dx0, dy0, *dev_blocks).compile()
+        timings["compile_seconds"] = _time.perf_counter() - t0
+
+        # Warm-up dispatch (n_iters is traced: same executable, zero work),
+        # then the timed run with a scalar readback as the completion
+        # barrier — through the remote-PJRT tunnel block_until_ready can
+        # return before the device finishes, a device_get cannot.
+        warm = compiled(np.int32(0), dx0, dy0, *dev_blocks)
+        _ = jax.device_get(warm[0][:1, :1])
+        t0 = _time.perf_counter()
+        x, y = compiled(n, dx0, dy0, *dev_blocks)
+        _ = jax.device_get(x[:1, :1])
+        timings["device_train_seconds"] = _time.perf_counter() - t0
+    elif chunk and params.num_iterations - start_iter > chunk:
         x, y = x0, y0
         it = start_iter
         while it < params.num_iterations:
