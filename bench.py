@@ -167,7 +167,7 @@ def ops_level_events_per_sec(u, i, r, n_users, n_items, nnz, rank, iters):
         f"{events_per_sec:,.0f} events/sec/chip")
     xf = np.asarray(jax.device_get(out[0]))
     assert np.isfinite(xf).all(), "non-finite factors"
-    return events_per_sec
+    return events_per_sec, train_time
 
 
 def dase_events_per_sec(u, i, r, n_users, n_items, nnz, rank, iters):
@@ -199,11 +199,14 @@ def dase_events_per_sec(u, i, r, n_users, n_items, nnz, rank, iters):
         data_source_class=SyntheticDataSource,
         algorithm_class_map={"als": ALSAlgorithm},
     )
+    algo_params = {"rank": rank, "numIterations": iters, "lambda": 0.01}
+    chunk_env = os.environ.get("PIO_BENCH_CHUNK")
+    if chunk_env is not None:
+        # Chunk sweeps must hit BOTH paths or the cross-check ratio
+        # measures the chunk-size delta instead of wrapper overhead.
+        algo_params["chunkTiles"] = int(chunk_env)
     engine_params = EngineParams.from_json({
-        "algorithms": [{
-            "name": "als",
-            "params": {"rank": rank, "numIterations": iters, "lambda": 0.01},
-        }],
+        "algorithms": [{"name": "als", "params": algo_params}],
     })
     ctx = WorkflowContext(app_name="bench")
     ctx.bench_timings = {}
@@ -220,7 +223,7 @@ def dase_events_per_sec(u, i, r, n_users, n_items, nnz, rank, iters):
         f"{t['upload_seconds']:.1f}s, compile {t['compile_seconds']:.1f}s, "
         f"steady-state train {t['device_train_seconds']:.2f}s on {n_dev} "
         f"device(s) → {events_per_sec:,.0f} events/sec/chip")
-    return events_per_sec
+    return events_per_sec, t["device_train_seconds"]
 
 
 def main() -> int:
@@ -243,15 +246,20 @@ def main() -> int:
     u, i, r = synth_ratings(n_users, n_items, nnz)
     log(f"[bench] synth data {time.time()-t0:.1f}s")
 
-    events_per_sec = dase_events_per_sec(
+    events_per_sec, dase_secs = dase_events_per_sec(
         u, i, r, n_users, n_items, nnz, rank, iters)
 
     if os.environ.get("PIO_BENCH_SKIP_OPS") != "1":
-        ops_eps = ops_level_events_per_sec(
+        ops_eps, ops_secs = ops_level_events_per_sec(
             u, i, r, n_users, n_items, nnz, rank, iters)
         ratio = events_per_sec / ops_eps
         log(f"[bench] product path / ops harness = {ratio:.3f}")
-        if abs(1 - ratio) > 0.07:
+        if min(dase_secs, ops_secs) < 0.5:
+            # Sub-half-second windows (CPU smoke runs, tiny scales) are
+            # dominated by dispatch jitter — the ratio is not meaningful.
+            log("[bench] timed windows too short for the divergence "
+                "check; skipping it")
+        elif abs(1 - ratio) > 0.07:
             log(f"[bench] WARNING: product path deviates >7% from the "
                 f"ops-level harness ({events_per_sec:,.0f} vs "
                 f"{ops_eps:,.0f} events/sec/chip)")

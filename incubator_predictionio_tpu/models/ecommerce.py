@@ -136,6 +136,10 @@ class ECommerceAlgoParams(Params):
     alpha: float = 1.0
     seen_events: Sequence[str] = ("view", "buy")
     seed: Optional[int] = None
+    # "auto" → bfloat16 on TPU meshes; set "float32" in engine.json to
+    # reproduce pre-auto runs exactly. -1 → auto HBM-budget chunking.
+    compute_dtype: str = "auto"
+    chunk_tiles: int = -1
 
 
 class ECommerceAlgorithm(Algorithm):
@@ -143,6 +147,7 @@ class ECommerceAlgorithm(Algorithm):
     params_aliases = {
         "appName": "app_name", "lambda": "reg",
         "numIterations": "num_iterations", "seenEvents": "seen_events",
+        "computeDtype": "compute_dtype", "chunkTiles": "chunk_tiles",
     }
 
     def train(self, ctx, pd) -> ECommerceModel:
@@ -154,6 +159,7 @@ class ECommerceAlgorithm(Algorithm):
                 rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
                 implicit_prefs=True, alpha=p.alpha,
                 seed=p.seed if p.seed is not None else 3,
+                compute_dtype=p.compute_dtype, chunk_tiles=p.chunk_tiles,
             ),
             mesh=ctx.get_mesh() if ctx else None,
             checkpoint_hook=getattr(ctx, "checkpoint_hook", None),

@@ -136,11 +136,18 @@ class SimilarProductAlgoParams(Params):
     reg: float = 0.01
     alpha: float = 1.0
     seed: Optional[int] = None
+    # "auto" → bfloat16 on TPU meshes; set "float32" in engine.json to
+    # reproduce pre-auto runs exactly. -1 → auto HBM-budget chunking.
+    compute_dtype: str = "auto"
+    chunk_tiles: int = -1
 
 
 class SimilarProductAlgorithm(Algorithm):
     params_cls = SimilarProductAlgoParams
-    params_aliases = {"lambda": "reg", "numIterations": "num_iterations"}
+    params_aliases = {
+        "lambda": "reg", "numIterations": "num_iterations",
+        "computeDtype": "compute_dtype", "chunkTiles": "chunk_tiles",
+    }
 
     def train(self, ctx, pd: PreparedData) -> SimilarProductModel:
         p = self.params
@@ -151,6 +158,7 @@ class SimilarProductAlgorithm(Algorithm):
                 rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
                 implicit_prefs=True, alpha=p.alpha,
                 seed=p.seed if p.seed is not None else 3,
+                compute_dtype=p.compute_dtype, chunk_tiles=p.chunk_tiles,
             ),
             mesh=ctx.get_mesh() if ctx else None,
             checkpoint_hook=getattr(ctx, "checkpoint_hook", None),

@@ -1,0 +1,142 @@
+"""Vanilla engine template — the third-party authorship scaffold.
+
+This file lives INSIDE the template project (not the framework): `pio
+train --engine-dir <here>` puts this directory on sys.path and resolves
+``engine.json``'s ``"engineFactory": "vanilla_engine.VanillaEngine"``
+reflectively, exactly how the reference loads a user's engine jar from a
+template checkout (reference: upstream template-scala-parallel-vanilla +
+core CreateWorkflow engine loading; SURVEY.md §2.8).
+
+Copy it (`pio template get vanilla <dir>`), rename, and replace the three
+components. Everything imports only the public framework API —
+``incubator_predictionio_tpu.controller`` and the event stores — never
+``incubator_predictionio_tpu.models``.
+
+The demo engine is a weighted-popularity recommender: every view/rate/buy
+event contributes to an item score (rates weighted by their rating), the
+reduction runs as a jitted segment-sum on the accelerator, and serving
+returns the top-N items. Wire format matches the recommendation
+quickstart: {"user": ..., "num": N} → {"itemScores": [...]}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineFactory,
+    Params,
+    SanityCheck,
+    Serving,
+)
+from incubator_predictionio_tpu.data.store.p_event_store import PEventStore
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_idx: np.ndarray
+    item_idx: np.ndarray
+    weight: np.ndarray
+    items: object  # BiMap item id ↔ dense index
+
+    def sanity_check(self):
+        assert len(self.item_idx) > 0, "no events found for training"
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_names: Sequence[str] = ("view", "rate", "buy")
+
+
+class VanillaDataSource(DataSource):
+    params_cls = DataSourceParams
+    params_aliases = {"appName": "app_name", "eventNames": "event_names"}
+
+    def read_training(self, ctx) -> TrainingData:
+        p: DataSourceParams = self.params
+        u, i, r, _users, items = PEventStore.find_ratings(
+            p.app_name or ctx.app_name,
+            event_names=list(p.event_names),
+            default_rating=1.0,  # view/buy events carry no rating
+            storage=ctx.get_storage(),
+            channel_name=ctx.channel_name,
+        )
+        return TrainingData(u, i, r, items)
+
+
+@dataclasses.dataclass
+class PopularityModel:
+    item_ids: list
+    scores: np.ndarray  # [n_items] f32, aligned with item_ids
+
+    def top(self, num: int):
+        order = np.argsort(-self.scores)[:num]
+        return [(self.item_ids[int(j)], float(self.scores[int(j)]))
+                for j in order]
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgorithmParams(Params):
+    rating_weight: float = 1.0
+
+
+class PopularityAlgorithm(Algorithm):
+    params_cls = AlgorithmParams
+    params_aliases = {"ratingWeight": "rating_weight"}
+
+    def train(self, ctx, td: TrainingData) -> PopularityModel:
+        import jax
+        import jax.numpy as jnp
+
+        n_items = len(td.items)
+        w = self.params.rating_weight
+
+        @jax.jit
+        def score(item_idx, weight):
+            return jax.ops.segment_sum(
+                weight * w, item_idx, num_segments=n_items)
+
+        scores = np.asarray(score(jnp.asarray(td.item_idx),
+                                  jnp.asarray(td.weight)))
+        item_ids = [td.items.inverse(j) for j in range(n_items)]
+        return PopularityModel(item_ids=item_ids, scores=scores)
+
+    def predict(self, model: PopularityModel, query: dict) -> dict:
+        num = int(query.get("num", 10))
+        return {
+            "itemScores": [
+                {"item": item, "score": score}
+                for item, score in model.top(num)
+            ]
+        }
+
+    def prepare_model_for_persistence(self, model: PopularityModel):
+        return {"item_ids": model.item_ids,
+                "scores": np.asarray(model.scores)}
+
+    def restore_model(self, stored, ctx) -> PopularityModel:
+        if isinstance(stored, PopularityModel):
+            return stored
+        return PopularityModel(item_ids=list(stored["item_ids"]),
+                               scores=np.asarray(stored["scores"]))
+
+
+class VanillaServing(Serving):
+    def serve(self, query: dict, predictions: Sequence[dict]) -> dict:
+        return predictions[0] if predictions else {"itemScores": []}
+
+
+class VanillaEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class=VanillaDataSource,
+            algorithm_class_map={"popularity": PopularityAlgorithm},
+            serving_class=VanillaServing,
+        )
