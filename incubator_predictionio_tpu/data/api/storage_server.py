@@ -1,0 +1,229 @@
+"""`pio storageserver` — hosts the storage DAO surface over HTTP.
+
+The network half of the client-server backend (see
+data/storage/http_backend.py for the protocol + reference mapping: the
+HBase/JDBC/ES storage-service role, SURVEY.md §2.1). The server process
+is configured with ordinary PIO_STORAGE_* env (typically the SQLITE/JSONL
+embedded backends); every RPC is routed to the backing client of the
+matching repository with the CLIENT's namespace passed through, so
+differently-named repositories never collide — the same contract the
+embedded backends honour.
+
+Handlers run the synchronous DAOs on the default executor (the event
+server's pattern); find() scans stream back as chunked NDJSON so large
+reads never materialize server-side.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from ..storage import http_backend as codec
+from ..storage.base import Model
+from ..storage.event import Event, EventValidationError
+from ..storage.registry import Storage
+
+log = logging.getLogger("pio.storageserver")
+
+# dao name → (repository, client accessor attribute)
+_DAO_ROUTES = {
+    "apps": ("METADATA", "apps"),
+    "access_keys": ("METADATA", "access_keys"),
+    "channels": ("METADATA", "channels"),
+    "engine_instances": ("METADATA", "engine_instances"),
+    "evaluation_instances": ("METADATA", "evaluation_instances"),
+    "models": ("MODELDATA", "models"),
+    "l_events": ("EVENTDATA", "l_events"),
+    "p_events": ("EVENTDATA", "p_events"),
+}
+
+# Record-valued "record" argument decoders, per DAO.
+_RECORD_FROM = {
+    "apps": codec.app_from_json,
+    "access_keys": codec.access_key_from_json,
+    "channels": codec.channel_from_json,
+    "engine_instances": codec.engine_instance_from_json,
+    "evaluation_instances": codec.evaluation_instance_from_json,
+}
+_RESULT_CODECS = {
+    "apps": codec.app_to_json,
+    "access_keys": codec.access_key_to_json,
+    "channels": codec.channel_to_json,
+    "engine_instances": codec.engine_instance_to_json,
+    "evaluation_instances": codec.evaluation_instance_to_json,
+}
+_TIME_ARGS = ("start_time", "until_time")
+
+
+def _dao_for(storage: Storage, dao: str, namespace: str):
+    repo, accessor = _DAO_ROUTES[dao]
+    client = storage._client(repo)  # same-package registry internal
+    return getattr(client, accessor)(namespace)
+
+
+def _decode_args(dao: str, method: str, args: dict) -> dict:
+    out = dict(args)
+    if "record" in out and out["record"] is not None:
+        out["record"] = _RECORD_FROM[dao](out["record"])
+    for t in _TIME_ARGS:
+        if out.get(t) is not None:
+            out[t] = codec._dt_from_json(out[t])
+    if "event" in out and out["event"] is not None:
+        out["event"] = Event.from_json(out["event"])
+    if "events" in out and out["events"] is not None:
+        out["events"] = [Event.from_json(o) for o in out["events"]]
+    return out
+
+
+def _encode_result(dao: str, result):
+    if isinstance(result, Event):  # l_events.get
+        return result.to_json()
+    enc = _RESULT_CODECS.get(dao)
+    if enc is None:
+        return result
+    if isinstance(result, list):
+        return [enc(r) for r in result]
+    if hasattr(result, "__dataclass_fields__"):
+        return enc(result)
+    return result
+
+
+def _positional(dao: str, method: str, args: dict) -> tuple[tuple, dict]:
+    """DAO methods take positional-friendly kwargs; 'instance' maps onto
+    the parameter named 'i' in the ABC signatures."""
+    args = dict(args)
+    if "record" in args:
+        return (args.pop("record"),), args
+    if "event" in args and method == "insert":
+        return (args.pop("event"),), args
+    if "events" in args and method in ("insert_batch", "write"):
+        return (args.pop("events"),), args
+    return (), args
+
+
+def build_app(storage: Optional[Storage] = None) -> web.Application:
+    # 8 GiB body cap: model blobs are factor matrices and can run multi-GB
+    # (the HDFS/S3 model-store role). Uploads buffer in server RAM — put
+    # the store node on a box sized for its models.
+    app = web.Application(client_max_size=1 << 33)
+    app["storage"] = storage  # None → Storage.instance() at request time
+
+    def get_storage() -> Storage:
+        return app["storage"] or Storage.instance()
+
+    async def health(_request):
+        return web.json_response({"status": "ok"})
+
+    async def rpc(request: web.Request):
+        dao = request.match_info["dao"]
+        method = request.match_info["method"]
+        if dao not in _DAO_ROUTES:
+            return web.json_response({"error": f"unknown dao {dao!r}"},
+                                     status=404)
+        if method.startswith("_"):
+            return web.json_response({"error": "invalid method"}, status=400)
+        try:
+            payload = await request.json()
+            namespace = payload.get("namespace") or "pio"
+            args = _decode_args(dao, method, payload.get("args") or {})
+        except (ValueError, KeyError, EventValidationError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+
+        loop = asyncio.get_running_loop()
+        try:
+            dao_obj = _dao_for(get_storage(), dao, namespace)
+            fn = getattr(dao_obj, method)
+        except AttributeError:
+            return web.json_response(
+                {"error": f"unknown method {dao}.{method}"}, status=404)
+
+        if method == "find":
+            # Stream NDJSON: pull the sync iterator in slabs on the
+            # executor so one slow scan never blocks the loop. The first
+            # slab is fetched BEFORE headers go out — find() is a
+            # generator, so argument/backend errors only surface on first
+            # pull, and this way they return a clean 500. Later failures
+            # are delivered in-band as an {"__error__": ...} line (the
+            # client raises StorageServerError on it) — the status line
+            # is already on the wire by then.
+            pos, kw = _positional(dao, method, args)
+            try:
+                it = fn(*pos, **kw)
+                slab = await loop.run_in_executor(
+                    None, lambda: list(itertools.islice(it, 500)))
+            except Exception as e:  # noqa: BLE001 — surfaced to client
+                log.exception("rpc %s.find failed", dao)
+                return web.json_response({"error": str(e)}, status=500)
+            resp = web.StreamResponse(
+                headers={"Content-Type": "application/x-ndjson"})
+            await resp.prepare(request)
+            while slab:
+                await resp.write(
+                    b"".join(json.dumps(e.to_json()).encode() + b"\n"
+                             for e in slab))
+                try:
+                    slab = await loop.run_in_executor(
+                        None, lambda: list(itertools.islice(it, 500)))
+                except Exception as e:  # noqa: BLE001 — in-band error
+                    log.exception("rpc %s.find failed mid-stream", dao)
+                    await resp.write(
+                        json.dumps({"__error__": str(e)}).encode() + b"\n")
+                    break
+            await resp.write_eof()
+            return resp
+
+        pos, kw = _positional(dao, method, args)
+        try:
+            result = await loop.run_in_executor(None, lambda: fn(*pos, **kw))
+        except Exception as e:  # noqa: BLE001 — surfaced to client
+            log.exception("rpc %s.%s failed", dao, method)
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"result": _encode_result(dao, result)})
+
+    async def model_put(request: web.Request):
+        ns = request.match_info["namespace"]
+        mid = request.match_info["model_id"]
+        data = await request.read()
+        loop = asyncio.get_running_loop()
+        dao = _dao_for(get_storage(), "models", ns)
+        await loop.run_in_executor(
+            None, lambda: dao.insert(Model(id=mid, models=data)))
+        return web.json_response({"result": True})
+
+    async def model_get(request: web.Request):
+        ns = request.match_info["namespace"]
+        mid = request.match_info["model_id"]
+        loop = asyncio.get_running_loop()
+        dao = _dao_for(get_storage(), "models", ns)
+        m = await loop.run_in_executor(None, lambda: dao.get(mid))
+        if m is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(body=m.models,
+                            content_type="application/octet-stream")
+
+    async def model_delete(request: web.Request):
+        ns = request.match_info["namespace"]
+        mid = request.match_info["model_id"]
+        loop = asyncio.get_running_loop()
+        dao = _dao_for(get_storage(), "models", ns)
+        await loop.run_in_executor(None, lambda: dao.delete(mid))
+        return web.json_response({"result": True})
+
+    app.router.add_get("/health", health)
+    app.router.add_post("/rpc/{dao}/{method}", rpc)
+    app.router.add_put("/models/{namespace}/{model_id}", model_put)
+    app.router.add_get("/models/{namespace}/{model_id}", model_get)
+    app.router.add_delete("/models/{namespace}/{model_id}", model_delete)
+    return app
+
+
+def run_storage_server(ip: str = "0.0.0.0", port: int = 7072,
+                       storage: Optional[Storage] = None) -> None:
+    web.run_app(build_app(storage), host=ip, port=port,
+                print=lambda *_: None)

@@ -25,6 +25,7 @@ import threading
 from typing import Callable, Optional
 
 from . import base
+from .http_backend import HTTPStorageClient
 from .jsonl import JSONLClient
 from .localfs import LocalFSClient
 from .memory import StorageClient as MemoryClient
@@ -40,11 +41,14 @@ _BACKENDS: dict[str, Callable[[base.StorageClientConfig], base.BaseStorageClient
     "SQLITE": SQLiteClient,
     "LOCALFS": LocalFSClient,
     "JSONL": JSONLClient,
-    # Placeholders for parity with the reference backend matrix; these are
-    # separate services the sandbox cannot host. The registry raises a
-    # clear error if selected (reference: hbase/elasticsearch/jdbc/s3/hdfs).
+    # Client-server: a `pio storageserver` service shared by many hosts —
+    # the HBase/JDBC/ES network-store role (http_backend.py).
+    "HTTP": HTTPStorageClient,
 }
 
+# Backend types whose wire protocols belong to external services this
+# distribution does not speak natively; the registry points at the HTTP
+# backend (same deployment shape: a shared network store) if selected.
 _UNSUPPORTED = {"HBASE", "ELASTICSEARCH", "PGSQL", "MYSQL", "JDBC", "S3", "HDFS"}
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
@@ -107,6 +111,16 @@ class Storage:
             f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"pio_{repo.lower()}"
         )
 
+    def repo_source_type(self, repo: str) -> str:
+        """The configured TYPE of a repository's source (without
+        constructing the client). Default source is SQLITE."""
+        source = self._repo_source_name(repo)
+        if source == "PIO_DEFAULT":
+            return "SQLITE"
+        return self._env.get(
+            f"PIO_STORAGE_SOURCES_{source}_TYPE", ""
+        ).upper()
+
     def _client_for_source(self, source_name: str) -> base.BaseStorageClient:
         with self._lock:
             if source_name in self._clients:
@@ -130,9 +144,10 @@ class Storage:
             if stype in _UNSUPPORTED and stype not in _BACKENDS:
                 raise StorageError(
                     f"Storage type {stype} requires an external service not "
-                    f"bundled with this build; register a backend via "
-                    f"register_backend({stype!r}, ...) or use "
-                    f"SQLITE/MEMORY/LOCALFS."
+                    f"bundled with this build; for a shared network store "
+                    f"run `pio storageserver` and set TYPE=HTTP, or "
+                    f"register a backend via register_backend({stype!r}, "
+                    f"...), or use SQLITE/MEMORY/LOCALFS/JSONL."
                 )
             if stype not in _BACKENDS:
                 raise StorageError(f"Unknown storage type {stype}")

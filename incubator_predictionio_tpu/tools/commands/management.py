@@ -43,6 +43,37 @@ def eventserver_cmd(args: list[str]) -> int:
     return 0
 
 
+@verb("storageserver", "host this node's storage over HTTP (:7072)")
+def storageserver_cmd(args: list[str]) -> int:
+    """Serve the DAO surface of the locally-configured PIO_STORAGE_*
+    backends to remote hosts (TYPE=HTTP clients) — the HBase/JDBC/ES
+    shared-store role. See data/api/storage_server.py."""
+    from ...data.storage.registry import REPOSITORIES
+
+    p = argparse.ArgumentParser(prog="pio storageserver")
+    # 127.0.0.1 by default: the protocol is unauthenticated (full
+    # read/write incl. access keys). Bind wider only inside a trusted
+    # network segment.
+    p.add_argument("--ip", default="127.0.0.1",
+                   help="bind address; the API is UNAUTHENTICATED — only "
+                        "expose it to trusted hosts")
+    p.add_argument("--port", type=int, default=7072)
+    ns = p.parse_args(args)
+    s = Storage.instance()
+    for repo in REPOSITORIES:
+        if s.repo_source_type(repo) == "HTTP":
+            print("[error] this node's own storage is TYPE=HTTP; serving "
+                  "it again would proxy in a loop. Point the server node "
+                  "at an embedded backend (SQLITE/JSONL/LOCALFS).",
+                  file=sys.stderr)
+            return 1
+    from ...data.api.storage_server import run_storage_server
+
+    print(f"[info] Storage server running on {ns.ip}:{ns.port}")
+    run_storage_server(ns.ip, ns.port)
+    return 0
+
+
 def _resolve_app_id(s: Storage, appid: int | None, app_name: str | None) -> int:
     if appid is not None:
         return appid

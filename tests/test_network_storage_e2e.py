@@ -1,0 +1,162 @@
+"""Network storage end-to-end: many hosts, one shared store.
+
+The deployment shape the embedded backends cannot give (VERDICT.md
+missing #2/#4): a `pio storageserver` node holds the data; training,
+serving, and ops hosts — each with its OWN empty PIO_FS_BASEDIR — point
+TYPE=HTTP at it. Proves (a) `pio status` connectivity checking, (b) the
+full app/import/train lifecycle over the wire, and (c) the HDFS/S3-role
+remote model store: a host that never trained deploys the model from the
+network and serves queries (reference: storage/hbase + jdbc + Models-on-
+HDFS roles, SURVEY.md §2.1).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PIO = os.path.join(REPO, "bin", "pio")
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_pio(args, env, check=True):
+    r = subprocess.run(
+        [PIO, *args], capture_output=True, text=True, env=env, timeout=300
+    )
+    if check and r.returncode != 0:
+        raise AssertionError(
+            f"pio {' '.join(args)} failed ({r.returncode}):\n{r.stdout}\n{r.stderr}"
+        )
+    return r
+
+
+def _http_env(base_dir, port):
+    env = dict(os.environ)
+    env.update({
+        "PIO_FS_BASEDIR": str(base_dir),
+        "PIO_TEST_FORCE_CPU": "1",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+        "PIO_STORAGE_SOURCES_NET_TYPE": "HTTP",
+        "PIO_STORAGE_SOURCES_NET_HOSTS": "127.0.0.1",
+        "PIO_STORAGE_SOURCES_NET_PORTS": str(port),
+    })
+    return env
+
+
+@pytest.fixture()
+def storage_server(tmp_path):
+    port = free_port()
+    server_env = dict(os.environ)
+    server_env["PIO_FS_BASEDIR"] = str(tmp_path / "server_store")
+    server_env["PIO_TEST_FORCE_CPU"] = "1"
+    proc = subprocess.Popen(
+        [PIO, "storageserver", "--ip", "127.0.0.1", "--port", str(port)],
+        env=server_env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=2
+            ) as r:
+                assert json.loads(r.read())["status"] == "ok"
+                break
+        except OSError:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"storageserver died: {proc.stdout.read()}")
+            time.sleep(0.5)
+    else:
+        raise AssertionError("storageserver never became healthy")
+    yield port
+    proc.terminate()
+    proc.wait(timeout=30)
+
+
+def test_shared_store_lifecycle_and_remote_deploy(storage_server, tmp_path):
+    port = storage_server
+
+    # Host A: ingest + train. Its basedir starts empty.
+    env_a = _http_env(tmp_path / "host_a", port)
+    r = run_pio(["status"], env_a)
+    assert "ready to go" in r.stdout  # connectivity verified over HTTP
+
+    run_pio(["app", "new", "NetApp"], env_a)
+    events = tmp_path / "events.jsonl"
+    rng = np.random.default_rng(0)
+    with open(events, "w") as f:
+        for k in range(300):
+            f.write(json.dumps({
+                "event": "rate", "entityType": "user",
+                "entityId": f"u{rng.integers(0, 20)}",
+                "targetEntityType": "item",
+                "targetEntityId": f"i{rng.integers(0, 12)}",
+                "properties": {"rating": int(rng.integers(1, 6))},
+                "eventTime": f"2024-01-01T00:{k // 60:02d}:{k % 60:02d}.000Z",
+            }) + "\n")
+    r = run_pio(["import", "--app-name", "NetApp", "--input", str(events)],
+                env_a)
+    assert "Imported 300 events" in r.stdout
+
+    proj = str(tmp_path / "engine")
+    run_pio(["template", "get", "recommendation", proj], env_a)
+    ej = os.path.join(proj, "engine.json")
+    with open(ej) as f:
+        e = json.load(f)
+    e["datasource"]["params"]["appName"] = "NetApp"
+    e["algorithms"][0]["params"]["numIterations"] = 3
+    with open(ej, "w") as f:
+        json.dump(e, f)
+    r = run_pio(["train", "--engine-dir", proj], env_a)
+    assert "Training completed" in r.stdout
+
+    # Host B: NEVER trained, EMPTY basedir — deploys the model from the
+    # shared store and answers queries (remote model store).
+    env_b = _http_env(tmp_path / "host_b", port)
+    port_b = free_port()
+    server = subprocess.Popen(
+        [PIO, "deploy", "--engine-dir", proj, "--port", str(port_b)],
+        env=env_b, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.time() + 120
+        body = None
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port_b}/queries.json",
+                    data=json.dumps({"user": "u1", "num": 3}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    body = json.loads(resp.read())
+                break
+            except OSError:
+                if server.poll() is not None:
+                    raise AssertionError(
+                        f"deploy died: {server.stdout.read()}")
+                time.sleep(1)
+        assert body is not None, "server never answered"
+        assert len(body["itemScores"]) == 3
+        # host_b's own disk must hold no model blob — it came off the wire.
+        for root, _dirs, files in os.walk(tmp_path / "host_b"):
+            assert not any(f.endswith((".sqlite", ".bin")) for f in files), (
+                root, files)
+    finally:
+        server.terminate()
+        server.wait(timeout=30)

@@ -58,11 +58,34 @@ def _make_storage(kind, tmp_path):
     return Storage(env)
 
 
-BACKENDS = ["memory", "sqlite", "mixed", "jsonl"]
+BACKENDS = ["memory", "sqlite", "mixed", "jsonl", "http"]
 
 
 @pytest.fixture(params=BACKENDS)
 def storage(request, tmp_path):
+    if request.param == "http":
+        # Client-server: a storage server (sqlite-backed) in a thread,
+        # the Storage under test speaking TYPE=HTTP to it — the network
+        # backend runs the IDENTICAL contract as the embedded ones
+        # (reference: LEventsSpec against HBase/JDBC/ES).
+        from incubator_predictionio_tpu.data.api.storage_server import build_app
+        from server_utils import ServerThread
+
+        backing = _make_storage("sqlite", tmp_path)
+        with ServerThread(build_app(backing)) as srv:
+            env = {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+                "PIO_STORAGE_SOURCES_NET_TYPE": "HTTP",
+                "PIO_STORAGE_SOURCES_NET_HOSTS": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_NET_PORTS": str(srv.port),
+            }
+            s = Storage(env)
+            yield s
+            s.close()
+            backing.close()
+        return
     s = _make_storage(request.param, tmp_path)
     yield s
     s.close()
