@@ -336,8 +336,15 @@ def _resolve_params(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
 
 
 def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
-                   items: ShardedBlocked):
-    """Build the jitted full training loop for fixed layouts."""
+                   items: ShardedBlocked, span_override=None):
+    """Build the jitted full training loop for fixed layouts.
+
+    ``span_override`` = (u_span, i_span): sharded multi-host ingest
+    passes globally-maxed scan-window bounds here, because each process
+    only holds its own tiles and the spans are baked into the (identical
+    everywhere) executable. All other layout numbers are per-shard and
+    already process-invariant.
+    """
     params = _resolve_params(mesh, params, users, items)
     cd = jnp.bfloat16 if params.compute_dtype == "bfloat16" else jnp.float32
     implicit = params.implicit_prefs
@@ -354,8 +361,11 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
     rep = P()                        # replicated
     y_spec = P(MODEL_AXIS, None) if model_sharded else rep
 
-    u_span = _chunk_row_span(users, params.chunk_tiles)
-    i_span = _chunk_row_span(items, params.chunk_tiles)
+    if span_override is not None:
+        u_span, i_span = span_override
+    else:
+        u_span = _chunk_row_span(users, params.chunk_tiles)
+        i_span = _chunk_row_span(items, params.chunk_tiles)
 
     def one_side(y, blk_cols, blk_vals, blk_lrow, counts,
                  rows_per_shard, row_span):
@@ -634,6 +644,173 @@ def train_als(
                 )
     else:
         x, y = fn(params.num_iterations - start_iter, x0, y0, *blocks)
+    x, y = jax.device_get((x, y))
+    return ALSFactors(
+        user_factors=np.asarray(x)[:n_users],
+        item_factors=np.asarray(y)[:n_items],
+        n_users=n_users,
+        n_items=n_items,
+    )
+
+
+def process_row_ranges(n_rows: int, mesh: Optional[Mesh] = None
+                       ) -> tuple[int, int]:
+    """[row0, row1) of entity rows THIS process owns on the mesh data axis.
+
+    The contract for sharded multi-host ingest: each training process
+    range-reads only the events whose solved-side row falls in its range
+    (one range per side), instead of every host scanning the full store.
+    Deterministic from (n_rows, mesh) alone — no coordination needed.
+    """
+    mesh = mesh or default_mesh()
+    d_size = mesh.shape[DATA_AXIS]
+    m_size = mesh.shape.get(MODEL_AXIS, 1)
+    rps = -(-(-(-n_rows // d_size)) // m_size) * m_size
+    n_proc = jax.process_count()
+    shards_per_proc = d_size // n_proc
+    p = jax.process_index()
+    return p * shards_per_proc * rps, (p + 1) * shards_per_proc * rps
+
+
+def _local_blocked(rows, cols, vals, row0, n_local_rows, rps, n_local_shards,
+                   block_len, pad_col):
+    """Blocked tiles for this process's row range only. ``rows`` are
+    global indices, all within [row0, row0 + n_local_rows)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    if rows.size and (rows.min() < row0 or rows.max() >= row0 + n_local_rows):
+        raise ValueError(
+            f"sharded ingest: got rows outside this process's range "
+            f"[{row0}, {row0 + n_local_rows}) — the caller must range-read "
+            "only owned rows (process_row_ranges)")
+    blocked = build_blocked(rows - row0, cols, vals, n_local_rows,
+                            block_len, pad_col=pad_col)
+    return shard_blocked(blocked, n_local_shards, rows_per_shard=rps)
+
+
+def train_als_process_sharded(
+    user_slice: tuple[np.ndarray, np.ndarray, np.ndarray],
+    item_slice: tuple[np.ndarray, np.ndarray, np.ndarray],
+    n_users: int,
+    n_items: int,
+    params: ALSParams,
+    mesh: Optional[Mesh] = None,
+) -> ALSFactors:
+    """Multi-controller ALS where each process ingests ONLY its shard.
+
+    ``user_slice`` = (user_idx, item_idx, rating) holding exactly the
+    events whose USER row this process owns (``process_row_ranges(
+    n_users)``); ``item_slice`` the same for ITEM rows. In a deployment
+    these are two range-reads against the shared event store — no host
+    ever materializes the full dataset, removing train_als's
+    every-process-holds-everything constraint (the Spark-side analog is
+    partitioned RDD ingest, SURVEY.md §2.10).
+
+    The math and layout are IDENTICAL to ``train_als`` on the same
+    global data: tiles are built per-owned-shard in local coordinates,
+    padded to the global per-shard tile count (one tiny allgather of
+    tile counts — the only control-plane coordination), and assembled
+    with ``jax.make_array_from_process_local_data``. Factors match the
+    single-process run bit-for-bit.
+
+    1-D (data-axis) meshes; checkpoint hooks are not supported here yet.
+    """
+    mesh = mesh or default_mesh()
+    if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
+        raise ValueError(
+            "sharded ingest currently supports 1-D data meshes only")
+    d_size = mesh.shape[DATA_AXIS]
+    n_proc = jax.process_count()
+    if d_size % n_proc:
+        raise ValueError(f"{d_size} devices do not divide {n_proc} processes")
+    n_local = d_size // n_proc
+
+    rps_u = -(-n_users // d_size)
+    rps_i = -(-n_items // d_size)
+    pad_users, pad_items = d_size * rps_u, d_size * rps_i
+
+    u_row0, _ = process_row_ranges(n_users, mesh)
+    i_row0, _ = process_row_ranges(n_items, mesh)
+    uu, ui, ur = user_slice
+    iu, ii, ir = item_slice
+    by_user = _local_blocked(uu, ui, ur, u_row0, n_local * rps_u, rps_u,
+                             n_local, params.block_len, pad_col=pad_items)
+    by_item = _local_blocked(ii, iu, ir, i_row0, n_local * rps_i, rps_i,
+                             n_local, params.block_len, pad_col=pad_users)
+
+    # Global per-shard tile count = max over every process's shards; the
+    # one piece of global knowledge the layout needs. 2-int allgather
+    # over the DCN control plane.
+    from jax.experimental import multihost_utils
+
+    local_bs = np.array([by_user.col.shape[0] // n_local,
+                         by_item.col.shape[0] // n_local], np.int64)
+    all_bs = np.asarray(
+        multihost_utils.process_allgather(local_bs)).reshape(-1, 2)
+    bs_u, bs_i = int(all_bs[:, 0].max()), int(all_bs[:, 1].max())
+
+    def _pad_tiles(sb: ShardedBlocked, bs: int, pad_col: int):
+        cur = sb.col.shape[0] // sb.n_shards
+        if cur == bs:
+            return sb
+        L = sb.col.shape[1]
+
+        def pad3(a, fill):
+            a = a.reshape(sb.n_shards, cur, *a.shape[1:])
+            width = [(0, 0), (0, bs - cur)] + [(0, 0)] * (a.ndim - 2)
+            return np.pad(a, width, constant_values=fill).reshape(
+                sb.n_shards * bs, *a.shape[2:])
+
+        return dataclasses.replace(
+            sb, col=pad3(sb.col, pad_col), val=pad3(sb.val, 0.0),
+            mask=pad3(sb.mask, 0.0), local_row=pad3(sb.local_row, 0),
+        )
+
+    by_user = _pad_tiles(by_user, bs_u, pad_items)
+    by_item = _pad_tiles(by_item, bs_i, pad_users)
+
+    # Per-shard layout numbers (rows/tiles per shard, L) are identical
+    # on every process after the padding above, so the local
+    # ShardedBlocked describes the global layout — except the chunked-
+    # scan row-span bounds, which are maxima over ALL shards: allgather
+    # them so each process bakes the same executable.
+    params = _resolve_params(mesh, params, by_user, by_item)
+    spans = np.array([
+        _chunk_row_span(by_user, params.chunk_tiles),
+        _chunk_row_span(by_item, params.chunk_tiles),
+    ], np.int64)
+    all_spans = np.asarray(
+        multihost_utils.process_allgather(spans)).reshape(-1, 2)
+    span_override = (int(all_spans[:, 0].max()), int(all_spans[:, 1].max()))
+    fn, in_shardings = _make_train_fn(mesh, params, by_user, by_item,
+                                      span_override=span_override)
+
+    # Same init as train_als._fresh_init — bit-for-bit parity. Factor
+    # init is O(rows·k) host memory (tiny next to the event data, which
+    # IS process-local here).
+    k = params.rank
+    rng = np.random.default_rng(params.seed)
+    x0 = (rng.standard_normal((pad_users, k)) / np.sqrt(k)).astype(np.float32)
+    y0 = (rng.standard_normal((pad_items, k)) / np.sqrt(k)).astype(np.float32)
+
+    def _from_local(local, sharding, global_rows):
+        return jax.make_array_from_process_local_data(
+            sharding, local, (global_rows,) + local.shape[1:])
+
+    u_blocks = (by_user.col, by_user.val, by_user.local_row,
+                by_user.counts)
+    i_blocks = (by_item.col, by_item.val, by_item.local_row,
+                by_item.counts)
+    blocks = tuple(
+        _from_local(b, s, d_size * (b.shape[0] // n_local))
+        for b, s in zip(u_blocks + i_blocks, in_shardings[3:])
+    )
+    # Factor carries are replicated on a 1-D mesh: every process supplies
+    # the (identical, same-seed) full array.
+    gx0 = jax.make_array_from_callback(
+        x0.shape, in_shardings[1], lambda idx: x0[idx])
+    gy0 = jax.make_array_from_callback(
+        y0.shape, in_shardings[2], lambda idx: y0[idx])
+    x, y = fn(np.int32(params.num_iterations), gx0, gy0, *blocks)
     x, y = jax.device_get((x, y))
     return ALSFactors(
         user_factors=np.asarray(x)[:n_users],

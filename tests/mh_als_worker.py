@@ -25,6 +25,7 @@ from incubator_predictionio_tpu.parallel.mesh import mesh_from_devices  # noqa: 
 
 def main() -> int:
     out_path = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "full"
     rng = np.random.default_rng(11)
     n_users, n_items, nnz = 40, 30, 600
     u = rng.integers(0, n_users, nnz).astype(np.int32)
@@ -33,7 +34,26 @@ def main() -> int:
 
     mesh = mesh_from_devices(devices=jax.devices())  # global: spans processes
     params = ALSParams(rank=4, num_iterations=3, block_len=8, seed=5)
-    out = train_als(u, i, r, n_users, n_items, params, mesh=mesh)
+    if mode == "sharded":
+        # Sharded ingest: this worker keeps ONLY the events it owns —
+        # one slice per side, the moral equivalent of two range-reads
+        # against a shared event store. The full arrays above stand in
+        # for the store; everything passed to training is sliced.
+        from incubator_predictionio_tpu.ops.als import (
+            process_row_ranges, train_als_process_sharded,
+        )
+
+        u0, u1 = process_row_ranges(n_users, mesh)
+        i0, i1 = process_row_ranges(n_items, mesh)
+        usel = (u >= u0) & (u < u1)
+        isel = (i >= i0) & (i < i1)
+        out = train_als_process_sharded(
+            (u[usel], i[usel], r[usel]),
+            (u[isel], i[isel], r[isel]),
+            n_users, n_items, params, mesh=mesh,
+        )
+    else:
+        out = train_als(u, i, r, n_users, n_items, params, mesh=mesh)
 
     if jax.process_index() == 0:
         np.savez(out_path, user=out.user_factors, item=out.item_factors)

@@ -28,7 +28,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_training_matches_single_process(tmp_path):
+@pytest.mark.parametrize("mode", ["full", "sharded"])
+def test_two_process_training_matches_single_process(tmp_path, mode):
+    """mode="full": every worker holds the whole dataset (shared-store
+    reads). mode="sharded": each worker ingests ONLY the event ranges it
+    owns (ops.als.train_als_process_sharded) — the partitioned-ingest
+    story; factors must still match the single-process run."""
     # No pytest-timeout in this image; the communicate(timeout=240) below
     # is the hang guard.
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -47,7 +52,7 @@ def test_two_process_training_matches_single_process(tmp_path):
     for pid in range(2):
         env = {**env_base, "PIO_PROCESS_ID": str(pid)}
         procs.append(subprocess.Popen(
-            [sys.executable, worker, out_path],
+            [sys.executable, worker, out_path, mode],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         ))
     outs = []
