@@ -47,8 +47,9 @@ class SelfCleaningDataSource:
         if cutoff is not None and self.event_window_remove:
             doomed = [e.event_id for e in le.find(app.id, until_time=cutoff)
                       if e.event not in ("$set", "$unset", "$delete")]
-            le.delete_batch(doomed, app.id)
-            removed += len(doomed)
+            # Count what delete_batch actually deleted, not what we asked
+            # for — a concurrent writer may have removed some ids already.
+            removed += sum(le.delete_batch(doomed, app.id))
 
         # 2) compact property-event streams per entity type into one $set
         prop_events = list(
@@ -61,8 +62,8 @@ class SelfCleaningDataSource:
             if len(events) <= len({e.entity_id for e in events}):
                 continue  # nothing to compact
             snapshot = aggregate_property_events(events)
-            le.delete_batch([e.event_id for e in events], app.id)
-            removed += len(events)
+            removed += sum(
+                le.delete_batch([e.event_id for e in events], app.id))
             for entity_id, pm in snapshot.items():
                 le.insert(
                     Event(
@@ -73,6 +74,9 @@ class SelfCleaningDataSource:
                     app.id,
                 )
                 removed -= 1
+        # A concurrent deleter racing the compaction pass can make
+        # deletions < insertions; net "removed" is then 0, not negative.
+        removed = max(removed, 0)
         if removed:
             log.info("self-cleaning removed %d events", removed)
         return removed

@@ -17,9 +17,11 @@ reads never materialize server-side.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import itertools
 import json
 import logging
+import os
 from typing import Optional
 
 from aiohttp import web
@@ -41,6 +43,26 @@ _DAO_ROUTES = {
     "models": ("MODELDATA", "models"),
     "l_events": ("EVENTDATA", "l_events"),
     "p_events": ("EVENTDATA", "p_events"),
+}
+
+# Wire surface per DAO — exactly the methods the HTTP client classes
+# speak (data/storage/http_backend.py _HTTP*). Anything else 404s:
+# the DAOs carry non-wire methods (aggregate_properties, compact, ...)
+# whose results aren't JSON-encodable and which were never meant to be
+# remote-callable. Model blobs ride the dedicated /models/... routes.
+_ALLOWED_METHODS = {
+    "apps": {"insert", "get", "get_by_name", "get_all", "update", "delete"},
+    "access_keys": {"insert", "get", "get_all", "get_by_appid", "update",
+                    "delete"},
+    "channels": {"insert", "get", "get_by_appid", "delete"},
+    "engine_instances": {"insert", "get", "get_all", "get_latest_completed",
+                         "get_completed", "update", "delete"},
+    "evaluation_instances": {"insert", "get", "get_all", "get_completed",
+                             "update", "delete"},
+    "models": set(),  # blob routes only
+    "l_events": {"init", "remove", "insert", "insert_batch", "get", "delete",
+                 "delete_batch", "find"},
+    "p_events": {"find", "write", "delete"},
 }
 
 # Record-valued "record" argument decoders, per DAO.
@@ -107,11 +129,31 @@ def _positional(dao: str, method: str, args: dict) -> tuple[tuple, dict]:
     return (), args
 
 
-def build_app(storage: Optional[Storage] = None) -> web.Application:
+def build_app(storage: Optional[Storage] = None,
+              secret: Optional[str] = None) -> web.Application:
+    """``secret``: shared-secret auth. When set, every route except
+    /health requires ``Authorization: Bearer <secret>`` (the client sends
+    it from ``PIO_STORAGE_SOURCES_<N>_SECRET``). Reference: every network
+    surface sits behind KeyAuthentication (common/.../authentication/
+    KeyAuthentication.scala, SURVEY.md §1 row 9)."""
     # 8 GiB body cap: model blobs are factor matrices and can run multi-GB
     # (the HDFS/S3 model-store role). Uploads buffer in server RAM — put
     # the store node on a box sized for its models.
-    app = web.Application(client_max_size=1 << 33)
+    @web.middleware
+    async def auth_middleware(request: web.Request, handler):
+        if secret and request.path != "/health":
+            got = request.headers.get("Authorization", "")
+            # bytes operands: compare_digest on str raises for non-ASCII
+            if not (got.startswith("Bearer ")
+                    and hmac.compare_digest(
+                        got[7:].encode("utf-8", "surrogateescape"),
+                        secret.encode("utf-8", "surrogateescape"))):
+                return web.json_response({"error": "unauthorized"},
+                                         status=401)
+        return await handler(request)
+
+    app = web.Application(client_max_size=1 << 33,
+                          middlewares=[auth_middleware])
     app["storage"] = storage  # None → Storage.instance() at request time
 
     def get_storage() -> Storage:
@@ -126,8 +168,9 @@ def build_app(storage: Optional[Storage] = None) -> web.Application:
         if dao not in _DAO_ROUTES:
             return web.json_response({"error": f"unknown dao {dao!r}"},
                                      status=404)
-        if method.startswith("_"):
-            return web.json_response({"error": "invalid method"}, status=400)
+        if method not in _ALLOWED_METHODS[dao]:
+            return web.json_response(
+                {"error": f"unknown method {dao}.{method}"}, status=404)
         try:
             payload = await request.json()
             namespace = payload.get("namespace") or "pio"
@@ -223,7 +266,21 @@ def build_app(storage: Optional[Storage] = None) -> web.Application:
     return app
 
 
-def run_storage_server(ip: str = "0.0.0.0", port: int = 7072,
-                       storage: Optional[Storage] = None) -> None:
-    web.run_app(build_app(storage), host=ip, port=port,
-                print=lambda *_: None)
+def run_storage_server(ip: str = "127.0.0.1", port: int = 7072,
+                       storage: Optional[Storage] = None,
+                       secret: Optional[str] = None) -> None:
+    """Safe-by-default posture: loopback bind, and a non-loopback bind
+    REFUSES to start without a shared secret (PIO_STORAGESERVER_SECRET or
+    the ``secret`` arg) — this API is full read/write over access keys,
+    events and models. TLS via PIO_SSL_CERTFILE/PIO_SSL_KEYFILE
+    (common/ssl_config.py), mirroring the reference's SSLConfiguration."""
+    from ...common.ssl_config import ssl_context_from_env
+
+    secret = secret or os.environ.get("PIO_STORAGESERVER_SECRET") or None
+    if not secret and ip not in ("127.0.0.1", "localhost", "::1"):
+        raise SystemExit(
+            f"refusing to bind the storage server on {ip} without a "
+            "shared secret: set PIO_STORAGESERVER_SECRET (and the matching "
+            "PIO_STORAGE_SOURCES_<N>_SECRET on clients) or bind 127.0.0.1")
+    web.run_app(build_app(storage, secret=secret), host=ip, port=port,
+                ssl_context=ssl_context_from_env(), print=lambda *_: None)

@@ -161,12 +161,25 @@ class StorageServerError(Exception):
     """Transport or server-side failure of a storage RPC."""
 
 
+# Per-line cap for NDJSON scan streams. Events with multi-MB properties
+# fit comfortably; an unterminated line from a buggy server trips it.
+_MAX_STREAM_LINE = 64 * 1024 * 1024
+
+
 class _Transport:
     def __init__(self, url: str, timeout: float = 30.0,
-                 stream_timeout: float = 600.0):
+                 stream_timeout: float = 600.0,
+                 secret: Optional[str] = None):
         self.url = url.rstrip("/")
         self.timeout = timeout
         self.stream_timeout = stream_timeout
+        self.secret = secret
+
+    def _headers(self, base: Optional[dict] = None) -> dict:
+        h = dict(base or {})
+        if self.secret:
+            h["Authorization"] = f"Bearer {self.secret}"
+        return h
 
     def ping(self) -> None:
         try:
@@ -184,7 +197,7 @@ class _Transport:
         body = json.dumps({"namespace": namespace, "args": args}).encode()
         req = urllib.request.Request(
             f"{self.url}/rpc/{dao}/{method}", data=body,
-            headers={"Content-Type": "application/json"},
+            headers=self._headers({"Content-Type": "application/json"}),
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
@@ -207,8 +220,8 @@ class _Transport:
         body = json.dumps({"namespace": namespace, "args": args}).encode()
         req = urllib.request.Request(
             f"{self.url}/rpc/{dao}/{method}", data=body,
-            headers={"Content-Type": "application/json",
-                     "Accept": "application/x-ndjson"},
+            headers=self._headers({"Content-Type": "application/json",
+                                   "Accept": "application/x-ndjson"}),
         )
         try:
             # Streaming scans use their own (much longer) timeout: a
@@ -217,7 +230,17 @@ class _Transport:
             with urllib.request.urlopen(
                 req, timeout=self.stream_timeout
             ) as r:
-                for line in r:
+                while True:
+                    # Bounded readline: a server-side bug emitting an
+                    # unterminated line must not buffer unboundedly here.
+                    line = r.readline(_MAX_STREAM_LINE + 1)
+                    if not line:
+                        break
+                    if len(line) > _MAX_STREAM_LINE and not line.endswith(b"\n"):
+                        raise StorageServerError(
+                            f"{dao}.{method}: stream line exceeds "
+                            f"{_MAX_STREAM_LINE} bytes (malformed NDJSON "
+                            "from server)")
                     line = line.strip()
                     if not line:
                         continue
@@ -243,14 +266,19 @@ class _Transport:
     def blob(self, method: str, path: str, data: Optional[bytes] = None):
         req = urllib.request.Request(
             f"{self.url}{path}", data=data, method=method,
-            headers={"Content-Type": "application/octet-stream"}
-            if data is not None else {},
+            headers=self._headers(
+                {"Content-Type": "application/octet-stream"}
+                if data is not None else {}),
         )
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return r.read()
         except urllib.error.HTTPError as e:
-            if e.code == 404:
+            # 404 is an expected answer only for reads/deletes of a
+            # missing blob. A PUT that 404s (wrong path prefix, proxy
+            # misroute) means the model was NOT stored — silent None here
+            # would surface much later as a failed deploy.
+            if e.code == 404 and method in ("GET", "DELETE"):
                 return None
             raise StorageServerError(f"{method} {path} failed ({e.code})") from e
         except OSError as e:
@@ -529,8 +557,14 @@ class HTTPStorageClient(base.BaseStorageClient):
         scheme = props.get("SCHEME", "http")
         timeout = float(props.get("TIMEOUT", "30"))
         stream_timeout = float(props.get("STREAM_TIMEOUT", "600"))
+        # Shared-secret auth: PIO_STORAGE_SOURCES_<N>_SECRET, falling back
+        # to the server-side var so one-box setups configure it once.
+        import os as _os
+
+        secret = (props.get("SECRET")
+                  or _os.environ.get("PIO_STORAGESERVER_SECRET") or None)
         self._t = _Transport(f"{scheme}://{host}:{port}", timeout=timeout,
-                             stream_timeout=stream_timeout)
+                             stream_timeout=stream_timeout, secret=secret)
         self._t.ping()
 
     def apps(self, namespace="pio_metadata"):

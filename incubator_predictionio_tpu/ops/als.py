@@ -194,6 +194,18 @@ def _half_step_local(y, col, val, local_row, counts, yty, *,
             # f32 path must match segment_sum bitwise-closely: force full
             # f32 matmul precision (TPU default truncates f32 to bf16 on
             # the MXU, which the non-chunked path never does).
+            #
+            # bf16 path — DELIBERATE precision divergence from unchunked:
+            # grams are f32 (accumulated from bf16 factors) but are cast
+            # back to bf16 here so the one-hot tile→row reduction runs as
+            # a bf16 MXU matmul; the unchunked path segment-sums the f32
+            # grams directly. The reduction dominates this path's FLOPs
+            # (span·chunk·k² vs the gram's chunk·L·k²), so an f32-HIGHEST
+            # reduction would cost ~6× the whole half-step. Per-entry
+            # rounding is one bf16 ulp (rel ≤ 2^-8) BEFORE an f32
+            # accumulation, and the λ ridge keeps the solve conditioned;
+            # tests/test_als_chunked_bf16.py bounds the chunked-vs-
+            # unchunked factor disagreement under this scheme.
             prec = (None if cd == jnp.bfloat16
                     else jax.lax.Precision.HIGHEST)
             part_a = jnp.einsum(
@@ -667,6 +679,12 @@ def process_row_ranges(n_rows: int, mesh: Optional[Mesh] = None
     m_size = mesh.shape.get(MODEL_AXIS, 1)
     rps = -(-(-(-n_rows // d_size)) // m_size) * m_size
     n_proc = jax.process_count()
+    if d_size % n_proc:
+        # Same contract train_als_process_sharded enforces; failing here
+        # keeps callers from range-reading wrong slices before train raises.
+        raise ValueError(
+            f"data axis size {d_size} is not divisible by "
+            f"{n_proc} processes")
     shards_per_proc = d_size // n_proc
     p = jax.process_index()
     return p * shards_per_proc * rps, (p + 1) * shards_per_proc * rps

@@ -51,13 +51,15 @@ def storageserver_cmd(args: list[str]) -> int:
     from ...data.storage.registry import REPOSITORIES
 
     p = argparse.ArgumentParser(prog="pio storageserver")
-    # 127.0.0.1 by default: the protocol is unauthenticated (full
-    # read/write incl. access keys). Bind wider only inside a trusted
-    # network segment.
     p.add_argument("--ip", default="127.0.0.1",
-                   help="bind address; the API is UNAUTHENTICATED — only "
-                        "expose it to trusted hosts")
+                   help="bind address; non-loopback binds REQUIRE a shared "
+                        "secret (--secret / PIO_STORAGESERVER_SECRET)")
     p.add_argument("--port", type=int, default=7072)
+    p.add_argument("--secret", default=None,
+                   help="shared secret clients must present as "
+                        "'Authorization: Bearer <secret>' (clients set "
+                        "PIO_STORAGE_SOURCES_<N>_SECRET); defaults to "
+                        "$PIO_STORAGESERVER_SECRET")
     ns = p.parse_args(args)
     s = Storage.instance()
     for repo in REPOSITORIES:
@@ -70,7 +72,7 @@ def storageserver_cmd(args: list[str]) -> int:
     from ...data.api.storage_server import run_storage_server
 
     print(f"[info] Storage server running on {ns.ip}:{ns.port}")
-    run_storage_server(ns.ip, ns.port)
+    run_storage_server(ns.ip, ns.port, secret=ns.secret)
     return 0
 
 

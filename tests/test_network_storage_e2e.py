@@ -23,6 +23,10 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PIO = os.path.join(REPO, "bin", "pio")
 
+# The whole e2e runs AUTHENTICATED (reference posture: every network
+# surface behind KeyAuthentication, SURVEY.md §1 row 9).
+SECRET = "e2e-shared-secret"
+
 
 def free_port():
     with socket.socket() as s:
@@ -52,6 +56,7 @@ def _http_env(base_dir, port):
         "PIO_STORAGE_SOURCES_NET_TYPE": "HTTP",
         "PIO_STORAGE_SOURCES_NET_HOSTS": "127.0.0.1",
         "PIO_STORAGE_SOURCES_NET_PORTS": str(port),
+        "PIO_STORAGE_SOURCES_NET_SECRET": SECRET,
     })
     return env
 
@@ -63,7 +68,8 @@ def storage_server(tmp_path):
     server_env["PIO_FS_BASEDIR"] = str(tmp_path / "server_store")
     server_env["PIO_TEST_FORCE_CPU"] = "1"
     proc = subprocess.Popen(
-        [PIO, "storageserver", "--ip", "127.0.0.1", "--port", str(port)],
+        [PIO, "storageserver", "--ip", "127.0.0.1", "--port", str(port),
+         "--secret", SECRET],
         env=server_env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True,
     )
@@ -160,3 +166,48 @@ def test_shared_store_lifecycle_and_remote_deploy(storage_server, tmp_path):
     finally:
         server.terminate()
         server.wait(timeout=30)
+
+
+def test_auth_rejects_bad_or_missing_secret(storage_server):
+    port = storage_server
+
+    def post(path, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps({"namespace": "pio_metadata",
+                             "args": {}}).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    # /health stays open (liveness probes don't carry secrets)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/health", timeout=5
+    ) as r:
+        assert r.status == 200
+    assert post("/rpc/apps/get_all") == 401
+    assert post("/rpc/apps/get_all",
+                {"Authorization": "Bearer wrong"}) == 401
+    assert post("/rpc/apps/get_all",
+                {"Authorization": f"Bearer {SECRET}"}) == 200
+    # non-wire DAO methods are not remotely callable (allowlist)
+    assert post("/rpc/p_events/aggregate_properties",
+                {"Authorization": f"Bearer {SECRET}"}) == 404
+
+
+def test_nonloopback_bind_requires_secret(tmp_path):
+    env = dict(os.environ)
+    env["PIO_FS_BASEDIR"] = str(tmp_path)
+    env["PIO_TEST_FORCE_CPU"] = "1"
+    env.pop("PIO_STORAGESERVER_SECRET", None)
+    r = subprocess.run(
+        [PIO, "storageserver", "--ip", "0.0.0.0", "--port",
+         str(free_port())],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert r.returncode != 0
+    assert "refusing" in (r.stdout + r.stderr).lower()
