@@ -107,38 +107,42 @@ def ops_level_events_per_sec(u, i, r, n_users, n_items, nnz, rank, iters):
     PIO_BENCH_CHUNK overrides, so the ratio isolates wrapper overhead."""
     import jax
 
-    from incubator_predictionio_tpu.ops.als import ALSParams, _make_train_fn
-    from incubator_predictionio_tpu.ops.blocked import build_blocked, shard_blocked
-    from incubator_predictionio_tpu.parallel.mesh import default_mesh
+    from incubator_predictionio_tpu.ops.als import (
+        ALSParams, _fresh_init, _host_lam, _make_train_fn, _side_flat,
+    )
+    from incubator_predictionio_tpu.ops.rowblocks import fill_buckets, plan_layout
+    from incubator_predictionio_tpu.parallel.mesh import (
+        DATA_AXIS, MODEL_AXIS, default_mesh,
+    )
 
     t0 = time.time()
     mesh = default_mesh()
     n_dev = len(mesh.devices.flatten().tolist())
+    d_size = mesh.shape[DATA_AXIS]
+    m_size = mesh.shape.get(MODEL_AXIS, 1)
     chunk_env = os.environ.get("PIO_BENCH_CHUNK")
     params = ALSParams(
-        rank=rank, num_iterations=iters, reg=0.01, block_len=32,
+        rank=rank, num_iterations=iters, reg=0.01,
         compute_dtype="auto",
         chunk_tiles=int(chunk_env) if chunk_env is not None else -1,
     )
-    pad_items = -(-n_items // n_dev) * n_dev
-    pad_users = -(-n_users // n_dev) * n_dev
-    by_user = shard_blocked(
-        build_blocked(u, i, r, n_users, params.block_len, pad_col=pad_items), n_dev)
-    by_item = shard_blocked(
-        build_blocked(i, u, r, n_items, params.block_len, pad_col=pad_users), n_dev)
-    log(f"[bench:ops] host prep {time.time()-t0:.1f}s "
-        f"(user tiles {by_user.col.shape}, item tiles {by_item.col.shape})")
+    plan_u = plan_layout(np.bincount(u, minlength=n_users), d_size, m_div=m_size)
+    plan_i = plan_layout(np.bincount(i, minlength=n_items), d_size, m_div=m_size)
+    arrs_u = fill_buckets(plan_u, u, i, r, col_slot_map=plan_i.slot_of_row,
+                          sentinel=plan_i.total_slots)
+    arrs_i = fill_buckets(plan_i, i, u, r, col_slot_map=plan_u.slot_of_row,
+                          sentinel=plan_u.total_slots)
+    log(f"[bench:ops] host prep {time.time()-t0:.1f}s (user buckets "
+        f"{[c.shape for c in arrs_u.cols]}, item buckets "
+        f"{[c.shape for c in arrs_i.cols]})")
 
-    rng = np.random.default_rng(params.seed)
-    x0 = (rng.standard_normal((by_user.padded_rows, rank)) / np.sqrt(rank)).astype(np.float32)
-    y0 = (rng.standard_normal((by_item.padded_rows, rank)) / np.sqrt(rank)).astype(np.float32)
-
-    fn, _ = _make_train_fn(mesh, params, by_user, by_item)
+    x0, y0 = _fresh_init(params, plan_u, plan_i, n_users, n_items)
+    fn, _ = _make_train_fn(mesh, params, plan_u, plan_i)
     args = (
         np.int32(iters),
         x0, y0,
-        by_user.col, by_user.val, by_user.local_row, by_user.counts,
-        by_item.col, by_item.val, by_item.local_row, by_item.counts,
+        *_side_flat(arrs_u, plan_u, _host_lam(plan_u, params)),
+        *_side_flat(arrs_i, plan_i, _host_lam(plan_i, params)),
     )
     t0 = time.time()
     args_dev = jax.device_put(args)

@@ -1,4 +1,4 @@
-"""JAX/XLA numeric kernels: blocked sparse layouts, ALS solves, segment
+"""JAX/XLA numeric kernels: length-bucketed sparse layouts, ALS solves, segment
 ops, top-k scoring, LLR co-occurrence. These are the TPU replacements for
 the MLlib/Mahout internals the reference delegates to (SURVEY.md §2.8-2.9).
 """

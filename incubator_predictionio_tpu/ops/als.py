@@ -6,27 +6,31 @@ ALS.trainImplicit; the distributed in/out-block shuffle lives inside Spark,
 SURVEY.md §2.9). This is a ground-up TPU design instead, following the ALX
 recipe (PAPERS.md: arxiv 2112.02194):
 
-- Factor matrices are dense f32 arrays. The side being *solved* is
-  row-sharded over the mesh data axis; on a 1-D mesh the counterpart
-  factor matrix is gathered (replicated) for the solve — the ICI
-  all-gather replaces MLlib's factor shuffle.
-- On a 2-D (d, m) mesh the counterpart is instead row-sharded over the
-  MODEL_AXIS (the ALX sharded layout): each device gathers only rows it
-  owns (zeros elsewhere) and the per-row normal equations — linear in
+- Ratings are laid out as length-bucketed dense row slabs
+  (ops/rowblocks.py): each row's entries occupy one [C_b]-wide slab row,
+  so the per-row normal equations fall straight out of a batched
+  [R, C_b, k] einsum on the MXU — there is no tile→row segment reduction
+  at all. The layout minimizes padded entries because the half-step is
+  GATHER-BOUND: the TPU gather unit sustains a fixed ~420M rows/s
+  (measured, tools/profile_als.py), so every padded entry wastes a fixed
+  gather slot. See BASELINE.md "ALS half-step roofline".
+- Factor matrices are dense f32 arrays in layout ("π") order. The side
+  being *solved* is slot-sharded over the mesh data axis; on a 1-D mesh
+  the counterpart factor matrix is replicated for the gather. On a 2-D
+  (d, m) mesh the counterpart is instead row-sharded over MODEL_AXIS
+  (the ALX sharded layout): each device gathers only slots it owns
+  (zeros elsewhere) and the per-row normal equations — linear in
   per-entry outer products — are psummed over 'm'. HBM budget: factor
-  storage per device is n_rows·k·4/m bytes instead of n_rows·k·4, so
-  catalog capacity scales linearly with the model axis; e.g. 20M items
-  at rank 128 is 10.2 GB replicated (over a v5e's 16 GB once both sides
-  plus tiles are resident) but 1.3 GB/device on an m=8 ring. The extra
-  cost is one [rows/d, k, k] psum per half-step plus the d↔m all-to-all
-  that re-shards freshly solved factors.
-- Ratings are laid out as blocked-COO tiles (ops/blocked.py), twice:
-  user-major and item-major. Per-tile Gram matrices are batched einsums
-  on the MXU; tile→row segment-sums are device-local by construction.
+  storage per device is n_rows·k·4/m bytes, so catalog capacity scales
+  linearly with the model axis. Ownership windows are windows of SLOTS,
+  so the ALX layout composes with any data-axis layout (including
+  multi-host sharded ingest) with no extra machinery.
 - One half-step solves the regularized normal equations
-  (YᵀY + λ·c·I) x = Yᵀr per row with a batched Cholesky solve.
+  (YᵀY + λ·c·I) x = Yᵀr per row with a batched Pallas Gauss-Jordan
+  solve (ops/pallas_kernels.py).
 - The whole iteration loop runs inside one jit under shard_map; the only
-  cross-device traffic is the all-gather of freshly solved factors.
+  cross-device traffic is the counterpart replication (1-D) or the
+  normal-equation psum + factor re-shard (2-D).
 
 Regularization conventions (must match template behaviour — SURVEY.md §7
 "hard parts"): ``lambda_scaling='nratings'`` multiplies λ by the row's
@@ -46,8 +50,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from .blocked import BlockedRows, ShardedBlocked, build_blocked, shard_blocked
 from .pallas_kernels import batched_spd_solve
+from .rowblocks import BucketArrays, LayoutPlan, fill_buckets, plan_layout
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
 
 
@@ -60,17 +64,19 @@ class ALSParams:
     implicit_prefs: bool = False
     alpha: float = 1.0  # implicit-feedback confidence weight
     seed: int = 3
+    # Retained for engine.json compatibility (blockLen): the bucketed
+    # layout has no tiles, so this only scales the chunk_tiles budget
+    # below (chunk_tiles × block_len = gathered entries per device step).
     block_len: int = 32
     # "auto" → bfloat16 on a TPU mesh, float32 elsewhere. Explicit
     # "float32"/"bfloat16" override.
     compute_dtype: str = "auto"
-    # Tiles processed per scan step inside a half-step. 0 = all at once
-    # (small data). At ML-20M scale the per-tile gram intermediate
-    # [B, k, k] would be ~10GB; chunking scans tile slabs and scatter-adds
-    # into the per-row normal equations, capping live memory at
-    # [chunk, L, k] + [chunk, k, k] + the [rows, k, k] accumulator.
-    # -1 = auto: chunk only when the unchunked gram batch would exceed
-    # the per-device budget (see _resolve_params).
+    # Device-step granularity: each bucket's gather+gram slab is chunked
+    # to ≈ chunk_tiles × block_len gathered entries per step, bounding
+    # the live [chunk, C_b, k] intermediate. -1 OR 0 = auto (2^17
+    # entries, the measured v5e sweet spot — chunking never changes the
+    # math in this layout, so there is no "unchunked" mode to ask for);
+    # engine.json's chunkTiles maps here.
     chunk_tiles: int = -1
 
 
@@ -82,15 +88,37 @@ class ALSFactors:
     n_items: int
 
 
-def _grams_from_p(p, val, *, implicit, alpha, compute_dtype):
-    """Per-tile normal-equation contributions from gathered counterpart
-    rows p [B, L, k]: grams [B, k, k], rhs [B, k].
+_AUTO_ENTRIES_PER_STEP = 1 << 17
+
+# Checkpoint-fingerprint seed identifying the factor-storage layout
+# ("π"/slot order, ops/rowblocks.py). Bump when the layout changes so
+# snapshots from an older layout are rejected deterministically instead
+# of resuming permuted factors when shapes happen to coincide.
+_LAYOUT_TAG = 0x70_10_00_02
+
+
+def _resolve_params(mesh: Mesh, params: ALSParams) -> tuple[ALSParams, int]:
+    """Materialize 'auto' knobs; returns (params, entries_per_step)."""
+    cd = params.compute_dtype
+    if cd == "auto":
+        platform = mesh.devices.flat[0].platform
+        cd = "bfloat16" if platform == "tpu" else "float32"
+        params = dataclasses.replace(params, compute_dtype=cd)
+    if params.chunk_tiles > 0:
+        entries = max(params.chunk_tiles * max(params.block_len, 1), 8)
+    else:
+        entries = _AUTO_ENTRIES_PER_STEP
+    return params, entries
+
+
+def _grams_rows(p, val, *, implicit, alpha, compute_dtype):
+    """Per-row normal-equation contributions from gathered counterpart
+    rows p [R, C, k]: grams [R, k, k] f32, rhs [R, k] f32.
 
     Padding / non-owned slots must already be zero rows in p. Both sums
-    are linear in per-entry outer products (each entry l contributes
-    p_l·p_lᵀ resp. w_l·p_l), so zero rows contribute nothing — and
-    shard-partial p's (each model shard zeroing rows it doesn't own)
-    psum to exactly the full-gather result.
+    are linear in per-entry outer products, so zero rows contribute
+    nothing — and shard-partial p's (each model shard zeroing slots it
+    doesn't own) psum to exactly the full-gather result.
     """
     cd = compute_dtype
     if implicit:
@@ -98,25 +126,25 @@ def _grams_from_p(p, val, *, implicit, alpha, compute_dtype):
         # p=1 for observed. C-I = alpha·r on observed entries only.
         cw = (alpha * val)[..., None].astype(cd)  # confidence-1 weights
         w = 1.0 + alpha * val
-        grams = jnp.einsum("blk,blm->bkm", p * cw, p,
+        grams = jnp.einsum("rck,rcm->rkm", p * cw, p,
                            preferred_element_type=jnp.float32)
-        rhs = jnp.einsum("blk,bl->bk", p, w.astype(cd),
+        rhs = jnp.einsum("rck,rc->rk", p, w.astype(cd),
                          preferred_element_type=jnp.float32)
     else:
-        grams = jnp.einsum("blk,blm->bkm", p, p,
+        grams = jnp.einsum("rck,rcm->rkm", p, p,
                            preferred_element_type=jnp.float32)
-        rhs = jnp.einsum("blk,bl->bk", p, val.astype(cd),
+        rhs = jnp.einsum("rck,rc->rk", p, val.astype(cd),
                          preferred_element_type=jnp.float32)
     return grams, rhs
 
 
 def _gather_model_partial(y_local, col, compute_dtype):
-    """ALX sharded gather: rows this shard owns, zero rows elsewhere.
+    """ALX sharded gather: slots this shard owns, zero rows elsewhere.
 
-    ``y_local`` is this device's row shard of the counterpart factor
-    matrix ([rows_total / m, k], MODEL_AXIS-sharded, contiguous blocks in
-    axis order). Column indices outside this shard's window — including
-    the out-of-range padding index — gather exact zeros, so psumming any
+    ``y_local`` is this device's slot shard of the counterpart factor
+    matrix ([total_slots / m, k], MODEL_AXIS-sharded, contiguous blocks in
+    axis order). Slot indices outside this shard's window — including the
+    sentinel padding index — gather exact zeros, so psumming any
     per-entry-linear reduction of the result over MODEL_AXIS equals the
     full-gather reduction without ever materializing the full matrix on
     one device (PAPERS.md ALX, arxiv 2112.02194 §3).
@@ -130,265 +158,161 @@ def _gather_model_partial(y_local, col, compute_dtype):
     return p.astype(cd) * valid[..., None].astype(cd)
 
 
-def _half_step_local(y, col, val, local_row, counts, yty, *,
-                     rows_per_shard, reg, lambda_scaling, implicit, alpha,
-                     compute_dtype, chunk_tiles=0, row_span=0,
-                     platform=None, model_sharded=False):
-    """Solve one side's factors for one shard's rows (runs inside
+def _slab_normal_eq(gather, colb, valb, *, sentinel, entries_per_step,
+                    implicit, alpha, compute_dtype):
+    """grams/rhs for one bucket slab [R, C], chunked over rows so the
+    gathered [chunk, C, k] intermediate stays bounded."""
+    R, C = colb.shape
+    chunk_r = max(1, min(R, entries_per_step // max(C, 1)))
+    n_sub = -(-R // chunk_r)
+    kw = dict(implicit=implicit, alpha=alpha, compute_dtype=compute_dtype)
+    if n_sub <= 1:
+        return _grams_rows(gather(colb), valb, **kw)
+    padR = n_sub * chunk_r - R
+    cc = jnp.pad(colb, ((0, padR), (0, 0)), constant_values=sentinel)
+    vv = jnp.pad(valb, ((0, padR), (0, 0)))
+    cc = cc.reshape(n_sub, chunk_r, C)
+    vv = vv.reshape(n_sub, chunk_r, C)
+
+    def body(chunk):
+        ccol, cval = chunk
+        return _grams_rows(gather(ccol), cval, **kw)
+
+    grams, rhs = jax.lax.map(body, (cc, vv))
+    k = grams.shape[-1]
+    return (grams.reshape(n_sub * chunk_r, k, k)[:R],
+            rhs.reshape(n_sub * chunk_r, k)[:R])
+
+
+def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
+                     sentinel, implicit, alpha, compute_dtype,
+                     entries_per_step, platform, model_sharded):
+    """Solve one side's factors for one shard's slots (runs inside
     shard_map; all arrays are the local shard).
 
     Replicated mode (``model_sharded=False``): ``y`` is the full
     counterpart matrix plus a trailing all-zero sentinel row that padding
-    column indices resolve to.
+    slot indices resolve to.
 
-    Model-sharded mode: ``y`` is this device's MODEL_AXIS row shard; the
-    gather is partial (zeros for non-owned rows) and the per-row normal
+    Model-sharded mode: ``y`` is this device's MODEL_AXIS slot shard; the
+    gather is partial (zeros for non-owned slots) and the per-row normal
     equations are psummed over MODEL_AXIS before the solve — the ALX
     sharded layout, so factor HBM scales with 1/m.
     """
     k = y.shape[1]
-    n_tiles = col.shape[0]
+    n_buckets = len(plan.lengths)
+    has_virtual = plan.v_rows_per_shard > 0
 
     def gather(cols):
         if model_sharded:
             return _gather_model_partial(y, cols, compute_dtype)
-        return y[cols].astype(compute_dtype)
-    if chunk_tiles and n_tiles > chunk_tiles:
-        # Large data: scan tile slabs. Tiles are row-sorted, so each
-        # slab's rows fall in a contiguous window of at most ``row_span``
-        # rows (host-computed static bound). The tile→row reduction is a
-        # one-hot matmul on the MXU — orders of magnitude faster than an
-        # XLA scatter-add at this size — and lands in the accumulator via
-        # one contiguous dynamic-slice read-modify-write per slab.
-        n_chunks = (n_tiles + chunk_tiles - 1) // chunk_tiles
-        pad = n_chunks * chunk_tiles - n_tiles
-        if pad:
-            # Chunk padding: sentinel zero row of y (replicated mode) or
-            # an index no model shard owns (sharded mode) — zeros either way.
-            pad_idx = (np.int32(2**31 - 1) if model_sharded
-                       else y.shape[0] - 1)
-            col = jnp.pad(col, ((0, pad), (0, 0)), constant_values=pad_idx)
-            val = jnp.pad(val, ((0, pad), (0, 0)))
-            local_row = jnp.pad(local_row, (0, pad))
-        cshape = (n_chunks, chunk_tiles)
-        col_c = col.reshape(*cshape, -1)
-        val_c = val.reshape(*cshape, -1)
-        lrow_c = local_row.reshape(cshape)
-        span = int(row_span)
-        cd = compute_dtype
-        span_iota = jnp.arange(span, dtype=jnp.int32)
+        return jnp.take(y, cols, axis=0).astype(compute_dtype)
 
-        def scan_body(carry, chunk):
-            a_acc, b_acc = carry
-            ccol, cval, clrow = chunk
-            grams, rhs = _grams_from_p(
-                gather(ccol), cval,
-                implicit=implicit, alpha=alpha, compute_dtype=cd,
-            )
-            # Window base: first tile's row. Tail padding tiles carry
-            # lrow 0 and zero grams — they either miss the window
-            # (local < 0) or add zeros, both harmless.
-            rbase = clrow[0]
-            local = clrow - rbase                       # [C] in [0, span)
-            onehot = (local[None, :] == span_iota[:, None]).astype(cd)
-            # f32 path must match segment_sum bitwise-closely: force full
-            # f32 matmul precision (TPU default truncates f32 to bf16 on
-            # the MXU, which the non-chunked path never does).
-            #
-            # bf16 path — DELIBERATE precision divergence from unchunked:
-            # grams are f32 (accumulated from bf16 factors) but are cast
-            # back to bf16 here so the one-hot tile→row reduction runs as
-            # a bf16 MXU matmul; the unchunked path segment-sums the f32
-            # grams directly. The reduction dominates this path's FLOPs
-            # (span·chunk·k² vs the gram's chunk·L·k²), so an f32-HIGHEST
-            # reduction would cost ~6× the whole half-step. Per-entry
-            # rounding is one bf16 ulp (rel ≤ 2^-8) BEFORE an f32
-            # accumulation, and the λ ridge keeps the solve conditioned;
-            # tests/test_als_chunked_bf16.py bounds the chunked-vs-
-            # unchunked factor disagreement under this scheme.
-            prec = (None if cd == jnp.bfloat16
-                    else jax.lax.Precision.HIGHEST)
-            part_a = jnp.einsum(
-                "rc,ckm->rkm", onehot, grams.astype(cd),
-                preferred_element_type=jnp.float32, precision=prec,
-            )
-            part_b = jnp.einsum(
-                "rc,ck->rk", onehot, rhs.astype(cd),
-                preferred_element_type=jnp.float32, precision=prec,
-            )
-            a_win = jax.lax.dynamic_slice(
-                a_acc, (rbase, 0, 0), (span, k, k))
-            b_win = jax.lax.dynamic_slice(b_acc, (rbase, 0), (span, k))
-            a_acc = jax.lax.dynamic_update_slice(
-                a_acc, a_win + part_a, (rbase, 0, 0))
-            b_acc = jax.lax.dynamic_update_slice(
-                b_acc, b_win + part_b, (rbase, 0))
-            return (a_acc, b_acc), None
+    kw = dict(sentinel=sentinel, entries_per_step=entries_per_step,
+              implicit=implicit, alpha=alpha, compute_dtype=compute_dtype)
+    a_parts, b_parts = [], []
+    for bi in range(n_buckets):
+        colb, valb = bucket_args[2 * bi], bucket_args[2 * bi + 1]
+        grams, rhs = _slab_normal_eq(gather, colb, valb, **kw)
+        a_parts.append(grams)
+        b_parts.append(rhs)
+    a = jnp.concatenate(a_parts, axis=0) if len(a_parts) > 1 else a_parts[0]
+    b = jnp.concatenate(b_parts, axis=0) if len(b_parts) > 1 else b_parts[0]
 
-        # Accumulators padded by `span` rows so the last window fits.
-        a0 = jnp.zeros((rows_per_shard + span, k, k), jnp.float32)
-        b0 = jnp.zeros((rows_per_shard + span, k), jnp.float32)
-        if hasattr(jax.lax, "pcast"):
-            # Inside shard_map the scatter-add output is device-varying;
-            # mark the zero carries to match (jax ≥0.8 VMA tracking). In
-            # sharded mode partial grams also vary over MODEL_AXIS until
-            # the psum below.
-            vaxes = (DATA_AXIS,) + ((MODEL_AXIS,) if model_sharded else ())
-            a0 = jax.lax.pcast(a0, vaxes, to="varying")
-            b0 = jax.lax.pcast(b0, vaxes, to="varying")
-        (a, b), _ = jax.lax.scan(
-            scan_body, (a0, b0), (col_c, val_c, lrow_c)
-        )
-        a = a[:rows_per_shard]
-        b = b[:rows_per_shard]
-    else:
-        grams, rhs = _grams_from_p(
-            gather(col), val,
-            implicit=implicit, alpha=alpha, compute_dtype=compute_dtype,
-        )
-        a = jax.ops.segment_sum(grams, local_row, num_segments=rows_per_shard)
-        b = jax.ops.segment_sum(rhs, local_row, num_segments=rows_per_shard)
+    if has_virtual:
+        v_cols, v_vals, v_parent = bucket_args[2 * n_buckets:2 * n_buckets + 3]
+        vg, vr = _slab_normal_eq(gather, v_cols, v_vals, **kw)
+        # Merge overflow chunks into their parent rows (few thousand rows
+        # at ML-20M scale — the only scatter in the whole half-step).
+        a = a.at[v_parent].add(vg)
+        b = b.at[v_parent].add(vr)
+
     if model_sharded:
         # Reconstruct the full per-row normal equations from the shard
-        # partials — the one collective of the sharded gather. Placed on
-        # the [rows/d, k, k] accumulators (cheaper than psumming gathered
-        # [chunk, L, k] factors every scan step at ml20m shapes).
+        # partials — the one collective of the sharded gather.
         a = jax.lax.psum(a, MODEL_AXIS)
         b = jax.lax.psum(b, MODEL_AXIS)
     if implicit:
         a = a + yty[None, :, :]  # shared YᵀY term (all items)
 
-    if lambda_scaling == "nratings":
-        lam = reg * jnp.maximum(counts.astype(jnp.float32), 1.0)
-    else:
-        lam = jnp.full(counts.shape, reg, dtype=jnp.float32)
-    # Rows with no ratings keep a well-conditioned system (solution 0).
-    lam = lam + jnp.where(counts == 0, 1e-6, 0.0)
     a = a + lam[:, None, None] * jnp.eye(k, dtype=jnp.float32)
 
-    # Batched SPD solve: Pallas VMEM Gauss-Jordan on TPU (43x the XLA
-    # batched-Cholesky lowering at ml20m shape), XLA Cholesky elsewhere.
-    # platform is the MESH's device platform, threaded from the caller —
-    # jax.default_backend() is wrong here: the driver dry-runs a CPU mesh
-    # while a TPU is still the process default backend (and vice versa in
-    # tests), and pallas_call on CPU without interpret mode is an error.
+    # Batched SPD solve: Pallas VMEM Gauss-Jordan on TPU, XLA Cholesky
+    # elsewhere. platform is the MESH's device platform, threaded from the
+    # caller — jax.default_backend() is wrong here: the driver dry-runs a
+    # CPU mesh while a TPU is still the process default backend (and vice
+    # versa in tests), and pallas_call on CPU without interpret mode is an
+    # error.
     x = batched_spd_solve(a, b, vma=(DATA_AXIS,), platform=platform)
     return x.astype(jnp.float32)
 
 
-def _chunk_row_span(sb: ShardedBlocked, chunk_tiles: int) -> int:
-    """Static bound on how many distinct rows one scan slab can touch.
-
-    Mirrors the per-device chunking in ``_half_step_local``: each shard's
-    local tiles are padded to a multiple of chunk_tiles and sliced; tiles
-    are row-sorted, so a slab's rows live in [first_row, max_row]. Returns
-    the max such window, rounded up to a lane-friendly multiple of 128.
-    """
-    local_tiles = sb.col.shape[0] // sb.n_shards
-    if not chunk_tiles or local_tiles <= chunk_tiles:
-        return 0
-    lrow = sb.local_row.reshape(sb.n_shards, local_tiles)
-    n_chunks = (local_tiles + chunk_tiles - 1) // chunk_tiles
-    pad = n_chunks * chunk_tiles - local_tiles
-    if pad:
-        lrow = np.pad(lrow, ((0, 0), (0, pad)))
-    chunks = lrow.reshape(sb.n_shards, n_chunks, chunk_tiles)
-    span = int(
-        np.maximum(chunks.max(axis=2) - chunks[:, :, 0], 0).max()
-    ) + 1
-    return min(-(-span // 128) * 128, sb.rows_per_shard + 128)
+def _host_lam(plan: LayoutPlan, params: ALSParams) -> np.ndarray:
+    """Per-slot ridge weights (static — computed once on the host)."""
+    counts = plan.counts_slot.astype(np.float32)
+    if params.lambda_scaling == "nratings":
+        lam = params.reg * np.maximum(counts, 1.0)
+    else:
+        lam = np.full(counts.shape, params.reg, dtype=np.float32)
+    # Slots with no ratings keep a well-conditioned system (solution 0).
+    return (lam + np.where(counts == 0, 1e-6, 0.0)).astype(np.float32)
 
 
-# Per-device budget for the unchunked [tiles, k, k] f32 gram batch plus
-# the gathered [tiles, L, k] factors; above it the scan-chunked path kicks
-# in. 1 GiB leaves headroom for factors + tiles + accumulators on a 16 GB
-# v5e chip.
-_AUTO_CHUNK_BUDGET_BYTES = 1 << 30
-# Measured sweet spot at ml20m/rank32 on v5e (bench.py sweeps): big enough
-# to keep the one-hot MXU reduction and DMA pipeline fed, small enough
-# that the [chunk, L, k] + [chunk, k, k] slabs stay cheap.
-_AUTO_CHUNK_TILES = 2048
+def _side_flat(arrs: BucketArrays, plan: LayoutPlan, lam: np.ndarray):
+    """Flatten one side's device args: per-bucket (col, val) pairs,
+    optional (v_cols, v_vals, v_parent), then lam."""
+    flat = []
+    for c, v in zip(arrs.cols, arrs.vals):
+        flat += [c, v]
+    if plan.v_rows_per_shard > 0:
+        flat += [arrs.v_cols, arrs.v_vals,
+                 np.asarray(plan.v_parent, np.int32)]
+    flat.append(lam)
+    return flat
 
 
-def _resolve_params(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
-                    items: ShardedBlocked) -> ALSParams:
-    """Materialize 'auto' knobs against the actual mesh + data layout.
-
-    Templates ship compute_dtype="auto" / chunk_tiles=-1 so a plain
-    `pio train` picks the TPU-optimal configuration the benchmarks use —
-    bf16 gathers on TPU meshes and scan-chunking whenever the unchunked
-    per-tile intermediates would blow the HBM budget (ml20m would
-    otherwise build a ~10 GB gram batch and OOM).
-    """
-    cd = params.compute_dtype
-    if cd == "auto":
-        platform = mesh.devices.flat[0].platform
-        cd = "bfloat16" if platform == "tpu" else "float32"
-    chunk = params.chunk_tiles
-    if chunk < 0:
-        k = params.rank
-        L = users.col.shape[1]
-        cd_bytes = 2 if cd == "bfloat16" else 4
-        per_tile = L * k * cd_bytes + k * k * 4
-        tiles_local = max(users.col.shape[0] // users.n_shards,
-                          items.col.shape[0] // items.n_shards)
-        if tiles_local * per_tile <= _AUTO_CHUNK_BUDGET_BYTES:
-            chunk = 0
-        else:
-            # Cap by the budget too: at extreme rank/block_len a 2048-tile
-            # slab can itself exceed the budget, and over-budget data
-            # guarantees budget//per_tile < tiles_local, so the chunked
-            # path (n_tiles > chunk_tiles) always engages.
-            chunk = max(1, min(_AUTO_CHUNK_TILES,
-                               _AUTO_CHUNK_BUDGET_BYTES // per_tile))
-    if cd != params.compute_dtype or chunk != params.chunk_tiles:
-        params = dataclasses.replace(
-            params, compute_dtype=cd, chunk_tiles=chunk)
-    return params
-
-
-def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
-                   items: ShardedBlocked, span_override=None):
-    """Build the jitted full training loop for fixed layouts.
-
-    ``span_override`` = (u_span, i_span): sharded multi-host ingest
-    passes globally-maxed scan-window bounds here, because each process
-    only holds its own tiles and the spans are baked into the (identical
-    everywhere) executable. All other layout numbers are per-shard and
-    already process-invariant.
-    """
-    params = _resolve_params(mesh, params, users, items)
+def _make_train_fn(mesh: Mesh, params: ALSParams, plan_u: LayoutPlan,
+                   plan_i: LayoutPlan):
+    """Build the jitted full training loop for fixed layouts. Returns
+    (fitted_fn, in_shardings); call as fn(n_iters, x0, y0, *u_flat,
+    *i_flat) with the _side_flat arg order."""
+    params, entries_per_step = _resolve_params(mesh, params)
     cd = jnp.bfloat16 if params.compute_dtype == "bfloat16" else jnp.float32
     implicit = params.implicit_prefs
     # Kernel selection must follow the MESH's platform, not the process
-    # default backend: the driver validates multi-chip sharding on a
-    # virtual CPU mesh while the sandbox TPU stays the default backend.
+    # default backend (see _half_step_local docstring).
     mesh_platform = mesh.devices.flat[0].platform
     # 2-D (d, m) mesh → ALX factor sharding: the counterpart factor
     # matrix is row-sharded over MODEL_AXIS (HBM per device ∝ 1/m) and
     # the per-row normal equations are psummed from shard partials.
     model_sharded = MODEL_AXIS in mesh.axis_names
 
-    row_spec = P(DATA_AXIS)          # tiles / rows split over data axis
-    rep = P()                        # replicated
+    row2 = P(DATA_AXIS, None)
+    row1 = P(DATA_AXIS)
+    rep = P()
     y_spec = P(MODEL_AXIS, None) if model_sharded else rep
 
-    if span_override is not None:
-        u_span, i_span = span_override
-    else:
-        u_span = _chunk_row_span(users, params.chunk_tiles)
-        i_span = _chunk_row_span(items, params.chunk_tiles)
+    def side_specs(plan: LayoutPlan):
+        specs = []
+        for _ in plan.lengths:
+            specs += [row2, row2]
+        if plan.v_rows_per_shard > 0:
+            specs += [row2, row2, row1]
+        specs.append(row1)  # lam
+        return specs
 
-    def one_side(y, blk_cols, blk_vals, blk_lrow, counts,
-                 rows_per_shard, row_span):
+    u_specs, i_specs = side_specs(plan_u), side_specs(plan_i)
+    n_u_args = len(u_specs)
+
+    def one_side(y, flat, plan, specs, sentinel):
         if model_sharded:
-            # No sentinel: the sharded gather masks by ownership window,
-            # and padded row counts already divide the model axis.
+            # No sentinel row: the sharded gather masks by ownership
+            # window (the sentinel index falls outside every window).
             y_cd = jax.lax.with_sharding_constraint(
                 y.astype(cd), NamedSharding(mesh, y_spec))
         else:
-            # Sentinel zero row appended so padding column indices gather
-            # 0s (mask-free hot loop); cast once here so the scan gathers
+            # Sentinel zero row appended so padding slot indices gather
+            # 0s (mask-free hot loop); cast once so the hot loop gathers
             # half-width bf16 rows instead of f32.
             y_cd = jnp.concatenate(
                 [y, jnp.zeros((1, y.shape[1]), y.dtype)], axis=0
@@ -399,76 +323,91 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, users: ShardedBlocked,
             if implicit
             else jnp.zeros((params.rank, params.rank), jnp.float32)
         )
+        lam = flat[-1]
+        bucket_args = flat[:-1]
         fn = shard_map(
             functools.partial(
                 _half_step_local,
-                rows_per_shard=rows_per_shard,
-                reg=params.reg,
-                lambda_scaling=params.lambda_scaling,
+                plan=plan,
+                sentinel=sentinel,
                 implicit=implicit,
                 alpha=params.alpha,
                 compute_dtype=cd,
-                chunk_tiles=params.chunk_tiles,
-                row_span=row_span,
+                entries_per_step=entries_per_step,
                 platform=mesh_platform,
                 model_sharded=model_sharded,
             ),
             mesh=mesh,
-            in_specs=(y_spec, row_spec, row_spec, row_spec, row_spec, rep),
-            out_specs=row_spec,
+            in_specs=(y_spec, row1, rep) + tuple(specs[:-1]),
+            out_specs=row1,
         )
-        x = fn(y_cd, blk_cols, blk_vals, blk_lrow, counts, yty)
+        x = fn(y_cd, lam, yty, *bucket_args)
         if model_sharded:
-            # Solved rows leave the shard_map split over 'd'; re-shard to
+            # Solved slots leave the shard_map split over 'd'; re-shard to
             # the MODEL_AXIS storage layout (XLA all-to-all over ICI) so
             # the next half-step consumes it as a sharded counterpart.
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, y_spec))
         return x
 
-    u_rps, i_rps = users.rows_per_shard, items.rows_per_shard
+    sent_u, sent_i = plan_u.total_slots, plan_i.total_slots
 
-    # The big tile arrays enter as jit args (not baked-in constants), and
+    # The big slab arrays enter as jit args (not baked-in constants), and
     # n_iters is traced so one compilation serves full runs, checkpoint
-    # chunks, and resume remainders alike (fori_loop with a traced bound
-    # lowers to while_loop — fine on TPU, no unrolling wanted here).
-    def loop(n_iters, x0, y0, u_col, u_val, u_lrow, u_counts,
-             i_col, i_val, i_lrow, i_counts):
+    # chunks, and resume remainders alike.
+    def loop(n_iters, x0, y0, *flat):
+        u_flat = flat[:n_u_args]
+        i_flat = flat[n_u_args:]
+
         def body(_, carry):
             x, y = carry
-            x = one_side(y, u_col, u_val, u_lrow, u_counts, u_rps, u_span)
-            y = one_side(x, i_col, i_val, i_lrow, i_counts, i_rps, i_span)
+            x = one_side(y, u_flat, plan_u, u_specs, sent_i)
+            y = one_side(x, i_flat, plan_i, i_specs, sent_u)
             return (x, y)
 
         return jax.lax.fori_loop(0, n_iters, body, (x0, y0))
 
-    shardings = {
-        "row2": NamedSharding(mesh, P(DATA_AXIS, None)),
-        "row1": NamedSharding(mesh, P(DATA_AXIS)),
-        "rep": NamedSharding(mesh, P()),
-        "factors": NamedSharding(mesh, y_spec),
-    }
+    factors_s = NamedSharding(mesh, y_spec)
     in_shardings = (
-        shardings["rep"],
-        shardings["factors"], shardings["factors"],
-        shardings["row2"], shardings["row2"],
-        shardings["row1"], shardings["row1"],
-        shardings["row2"], shardings["row2"],
-        shardings["row1"], shardings["row1"],
-    )
+        NamedSharding(mesh, rep), factors_s, factors_s,
+    ) + tuple(NamedSharding(mesh, s) for s in u_specs + i_specs)
     # Outputs stay MODEL_AXIS-sharded on a 2-D mesh — replicating here
     # would all-gather both full factor matrices onto every device and
     # defeat the 1/m HBM scaling (host device_get assembles from shards).
     # Multi-controller runs need replicated outputs so every process can
     # device_get its result.
-    out_s = (shardings["factors"] if jax.process_count() == 1
-             else shardings["rep"])
+    out_s = (factors_s if jax.process_count() == 1
+             else NamedSharding(mesh, rep))
     fitted = jax.jit(
         loop,
         in_shardings=in_shardings,
         out_shardings=(out_s, out_s),
     )
     return fitted, in_shardings
+
+
+def _mesh_dims(mesh: Mesh) -> tuple[int, int]:
+    if DATA_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh must have a '{DATA_AXIS}' axis, "
+                         f"got {mesh.axis_names}")
+    return mesh.shape[DATA_AXIS], mesh.shape.get(MODEL_AXIS, 1)
+
+
+def _fresh_init(params: ALSParams, plan_u: LayoutPlan, plan_i: LayoutPlan,
+                n_users: int, n_items: int):
+    """MLlib-style init (scaled standard normal), drawn in GLOBAL row
+    order and placed into layout slots — identical factors regardless of
+    mesh shape or layout, and filler slots start at exactly 0 (so the
+    implicit-mode YᵀY term never sees garbage rows)."""
+    k = params.rank
+    rng = np.random.default_rng(params.seed)
+    x0 = np.zeros((plan_u.total_slots, k), np.float32)
+    y0 = np.zeros((plan_i.total_slots, k), np.float32)
+    x0[plan_u.slot_of_row] = (
+        rng.standard_normal((n_users, k)) / np.sqrt(k)).astype(np.float32)
+    y0[plan_i.slot_of_row] = (
+        rng.standard_normal((n_items, k)) / np.sqrt(k)).astype(np.float32)
+    return x0, y0
 
 
 def train_als(
@@ -501,47 +440,22 @@ def train_als(
     ALSAlgorithm → here. Single-process, non-checkpoint-chunked runs only.
     """
     mesh = mesh or default_mesh()
-    if DATA_AXIS not in mesh.axis_names:
-        raise ValueError(f"mesh must have a '{DATA_AXIS}' axis, "
-                         f"got {mesh.axis_names}")
-    # Tiles (and the rows being solved) split over the data axis; on a
-    # 2-D (d, m) mesh the factor matrices are additionally row-sharded
-    # over the model axis (ALX layout), so padded row counts must divide
-    # both axes.
-    d_size = mesh.shape[DATA_AXIS]
-    m_size = mesh.shape.get(MODEL_AXIS, 1)
+    d_size, m_size = _mesh_dims(mesh)
 
-    def _rows_per_shard(n_rows: int) -> int:
-        rps = -(-n_rows // d_size)
-        return -(-rps // m_size) * m_size
-
-    rps_users = _rows_per_shard(n_users)
-    rps_items = _rows_per_shard(n_items)
-    # Padding column indices point one past the counterpart's padded rows:
-    # in replicated mode one_side appends a zero sentinel row there (mask-
-    # free hot loop); in sharded mode the index falls outside every
-    # shard's ownership window and gathers zeros via the validity mask.
-    pad_items = d_size * rps_items
-    pad_users = d_size * rps_users
-    by_user = shard_blocked(
-        build_blocked(user_idx, item_idx, rating, n_users, params.block_len,
-                      pad_col=pad_items), d_size, rows_per_shard=rps_users
-    )
-    by_item = shard_blocked(
-        build_blocked(item_idx, user_idx, rating, n_items, params.block_len,
-                      pad_col=pad_users), d_size, rows_per_shard=rps_items
-    )
+    counts_u = np.bincount(np.asarray(user_idx, np.int64), minlength=n_users)
+    counts_i = np.bincount(np.asarray(item_idx, np.int64), minlength=n_items)
+    plan_u = plan_layout(counts_u, d_size, m_div=m_size)
+    plan_i = plan_layout(counts_i, d_size, m_div=m_size)
+    arrs_u = fill_buckets(plan_u, user_idx, item_idx, rating,
+                          col_slot_map=plan_i.slot_of_row,
+                          sentinel=plan_i.total_slots)
+    arrs_i = fill_buckets(plan_i, item_idx, user_idx, rating,
+                          col_slot_map=plan_u.slot_of_row,
+                          sentinel=plan_u.total_slots)
 
     k = params.rank
-    x_shape = (by_user.padded_rows, k)
-    y_shape = (by_item.padded_rows, k)
-
-    def _fresh_init():
-        # MLlib-style init: scaled standard normal.
-        rng = np.random.default_rng(params.seed)
-        x = (rng.standard_normal(x_shape) / np.sqrt(k)).astype(np.float32)
-        y = (rng.standard_normal(y_shape) / np.sqrt(k)).astype(np.float32)
-        return x, y
+    x_shape = (plan_u.total_slots, k)
+    y_shape = (plan_i.total_slots, k)
 
     # Fingerprint of the exact COO triple: resume is only sound against the
     # identical rating data (shape equality alone misses in-place rating
@@ -551,10 +465,18 @@ def train_als(
     if checkpoint_hook is not None:
         import zlib
 
+        # Seeded with _LAYOUT_TAG (layout generation) and the slot
+        # permutations (mesh-dependent): factors are stored in slot
+        # order, so a snapshot is only resumable by a run with the
+        # IDENTICAL plan — same data AND same (d, m) mesh shape.
+        layout_fp = zlib.crc32(
+            plan_i.slot_of_row.tobytes(),
+            zlib.crc32(plan_u.slot_of_row.tobytes(), _LAYOUT_TAG))
         fingerprint = zlib.crc32(
-            rating.astype(np.float32, copy=False).tobytes(),
+            np.asarray(rating, np.float32).tobytes(),
             zlib.crc32(np.asarray(item_idx).tobytes(),
-                       zlib.crc32(np.asarray(user_idx).tobytes())))
+                       zlib.crc32(np.asarray(user_idx).tobytes(),
+                                  layout_fp)))
 
     start_iter = 0
     x0 = y0 = None
@@ -591,12 +513,10 @@ def train_als(
             )
 
     if x0 is None:
-        x0, y0 = _fresh_init()
-    fn, in_shardings = _make_train_fn(mesh, params, by_user, by_item)
-    blocks = (
-        by_user.col, by_user.val, by_user.local_row, by_user.counts,
-        by_item.col, by_item.val, by_item.local_row, by_item.counts,
-    )
+        x0, y0 = _fresh_init(params, plan_u, plan_i, n_users, n_items)
+    fn, in_shardings = _make_train_fn(mesh, params, plan_u, plan_i)
+    flat = tuple(_side_flat(arrs_u, plan_u, _host_lam(plan_u, params))
+                 + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params)))
     if jax.process_count() > 1:
         # Multi-controller: every process holds the SAME full numpy
         # arrays (the event store is shared), so build global jax.Arrays
@@ -608,9 +528,9 @@ def train_als(
 
         x0 = _globalize(np.asarray(x0), in_shardings[1])
         y0 = _globalize(np.asarray(y0), in_shardings[2])
-        blocks = tuple(
+        flat = tuple(
             _globalize(np.asarray(b), s)
-            for b, s in zip(blocks, in_shardings[3:])
+            for b, s in zip(flat, in_shardings[3:])
         )
     chunk = checkpoint_hook.every_n if checkpoint_hook is not None and checkpoint_hook.enabled else 0
     if (timings is not None and jax.process_count() == 1
@@ -620,26 +540,26 @@ def train_als(
         t0 = _time.perf_counter()
         dx0 = jax.device_put(np.asarray(x0), in_shardings[1])
         dy0 = jax.device_put(np.asarray(y0), in_shardings[2])
-        dev_blocks = tuple(
+        dev_flat = tuple(
             jax.device_put(np.asarray(b), s)
-            for b, s in zip(blocks, in_shardings[3:])
+            for b, s in zip(flat, in_shardings[3:])
         )
-        jax.block_until_ready((dx0, dy0, dev_blocks))
+        jax.block_until_ready((dx0, dy0, dev_flat))
         timings["upload_seconds"] = _time.perf_counter() - t0
 
         n = np.int32(params.num_iterations - start_iter)
         t0 = _time.perf_counter()
-        compiled = fn.lower(n, dx0, dy0, *dev_blocks).compile()
+        compiled = fn.lower(n, dx0, dy0, *dev_flat).compile()
         timings["compile_seconds"] = _time.perf_counter() - t0
 
         # Warm-up dispatch (n_iters is traced: same executable, zero work),
         # then the timed run with a scalar readback as the completion
         # barrier — through the remote-PJRT tunnel block_until_ready can
         # return before the device finishes, a device_get cannot.
-        warm = compiled(np.int32(0), dx0, dy0, *dev_blocks)
+        warm = compiled(np.int32(0), dx0, dy0, *dev_flat)
         _ = jax.device_get(warm[0][:1, :1])
         t0 = _time.perf_counter()
-        x, y = compiled(n, dx0, dy0, *dev_blocks)
+        x, y = compiled(n, dx0, dy0, *dev_flat)
         _ = jax.device_get(x[:1, :1])
         timings["device_train_seconds"] = _time.perf_counter() - t0
     elif chunk and params.num_iterations - start_iter > chunk:
@@ -647,7 +567,7 @@ def train_als(
         it = start_iter
         while it < params.num_iterations:
             n = min(chunk, params.num_iterations - it)
-            x, y = fn(n, x, y, *blocks)
+            x, y = fn(n, x, y, *flat)
             it += n
             if it < params.num_iterations:
                 checkpoint_hook.save(
@@ -655,11 +575,11 @@ def train_als(
                          "fingerprint": np.int64(fingerprint)}
                 )
     else:
-        x, y = fn(params.num_iterations - start_iter, x0, y0, *blocks)
+        x, y = fn(params.num_iterations - start_iter, x0, y0, *flat)
     x, y = jax.device_get((x, y))
     return ALSFactors(
-        user_factors=np.asarray(x)[:n_users],
-        item_factors=np.asarray(y)[:n_items],
+        user_factors=np.asarray(x)[plan_u.slot_of_row],
+        item_factors=np.asarray(y)[plan_i.slot_of_row],
         n_users=n_users,
         n_items=n_items,
     )
@@ -673,11 +593,12 @@ def process_row_ranges(n_rows: int, mesh: Optional[Mesh] = None
     range-reads only the events whose solved-side row falls in its range
     (one range per side), instead of every host scanning the full store.
     Deterministic from (n_rows, mesh) alone — no coordination needed.
+    Ranges are in LOGICAL row ids (the layout's internal slot padding
+    never changes ownership); row1 may exceed n_rows on the last process.
     """
     mesh = mesh or default_mesh()
-    d_size = mesh.shape[DATA_AXIS]
-    m_size = mesh.shape.get(MODEL_AXIS, 1)
-    rps = -(-(-(-n_rows // d_size)) // m_size) * m_size
+    d_size, _ = _mesh_dims(mesh)
+    rpl = -(-n_rows // d_size)
     n_proc = jax.process_count()
     if d_size % n_proc:
         # Same contract train_als_process_sharded enforces; failing here
@@ -687,22 +608,7 @@ def process_row_ranges(n_rows: int, mesh: Optional[Mesh] = None
             f"{n_proc} processes")
     shards_per_proc = d_size // n_proc
     p = jax.process_index()
-    return p * shards_per_proc * rps, (p + 1) * shards_per_proc * rps
-
-
-def _local_blocked(rows, cols, vals, row0, n_local_rows, rps, n_local_shards,
-                   block_len, pad_col):
-    """Blocked tiles for this process's row range only. ``rows`` are
-    global indices, all within [row0, row0 + n_local_rows)."""
-    rows = np.asarray(rows, dtype=np.int64)
-    if rows.size and (rows.min() < row0 or rows.max() >= row0 + n_local_rows):
-        raise ValueError(
-            f"sharded ingest: got rows outside this process's range "
-            f"[{row0}, {row0 + n_local_rows}) — the caller must range-read "
-            "only owned rows (process_row_ranges)")
-    blocked = build_blocked(rows - row0, cols, vals, n_local_rows,
-                            block_len, pad_col=pad_col)
-    return shard_blocked(blocked, n_local_shards, rows_per_shard=rps)
+    return p * shards_per_proc * rpl, (p + 1) * shards_per_proc * rpl
 
 
 def train_als_process_sharded(
@@ -712,127 +618,179 @@ def train_als_process_sharded(
     n_items: int,
     params: ALSParams,
     mesh: Optional[Mesh] = None,
+    checkpoint_hook=None,
+    resume: bool = False,
 ) -> ALSFactors:
     """Multi-controller ALS where each process ingests ONLY its shard.
 
     ``user_slice`` = (user_idx, item_idx, rating) holding exactly the
     events whose USER row this process owns (``process_row_ranges(
-    n_users)``); ``item_slice`` the same for ITEM rows. In a deployment
+    n_users)``); ``item_slice`` = the same tuple order, holding the
+    events whose ITEM row this process owns. In a deployment
     these are two range-reads against the shared event store — no host
-    ever materializes the full dataset, removing train_als's
-    every-process-holds-everything constraint (the Spark-side analog is
+    ever materializes the full dataset (the Spark-side analog is
     partitioned RDD ingest, SURVEY.md §2.10).
 
-    The math and layout are IDENTICAL to ``train_als`` on the same
-    global data: tiles are built per-owned-shard in local coordinates,
-    padded to the global per-shard tile count (one tiny allgather of
-    tile counts — the only control-plane coordination), and assembled
-    with ``jax.make_array_from_process_local_data``. Factors match the
-    single-process run bit-for-bit.
+    The layout is a pure function of the per-row nnz counts, so ONE
+    allgather of each side's local counts gives every process the
+    identical global plan; each then fills only its own shards and the
+    arrays are assembled with ``jax.make_array_from_process_local_data``.
+    Factors match the single-process run bit-for-bit. Works on 1-D data
+    meshes AND 2-D (d, m) ALX meshes — ownership windows are windows of
+    layout slots, independent of which process filled them.
 
-    1-D (data-axis) meshes; checkpoint hooks are not supported here yet.
+    ``checkpoint_hook``/``resume``: same contract as train_als; snapshots
+    are written by process 0 (factors are replicated across processes in
+    multi-controller runs) and restored by every process.
     """
     mesh = mesh or default_mesh()
-    if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
-        raise ValueError(
-            "sharded ingest currently supports 1-D data meshes only")
-    d_size = mesh.shape[DATA_AXIS]
+    d_size, m_size = _mesh_dims(mesh)
     n_proc = jax.process_count()
     if d_size % n_proc:
         raise ValueError(f"{d_size} devices do not divide {n_proc} processes")
     n_local = d_size // n_proc
+    p = jax.process_index()
+    shard0 = p * n_local
 
-    rps_u = -(-n_users // d_size)
-    rps_i = -(-n_items // d_size)
-    pad_users, pad_items = d_size * rps_u, d_size * rps_i
-
-    u_row0, _ = process_row_ranges(n_users, mesh)
-    i_row0, _ = process_row_ranges(n_items, mesh)
-    uu, ui, ur = user_slice
-    iu, ii, ir = item_slice
-    by_user = _local_blocked(uu, ui, ur, u_row0, n_local * rps_u, rps_u,
-                             n_local, params.block_len, pad_col=pad_items)
-    by_item = _local_blocked(ii, iu, ir, i_row0, n_local * rps_i, rps_i,
-                             n_local, params.block_len, pad_col=pad_users)
-
-    # Global per-shard tile count = max over every process's shards; the
-    # one piece of global knowledge the layout needs. 2-int allgather
-    # over the DCN control plane.
     from jax.experimental import multihost_utils
 
-    local_bs = np.array([by_user.col.shape[0] // n_local,
-                         by_item.col.shape[0] // n_local], np.int64)
-    all_bs = np.asarray(
-        multihost_utils.process_allgather(local_bs)).reshape(-1, 2)
-    bs_u, bs_i = int(all_bs[:, 0].max()), int(all_bs[:, 1].max())
+    def _global_counts(rows, n_rows):
+        """Allgather per-process local counts into the full count vector
+        (each process counts only rows it owns; ranges are disjoint)."""
+        rpl = -(-n_rows // d_size)
+        seg = n_local * rpl
+        local = np.zeros(seg, np.int64)
+        rows = np.asarray(rows, np.int64)
+        row0 = p * seg
+        if rows.size:
+            if rows.min() < row0 or rows.max() >= row0 + seg:
+                raise ValueError(
+                    "sharded ingest: got rows outside this process's range "
+                    f"[{row0}, {row0 + seg}) — the caller must range-read "
+                    "only owned rows (process_row_ranges); got rows in "
+                    f"[{rows.min()}, {rows.max()}] (n_rows={n_rows}, "
+                    f"p={p}, n_local={n_local}, d={d_size})")
+            local = np.bincount(rows - row0, minlength=seg)[:seg]
+        gathered = np.asarray(
+            multihost_utils.process_allgather(local)).reshape(-1)
+        return gathered[:n_rows]
 
-    def _pad_tiles(sb: ShardedBlocked, bs: int, pad_col: int):
-        cur = sb.col.shape[0] // sb.n_shards
-        if cur == bs:
-            return sb
-        L = sb.col.shape[1]
+    # Both slices use (user_idx, item_idx, rating) tuple order; the
+    # solved-side ROW array is user_slice[0] resp. item_slice[1].
+    counts_u = _global_counts(user_slice[0], n_users)
+    counts_i = _global_counts(item_slice[1], n_items)
+    plan_u = plan_layout(counts_u, d_size, m_div=m_size)
+    plan_i = plan_layout(counts_i, d_size, m_div=m_size)
+    arrs_u = fill_buckets(plan_u, user_slice[0], user_slice[1], user_slice[2],
+                          col_slot_map=plan_i.slot_of_row,
+                          sentinel=plan_i.total_slots,
+                          shard0=shard0, n_local_shards=n_local)
+    arrs_i = fill_buckets(plan_i, item_slice[1], item_slice[0], item_slice[2],
+                          col_slot_map=plan_u.slot_of_row,
+                          sentinel=plan_u.total_slots,
+                          shard0=shard0, n_local_shards=n_local)
 
-        def pad3(a, fill):
-            a = a.reshape(sb.n_shards, cur, *a.shape[1:])
-            width = [(0, 0), (0, bs - cur)] + [(0, 0)] * (a.ndim - 2)
-            return np.pad(a, width, constant_values=fill).reshape(
-                sb.n_shards * bs, *a.shape[2:])
+    fn, in_shardings = _make_train_fn(mesh, params, plan_u, plan_i)
+    flat_local = (_side_flat(arrs_u, plan_u, _host_lam(plan_u, params))
+                  + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params)))
 
-        return dataclasses.replace(
-            sb, col=pad3(sb.col, pad_col), val=pad3(sb.val, 0.0),
-            mask=pad3(sb.mask, 0.0), local_row=pad3(sb.local_row, 0),
-        )
-
-    by_user = _pad_tiles(by_user, bs_u, pad_items)
-    by_item = _pad_tiles(by_item, bs_i, pad_users)
-
-    # Per-shard layout numbers (rows/tiles per shard, L) are identical
-    # on every process after the padding above, so the local
-    # ShardedBlocked describes the global layout — except the chunked-
-    # scan row-span bounds, which are maxima over ALL shards: allgather
-    # them so each process bakes the same executable.
-    params = _resolve_params(mesh, params, by_user, by_item)
-    spans = np.array([
-        _chunk_row_span(by_user, params.chunk_tiles),
-        _chunk_row_span(by_item, params.chunk_tiles),
-    ], np.int64)
-    all_spans = np.asarray(
-        multihost_utils.process_allgather(spans)).reshape(-1, 2)
-    span_override = (int(all_spans[:, 0].max()), int(all_spans[:, 1].max()))
-    fn, in_shardings = _make_train_fn(mesh, params, by_user, by_item,
-                                      span_override=span_override)
-
-    # Same init as train_als._fresh_init — bit-for-bit parity. Factor
-    # init is O(rows·k) host memory (tiny next to the event data, which
-    # IS process-local here).
-    k = params.rank
-    rng = np.random.default_rng(params.seed)
-    x0 = (rng.standard_normal((pad_users, k)) / np.sqrt(k)).astype(np.float32)
-    y0 = (rng.standard_normal((pad_items, k)) / np.sqrt(k)).astype(np.float32)
-
-    def _from_local(local, sharding, global_rows):
+    def _to_global(local, sharding):
+        # Every per-side device arg is row-sharded over the data axis;
+        # this process supplies its own shards' slice.
+        local = np.asarray(local)
+        global_rows = local.shape[0] * n_proc
         return jax.make_array_from_process_local_data(
             sharding, local, (global_rows,) + local.shape[1:])
 
-    u_blocks = (by_user.col, by_user.val, by_user.local_row,
-                by_user.counts)
-    i_blocks = (by_item.col, by_item.val, by_item.local_row,
-                by_item.counts)
-    blocks = tuple(
-        _from_local(b, s, d_size * (b.shape[0] // n_local))
-        for b, s in zip(u_blocks + i_blocks, in_shardings[3:])
+    # lam and v_parent are global per-slot vectors in _side_flat; slice
+    # them to this process's shards before assembly.
+    def _slice_side(flat, plan):
+        out = list(flat)
+        rps = plan.rows_per_shard
+        out[-1] = out[-1][shard0 * rps:(shard0 + n_local) * rps]
+        if plan.v_rows_per_shard > 0:
+            rv = plan.v_rows_per_shard
+            out[-2] = out[-2][shard0 * rv:(shard0 + n_local) * rv]
+        return out
+
+    n_u_args = (2 * len(plan_u.lengths)
+                + (3 if plan_u.v_rows_per_shard else 0) + 1)
+    u_flat = _slice_side(flat_local[:n_u_args], plan_u)
+    i_flat = _slice_side(flat_local[n_u_args:], plan_i)
+    flat = tuple(
+        _to_global(b, s)
+        for b, s in zip(u_flat + i_flat, in_shardings[3:])
     )
-    # Factor carries are replicated on a 1-D mesh: every process supplies
-    # the (identical, same-seed) full array.
+
+    x0, y0 = _fresh_init(params, plan_u, plan_i, n_users, n_items)
+
+    fingerprint = None
+    if checkpoint_hook is not None:
+        import zlib
+
+        # Process-invariant fingerprint: every process sees only its own
+        # slice, so hash the local slice and allgather the per-process
+        # digests — combined in process order, the result is identical
+        # everywhere (and still covers the full global triple).
+        layout_fp = zlib.crc32(
+            plan_i.slot_of_row.tobytes(),
+            zlib.crc32(plan_u.slot_of_row.tobytes(), _LAYOUT_TAG))
+        local_fp = zlib.crc32(
+            np.asarray(user_slice[2], np.float32).tobytes(),
+            zlib.crc32(np.asarray(user_slice[1], np.int64).tobytes(),
+                       zlib.crc32(np.asarray(user_slice[0], np.int64)
+                                  .tobytes(), layout_fp)))
+        all_fp = np.asarray(multihost_utils.process_allgather(
+            np.int64(local_fp))).reshape(-1)
+        fingerprint = zlib.crc32(
+            all_fp.tobytes(),
+            zlib.crc32(np.asarray(counts_u).tobytes(),
+                       zlib.crc32(np.asarray(counts_i).tobytes(),
+                                  layout_fp)))
+
+    start_iter = 0
+    if checkpoint_hook is not None and resume:
+        from ..workflow.checkpoint import CheckpointIncompatibleError
+
+        step = checkpoint_hook.latest_step()
+        if step is not None and step < params.num_iterations:
+            start_iter, tree = checkpoint_hook.restore(step)
+            rx = np.asarray(tree["user_factors"])
+            ry = np.asarray(tree["item_factors"])
+            if rx.shape != x0.shape or ry.shape != y0.shape or \
+                    int(np.asarray(tree.get("fingerprint", -1))) != fingerprint:
+                raise CheckpointIncompatibleError(
+                    "checkpoint does not match the current sharded layout/"
+                    "data — retrain from scratch")
+            x0, y0 = rx, ry
+
     gx0 = jax.make_array_from_callback(
         x0.shape, in_shardings[1], lambda idx: x0[idx])
     gy0 = jax.make_array_from_callback(
         y0.shape, in_shardings[2], lambda idx: y0[idx])
-    x, y = fn(np.int32(params.num_iterations), gx0, gy0, *blocks)
+
+    chunk = (checkpoint_hook.every_n
+             if checkpoint_hook is not None and checkpoint_hook.enabled else 0)
+    if chunk and params.num_iterations - start_iter > chunk:
+        x, y = gx0, gy0
+        it = start_iter
+        while it < params.num_iterations:
+            n = min(chunk, params.num_iterations - it)
+            x, y = fn(np.int32(n), x, y, *flat)
+            it += n
+            if it < params.num_iterations and jax.process_index() == 0:
+                checkpoint_hook.save(
+                    it, {"user_factors": np.asarray(jax.device_get(x)),
+                         "item_factors": np.asarray(jax.device_get(y)),
+                         "fingerprint": np.int64(fingerprint)})
+            multihost_utils.sync_global_devices(f"pio_als_ckpt_{it}")
+    else:
+        x, y = fn(np.int32(params.num_iterations - start_iter), gx0, gy0,
+                  *flat)
     x, y = jax.device_get((x, y))
     return ALSFactors(
-        user_factors=np.asarray(x)[:n_users],
-        item_factors=np.asarray(y)[:n_items],
+        user_factors=np.asarray(x)[plan_u.slot_of_row],
+        item_factors=np.asarray(y)[plan_i.slot_of_row],
         n_users=n_users,
         n_items=n_items,
     )

@@ -1,13 +1,19 @@
-"""ALS kernel tests: blocked layout correctness, half-step equivalence with
-a dense NumPy reference, convergence, implicit feedback, and sharding over
-the 8-device CPU mesh (SURVEY.md §4 device-free CI trick)."""
+"""ALS kernel tests: bucketed row layout correctness, half-step
+equivalence with a dense NumPy reference, convergence, implicit feedback,
+and sharding over the 8-device CPU mesh (SURVEY.md §4 device-free CI
+trick)."""
 
 import numpy as np
 import pytest
 
-from incubator_predictionio_tpu.ops.blocked import build_blocked, shard_blocked
+from incubator_predictionio_tpu.ops.rowblocks import (
+    fill_buckets,
+    length_ladder,
+    plan_layout,
+)
 from incubator_predictionio_tpu.ops.als import (
     ALSParams,
+    _fresh_init,
     predict_rmse,
     train_als,
 )
@@ -24,43 +30,88 @@ def _toy_ratings(n_users=60, n_items=40, density=0.3, seed=0):
     return u.astype(np.int32), i.astype(np.int32), full[u, i].astype(np.float32)
 
 
-def test_build_blocked_roundtrip():
+def _reconstruct_dense(plan, arrs, inv_col_slot, n_rows, n_cols, sentinel):
+    """Rebuild the dense rating matrix from the bucketed slabs (tests the
+    layout round-trips every entry exactly once, incl. overflow rows)."""
+    dense = np.zeros((n_rows, n_cols))
+    row_of_slot = np.full(plan.total_slots, -1, np.int64)
+    row_of_slot[plan.slot_of_row] = np.arange(plan.n_rows)
+    bucket_base = np.concatenate([[0], np.cumsum(plan.bucket_rows)])
+    for b, (cols, vals) in enumerate(zip(arrs.cols, arrs.vals)):
+        R_b = plan.bucket_rows[b]
+        for idx in range(cols.shape[0]):
+            shard, rib = divmod(idx, R_b)
+            slot = shard * plan.rows_per_shard + bucket_base[b] + rib
+            row = row_of_slot[slot]
+            for c, v in zip(cols[idx], vals[idx]):
+                if c != sentinel:
+                    dense[row, inv_col_slot[c]] += v
+    Rv = plan.v_rows_per_shard
+    for idx in range(arrs.v_cols.shape[0]):
+        shard = idx // Rv
+        parent_local = plan.v_parent[idx]
+        row = row_of_slot[shard * plan.rows_per_shard + parent_local]
+        for c, v in zip(arrs.v_cols[idx], arrs.v_vals[idx]):
+            if c != sentinel:
+                dense[row, inv_col_slot[c]] += v
+    return dense
+
+
+def test_layout_roundtrip():
     u, i, r = _toy_ratings()
-    b = build_blocked(u, i, r, n_rows=60, block_len=8)
-    # every real entry appears exactly once; padded slots are masked out
-    assert int(b.mask.sum()) == len(u)
-    dense = np.zeros((60, 40))
-    for blk in range(b.n_blocks):
-        row = b.block_row[blk]
-        for slot in range(b.block_len):
-            if b.mask[blk, slot]:
-                dense[row, b.col[blk, slot]] += b.val[blk, slot]
+    counts_u = np.bincount(u, minlength=60)
+    counts_i = np.bincount(i, minlength=40)
+    plan_u = plan_layout(counts_u, n_shards=8)
+    plan_i = plan_layout(counts_i, n_shards=8)
+    arrs = fill_buckets(plan_u, u, i, r, col_slot_map=plan_i.slot_of_row,
+                        sentinel=plan_i.total_slots)
+    inv = np.full(plan_i.total_slots, -1, np.int64)
+    inv[plan_i.slot_of_row] = np.arange(40)
+    dense = _reconstruct_dense(plan_u, arrs, inv, 60, 40,
+                               plan_i.total_slots)
     ref = np.zeros((60, 40))
     ref[u, i] = r
     np.testing.assert_allclose(dense, ref, rtol=1e-6)
-    assert (b.counts == np.bincount(u, minlength=60)).all()
+    assert (plan_u.counts_slot[plan_u.slot_of_row] == counts_u).all()
 
 
-def test_build_blocked_empty_and_long_rows():
-    # row 0 empty; row 1 has 20 entries with L=8 → 3 blocks
-    u = np.array([1] * 20 + [2], dtype=np.int32)
-    i = np.arange(21, dtype=np.int32)
-    r = np.ones(21, dtype=np.float32)
-    b = build_blocked(u, i, r, n_rows=3, block_len=8)
-    assert b.counts.tolist() == [0, 20, 1]
-    assert (b.block_row == np.array([1, 1, 1, 2])).all()
+def test_layout_overflow_rows():
+    """Rows longer than overflow_len split into virtual rows + remainder
+    and still round-trip exactly."""
+    rng = np.random.default_rng(1)
+    # row 0: 70 entries with overflow_len=32 → 2 virtual + remainder 6
+    # row 1: exactly 64 entries → 1 virtual + remainder 32 (never empty)
+    # row 2: 3 entries; row 3: empty
+    rows = np.concatenate([np.zeros(70), np.ones(64), np.full(3, 2)]).astype(np.int64)
+    cols = rng.integers(0, 50, len(rows)).astype(np.int64)
+    vals = rng.random(len(rows)).astype(np.float32)
+    counts = np.bincount(rows, minlength=4)
+    plan = plan_layout(counts, n_shards=2, overflow_len=32)
+    assert plan.v_chunks_of_row.tolist() == [2, 1, 0, 0]
+    cmap = np.arange(50)  # identity counterpart map
+    arrs = fill_buckets(plan, rows, cols, vals, col_slot_map=cmap,
+                        sentinel=50)
+    inv = np.arange(50)
+    dense = _reconstruct_dense(plan, arrs, inv, 4, 50, 50)
+    ref = np.zeros((4, 50))
+    np.add.at(ref, (rows, cols), vals)
+    np.testing.assert_allclose(dense, ref, rtol=1e-6)
 
 
-def test_shard_blocked_locality():
-    u, i, r = _toy_ratings()
-    b = build_blocked(u, i, r, n_rows=60, block_len=8)
-    s = shard_blocked(b, n_shards=8)
-    assert s.padded_rows % 8 == 0
-    # local rows stay within each shard's row budget
-    assert s.local_row.max() < s.rows_per_shard
-    # mass is conserved
-    assert np.isclose(s.val.sum(), r.sum())
-    assert int(s.mask.sum()) == len(u)
+def test_length_ladder_shape():
+    lad = length_ladder(500)
+    assert lad[0] == 8 and (np.diff(lad) > 0).all()
+    assert (lad % 8 == 0).all()
+    assert lad[-1] >= 500
+    # capped at overflow
+    assert length_ladder(10**9)[-1] == 2048
+
+
+def test_plan_m_divisibility():
+    counts = np.random.default_rng(0).integers(0, 20, 37)
+    plan = plan_layout(counts, n_shards=2, m_div=4)
+    assert (2 * plan.rows_per_shard) % 4 == 0
+    assert plan.rows_per_shard % 4 == 0
 
 
 def _numpy_als_step(y, u, i, r, n_users, reg):
@@ -82,17 +133,16 @@ def test_half_step_matches_dense_reference():
     """One full train iteration from a fixed init must match the dense
     NumPy normal-equation solve on both sides."""
     u, i, r = _toy_ratings(n_users=30, n_items=20)
-    params = ALSParams(rank=4, num_iterations=1, reg=0.1, seed=7, block_len=8)
+    params = ALSParams(rank=4, num_iterations=1, reg=0.1, seed=7)
     out = train_als(u, i, r, 30, 20, params)
 
-    # replicate: same init as train_als
-    by_user = shard_blocked(build_blocked(u, i, r, 30, 8), 8)
-    by_item = shard_blocked(build_blocked(i, u, r, 20, 8), 8)
-    rng = np.random.default_rng(7)
-    x0 = (rng.standard_normal((by_user.padded_rows, 4)) / 2.0).astype(np.float32)
-    y0 = (rng.standard_normal((by_item.padded_rows, 4)) / 2.0).astype(np.float32)
+    # replicate init: global-row-order draw (layout-independent)
+    plan_u = plan_layout(np.bincount(u, minlength=30), 8)
+    plan_i = plan_layout(np.bincount(i, minlength=20), 8)
+    x0, y0 = _fresh_init(params, plan_u, plan_i, 30, 20)
+    y0_global = y0[plan_i.slot_of_row]
 
-    x_ref = _numpy_als_step(y0[:20].astype(np.float64), u, i, r, 30, 0.1)
+    x_ref = _numpy_als_step(y0_global.astype(np.float64), u, i, r, 30, 0.1)
     y_ref = _numpy_als_step(
         x_ref, i, u, r, 20, 0.1
     )  # items solved against fresh users
@@ -102,7 +152,7 @@ def test_half_step_matches_dense_reference():
 
 def test_als_converges():
     u, i, r = _toy_ratings(n_users=80, n_items=50, density=0.4, seed=3)
-    params = ALSParams(rank=8, num_iterations=12, reg=0.05, seed=1, block_len=16)
+    params = ALSParams(rank=8, num_iterations=12, reg=0.05, seed=1)
     out = train_als(u, i, r, 80, 50, params)
     rmse = predict_rmse(out, u, i, r)
     assert rmse < 0.15, f"ALS failed to fit training data, rmse={rmse}"
@@ -111,7 +161,7 @@ def test_als_converges():
 def test_als_lambda_scaling_nratings():
     u, i, r = _toy_ratings(n_users=30, n_items=20)
     params = ALSParams(rank=4, num_iterations=5, reg=0.01,
-                       lambda_scaling="nratings", block_len=8)
+                       lambda_scaling="nratings")
     out = train_als(u, i, r, 30, 20, params)
     assert np.isfinite(out.user_factors).all()
     assert predict_rmse(out, u, i, r) < 0.5
@@ -123,7 +173,7 @@ def test_als_implicit():
     i = rng.integers(0, 30, 600).astype(np.int32)
     r = np.ones(600, dtype=np.float32)  # implicit view counts
     params = ALSParams(rank=8, num_iterations=8, reg=0.1,
-                       implicit_prefs=True, alpha=40.0, block_len=16)
+                       implicit_prefs=True, alpha=40.0)
     out = train_als(u, i, r, 40, 30, params)
     assert np.isfinite(out.user_factors).all()
     # observed pairs should score higher than random unobserved pairs
@@ -145,14 +195,42 @@ def test_als_on_explicit_submesh():
     assert np.isfinite(out.user_factors).all()
 
 
-def test_als_chunked_matches_unchunked():
-    """chunk_tiles must not change results (review: HBM-bounded path)."""
+def test_als_chunking_is_invariant():
+    """entries-per-step chunking (chunk_tiles × block_len) slices bucket
+    slabs over ROWS, so it cannot change the math — results must match
+    the unchunked run to f32 reduction-order tolerance (batch shape
+    changes XLA's accumulation schedule, nothing more)."""
     u, i, r = _toy_ratings(n_users=50, n_items=30, density=0.4, seed=9)
-    base = ALSParams(rank=6, num_iterations=3, reg=0.05, block_len=8)
-    chunked = ALSParams(rank=6, num_iterations=3, reg=0.05, block_len=8,
-                        chunk_tiles=4)
+    base = ALSParams(rank=6, num_iterations=3, reg=0.05)
+    chunked = ALSParams(rank=6, num_iterations=3, reg=0.05,
+                        block_len=8, chunk_tiles=4)  # 32 entries/step
     out_a = train_als(u, i, r, 50, 30, base)
     out_b = train_als(u, i, r, 50, 30, chunked)
     np.testing.assert_allclose(
-        out_a.user_factors, out_b.user_factors, rtol=1e-4, atol=1e-5
+        out_a.user_factors, out_b.user_factors, rtol=1e-3, atol=1e-5
     )
+
+
+def test_als_overflow_rows_train():
+    """A pathologically heavy row (> overflow_len entries) trains and
+    matches the dense reference."""
+    rng = np.random.default_rng(6)
+    n_users, n_items = 12, 2100
+    # user 0 rates 2100 items (forces overflow split at 2048); others few
+    u0 = np.zeros(2100, np.int64)
+    i0 = np.arange(2100, dtype=np.int64)
+    u1 = rng.integers(1, n_users, 300)
+    i1 = rng.integers(0, n_items, 300)
+    u = np.concatenate([u0, u1]).astype(np.int32)
+    i = np.concatenate([i0, i1]).astype(np.int32)
+    r = rng.random(len(u)).astype(np.float32)
+    params = ALSParams(rank=4, num_iterations=1, reg=0.1, seed=2)
+    out = train_als(u, i, r, n_users, n_items, params)
+
+    plan_u = plan_layout(np.bincount(u, minlength=n_users), 8)
+    plan_i = plan_layout(np.bincount(i, minlength=n_items), 8)
+    assert plan_u.v_rows_per_shard > 0  # the overflow path engaged
+    x0, y0 = _fresh_init(params, plan_u, plan_i, n_users, n_items)
+    x_ref = _numpy_als_step(y0[plan_i.slot_of_row].astype(np.float64),
+                            u, i, r, n_users, 0.1)
+    np.testing.assert_allclose(out.user_factors, x_ref, rtol=2e-3, atol=2e-4)

@@ -1,12 +1,11 @@
 """Auto-resolution of ALS compute knobs + template param plumbing.
 
-Round-1 gap (VERDICT.md "What's weak" #2): the bench harness set
-chunk_tiles/bf16 by hand while the template exposed neither, so a real
-`pio train` at ml20m would have built the unchunked ~10 GB gram batch
-and OOMed. These tests pin: (a) the "auto" knobs resolve to the bench
-configuration exactly when the data demands it, (b) engine.json spellings
-reach ALSParams, (c) the DASE path trains with pure template defaults.
-"""
+Round-1 gap (VERDICT.md r1 "What's weak" #2): the bench harness set its
+knobs by hand while the template exposed neither, so a real `pio train`
+at ml20m diverged from the benched configuration. These tests pin:
+(a) the "auto" knobs resolve deterministically from the mesh platform,
+(b) engine.json spellings reach ALSParams, (c) the DASE path trains with
+pure template defaults."""
 
 import numpy as np
 
@@ -14,56 +13,33 @@ import jax
 
 from incubator_predictionio_tpu.ops.als import (
     ALSParams,
-    _AUTO_CHUNK_TILES,
+    _AUTO_ENTRIES_PER_STEP,
     _resolve_params,
     train_als,
 )
-from incubator_predictionio_tpu.ops.blocked import build_blocked, shard_blocked
 from incubator_predictionio_tpu.parallel.mesh import mesh_from_devices
 
 
-def _sharded(n_rows, n_cols, nnz, block_len, n_shards, seed=0):
-    rng = np.random.default_rng(seed)
-    row = rng.integers(0, n_rows, nnz).astype(np.int32)
-    col = rng.integers(0, n_cols, nnz).astype(np.int32)
-    val = rng.random(nnz).astype(np.float32)
-    return shard_blocked(
-        build_blocked(row, col, val, n_rows, block_len), n_shards)
-
-
-def test_auto_resolves_small_data_to_unchunked_f32_on_cpu():
+def test_auto_resolves_dtype_from_mesh_platform():
     mesh = mesh_from_devices(devices=jax.devices("cpu")[:2])
-    users = _sharded(64, 48, 500, 8, 2)
-    items = _sharded(48, 64, 500, 8, 2, seed=1)
-    p = _resolve_params(mesh, ALSParams(rank=8), users, items)
+    p, entries = _resolve_params(mesh, ALSParams(rank=8))
     assert p.compute_dtype == "float32"  # cpu mesh
-    assert p.chunk_tiles == 0  # tiny data: no chunking
+    assert entries == _AUTO_ENTRIES_PER_STEP
 
 
-def test_auto_chunks_when_gram_batch_exceeds_budget(monkeypatch):
-    from incubator_predictionio_tpu.ops import als as als_mod
-
-    # Shrink the budget so toy data crosses it — the decision logic is
-    # what's under test, not the 1 GiB constant.
-    monkeypatch.setattr(als_mod, "_AUTO_CHUNK_BUDGET_BYTES", 1024)
+def test_chunk_tiles_scales_entries_per_step():
+    """chunkTiles keeps its engine.json meaning: tiles × blockLen
+    gathered entries per device step."""
     mesh = mesh_from_devices(devices=jax.devices("cpu")[:2])
-    users = _sharded(64, 48, 2000, 8, 2)
-    items = _sharded(48, 64, 2000, 8, 2, seed=1)
-    p = als_mod._resolve_params(mesh, ALSParams(rank=8), users, items)
-    # Budget-capped: per-chunk slab must fit (chunk*per_tile <= budget)
-    # and the chunked path must actually engage (chunk < local tiles).
-    per_tile = 8 * 8 * 4 + 8 * 8 * 4  # L*k*f32 + k*k*f32
-    assert 0 < p.chunk_tiles <= 1024 // per_tile
-    assert p.chunk_tiles < users.col.shape[0] // users.n_shards
-    assert p.chunk_tiles <= _AUTO_CHUNK_TILES
+    p, entries = _resolve_params(
+        mesh, ALSParams(rank=8, block_len=16, chunk_tiles=128))
+    assert entries == 128 * 16
 
 
 def test_explicit_knobs_pass_through_unchanged():
     mesh = mesh_from_devices(devices=jax.devices("cpu")[:2])
-    users = _sharded(64, 48, 500, 8, 2)
-    items = _sharded(48, 64, 500, 8, 2, seed=1)
     p0 = ALSParams(rank=8, compute_dtype="bfloat16", chunk_tiles=7)
-    p = _resolve_params(mesh, p0, users, items)
+    p, _ = _resolve_params(mesh, p0)
     assert p.compute_dtype == "bfloat16"
     assert p.chunk_tiles == 7
 
@@ -76,7 +52,7 @@ def test_auto_defaults_train_end_to_end():
     r = rng.random(400).astype(np.float32)
     mesh = mesh_from_devices(devices=jax.devices("cpu")[:4])
     out = train_als(u, i, r, 30, 20,
-                    ALSParams(rank=8, num_iterations=2, block_len=8),
+                    ALSParams(rank=8, num_iterations=2),
                     mesh=mesh)
     assert np.isfinite(out.user_factors).all()
 
@@ -121,7 +97,7 @@ def test_timings_hook_through_train_als():
     i = rng.integers(0, 15, 300).astype(np.int32)
     r = rng.random(300).astype(np.float32)
     mesh = mesh_from_devices(devices=jax.devices("cpu")[:4])
-    p = ALSParams(rank=4, num_iterations=3, block_len=8)
+    p = ALSParams(rank=4, num_iterations=3)
     plain = train_als(u, i, r, 25, 15, p, mesh=mesh)
     t = {}
     timed = train_als(u, i, r, 25, 15, p, mesh=mesh, timings=t)

@@ -1,0 +1,58 @@
+"""bf16 numerics of the bucketed half-step.
+
+Historical note: the old tiled layout's chunked scan reduced tile grams
+to rows through a bf16 one-hot MXU matmul, which accumulated LOWER
+precision normal equations than the unchunked path (documented
+divergence, ADVICE r2). The bucketed layout (ops/rowblocks.py) removed
+that reduction entirely — per-row grams come straight out of one einsum
+with f32 accumulation — so chunking now CANNOT change the math. These
+tests pin both properties: chunk-invariance under bf16, and bf16-vs-f32
+distance staying at rounding level."""
+
+import numpy as np
+
+import jax
+
+from incubator_predictionio_tpu.ops.als import ALSParams, train_als
+from incubator_predictionio_tpu.parallel.mesh import mesh_from_devices
+
+
+def _toy(seed=0, n_users=40, n_items=25, nnz=900):
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = (rng.integers(1, 11, nnz) / 2.0).astype(np.float32)
+    return u, i, r, n_users, n_items
+
+
+def test_bf16_chunked_matches_bf16_unchunked():
+    """Row-chunking slices bucket slabs over rows; with the same einsum
+    shapes per row the contraction is identical — bf16 results must agree
+    to float-reduction tolerance."""
+    u, i, r, nu, ni = _toy()
+    mesh = mesh_from_devices(devices=jax.devices("cpu")[:4])
+    base = dict(rank=8, num_iterations=3, reg=0.05,
+                compute_dtype="bfloat16")
+    out_a = train_als(u, i, r, nu, ni, ALSParams(**base), mesh=mesh)
+    out_b = train_als(u, i, r, nu, ni,
+                      ALSParams(**base, block_len=8, chunk_tiles=4),
+                      mesh=mesh)
+    np.testing.assert_allclose(
+        out_a.user_factors, out_b.user_factors, rtol=2e-3, atol=2e-4)
+
+
+def test_bf16_close_to_f32():
+    """bf16 gathers round factor rows to 8 mantissa bits before the f32
+    gram accumulation; with the λ ridge the solved factors stay within
+    bf16 rounding distance of the f32 run."""
+    u, i, r, nu, ni = _toy(seed=3)
+    mesh = mesh_from_devices(devices=jax.devices("cpu")[:4])
+    f32 = train_als(u, i, r, nu, ni,
+                    ALSParams(rank=8, num_iterations=3, reg=0.05,
+                              compute_dtype="float32"), mesh=mesh)
+    bf16 = train_als(u, i, r, nu, ni,
+                     ALSParams(rank=8, num_iterations=3, reg=0.05,
+                               compute_dtype="bfloat16"), mesh=mesh)
+    scale = np.abs(f32.user_factors).max()
+    err = np.abs(f32.user_factors - bf16.user_factors).max()
+    assert err < 0.05 * scale, f"bf16 drifted too far: {err} vs scale {scale}"
