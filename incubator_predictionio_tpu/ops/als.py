@@ -639,9 +639,11 @@ def train_als_process_sharded(
     meshes AND 2-D (d, m) ALX meshes — ownership windows are windows of
     layout slots, independent of which process filled them.
 
-    ``checkpoint_hook``/``resume``: same contract as train_als; snapshots
-    are written by process 0 (factors are replicated across processes in
-    multi-controller runs) and restored by every process.
+    ``checkpoint_hook``/``resume``: same contract as train_als; every
+    process drives the (multihost-coordinated) orbax hook — the primary
+    writes, the rest participate in its barriers. Factors are replicated
+    across processes in multi-controller runs, so the snapshots are
+    identical regardless of which process persists them.
     """
     mesh = mesh or default_mesh()
     d_size, m_size = _mesh_dims(mesh)
@@ -778,12 +780,16 @@ def train_als_process_sharded(
             n = min(chunk, params.num_iterations - it)
             x, y = fn(np.int32(n), x, y, *flat)
             it += n
-            if it < params.num_iterations and jax.process_index() == 0:
+            if it < params.num_iterations:
+                # EVERY process calls save: orbax's CheckpointManager is
+                # multihost-coordinated (its own barriers; the primary
+                # process writes, the rest participate). Factors are
+                # replicated in multi-controller runs, so the pytrees
+                # are identical across processes.
                 checkpoint_hook.save(
                     it, {"user_factors": np.asarray(jax.device_get(x)),
                          "item_factors": np.asarray(jax.device_get(y)),
                          "fingerprint": np.int64(fingerprint)})
-            multihost_utils.sync_global_devices(f"pio_als_ckpt_{it}")
     else:
         x, y = fn(np.int32(params.num_iterations - start_iter), gx0, gy0,
                   *flat)

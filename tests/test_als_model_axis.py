@@ -109,3 +109,65 @@ def test_2d_mesh_rows_not_divisible():
     out = train_als(u, i, r, nu, ni, params, mesh=_mesh_2d(2, 4))
     np.testing.assert_allclose(
         out.user_factors, ref.user_factors, rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("d,m", [(2, 4), (4, 2)])
+def test_2d_mesh_at_scale_with_overflow_and_chunking(d, m):
+    """MODEL_AXIS numerics at a size where everything interacts at once
+    (VERDICT r2 weak #6): per-shard ownership windows spanning many
+    bucket blocks, the out-of-window sentinel index, rows heavier than
+    overflow_len (virtual-row scatter under psum), skewed popularity,
+    empty rows, AND row-chunked slabs (tiny entries-per-step). Both
+    factor matrices verified against the dense NumPy normal equations
+    from the same init."""
+    rng = np.random.default_rng(42)
+    n_users, n_items, nnz = 2601, 143, 30_000
+    u = rng.integers(0, n_users - 1, nnz)  # user n_users-1 stays EMPTY
+    # skewed items; item 0 made heavier than overflow_len below
+    i = (n_items * rng.random(nnz) ** 3).astype(np.int64)
+    i = np.minimum(i, n_items - 1)
+    # force item 0 over the 2048-entry overflow split: 2500 DISTINCT
+    # users rate it (distinct so the (user, item) dedupe keeps them all)
+    heavy_u = rng.permutation(n_users - 1)[:2500]
+    u = np.concatenate([u, heavy_u]).astype(np.int32)
+    i = np.concatenate([i, np.zeros(2500, np.int64)]).astype(np.int32)
+    r = (rng.random(len(u)) * 4 + 1).astype(np.float32)
+    # dedupe (user, item) pairs so the dense reference is well-defined
+    key = u.astype(np.int64) * n_items + i
+    _, first = np.unique(key, return_index=True)
+    u, i, r = u[first], i[first], r[first]
+
+    from incubator_predictionio_tpu.ops.als import _fresh_init
+    from incubator_predictionio_tpu.ops.rowblocks import plan_layout
+
+    assert np.bincount(i, minlength=n_items)[0] > 2048  # overflow engaged
+
+    params = ALSParams(rank=8, num_iterations=1, reg=0.1, seed=9,
+                       block_len=8, chunk_tiles=32)  # 256 entries/step
+    mesh = _mesh_2d(d, m)
+    out = train_als(u, i, r, n_users, n_items, params, mesh=mesh)
+
+    plan_u = plan_layout(np.bincount(u, minlength=n_users), d, m_div=m)
+    plan_i = plan_layout(np.bincount(i, minlength=n_items), d, m_div=m)
+    assert plan_i.v_rows_per_shard > 0
+    x0, y0 = _fresh_init(params, plan_u, plan_i, n_users, n_items)
+    y0_g = y0[plan_i.slot_of_row].astype(np.float64)
+
+    def np_step(y, rows, cols, vals, n_rows, reg):
+        k = y.shape[1]
+        x = np.zeros((n_rows, k))
+        for rr in range(n_rows):
+            sel = rows == rr
+            if not sel.any():
+                continue
+            yy = y[cols[sel]]
+            x[rr] = np.linalg.solve(yy.T @ yy + reg * np.eye(k),
+                                    yy.T @ vals[sel])
+        return x
+
+    x_ref = np_step(y0_g, u, i, r, n_users, 0.1)
+    y_ref = np_step(x_ref, i, u, r, n_items, 0.1)
+    np.testing.assert_allclose(out.user_factors, x_ref, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(out.item_factors, y_ref, rtol=2e-3, atol=2e-4)
+    # the empty user must solve to ~0 (eps ridge only)
+    assert np.abs(out.user_factors[-1]).max() < 1e-3
