@@ -41,6 +41,10 @@ class TrainingData(SanityCheck):
     users: BiMap
     items: BiMap
     item_categories: dict[str, set[str]]
+    # item id → {"availableDate"/"expireDate"/"date": ISO string} for the
+    # UR date rules (reference UR: available/expire serving filters and
+    # the query dateRange rule).
+    item_dates: dict[str, dict] = dataclasses.field(default_factory=dict)
 
     def sanity_check(self):
         assert self.events, "no indicator events found"
@@ -88,13 +92,19 @@ class URDataSource(DataSource):
             for n, (lu, li) in per_event.items()
         }
         cats: dict[str, set[str]] = {}
+        dates: dict[str, dict] = {}
         for item_id, pm in PEventStore.aggregate_properties(
             app_name, p.item_entity_type, storage=ctx.get_storage()
         ).items():
             c = pm.get_opt("categories")
             if c:
                 cats[item_id] = set(c)
-        return TrainingData(events, users, items, cats)
+            d = {k: pm.get_opt(k)
+                 for k in ("availableDate", "expireDate", "date")}
+            d = {k: v for k, v in d.items() if v}
+            if d:
+                dates[item_id] = d
+        return TrainingData(events, users, items, cats, dates)
 
 
 @dataclasses.dataclass
@@ -106,13 +116,53 @@ class URModel:
     item_categories: dict[str, set[str]]
     app_name: str
     event_names: Sequence[str]
+    # primary-event count per item — the UR "popular" backfill ranking
+    # used for cold/unknown users (reference UR: RankingFieldName /
+    # popModel backfill).
+    popularity: np.ndarray = None
+    # item id → {"availableDate"/"expireDate"/"date": ISO} (date rules)
+    item_dates: dict[str, dict] = dataclasses.field(default_factory=dict)
     _storage: object = dataclasses.field(default=None, repr=False, compare=False)
     _cat_index: object = dataclasses.field(default=None, repr=False, compare=False)
+    _date_arrays: object = dataclasses.field(default=None, repr=False, compare=False)
 
     def category_index(self) -> CategoryIndex:
         if self._cat_index is None:
             self._cat_index = CategoryIndex(self.items, self.item_categories)
         return self._cat_index
+
+    def date_arrays(self):
+        """(avail_ts, expire_ts, date_ts) [I] epoch-second arrays.
+        Missing availableDate → -inf (always available); missing
+        expireDate → +inf (never expires); missing date → NaN (fails any
+        dateRange comparison, matching UR's must-clause semantics)."""
+        if self._date_arrays is None:
+            from ..data.storage.event import parse_event_time
+
+            n = len(self.items)
+            avail = np.full(n, -np.inf)
+            expire = np.full(n, np.inf)
+            date = np.full(n, np.nan)
+            for item_id, d in self.item_dates.items():
+                j = self.items.get(item_id)
+                if j is None:
+                    continue
+                # str() coercion + AttributeError: the property value is
+                # arbitrary JSON (int/list/...), and parse_event_time
+                # raises AttributeError on non-strings.
+                try:
+                    if "availableDate" in d:
+                        avail[j] = parse_event_time(
+                            str(d["availableDate"])).timestamp()
+                    if "expireDate" in d:
+                        expire[j] = parse_event_time(
+                            str(d["expireDate"])).timestamp()
+                    if "date" in d:
+                        date[j] = parse_event_time(str(d["date"])).timestamp()
+                except (ValueError, TypeError, AttributeError):
+                    pass  # unparseable property: treat as absent
+            self._date_arrays = (avail, expire, date)
+        return self._date_arrays
 
     def warm_up(self, num: int = 10):
         if len(self.users):
@@ -142,24 +192,75 @@ class URModel:
                 membership[j] = 1.0
         return out
 
+    def _date_exclude(self, current_date: Optional[str],
+                      date_range: Optional[dict]) -> np.ndarray:
+        """UR date rules as an exclude mask: items not yet available or
+        already expired at the query's currentDate (default: now), plus
+        the optional dateRange clause on the item's "date" property."""
+        from ..data.storage.event import parse_event_time
+
+        n = len(self.items)
+        exclude = np.zeros(n, dtype=bool)
+        avail, expire, date = self.date_arrays()
+        if current_date:
+            now = parse_event_time(str(current_date)).timestamp()
+        else:
+            import time as _time
+
+            now = _time.time()
+        exclude |= (now < avail) | (now > expire)
+        if date_range:
+            after = date_range.get("after")
+            before = date_range.get("before")
+            ok = ~np.isnan(date)
+            if after:
+                ok &= date >= parse_event_time(str(after)).timestamp()
+            if before:
+                ok &= date <= parse_event_time(str(before)).timestamp()
+            exclude |= ~ok
+        return exclude
+
     def recommend(
         self,
-        user: str,
+        user: Optional[str],
         num: int,
         fields: Optional[Sequence[dict]] = None,
         blacklist_items: Optional[Sequence[str]] = None,
         exclude_primary_history: bool = True,
+        items: Optional[Sequence[str]] = None,
+        current_date: Optional[str] = None,
+        date_range: Optional[dict] = None,
     ):
-        history = self._history(user)
-        if not any(m.any() for m in history.values()):
-            return []  # unknown/cold user: UR would fall back to popularity
+        """UR query core: user-based, item-based ("similar to these
+        items"), or both (memberships union); cold/unknown users fall
+        back to the popularity ranking through the SAME filter pipeline
+        (reference UR: popModel backfill; item-based and dateRange
+        queries per the UR query spec)."""
         n_items = len(self.items)
+        history = (self._history(user) if user is not None
+                   else {n: np.zeros(n_items, np.float32)
+                         for n in self.event_names})
+        # Item-based query: the query items act as history for every
+        # indicator type — _score_history then reads each candidate's
+        # correlator weight against them (the item-similarity column).
+        query_idx = []
+        for q in items or []:
+            j = self.items.get(q)
+            if j is not None:
+                query_idx.append(j)
+        for j in query_idx:
+            for name in self.event_names:
+                history[name][j] = 1.0
+
         exclude = build_exclude_mask(
-            self.items, black_list=blacklist_items
+            self.items, black_list=blacklist_items,
+            extra_excluded_items=items,  # never return the query items
         )
         if exclude_primary_history:
             primary = self.event_names[0]
             exclude |= history[primary] > 0
+        if current_date or date_range or self.item_dates:
+            exclude |= self._date_exclude(current_date, date_range)
         # UR "fields" biz rules: bias<0 = hard filter, bias>0 = boost —
         # category masks precomputed (CategoryIndex), no per-item loop.
         boost_vec = np.ones(n_items, np.float32)
@@ -170,6 +271,20 @@ class URModel:
                 exclude |= ~match
             else:
                 boost_vec = np.where(match, boost_vec * bias, boost_vec)
+
+        if not any(m.any() for m in history.values()):
+            # Cold/unknown user with no query items: popularity-ranked
+            # backfill through the same exclude/boost masks.
+            if self.popularity is None or not np.any(self.popularity):
+                return []
+            scores = np.where(exclude, -np.inf,
+                              self.popularity * boost_vec)
+            order = np.argsort(-scores)[:num]
+            return [
+                (self.items.inverse(int(j)), float(scores[j]))
+                for j in order
+                if np.isfinite(scores[j]) and scores[j] > 0
+            ]
 
         indicator_list = [
             (self.indicators[name], history[name], 1.0)
@@ -219,21 +334,38 @@ class URAlgorithm(Algorithm):
                 llr_threshold=p.llr_threshold,
                 u_chunk=p.user_chunk,
             )
+        # Popularity backfill ranking: raw primary-event count per item
+        # (reference UR's default "popular" popModel).
+        popularity = np.bincount(
+            np.asarray(pi, np.int64), minlength=len(pd.items)
+        ).astype(np.float32)
         model = URModel(
             indicators=indicators, users=pd.users, items=pd.items,
             item_categories=pd.item_categories,
             app_name=p.app_name or ctx.app_name,
             event_names=tuple(names),
+            popularity=popularity,
+            item_dates=dict(pd.item_dates),
         )
         model._storage = ctx.get_storage()
         return model
 
     def predict(self, model: URModel, query: dict) -> dict:
+        # UR query spec: "user" and/or "item"/"itemSet" (item-based),
+        # "fields" biz rules, "blacklistItems", "currentDate" (for the
+        # available/expire rules), "dateRange" {"after","before"}.
+        items = query.get("itemSet") or query.get("items")
+        if not items and query.get("item") is not None:
+            items = [query["item"]]
+        user = query.get("user")
         pairs = model.recommend(
-            str(query["user"]),
+            str(user) if user is not None else None,
             int(query.get("num", 10)),
             fields=query.get("fields"),
             blacklist_items=query.get("blacklistItems"),
+            items=[str(i) for i in items] if items else None,
+            current_date=query.get("currentDate"),
+            date_range=query.get("dateRange"),
         )
         return {"itemScores": [{"item": i, "score": s} for i, s in pairs]}
 
@@ -248,6 +380,9 @@ class URAlgorithm(Algorithm):
             "item_categories": {k: sorted(v) for k, v in model.item_categories.items()},
             "app_name": model.app_name,
             "event_names": list(model.event_names),
+            "popularity": np.asarray(model.popularity)
+            if model.popularity is not None else None,
+            "item_dates": dict(model.item_dates),
         }
 
     def restore_model(self, stored, ctx) -> URModel:
@@ -264,6 +399,8 @@ class URAlgorithm(Algorithm):
             item_categories={k: set(v) for k, v in stored["item_categories"].items()},
             app_name=stored["app_name"],
             event_names=tuple(stored["event_names"]),
+            popularity=stored.get("popularity"),
+            item_dates=dict(stored.get("item_dates") or {}),
         )
         model._storage = ctx.get_storage()
         return model

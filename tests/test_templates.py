@@ -287,8 +287,10 @@ def test_universal_recommender_template(memory_storage):
     }
     assert not (set(items) & bought)
 
-    # unknown user → empty (cold start)
-    assert dep.query({"user": "zzz", "num": 3}) == {"itemScores": []}
+    # unknown user → popularity backfill (UR popModel; detailed coverage
+    # in tests/test_ur_completeness.py)
+    cold = dep.query({"user": "zzz", "num": 3})
+    assert len(cold["itemScores"]) == 3
 
     # blacklist honoured
     r2 = dep.query({"user": "0", "num": 4, "blacklistItems": [items[0]]})

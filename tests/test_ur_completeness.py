@@ -1,0 +1,168 @@
+"""Universal Recommender serving completeness (VERDICT r2 item #5).
+
+Reference: ActionML UR query spec (SURVEY.md §2.8 row 5 — "biz rules,
+dates, boosts"): popularity backfill for cold/unknown users, the
+available/expire date rules + query dateRange clause, and item-based
+("similar to these items") queries. Each is exercised through the real
+Engine.train → deploy → query path on the in-memory store."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.controller import EngineParams
+from incubator_predictionio_tpu.data.storage import App, DataMap, Event
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import (
+    load_deployment,
+    run_train,
+)
+
+T0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+
+
+def _ts(i):
+    return T0 + dt.timedelta(seconds=i)
+
+
+@pytest.fixture()
+def ur_deployment(memory_storage):
+    """Two taste groups (items i0-i11 vs i12-i23); item 0 is by far the
+    most bought (popularity winner). Items carry categories and date
+    properties: i1 not yet available, i2 expired, others open-ended;
+    every item has a "date" stamp = its index day after 2024-01-01."""
+    from incubator_predictionio_tpu.models.universal_recommender import (
+        UniversalRecommenderEngine,
+    )
+
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "urcapp"))
+    le = memory_storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(3)
+    events = []
+    for u in range(40):
+        lo, hi = (0, 12) if u % 2 == 0 else (12, 24)
+        for _ in range(4):
+            events.append(Event("buy", "user", str(u), "item",
+                                f"i{rng.integers(lo, hi)}",
+                                event_time=_ts(len(events))))
+        for _ in range(8):
+            events.append(Event("view", "user", str(u), "item",
+                                f"i{rng.integers(lo, hi)}",
+                                event_time=_ts(len(events))))
+    # make i0 the runaway popularity leader
+    for u in range(40):
+        events.append(Event("buy", "user", str(u), "item", "i0",
+                            event_time=_ts(len(events))))
+    # item metadata: categories + dates
+    for j in range(24):
+        props = {"categories": ["even" if j % 2 == 0 else "odd"],
+                 "date": (T0 + dt.timedelta(days=j)).isoformat()}
+        if j == 1:
+            props["availableDate"] = "2030-01-01T00:00:00Z"  # future
+        if j == 2:
+            props["expireDate"] = "2020-01-01T00:00:00Z"  # past
+        events.append(Event("$set", "item", f"i{j}",
+                            properties=DataMap(props),
+                            event_time=_ts(len(events))))
+    le.insert_batch(events, app_id)
+
+    engine = UniversalRecommenderEngine()()
+    ctx = WorkflowContext(app_name="urcapp", storage=memory_storage)
+    ep = EngineParams.from_json({
+        "datasource": {"params": {"appName": "urcapp",
+                                  "eventNames": ["buy", "view"]}},
+        "algorithms": [{"name": "ur",
+                        "params": {"appName": "urcapp",
+                                   "maxCorrelatorsPerItem": 8,
+                                   "user_chunk": 64}}],
+    })
+    iid = run_train(engine, ep, ctx, engine_factory_name="ur")
+    dep, _, _ = load_deployment(
+        engine, iid, WorkflowContext(storage=memory_storage),
+        engine_factory_name="ur",
+    )
+    return dep
+
+
+def test_cold_user_popularity_fallback(ur_deployment):
+    """Unknown users get the popularity backfill (not an empty list),
+    ranked by primary-event count, through the same filters."""
+    r = ur_deployment.query({"user": "no-such-user", "num": 5})
+    items = [s["item"] for s in r["itemScores"]]
+    assert items, "cold user must fall back to popularity, not []"
+    assert items[0] == "i0"  # the runaway bestseller
+    # scores are the popularity counts, descending
+    scores = [s["score"] for s in r["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+
+    # filters still apply on the fallback path
+    r = ur_deployment.query({
+        "user": "no-such-user", "num": 5,
+        "fields": [{"name": "categories", "values": ["odd"], "bias": -1}],
+    })
+    assert r["itemScores"]
+    for s in r["itemScores"]:
+        assert int(s["item"][1:]) % 2 == 1, s
+
+
+def test_date_rules_available_expire(ur_deployment):
+    """i1 (available 2030) and i2 (expired 2020) are excluded at query
+    time; a currentDate in 2031 brings i1 back and keeps i2 out."""
+    r = ur_deployment.query({"user": "no-such-user", "num": 24})
+    items = {s["item"] for s in r["itemScores"]}
+    assert "i1" not in items and "i2" not in items
+    assert "i3" in items or "i0" in items  # open-dated items fine
+
+    r = ur_deployment.query({"user": "no-such-user", "num": 24,
+                             "currentDate": "2031-06-01T00:00:00Z"})
+    items = {s["item"] for s in r["itemScores"]}
+    assert "i1" in items
+    assert "i2" not in items
+
+
+def test_date_range_rule(ur_deployment):
+    """dateRange clause filters on the item "date" property."""
+    r = ur_deployment.query({
+        "user": "no-such-user", "num": 24,
+        "dateRange": {"after": (T0 + dt.timedelta(days=4)).isoformat(),
+                      "before": (T0 + dt.timedelta(days=8)).isoformat()},
+    })
+    items = [s["item"] for s in r["itemScores"]]
+    assert items
+    for it in items:
+        assert 4 <= int(it[1:]) <= 8, items
+
+
+def test_item_based_query(ur_deployment):
+    """{"item": "i5"} returns items similar to i5 (same taste group),
+    never the query item itself; works with no user at all."""
+    r = ur_deployment.query({"item": "i5", "num": 5})
+    items = [s["item"] for s in r["itemScores"]]
+    assert items, "item-based query returned nothing"
+    assert "i5" not in items
+    in_group = sum(1 for it in items if int(it[1:]) < 12)
+    assert in_group >= len(items) - 1, f"similarity leaked across groups: {items}"
+
+    # itemSet spelling
+    r2 = ur_deployment.query({"itemSet": ["i5", "i7"], "num": 5})
+    assert r2["itemScores"]
+    assert not {"i5", "i7"} & {s["item"] for s in r2["itemScores"]}
+
+
+def test_user_plus_items_union(ur_deployment):
+    """A known user combined with query items unions the memberships."""
+    r = ur_deployment.query({"user": "0", "item": "i4", "num": 5})
+    items = [s["item"] for s in r["itemScores"]]
+    assert items
+    assert "i4" not in items
+
+
+def test_popularity_and_dates_survive_persistence(ur_deployment, memory_storage):
+    """The deployed model above was restored through the Models DAO blob
+    (load_deployment), so passing the fallback/date tests already proves
+    round-tripping; this pins the fields explicitly."""
+    model = ur_deployment.models[0]
+    assert model.popularity is not None and model.popularity.max() >= 40
+    assert "i1" in model.item_dates and "availableDate" in model.item_dates["i1"]
