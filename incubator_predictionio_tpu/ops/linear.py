@@ -109,6 +109,63 @@ class LogisticRegressionModel:
         return e / e.sum(axis=-1, keepdims=True)
 
 
+@functools.partial(jax.jit, static_argnames=("n_classes",),
+                   donate_argnums=())
+def _lr_fit(xp, yp, maskp, n, reg, tol, max_iters, n_classes: int):
+    """The ENTIRE L-BFGS optimization in one jit (lax.while_loop with
+    the convergence test on-device). Module-level so the compiled
+    executable is REUSED across train calls at the same shapes — a
+    per-call closure would retrace+recompile every `pio train`, and a
+    host-side step loop would pay a dispatch+readback round trip per
+    iteration (~1s/iter through a remote-PJRT tunnel, 1000x the actual
+    step cost at template shapes)."""
+    import optax
+
+    d = xp.shape[1]
+
+    def loss_fn(params):
+        w, b = params
+        logits = xp @ w + b  # [Np, C] row-sharded
+        logp = jax.nn.log_softmax(logits)
+        # one-hot contraction, NOT take_along_axis: a per-row gather runs
+        # at the TPU gather unit's fixed ~420M rows/s (BASELINE.md
+        # roofline) — 6x the cost of this elementwise mask at bench shape.
+        onehot = jax.nn.one_hot(yp, n_classes, dtype=logp.dtype)
+        nll = -(logp * onehot).sum(axis=1)
+        data = jnp.sum(nll * maskp) / n
+        return data + 0.5 * reg * jnp.sum(w * w)
+
+    # Backtracking linesearch instead of the default zoom: zoom's
+    # while_loop lowers to ~1.7s/step at 2M-example shape (hundreds of
+    # serialized loss evals); backtracking converges the template
+    # configurations identically at ~3ms/step.
+    opt = optax.lbfgs(linesearch=optax.scale_by_backtracking_linesearch(
+        max_backtracking_steps=20, store_grad=True))
+    value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+    def step(carry):
+        it, params, state, prev, _ = carry
+        value, grad = value_and_grad(params, state=state)
+        updates, state = opt.update(
+            grad, state, params, value=value, grad=grad, value_fn=loss_fn
+        )
+        params = optax.apply_updates(params, updates)
+        gnorm = optax.tree.norm(grad)
+        done = (jnp.abs(prev - value)
+                < tol * jnp.maximum(1.0, jnp.abs(prev))) & (gnorm < 1e-4)
+        return it + 1, params, state, value, done
+
+    def cond(carry):
+        it, _, _, _, done = carry
+        return (it < max_iters) & ~done
+
+    params = (jnp.zeros((d, n_classes)), jnp.zeros((n_classes,)))
+    carry = (jnp.int32(0), params, opt.init(params), jnp.float32(jnp.inf),
+             jnp.bool_(False))
+    carry = jax.lax.while_loop(cond, step, carry)
+    return carry[1]
+
+
 def train_logistic_regression(
     x: np.ndarray,
     y: np.ndarray,
@@ -120,8 +177,6 @@ def train_logistic_regression(
 ) -> LogisticRegressionModel:
     """Full-batch multinomial LR via optax L-BFGS; data row-sharded over
     the mesh, gradient psum inserted by XLA."""
-    import optax
-
     mesh = mesh or default_mesh()
     n_dev = int(np.prod(list(mesh.shape.values())))
     x = np.asarray(x, np.float32)
@@ -135,37 +190,9 @@ def train_logistic_regression(
     xp = jax.device_put(xp, shard2)
     yp = jax.device_put(yp, shard1)
     maskp = jax.device_put(mask, shard1)
-    d = x.shape[1]
 
-    def loss_fn(params):
-        w, b = params
-        logits = xp @ w + b  # [Np, C] row-sharded
-        logp = jax.nn.log_softmax(logits)
-        nll = -jnp.take_along_axis(logp, yp[:, None], axis=1)[:, 0]
-        data = jnp.sum(nll * maskp) / n
-        return data + 0.5 * reg * jnp.sum(w * w)
-
-    opt = optax.lbfgs()
-    params = (jnp.zeros((d, n_classes)), jnp.zeros((n_classes,)))
-    value_and_grad = optax.value_and_grad_from_state(loss_fn)
-
-    @jax.jit
-    def step(params, state):
-        value, grad = value_and_grad(params, state=state)
-        updates, state = opt.update(
-            grad, state, params, value=value, grad=grad, value_fn=loss_fn
-        )
-        params = optax.apply_updates(params, updates)
-        return params, state, value, optax.tree.norm(grad)
-
-    state = opt.init(params)
-    prev = np.inf
-    for it in range(max_iters):
-        params, state, value, gnorm = step(params, state)
-        v = float(value)
-        if abs(prev - v) < tol * max(1.0, abs(prev)) and float(gnorm) < 1e-4:
-            break
-        prev = v
+    params = _lr_fit(xp, yp, maskp, jnp.float32(n), jnp.float32(reg),
+                     jnp.float32(tol), jnp.int32(max_iters), n_classes)
     w, b = jax.device_get(params)
     return LogisticRegressionModel(
         weights=np.asarray(w, np.float32),

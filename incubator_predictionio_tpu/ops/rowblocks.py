@@ -237,17 +237,9 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
     counterpart's π space.
     """
     S_loc = plan.n_shards - shard0 if n_local_shards is None else int(n_local_shards)
-    row = np.asarray(row, dtype=np.int64)
-    col = np.asarray(col, dtype=np.int64)
     val = np.asarray(val, dtype=np.float32)
     n_buckets = len(plan.lengths)
     Rv, OV = plan.v_rows_per_shard, plan.overflow_len
-
-    shard_of = plan.shard_of_row(row) if row.size else np.zeros(0, np.int64)
-    if row.size and (shard_of.min() < shard0 or shard_of.max() >= shard0 + S_loc):
-        raise ValueError(
-            "fill_buckets: entries reference rows outside shards "
-            f"[{shard0}, {shard0 + S_loc}) — range-read only owned rows")
 
     # flat buffer: [bucket slabs ..., virtual slab]
     sizes = [S_loc * int(plan.bucket_rows[b]) * int(plan.lengths[b])
@@ -258,50 +250,62 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
     flat_cols = np.full(int(offsets[-1]), sentinel, dtype=np.int32)
     flat_vals = np.zeros(int(offsets[-1]), dtype=np.float32)
 
-    if row.size:
-        order = np.argsort(row, kind="stable")
-        rs, cs, vs = row[order], col[order], val[order]
-        # position of each entry within its row (rows may be any subset)
-        uniq, first_idx, cnt = np.unique(rs, return_index=True,
-                                         return_counts=True)
-        starts = np.zeros(len(rs), dtype=np.int64)
-        starts[first_idx] = np.arange(len(rs), dtype=np.int64)[first_idx]
-        starts = np.maximum.accumulate(starts)
-        pos = np.arange(len(rs), dtype=np.int64) - starts
+    if len(row):
+        # Hot path over nnz entries: one int32 stable argsort (radix —
+        # 2x+ faster than int64 comparison sort; row ids are bounded by
+        # n_rows, guarded below), then only gathers of small per-ROW
+        # tables + one scatter. All per-row destination arithmetic is
+        # precomputed in O(n_rows).
+        if plan.n_rows > 2**31 - 1:
+            raise NotImplementedError(
+                "fill_buckets: row ids beyond int32 are not supported")
+        order = np.argsort(np.asarray(row, np.int32), kind="stable")
+        rs = np.asarray(row, np.int64)[order]
+        # remap columns into counterpart pi space at the SOURCE (all
+        # real); sentinel prefill covers the padding slots.
+        cs = np.asarray(col_slot_map, np.int64)[
+            np.asarray(col, np.int64)[order]].astype(np.int32)
+        vs = val[order]
 
-        vchunks = plan.v_chunks_of_row[rs]
-        in_virtual = pos < vchunks * OV
-        shard_e = plan.shard_of_row(rs)
-
-        # primary destinations
-        prim = ~in_virtual
-        p_rows, p_pos = rs[prim], (pos - vchunks * OV)[prim]
-        b = plan.bucket_of_row[p_rows]
-        slot_local = (plan.slot_of_row[p_rows]
-                      - shard_e[prim] * plan.rows_per_shard)
+        n_rows = plan.n_rows
+        shard_r = plan.shard_of_row(np.arange(n_rows, dtype=np.int64))
+        # per-row flat bases (garbage for non-local rows — the range
+        # check below guarantees none are referenced)
         bucket_base = np.zeros(n_buckets + 1, dtype=np.int64)
         np.cumsum(plan.bucket_rows, out=bucket_base[1:])
-        row_in_bucket = slot_local - bucket_base[b]
-        dest = (offsets[b]
-                + ((shard_e[prim] - shard0) * plan.bucket_rows[b]
-                   + row_in_bucket) * plan.lengths[b]
-                + p_pos)
-        flat_cols[dest] = cs[prim].astype(np.int32)
-        flat_vals[dest] = vs[prim]
+        b_r = plan.bucket_of_row
+        rib = (plan.slot_of_row - shard_r * plan.rows_per_shard
+               - bucket_base[b_r])
+        prim_base = (offsets[b_r]
+                     + ((shard_r - shard0) * plan.bucket_rows[b_r] + rib)
+                     * plan.lengths[b_r])
+        vc_r = plan.v_chunks_of_row
+        # a row's virtual chunks are CONSECUTIVE v-slots, so its first
+        # vc*OV entries land contiguously at v_base + pos
+        v_base = (offsets[n_buckets]
+                  + ((shard_r - shard0) * Rv + plan.v_base_of_row) * OV)
 
-        if in_virtual.any():
-            v_rows, v_pos = rs[in_virtual], pos[in_virtual]
-            v_idx = (plan.v_base_of_row[v_rows] + v_pos // OV)
-            dest = (offsets[n_buckets]
-                    + ((shard_e[in_virtual] - shard0) * Rv + v_idx) * OV
-                    + v_pos % OV)
-            flat_cols[dest] = cs[in_virtual].astype(np.int32)
-            flat_vals[dest] = vs[in_virtual]
+        # sorted rows → min/max are the ends; range check before any
+        # gather of the per-row tables above
+        s_lo, s_hi = (int(s) for s in plan.shard_of_row(rs[[0, -1]]))
+        if s_lo < shard0 or s_hi >= shard0 + S_loc:
+            raise ValueError(
+                "fill_buckets: entries reference rows outside shards "
+                f"[{shard0}, {shard0 + S_loc}) — range-read only owned rows")
 
-    # map real cols into counterpart pi space (sentinel slots stay put)
-    col_slot_map = np.asarray(col_slot_map, dtype=np.int64)
-    real = flat_cols != sentinel
-    flat_cols[real] = col_slot_map[flat_cols[real]].astype(np.int32)
+        # position of each entry within its row (stable original order)
+        rmin = int(rs[0])
+        cnt = np.bincount((rs - rmin).astype(np.int64))
+        starts = np.zeros(len(cnt), dtype=np.int64)
+        np.cumsum(cnt[:-1], out=starts[1:])
+        pos = np.arange(len(rs), dtype=np.int64) - starts[rs - rmin]
+
+        vc_e = vc_r[rs] * OV
+        dest = np.where(pos < vc_e,
+                        v_base[rs] + pos,
+                        prim_base[rs] + pos - vc_e)
+        flat_cols[dest] = cs
+        flat_vals[dest] = vs
 
     cols, vals = [], []
     for b in range(n_buckets):
