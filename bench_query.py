@@ -17,9 +17,17 @@ below as dispatch_rtt_ms and reported alongside). The serving stack's own
 overhead = http_p50 − dispatch_rtt; on a host-attached chip the dispatch
 is sub-millisecond.
 
+Concurrency mode (VERDICT r2 #7 — serving under load): set
+PIO_QBENCH_QPS to ALSO run an open-loop load test — arrivals scheduled
+at the target rate regardless of completions (the honest tail-latency
+protocol; a closed loop hides queueing), async aiohttp clients,
+reporting p50/p95/p99 + achieved throughput at each offered rate, with
+the micro-batching window off and on (PIO_QBENCH_BATCH_MS, default 5).
+
 Env: PIO_QBENCH_ITEMS (default 26744), PIO_QBENCH_RANK (32),
 PIO_QBENCH_USERS (3000), PIO_QBENCH_N (200 queries),
-PIO_BENCH_FORCE_CPU=1 to smoke off-TPU.
+PIO_QBENCH_QPS ("50,100,200"), PIO_QBENCH_DURATION (seconds per rate),
+PIO_QBENCH_BATCH_MS (5), PIO_BENCH_FORCE_CPU=1 to smoke off-TPU.
 """
 
 from __future__ import annotations
@@ -34,6 +42,54 @@ import numpy as np
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def load_test(base_url: str, qps: float, duration: float, n_users: int,
+              seed: int = 1):
+    """Open-loop fixed-rate load: one asyncio loop schedules arrivals at
+    exact times; each request is an independent task. Returns latency
+    percentiles + achieved rate + error count."""
+    import asyncio
+
+    import aiohttp
+
+    async def run():
+        rng = np.random.default_rng(seed)
+        n = max(int(qps * duration), 1)
+        lat, errors = [], [0]
+        async with aiohttp.ClientSession() as sess:
+            # warm the connection pool
+            await sess.post(base_url + "/queries.json",
+                            json={"user": "0", "num": 10})
+
+            async def one(delay, user):
+                await asyncio.sleep(delay)
+                t0 = time.perf_counter()
+                try:
+                    async with sess.post(
+                        base_url + "/queries.json",
+                        json={"user": user, "num": 10},
+                    ) as resp:
+                        await resp.read()
+                        if resp.status != 200:
+                            errors[0] += 1
+                            return
+                except Exception:
+                    errors[0] += 1
+                    return
+                lat.append((time.perf_counter() - t0) * 1000)
+
+            start = time.perf_counter()
+            tasks = [
+                asyncio.create_task(
+                    one(k / qps, str(int(rng.integers(0, n_users)))))
+                for k in range(n)
+            ]
+            await asyncio.gather(*tasks)
+            wall = time.perf_counter() - start
+        return lat, errors[0], len(lat) / wall
+
+    return asyncio.run(run())
 
 
 def main() -> int:
@@ -147,6 +203,35 @@ def main() -> int:
         f"{pct(lat_http, 50) - pct(lat_predict, 50):.2f}ms; device dispatch "
         f"RTT {rtt_ms:.2f}ms of predict is attachment latency")
 
+    # -- open-loop load test at fixed offered rates -----------------------
+    load_detail = {}
+    qps_env = os.environ.get("PIO_QBENCH_QPS")
+    if qps_env:
+        rates = [float(s) for s in qps_env.split(",")]
+        duration = float(os.environ.get("PIO_QBENCH_DURATION", "5"))
+        batch_ms = float(os.environ.get("PIO_QBENCH_BATCH_MS", "5"))
+        for label, window in (("unbatched", 0.0), ("batched", batch_ms)):
+            srv = EngineServer(
+                engine, engine_factory_name="qbench", storage=storage,
+                batch_window_ms=window,
+            )
+            with ServerThread(srv.app) as st:
+                for rate in rates:
+                    lat, errs, achieved = load_test(
+                        st.base, rate, duration, n_users)
+                    key = f"{label}_{int(rate)}qps"
+                    load_detail[key] = {
+                        "p50_ms": round(pct(lat, 50), 2) if lat else None,
+                        "p95_ms": round(pct(lat, 95), 2) if lat else None,
+                        "p99_ms": round(pct(lat, 99), 2) if lat else None,
+                        "achieved_qps": round(achieved, 1),
+                        "errors": errs,
+                    }
+                    log(f"[qbench:load] {label} window={window}ms "
+                        f"offered={rate:.0f}qps achieved={achieved:.0f}qps "
+                        f"p50={load_detail[key]['p50_ms']}ms "
+                        f"p99={load_detail[key]['p99_ms']}ms errors={errs}")
+
     p50 = pct(lat_http, 50)
     print(json.dumps({
         "metric": f"pio query p50 /queries.json {n_items}-item catalog "
@@ -159,6 +244,7 @@ def main() -> int:
             "http_p50_ms": round(p50, 2),
             "http_p99_ms": round(pct(lat_http, 99), 2),
             "dispatch_rtt_ms": round(rtt_ms, 2),
+            **({"load": load_detail} if load_detail else {}),
         },
     }))
     return 0

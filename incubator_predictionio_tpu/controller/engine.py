@@ -238,6 +238,20 @@ class Deployment:
         ]
         return self.serving.serve(q, predictions)
 
+    def batch_query(self, queries) -> list[Any]:
+        """Vectorized multi-query path (one device dispatch per
+        algorithm instead of one per query) — used by the engine
+        server's micro-batching window and `pio batchpredict`."""
+        qs = [self.serving.supplement(q) for q in queries]
+        per_algo = [
+            algo.batch_predict(model, qs)
+            for (_, algo), model in zip(self.algo_list, self.models)
+        ]
+        return [
+            self.serving.serve(q, [pred[j] for pred in per_algo])
+            for j, q in enumerate(qs)
+        ]
+
 
 class SimpleEngine(Engine):
     """Reference: SimpleEngine — one DataSource + one Algorithm, identity

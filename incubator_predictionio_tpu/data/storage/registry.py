@@ -29,6 +29,7 @@ from .http_backend import HTTPStorageClient
 from .jsonl import JSONLClient
 from .localfs import LocalFSClient
 from .memory import StorageClient as MemoryClient
+from .s3 import S3Client
 from .sqlite import SQLiteClient
 
 
@@ -44,12 +45,16 @@ _BACKENDS: dict[str, Callable[[base.StorageClientConfig], base.BaseStorageClient
     # Client-server: a `pio storageserver` service shared by many hosts —
     # the HBase/JDBC/ES network-store role (http_backend.py).
     "HTTP": HTTPStorageClient,
+    # Real S3 REST protocol (SigV4) — model-data repository only, like
+    # the reference's storage/s3 assembly (s3.py); works against AWS
+    # S3 / MinIO / any S3-compatible store.
+    "S3": S3Client,
 }
 
 # Backend types whose wire protocols belong to external services this
 # distribution does not speak natively; the registry points at the HTTP
 # backend (same deployment shape: a shared network store) if selected.
-_UNSUPPORTED = {"HBASE", "ELASTICSEARCH", "PGSQL", "MYSQL", "JDBC", "S3", "HDFS"}
+_UNSUPPORTED = {"HBASE", "ELASTICSEARCH", "PGSQL", "MYSQL", "JDBC", "HDFS"}
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
 

@@ -79,6 +79,13 @@ class ALSModel:
         if len(self.users):
             self.recommend_products(next(iter(self.users.keys())), num)
 
+    def example_query(self):
+        """A valid query for serving warm-ups (micro-batch shape
+        pre-compilation in the engine server)."""
+        if not len(self.users):
+            return None
+        return {"user": next(iter(self.users.keys())), "num": 10}
+
     def recommend_products(self, user: str, num: int):
         uidx = self.users.get(user)
         if uidx is None:
@@ -230,13 +237,15 @@ class ALSAlgorithm(Algorithm):
             ]
         )
         num = max(int(q.get("num", 10)) for q in queries)
-        scores, idx = batch_top_k(uvecs, model.factors.item_factors, num)
+        # device-resident factors (cached) — passing the host array would
+        # re-upload the full catalog matrix on every serving micro-batch
+        scores, idx = batch_top_k(uvecs, model.device_item_factors(), num)
         out = []
         for j, (q, ok) in enumerate(zip(queries, known)):
             if not ok:
                 out.append({"itemScores": []})
                 continue
-            n = int(q.get("num", 10))
+            n = min(int(q.get("num", 10)), idx.shape[1])  # catalog may be smaller
             out.append(
                 {
                     "itemScores": [

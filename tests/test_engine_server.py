@@ -81,3 +81,35 @@ def test_engine_server_plugins(memory_storage):
         assert requests.get(st.base + "/plugins.json").json() == {"plugins": ["capper"]}
         r = requests.post(st.base + "/queries.json", json={"user": "1", "num": 5})
         assert len(r.json()["itemScores"]) == 1
+
+
+def test_engine_server_micro_batching(memory_storage):
+    """batch_window_ms coalesces concurrent queries into one vectorized
+    Deployment.batch_query dispatch; results must match the per-query
+    path exactly (SURVEY.md §7 hard part 1 — batching window at QPS)."""
+    import concurrent.futures
+
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rec")
+
+    plain = EngineServer(engine, engine_factory_name="rec",
+                         storage=memory_storage)
+    batched = EngineServer(engine, engine_factory_name="rec",
+                           storage=memory_storage,
+                           batch_window_ms=10.0, max_batch=8)
+    queries = [{"user": str(u), "num": 3} for u in range(6)] + [{"num": 3}]
+    with ServerThread(plain.app) as sp:
+        expected = [requests.post(sp.base + "/queries.json", json=q)
+                    for q in queries]
+    with ServerThread(batched.app) as sb:
+        # concurrent burst: all queries inside one window
+        with concurrent.futures.ThreadPoolExecutor(max_workers=7) as ex:
+            got = list(ex.map(
+                lambda q: requests.post(sb.base + "/queries.json", json=q),
+                queries))
+    for q, e, g in zip(queries, expected, got):
+        assert g.status_code == e.status_code, (q, g.text)
+        if e.status_code == 200:
+            assert g.json() == e.json(), q
