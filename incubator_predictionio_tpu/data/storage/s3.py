@@ -157,7 +157,9 @@ class S3Models(base.Models):
         self._ns = namespace
 
     def _key(self, model_id: str) -> str:
-        safe = model_id.replace("/", "_")
+        # percent-encode (collision-free — '/' → '_' would alias 'a/b'
+        # with 'a_b'); the transport signs encoded paths correctly
+        safe = urllib.parse.quote(model_id, safe="")
         return f"{self._ns}/pio_model_{safe}.bin"
 
     def insert(self, model: base.Model) -> None:

@@ -59,15 +59,20 @@ def batch_top_k(user_vecs, item_factors, k: int):
     # Pad only serving-scale batches: eval / `pio batchpredict` call this
     # once with thousands of fixed-size queries — one compile either way,
     # and pow2 padding there would waste up to 2x the matmul.
+    # (EngineServer caps its micro-batch max_batch at 256 to match.)
     bp = (1 << max(b - 1, 0).bit_length()) if b <= 256 else b
+    # k is a static jit arg too: bucket it to the next pow2 (≥8) so
+    # clients varying "num" share executables per bucket instead of
+    # compiling one per distinct value.
+    kp = min(max(8, 1 << max(k - 1, 0).bit_length()), item_factors.shape[0])
     if bp != b:
         user_vecs = np.concatenate(
             [user_vecs, np.zeros((bp - b,) + user_vecs.shape[1:],
                                  user_vecs.dtype)], axis=0)
     scores, idx = jax.device_get(
-        _batch_topk(jnp.asarray(user_vecs), jnp.asarray(item_factors), k)
+        _batch_topk(jnp.asarray(user_vecs), jnp.asarray(item_factors), kp)
     )
-    return scores[:b], idx[:b]
+    return scores[:b, :k], idx[:b, :k]
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

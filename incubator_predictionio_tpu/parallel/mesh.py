@@ -17,6 +17,7 @@ Axis conventions:
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -51,11 +52,41 @@ def mesh_from_devices(
 _default_mesh: Optional[Mesh] = None
 
 
+def _mesh_shape_from_env() -> Optional[tuple[int, ...]]:
+    """PIO_MESH_SHAPE: "8" → 1-D data mesh over 8 devices; "4x2" →
+    2-D (d, m)=(4, 2) ALX mesh. Set directly or via the CLI passthrough
+    tier (`pio train -- --mesh=4x2`, SURVEY.md §5.6c)."""
+    spec = (os.environ.get("PIO_MESH_SHAPE") or "").strip()
+    if not spec:
+        return None
+    try:
+        dims = tuple(int(s) for s in spec.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"PIO_MESH_SHAPE={spec!r}: expected D or DxM")
+    if len(dims) > 2 or any(d < 1 for d in dims):
+        raise ValueError(f"PIO_MESH_SHAPE={spec!r}: expected D or DxM")
+    return dims
+
+
 def default_mesh(refresh: bool = False) -> Mesh:
-    """Process-wide default 1-D mesh (cached)."""
+    """Process-wide default mesh (cached): 1-D over all devices unless
+    PIO_MESH_SHAPE overrides the shape."""
     global _default_mesh
     if _default_mesh is None or refresh:
-        _default_mesh = mesh_from_devices()
+        shape = _mesh_shape_from_env()
+        if shape is None:
+            _default_mesh = mesh_from_devices()
+        else:
+            n = int(np.prod(shape))
+            devices = jax.devices()
+            if n > len(devices):
+                raise ValueError(
+                    f"PIO_MESH_SHAPE/--mesh requests {shape} = {n} devices "
+                    f"but only {len(devices)} are available")
+            axes = (DATA_AXIS, MODEL_AXIS)[: len(shape)]
+            _default_mesh = mesh_from_devices(
+                shape=shape, axis_names=axes,
+                devices=devices[:n])
     return _default_mesh
 
 

@@ -23,6 +23,9 @@ def eval_cmd(args: list[str]) -> int:
                    help="dotted path of the EngineParamsGenerator (optional if the Evaluation defines params)")
     p.add_argument("--engine-dir", default=".")
     p.add_argument("--batch", default="")
+    p.add_argument("--app-name", default="",
+                   help="app whose events the evaluation reads (used when "
+                        "the Evaluation/generator classes don't bake one in)")
     ns = p.parse_args(args)
     from ...workflow.evaluation_workflow import run_evaluation
     from ...workflow.json_extractor import resolve_engine_factory
@@ -31,7 +34,7 @@ def eval_cmd(args: list[str]) -> int:
     generator_cls = (
         resolve_engine_factory(ns.generator, ns.engine_dir) if ns.generator else None
     )
-    ctx = WorkflowContext(storage=Storage.instance())
+    ctx = WorkflowContext(app_name=ns.app_name, storage=Storage.instance())
     result, instance_id = run_evaluation(
         evaluation_cls() if isinstance(evaluation_cls, type) else evaluation_cls,
         generator_cls() if isinstance(generator_cls, type) else generator_cls,

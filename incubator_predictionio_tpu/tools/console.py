@@ -3,12 +3,61 @@
 Verbs are registered incrementally as subsystems land; unknown verbs get a
 clear not-yet-implemented error instead of a crash. See tools/commands/ for
 implementations.
+
+Runtime passthrough (reference: `pio train -- --driver-memory 8G`, the
+post-`--` spark-submit tier, SURVEY.md §5.6c): everything after a bare
+``--`` configures the XLA/JAX/mesh runtime instead of the verb:
+
+    pio train -- --mesh=4x2 --xla_force_host_platform_device_count=8
+    pio deploy -- --jax_platforms=cpu
+    pio train -- --jax_default_matmul_precision=float32
+
+    --mesh=D | DxM          device-mesh shape (DxM → 2-D (d, m) ALX mesh)
+    --xla_<flag>[=v]        appended to XLA_FLAGS before backend init
+    --jax_<option>=v        jax.config.update("jax_<option>", v)
 """
 
 from __future__ import annotations
 
 import os
 import sys
+
+
+def apply_runtime_passthrough(extra: list[str]) -> None:
+    """Apply post-`--` runtime args. Must run before the first device
+    touch — XLA_FLAGS is read once at backend initialization."""
+    xla_flags = []
+    for tok in extra:
+        if not tok.startswith("--"):
+            raise SystemExit(
+                f"[error] runtime passthrough args must be --flags, got {tok!r}")
+        body = tok[2:]
+        key, sep, value = body.partition("=")
+        if key == "mesh":
+            if not value:
+                raise SystemExit(
+                    "[error] --mesh needs a shape, e.g. --mesh=8 or "
+                    "--mesh=4x2")
+            os.environ["PIO_MESH_SHAPE"] = value
+        elif key.startswith("xla_"):
+            xla_flags.append(tok)
+        elif key.startswith("jax_"):
+            import jax
+
+            v: object = value
+            if value.lower() in ("true", "false"):
+                v = value.lower() == "true"
+            elif value.isdigit():
+                v = int(value)
+            jax.config.update(key, v)
+        else:
+            raise SystemExit(
+                f"[error] unknown runtime passthrough {tok!r} "
+                "(expected --mesh=..., --xla_..., or --jax_...)")
+    if xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + " ".join(xla_flags)
+        ).strip()
 
 
 def main(argv=None) -> int:
@@ -32,7 +81,12 @@ def main(argv=None) -> int:
 
         print(__version__)
         return 0
-    return commands.dispatch(argv[0], argv[1:])
+    verb_args = argv[1:]
+    if "--" in verb_args:
+        split = verb_args.index("--")
+        apply_runtime_passthrough(verb_args[split + 1:])
+        verb_args = verb_args[:split]
+    return commands.dispatch(argv[0], verb_args)
 
 
 if __name__ == "__main__":

@@ -28,16 +28,55 @@ class Dashboard:
             ]
         )
 
+    @staticmethod
+    def _parsed_results(i) -> dict:
+        """bestScore / metricHeader / bestEngineParams / candidate count
+        from the stored MetricEvaluatorResult JSON (empty on legacy or
+        malformed rows)."""
+        try:
+            r = json.loads(i.evaluator_results_json or "{}")
+        except json.JSONDecodeError:
+            return {}
+        if not isinstance(r, dict):
+            return {}
+        return {
+            "metricHeader": r.get("metricHeader"),
+            "bestScore": r.get("bestScore"),
+            "bestEngineParams": r.get("bestEngineParams"),
+            "candidates": len(r.get("results", []) or []),
+        }
+
     async def handle_index(self, request: web.Request) -> web.Response:
+        """The reference dashboard's actual value: a leaderboard with the
+        metric score AND the winning params JSON ready to paste into
+        engine.json (reference: Dashboard.scala twirl table)."""
         rows = []
         for i in self.storage.get_meta_data_evaluation_instances().get_completed():
+            res = self._parsed_results(i)
+            best_params = res.get("bestEngineParams")
+            params_pre = (
+                html.escape(json.dumps(best_params, indent=2))
+                if best_params is not None else "—"
+            )
+            score = res.get("bestScore")
             rows.append(
-                "<tr><td>{id}</td><td>{cls}</td><td>{start}</td><td>{end}</td>"
-                "<td><pre>{res}</pre></td></tr>".format(
-                    id=html.escape(i.id[:13]),
+                "<tr><td><a href='/instances/{id}.json'>{sid}</a></td>"
+                "<td>{cls}</td><td>{metric}</td><td>{score}</td>"
+                "<td>{cand}</td><td>{start}</td><td>{end}</td>"
+                "<td><details><summary>engine.json params</summary>"
+                "<pre>{params}</pre></details>"
+                "<details><summary>full results</summary><pre>{res}</pre>"
+                "</details></td></tr>".format(
+                    id=html.escape(i.id),
+                    sid=html.escape(i.id[:13]),
                     cls=html.escape(i.evaluation_class),
+                    metric=html.escape(str(res.get("metricHeader") or "—")),
+                    score=(f"{score:.6g}" if isinstance(score, (int, float))
+                           else "—"),
+                    cand=res.get("candidates", "—"),
                     start=html.escape(str(i.start_time)),
                     end=html.escape(str(i.end_time)),
+                    params=params_pre,
                     res=html.escape(i.evaluator_results),
                 )
             )
@@ -45,24 +84,29 @@ class Dashboard:
             "<html><head><title>PredictionIO-TPU Dashboard</title></head><body>"
             "<h1>Completed evaluations</h1>"
             "<table border=1 cellpadding=4><tr><th>ID</th><th>Evaluation</th>"
-            "<th>Started</th><th>Finished</th><th>Results</th></tr>"
+            "<th>Metric</th><th>Best score</th><th>Candidates</th>"
+            "<th>Started</th><th>Finished</th><th>Best params / results</th></tr>"
             + "".join(rows)
             + "</table></body></html>"
         )
         return web.Response(text=body, content_type="text/html")
 
     async def handle_instances_json(self, request: web.Request) -> web.Response:
-        out = [
-            {
+        out = []
+        for i in self.storage.get_meta_data_evaluation_instances().get_completed():
+            res = self._parsed_results(i)
+            out.append({
                 "id": i.id,
                 "evaluationClass": i.evaluation_class,
                 "engineParamsGeneratorClass": i.engine_params_generator_class,
                 "startTime": i.start_time.isoformat(),
                 "endTime": i.end_time.isoformat() if i.end_time else None,
                 "batch": i.batch,
-            }
-            for i in self.storage.get_meta_data_evaluation_instances().get_completed()
-        ]
+                "metricHeader": res.get("metricHeader"),
+                "bestScore": res.get("bestScore"),
+                "bestEngineParams": res.get("bestEngineParams"),
+                "candidates": res.get("candidates"),
+            })
         return web.json_response(out, headers={"Access-Control-Allow-Origin": "*"})
 
     async def handle_instance_json(self, request: web.Request) -> web.Response:

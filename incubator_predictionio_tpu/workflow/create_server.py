@@ -63,7 +63,10 @@ class EngineServer:
         # in throughput (SURVEY.md §2.9 serving-concurrency row / §7
         # hard part 1 "may need batching window at high QPS").
         self.batch_window_ms = float(batch_window_ms)
-        self.max_batch = int(max_batch)
+        # Cap: ops.topk pads pow2 only up to 256 (larger batches are the
+        # bulk eval/batchpredict regime where padding wastes matmul), so
+        # windows beyond that would compile per exact batch size.
+        self.max_batch = min(int(max_batch), 256)
         self._batch_queue = None
         self._batch_task = None
         self.start_time = _dt.datetime.now(_dt.timezone.utc)

@@ -112,3 +112,53 @@ def test_quickstart_lifecycle(cli_env, tmp_path):
     # unknown command → usage, exit 1
     r = run_pio(["bogus"], cli_env, check=False)
     assert r.returncode == 1 and "usage" in r.stderr
+
+
+def test_runtime_passthrough_tier(cli_env, tmp_path):
+    """`pio train -- --mesh=4x2 --xla_...` (reference: the post-`--`
+    spark-submit passthrough, SURVEY.md §5.6c): runtime args after the
+    bare -- configure the mesh/XLA/JAX runtime, not the verb."""
+    env = dict(cli_env)
+    env["XLA_FLAGS"] = ""  # passthrough must provide the device count
+    _write_events_file(tmp_path / "events.json")
+    run_pio(["app", "new", "ptapp"], env)
+    run_pio(["import", "--appid", "1", "--input",
+             str(tmp_path / "events.json")], env)
+    eng = tmp_path / "eng"
+    eng.mkdir()
+    (eng / "engine.json").write_text(json.dumps({
+        "id": "pt", "version": "1",
+        "engineFactory": "incubator_predictionio_tpu.models."
+                         "recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "ptapp"}},
+        "algorithms": [{"name": "als",
+                        "params": {"rank": 4, "numIterations": 2,
+                                   "lambda": 0.05}}],
+    }))
+    r = run_pio(["train", "--engine-dir", str(eng), "--",
+                 "--mesh=4x2",
+                 "--xla_force_host_platform_device_count=8"], env)
+    assert "Training completed" in r.stdout
+
+    # unknown passthrough flags are rejected with a clear error
+    r = run_pio(["train", "--engine-dir", str(eng), "--",
+                 "--definitely-not-a-flag"], env, check=False)
+    assert r.returncode != 0
+    assert "runtime passthrough" in (r.stdout + r.stderr)
+
+
+def test_mesh_shape_env_parses():
+    from incubator_predictionio_tpu.parallel.mesh import _mesh_shape_from_env
+
+    os.environ.pop("PIO_MESH_SHAPE", None)
+    assert _mesh_shape_from_env() is None
+    os.environ["PIO_MESH_SHAPE"] = "8"
+    try:
+        assert _mesh_shape_from_env() == (8,)
+        os.environ["PIO_MESH_SHAPE"] = "4x2"
+        assert _mesh_shape_from_env() == (4, 2)
+        os.environ["PIO_MESH_SHAPE"] = "bogus"
+        with pytest.raises(ValueError):
+            _mesh_shape_from_env()
+    finally:
+        os.environ.pop("PIO_MESH_SHAPE", None)

@@ -44,8 +44,19 @@ def test_evaluation_workflow_end_to_end(memory_storage):
     with ServerThread(Dashboard(memory_storage).app) as st:
         html = requests.get(st.base + "/").text
         assert "RecommendationEvaluation" in html
+        # leaderboard shows the metric, the score, and the winning params
+        # JSON ready to paste into engine.json (the reference dashboard's
+        # actual value)
+        assert "HitRate@10" in html
+        assert f"{result.best_score:.6g}" in html
+        assert "engine.json params" in html
+        assert "algorithms" in html  # best params JSON rendered
         listing = requests.get(st.base + "/instances.json").json()
         assert listing[0]["id"] == iid
+        assert listing[0]["metricHeader"] == "HitRate@10"
+        assert listing[0]["bestScore"] == result.best_score
+        assert listing[0]["candidates"] == 4
+        assert listing[0]["bestEngineParams"]["algorithms"]
         detail = requests.get(f"{st.base}/instances/{iid}.json").json()
         assert detail["results"]["metricHeader"] == "HitRate@10"
         assert requests.get(st.base + "/instances/nope.json").status_code == 404
