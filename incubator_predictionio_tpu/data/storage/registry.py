@@ -25,6 +25,7 @@ import threading
 from typing import Callable, Optional
 
 from . import base
+from .elasticsearch import ESClient
 from .http_backend import HTTPStorageClient
 from .jsonl import JSONLClient
 from .localfs import LocalFSClient
@@ -49,12 +50,16 @@ _BACKENDS: dict[str, Callable[[base.StorageClientConfig], base.BaseStorageClient
     # the reference's storage/s3 assembly (s3.py); works against AWS
     # S3 / MinIO / any S3-compatible store.
     "S3": S3Client,
+    # Real Elasticsearch REST protocol — metadata + eventdata, like the
+    # reference's storage/elasticsearch assembly (elasticsearch.py);
+    # works against ES 7/8 or OpenSearch.
+    "ELASTICSEARCH": ESClient,
 }
 
 # Backend types whose wire protocols belong to external services this
 # distribution does not speak natively; the registry points at the HTTP
 # backend (same deployment shape: a shared network store) if selected.
-_UNSUPPORTED = {"HBASE", "ELASTICSEARCH", "PGSQL", "MYSQL", "JDBC", "HDFS"}
+_UNSUPPORTED = {"HBASE", "PGSQL", "MYSQL", "JDBC", "HDFS"}
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
 

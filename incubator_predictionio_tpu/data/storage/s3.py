@@ -162,6 +162,12 @@ class S3Models(base.Models):
         safe = urllib.parse.quote(model_id, safe="")
         return f"{self._ns}/pio_model_{safe}.bin"
 
+    def _legacy_key(self, model_id: str) -> Optional[str]:
+        """Pre-r3 key scheme ('/' → '_'); read fallback so blobs stored
+        before the percent-encoding change stay reachable."""
+        legacy = f"{self._ns}/pio_model_{model_id.replace('/', '_')}.bin"
+        return legacy if legacy != self._key(model_id) else None
+
     def insert(self, model: base.Model) -> None:
         status, body = self._t.request("PUT", self._key(model.id),
                                        model.models)
@@ -173,6 +179,11 @@ class S3Models(base.Models):
     def get(self, model_id: str) -> Optional[base.Model]:
         status, body = self._t.request("GET", self._key(model_id))
         if status == 404:
+            legacy = self._legacy_key(model_id)
+            if legacy is not None:
+                status, body = self._t.request("GET", legacy)
+                if status == 200:
+                    return base.Model(model_id, body)
             return None
         if status != 200:
             raise S3StorageError(

@@ -393,6 +393,41 @@ def _mesh_dims(mesh: Mesh) -> tuple[int, int]:
     return mesh.shape[DATA_AXIS], mesh.shape.get(MODEL_AXIS, 1)
 
 
+def _plan_signature(plan: LayoutPlan) -> tuple:
+    """Everything _make_train_fn bakes into the executable for one side."""
+    return (
+        tuple(int(x) for x in plan.lengths),
+        tuple(int(x) for x in plan.bucket_rows),
+        plan.rows_per_shard, plan.n_shards, plan.v_rows_per_shard,
+        plan.overflow_len, plan.total_slots,
+    )
+
+
+_train_fn_cache: dict = {}
+
+
+def _cached_train_fn(mesh: Mesh, params: ALSParams, plan_u: LayoutPlan,
+                     plan_i: LayoutPlan):
+    """Reuse the jitted loop across train calls with identical mesh /
+    params / layout shapes: jax's jit cache keys on the CALLABLE, so a
+    fresh _make_train_fn closure per `pio train` would recompile the
+    whole program (~3-6s) even for back-to-back trains on the same data
+    shapes (repeat trains, eval sweeps, serving reload-retrain loops)."""
+    key = (
+        tuple(id(d) for d in mesh.devices.flat), mesh.axis_names,
+        dataclasses.astuple(params)[:len(dataclasses.fields(params))],
+        _plan_signature(plan_u), _plan_signature(plan_i),
+        jax.process_count(),
+    )
+    hit = _train_fn_cache.get(key)
+    if hit is None:
+        hit = _make_train_fn(mesh, params, plan_u, plan_i)
+        if len(_train_fn_cache) > 8:  # bound: old layouts just recompile
+            _train_fn_cache.clear()
+        _train_fn_cache[key] = hit
+    return hit
+
+
 def _fresh_init(params: ALSParams, plan_u: LayoutPlan, plan_i: LayoutPlan,
                 n_users: int, n_items: int):
     """MLlib-style init (scaled standard normal), drawn in GLOBAL row
@@ -514,7 +549,7 @@ def train_als(
 
     if x0 is None:
         x0, y0 = _fresh_init(params, plan_u, plan_i, n_users, n_items)
-    fn, in_shardings = _make_train_fn(mesh, params, plan_u, plan_i)
+    fn, in_shardings = _cached_train_fn(mesh, params, plan_u, plan_i)
     flat = tuple(_side_flat(arrs_u, plan_u, _host_lam(plan_u, params))
                  + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params)))
     if jax.process_count() > 1:
@@ -692,7 +727,7 @@ def train_als_process_sharded(
                           sentinel=plan_u.total_slots,
                           shard0=shard0, n_local_shards=n_local)
 
-    fn, in_shardings = _make_train_fn(mesh, params, plan_u, plan_i)
+    fn, in_shardings = _cached_train_fn(mesh, params, plan_u, plan_i)
     flat_local = (_side_flat(arrs_u, plan_u, _host_lam(plan_u, params))
                   + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params)))
 

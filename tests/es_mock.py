@@ -1,0 +1,203 @@
+"""In-process Elasticsearch-compatible server for contract tests.
+
+Implements the REST subset the ELASTICSEARCH backend speaks — index
+create/delete, `_doc` CRUD with `_version`/`_seq_no` semantics, `_bulk`
+NDJSON, and `_search` with bool/term/terms/range filters, field +
+`_seq_no` sorts, `search_after` pagination, and `size` — with real ES
+semantics for the parts that matter to the contract:
+
+- re-indexing a doc id bumps the index-wide `_seq_no` (sort/tie order)
+  and the per-doc `_version` (the ESSequences id-generation trick),
+- `?refresh=true` is accepted (all writes here are immediately visible),
+- errors use ES-style JSON (`resource_already_exists_exception`, 404s).
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+
+class _Index:
+    def __init__(self):
+        self.docs: dict[str, dict] = {}      # id → {"_source","_seq_no","_version"}
+        self.seq = 0
+
+
+def build_es_app():
+    indices: dict[str, _Index] = {}
+
+    def es_json(status, payload):
+        return web.json_response(payload, status=status)
+
+    # -- query evaluation -------------------------------------------------
+    def match(doc_source, query) -> bool:
+        if not query or "match_all" in query:
+            return True
+        if "bool" in query:
+            return all(match(doc_source, f)
+                       for f in query["bool"].get("filter", []))
+        if "term" in query:
+            ((field, value),) = query["term"].items()
+            if isinstance(value, dict):
+                value = value.get("value")
+            return doc_source.get(field) == value
+        if "terms" in query:
+            ((field, values),) = query["terms"].items()
+            return doc_source.get(field) in values
+        if "range" in query:
+            ((field, spec),) = query["range"].items()
+            v = doc_source.get(field)
+            if v is None:
+                return False
+            if "gte" in spec and not v >= spec["gte"]:
+                return False
+            if "gt" in spec and not v > spec["gt"]:
+                return False
+            if "lte" in spec and not v <= spec["lte"]:
+                return False
+            if "lt" in spec and not v < spec["lt"]:
+                return False
+            return True
+        raise web.HTTPBadRequest(text=f"unsupported query {query}")
+
+    def sort_key(sort_spec, doc):
+        keys = []
+        for clause in sort_spec:
+            ((field, opts),) = clause.items() if isinstance(clause, dict) \
+                else ((clause, {}),)
+            order = (opts or {}).get("order", "asc") if isinstance(opts, dict) \
+                else "asc"
+            v = doc["_seq_no"] if field == "_seq_no" \
+                else doc["_source"].get(field)
+            keys.append((v, order))
+        return keys
+
+    def cmp_keys(a, b):
+        for (va, orda), (vb, _) in zip(a, b):
+            if va == vb:
+                continue
+            lt = va < vb
+            return -1 if (lt if orda == "asc" else not lt) else 1
+        return 0
+
+    # -- handlers ---------------------------------------------------------
+    async def handle_index_put(request):
+        name = request.match_info["index"]
+        if name in indices:
+            return es_json(400, {"error": {
+                "type": "resource_already_exists_exception"}})
+        indices[name] = _Index()
+        return es_json(200, {"acknowledged": True, "index": name})
+
+    async def handle_index_delete(request):
+        name = request.match_info["index"]
+        if indices.pop(name, None) is None:
+            return es_json(404, {"error": {"type": "index_not_found_exception"}})
+        return es_json(200, {"acknowledged": True})
+
+    def _put_doc(index_name, doc_id, source):
+        idx = indices.setdefault(index_name, _Index())
+        idx.seq += 1
+        prev = idx.docs.get(doc_id)
+        version = (prev["_version"] + 1) if prev else 1
+        idx.docs[doc_id] = {"_source": source, "_seq_no": idx.seq,
+                            "_version": version}
+        return version, idx.seq
+
+    async def handle_doc_put(request):
+        source = await request.json()
+        version, seq = _put_doc(request.match_info["index"],
+                                request.match_info["id"], source)
+        return es_json(200 if version > 1 else 201, {
+            "_index": request.match_info["index"],
+            "_id": request.match_info["id"],
+            "_version": version, "_seq_no": seq,
+            "result": "updated" if version > 1 else "created",
+        })
+
+    async def handle_doc_get(request):
+        idx = indices.get(request.match_info["index"])
+        doc = idx.docs.get(request.match_info["id"]) if idx else None
+        if doc is None:
+            return es_json(404, {"found": False})
+        return es_json(200, {"_id": request.match_info["id"], "found": True,
+                             "_source": doc["_source"],
+                             "_version": doc["_version"]})
+
+    async def handle_doc_delete(request):
+        idx = indices.get(request.match_info["index"])
+        if idx is None or idx.docs.pop(request.match_info["id"], None) is None:
+            return es_json(404, {"result": "not_found"})
+        return es_json(200, {"result": "deleted"})
+
+    async def handle_bulk(request):
+        lines = [ln for ln in (await request.text()).split("\n") if ln.strip()]
+        items = []
+        i = 0
+        while i < len(lines):
+            action = json.loads(lines[i])
+            if "index" not in action:
+                return es_json(400, {"error": "only index actions supported"})
+            meta = action["index"]
+            source = json.loads(lines[i + 1])
+            version, seq = _put_doc(meta["_index"], meta["_id"], source)
+            items.append({"index": {"_id": meta["_id"], "status": 200,
+                                    "_version": version, "_seq_no": seq}})
+            i += 2
+        return es_json(200, {"errors": False, "items": items})
+
+    async def handle_search(request):
+        import functools
+
+        idx = indices.get(request.match_info["index"])
+        if idx is None:
+            return es_json(404, {"error": {"type": "index_not_found_exception"}})
+        body = await request.json() if request.can_read_body else {}
+        query = body.get("query", {"match_all": {}})
+        sort_spec = body.get("sort")
+        size = int(body.get("size", 10))
+        after = body.get("search_after")
+
+        hits = [
+            {"_id": doc_id, "_source": d["_source"], "_seq_no": d["_seq_no"]}
+            for doc_id, d in idx.docs.items()
+            if match(d["_source"], query)
+        ]
+        if sort_spec:
+            keyed = [(sort_key(sort_spec, h), h) for h in hits]
+            keyed.sort(key=functools.cmp_to_key(
+                lambda a, b: cmp_keys(a[0], b[0])))
+            if after is not None:
+                after_keys = [(v, k[1]) for v, k in zip(after,
+                              keyed[0][0] if keyed else [])]
+                # compare against the raw after values with each
+                # clause's declared order
+                def after_cmp(k):
+                    ak = [(av, ko[1]) for av, ko in zip(after, k)]
+                    return cmp_keys(k, ak)
+                keyed = [kh for kh in keyed if after_cmp(kh[0]) > 0]
+            out = []
+            for keys, h in keyed[:size]:
+                h = dict(h)
+                h["sort"] = [v for v, _ in keys]
+                out.append(h)
+            hits = out
+        else:
+            hits = hits[:size]
+        return es_json(200, {"hits": {"hits": hits,
+                                      "total": {"value": len(hits)}}})
+
+    app = web.Application()
+    app.add_routes([
+        web.put("/{index}", handle_index_put),
+        web.delete("/{index}", handle_index_delete),
+        web.put("/{index}/_doc/{id}", handle_doc_put),
+        web.get("/{index}/_doc/{id}", handle_doc_get),
+        web.delete("/{index}/_doc/{id}", handle_doc_delete),
+        web.post("/_bulk", handle_bulk),
+        web.post("/{index}/_search", handle_search),
+    ])
+    app["indices"] = indices
+    return app

@@ -58,11 +58,35 @@ def _make_storage(kind, tmp_path):
     return Storage(env)
 
 
-BACKENDS = ["memory", "sqlite", "mixed", "jsonl", "http", "s3"]
+BACKENDS = ["memory", "sqlite", "mixed", "jsonl", "http", "s3",
+            "elasticsearch"]
 
 
 @pytest.fixture(params=BACKENDS)
 def storage(request, tmp_path):
+    if request.param == "elasticsearch":
+        # Metadata + events on an Elasticsearch-compatible store over the
+        # REAL ES REST protocol (index/doc CRUD, _bulk NDJSON, _search
+        # DSL with search_after, the ESSequences _version trick) — the
+        # reference's ES assembly scope; models ride sqlite.
+        from es_mock import build_es_app
+        from server_utils import ServerThread
+
+        with ServerThread(build_es_app()) as srv:
+            env = {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "ES",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "ES",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+                "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+                "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "esmeta.sqlite"),
+                "PIO_STORAGE_SOURCES_ES_TYPE": "ELASTICSEARCH",
+                "PIO_STORAGE_SOURCES_ES_HOSTS": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_ES_PORTS": str(srv.port),
+            }
+            s = Storage(env)
+            yield s
+            s.close()
+        return
     if request.param == "s3":
         # Model blobs on an S3-compatible object store over the REAL S3
         # REST protocol: the in-process server INDEPENDENTLY re-derives
