@@ -1,0 +1,302 @@
+"""PostgreSQL wire-protocol (v3) client — no driver dependency.
+
+The reference's JDBC backend reaches Postgres/MySQL through scalikejdbc
+(SURVEY.md §2.1 storage/jdbc). This sandbox has no psycopg, so the
+PGSQL backend (postgres.py) speaks the frontend/backend protocol
+directly: startup, password authentication (cleartext, MD5, and
+SCRAM-SHA-256 per RFC 5802/7677), and the EXTENDED query protocol
+(Parse/Bind/Execute/Sync) — parameters travel out-of-band in text
+format, so there is no SQL string interpolation anywhere.
+
+Scope: synchronous, text-format results, one connection per client
+(the storage layer serializes DAO calls). TLS is out of scope in-repo;
+deployments front Postgres with stunnel/pgbouncer or a local socket.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import socket
+import struct
+import threading
+from typing import Optional, Sequence
+
+
+class PGError(RuntimeError):
+    """Server-reported error (severity, code, message)."""
+
+    def __init__(self, fields: dict):
+        self.fields = fields
+        super().__init__(
+            f"{fields.get('S', 'ERROR')} {fields.get('C', '')}: "
+            f"{fields.get('M', 'unknown error')}")
+
+    @property
+    def sqlstate(self) -> str:
+        return self.fields.get("C", "")
+
+
+class PGProtocolError(RuntimeError):
+    pass
+
+
+def _md5_password(user: str, password: str, salt: bytes) -> str:
+    inner = hashlib.md5(password.encode() + user.encode()).hexdigest()
+    return "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+
+
+class _Scram:
+    """Client side of SCRAM-SHA-256 (RFC 5802 / RFC 7677)."""
+
+    def __init__(self, user: str, password: str):
+        self.password = password.encode()
+        self.nonce = base64.b64encode(os.urandom(18)).decode()
+        # Postgres ignores the SCRAM username (uses the startup user)
+        self.client_first_bare = f"n=,r={self.nonce}"
+
+    def first_message(self) -> bytes:
+        return ("n,," + self.client_first_bare).encode()
+
+    def final_message(self, server_first: bytes) -> bytes:
+        attrs = dict(kv.split("=", 1)
+                     for kv in server_first.decode().split(","))
+        server_nonce, salt_b64, iters = attrs["r"], attrs["s"], int(attrs["i"])
+        if not server_nonce.startswith(self.nonce):
+            raise PGProtocolError("SCRAM server nonce mismatch")
+        salted = hashlib.pbkdf2_hmac(
+            "sha256", self.password, base64.b64decode(salt_b64), iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        without_proof = f"c=biws,r={server_nonce}"
+        auth_message = ",".join([
+            self.client_first_bare, server_first.decode(), without_proof,
+        ]).encode()
+        client_sig = hmac.new(stored_key, auth_message,
+                              hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        self._server_sig = hmac.new(server_key, auth_message,
+                                    hashlib.sha256).digest()
+        return (without_proof
+                + ",p=" + base64.b64encode(proof).decode()).encode()
+
+    def verify_final(self, server_final: bytes) -> None:
+        attrs = dict(kv.split("=", 1)
+                     for kv in server_final.decode().split(","))
+        if base64.b64decode(attrs.get("v", "")) != self._server_sig:
+            raise PGProtocolError(
+                "SCRAM server signature mismatch (server does not know "
+                "the password — possible MITM)")
+
+
+class PGConnection:
+    """One protocol-v3 connection; ``query`` is thread-safe (lock)."""
+
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 database: str, timeout: float = 30.0,
+                 connect_timeout: float = 10.0):
+        self._lock = threading.RLock()
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(timeout)
+        self._buf = b""
+        self._broken = False
+        self.user = user
+        self._startup(user, password, database)
+
+    # -- low-level framing -------------------------------------------------
+    def _send(self, type_byte: bytes, payload: bytes) -> None:
+        self._sock.sendall(type_byte + struct.pack("!I", len(payload) + 4)
+                           + payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise PGProtocolError("server closed the connection")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _recv_message(self) -> tuple[bytes, bytes]:
+        head = self._recv_exact(5)
+        mtype = head[:1]
+        length = struct.unpack("!I", head[1:])[0]
+        return mtype, self._recv_exact(length - 4)
+
+    @staticmethod
+    def _cstr(s: str) -> bytes:
+        return s.encode() + b"\x00"
+
+    @staticmethod
+    def _parse_error(payload: bytes) -> PGError:
+        fields = {}
+        for part in payload.split(b"\x00"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode(errors="replace")
+        return PGError(fields)
+
+    # -- startup + auth ------------------------------------------------------
+    def _startup(self, user: str, password: str, database: str) -> None:
+        params = (self._cstr("user") + self._cstr(user)
+                  + self._cstr("database") + self._cstr(database)
+                  + self._cstr("client_encoding") + self._cstr("UTF8")
+                  + b"\x00")
+        body = struct.pack("!I", 196608) + params  # protocol 3.0
+        self._sock.sendall(struct.pack("!I", len(body) + 4) + body)
+
+        scram: Optional[_Scram] = None
+        while True:
+            mtype, payload = self._recv_message()
+            if mtype == b"E":
+                raise self._parse_error(payload)
+            if mtype == b"R":
+                code = struct.unpack("!I", payload[:4])[0]
+                if code == 0:  # AuthenticationOk
+                    continue
+                if code == 3:  # CleartextPassword
+                    self._send(b"p", self._cstr(password))
+                elif code == 5:  # MD5Password
+                    self._send(b"p", self._cstr(
+                        _md5_password(user, password, payload[4:8])))
+                elif code == 10:  # SASL: mechanism list
+                    mechs = payload[4:].split(b"\x00")
+                    if b"SCRAM-SHA-256" not in mechs:
+                        raise PGProtocolError(
+                            f"no supported SASL mechanism in {mechs}")
+                    scram = _Scram(user, password)
+                    first = scram.first_message()
+                    self._send(b"p", self._cstr("SCRAM-SHA-256")
+                               + struct.pack("!I", len(first)) + first)
+                elif code == 11:  # SASLContinue
+                    assert scram is not None
+                    self._send(b"p", scram.final_message(payload[4:]))
+                elif code == 12:  # SASLFinal
+                    assert scram is not None
+                    scram.verify_final(payload[4:])
+                else:
+                    raise PGProtocolError(
+                        f"unsupported authentication method {code}")
+            elif mtype in (b"S", b"K", b"N"):  # ParameterStatus/BackendKey/Notice
+                continue
+            elif mtype == b"Z":  # ReadyForQuery
+                return
+            else:
+                raise PGProtocolError(f"unexpected message {mtype!r} in startup")
+
+    # -- extended query ------------------------------------------------------
+    def query(self, sql: str, params: Sequence = ()) -> tuple[list[str], list[list]]:
+        """Parse/Bind/Execute one statement with TEXT-format parameters.
+        Returns (column_names, rows) — rows hold str or None (bytes for
+        bytea columns, decoded by type OID from the RowDescription).
+        Parameters: None → NULL, bytes → bytea hex, everything else →
+        str(). A transport/protocol failure poisons the connection (the
+        stream may hold half a message; continuing would misparse)."""
+        with self._lock:
+            if self._broken:
+                raise PGProtocolError(
+                    "connection is broken by an earlier transport error — "
+                    "create a new PGConnection")
+            try:
+                return self._query_locked(sql, params)
+            except (OSError, PGProtocolError):
+                self._broken = True
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise
+
+    def _query_locked(self, sql, params):
+        # Parse (unnamed statement), Bind (unnamed portal), Execute, Sync
+        self._send(b"P", self._cstr("") + self._cstr(sql)
+                   + struct.pack("!H", 0))
+        bind = self._cstr("") + self._cstr("")
+        bind += struct.pack("!H", 0)  # all params in text format
+        bind += struct.pack("!H", len(params))
+        for p in params:
+            if p is None:
+                bind += struct.pack("!i", -1)
+            else:
+                if isinstance(p, bytes):
+                    text = "\\x" + p.hex()
+                elif isinstance(p, bool):
+                    text = "t" if p else "f"
+                else:
+                    text = str(p)
+                raw = text.encode()
+                bind += struct.pack("!i", len(raw)) + raw
+        bind += struct.pack("!H", 0)  # all results in text format
+        self._send(b"B", bind)
+        self._send(b"D", b"P" + self._cstr(""))  # Describe portal
+        self._send(b"E", self._cstr("") + struct.pack("!i", 0))
+        self._send(b"S", b"")
+
+        columns: list[str] = []
+        type_oids: list[int] = []
+        rows: list[list] = []
+        error: Optional[PGError] = None
+        BYTEA_OID = 17
+        while True:
+            mtype, payload = self._recv_message()
+            if mtype == b"E":
+                error = self._parse_error(payload)
+            elif mtype == b"T":  # RowDescription
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                for _ in range(n):
+                    end = payload.index(b"\x00", off)
+                    columns.append(payload[off:end].decode())
+                    # fixed metadata: tableOID(4) attnum(2) typeOID(4)
+                    # typlen(2) typmod(4) fmt(2)
+                    (type_oid,) = struct.unpack(
+                        "!I", payload[end + 7:end + 11])
+                    type_oids.append(type_oid)
+                    off = end + 1 + 18
+            elif mtype == b"D":  # DataRow
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row = []
+                for j in range(n):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        text = payload[off:off + ln].decode()
+                        off += ln
+                        # decode by declared column type, NOT by sniffing
+                        # the text — a TEXT value may legitimately start
+                        # with "\\x"
+                        if (j < len(type_oids)
+                                and type_oids[j] == BYTEA_OID
+                                and text.startswith("\\x")):
+                            row.append(bytes.fromhex(text[2:]))
+                        else:
+                            row.append(text)
+                rows.append(row)
+            elif mtype == b"Z":  # ReadyForQuery — the transaction boundary
+                if error is not None:
+                    raise error
+                return columns, rows
+            elif mtype in (b"1", b"2", b"C", b"n", b"N", b"s", b"S", b"K",
+                           b"t", b"I"):
+                # ParseComplete/BindComplete/CommandComplete/NoData/Notice/
+                # PortalSuspended/ParameterStatus/ParameterDescription/
+                # EmptyQuery — nothing to do
+                continue
+            else:
+                raise PGProtocolError(f"unexpected message {mtype!r}")
+
+    def close(self) -> None:
+        try:
+            self._send(b"X", b"")
+        except Exception:  # noqa: BLE001 - best-effort terminate
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

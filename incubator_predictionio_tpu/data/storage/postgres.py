@@ -1,0 +1,617 @@
+"""PostgreSQL backend — the `PGSQL` source type (all three repositories).
+
+Reference: storage/jdbc/.../{JDBCLEvents,JDBCPEvents,JDBCModels,JDBCApps,
+JDBCAccessKeys,JDBCChannels,JDBCEngineInstances,JDBCEvaluationInstances,
+JDBCUtils} (SURVEY.md §2.1): a full alternative backend on a network SQL
+database. No SQL driver ships in this distribution, so the connection is
+data/storage/pgwire.py — the Postgres wire protocol spoken directly
+(extended query protocol: parameters never interpolate into SQL text).
+
+    PIO_STORAGE_SOURCES_PG_TYPE=PGSQL
+    PIO_STORAGE_SOURCES_PG_HOST=db-host      PORT=5432
+    PIO_STORAGE_SOURCES_PG_USERNAME=pio      PASSWORD=...
+    PIO_STORAGE_SOURCES_PG_DATABASE=pio
+
+Schema notes: event/metadata times are stored as BIGINT epoch
+microseconds (UTC), events keep their full wire JSON alongside the
+filterable columns, and the cross-backend event tie-order contract rides
+a monotone ``seq`` column — an upsert is one atomic INSERT ... ON
+CONFLICT DO UPDATE that assigns a fresh seq, moving the event to the END
+of its equal-timestamp group like every other backend. Generated ids use
+MAX(id)+1 inside the insert statement; metadata writes are low-rate and
+the storage layer serializes per-process access (the reference's
+JDBCUtils generated keys carry the same caveat).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+from typing import Iterable, Iterator, Optional, Sequence
+
+from . import base
+from .event import Event, new_event_id
+from .pgwire import PGConnection, PGError
+from .sqlite import _safe_ident
+
+
+def _time_us(t: _dt.datetime) -> int:
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int(t.timestamp() * 1_000_000)
+
+
+def _from_us(us) -> Optional[_dt.datetime]:
+    if us is None:
+        return None
+    return _dt.datetime.fromtimestamp(int(us) / 1_000_000, _dt.timezone.utc)
+
+
+class PGLEvents(base.LEvents):
+    def __init__(self, conn: PGConnection, namespace: str):
+        self._c = conn
+        self._t = f"{_safe_ident(namespace)}_events".lower()
+        self._ensure()
+
+    def _ensure(self):
+        self._c.query(
+            f"CREATE TABLE IF NOT EXISTS {self._t} ("
+            "  appid BIGINT NOT NULL,"
+            "  channelid BIGINT NOT NULL,"
+            "  eventid TEXT NOT NULL,"
+            "  seq BIGINT NOT NULL,"
+            "  event TEXT NOT NULL,"
+            "  entitytype TEXT NOT NULL,"
+            "  entityid TEXT NOT NULL,"
+            "  targetentitytype TEXT,"
+            "  targetentityid TEXT,"
+            "  eventtimeus BIGINT NOT NULL,"
+            "  eventjson TEXT NOT NULL,"
+            "  PRIMARY KEY (appid, channelid, eventid))")
+        self._c.query(
+            f"CREATE INDEX IF NOT EXISTS {self._t}_time "
+            f"ON {self._t} (appid, channelid, eventtimeus, seq)")
+
+    @staticmethod
+    def _chan(channel_id: Optional[int]) -> int:
+        return int(channel_id) if channel_id is not None else 0
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._ensure()
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._c.query(
+            f"DELETE FROM {self._t} WHERE appid=$1 AND channelid=$2",
+            (app_id, self._chan(channel_id)))
+        return True
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        eid = event.event_id or new_event_id()
+        stored = event.with_event_id(eid)
+        chan = self._chan(channel_id)
+        # Atomic upsert: the fresh seq moves the event to the END of its
+        # equal-timestamp tie group (cross-backend contract). One
+        # statement, so a crash never loses the event and a concurrent
+        # duplicate id upserts instead of erroring. (The MAX(seq)+1 read
+        # can still collide across CONCURRENT writers — ties between two
+        # simultaneously-inserted events are then unordered, which the
+        # contract leaves unspecified anyway.)
+        self._c.query(
+            f"INSERT INTO {self._t} (appid, channelid, eventid, seq, event,"
+            " entitytype, entityid, targetentitytype, targetentityid,"
+            " eventtimeus, eventjson) VALUES ($1,$2,$3,"
+            f" (SELECT COALESCE(MAX(seq),0)+1 FROM {self._t}),"
+            " $4,$5,$6,$7,$8,$9,$10)"
+            " ON CONFLICT (appid, channelid, eventid) DO UPDATE SET"
+            " seq=excluded.seq, event=excluded.event,"
+            " entitytype=excluded.entitytype, entityid=excluded.entityid,"
+            " targetentitytype=excluded.targetentitytype,"
+            " targetentityid=excluded.targetentityid,"
+            " eventtimeus=excluded.eventtimeus, eventjson=excluded.eventjson",
+            (app_id, chan, eid, stored.event, stored.entity_type,
+             stored.entity_id, stored.target_entity_type,
+             stored.target_entity_id, _time_us(stored.event_time),
+             json.dumps(stored.to_json())))
+        return eid
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        _, rows = self._c.query(
+            f"SELECT eventjson FROM {self._t} "
+            "WHERE appid=$1 AND channelid=$2 AND eventid=$3",
+            (app_id, self._chan(channel_id), event_id))
+        if not rows:
+            return None
+        return Event.from_json(json.loads(rows[0][0]))
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        _, rows = self._c.query(
+            f"DELETE FROM {self._t} "
+            "WHERE appid=$1 AND channelid=$2 AND eventid=$3 "
+            "RETURNING eventid",
+            (app_id, self._chan(channel_id), event_id))
+        return bool(rows)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]:
+        where = ["appid=$1", "channelid=$2"]
+        params: list = [app_id, self._chan(channel_id)]
+
+        def arg(v):
+            params.append(v)
+            return f"${len(params)}"
+
+        if start_time is not None:
+            where.append(f"eventtimeus >= {arg(_time_us(start_time))}")
+        if until_time is not None:
+            where.append(f"eventtimeus < {arg(_time_us(until_time))}")
+        if entity_type is not None:
+            where.append(f"entitytype = {arg(entity_type)}")
+        if entity_id is not None:
+            where.append(f"entityid = {arg(entity_id)}")
+        if target_entity_type is not None:
+            where.append(f"targetentitytype = {arg(target_entity_type)}")
+        if target_entity_id is not None:
+            where.append(f"targetentityid = {arg(target_entity_id)}")
+        if event_names is not None:
+            if not list(event_names):
+                return iter(())
+            slots = ",".join(arg(n) for n in event_names)
+            where.append(f"event IN ({slots})")
+        order = "DESC" if reversed_order else "ASC"
+        sql = (f"SELECT eventjson FROM {self._t} WHERE "
+               + " AND ".join(where)
+               + f" ORDER BY eventtimeus {order}, seq ASC")
+        if limit is not None and limit >= 0:
+            sql += f" LIMIT {arg(int(limit))}"
+        _, rows = self._c.query(sql, params)
+        return (Event.from_json(json.loads(r[0])) for r in rows)
+
+
+class PGPEvents(base.PEvents):
+    def __init__(self, l_events: PGLEvents):
+        self._l = l_events
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None) -> Iterator[Event]:
+        return self._l.find(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id,
+        )
+
+    def write(self, events: Iterable[Event], app_id: int,
+              channel_id: Optional[int] = None) -> None:
+        for e in events:
+            self._l.insert(e, app_id, channel_id)
+
+    def delete(self, event_ids: Iterable[str], app_id: int,
+               channel_id: Optional[int] = None) -> None:
+        for eid in event_ids:
+            self._l.delete(eid, app_id, channel_id)
+
+
+class PGApps(base.Apps):
+    def __init__(self, conn: PGConnection, namespace: str):
+        self._c = conn
+        self._t = f"{_safe_ident(namespace)}_apps".lower()
+        conn.query(
+            f"CREATE TABLE IF NOT EXISTS {self._t} ("
+            "id BIGINT PRIMARY KEY, name TEXT NOT NULL UNIQUE,"
+            " description TEXT)")
+
+    def insert(self, app: base.App) -> Optional[int]:
+        if self.get_by_name(app.name) is not None:
+            return None
+        try:
+            if app.id > 0:
+                _, rows = self._c.query(
+                    f"INSERT INTO {self._t} (id, name, description) "
+                    "VALUES ($1,$2,$3) RETURNING id",
+                    (app.id, app.name, app.description))
+            else:
+                _, rows = self._c.query(
+                    f"INSERT INTO {self._t} (id, name, description) VALUES "
+                    f"((SELECT COALESCE(MAX(id),0)+1 FROM {self._t}),"
+                    "$1,$2) RETURNING id",
+                    (app.name, app.description))
+        except PGError as e:
+            if e.sqlstate == "23505":  # unique_violation
+                return None
+            raise
+        return int(rows[0][0])
+
+    def _row(self, r) -> base.App:
+        return base.App(int(r[0]), r[1], r[2])
+
+    def get(self, app_id: int) -> Optional[base.App]:
+        _, rows = self._c.query(
+            f"SELECT id, name, description FROM {self._t} WHERE id=$1",
+            (app_id,))
+        return self._row(rows[0]) if rows else None
+
+    def get_by_name(self, name: str) -> Optional[base.App]:
+        _, rows = self._c.query(
+            f"SELECT id, name, description FROM {self._t} WHERE name=$1",
+            (name,))
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self) -> list[base.App]:
+        _, rows = self._c.query(
+            f"SELECT id, name, description FROM {self._t} ORDER BY id")
+        return [self._row(r) for r in rows]
+
+    def update(self, app: base.App) -> None:
+        self._c.query(
+            f"UPDATE {self._t} SET name=$1, description=$2 WHERE id=$3",
+            (app.name, app.description, app.id))
+
+    def delete(self, app_id: int) -> None:
+        self._c.query(f"DELETE FROM {self._t} WHERE id=$1", (app_id,))
+
+
+class PGAccessKeys(base.AccessKeys):
+    def __init__(self, conn: PGConnection, namespace: str):
+        self._c = conn
+        self._t = f"{_safe_ident(namespace)}_accesskeys".lower()
+        conn.query(
+            f"CREATE TABLE IF NOT EXISTS {self._t} ("
+            "accesskey TEXT PRIMARY KEY, appid BIGINT NOT NULL, events TEXT)")
+
+    def insert(self, k: base.AccessKey) -> Optional[str]:
+        import secrets
+
+        key = k.key or secrets.token_urlsafe(48)
+        try:
+            self._c.query(
+                f"INSERT INTO {self._t} (accesskey, appid, events) "
+                "VALUES ($1,$2,$3)",
+                (key, k.appid, json.dumps(list(k.events))))
+        except PGError as e:
+            if e.sqlstate == "23505":
+                return None
+            raise
+        return key
+
+    def _row(self, r) -> base.AccessKey:
+        return base.AccessKey(r[0], int(r[1]),
+                              tuple(json.loads(r[2]) if r[2] else ()))
+
+    def get(self, key: str) -> Optional[base.AccessKey]:
+        _, rows = self._c.query(
+            f"SELECT accesskey, appid, events FROM {self._t} "
+            "WHERE accesskey=$1", (key,))
+        return self._row(rows[0]) if rows else None
+
+    def get_all(self) -> list[base.AccessKey]:
+        _, rows = self._c.query(
+            f"SELECT accesskey, appid, events FROM {self._t}")
+        return [self._row(r) for r in rows]
+
+    def get_by_appid(self, appid: int) -> list[base.AccessKey]:
+        _, rows = self._c.query(
+            f"SELECT accesskey, appid, events FROM {self._t} WHERE appid=$1",
+            (appid,))
+        return [self._row(r) for r in rows]
+
+    def update(self, k: base.AccessKey) -> None:
+        self._c.query(
+            f"UPDATE {self._t} SET appid=$1, events=$2 WHERE accesskey=$3",
+            (k.appid, json.dumps(list(k.events)), k.key))
+
+    def delete(self, key: str) -> None:
+        self._c.query(f"DELETE FROM {self._t} WHERE accesskey=$1", (key,))
+
+
+class PGChannels(base.Channels):
+    def __init__(self, conn: PGConnection, namespace: str):
+        self._c = conn
+        self._t = f"{_safe_ident(namespace)}_channels".lower()
+        conn.query(
+            f"CREATE TABLE IF NOT EXISTS {self._t} ("
+            "id BIGINT PRIMARY KEY, name TEXT NOT NULL, appid BIGINT NOT NULL)")
+
+    def insert(self, channel: base.Channel) -> Optional[int]:
+        if not base.Channel.is_valid_name(channel.name):
+            return None
+        try:
+            if channel.id > 0:
+                _, rows = self._c.query(
+                    f"INSERT INTO {self._t} (id, name, appid) "
+                    "VALUES ($1,$2,$3) RETURNING id",
+                    (channel.id, channel.name, channel.appid))
+            else:
+                _, rows = self._c.query(
+                    f"INSERT INTO {self._t} (id, name, appid) VALUES "
+                    f"((SELECT COALESCE(MAX(id),0)+1 FROM {self._t}),"
+                    "$1,$2) RETURNING id",
+                    (channel.name, channel.appid))
+        except PGError as e:
+            if e.sqlstate == "23505":
+                return None
+            raise
+        return int(rows[0][0])
+
+    def get(self, channel_id: int) -> Optional[base.Channel]:
+        _, rows = self._c.query(
+            f"SELECT id, name, appid FROM {self._t} WHERE id=$1",
+            (channel_id,))
+        return (base.Channel(int(rows[0][0]), rows[0][1], int(rows[0][2]))
+                if rows else None)
+
+    def get_by_appid(self, appid: int) -> list[base.Channel]:
+        _, rows = self._c.query(
+            f"SELECT id, name, appid FROM {self._t} WHERE appid=$1",
+            (appid,))
+        return [base.Channel(int(r[0]), r[1], int(r[2])) for r in rows]
+
+    def delete(self, channel_id: int) -> None:
+        self._c.query(f"DELETE FROM {self._t} WHERE id=$1", (channel_id,))
+
+
+class PGEngineInstances(base.EngineInstances):
+    def __init__(self, conn: PGConnection, namespace: str):
+        self._c = conn
+        self._t = f"{_safe_ident(namespace)}_engineinstances".lower()
+        conn.query(
+            f"CREATE TABLE IF NOT EXISTS {self._t} ("
+            "id TEXT PRIMARY KEY, status TEXT, starttimeus BIGINT,"
+            " engineid TEXT, engineversion TEXT, enginevariant TEXT,"
+            " doc TEXT NOT NULL)")
+
+    @staticmethod
+    def _encode(i: base.EngineInstance) -> str:
+        return json.dumps({
+            "id": i.id, "status": i.status,
+            "startTimeUs": _time_us(i.start_time) if i.start_time else None,
+            "endTimeUs": _time_us(i.end_time) if i.end_time else None,
+            "engineId": i.engine_id, "engineVersion": i.engine_version,
+            "engineVariant": i.engine_variant,
+            "engineFactory": i.engine_factory, "batch": i.batch,
+            "env": dict(i.env), "runtimeConf": dict(i.runtime_conf),
+            "dataSourceParams": i.data_source_params,
+            "preparatorParams": i.preparator_params,
+            "algorithmsParams": i.algorithms_params,
+            "servingParams": i.serving_params,
+        })
+
+    @staticmethod
+    def _decode(doc: str) -> base.EngineInstance:
+        s = json.loads(doc)
+        return base.EngineInstance(
+            id=s["id"], status=s["status"],
+            start_time=_from_us(s.get("startTimeUs")),
+            end_time=_from_us(s.get("endTimeUs")),
+            engine_id=s.get("engineId", ""),
+            engine_version=s.get("engineVersion", ""),
+            engine_variant=s.get("engineVariant", ""),
+            engine_factory=s.get("engineFactory", ""),
+            batch=s.get("batch", ""), env=s.get("env") or {},
+            runtime_conf=s.get("runtimeConf") or {},
+            data_source_params=s.get("dataSourceParams", ""),
+            preparator_params=s.get("preparatorParams", ""),
+            algorithms_params=s.get("algorithmsParams", ""),
+            serving_params=s.get("servingParams", ""),
+        )
+
+    def _put(self, iid: str, i: base.EngineInstance) -> None:
+        stored = base.EngineInstance(**{**i.__dict__, "id": iid})
+        self._c.query(
+            f"DELETE FROM {self._t} WHERE id=$1", (iid,))
+        self._c.query(
+            f"INSERT INTO {self._t} (id, status, starttimeus, engineid,"
+            " engineversion, enginevariant, doc) VALUES ($1,$2,$3,$4,$5,$6,$7)",
+            (iid, stored.status,
+             _time_us(stored.start_time) if stored.start_time else None,
+             stored.engine_id, stored.engine_version, stored.engine_variant,
+             self._encode(stored)))
+
+    def insert(self, i: base.EngineInstance) -> str:
+        import uuid
+
+        iid = i.id or uuid.uuid4().hex
+        self._put(iid, i)
+        return iid
+
+    def get(self, instance_id: str) -> Optional[base.EngineInstance]:
+        _, rows = self._c.query(
+            f"SELECT doc FROM {self._t} WHERE id=$1", (instance_id,))
+        return self._decode(rows[0][0]) if rows else None
+
+    def get_all(self) -> list[base.EngineInstance]:
+        _, rows = self._c.query(f"SELECT doc FROM {self._t}")
+        return [self._decode(r[0]) for r in rows]
+
+    def get_completed(self, engine_id, engine_version, engine_variant):
+        _, rows = self._c.query(
+            f"SELECT doc FROM {self._t} WHERE status='COMPLETED' AND "
+            "engineid=$1 AND engineversion=$2 AND enginevariant=$3 "
+            "ORDER BY starttimeus DESC",
+            (engine_id, engine_version, engine_variant))
+        return [self._decode(r[0]) for r in rows]
+
+    def get_latest_completed(self, engine_id, engine_version, engine_variant):
+        done = self.get_completed(engine_id, engine_version, engine_variant)
+        return done[0] if done else None
+
+    def update(self, i: base.EngineInstance) -> None:
+        self._put(i.id, i)
+
+    def delete(self, instance_id: str) -> None:
+        self._c.query(f"DELETE FROM {self._t} WHERE id=$1", (instance_id,))
+
+
+class PGEvaluationInstances(base.EvaluationInstances):
+    def __init__(self, conn: PGConnection, namespace: str):
+        self._c = conn
+        self._t = f"{_safe_ident(namespace)}_evaluationinstances".lower()
+        conn.query(
+            f"CREATE TABLE IF NOT EXISTS {self._t} ("
+            "id TEXT PRIMARY KEY, status TEXT, starttimeus BIGINT,"
+            " doc TEXT NOT NULL)")
+
+    @staticmethod
+    def _encode(i: base.EvaluationInstance) -> str:
+        return json.dumps({
+            "id": i.id, "status": i.status,
+            "startTimeUs": _time_us(i.start_time) if i.start_time else None,
+            "endTimeUs": _time_us(i.end_time) if i.end_time else None,
+            "evaluationClass": i.evaluation_class,
+            "engineParamsGeneratorClass": i.engine_params_generator_class,
+            "batch": i.batch, "env": dict(i.env),
+            "evaluatorResults": i.evaluator_results,
+            "evaluatorResultsHTML": i.evaluator_results_html,
+            "evaluatorResultsJSON": i.evaluator_results_json,
+        })
+
+    @staticmethod
+    def _decode(doc: str) -> base.EvaluationInstance:
+        s = json.loads(doc)
+        return base.EvaluationInstance(
+            id=s["id"], status=s["status"],
+            start_time=_from_us(s.get("startTimeUs")),
+            end_time=_from_us(s.get("endTimeUs")),
+            evaluation_class=s.get("evaluationClass", ""),
+            engine_params_generator_class=s.get(
+                "engineParamsGeneratorClass", ""),
+            batch=s.get("batch", ""), env=s.get("env") or {},
+            evaluator_results=s.get("evaluatorResults", ""),
+            evaluator_results_html=s.get("evaluatorResultsHTML", ""),
+            evaluator_results_json=s.get("evaluatorResultsJSON", ""),
+        )
+
+    def _put(self, iid: str, i: base.EvaluationInstance) -> None:
+        stored = base.EvaluationInstance(**{**i.__dict__, "id": iid})
+        self._c.query(f"DELETE FROM {self._t} WHERE id=$1", (iid,))
+        self._c.query(
+            f"INSERT INTO {self._t} (id, status, starttimeus, doc) "
+            "VALUES ($1,$2,$3,$4)",
+            (iid, stored.status,
+             _time_us(stored.start_time) if stored.start_time else None,
+             self._encode(stored)))
+
+    def insert(self, i: base.EvaluationInstance) -> str:
+        import uuid
+
+        iid = i.id or uuid.uuid4().hex
+        self._put(iid, i)
+        return iid
+
+    def get(self, instance_id: str) -> Optional[base.EvaluationInstance]:
+        _, rows = self._c.query(
+            f"SELECT doc FROM {self._t} WHERE id=$1", (instance_id,))
+        return self._decode(rows[0][0]) if rows else None
+
+    def get_all(self) -> list[base.EvaluationInstance]:
+        _, rows = self._c.query(f"SELECT doc FROM {self._t}")
+        return [self._decode(r[0]) for r in rows]
+
+    def get_completed(self) -> list[base.EvaluationInstance]:
+        _, rows = self._c.query(
+            f"SELECT doc FROM {self._t} WHERE status='EVALCOMPLETED' "
+            "ORDER BY starttimeus DESC")
+        return [self._decode(r[0]) for r in rows]
+
+    def update(self, i: base.EvaluationInstance) -> None:
+        self._put(i.id, i)
+
+    def delete(self, instance_id: str) -> None:
+        self._c.query(f"DELETE FROM {self._t} WHERE id=$1", (instance_id,))
+
+
+class PGModels(base.Models):
+    def __init__(self, conn: PGConnection, namespace: str):
+        self._c = conn
+        self._t = f"{_safe_ident(namespace)}_models".lower()
+        conn.query(
+            f"CREATE TABLE IF NOT EXISTS {self._t} ("
+            "id TEXT PRIMARY KEY, models BYTEA NOT NULL)")
+
+    def insert(self, model: base.Model) -> None:
+        self._c.query(f"DELETE FROM {self._t} WHERE id=$1", (model.id,))
+        self._c.query(
+            f"INSERT INTO {self._t} (id, models) VALUES ($1,$2)",
+            (model.id, bytes(model.models)))
+
+    def get(self, model_id: str) -> Optional[base.Model]:
+        _, rows = self._c.query(
+            f"SELECT models FROM {self._t} WHERE id=$1", (model_id,))
+        if not rows:
+            return None
+        blob = rows[0][0]
+        if isinstance(blob, str):
+            blob = blob.encode()
+        return base.Model(model_id, blob)
+
+    def delete(self, model_id: str) -> None:
+        self._c.query(f"DELETE FROM {self._t} WHERE id=$1", (model_id,))
+
+
+class PGClient(base.BaseStorageClient):
+    """`TYPE=PGSQL`; properties HOST (default 127.0.0.1), PORT (5432),
+    USERNAME, PASSWORD, DATABASE (default = username). Serves all three
+    repositories, like the reference's JDBC assembly."""
+
+    def __init__(self, config: base.StorageClientConfig):
+        super().__init__(config)
+        p = config.properties
+        user = p.get("USERNAME", "pio")
+        self._conn = PGConnection(
+            host=p.get("HOST", "127.0.0.1"),
+            port=int(p.get("PORT", "5432")),
+            user=user,
+            password=p.get("PASSWORD", ""),
+            database=p.get("DATABASE", user),
+        )
+        self._daos: dict = {}
+
+    def _dao(self, cls, namespace: str):
+        # DAO constructors run DDL round trips; cache per (class, ns) so
+        # per-request registry accessors don't repeat them on the wire.
+        key = (cls, namespace)
+        dao = self._daos.get(key)
+        if dao is None:
+            dao = self._daos[key] = cls(self._conn, namespace)
+        return dao
+
+    def apps(self, namespace: str = "pio_metadata"):
+        return self._dao(PGApps, namespace)
+
+    def access_keys(self, namespace: str = "pio_metadata"):
+        return self._dao(PGAccessKeys, namespace)
+
+    def channels(self, namespace: str = "pio_metadata"):
+        return self._dao(PGChannels, namespace)
+
+    def engine_instances(self, namespace: str = "pio_metadata"):
+        return self._dao(PGEngineInstances, namespace)
+
+    def evaluation_instances(self, namespace: str = "pio_metadata"):
+        return self._dao(PGEvaluationInstances, namespace)
+
+    def models(self, namespace: str = "pio_modeldata"):
+        return self._dao(PGModels, namespace)
+
+    def l_events(self, namespace: str = "pio_eventdata"):
+        return self._dao(PGLEvents, namespace)
+
+    def p_events(self, namespace: str = "pio_eventdata"):
+        return PGPEvents(self.l_events(namespace))
+
+    def close(self) -> None:
+        self._conn.close()

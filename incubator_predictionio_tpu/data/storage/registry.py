@@ -30,6 +30,7 @@ from .http_backend import HTTPStorageClient
 from .jsonl import JSONLClient
 from .localfs import LocalFSClient
 from .memory import StorageClient as MemoryClient
+from .postgres import PGClient
 from .s3 import S3Client
 from .sqlite import SQLiteClient
 
@@ -54,12 +55,16 @@ _BACKENDS: dict[str, Callable[[base.StorageClientConfig], base.BaseStorageClient
     # reference's storage/elasticsearch assembly (elasticsearch.py);
     # works against ES 7/8 or OpenSearch.
     "ELASTICSEARCH": ESClient,
+    # Real Postgres wire protocol (v3, SCRAM-SHA-256) — all three
+    # repositories, like the reference's JDBC assembly (postgres.py;
+    # connection: pgwire.py, no driver dependency).
+    "PGSQL": PGClient,
 }
 
 # Backend types whose wire protocols belong to external services this
 # distribution does not speak natively; the registry points at the HTTP
 # backend (same deployment shape: a shared network store) if selected.
-_UNSUPPORTED = {"HBASE", "PGSQL", "MYSQL", "JDBC", "HDFS"}
+_UNSUPPORTED = {"HBASE", "MYSQL", "JDBC", "HDFS"}
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
 

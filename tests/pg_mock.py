@@ -1,0 +1,255 @@
+"""In-process PostgreSQL wire-protocol server for contract tests.
+
+Implements the server side of protocol v3 — startup (including the
+SSLRequest dance), **SCRAM-SHA-256 authentication with real proof
+verification** (RFC 5802/7677: the server independently derives the
+client key from the configured password and rejects bad proofs), and
+the extended query protocol (Parse/Bind/Describe/Execute/Sync) — backed
+by an in-memory sqlite engine with a minimal PG→sqlite dialect shim
+($N → ?N params, BYTEA → BLOB). The client under test
+(data/storage/pgwire.py) is thereby proven to emit a real, verifiable
+wire conversation, not merely self-consistent bytes."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import re
+import socket
+import socketserver
+import sqlite3
+import struct
+import threading
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class _Db:
+    def __init__(self):
+        self.conn = sqlite3.connect(":memory:", check_same_thread=False)
+        self.lock = threading.RLock()
+
+    def execute(self, sql: str, params):
+        sql = re.sub(r"\$(\d+)", r"?\1", sql)
+        sql = re.sub(r"\bBYTEA\b", "BLOB", sql)
+        with self.lock:
+            cur = self.conn.execute(sql, params)
+            rows = cur.fetchall()
+            cols = [d[0] for d in cur.description] if cur.description else []
+            self.conn.commit()
+        return cols, rows
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client closed")
+            buf += chunk
+        return buf
+
+    def _send(self, t: bytes, payload: bytes):
+        self.request.sendall(t + struct.pack("!I", len(payload) + 4) + payload)
+
+    def _error(self, code: str, message: str):
+        self._send(b"E", b"S" + _cstr("ERROR") + b"C" + _cstr(code)
+                   + b"M" + _cstr(message) + b"\x00")
+
+    def _ready(self):
+        self._send(b"Z", b"I")
+
+    # -- SCRAM server side -------------------------------------------------
+    def _scram(self, password: str) -> bool:
+        self._send(b"R", struct.pack("!I", 10) + _cstr("SCRAM-SHA-256")
+                   + b"\x00")
+        t, payload = self._recv_message()
+        if t != b"p":
+            return False
+        mech_end = payload.index(b"\x00")
+        if payload[:mech_end] != b"SCRAM-SHA-256":
+            return False
+        (n,) = struct.unpack("!I", payload[mech_end + 1:mech_end + 5])
+        client_first = payload[mech_end + 5:mech_end + 5 + n].decode()
+        bare = client_first.split(",", 2)[2]
+        client_nonce = dict(kv.split("=", 1)
+                            for kv in bare.split(","))["r"]
+        salt = os.urandom(16)
+        iters = 4096
+        server_nonce = client_nonce + base64.b64encode(os.urandom(12)).decode()
+        server_first = (f"r={server_nonce},"
+                        f"s={base64.b64encode(salt).decode()},i={iters}")
+        self._send(b"R", struct.pack("!I", 11) + server_first.encode())
+
+        t, payload = self._recv_message()
+        if t != b"p":
+            return False
+        client_final = payload.decode()
+        attrs = dict(kv.split("=", 1) for kv in client_final.split(","))
+        if attrs.get("r") != server_nonce:
+            return False
+        without_proof = client_final.rsplit(",p=", 1)[0]
+        auth_message = ",".join([bare, server_first, without_proof]).encode()
+        salted = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        client_sig = hmac.new(stored_key, auth_message,
+                              hashlib.sha256).digest()
+        proof = base64.b64decode(attrs["p"])
+        recovered = bytes(a ^ b for a, b in zip(proof, client_sig))
+        if hashlib.sha256(recovered).digest() != stored_key:
+            self._error("28P01", "password authentication failed")
+            return False
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        server_sig = hmac.new(server_key, auth_message,
+                              hashlib.sha256).digest()
+        self._send(b"R", struct.pack("!I", 12)
+                   + b"v=" + base64.b64encode(server_sig))
+        self._send(b"R", struct.pack("!I", 0))  # AuthenticationOk
+        return True
+
+    def _recv_message(self):
+        head = self._recv_exact(5)
+        (length,) = struct.unpack("!I", head[1:])
+        return head[:1], self._recv_exact(length - 4)
+
+    # -- session -------------------------------------------------------------
+    def handle(self):
+        try:
+            self._handle()
+        except (ConnectionError, OSError):
+            pass
+
+    def _handle(self):
+        # startup (len + payload, no type byte); answer SSLRequest with 'N'
+        (length,) = struct.unpack("!I", self._recv_exact(4))
+        payload = self._recv_exact(length - 4)
+        (code,) = struct.unpack("!I", payload[:4])
+        if code == 80877103:  # SSLRequest
+            self.request.sendall(b"N")
+            (length,) = struct.unpack("!I", self._recv_exact(4))
+            payload = self._recv_exact(length - 4)
+            (code,) = struct.unpack("!I", payload[:4])
+        if code != 196608:
+            self._error("08P01", f"unsupported protocol {code}")
+            return
+        params = payload[4:].split(b"\x00")
+        kv = {params[i].decode(): params[i + 1].decode()
+              for i in range(0, len(params) - 1, 2) if params[i]}
+        if kv.get("user") != self.server.pg_user:
+            self._error("28000", f"role {kv.get('user')!r} does not exist")
+            return
+        if not self._scram(self.server.pg_password):
+            return
+        self._send(b"S", _cstr("server_version") + _cstr("16.0-pio-mock"))
+        self._ready()
+
+        stmt_sql = ""
+        bound_params: list = []
+        while True:
+            t, payload = self._recv_message()
+            if t == b"X":
+                return
+            if t == b"P":
+                off = payload.index(b"\x00") + 1  # unnamed statement
+                end = payload.index(b"\x00", off)
+                stmt_sql = payload[off:end].decode()
+                self._send(b"1", b"")
+            elif t == b"B":
+                off = payload.index(b"\x00") + 1  # portal
+                off = payload.index(b"\x00", off) + 1  # statement
+                (nfmt,) = struct.unpack("!H", payload[off:off + 2])
+                off += 2 + 2 * nfmt
+                (nparams,) = struct.unpack("!H", payload[off:off + 2])
+                off += 2
+                bound_params = []
+                for _ in range(nparams):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        bound_params.append(None)
+                    else:
+                        text = payload[off:off + ln].decode()
+                        off += ln
+                        if text.startswith("\\x"):
+                            bound_params.append(bytes.fromhex(text[2:]))
+                        else:
+                            bound_params.append(text)
+                self._send(b"2", b"")
+            elif t == b"D":
+                continue  # description is sent with the result set
+            elif t == b"E":
+                try:
+                    cols, rows = self.server.db.execute(stmt_sql, bound_params)
+                except sqlite3.IntegrityError as e:
+                    self._error("23505", str(e))
+                    continue
+                except sqlite3.Error as e:
+                    self._error("XX000", str(e))
+                    continue
+                if cols:
+                    # type OID per column: 17 (bytea) when any value in
+                    # the result is bytes, else 25 (text) — the client
+                    # decodes \\x hex by OID, like a real server's
+                    # catalog-driven RowDescription.
+                    oids = []
+                    for j in range(len(cols)):
+                        oids.append(17 if any(
+                            isinstance(r[j], bytes) for r in rows) else 25)
+                    desc = struct.pack("!H", len(cols))
+                    for c, oid in zip(cols, oids):
+                        desc += (_cstr(c)
+                                 + struct.pack("!IHIHIH", 0, 0, oid, -1
+                                               & 0xFFFF, 0, 0))
+                    self._send(b"T", desc)
+                for row in rows:
+                    body = struct.pack("!H", len(row))
+                    for v in row:
+                        if v is None:
+                            body += struct.pack("!i", -1)
+                        else:
+                            if isinstance(v, bytes):
+                                text = "\\x" + v.hex()
+                            elif isinstance(v, float):
+                                text = repr(v)
+                            else:
+                                text = str(v)
+                            raw = text.encode()
+                            body += struct.pack("!i", len(raw)) + raw
+                    self._send(b"D", body)
+                self._send(b"C", _cstr("SELECT " + str(len(rows))))
+            elif t == b"S":
+                self._ready()
+            else:
+                self._error("08P01", f"unsupported message {t!r}")
+                self._ready()
+
+
+class MockPGServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, user="pio", password="piosecret"):
+        self.pg_user = user
+        self.pg_password = password
+        self.db = _Db()
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        self.server_close()
