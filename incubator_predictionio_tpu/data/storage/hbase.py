@@ -1,0 +1,396 @@
+"""HBase backend — the `HBASE` source type, over the HBase REST gateway.
+
+Reference: storage/hbase/.../{HBLEvents,HBPEvents,HBEventsUtil}
+(SURVEY.md §2.1): the event store of record, rowkeys encoding time so
+scans ride rowkey order. A native HBase RPC client (protobuf + SASL) is
+out of scope here; instead this speaks the **HBase REST gateway**
+protocol (the `hbase rest` service every distribution ships, JSON
+representation with base64 keys/cells): table schema CRUD, row
+GET/PUT/DELETE, and the stateful scanner API with start/stop rows.
+
+    PIO_STORAGE_SOURCES_HB_TYPE=HBASE
+    PIO_STORAGE_SOURCES_HB_HOSTS=hbase-rest-host   PORTS=8080
+
+Layout (one table per (namespace, app, channel), like the reference's
+pio_event_<appId>[_<channelId>]):
+
+- data rows:  ``t:<eventTimeUs 16-hex><seq 16-hex>`` → cells
+  ``e:json`` (full event wire JSON). Rowkey order == (time, insertion)
+  order, so time-window scans are rowkey-range scans and the
+  cross-backend tie-order contract holds: ``seq`` is a client-side
+  monotone counter, and an upsert writes a FRESH seq (moving the event
+  to the end of its tie group) after deleting the old data row.
+- index rows: ``i:<eventId>`` → cell ``e:k`` holding the current data
+  rowkey — the eventId → rowkey lookup for get/delete/upsert.
+
+Filters beyond the time range are applied client-side on the scan
+stream, like the reference's filter lists evaluate server-side but with
+identical semantics.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Iterable, Iterator, Optional, Sequence
+
+from . import base as storage_base
+from .event import Event, new_event_id
+from .sqlite import _safe_ident
+
+
+class HBaseError(RuntimeError):
+    pass
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class _HBaseRest:
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        self.endpoint = endpoint.rstrip("/")
+
+        self.timeout = timeout
+
+    def request(self, method: str, path: str, body=None,
+                want_location: bool = False):
+        url = self.endpoint + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Accept": "application/json",
+                     "Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                loc = resp.headers.get("Location")
+                out = json.loads(raw) if raw else None
+                return resp.status, (loc if want_location else out)
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code, None
+        except urllib.error.URLError as e:
+            raise HBaseError(
+                f"HBase REST gateway unreachable: {self.endpoint} "
+                f"({e.reason})") from e
+
+
+class HBLEvents(storage_base.LEvents):
+    _CF = "e"
+
+    def __init__(self, transport: _HBaseRest, namespace: str):
+        self._t = transport
+        self._ns = _safe_ident(namespace).lower()
+        self._seq_lock = threading.Lock()
+        self._last_seq = 0
+
+    def _table(self, app_id: int, channel_id: Optional[int]) -> str:
+        name = f"{self._ns}_{int(app_id)}"
+        if channel_id is not None:
+            name += f"_{int(channel_id)}"
+        return name
+
+    def _next_seq(self) -> int:
+        """Client-side monotone insertion counter (wall-clock ns, bumped
+        past the previous value): orders equal-timestamp ties by
+        insertion, surviving restarts; best-effort across multiple
+        concurrent writer processes (the tie order between two
+        SIMULTANEOUS inserts is unspecified by the contract)."""
+        with self._seq_lock:
+            seq = max(self._last_seq + 1, time.time_ns())
+            self._last_seq = seq
+            return seq
+
+    @staticmethod
+    def _time_us(t: _dt.datetime) -> int:
+        if t.tzinfo is None:
+            t = t.replace(tzinfo=_dt.timezone.utc)
+        return int(t.timestamp() * 1_000_000)
+
+    @staticmethod
+    def _data_key(time_us: int, seq: int) -> bytes:
+        # +2^63 bias: pre-epoch (negative) times still render fixed-width
+        # unsigned hex, keeping lexicographic rowkey order == time order
+        return f"t:{time_us + 2**63:017x}{seq:016x}".encode()
+
+    @staticmethod
+    def _index_key(event_id: str) -> bytes:
+        return b"i:" + event_id.encode()
+
+    # -- table lifecycle ---------------------------------------------------
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        status, _ = self._t.request(
+            "PUT", f"/{self._table(app_id, channel_id)}/schema",
+            body={"name": self._table(app_id, channel_id),
+                  "ColumnSchema": [{"name": self._CF}]})
+        if status not in (200, 201):
+            raise HBaseError(f"create table: HTTP {status}")
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        status, _ = self._t.request(
+            "DELETE", f"/{self._table(app_id, channel_id)}/schema")
+        return status in (200, 404)
+
+    # -- row helpers -------------------------------------------------------
+    def _put_cells(self, table: str, row_key: bytes,
+                   cells: dict[str, bytes]) -> None:
+        body = {"Row": [{
+            "key": _b64(row_key),
+            "Cell": [{"column": _b64(f"{self._CF}:{q}".encode()),
+                      "$": _b64(v)} for q, v in cells.items()],
+        }]}
+        row_q = urllib.parse.quote(row_key.decode(), safe="")
+        status, _ = self._t.request("PUT", f"/{table}/{row_q}", body=body)
+        if status == 404:
+            # auto-create on first write (contract: insert without init)
+            s, _ = self._t.request(
+                "PUT", f"/{table}/schema",
+                body={"name": table, "ColumnSchema": [{"name": self._CF}]})
+            if s in (200, 201):
+                status, _ = self._t.request(
+                    "PUT", f"/{table}/{row_q}", body=body)
+        if status not in (200, 201):
+            raise HBaseError(f"put {table}/{row_key!r}: HTTP {status}")
+
+    def _get_cells(self, table: str, row_key: bytes) -> Optional[dict]:
+        row_q = urllib.parse.quote(row_key.decode(), safe="")
+        status, out = self._t.request("GET", f"/{table}/{row_q}")
+        if status == 404 or not out:
+            return None
+        if status != 200:
+            raise HBaseError(f"get {table}/{row_key!r}: HTTP {status}")
+        cells = {}
+        for row in out.get("Row", []):
+            for cell in row.get("Cell", []):
+                col = _unb64(cell["column"]).decode()
+                cells[col.split(":", 1)[1]] = _unb64(cell["$"])
+        return cells or None
+
+    def _delete_row(self, table: str, row_key: bytes) -> bool:
+        row_q = urllib.parse.quote(row_key.decode(), safe="")
+        status, _ = self._t.request("DELETE", f"/{table}/{row_q}")
+        return status == 200
+
+    # -- LEvents contract --------------------------------------------------
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        table = self._table(app_id, channel_id)
+        fresh = not event.event_id
+        eid = event.event_id or new_event_id()
+        stored = event.with_event_id(eid)
+        if not fresh:
+            # only client-supplied ids can collide (upsert); fresh uuids
+            # skip the index round trip
+            old = self._get_cells(table, self._index_key(eid))
+            if old and "k" in old:
+                self._delete_row(table, old["k"])
+        data_key = self._data_key(self._time_us(stored.event_time),
+                                  self._next_seq())
+        self._put_cells(table, data_key,
+                        {"json": json.dumps(stored.to_json()).encode()})
+        self._put_cells(table, self._index_key(eid), {"k": data_key})
+        return eid
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> list[str]:
+        """Bulk ingest via the gateway's multi-row PUT: one request per
+        chunk instead of 2-3 per event. Events carrying client-supplied
+        ids fall back to the upsert-aware single-insert path."""
+        table = self._table(app_id, channel_id)
+        ids: list[str] = []
+        CHUNK = 500
+        fresh: list[Event] = []
+
+        def flush():
+            if not fresh:
+                return
+            rows = []
+            for e in fresh:
+                data_key = self._data_key(self._time_us(e.event_time),
+                                          self._next_seq())
+                rows.append({"key": _b64(data_key), "Cell": [{
+                    "column": _b64(f"{self._CF}:json".encode()),
+                    "$": _b64(json.dumps(e.to_json()).encode())}]})
+                rows.append({"key": _b64(self._index_key(e.event_id)),
+                             "Cell": [{
+                                 "column": _b64(f"{self._CF}:k".encode()),
+                                 "$": _b64(data_key)}]})
+            status, _ = self._t.request(
+                "PUT", f"/{table}/batch", body={"Row": rows})
+            if status == 404:
+                self.init(app_id, channel_id)
+                status, _ = self._t.request(
+                    "PUT", f"/{table}/batch", body={"Row": rows})
+            if status not in (200, 201):
+                raise HBaseError(f"bulk put {table}: HTTP {status}")
+            fresh.clear()
+
+        for e in events:
+            if e.event_id:
+                flush()
+                ids.append(self.insert(e, app_id, channel_id))
+            else:
+                eid = new_event_id()
+                fresh.append(e.with_event_id(eid))
+                ids.append(eid)
+                if len(fresh) >= CHUNK:
+                    flush()
+        flush()
+        return ids
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        table = self._table(app_id, channel_id)
+        idx = self._get_cells(table, self._index_key(event_id))
+        if not idx or "k" not in idx:
+            return None
+        data = self._get_cells(table, idx["k"])
+        if not data or "json" not in data:
+            return None
+        return Event.from_json(json.loads(data["json"].decode()))
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        table = self._table(app_id, channel_id)
+        idx = self._get_cells(table, self._index_key(event_id))
+        if not idx or "k" not in idx:
+            return False
+        self._delete_row(table, idx["k"])
+        self._delete_row(table, self._index_key(event_id))
+        return True
+
+    def _scan(self, table: str, start_key: bytes, end_key: bytes,
+              batch: int = 1000) -> Iterator[Event]:
+        """Rowkey-range scan via the stateful scanner API."""
+        status, location = self._t.request(
+            "PUT", f"/{table}/scanner",
+            body={"batch": batch, "startRow": _b64(start_key),
+                  "endRow": _b64(end_key)},
+            want_location=True)
+        if status == 404:
+            return
+        if status != 201 or not location:
+            raise HBaseError(f"open scanner on {table}: HTTP {status}")
+        path = urllib.parse.urlsplit(location).path
+        try:
+            while True:
+                status, out = self._t.request("GET", path)
+                if status == 204:
+                    return
+                if status != 200:
+                    raise HBaseError(f"scanner read: HTTP {status}")
+                for row in (out or {}).get("Row", []):
+                    for cell in row.get("Cell", []):
+                        col = _unb64(cell["column"]).decode()
+                        if col == f"{self._CF}:json":
+                            yield Event.from_json(
+                                json.loads(_unb64(cell["$"]).decode()))
+        finally:
+            self._t.request("DELETE", path)
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Optional[str] = None,
+        target_entity_id: Optional[str] = None,
+        limit: Optional[int] = None,
+        reversed_order: bool = False,
+    ) -> Iterator[Event]:
+        from .memory import event_matches
+
+        table = self._table(app_id, channel_id)
+        start_key = (self._data_key(self._time_us(start_time), 0)
+                     if start_time is not None else b"t:")
+        end_key = (self._data_key(self._time_us(until_time), 0)
+                   if until_time is not None else b"t;")  # ';' > ':'
+        it = (
+            e for e in self._scan(table, start_key, end_key)
+            if event_matches(e, start_time, until_time, entity_type,
+                             entity_id, event_names, target_entity_type,
+                             target_entity_id)
+        )
+        if limit is not None and limit < 0:
+            limit = None
+        if reversed_order:
+            # time DESC, tie (insertion) ASC — stable sort of the
+            # already time+seq-ascending stream. KNOWN LIMITATION: the
+            # REST gateway exposes no reversed scanner, so this
+            # materializes the whole matching window before slicing the
+            # limit; bound the scan with start_time/until_time for
+            # "latest N" queries on large apps.
+            events = sorted(it, key=lambda e: self._time_us(e.event_time),
+                            reverse=True)
+            yield from (events[:limit] if limit is not None else events)
+            return
+        import itertools
+
+        yield from (itertools.islice(it, limit) if limit is not None else it)
+
+
+class HBPEvents(storage_base.PEvents):
+    def __init__(self, l_events: HBLEvents):
+        self._l = l_events
+
+    def find(self, app_id, channel_id=None, start_time=None, until_time=None,
+             entity_type=None, entity_id=None, event_names=None,
+             target_entity_type=None, target_entity_id=None) -> Iterator[Event]:
+        return self._l.find(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id,
+        )
+
+    def write(self, events: Iterable[Event], app_id: int,
+              channel_id: Optional[int] = None) -> None:
+        for e in events:
+            self._l.insert(e, app_id, channel_id)
+
+    def delete(self, event_ids: Iterable[str], app_id: int,
+               channel_id: Optional[int] = None) -> None:
+        for eid in event_ids:
+            self._l.delete(eid, app_id, channel_id)
+
+
+class HBaseClient(storage_base.BaseStorageClient):
+    """`TYPE=HBASE`; properties HOSTS (REST gateway host or URL), PORTS
+    (default 8080). Event data only — the reference's HBase role (the
+    event store of record; metadata/models ride another source)."""
+
+    def __init__(self, config: storage_base.StorageClientConfig):
+        super().__init__(config)
+        p = config.properties
+        host = (p.get("HOSTS") or "").split(",")[0].strip()
+        if not host:
+            raise ValueError(
+                "HBASE source needs PIO_STORAGE_SOURCES_<NAME>_HOSTS "
+                "(the HBase REST gateway)")
+        port = (p.get("PORTS") or "8080").split(",")[0].strip()
+        endpoint = host if "://" in host else f"http://{host}:{port}"
+        self._transport = _HBaseRest(endpoint)
+        self._daos: dict = {}
+
+    def l_events(self, namespace: str = "pio_eventdata"):
+        dao = self._daos.get(namespace)
+        if dao is None:
+            dao = self._daos[namespace] = HBLEvents(self._transport, namespace)
+        return dao
+
+    def p_events(self, namespace: str = "pio_eventdata"):
+        return HBPEvents(self.l_events(namespace))

@@ -26,6 +26,7 @@ from typing import Callable, Optional
 
 from . import base
 from .elasticsearch import ESClient
+from .hbase import HBaseClient
 from .http_backend import HTTPStorageClient
 from .jsonl import JSONLClient
 from .localfs import LocalFSClient
@@ -59,12 +60,15 @@ _BACKENDS: dict[str, Callable[[base.StorageClientConfig], base.BaseStorageClient
     # repositories, like the reference's JDBC assembly (postgres.py;
     # connection: pgwire.py, no driver dependency).
     "PGSQL": PGClient,
+    # HBase REST gateway protocol — event data only, the reference's
+    # HBase "event store of record" role (hbase.py).
+    "HBASE": HBaseClient,
 }
 
 # Backend types whose wire protocols belong to external services this
 # distribution does not speak natively; the registry points at the HTTP
 # backend (same deployment shape: a shared network store) if selected.
-_UNSUPPORTED = {"HBASE", "MYSQL", "JDBC", "HDFS"}
+_UNSUPPORTED = {"MYSQL", "JDBC", "HDFS"}
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
 

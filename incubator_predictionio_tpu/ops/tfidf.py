@@ -49,6 +49,13 @@ class TfIdfVectorizer:
     _hash_cache: dict = dataclasses.field(
         default_factory=dict, repr=False, compare=False)
 
+    def __getstate__(self):
+        # The cache is pure derived data; pickling it would inflate every
+        # persisted model blob by the corpus vocabulary.
+        state = self.__dict__.copy()
+        state["_hash_cache"] = {}
+        return state
+
     def term_frequencies(self, docs: Sequence[str]) -> np.ndarray:
         D = self.n_features
         x = np.zeros((len(docs), D), np.float32)

@@ -1,0 +1,130 @@
+"""In-process HBase REST gateway for contract tests.
+
+Implements the JSON representation of the gateway the HBASE backend
+speaks: table schema PUT/DELETE, row GET/PUT/DELETE with base64
+keys/columns/values (cell data under the "$" field, exactly like the
+real gateway), and the stateful scanner API (PUT /{table}/scanner →
+Location header; GET batches until 204; DELETE). Rows iterate in rowkey
+byte order, the property every HBase region server guarantees and the
+backend's time-window scans rely on."""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import urllib.parse
+
+from aiohttp import web
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def build_hbase_app():
+    tables: dict[str, dict[bytes, dict[str, bytes]]] = {}
+    scanners: dict[str, dict] = {}
+    scanner_ids = itertools.count(1)
+
+    async def schema_put(request):
+        tables.setdefault(request.match_info["table"], {})
+        return web.Response(status=201)
+
+    async def schema_delete(request):
+        if tables.pop(request.match_info["table"], None) is None:
+            return web.json_response({}, status=404)
+        return web.Response(status=200)
+
+    def _row_key(request) -> bytes:
+        return urllib.parse.unquote(request.match_info["row"]).encode()
+
+    async def row_put(request):
+        t = tables.get(request.match_info["table"])
+        if t is None:
+            return web.json_response({}, status=404)
+        body = await request.json()
+        for row in body.get("Row", []):
+            key = _unb64(row["key"])
+            cells = t.setdefault(key, {})
+            for cell in row.get("Cell", []):
+                col = _unb64(cell["column"]).decode()
+                cells[col] = _unb64(cell["$"])
+        return web.Response(status=200)
+
+    async def row_get(request):
+        t = tables.get(request.match_info["table"])
+        key = _row_key(request)
+        cells = t.get(key) if t is not None else None
+        if not cells:
+            return web.json_response({}, status=404)
+        return web.json_response({"Row": [{
+            "key": _b64(key),
+            "Cell": [{"column": _b64(col.encode()), "timestamp": 1,
+                      "$": _b64(v)} for col, v in cells.items()],
+        }]})
+
+    async def row_delete(request):
+        t = tables.get(request.match_info["table"])
+        if t is None or t.pop(_row_key(request), None) is None:
+            return web.json_response({}, status=404)
+        return web.Response(status=200)
+
+    async def scanner_open(request):
+        table = request.match_info["table"]
+        if table not in tables:
+            return web.json_response({}, status=404)
+        body = await request.json()
+        sid = str(next(scanner_ids))
+        # snapshot the rowkey-ordered view at open time
+        start = _unb64(body.get("startRow", "")) if body.get("startRow") else b""
+        end = _unb64(body.get("endRow", "")) if body.get("endRow") else None
+        keys = sorted(k for k in tables[table]
+                      if k >= start and (end is None or k < end))
+        scanners[sid] = {"table": table, "keys": keys, "pos": 0,
+                         "batch": int(body.get("batch", 100))}
+        return web.Response(
+            status=201,
+            headers={"Location": f"http://{request.host}/scanner/{sid}"})
+
+    async def scanner_next(request):
+        s = scanners.get(request.match_info["sid"])
+        if s is None:
+            return web.json_response({}, status=404)
+        t = tables.get(s["table"], {})
+        out = []
+        while s["pos"] < len(s["keys"]) and len(out) < s["batch"]:
+            key = s["keys"][s["pos"]]
+            s["pos"] += 1
+            cells = t.get(key)
+            if cells is None:  # deleted since the scanner opened
+                continue
+            out.append({
+                "key": _b64(key),
+                "Cell": [{"column": _b64(col.encode()), "timestamp": 1,
+                          "$": _b64(v)} for col, v in cells.items()],
+            })
+        if not out:
+            return web.Response(status=204)
+        return web.json_response({"Row": out})
+
+    async def scanner_delete(request):
+        scanners.pop(request.match_info["sid"], None)
+        return web.Response(status=200)
+
+    app = web.Application()
+    app.add_routes([
+        web.put("/{table}/schema", schema_put),
+        web.delete("/{table}/schema", schema_delete),
+        web.put("/{table}/scanner", scanner_open),
+        web.get("/scanner/{sid}", scanner_next),
+        web.delete("/scanner/{sid}", scanner_delete),
+        web.put("/{table}/{row}", row_put),
+        web.get("/{table}/{row}", row_get),
+        web.delete("/{table}/{row}", row_delete),
+    ])
+    app["tables"] = tables
+    return app

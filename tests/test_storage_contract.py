@@ -59,7 +59,7 @@ def _make_storage(kind, tmp_path):
 
 
 BACKENDS = ["memory", "sqlite", "mixed", "jsonl", "http", "s3",
-            "elasticsearch", "pgsql"]
+            "elasticsearch", "pgsql", "hbase"]
 
 
 @pytest.fixture(params=BACKENDS)
@@ -82,6 +82,29 @@ def storage(request, tmp_path):
                 "PIO_STORAGE_SOURCES_PG_PORT": str(srv.port),
                 "PIO_STORAGE_SOURCES_PG_USERNAME": "pio",
                 "PIO_STORAGE_SOURCES_PG_PASSWORD": "piosecret",
+            }
+            s = Storage(env)
+            yield s
+            s.close()
+        return
+    if request.param == "hbase":
+        # Event data over the HBase REST gateway protocol (schema CRUD,
+        # base64 row/cell JSON, stateful scanners) — the reference's
+        # "event store of record" role with wire parity against the
+        # `hbase rest` service (hbase_mock.py); metadata+models on sqlite.
+        from hbase_mock import build_hbase_app
+        from server_utils import ServerThread
+
+        with ServerThread(build_hbase_app()) as srv:
+            env = {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "HB",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+                "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+                "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "hbmeta.sqlite"),
+                "PIO_STORAGE_SOURCES_HB_TYPE": "HBASE",
+                "PIO_STORAGE_SOURCES_HB_HOSTS": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_HB_PORTS": str(srv.port),
             }
             s = Storage(env)
             yield s
