@@ -27,6 +27,7 @@ from typing import Callable, Optional
 from . import base
 from .elasticsearch import ESClient
 from .hbase import HBaseClient
+from .hdfs import HDFSClient
 from .http_backend import HTTPStorageClient
 from .jsonl import JSONLClient
 from .localfs import LocalFSClient
@@ -63,12 +64,15 @@ _BACKENDS: dict[str, Callable[[base.StorageClientConfig], base.BaseStorageClient
     # HBase REST gateway protocol — event data only, the reference's
     # HBase "event store of record" role (hbase.py).
     "HBASE": HBaseClient,
+    # WebHDFS REST protocol — model blobs on a Hadoop filesystem, the
+    # reference's storage/hdfs assembly (hdfs.py).
+    "HDFS": HDFSClient,
 }
 
 # Backend types whose wire protocols belong to external services this
 # distribution does not speak natively; the registry points at the HTTP
 # backend (same deployment shape: a shared network store) if selected.
-_UNSUPPORTED = {"MYSQL", "JDBC", "HDFS"}
+_UNSUPPORTED = {"MYSQL", "JDBC"}
 
 REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
 

@@ -1,0 +1,50 @@
+"""In-process WebHDFS gateway for contract tests: implements the
+NameNode side of CREATE (with the real 307-redirect-to-DataNode dance),
+OPEN, and DELETE over an in-memory filesystem."""
+
+from __future__ import annotations
+
+import urllib.parse
+
+from aiohttp import web
+
+
+def build_hdfs_app():
+    files: dict[str, bytes] = {}
+
+    async def handle(request: web.Request) -> web.Response:
+        path = urllib.parse.unquote(
+            request.path[len("/webhdfs/v1"):]) if request.path.startswith(
+            "/webhdfs/v1") else None
+        if path is None:
+            return web.json_response({}, status=404)
+        op = (request.query.get("op") or "").upper()
+        if request.method == "PUT" and op == "CREATE":
+            if "datanode" not in request.query:
+                # NameNode leg: must be body-free; redirect to the
+                # "DataNode" (same server). raw_path keeps the as-sent
+                # percent-encoding — request.path is decoded and would
+                # double-decode the key on the second leg.
+                assert not await request.read(), \
+                    "WebHDFS NameNode CREATE leg must not carry data"
+                raw = request.raw_path.split("?", 1)[0]
+                loc = (f"http://{request.host}{raw}?"
+                       f"{request.query_string}&datanode=1")
+                return web.Response(status=307, headers={"Location": loc})
+            files[path] = await request.read()
+            return web.Response(status=201)
+        if request.method == "GET" and op == "OPEN":
+            if path not in files:
+                return web.json_response(
+                    {"RemoteException": {"exception": "FileNotFoundException"}},
+                    status=404)
+            return web.Response(body=files[path])
+        if request.method == "DELETE" and op == "DELETE":
+            existed = files.pop(path, None) is not None
+            return web.json_response({"boolean": existed})
+        return web.json_response({}, status=400)
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", handle)
+    app["files"] = files
+    return app

@@ -59,7 +59,7 @@ def _make_storage(kind, tmp_path):
 
 
 BACKENDS = ["memory", "sqlite", "mixed", "jsonl", "http", "s3",
-            "elasticsearch", "pgsql", "hbase"]
+            "elasticsearch", "pgsql", "hbase", "hdfs"]
 
 
 @pytest.fixture(params=BACKENDS)
@@ -82,6 +82,30 @@ def storage(request, tmp_path):
                 "PIO_STORAGE_SOURCES_PG_PORT": str(srv.port),
                 "PIO_STORAGE_SOURCES_PG_USERNAME": "pio",
                 "PIO_STORAGE_SOURCES_PG_PASSWORD": "piosecret",
+            }
+            s = Storage(env)
+            yield s
+            s.close()
+        return
+    if request.param == "hdfs":
+        # Model blobs over the WebHDFS REST protocol incl. the real
+        # 307 NameNode->DataNode CREATE redirect (hdfs_mock.py) — the
+        # reference's storage/hdfs assembly scope; metadata+events on
+        # sqlite.
+        from hdfs_mock import build_hdfs_app
+        from server_utils import ServerThread
+
+        with ServerThread(build_hdfs_app()) as srv:
+            env = {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "DB",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DFS",
+                "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+                "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "hdfsmeta.sqlite"),
+                "PIO_STORAGE_SOURCES_DFS_TYPE": "HDFS",
+                "PIO_STORAGE_SOURCES_DFS_HOSTS": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_DFS_PORTS": str(srv.port),
+                "PIO_STORAGE_SOURCES_DFS_PATH": "/pio/models",
             }
             s = Storage(env)
             yield s
@@ -568,3 +592,24 @@ def test_pgsql_scram_server_signature_verified():
     with srv:
         with pytest.raises(PGProtocolError, match="signature"):
             PGConnection("127.0.0.1", srv.port, "pio", "pw", "pio")
+
+
+def test_hdfs_key_with_reserved_characters(tmp_path):
+    """WebHDFS paths with spaces / reserved chars must survive the
+    NameNode→DataNode redirect without double-decoding."""
+    from hdfs_mock import build_hdfs_app
+    from server_utils import ServerThread
+
+    from incubator_predictionio_tpu.data.storage.hdfs import HDFSClient
+    from incubator_predictionio_tpu.data.storage.base import StorageClientConfig
+
+    with ServerThread(build_hdfs_app()) as srv:
+        client = HDFSClient(StorageClientConfig(properties={
+            "HOSTS": "127.0.0.1", "PORTS": str(srv.port),
+            "PATH": "/pio/models",
+        }))
+        models = client.models("name space+ns")
+        models.insert(Model("id with space+plus", b"\x02blob"))
+        assert models.get("id with space+plus").models == b"\x02blob"
+        models.delete("id with space+plus")
+        assert models.get("id with space+plus") is None
