@@ -112,7 +112,7 @@ class EngineServer:
                     log.exception("model warm-up failed")
         if self.batch_window_ms > 0:
             # Pre-compile every power-of-two batch shape the micro-batch
-            # path can produce — a cold shape costs ~1.4s through a
+            # path can produce — a cold shape showed ~1.5s p99 through a
             # remote compile service, which would otherwise surface as
             # p99 spikes on live traffic. Models opt in by providing an
             # example_query() the batch path can execute.
