@@ -36,7 +36,7 @@ import urllib.request
 from typing import Iterable, Iterator, Optional, Sequence
 
 from . import base
-from .event import Event, new_event_id
+from .event import Event, event_time_us as _time_us, new_event_id
 
 _PAGE = 1000  # _search page size (search_after pagination)
 
@@ -201,14 +201,6 @@ def _event_index(namespace: str, app_id: int,
     if channel_id is not None:
         idx += f"_{int(channel_id)}"
     return idx.lower()
-
-
-def _time_us(t: _dt.datetime) -> int:
-    if t.tzinfo is None:
-        # naive == UTC, matching sqlite._to_micros — a local-time reading
-        # would silently shift range filters per backend
-        t = t.replace(tzinfo=_dt.timezone.utc)
-    return int(t.timestamp() * 1_000_000)
 
 
 class ESLEvents(base.LEvents):
@@ -620,24 +612,35 @@ class ESClient(base.BaseStorageClient):
         self._transport = _ESTransport(
             endpoint, username=p.get("USERNAME", ""),
             password=p.get("PASSWORD", ""))
+        self._daos: dict = {}
+
+    def _dao(self, cls, namespace: str):
+        # metadata DAO constructors ensure their index (a network round
+        # trip); cache per (class, ns) so per-request registry accessors
+        # don't repeat it
+        key = (cls, namespace)
+        dao = self._daos.get(key)
+        if dao is None:
+            dao = self._daos[key] = cls(self._transport, namespace)
+        return dao
 
     def apps(self, namespace: str = "pio_metadata"):
-        return ESApps(self._transport, namespace)
+        return self._dao(ESApps, namespace)
 
     def access_keys(self, namespace: str = "pio_metadata"):
-        return ESAccessKeys(self._transport, namespace)
+        return self._dao(ESAccessKeys, namespace)
 
     def channels(self, namespace: str = "pio_metadata"):
-        return ESChannels(self._transport, namespace)
+        return self._dao(ESChannels, namespace)
 
     def engine_instances(self, namespace: str = "pio_metadata"):
-        return ESEngineInstances(self._transport, namespace)
+        return self._dao(ESEngineInstances, namespace)
 
     def evaluation_instances(self, namespace: str = "pio_metadata"):
-        return ESEvaluationInstances(self._transport, namespace)
+        return self._dao(ESEvaluationInstances, namespace)
 
     def l_events(self, namespace: str = "pio_eventdata"):
-        return ESLEvents(self._transport, namespace)
+        return self._dao(ESLEvents, namespace)
 
     def p_events(self, namespace: str = "pio_eventdata"):
         return ESPEvents(self.l_events(namespace))

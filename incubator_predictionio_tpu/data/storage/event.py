@@ -41,6 +41,15 @@ def parse_event_time(value: str) -> _dt.datetime:
     return t
 
 
+def event_time_us(t: _dt.datetime) -> int:
+    """Epoch microseconds; naive datetimes read as UTC (the storage
+    backends' shared time encoding — sqlite/ES/PG/HBase all sort and
+    range-filter on this)."""
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int(t.timestamp() * 1_000_000)
+
+
 def format_event_time(t: _dt.datetime) -> str:
     if t.tzinfo is None:
         t = t.replace(tzinfo=_dt.timezone.utc)

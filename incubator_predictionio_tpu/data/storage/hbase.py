@@ -41,7 +41,7 @@ import urllib.request
 from typing import Iterable, Iterator, Optional, Sequence
 
 from . import base as storage_base
-from .event import Event, new_event_id
+from .event import Event, event_time_us, new_event_id
 from .sqlite import _safe_ident
 
 
@@ -112,11 +112,7 @@ class HBLEvents(storage_base.LEvents):
             self._last_seq = seq
             return seq
 
-    @staticmethod
-    def _time_us(t: _dt.datetime) -> int:
-        if t.tzinfo is None:
-            t = t.replace(tzinfo=_dt.timezone.utc)
-        return int(t.timestamp() * 1_000_000)
+    _time_us = staticmethod(event_time_us)
 
     @staticmethod
     def _data_key(time_us: int, seq: int) -> bytes:

@@ -30,15 +30,9 @@ import json
 from typing import Iterable, Iterator, Optional, Sequence
 
 from . import base
-from .event import Event, new_event_id
+from .event import Event, event_time_us as _time_us, new_event_id
 from .pgwire import PGConnection, PGError
 from .sqlite import _safe_ident
-
-
-def _time_us(t: _dt.datetime) -> int:
-    if t.tzinfo is None:
-        t = t.replace(tzinfo=_dt.timezone.utc)
-    return int(t.timestamp() * 1_000_000)
 
 
 def _from_us(us) -> Optional[_dt.datetime]:

@@ -32,7 +32,7 @@ def apply_runtime_passthrough(extra: list[str]) -> None:
             raise SystemExit(
                 f"[error] runtime passthrough args must be --flags, got {tok!r}")
         body = tok[2:]
-        key, sep, value = body.partition("=")
+        key, _, value = body.partition("=")
         if key == "mesh":
             if not value:
                 raise SystemExit(
