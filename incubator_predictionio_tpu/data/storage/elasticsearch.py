@@ -207,15 +207,28 @@ class ESLEvents(base.LEvents):
     def __init__(self, transport: _ESTransport, namespace: str):
         self._t = transport
         self._ns = namespace
+        self._ensured: set[str] = set()
 
     def _idx(self, app_id, channel_id):
         return _event_index(self._ns, app_id, channel_id)
 
+    def _ensured_idx(self, app_id, channel_id) -> str:
+        """Index name, created with the RIGHT mappings if needed: relying
+        on ES dynamic auto-creation would map entity ids as analyzed
+        text and term filters would silently miss events on a real
+        cluster (the keyword dynamic_template must be present)."""
+        index = self._idx(app_id, channel_id)
+        if index not in self._ensured:
+            self._t.ensure_index(index, event_index=True)
+            self._ensured.add(index)
+        return index
+
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        self._t.ensure_index(self._idx(app_id, channel_id), event_index=True)
+        self._ensured_idx(app_id, channel_id)
         return True
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._ensured.discard(self._idx(app_id, channel_id))
         return self._t.drop_index(self._idx(app_id, channel_id))
 
     @staticmethod
@@ -228,7 +241,7 @@ class ESLEvents(base.LEvents):
                channel_id: Optional[int] = None) -> str:
         eid = event.event_id or new_event_id()
         stored = event.with_event_id(eid)
-        self._t.put_doc(self._idx(app_id, channel_id), eid,
+        self._t.put_doc(self._ensured_idx(app_id, channel_id), eid,
                         self._source(stored))
         return eid
 
@@ -241,7 +254,7 @@ class ESLEvents(base.LEvents):
                      channel_id: Optional[int] = None) -> list[str]:
         if not events:
             return []
-        index = self._idx(app_id, channel_id)
+        index = self._ensured_idx(app_id, channel_id)
         ids: list[str] = []
         for lo in range(0, len(events), self._BULK_PAGE):
             lines = []
@@ -256,6 +269,28 @@ class ESLEvents(base.LEvents):
             if status != 200 or body.get("errors"):
                 raise ESStorageError(f"bulk insert: HTTP {status} {body}")
         return ids
+
+    def delete_batch(self, event_ids: Sequence[str], app_id: int,
+                     channel_id: Optional[int] = None) -> list[bool]:
+        """Paged _bulk delete — one request per page instead of one HTTP
+        round trip (with refresh) per event."""
+        if not event_ids:
+            return []
+        index = self._idx(app_id, channel_id)
+        out: list[bool] = []
+        for lo in range(0, len(event_ids), self._BULK_PAGE):
+            page = event_ids[lo:lo + self._BULK_PAGE]
+            lines = [json.dumps({"delete": {"_index": index, "_id": eid}})
+                     for eid in page]
+            status, body = self._t.request(
+                "POST", "/_bulk?refresh=true", ndjson="\n".join(lines) + "\n")
+            if status != 200:
+                raise ESStorageError(f"bulk delete: HTTP {status} {body}")
+            for item in body.get("items", []):
+                res = item.get("delete", {})
+                out.append(res.get("status") == 200
+                           and res.get("result") != "not_found")
+        return out
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
@@ -329,8 +364,7 @@ class ESPEvents(base.PEvents):
 
     def delete(self, event_ids: Iterable[str], app_id: int,
                channel_id: Optional[int] = None) -> None:
-        for eid in event_ids:
-            self._l.delete(eid, app_id, channel_id)
+        self._l.delete_batch(list(event_ids), app_id, channel_id)
 
 
 # -- metadata ---------------------------------------------------------------

@@ -50,6 +50,28 @@ def event_time_us(t: _dt.datetime) -> int:
     return int(t.timestamp() * 1_000_000)
 
 
+class MonotoneNs:
+    """Client-side monotone insertion counter (wall-clock ns, bumped past
+    the previous value): orders equal-timestamp event ties by insertion,
+    survives restarts, and stays best-effort across multiple concurrent
+    writer processes (tie order between two SIMULTANEOUS inserts is
+    unspecified by the storage contract). Used by backends whose stores
+    have no server-side sequence (HBase rowkeys, Postgres seq column)."""
+
+    def __init__(self) -> None:
+        import threading
+        import time
+
+        self._time_ns = time.time_ns
+        self._last = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._last = max(self._last + 1, self._time_ns())
+            return self._last
+
+
 def format_event_time(t: _dt.datetime) -> str:
     if t.tzinfo is None:
         t = t.replace(tzinfo=_dt.timezone.utc)

@@ -33,15 +33,13 @@ from __future__ import annotations
 import base64
 import datetime as _dt
 import json
-import threading
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Iterable, Iterator, Optional, Sequence
 
 from . import base as storage_base
-from .event import Event, event_time_us, new_event_id
+from .event import Event, MonotoneNs, event_time_us, new_event_id
 from .sqlite import _safe_ident
 
 
@@ -92,8 +90,7 @@ class HBLEvents(storage_base.LEvents):
     def __init__(self, transport: _HBaseRest, namespace: str):
         self._t = transport
         self._ns = _safe_ident(namespace).lower()
-        self._seq_lock = threading.Lock()
-        self._last_seq = 0
+        self._seq = MonotoneNs()
 
     def _table(self, app_id: int, channel_id: Optional[int]) -> str:
         name = f"{self._ns}_{int(app_id)}"
@@ -102,15 +99,7 @@ class HBLEvents(storage_base.LEvents):
         return name
 
     def _next_seq(self) -> int:
-        """Client-side monotone insertion counter (wall-clock ns, bumped
-        past the previous value): orders equal-timestamp ties by
-        insertion, surviving restarts; best-effort across multiple
-        concurrent writer processes (the tie order between two
-        SIMULTANEOUS inserts is unspecified by the contract)."""
-        with self._seq_lock:
-            seq = max(self._last_seq + 1, time.time_ns())
-            self._last_seq = seq
-            return seq
+        return self._seq.next()
 
     _time_us = staticmethod(event_time_us)
 

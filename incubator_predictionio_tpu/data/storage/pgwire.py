@@ -183,6 +183,10 @@ class PGConnection:
             elif mtype in (b"S", b"K", b"N"):  # ParameterStatus/BackendKey/Notice
                 continue
             elif mtype == b"Z":  # ReadyForQuery
+                # hex bytea output is assumed by the row decoder; legacy
+                # 'escape'-configured servers would otherwise corrupt
+                # blobs silently
+                self._query_locked("SET bytea_output = 'hex'", ())
                 return
             else:
                 raise PGProtocolError(f"unexpected message {mtype!r} in startup")

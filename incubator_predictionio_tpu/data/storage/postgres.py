@@ -15,12 +15,13 @@ data/storage/pgwire.py — the Postgres wire protocol spoken directly
 Schema notes: event/metadata times are stored as BIGINT epoch
 microseconds (UTC), events keep their full wire JSON alongside the
 filterable columns, and the cross-backend event tie-order contract rides
-a monotone ``seq`` column — an upsert is one atomic INSERT ... ON
-CONFLICT DO UPDATE that assigns a fresh seq, moving the event to the END
-of its equal-timestamp group like every other backend. Generated ids use
-MAX(id)+1 inside the insert statement; metadata writes are low-rate and
-the storage layer serializes per-process access (the reference's
-JDBCUtils generated keys carry the same caveat).
+a monotone ``seq`` column (client-side counter, event.MonotoneNs) — an
+upsert is one atomic INSERT ... ON CONFLICT DO UPDATE that assigns a
+fresh seq, moving the event to the END of its equal-timestamp group like
+every other backend; bulk ingest rides multi-row INSERTs. Generated
+METADATA ids use MAX(id)+1 inside the insert statement; metadata writes
+are low-rate and the storage layer serializes per-process access (the
+reference's JDBCUtils generated keys carry the same caveat).
 """
 
 from __future__ import annotations
@@ -30,7 +31,8 @@ import json
 from typing import Iterable, Iterator, Optional, Sequence
 
 from . import base
-from .event import Event, event_time_us as _time_us, new_event_id
+from .event import (Event, MonotoneNs,
+                    event_time_us as _time_us, new_event_id)
 from .pgwire import PGConnection, PGError
 from .sqlite import _safe_ident
 
@@ -45,6 +47,11 @@ class PGLEvents(base.LEvents):
     def __init__(self, conn: PGConnection, namespace: str):
         self._c = conn
         self._t = f"{_safe_ident(namespace)}_events".lower()
+        # client-side monotone seq (tie order): a MAX(seq)+1 subquery per
+        # insert would full-scan without a dedicated index and still race
+        # across writers; the client counter has the same best-effort
+        # concurrent semantics at zero query cost
+        self._seq = MonotoneNs()
         self._ensure()
 
     def _ensure(self):
@@ -65,6 +72,8 @@ class PGLEvents(base.LEvents):
         self._c.query(
             f"CREATE INDEX IF NOT EXISTS {self._t}_time "
             f"ON {self._t} (appid, channelid, eventtimeus, seq)")
+        self._c.query(
+            f"CREATE INDEX IF NOT EXISTS {self._t}_seq ON {self._t} (seq)")
 
     @staticmethod
     def _chan(channel_id: Optional[int]) -> int:
@@ -88,27 +97,71 @@ class PGLEvents(base.LEvents):
         # Atomic upsert: the fresh seq moves the event to the END of its
         # equal-timestamp tie group (cross-backend contract). One
         # statement, so a crash never loses the event and a concurrent
-        # duplicate id upserts instead of erroring. (The MAX(seq)+1 read
-        # can still collide across CONCURRENT writers — ties between two
-        # simultaneously-inserted events are then unordered, which the
-        # contract leaves unspecified anyway.)
+        # duplicate id upserts instead of erroring.
         self._c.query(
-            f"INSERT INTO {self._t} (appid, channelid, eventid, seq, event,"
-            " entitytype, entityid, targetentitytype, targetentityid,"
-            " eventtimeus, eventjson) VALUES ($1,$2,$3,"
-            f" (SELECT COALESCE(MAX(seq),0)+1 FROM {self._t}),"
-            " $4,$5,$6,$7,$8,$9,$10)"
-            " ON CONFLICT (appid, channelid, eventid) DO UPDATE SET"
+            self._INSERT_SQL + " ON CONFLICT (appid, channelid, eventid)"
+            " DO UPDATE SET"
             " seq=excluded.seq, event=excluded.event,"
             " entitytype=excluded.entitytype, entityid=excluded.entityid,"
             " targetentitytype=excluded.targetentitytype,"
             " targetentityid=excluded.targetentityid,"
             " eventtimeus=excluded.eventtimeus, eventjson=excluded.eventjson",
-            (app_id, chan, eid, stored.event, stored.entity_type,
-             stored.entity_id, stored.target_entity_type,
-             stored.target_entity_id, _time_us(stored.event_time),
-             json.dumps(stored.to_json())))
+            (app_id, chan, eid, self._seq.next()) + self._row_tail(stored))
         return eid
+
+    @property
+    def _INSERT_SQL(self) -> str:
+        return (f"INSERT INTO {self._t} (appid, channelid, eventid, seq,"
+                " event, entitytype, entityid, targetentitytype,"
+                " targetentityid, eventtimeus, eventjson)"
+                " VALUES ($1,$2,$3,$4,$5,$6,$7,$8,$9,$10,$11)")
+
+    @staticmethod
+    def _row_tail(stored: Event) -> tuple:
+        return (stored.event, stored.entity_type, stored.entity_id,
+                stored.target_entity_type, stored.target_entity_id,
+                _time_us(stored.event_time), json.dumps(stored.to_json()))
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> list[str]:
+        """Bulk ingest: fresh-uuid events (no possible conflict) ride
+        multi-row INSERTs in chunks; client-supplied ids take the
+        per-event upsert path."""
+        chan = self._chan(channel_id)
+        ids: list[str] = []
+        CHUNK = 200  # 11 params/row, well under the 65535 bind limit
+        fresh: list[Event] = []
+
+        def flush():
+            if not fresh:
+                return
+            cols = ("(appid, channelid, eventid, seq, event, entitytype,"
+                    " entityid, targetentitytype, targetentityid,"
+                    " eventtimeus, eventjson)")
+            rows_sql, params = [], []
+            for e in fresh:
+                b = len(params)
+                rows_sql.append(
+                    "(" + ",".join(f"${b + j}" for j in range(1, 12)) + ")")
+                params.extend((app_id, chan, e.event_id, self._seq.next())
+                              + self._row_tail(e))
+            self._c.query(
+                f"INSERT INTO {self._t} {cols} VALUES "
+                + ",".join(rows_sql), params)
+            fresh.clear()
+
+        for e in events:
+            if e.event_id:
+                flush()
+                ids.append(self.insert(e, app_id, channel_id))
+            else:
+                eid = new_event_id()
+                fresh.append(e.with_event_id(eid))
+                ids.append(eid)
+                if len(fresh) >= CHUNK:
+                    flush()
+        flush()
+        return ids
 
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
@@ -191,8 +244,7 @@ class PGPEvents(base.PEvents):
 
     def write(self, events: Iterable[Event], app_id: int,
               channel_id: Optional[int] = None) -> None:
-        for e in events:
-            self._l.insert(e, app_id, channel_id)
+        self._l.insert_batch(list(events), app_id, channel_id)
 
     def delete(self, event_ids: Iterable[str], app_id: int,
                channel_id: Optional[int] = None) -> None:

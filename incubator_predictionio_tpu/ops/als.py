@@ -415,7 +415,7 @@ def _cached_train_fn(mesh: Mesh, params: ALSParams, plan_u: LayoutPlan,
     shapes (repeat trains, eval sweeps, serving reload-retrain loops)."""
     key = (
         tuple(id(d) for d in mesh.devices.flat), mesh.axis_names,
-        dataclasses.astuple(params)[:len(dataclasses.fields(params))],
+        dataclasses.astuple(params),
         _plan_signature(plan_u), _plan_signature(plan_i),
         jax.process_count(),
     )

@@ -83,10 +83,20 @@ def default_mesh(refresh: bool = False) -> Mesh:
                 raise ValueError(
                     f"PIO_MESH_SHAPE/--mesh requests {shape} = {n} devices "
                     f"but only {len(devices)} are available")
+            chosen = devices[:n]
+            if jax.process_count() > 1:
+                # every process must own a shard or its collectives hang
+                # with an opaque sharding error
+                procs = {d.process_index for d in chosen}
+                if len(procs) != jax.process_count():
+                    raise ValueError(
+                        f"PIO_MESH_SHAPE/--mesh shape {shape} uses only "
+                        f"devices of processes {sorted(procs)} but "
+                        f"{jax.process_count()} processes are running — "
+                        "the mesh must span every process")
             axes = (DATA_AXIS, MODEL_AXIS)[: len(shape)]
             _default_mesh = mesh_from_devices(
-                shape=shape, axis_names=axes,
-                devices=devices[:n])
+                shape=shape, axis_names=axes, devices=chosen)
     return _default_mesh
 
 

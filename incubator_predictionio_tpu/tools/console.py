@@ -44,11 +44,19 @@ def apply_runtime_passthrough(extra: list[str]) -> None:
         elif key.startswith("jax_"):
             import jax
 
-            v: object = value
-            if value.lower() in ("true", "false"):
+            v: object
+            if not value:
+                v = True  # bare --jax_flag means enable (XLA convention)
+            elif value.lower() in ("true", "false"):
                 v = value.lower() == "true"
-            elif value.isdigit():
-                v = int(value)
+            else:
+                try:
+                    v = int(value)
+                except ValueError:
+                    try:
+                        v = float(value)
+                    except ValueError:
+                        v = value
             jax.config.update(key, v)
         else:
             raise SystemExit(

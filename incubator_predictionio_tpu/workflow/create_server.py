@@ -164,9 +164,19 @@ class EngineServer:
             self._batch_worker())
 
     async def _stop_batcher(self, app) -> None:
+        # stop accepting, cancel the worker, and fail any stranded
+        # queries cleanly instead of leaving their handlers awaiting
+        # futures that will never resolve
+        queue, self._batch_queue = self._batch_queue, None
         if self._batch_task is not None:
             self._batch_task.cancel()
             self._batch_task = None
+        if queue is not None:
+            while not queue.empty():
+                _, fut = queue.get_nowait()
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError("engine server shutting down"))
 
     async def _batch_worker(self) -> None:
         """Coalesce queued queries: wait for the first, gather more until

@@ -138,14 +138,25 @@ def build_es_app():
         i = 0
         while i < len(lines):
             action = json.loads(lines[i])
-            if "index" not in action:
-                return es_json(400, {"error": "only index actions supported"})
-            meta = action["index"]
-            source = json.loads(lines[i + 1])
-            version, seq = _put_doc(meta["_index"], meta["_id"], source)
-            items.append({"index": {"_id": meta["_id"], "status": 200,
-                                    "_version": version, "_seq_no": seq}})
-            i += 2
+            if "index" in action:
+                meta = action["index"]
+                source = json.loads(lines[i + 1])
+                version, seq = _put_doc(meta["_index"], meta["_id"], source)
+                items.append({"index": {"_id": meta["_id"], "status": 200,
+                                        "_version": version, "_seq_no": seq}})
+                i += 2
+            elif "delete" in action:
+                meta = action["delete"]
+                idx = indices.get(meta["_index"])
+                existed = (idx is not None
+                           and idx.docs.pop(meta["_id"], None) is not None)
+                items.append({"delete": {
+                    "_id": meta["_id"],
+                    "status": 200 if existed else 404,
+                    "result": "deleted" if existed else "not_found"}})
+                i += 1
+            else:
+                return es_json(400, {"error": "unsupported bulk action"})
         return es_json(200, {"errors": False, "items": items})
 
     async def handle_search(request):

@@ -34,6 +34,8 @@ class _Db:
         self.lock = threading.RLock()
 
     def execute(self, sql: str, params):
+        if sql.strip().upper().startswith("SET "):
+            return [], []  # session parameters: accepted, no-op
         sql = re.sub(r"\$(\d+)", r"?\1", sql)
         sql = re.sub(r"\bBYTEA\b", "BLOB", sql)
         with self.lock:
