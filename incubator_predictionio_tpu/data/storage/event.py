@@ -71,6 +71,14 @@ class MonotoneNs:
             self._last = max(self._last + 1, self._time_ns())
             return self._last
 
+    def prime(self, floor: int) -> None:
+        """Raise the counter past an externally-observed maximum (e.g.
+        the store's current MAX(seq)) so a wall clock stepped backwards
+        between restarts cannot emit sequence numbers below already-
+        committed rows."""
+        with self._lock:
+            self._last = max(self._last, int(floor))
+
 
 def format_event_time(t: _dt.datetime) -> str:
     if t.tzinfo is None:

@@ -99,6 +99,12 @@ class HBLEvents(storage_base.LEvents):
         return name
 
     def _next_seq(self) -> int:
+        # Caveat vs the PG backend: the REST gateway has no cheap
+        # max-rowkey read to prime the counter from, so a wall clock
+        # stepped BACKWARDS between writer restarts can order an upsert
+        # below its pre-existing tie group (ties are otherwise
+        # insertion-ordered; simultaneous multi-writer ties are
+        # unspecified by the contract either way).
         return self._seq.next()
 
     _time_us = staticmethod(event_time_us)

@@ -49,10 +49,15 @@ class PGLEvents(base.LEvents):
         self._t = f"{_safe_ident(namespace)}_events".lower()
         # client-side monotone seq (tie order): a MAX(seq)+1 subquery per
         # insert would full-scan without a dedicated index and still race
-        # across writers; the client counter has the same best-effort
-        # concurrent semantics at zero query cost
+        # across writers; the client counter costs zero queries per
+        # insert and is PRIMED from the store's committed maximum below,
+        # so a wall clock stepped backwards between restarts cannot
+        # order an upsert below its existing tie group
         self._seq = MonotoneNs()
         self._ensure()
+        _, rows = self._c.query(
+            f"SELECT COALESCE(MAX(seq),0) FROM {self._t}")
+        self._seq.prime(int(rows[0][0]))
 
     def _ensure(self):
         self._c.query(
@@ -72,6 +77,8 @@ class PGLEvents(base.LEvents):
         self._c.query(
             f"CREATE INDEX IF NOT EXISTS {self._t}_time "
             f"ON {self._t} (appid, channelid, eventtimeus, seq)")
+        # serves the one-time MAX(seq) startup seed of the client-side
+        # sequence counter (an unindexed MAX would full-scan)
         self._c.query(
             f"CREATE INDEX IF NOT EXISTS {self._t}_seq ON {self._t} (seq)")
 

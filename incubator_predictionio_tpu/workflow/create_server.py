@@ -180,11 +180,25 @@ class EngineServer:
 
     async def _batch_worker(self) -> None:
         """Coalesce queued queries: wait for the first, gather more until
-        the window closes (or max_batch), one vectorized dispatch."""
+        the window closes (or max_batch), one vectorized dispatch. On
+        cancellation (server shutdown) the IN-FLIGHT batch's futures are
+        failed too — _stop_batcher only sees items still queued."""
+        try:
+            await self._batch_worker_loop()
+        except asyncio.CancelledError:
+            for _, fut in getattr(self, "_inflight_batch", []):
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError("engine server shutting down"))
+            raise
+
+    async def _batch_worker_loop(self) -> None:
         loop = asyncio.get_running_loop()
         window = self.batch_window_ms / 1000.0
         while True:
-            batch = [await self._batch_queue.get()]
+            self._inflight_batch = []
+            batch = self._inflight_batch
+            batch.append(await self._batch_queue.get())
             deadline = loop.time() + window
             while len(batch) < self.max_batch:
                 timeout = deadline - loop.time()
