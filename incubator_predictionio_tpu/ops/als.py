@@ -29,8 +29,11 @@ recipe (PAPERS.md: arxiv 2112.02194):
   (YᵀY + λ·c·I) x = Yᵀr per row with a batched Pallas Gauss-Jordan
   solve (ops/pallas_kernels.py).
 - The whole iteration loop runs inside one jit under shard_map; the only
-  cross-device traffic is the counterpart replication (1-D) or the
-  normal-equation psum + factor re-shard (2-D).
+  cross-device traffic is the counterpart replication (1-D) or, on 2-D
+  meshes, per-chunk normal-equation psums (one [512, k, k] all-reduce
+  per fused solve chunk — same total bytes as a single big psum, more
+  latency points; the price of never materializing the normal
+  equations) + the factor re-shard.
 
 Regularization conventions (must match template behaviour — SURVEY.md §7
 "hard parts"): ``lambda_scaling='nratings'`` multiplies λ by the row's
@@ -71,12 +74,14 @@ class ALSParams:
     # "auto" → bfloat16 on a TPU mesh, float32 elsewhere. Explicit
     # "float32"/"bfloat16" override.
     compute_dtype: str = "auto"
-    # Device-step granularity: each bucket's gather+gram slab is chunked
-    # to ≈ chunk_tiles × block_len gathered entries per step, bounding
-    # the live [chunk, C_b, k] intermediate. -1 OR 0 = auto (2^17
-    # entries, the measured v5e sweet spot — chunking never changes the
-    # math in this layout, so there is no "unchunked" mode to ask for);
-    # engine.json's chunkTiles maps here.
+    # Device-step granularity: each bucket's gather+gram(+solve) slab is
+    # chunked to ≈ chunk_tiles × block_len gathered entries per step,
+    # bounding the live [chunk, C_b, k] intermediate. -1 OR 0 = auto
+    # (the fused pipeline targets 512-row chunks — the Pallas solve's
+    # native slab width — capped at ~0.5 GB of gathered slab; chunking
+    # never changes the math in this layout, so there is no "unchunked"
+    # mode to ask for); engine.json's chunkTiles maps here and an
+    # explicit value bounds the fused slab too.
     chunk_tiles: int = -1
 
 
@@ -184,9 +189,83 @@ def _slab_normal_eq(gather, colb, valb, *, sentinel, entries_per_step,
             rhs.reshape(n_sub * chunk_r, k)[:R])
 
 
+def _ridge_solve(a, b, lam, yty, *, implicit, model_sharded, platform, k):
+    """psum → +YᵀY → ridge → batched SPD solve (shared by the fused
+    per-chunk path and the heavy-bucket path)."""
+    if model_sharded:
+        # Reconstruct the full per-row normal equations from the shard
+        # partials — the one collective of the sharded gather.
+        a = jax.lax.psum(a, MODEL_AXIS)
+        b = jax.lax.psum(b, MODEL_AXIS)
+    if implicit:
+        a = a + yty[None, :, :]  # shared YᵀY term (all items)
+    a = a + lam[:, None, None] * jnp.eye(k, dtype=jnp.float32)
+    # Pallas VMEM Gauss-Jordan on TPU, XLA Cholesky elsewhere. platform
+    # is the MESH's device platform, threaded from the caller —
+    # jax.default_backend() is wrong here: the driver dry-runs a CPU mesh
+    # while a TPU stays the process default backend (and vice versa in
+    # tests), and pallas_call on CPU without interpret mode is an error.
+    x = batched_spd_solve(a, b, vma=(DATA_AXIS,), platform=platform)
+    return x.astype(jnp.float32)
+
+
+#: rows per fused gather→gram→solve step: the Pallas solve's native slab
+#: width, so per-chunk solves carry zero batch padding
+_FUSED_CHUNK_ROWS = 512
+#: cap on the gathered [chunk, C, k] slab bytes per fused step
+_FUSED_SLAB_BYTES = 512 * 1024 * 1024
+
+
+def _fused_bucket_solve(gather, colb, valb, lam_b, yty, *, sentinel,
+                        entries_budget, implicit, alpha, compute_dtype,
+                        model_sharded, platform, k):
+    """One NON-overflow bucket: gather → per-row grams → ridge → solve,
+    chunked over rows, never materializing the bucket's [R, k, k] normal
+    equations (at rank 128 the full-side materialization would be ~11 GB
+    at ML-20M — the r3 fused design keeps live memory per step at the
+    [chunk, C, k] gather slab plus one [chunk, k, k] gram block).
+    ``entries_budget``: user-configured cap on chunk_r × C (chunkTiles ×
+    blockLen) — None = auto (512 rows, byte-capped)."""
+    R, C = colb.shape
+    cd_bytes = 2 if compute_dtype == jnp.bfloat16 else 4
+    chunk_r = _FUSED_CHUNK_ROWS
+    while chunk_r > 64 and (
+        chunk_r * C * k * cd_bytes > _FUSED_SLAB_BYTES
+        or (entries_budget is not None and chunk_r * C > entries_budget
+            and chunk_r > 1)
+    ):
+        chunk_r //= 2
+    if entries_budget is not None:
+        chunk_r = max(1, min(chunk_r, entries_budget // max(C, 1) or 1))
+    chunk_r = min(chunk_r, max(R, 1))
+    n_sub = -(-R // chunk_r)
+    kw = dict(implicit=implicit, alpha=alpha, compute_dtype=compute_dtype)
+
+    def solve_chunk(ccol, cval, clam):
+        grams, rhs = _grams_rows(gather(ccol), cval, **kw)
+        return _ridge_solve(grams, rhs, clam, yty, implicit=implicit,
+                            model_sharded=model_sharded, platform=platform,
+                            k=k)
+
+    if n_sub <= 1:
+        return solve_chunk(colb, valb, lam_b)
+    padR = n_sub * chunk_r - R
+    cc = jnp.pad(colb, ((0, padR), (0, 0)), constant_values=sentinel)
+    vv = jnp.pad(valb, ((0, padR), (0, 0)))
+    # padded lam rows: benign 1.0 ridge keeps the padded systems SPD
+    ll = jnp.pad(lam_b, (0, padR), constant_values=1.0)
+    x = jax.lax.map(
+        lambda chunk: solve_chunk(*chunk),
+        (cc.reshape(n_sub, chunk_r, C), vv.reshape(n_sub, chunk_r, C),
+         ll.reshape(n_sub, chunk_r)),
+    )
+    return x.reshape(n_sub * chunk_r, k)[:R]
+
+
 def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
                      sentinel, implicit, alpha, compute_dtype,
-                     entries_per_step, platform, model_sharded):
+                     entries_per_step, entries_budget, platform,
+                     model_sharded):
     """Solve one side's factors for one shard's slots (runs inside
     shard_map; all arrays are the local shard).
 
@@ -198,53 +277,55 @@ def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
     gather is partial (zeros for non-owned slots) and the per-row normal
     equations are psummed over MODEL_AXIS before the solve — the ALX
     sharded layout, so factor HBM scales with 1/m.
+
+    Non-overflow buckets run the FUSED gather→gram→ridge→solve pipeline
+    (no [R, k, k] materialization); the dedicated heavy bucket (overflow
+    parents, plan.has_heavy_bucket) materializes its small gram block so
+    the virtual slabs can scatter-add into it before its solve.
     """
     k = y.shape[1]
     n_buckets = len(plan.lengths)
-    has_virtual = plan.v_rows_per_shard > 0
+    has_heavy = plan.has_heavy_bucket
+    n_fused = n_buckets - (1 if has_heavy else 0)
 
     def gather(cols):
         if model_sharded:
             return _gather_model_partial(y, cols, compute_dtype)
         return jnp.take(y, cols, axis=0).astype(compute_dtype)
 
-    kw = dict(sentinel=sentinel, entries_per_step=entries_per_step,
-              implicit=implicit, alpha=alpha, compute_dtype=compute_dtype)
-    a_parts, b_parts = [], []
-    for bi in range(n_buckets):
+    solve_kw = dict(implicit=implicit, model_sharded=model_sharded,
+                    platform=platform, k=k)
+    base = 0
+    x_parts = []
+    for bi in range(n_fused):
         colb, valb = bucket_args[2 * bi], bucket_args[2 * bi + 1]
-        grams, rhs = _slab_normal_eq(gather, colb, valb, **kw)
-        a_parts.append(grams)
-        b_parts.append(rhs)
-    a = jnp.concatenate(a_parts, axis=0) if len(a_parts) > 1 else a_parts[0]
-    b = jnp.concatenate(b_parts, axis=0) if len(b_parts) > 1 else b_parts[0]
+        R_b = colb.shape[0]
+        x_parts.append(_fused_bucket_solve(
+            gather, colb, valb, jax.lax.slice(lam, (base,), (base + R_b,)),
+            yty, sentinel=sentinel, entries_budget=entries_budget,
+            alpha=alpha, compute_dtype=compute_dtype, **solve_kw))
+        base += R_b
 
-    if has_virtual:
+    if has_heavy:
+        colb, valb = bucket_args[2 * n_fused], bucket_args[2 * n_fused + 1]
         v_cols, v_vals, v_parent = bucket_args[2 * n_buckets:2 * n_buckets + 3]
+        R_h = colb.shape[0]
+        kw = dict(sentinel=sentinel, entries_per_step=entries_per_step,
+                  implicit=implicit, alpha=alpha,
+                  compute_dtype=compute_dtype)
+        a, b = _slab_normal_eq(gather, colb, valb, **kw)
         vg, vr = _slab_normal_eq(gather, v_cols, v_vals, **kw)
-        # Merge overflow chunks into their parent rows (few thousand rows
-        # at ML-20M scale — the only scatter in the whole half-step).
-        a = a.at[v_parent].add(vg)
-        b = b.at[v_parent].add(vr)
+        # Merge overflow chunks into their parent rows; parents all live
+        # in this (last) bucket, so re-base the shard-local slots.
+        vp = v_parent - base
+        a = a.at[vp].add(vg)
+        b = b.at[vp].add(vr)
+        x_parts.append(_ridge_solve(
+            a, b, jax.lax.slice(lam, (base,), (base + R_h,)), yty,
+            **solve_kw))
 
-    if model_sharded:
-        # Reconstruct the full per-row normal equations from the shard
-        # partials — the one collective of the sharded gather.
-        a = jax.lax.psum(a, MODEL_AXIS)
-        b = jax.lax.psum(b, MODEL_AXIS)
-    if implicit:
-        a = a + yty[None, :, :]  # shared YᵀY term (all items)
-
-    a = a + lam[:, None, None] * jnp.eye(k, dtype=jnp.float32)
-
-    # Batched SPD solve: Pallas VMEM Gauss-Jordan on TPU, XLA Cholesky
-    # elsewhere. platform is the MESH's device platform, threaded from the
-    # caller — jax.default_backend() is wrong here: the driver dry-runs a
-    # CPU mesh while a TPU is still the process default backend (and vice
-    # versa in tests), and pallas_call on CPU without interpret mode is an
-    # error.
-    x = batched_spd_solve(a, b, vma=(DATA_AXIS,), platform=platform)
-    return x.astype(jnp.float32)
+    return (jnp.concatenate(x_parts, axis=0) if len(x_parts) > 1
+            else x_parts[0])
 
 
 def _host_lam(plan: LayoutPlan, params: ALSParams) -> np.ndarray:
@@ -277,6 +358,9 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, plan_u: LayoutPlan,
     (fitted_fn, in_shardings); call as fn(n_iters, x0, y0, *u_flat,
     *i_flat) with the _side_flat arg order."""
     params, entries_per_step = _resolve_params(mesh, params)
+    # an EXPLICIT chunkTiles bounds the fused pipeline's slab too; auto
+    # lets it target the solve's native 512-row chunks
+    entries_budget = entries_per_step if params.chunk_tiles > 0 else None
     cd = jnp.bfloat16 if params.compute_dtype == "bfloat16" else jnp.float32
     implicit = params.implicit_prefs
     # Kernel selection must follow the MESH's platform, not the process
@@ -334,6 +418,7 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, plan_u: LayoutPlan,
                 alpha=params.alpha,
                 compute_dtype=cd,
                 entries_per_step=entries_per_step,
+                entries_budget=entries_budget,
                 platform=mesh_platform,
                 model_sharded=model_sharded,
             ),

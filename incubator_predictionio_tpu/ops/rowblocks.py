@@ -83,6 +83,14 @@ class LayoutPlan:
     v_parent: np.ndarray       # [n_shards*v_rows_per_shard] int64 LOCAL slot
 
     @property
+    def has_heavy_bucket(self) -> bool:
+        """True → the LAST bucket holds exactly the overflow parents
+        (their grams are materialized + merged with the virtual slabs;
+        all other buckets fuse ridge+solve per chunk). Derived, not
+        stored: plan_layout routes heavy rows there iff any exist."""
+        return self.v_rows_per_shard > 0
+
+    @property
     def total_slots(self) -> int:
         return self.n_shards * self.rows_per_shard
 
@@ -117,6 +125,19 @@ def plan_layout(counts: np.ndarray, n_shards: int, m_div: int = 1,
     ladder = length_ladder(int(rem.max()) if n_rows else 8, overflow_len)
     bucket_of_row = np.searchsorted(ladder, np.maximum(rem, 1))
     n_buckets = len(ladder)
+    # Rows with overflow (virtual) chunks go to a DEDICATED LAST bucket:
+    # their normal equations need the virtual scatter-add before the
+    # solve, so the device loop materializes grams only for this (small)
+    # bucket and fuses ridge+solve per chunk everywhere else — the
+    # full [rows, k, k] materialization would be ~11 GB at ML-20M
+    # rank 128.
+    heavy_mask = v_chunks > 0
+    if heavy_mask.any():
+        heavy_cap = ladder[np.searchsorted(
+            ladder, max(int(rem[heavy_mask].max()), 1))]
+        bucket_of_row = np.where(heavy_mask, n_buckets, bucket_of_row)
+        ladder = np.append(ladder, heavy_cap)
+        n_buckets += 1
 
     per_sb = np.bincount(
         shard_of_row * n_buckets + bucket_of_row, minlength=S * n_buckets
