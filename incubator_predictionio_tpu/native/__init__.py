@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 6
+_EXPECTED_VERSION = 7
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -47,7 +47,11 @@ def _src_path() -> str:
 
 
 def _lib_path() -> str:
-    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib", "libpioevent.so")
+    # ABI version in the filename: glibc dlopen dedups by pathname, so a
+    # same-path rebuild inside a live process would silently resolve to
+    # the stale mapped library (its symbols, not the new ones).
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib",
+                        f"libpioevent.v{_EXPECTED_VERSION}.so")
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -92,6 +96,23 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.pio_free.restype = None
     lib.pio_free.argtypes = [ctypes.c_void_p]
+    lib.pio_fill_entries.restype = ctypes.c_int32
+    lib.pio_fill_entries.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),   # row
+        ctypes.POINTER(ctypes.c_int64),   # col
+        ctypes.POINTER(ctypes.c_float),   # val
+        ctypes.c_int64,                   # nnz
+        ctypes.POINTER(ctypes.c_int64),   # col_slot_map
+        ctypes.c_int64,                   # n_cols
+        ctypes.POINTER(ctypes.c_int64),   # prim_base
+        ctypes.POINTER(ctypes.c_int64),   # v_base
+        ctypes.POINTER(ctypes.c_int64),   # vc_e
+        ctypes.POINTER(ctypes.c_int32),   # cursor scratch
+        ctypes.c_int64,                   # n_rows
+        ctypes.POINTER(ctypes.c_int32),   # flat_cols
+        ctypes.POINTER(ctypes.c_float),   # flat_vals
+        ctypes.c_int64,                   # total
+    ]
     return lib
 
 
@@ -105,6 +126,15 @@ def _build() -> str:
     if proc.returncode != 0:
         raise NativeUnavailable(f"g++ build failed: {proc.stderr[-2000:]}")
     os.replace(tmp, out)
+    # drop superseded ABI versions (and the pre-v7 unversioned file)
+    import glob
+
+    for stale in glob.glob(os.path.join(os.path.dirname(out), "libpioevent*.so")):
+        if stale != out:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
     return out
 
 
@@ -273,6 +303,56 @@ def parse_events_jsonl(buf: bytes) -> ColumnarEvents:
         )
     finally:
         lib.pio_free(handle)
+
+
+_FILL_ERRORS = {
+    -1: "column id outside the counterpart slot map",
+    -2: "computed destination outside the flat buffer (inconsistent plan)",
+    -3: "row id outside [0, n_rows)",
+}
+
+
+def fill_entries(row: np.ndarray, col: np.ndarray, val: np.ndarray,
+                 col_slot_map: np.ndarray, prim_base: np.ndarray,
+                 v_base: np.ndarray, vc_e: np.ndarray,
+                 flat_cols: np.ndarray, flat_vals: np.ndarray) -> None:
+    """Native scatter for ops/rowblocks.fill_buckets (see event_codec.cc).
+
+    Mutates ``flat_cols``/``flat_vals`` in place; within-row entry order
+    is the original order, bit-identical to the numpy fallback path.
+    Raises NativeUnavailable when no toolchain, ValueError on the
+    contract violations the library range-checks.
+    """
+    lib = _load()
+    n_rows = int(prim_base.shape[0])
+    row = np.ascontiguousarray(row, np.int64)
+    col = np.ascontiguousarray(col, np.int64)
+    val = np.ascontiguousarray(val, np.float32)
+    col_slot_map = np.ascontiguousarray(col_slot_map, np.int64)
+    prim_base = np.ascontiguousarray(prim_base, np.int64)
+    v_base = np.ascontiguousarray(v_base, np.int64)
+    vc_e = np.ascontiguousarray(vc_e, np.int64)
+    if flat_cols.dtype != np.int32 or not flat_cols.flags.c_contiguous:
+        raise ValueError("fill_entries: flat_cols must be contiguous int32")
+    if flat_vals.dtype != np.float32 or not flat_vals.flags.c_contiguous:
+        raise ValueError("fill_entries: flat_vals must be contiguous float32")
+    cursor = np.empty(n_rows, np.int32)
+
+    def p(a, ct):
+        return a.ctypes.data_as(ctypes.POINTER(ct))
+
+    rc = lib.pio_fill_entries(
+        p(row, ctypes.c_int64), p(col, ctypes.c_int64),
+        p(val, ctypes.c_float), len(row),
+        p(col_slot_map, ctypes.c_int64), len(col_slot_map),
+        p(prim_base, ctypes.c_int64), p(v_base, ctypes.c_int64),
+        p(vc_e, ctypes.c_int64), p(cursor, ctypes.c_int32), n_rows,
+        p(flat_cols, ctypes.c_int32), p(flat_vals, ctypes.c_float),
+        len(flat_cols),
+    )
+    if rc != 0:
+        raise ValueError(
+            f"fill_entries: {_FILL_ERRORS.get(rc, f'error {rc}')}")
 
 
 def _scan_object_bytes(rec: bytes, start: int) -> int:

@@ -229,11 +229,7 @@ def _fused_bucket_solve(gather, colb, valb, lam_b, yty, *, sentinel,
     R, C = colb.shape
     cd_bytes = 2 if compute_dtype == jnp.bfloat16 else 4
     chunk_r = _FUSED_CHUNK_ROWS
-    while chunk_r > 64 and (
-        chunk_r * C * k * cd_bytes > _FUSED_SLAB_BYTES
-        or (entries_budget is not None and chunk_r * C > entries_budget
-            and chunk_r > 1)
-    ):
+    while chunk_r > 64 and chunk_r * C * k * cd_bytes > _FUSED_SLAB_BYTES:
         chunk_r //= 2
     if entries_budget is not None:
         chunk_r = max(1, min(chunk_r, entries_budget // max(C, 1) or 1))

@@ -34,10 +34,9 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _gauss_jordan_kernel(a_ref, b_ref, x_ref, a_s, b_s, *, k: int):
-    """Solve C systems: a_ref [k, k, C], b_ref [k, C] → x_ref [k, C].
+def _gj_eliminate(a_s, b_s, *, k: int):
+    """Run the elimination on VMEM scratch [k, k, C] / [k, C]; return x.
 
-    a_s/b_s are VMEM scratch copies mutated in place by the elimination.
     Normalization-free Gauss-Jordan: pivot rows are never scaled in place
     (row j's elimination factor is masked to zero, so row j survives
     verbatim); after k elimination steps A is diagonal and one division
@@ -46,9 +45,6 @@ def _gauss_jordan_kernel(a_ref, b_ref, x_ref, a_s, b_s, *, k: int):
     normalized pivot row cost as much as the elimination FMA itself.
     """
     from jax.experimental import pallas as pl
-
-    a_s[...] = a_ref[...]
-    b_s[...] = b_ref[...]
 
     row_ids = jax.lax.broadcasted_iota(jnp.int32, (k, 1), 0)  # [k, 1]
 
@@ -74,7 +70,44 @@ def _gauss_jordan_kernel(a_ref, b_ref, x_ref, a_s, b_s, *, k: int):
     col_ids = jax.lax.broadcasted_iota(jnp.int32, (k, k), 1)
     eye_mask = (row_ids == col_ids).astype(jnp.float32)     # [k, k]
     diag = jnp.sum(a_s[...] * eye_mask[:, :, None], axis=1)  # [k, C]
-    x_ref[...] = b_s[...] / diag
+    return b_s[...] / diag
+
+
+def _gauss_jordan_kernel(a_ref, b_ref, x_ref, a_s, b_s, *, k: int):
+    """Solve C systems: a_ref [k, k, C], b_ref [k, C] → x_ref [k, C].
+
+    a_s/b_s are VMEM scratch copies mutated in place by the elimination.
+    """
+    a_s[...] = a_ref[...]
+    b_s[...] = b_ref[...]
+    x_ref[...] = _gj_eliminate(a_s, b_s, k=k)
+
+
+def _gauss_jordan_kernel_wide(a_hbm, b_hbm, x_hbm, a_s, b_s, sems, *, k: int):
+    """Wide-rank slab (96 < k ≤ 128): a_hbm [G, k, k, C], C = 128.
+
+    At k=128 the f32 [k, k, C] slab is 8 MB, so the pipelined kernel's
+    double-buffered input block plus scratch copy (24 MB) exceeds VMEM
+    (and Mosaic rejects lane blocks narrower than 128). Slabs therefore
+    stay in HBM (ANY space) and each grid step DMAs ONE slab into a
+    single VMEM scratch — no double buffering. The elimination is
+    compute-bound (k⁴·C/k ≈ 0.5 GFLOP/slab against 8 MB of traffic), so
+    the lost DMA/compute overlap is noise.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+    cp_a = pltpu.make_async_copy(a_hbm.at[i], a_s, sems.at[0])
+    cp_b = pltpu.make_async_copy(b_hbm.at[i], b_s, sems.at[1])
+    cp_a.start()
+    cp_b.start()
+    cp_a.wait()
+    cp_b.wait()
+    b_s[...] = _gj_eliminate(a_s, b_s, k=k)
+    cp_x = pltpu.make_async_copy(b_s, x_hbm.at[i], sems.at[2])
+    cp_x.start()
+    cp_x.wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "vma"))
@@ -92,8 +125,10 @@ def _solve_lanes(a_t, b_t, *, interpret: bool = False, vma=None):
         out_shape = jax.ShapeDtypeStruct((k, n), jnp.float32, vma=vma)
     else:
         out_shape = jax.ShapeDtypeStruct((k, n), jnp.float32)
-    # Slab width: full lane utilization, capped so a f32 [k, k, C] slab
-    # (plus its scratch copy and double buffering) stays well under VMEM.
+    # Slab width: full lane utilization, capped so the f32 [k, k, C]
+    # input block (double-buffered by the pipeline) plus its scratch copy
+    # stays under the ~16 MB VMEM budget. Ranks past 96 take the wide
+    # path (_solve_slabs_wide) instead.
     c = 512 if k <= 32 else (256 if k <= 48 else 128)
     c = min(c, n)
     grid = (n // c,)
@@ -118,8 +153,43 @@ def _solve_lanes(a_t, b_t, *, interpret: bool = False, vma=None):
     )(a_t, b_t)
 
 
+@functools.partial(jax.jit, static_argnames=("interpret", "vma"))
+def _solve_slabs_wide(a_g, b_g, *, interpret: bool = False, vma=None):
+    """a_g [G, k, k, 128], b_g [G, k, 128] → x_g [G, k, 128] (96 < k ≤ 128).
+
+    Slab-major layout: the caller pre-transposes so each grid step's slab
+    is one contiguous [k, k, 128] block — the kernel's manual DMA is a
+    single contiguous transfer (see _gauss_jordan_kernel_wide).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g, k, _, c = a_g.shape
+    if vma is not None:
+        out_shape = jax.ShapeDtypeStruct((g, k, c), jnp.float32, vma=vma)
+    else:
+        out_shape = jax.ShapeDtypeStruct((g, k, c), jnp.float32)
+    kernel = functools.partial(_gauss_jordan_kernel_wide, k=k)
+    return pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((k, k, c), jnp.float32),
+            pltpu.VMEM((k, c), jnp.float32),
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=interpret,
+    )(a_g, b_g)
+
+
 def _solve_reference(a, b):
-    """XLA fallback: batched Cholesky solve (CPU and rank > 64)."""
+    """XLA fallback: batched Cholesky solve (CPU and rank > 128)."""
     chol = jnp.linalg.cholesky(a)
     return jax.scipy.linalg.cho_solve((chol, True), b[..., None])[..., 0]
 
@@ -132,7 +202,7 @@ def batched_spd_solve(a, b, *, use_pallas: bool | None = None,
     a: [N, k, k] float32, b: [N, k] float32 → x [N, k] float32.
 
     ``use_pallas=None`` auto-selects: the Pallas kernel when ``platform``
-    is "tpu" and k ≤ 64 (the kernel's VMEM slab cap), the XLA Cholesky
+    is "tpu" and k ≤ 128 (the kernel's VMEM slab cap), the XLA Cholesky
     path otherwise. ``platform`` must be the platform of the devices that
     will EXECUTE this computation — pass the mesh's device platform when
     calling under shard_map/jit-with-shardings; it defaults to
@@ -145,13 +215,16 @@ def batched_spd_solve(a, b, *, use_pallas: bool | None = None,
     if use_pallas is None:
         if platform is None:
             platform = jax.default_backend()
-        use_pallas = platform == "tpu" and k <= 64
+        use_pallas = platform == "tpu" and k <= 128
     if not use_pallas:
         return _solve_reference(a, b)
 
     kp = _round_up(k, 8)
-    # Multiple of 512 so every slab width (512/256/128) divides the batch.
-    npad = _round_up(max(n, 1), 512)
+    # Lanes path: multiple of 512 so every slab width (512/256/128)
+    # divides the batch. Wide path: its slab width is always 128, and a
+    # padding slab is ~0.5 GFLOP of pure identity solves — don't round
+    # further than needed.
+    npad = _round_up(max(n, 1), 128 if kp > 96 else 512)
     a = a.astype(jnp.float32)
     b = b.astype(jnp.float32)
     if kp != k:
@@ -166,8 +239,18 @@ def batched_spd_solve(a, b, *, use_pallas: bool | None = None,
         a = jnp.concatenate([a, pad], axis=0)
         b = jnp.concatenate([b, jnp.zeros((npad - n, kp), jnp.float32)], axis=0)
 
+    vma_f = None if vma is None else frozenset(vma)
+    if kp > 96:
+        # Wide-rank path: slab-major [G, kp, kp, 128] so each slab is one
+        # contiguous manual-DMA transfer inside the kernel.
+        c = 128
+        g = npad // c
+        a_g = jnp.transpose(a.reshape(g, c, kp, kp), (0, 2, 3, 1))
+        b_g = jnp.transpose(b.reshape(g, c, kp), (0, 2, 1))
+        x_g = _solve_slabs_wide(a_g, b_g, interpret=interpret, vma=vma_f)
+        return jnp.transpose(x_g, (0, 2, 1)).reshape(npad, kp)[:n, :k]
+
     a_t = jnp.transpose(a, (1, 2, 0))  # [kp, kp, Np] — batch on lanes
     b_t = jnp.transpose(b, (1, 0))     # [kp, Np]
-    x_t = _solve_lanes(a_t, b_t, interpret=interpret,
-                       vma=None if vma is None else frozenset(vma))
+    x_t = _solve_lanes(a_t, b_t, interpret=interpret, vma=vma_f)
     return jnp.transpose(x_t, (1, 0))[:n, :k]

@@ -249,13 +249,18 @@ class BucketArrays:
 
 def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
                  val: np.ndarray, col_slot_map: np.ndarray, sentinel: int,
-                 shard0: int = 0, n_local_shards: int | None = None
-                 ) -> BucketArrays:
+                 shard0: int = 0, n_local_shards: int | None = None,
+                 use_native: bool | None = None) -> BucketArrays:
     """Scatter entries into the planned slabs for shards
     [shard0, shard0+n_local_shards). ``row`` must contain ONLY rows owned
     by those shards (the multi-host range-read contract); ``col`` is
     global counterpart row ids, mapped through ``col_slot_map`` into the
     counterpart's π space.
+
+    ``use_native``: None = auto (the C++ single-pass scatter when the
+    toolchain is available — it replaces the numpy path's stable argsort,
+    the dominant host cost of layout prep, and is bit-identical to it);
+    False forces the numpy path (tests use both and assert equality).
     """
     S_loc = plan.n_shards - shard0 if n_local_shards is None else int(n_local_shards)
     val = np.asarray(val, dtype=np.float32)
@@ -272,22 +277,9 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
     flat_vals = np.zeros(int(offsets[-1]), dtype=np.float32)
 
     if len(row):
-        # Hot path over nnz entries: one int32 stable argsort (radix —
-        # 2x+ faster than int64 comparison sort; row ids are bounded by
-        # n_rows, guarded below), then only gathers of small per-ROW
-        # tables + one scatter. All per-row destination arithmetic is
-        # precomputed in O(n_rows).
         if plan.n_rows > 2**31 - 1:
             raise NotImplementedError(
                 "fill_buckets: row ids beyond int32 are not supported")
-        order = np.argsort(np.asarray(row, np.int32), kind="stable")
-        rs = np.asarray(row, np.int64)[order]
-        # remap columns into counterpart pi space at the SOURCE (all
-        # real); sentinel prefill covers the padding slots.
-        cs = np.asarray(col_slot_map, np.int64)[
-            np.asarray(col, np.int64)[order]].astype(np.int32)
-        vs = val[order]
-
         n_rows = plan.n_rows
         shard_r = plan.shard_of_row(np.arange(n_rows, dtype=np.int64))
         # per-row flat bases (garbage for non-local rows — the range
@@ -306,27 +298,60 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
         v_base = (offsets[n_buckets]
                   + ((shard_r - shard0) * Rv + plan.v_base_of_row) * OV)
 
-        # sorted rows → min/max are the ends; range check before any
-        # gather of the per-row tables above
-        s_lo, s_hi = (int(s) for s in plan.shard_of_row(rs[[0, -1]]))
+        # range checks before any gather of the per-row tables above
+        # (also keeps the native and numpy paths raising identically)
+        row64 = np.asarray(row, np.int64)
+        s_lo, s_hi = (int(s) for s in plan.shard_of_row(
+            np.array([row64.min(), row64.max()], np.int64)))
         if s_lo < shard0 or s_hi >= shard0 + S_loc:
             raise ValueError(
                 "fill_buckets: entries reference rows outside shards "
                 f"[{shard0}, {shard0 + S_loc}) — range-read only owned rows")
+        col64 = np.asarray(col, np.int64)
+        if len(col64) and (col64.min() < 0
+                           or col64.max() >= len(col_slot_map)):
+            raise ValueError(
+                "fill_buckets: column ids outside the counterpart slot map")
 
-        # position of each entry within its row (stable original order)
-        rmin = int(rs[0])
-        cnt = np.bincount((rs - rmin).astype(np.int64))
-        starts = np.zeros(len(cnt), dtype=np.int64)
-        np.cumsum(cnt[:-1], out=starts[1:])
-        pos = np.arange(len(rs), dtype=np.int64) - starts[rs - rmin]
+        done = False
+        if use_native is not False:
+            # C++ single-pass scatter (native/src/event_codec.cc
+            # pio_fill_entries): per-row write cursors replace the
+            # argsort + position arithmetic below; same entry order.
+            try:
+                from ..native import NativeUnavailable, fill_entries
+                fill_entries(row64, col64, val, col_slot_map, prim_base,
+                             v_base, vc_r * OV, flat_cols, flat_vals)
+                done = True
+            except NativeUnavailable:
+                if use_native is True:
+                    raise
+        if not done:
+            # numpy fallback: one int32 stable argsort (radix — 2x+
+            # faster than int64 comparison sort; row ids bounded by the
+            # int32 guard above), then only gathers of small per-ROW
+            # tables + one scatter.
+            order = np.argsort(np.asarray(row, np.int32), kind="stable")
+            rs = row64[order]
+            # remap columns into counterpart pi space at the SOURCE (all
+            # real); sentinel prefill covers the padding slots.
+            cs = np.asarray(col_slot_map, np.int64)[
+                col64[order]].astype(np.int32)
+            vs = val[order]
 
-        vc_e = vc_r[rs] * OV
-        dest = np.where(pos < vc_e,
-                        v_base[rs] + pos,
-                        prim_base[rs] + pos - vc_e)
-        flat_cols[dest] = cs
-        flat_vals[dest] = vs
+            # position of each entry within its row (stable original order)
+            rmin = int(rs[0])
+            cnt = np.bincount((rs - rmin).astype(np.int64))
+            starts = np.zeros(len(cnt), dtype=np.int64)
+            np.cumsum(cnt[:-1], out=starts[1:])
+            pos = np.arange(len(rs), dtype=np.int64) - starts[rs - rmin]
+
+            vc_e = vc_r[rs] * OV
+            dest = np.where(pos < vc_e,
+                            v_base[rs] + pos,
+                            prim_base[rs] + pos - vc_e)
+            flat_cols[dest] = cs
+            flat_vals[dest] = vs
 
     cols, vals = [], []
     for b in range(n_buckets):

@@ -98,6 +98,54 @@ def test_layout_overflow_rows():
     np.testing.assert_allclose(dense, ref, rtol=1e-6)
 
 
+def test_fill_buckets_native_matches_numpy():
+    """The C++ single-pass scatter (pio_fill_entries) must be
+    bit-identical to the numpy argsort path — including overflow rows,
+    multi-shard plans, and a local-shard (shard0 > 0) fill."""
+    from incubator_predictionio_tpu import native as pionative
+
+    if not pionative.available():
+        pytest.skip("no C++ toolchain")
+    rng = np.random.default_rng(7)
+    n_rows, n_cols, nnz = 200, 90, 20_000
+    row = rng.integers(0, n_rows, nnz)
+    col = rng.integers(0, n_cols, nnz)
+    row[:3000] = 5  # overflow row (overflow_len=512)
+    val = rng.random(nnz).astype(np.float32)
+    counts = np.bincount(row, minlength=n_rows)
+    cplan = plan_layout(np.bincount(col, minlength=n_cols), 4)
+    plan = plan_layout(counts, 4, overflow_len=512)
+
+    def flat(a):
+        return [*a.cols, a.v_cols, *a.vals, a.v_vals]
+
+    a_np = fill_buckets(plan, row, col, val, cplan.slot_of_row,
+                        cplan.total_slots, use_native=False)
+    a_nc = fill_buckets(plan, row, col, val, cplan.slot_of_row,
+                        cplan.total_slots, use_native=True)
+    for x, y in zip(flat(a_np), flat(a_nc)):
+        assert np.array_equal(x, y)
+
+    # local-shard fill (multi-host contract): only shard 2's rows
+    rpl = -(-n_rows // 4)
+    m = (row >= 2 * rpl) & (row < 3 * rpl)
+    for mode in (False, True):
+        a_loc = fill_buckets(plan, row[m], col[m], val[m],
+                             cplan.slot_of_row, cplan.total_slots,
+                             shard0=2, n_local_shards=1, use_native=mode)
+        if mode:
+            for x, y in zip(flat(prev), flat(a_loc)):
+                assert np.array_equal(x, y)
+        prev = a_loc
+
+    # out-of-shard rows must raise on both paths
+    for mode in (False, True):
+        with pytest.raises(ValueError):
+            fill_buckets(plan, row, col, val, cplan.slot_of_row,
+                         cplan.total_slots, shard0=2, n_local_shards=1,
+                         use_native=mode)
+
+
 def test_length_ladder_shape():
     lad = length_ladder(500)
     assert lad[0] == 8 and (np.diff(lad) > 0).all()

@@ -25,7 +25,12 @@ def _random_spd(n, k, seed=0, scale=1.0):
     return a, b
 
 
-@pytest.mark.parametrize("n,k", [(5, 10), (300, 32), (130, 7), (1, 1), (513, 16)])
+@pytest.mark.parametrize(
+    "n,k", [(5, 10), (300, 32), (130, 7), (1, 1), (513, 16),
+            # k=80: the lanes path's widest slab (C=128, kp=80);
+            # k=128 and k=100 (kp rounds to 104): the wide manual-DMA
+            # path, with and without k-padding
+            (40, 80), (24, 128), (9, 100)])
 def test_interpret_matches_cholesky(n, k):
     a, b = _random_spd(n, k, seed=n + k)
     x_ref = np.asarray(_solve_reference(jnp.asarray(a), jnp.asarray(b)))
