@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 7
+_EXPECTED_VERSION = 8
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -112,6 +112,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int32),   # flat_cols
         ctypes.POINTER(ctypes.c_float),   # flat_vals
         ctypes.c_int64,                   # total
+    ]
+    lib.pio_tfidf_tf.restype = ctypes.c_int32
+    lib.pio_tfidf_tf.argtypes = [
+        ctypes.c_char_p,                  # concatenated utf-8 docs
+        ctypes.POINTER(ctypes.c_int64),   # offsets [n_docs + 1]
+        ctypes.c_int64,                   # n_docs
+        ctypes.c_int32,                   # n_features
+        ctypes.c_int32,                   # ngram
+        ctypes.POINTER(ctypes.c_float),   # out [n_docs, n_features]
     ]
     return lib
 
@@ -353,6 +362,33 @@ def fill_entries(row: np.ndarray, col: np.ndarray, val: np.ndarray,
     if rc != 0:
         raise ValueError(
             f"fill_entries: {_FILL_ERRORS.get(rc, f'error {rc}')}")
+
+
+def tfidf_tf(docs, n_features: int, ngram: int) -> np.ndarray:
+    """Native term-frequency rows (see pio_tfidf_tf in event_codec.cc).
+
+    Bit-identical to ops/tfidf.TfIdfVectorizer's Python token loop.
+    Raises NativeUnavailable when no toolchain.
+    """
+    lib = _load()
+    # errors="replace": lone surrogates (legal in Python str, e.g. out
+    # of json.loads "\ud800" escapes) can't encode to UTF-8. '?' is not
+    # a token byte, and neither is a surrogate under the Python
+    # tokenizer's ASCII class — both act as separators, so replacement
+    # preserves token boundaries and bit-identity with the fallback.
+    enc = [d.encode(errors="replace") for d in docs]
+    offs = np.zeros(len(enc) + 1, np.int64)
+    np.cumsum([len(e) for e in enc], out=offs[1:])
+    buf = b"".join(enc)
+    out = np.zeros((len(enc), n_features), np.float32)
+    rc = lib.pio_tfidf_tf(
+        buf, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(enc), n_features, ngram,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if rc != 0:
+        raise ValueError(f"tfidf_tf: native tokenizer error {rc}")
+    return out
 
 
 def _scan_object_bytes(rec: bytes, start: int) -> int:

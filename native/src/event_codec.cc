@@ -547,7 +547,80 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 7; }
+int32_t pio_codec_version() { return 8; }
+
+namespace {
+// FNV-1a over a byte range, continuing from a running state.
+inline uint32_t fnv1a(uint32_t h, const char* p, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    h = (h ^ static_cast<unsigned char>(p[i])) * 16777619u;
+  }
+  return h;
+}
+constexpr uint32_t kFnvInit = 2166136261u;
+inline bool is_token_byte(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '\'';
+}
+}  // namespace
+
+// Term-frequency rows for the text-classification template: tokenize
+// ([A-Za-z0-9']+ runs, ASCII-lowercased — the token class is pure ASCII
+// so byte-level scanning matches codepoint-level exactly), FNV-1a-hash
+// each token (and each " "-joined n-gram up to `ngram`) into n_features
+// buckets, accumulate counts into the caller-zeroed [n_docs, n_features]
+// row-major float32 matrix. Bit-identical to the Python fallback in
+// ops/tfidf.py. Returns 0, or -1 on invalid offsets.
+int32_t pio_tfidf_tf(const char* buf, const int64_t* offs, int64_t n_docs,
+                     int32_t n_features, int32_t ngram, float* out) {
+  if (n_features <= 0 || ngram < 1) return -1;
+  std::vector<char> low;        // lowercased doc bytes
+  std::vector<int64_t> tok_s;   // token start in `low`
+  std::vector<int64_t> tok_e;   // token end in `low`
+  for (int64_t d = 0; d < n_docs; ++d) {
+    const int64_t b0 = offs[d], b1 = offs[d + 1];
+    if (b0 < 0 || b1 < b0) return -1;
+    low.clear();
+    tok_s.clear();
+    tok_e.clear();
+    low.reserve(b1 - b0);
+    bool in_tok = false;
+    for (int64_t p = b0; p < b1; ++p) {
+      unsigned char c = static_cast<unsigned char>(buf[p]);
+      if (is_token_byte(c)) {
+        if (!in_tok) {
+          tok_s.push_back(static_cast<int64_t>(low.size()));
+          in_tok = true;
+        }
+        low.push_back(c >= 'A' && c <= 'Z' ? c + 32 : c);
+      } else if (in_tok) {
+        tok_e.push_back(static_cast<int64_t>(low.size()));
+        in_tok = false;
+      }
+    }
+    if (in_tok) tok_e.push_back(static_cast<int64_t>(low.size()));
+    // n_features is 4096 by default — mask instead of divide when pow2
+    const uint32_t nf = static_cast<uint32_t>(n_features);
+    const uint32_t mask = (nf & (nf - 1)) == 0 ? nf - 1 : 0;
+    float* row = out + d * static_cast<int64_t>(n_features);
+    const int64_t nt = static_cast<int64_t>(tok_s.size());
+    for (int64_t j = 0; j < nt; ++j) {
+      uint32_t h = fnv1a(kFnvInit, low.data() + tok_s[j], tok_e[j] - tok_s[j]);
+      row[mask ? (h & mask) : (h % nf)] += 1.0f;
+    }
+    for (int32_t n = 2; n <= ngram; ++n) {
+      for (int64_t j = 0; j + n <= nt; ++j) {
+        uint32_t h = kFnvInit;
+        for (int32_t q = 0; q < n; ++q) {
+          if (q) h = (h ^ static_cast<uint32_t>(' ')) * 16777619u;
+          h = fnv1a(h, low.data() + tok_s[j + q], tok_e[j + q] - tok_s[j + q]);
+        }
+        row[mask ? (h & mask) : (h % nf)] += 1.0f;
+      }
+    }
+  }
+  return 0;
+}
 
 // Layout fill for ops/rowblocks.fill_buckets: scatter nnz COO entries
 // into the planned bucket slabs in one sequential pass. Replaces the

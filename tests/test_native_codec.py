@@ -63,6 +63,33 @@ def test_python_oracle_semantics():
     assert c.properties_dict(2) == {}  # no properties key
 
 
+def test_tfidf_native_matches_python():
+    """The C++ tokenizer+hasher (pio_tfidf_tf) must match the Python
+    token loop bit-for-bit: same ASCII token class, same lowercasing,
+    same FNV-1a buckets, same n-gram joins — across unicode text,
+    apostrophes, empty docs, and non-pow2 feature counts."""
+    import random
+
+    from incubator_predictionio_tpu import native as pionative
+    from incubator_predictionio_tpu.ops.tfidf import TfIdfVectorizer
+
+    if not pionative.available():
+        pytest.skip("no C++ toolchain")
+    docs = ["Hello WORLD don't stop", "", "   ", "naïve café déjà-vu 123abc",
+            "a b c d e f", "x'y'z 'quoted' ''", "ABC abc AbC",
+            "tab\tsep\nline", "ü漢字mixedASCII99"]
+    rng = random.Random(1)
+    alphabet = "abcXYZ019'@ü漢 \t\n-_.,"
+    docs += ["".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 300)))
+             for _ in range(100)]
+    for ngram in (1, 2, 3):
+        for n_features in (512, 300):  # pow2 mask path + modulo path
+            v = TfIdfVectorizer(n_features=n_features, ngram=ngram)
+            ref = v.term_frequencies(docs, use_native=False)
+            nat = v.term_frequencies(docs, use_native=True)
+            assert np.array_equal(ref, nat), (ngram, n_features)
+
+
 def test_native_matches_oracle():
     if not native.available():
         pytest.skip("no C++ toolchain")
