@@ -149,6 +149,10 @@ def _build() -> str:
 
 def _load() -> ctypes.CDLL:
     global _lib, _lib_error
+    if os.environ.get("PIO_DISABLE_NATIVE") == "1":
+        # operational kill-switch: force every caller onto the pure-
+        # Python fallbacks (e.g. a miscompiling toolchain in the field)
+        raise NativeUnavailable("disabled by PIO_DISABLE_NATIVE=1")
     with _lock:
         if _lib is not None:
             return _lib

@@ -259,6 +259,53 @@ def test_als_chunking_is_invariant():
     )
 
 
+def test_als_wide_rank_half_step_matches_dense():
+    """Rank > 96 exercises the wide-solve routing and the fused chunk
+    sizing at large k (on CPU the solve falls back to XLA Cholesky; the
+    TPU wide kernel is pinned by interpret-mode tests). One iteration vs
+    the dense NumPy normal equations."""
+    rng = np.random.default_rng(5)
+    n_users, n_items, nnz = 300, 120, 6000
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    key = u.astype(np.int64) * n_items + i
+    _, first = np.unique(key, return_index=True)
+    u, i = u[first], i[first]
+    r = (rng.random(len(u)) * 4 + 1).astype(np.float32)
+
+    from incubator_predictionio_tpu.ops.als import _fresh_init
+    from incubator_predictionio_tpu.ops.rowblocks import plan_layout
+
+    params = ALSParams(rank=100, num_iterations=1, reg=0.1, seed=3,
+                       block_len=8)
+    mesh = mesh_from_devices(devices=__import__("jax").devices("cpu")[:2])
+    out = train_als(u, i, r, n_users, n_items, params, mesh=mesh)
+
+    plan_u = plan_layout(np.bincount(u, minlength=n_users), 2)
+    plan_i = plan_layout(np.bincount(i, minlength=n_items), 2)
+    x0, y0 = _fresh_init(params, plan_u, plan_i, n_users, n_items)
+    y0_g = y0[plan_i.slot_of_row].astype(np.float64)
+
+    def np_step(y, rows, cols, vals, n_rows, reg):
+        k = y.shape[1]
+        x = np.zeros((n_rows, k))
+        for rr in range(n_rows):
+            sel = rows == rr
+            if not sel.any():
+                continue
+            yy = y[cols[sel]]
+            x[rr] = np.linalg.solve(yy.T @ yy + reg * np.eye(k),
+                                    yy.T @ vals[sel])
+        return x
+
+    x_ref = np_step(y0_g, u, i, r, n_users, 0.1)
+    y_ref = np_step(x_ref, i, u, r, n_items, 0.1)
+    np.testing.assert_allclose(out.user_factors, x_ref, rtol=5e-3, atol=5e-4)
+    # item side solves against bf16-rounded user factors (second half-
+    # step compounds the compute-dtype error at k=100): 2e-3 abs bound
+    np.testing.assert_allclose(out.item_factors, y_ref, rtol=5e-3, atol=2e-3)
+
+
 def test_als_overflow_rows_train():
     """A pathologically heavy row (> overflow_len entries) trains and
     matches the dense reference."""
