@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 8
+_EXPECTED_VERSION = 9
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -325,34 +325,41 @@ _FILL_ERRORS = {
 }
 
 
-def fill_entries(row: np.ndarray, col: np.ndarray, val: np.ndarray,
-                 col_slot_map: np.ndarray, prim_base: np.ndarray,
-                 v_base: np.ndarray, vc_e: np.ndarray,
-                 flat_cols: np.ndarray, flat_vals: np.ndarray) -> None:
+def fill_entries(row: np.ndarray, col: np.ndarray, val, col_slot_map,
+                 prim_base: np.ndarray, v_base: np.ndarray,
+                 vc_e: np.ndarray, flat_cols: np.ndarray,
+                 flat_vals) -> None:
     """Native scatter for ops/rowblocks.fill_buckets (see event_codec.cc).
 
     Mutates ``flat_cols``/``flat_vals`` in place; within-row entry order
     is the original order, bit-identical to the numpy fallback path.
-    Raises NativeUnavailable when no toolchain, ValueError on the
-    contract violations the library range-checks.
+    ``val``/``flat_vals`` may be None together (binary-ratings mode —
+    the value slabs are never built). Raises NativeUnavailable when no
+    toolchain, ValueError on the contract violations the library
+    range-checks.
     """
     lib = _load()
     n_rows = int(prim_base.shape[0])
     row = np.ascontiguousarray(row, np.int64)
     col = np.ascontiguousarray(col, np.int64)
-    val = np.ascontiguousarray(val, np.float32)
     col_slot_map = np.ascontiguousarray(col_slot_map, np.int64)
     prim_base = np.ascontiguousarray(prim_base, np.int64)
     v_base = np.ascontiguousarray(v_base, np.int64)
     vc_e = np.ascontiguousarray(vc_e, np.int64)
     if flat_cols.dtype != np.int32 or not flat_cols.flags.c_contiguous:
         raise ValueError("fill_entries: flat_cols must be contiguous int32")
-    if flat_vals.dtype != np.float32 or not flat_vals.flags.c_contiguous:
-        raise ValueError("fill_entries: flat_vals must be contiguous float32")
+    if (flat_vals is None) != (val is None):
+        raise ValueError("fill_entries: val and flat_vals must be "
+                         "both present or both None")
+    if flat_vals is not None:
+        val = np.ascontiguousarray(val, np.float32)
+        if flat_vals.dtype != np.float32 or not flat_vals.flags.c_contiguous:
+            raise ValueError(
+                "fill_entries: flat_vals must be contiguous float32")
     cursor = np.empty(n_rows, np.int32)
 
     def p(a, ct):
-        return a.ctypes.data_as(ctypes.POINTER(ct))
+        return None if a is None else a.ctypes.data_as(ctypes.POINTER(ct))
 
     rc = lib.pio_fill_entries(
         p(row, ctypes.c_int64), p(col, ctypes.c_int64),

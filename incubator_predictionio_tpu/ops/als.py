@@ -83,6 +83,11 @@ class ALSParams:
     # mode to ask for); engine.json's chunkTiles maps here and an
     # explicit value bounds the fused slab too.
     chunk_tiles: int = -1
+    # All-ones ratings (implicit view/buy streams): the value slabs are
+    # fully derivable on device, so train_als skips building/uploading
+    # them — about half the host→device slab bytes. None = auto-detect
+    # from the data; False forces the explicit-value path (tests).
+    binary_ratings: "bool | None" = None
 
 
 @dataclasses.dataclass
@@ -261,7 +266,7 @@ def _fused_bucket_solve(gather, colb, valb, lam_b, yty, *, sentinel,
 def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
                      sentinel, implicit, alpha, compute_dtype,
                      entries_per_step, entries_budget, platform,
-                     model_sharded):
+                     model_sharded, binary=False):
     """Solve one side's factors for one shard's slots (runs inside
     shard_map; all arrays are the local shard).
 
@@ -291,10 +296,21 @@ def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
 
     solve_kw = dict(implicit=implicit, model_sharded=model_sharded,
                     platform=platform, k=k)
+    # binary mode: value slabs were never uploaded — every real entry is
+    # 1.0, and padding/non-owned slots already gather zero factor ROWS,
+    # so a constant-ones val slab is exact (every val use is multiplied
+    # by the gathered row).
+    stride = 1 if binary else 2
+
+    def val_of(colb, idx):
+        return (jnp.ones(colb.shape, jnp.float32) if binary
+                else bucket_args[idx])
+
     base = 0
     x_parts = []
     for bi in range(n_fused):
-        colb, valb = bucket_args[2 * bi], bucket_args[2 * bi + 1]
+        colb = bucket_args[stride * bi]
+        valb = val_of(colb, stride * bi + 1)
         R_b = colb.shape[0]
         x_parts.append(_fused_bucket_solve(
             gather, colb, valb, jax.lax.slice(lam, (base,), (base + R_b,)),
@@ -303,8 +319,14 @@ def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
         base += R_b
 
     if has_heavy:
-        colb, valb = bucket_args[2 * n_fused], bucket_args[2 * n_fused + 1]
-        v_cols, v_vals, v_parent = bucket_args[2 * n_buckets:2 * n_buckets + 3]
+        colb = bucket_args[stride * n_fused]
+        valb = val_of(colb, stride * n_fused + 1)
+        if binary:
+            v_cols, v_parent = bucket_args[n_buckets:n_buckets + 2]
+            v_vals = jnp.ones(v_cols.shape, jnp.float32)
+        else:
+            v_cols, v_vals, v_parent = (
+                bucket_args[2 * n_buckets:2 * n_buckets + 3])
         R_h = colb.shape[0]
         kw = dict(sentinel=sentinel, entries_per_step=entries_per_step,
                   implicit=implicit, alpha=alpha,
@@ -335,15 +357,22 @@ def _host_lam(plan: LayoutPlan, params: ALSParams) -> np.ndarray:
     return (lam + np.where(counts == 0, 1e-6, 0.0)).astype(np.float32)
 
 
-def _side_flat(arrs: BucketArrays, plan: LayoutPlan, lam: np.ndarray):
+def _side_flat(arrs: BucketArrays, plan: LayoutPlan, lam: np.ndarray,
+               binary: bool = False):
     """Flatten one side's device args: per-bucket (col, val) pairs,
-    optional (v_cols, v_vals, v_parent), then lam."""
-    flat = []
-    for c, v in zip(arrs.cols, arrs.vals):
-        flat += [c, v]
-    if plan.v_rows_per_shard > 0:
-        flat += [arrs.v_cols, arrs.v_vals,
-                 np.asarray(plan.v_parent, np.int32)]
+    optional (v_cols, v_vals, v_parent), then lam. ``binary``: value
+    slabs are elided entirely (synthesized on device as ones)."""
+    if binary:
+        flat = list(arrs.cols)
+        if plan.v_rows_per_shard > 0:
+            flat += [arrs.v_cols, np.asarray(plan.v_parent, np.int32)]
+    else:
+        flat = []
+        for c, v in zip(arrs.cols, arrs.vals):
+            flat += [c, v]
+        if plan.v_rows_per_shard > 0:
+            flat += [arrs.v_cols, arrs.v_vals,
+                     np.asarray(plan.v_parent, np.int32)]
     flat.append(lam)
     return flat
 
@@ -372,12 +401,14 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, plan_u: LayoutPlan,
     rep = P()
     y_spec = P(MODEL_AXIS, None) if model_sharded else rep
 
+    binary = bool(params.binary_ratings)
+
     def side_specs(plan: LayoutPlan):
         specs = []
         for _ in plan.lengths:
-            specs += [row2, row2]
+            specs += [row2] if binary else [row2, row2]
         if plan.v_rows_per_shard > 0:
-            specs += [row2, row2, row1]
+            specs += ([row2, row1] if binary else [row2, row2, row1])
         specs.append(row1)  # lam
         return specs
 
@@ -417,6 +448,7 @@ def _make_train_fn(mesh: Mesh, params: ALSParams, plan_u: LayoutPlan,
                 entries_budget=entries_budget,
                 platform=mesh_platform,
                 model_sharded=model_sharded,
+                binary=binary,
             ),
             mesh=mesh,
             in_specs=(y_spec, row1, rep) + tuple(specs[:-1]),
@@ -558,16 +590,23 @@ def train_als(
     mesh = mesh or default_mesh()
     d_size, m_size = _mesh_dims(mesh)
 
+    if params.binary_ratings is None:
+        params = dataclasses.replace(
+            params,
+            binary_ratings=bool(np.all(np.asarray(rating) == 1.0)))
+
     counts_u = np.bincount(np.asarray(user_idx, np.int64), minlength=n_users)
     counts_i = np.bincount(np.asarray(item_idx, np.int64), minlength=n_items)
     plan_u = plan_layout(counts_u, d_size, m_div=m_size)
     plan_i = plan_layout(counts_i, d_size, m_div=m_size)
     arrs_u = fill_buckets(plan_u, user_idx, item_idx, rating,
                           col_slot_map=plan_i.slot_of_row,
-                          sentinel=plan_i.total_slots)
+                          sentinel=plan_i.total_slots,
+                          fill_vals=not params.binary_ratings)
     arrs_i = fill_buckets(plan_i, item_idx, user_idx, rating,
                           col_slot_map=plan_u.slot_of_row,
-                          sentinel=plan_u.total_slots)
+                          sentinel=plan_u.total_slots,
+                          fill_vals=not params.binary_ratings)
 
     k = params.rank
     x_shape = (plan_u.total_slots, k)
@@ -631,8 +670,10 @@ def train_als(
     if x0 is None:
         x0, y0 = _fresh_init(params, plan_u, plan_i, n_users, n_items)
     fn, in_shardings = _cached_train_fn(mesh, params, plan_u, plan_i)
-    flat = tuple(_side_flat(arrs_u, plan_u, _host_lam(plan_u, params))
-                 + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params)))
+    binary = bool(params.binary_ratings)
+    flat = tuple(
+        _side_flat(arrs_u, plan_u, _host_lam(plan_u, params), binary)
+        + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params), binary))
     if jax.process_count() > 1:
         # Multi-controller: every process holds the SAME full numpy
         # arrays (the event store is shared), so build global jax.Arrays
@@ -799,18 +840,34 @@ def train_als_process_sharded(
     counts_i = _global_counts(item_slice[1], n_items)
     plan_u = plan_layout(counts_u, d_size, m_div=m_size)
     plan_i = plan_layout(counts_i, d_size, m_div=m_size)
+
+    if params.binary_ratings is None:
+        # Every process must pick the SAME jit signature: AND the local
+        # all-ones verdicts (a process's slice can be all-ones while
+        # another's is not).
+        local_bin = np.array([
+            np.all(np.asarray(user_slice[2]) == 1.0)
+            and np.all(np.asarray(item_slice[2]) == 1.0)], np.int32)
+        agreed = np.asarray(
+            multihost_utils.process_allgather(local_bin)).all()
+        params = dataclasses.replace(params, binary_ratings=bool(agreed))
+    binary = bool(params.binary_ratings)
+
     arrs_u = fill_buckets(plan_u, user_slice[0], user_slice[1], user_slice[2],
                           col_slot_map=plan_i.slot_of_row,
                           sentinel=plan_i.total_slots,
-                          shard0=shard0, n_local_shards=n_local)
+                          shard0=shard0, n_local_shards=n_local,
+                          fill_vals=not binary)
     arrs_i = fill_buckets(plan_i, item_slice[1], item_slice[0], item_slice[2],
                           col_slot_map=plan_u.slot_of_row,
                           sentinel=plan_u.total_slots,
-                          shard0=shard0, n_local_shards=n_local)
+                          shard0=shard0, n_local_shards=n_local,
+                          fill_vals=not binary)
 
     fn, in_shardings = _cached_train_fn(mesh, params, plan_u, plan_i)
-    flat_local = (_side_flat(arrs_u, plan_u, _host_lam(plan_u, params))
-                  + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params)))
+    flat_local = (
+        _side_flat(arrs_u, plan_u, _host_lam(plan_u, params), binary)
+        + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params), binary))
 
     def _to_global(local, sharding):
         # Every per-side device arg is row-sharded over the data axis;
@@ -831,8 +888,9 @@ def train_als_process_sharded(
             out[-2] = out[-2][shard0 * rv:(shard0 + n_local) * rv]
         return out
 
-    n_u_args = (2 * len(plan_u.lengths)
-                + (3 if plan_u.v_rows_per_shard else 0) + 1)
+    per_bucket = 1 if binary else 2
+    n_u_args = (per_bucket * len(plan_u.lengths)
+                + ((per_bucket + 1) if plan_u.v_rows_per_shard else 0) + 1)
     u_flat = _slice_side(flat_local[:n_u_args], plan_u)
     i_flat = _slice_side(flat_local[n_u_args:], plan_i)
     flat = tuple(

@@ -245,12 +245,15 @@ class BucketArrays:
     v_vals: np.ndarray             # [S_loc*Rv, overflow_len] f32
     shard0: int
     n_local_shards: int
+    # fill_vals=False (binary-ratings mode): vals is an empty tuple and
+    # v_vals a zero-size array — the device synthesizes exact ones.
 
 
 def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
                  val: np.ndarray, col_slot_map: np.ndarray, sentinel: int,
                  shard0: int = 0, n_local_shards: int | None = None,
-                 use_native: bool | None = None) -> BucketArrays:
+                 use_native: bool | None = None,
+                 fill_vals: bool = True) -> BucketArrays:
     """Scatter entries into the planned slabs for shards
     [shard0, shard0+n_local_shards). ``row`` must contain ONLY rows owned
     by those shards (the multi-host range-read contract); ``col`` is
@@ -261,9 +264,14 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
     toolchain is available — it replaces the numpy path's stable argsort,
     the dominant host cost of layout prep, and is bit-identical to it);
     False forces the numpy path (tests use both and assert equality).
+
+    ``fill_vals=False`` (binary-ratings mode): the value slabs are
+    neither allocated nor filled — every real entry is 1.0 and the
+    device synthesizes exact ones (ops/als.py binary_ratings).
     """
     S_loc = plan.n_shards - shard0 if n_local_shards is None else int(n_local_shards)
-    val = np.asarray(val, dtype=np.float32)
+    if fill_vals:
+        val = np.asarray(val, dtype=np.float32)
     n_buckets = len(plan.lengths)
     Rv, OV = plan.v_rows_per_shard, plan.overflow_len
 
@@ -274,7 +282,8 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
     offsets = np.zeros(n_buckets + 2, dtype=np.int64)
     np.cumsum(np.asarray(sizes + [v_size], dtype=np.int64), out=offsets[1:])
     flat_cols = np.full(int(offsets[-1]), sentinel, dtype=np.int32)
-    flat_vals = np.zeros(int(offsets[-1]), dtype=np.float32)
+    flat_vals = (np.zeros(int(offsets[-1]), dtype=np.float32)
+                 if fill_vals else None)
 
     if len(row):
         if plan.n_rows > 2**31 - 1:
@@ -320,8 +329,9 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
             # argsort + position arithmetic below; same entry order.
             try:
                 from ..native import NativeUnavailable, fill_entries
-                fill_entries(row64, col64, val, col_slot_map, prim_base,
-                             v_base, vc_r * OV, flat_cols, flat_vals)
+                fill_entries(row64, col64, val if fill_vals else None,
+                             col_slot_map, prim_base, v_base, vc_r * OV,
+                             flat_cols, flat_vals)
                 done = True
             except NativeUnavailable:
                 if use_native is True:
@@ -337,7 +347,6 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
             # real); sentinel prefill covers the padding slots.
             cs = np.asarray(col_slot_map, np.int64)[
                 col64[order]].astype(np.int32)
-            vs = val[order]
 
             # position of each entry within its row (stable original order)
             rmin = int(rs[0])
@@ -351,17 +360,20 @@ def fill_buckets(plan: LayoutPlan, row: np.ndarray, col: np.ndarray,
                             v_base[rs] + pos,
                             prim_base[rs] + pos - vc_e)
             flat_cols[dest] = cs
-            flat_vals[dest] = vs
+            if fill_vals:
+                flat_vals[dest] = val[order]
 
     cols, vals = [], []
     for b in range(n_buckets):
         R, C = S_loc * int(plan.bucket_rows[b]), int(plan.lengths[b])
         cols.append(flat_cols[offsets[b]:offsets[b + 1]].reshape(R, C))
-        vals.append(flat_vals[offsets[b]:offsets[b + 1]].reshape(R, C))
+        if fill_vals:
+            vals.append(flat_vals[offsets[b]:offsets[b + 1]].reshape(R, C))
     v_cols = flat_cols[offsets[n_buckets]:offsets[n_buckets + 1]].reshape(
         S_loc * Rv, OV)
-    v_vals = flat_vals[offsets[n_buckets]:offsets[n_buckets + 1]].reshape(
-        S_loc * Rv, OV)
+    v_vals = (flat_vals[offsets[n_buckets]:offsets[n_buckets + 1]].reshape(
+        S_loc * Rv, OV) if fill_vals
+        else np.zeros((0, OV), np.float32))
     return BucketArrays(
         cols=tuple(cols), vals=tuple(vals), v_cols=v_cols, v_vals=v_vals,
         shard0=shard0, n_local_shards=S_loc,

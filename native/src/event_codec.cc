@@ -547,7 +547,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 8; }
+int32_t pio_codec_version() { return 9; }
 
 namespace {
 // FNV-1a over a byte range, continuing from a running state.
@@ -626,9 +626,11 @@ int32_t pio_tfidf_tf(const char* buf, const int64_t* offs, int64_t n_docs,
 // into the planned bucket slabs in one sequential pass. Replaces the
 // numpy path's stable argsort + position arithmetic (the dominant host
 // cost of ALS layout prep); order within a row is the original entry
-// order, bit-identical to the numpy fallback. Returns 0 on success,
-// -1 col out of range, -2 computed destination out of range (corrupt /
-// inconsistent plan tables), -3 row out of range.
+// order, bit-identical to the numpy fallback. `val`/`flat_vals` may be
+// NULL together (binary-ratings mode: the value slabs are synthesized
+// on device, so neither building nor uploading them is needed).
+// Returns 0 on success, -1 col out of range, -2 computed destination
+// out of range (corrupt / inconsistent plan tables), -3 row out of range.
 int32_t pio_fill_entries(
     const int64_t* row, const int64_t* col, const float* val, int64_t nnz,
     const int64_t* col_slot_map, int64_t n_cols,
@@ -646,7 +648,7 @@ int32_t pio_fill_entries(
     const int64_t dest = p < ve ? v_base[r] + p : prim_base[r] + p - ve;
     if (dest < 0 || dest >= total) return -2;
     flat_cols[dest] = static_cast<int32_t>(col_slot_map[c]);
-    flat_vals[dest] = val[i];
+    if (flat_vals != nullptr) flat_vals[dest] = val[i];
   }
   return 0;
 }
