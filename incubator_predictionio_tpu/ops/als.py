@@ -290,6 +290,9 @@ def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
     n_fused = n_buckets - (1 if has_heavy else 0)
 
     def gather(cols):
+        # col slabs may arrive uint16 (narrow counterpart slot space —
+        # half the upload bytes); widen per chunk, in-register.
+        cols = cols.astype(jnp.int32)
         if model_sharded:
             return _gather_model_partial(y, cols, compute_dtype)
         return jnp.take(y, cols, axis=0).astype(compute_dtype)
@@ -358,20 +361,28 @@ def _host_lam(plan: LayoutPlan, params: ALSParams) -> np.ndarray:
 
 
 def _side_flat(arrs: BucketArrays, plan: LayoutPlan, lam: np.ndarray,
-               binary: bool = False):
+               binary: bool = False, col_sentinel: int | None = None):
     """Flatten one side's device args: per-bucket (col, val) pairs,
     optional (v_cols, v_vals, v_parent), then lam. ``binary``: value
-    slabs are elided entirely (synthesized on device as ones)."""
+    slabs are elided entirely (synthesized on device as ones).
+    ``col_sentinel``: the counterpart sentinel index — when it fits
+    uint16, col slabs upload at half width (the device widens per chunk
+    inside the gather)."""
+    narrow = col_sentinel is not None and col_sentinel <= np.iinfo(np.uint16).max
+
+    def col(c):
+        return c.astype(np.uint16) if narrow else c
+
     if binary:
-        flat = list(arrs.cols)
+        flat = [col(c) for c in arrs.cols]
         if plan.v_rows_per_shard > 0:
-            flat += [arrs.v_cols, np.asarray(plan.v_parent, np.int32)]
+            flat += [col(arrs.v_cols), np.asarray(plan.v_parent, np.int32)]
     else:
         flat = []
         for c, v in zip(arrs.cols, arrs.vals):
-            flat += [c, v]
+            flat += [col(c), v]
         if plan.v_rows_per_shard > 0:
-            flat += [arrs.v_cols, arrs.v_vals,
+            flat += [col(arrs.v_cols), arrs.v_vals,
                      np.asarray(plan.v_parent, np.int32)]
     flat.append(lam)
     return flat
@@ -672,8 +683,10 @@ def train_als(
     fn, in_shardings = _cached_train_fn(mesh, params, plan_u, plan_i)
     binary = bool(params.binary_ratings)
     flat = tuple(
-        _side_flat(arrs_u, plan_u, _host_lam(plan_u, params), binary)
-        + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params), binary))
+        _side_flat(arrs_u, plan_u, _host_lam(plan_u, params), binary,
+                   col_sentinel=plan_i.total_slots)
+        + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params), binary,
+                     col_sentinel=plan_u.total_slots))
     if jax.process_count() > 1:
         # Multi-controller: every process holds the SAME full numpy
         # arrays (the event store is shared), so build global jax.Arrays
@@ -866,8 +879,10 @@ def train_als_process_sharded(
 
     fn, in_shardings = _cached_train_fn(mesh, params, plan_u, plan_i)
     flat_local = (
-        _side_flat(arrs_u, plan_u, _host_lam(plan_u, params), binary)
-        + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params), binary))
+        _side_flat(arrs_u, plan_u, _host_lam(plan_u, params), binary,
+                   col_sentinel=plan_i.total_slots)
+        + _side_flat(arrs_i, plan_i, _host_lam(plan_i, params), binary,
+                     col_sentinel=plan_u.total_slots))
 
     def _to_global(local, sharding):
         # Every per-side device arg is row-sharded over the data axis;

@@ -98,10 +98,13 @@ def test_layout_overflow_rows():
     np.testing.assert_allclose(dense, ref, rtol=1e-6)
 
 
-def test_fill_buckets_native_matches_numpy():
+@pytest.mark.parametrize("fill_vals", [True, False])
+def test_fill_buckets_native_matches_numpy(fill_vals):
     """The C++ single-pass scatter (pio_fill_entries) must be
     bit-identical to the numpy argsort path — including overflow rows,
-    multi-shard plans, and a local-shard (shard0 > 0) fill."""
+    multi-shard plans, a local-shard (shard0 > 0) fill, and the
+    fill_vals=False (binary-ratings) branch where neither path builds
+    value slabs."""
     from incubator_predictionio_tpu import native as pionative
 
     if not pionative.available():
@@ -115,14 +118,18 @@ def test_fill_buckets_native_matches_numpy():
     counts = np.bincount(row, minlength=n_rows)
     cplan = plan_layout(np.bincount(col, minlength=n_cols), 4)
     plan = plan_layout(counts, 4, overflow_len=512)
+    kw = dict(fill_vals=fill_vals)
 
     def flat(a):
         return [*a.cols, a.v_cols, *a.vals, a.v_vals]
 
     a_np = fill_buckets(plan, row, col, val, cplan.slot_of_row,
-                        cplan.total_slots, use_native=False)
+                        cplan.total_slots, use_native=False, **kw)
     a_nc = fill_buckets(plan, row, col, val, cplan.slot_of_row,
-                        cplan.total_slots, use_native=True)
+                        cplan.total_slots, use_native=True, **kw)
+    if not fill_vals:
+        assert a_np.vals == () and a_nc.vals == ()
+        assert a_np.v_vals.size == 0 and a_nc.v_vals.size == 0
     for x, y in zip(flat(a_np), flat(a_nc)):
         assert np.array_equal(x, y)
 
@@ -132,7 +139,8 @@ def test_fill_buckets_native_matches_numpy():
     for mode in (False, True):
         a_loc = fill_buckets(plan, row[m], col[m], val[m],
                              cplan.slot_of_row, cplan.total_slots,
-                             shard0=2, n_local_shards=1, use_native=mode)
+                             shard0=2, n_local_shards=1, use_native=mode,
+                             **kw)
         if mode:
             for x, y in zip(flat(prev), flat(a_loc)):
                 assert np.array_equal(x, y)
@@ -143,7 +151,7 @@ def test_fill_buckets_native_matches_numpy():
         with pytest.raises(ValueError):
             fill_buckets(plan, row, col, val, cplan.slot_of_row,
                          cplan.total_slots, shard0=2, n_local_shards=1,
-                         use_native=mode)
+                         use_native=mode, **kw)
 
 
 def test_length_ladder_shape():

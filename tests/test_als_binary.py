@@ -53,8 +53,6 @@ def test_binary_elision_matches_explicit_path(implicit):
 
 def test_binary_elision_with_overflow_rows():
     """The virtual-row (overflow) slabs are elided too."""
-    from incubator_predictionio_tpu.ops.rowblocks import plan_layout
-
     u, i, r = _views(n_users=3100, n_items=40, nnz=4000, heavy=True)
     counts_i = np.bincount(i, minlength=40)
     assert counts_i[0] > 2048  # overflow engaged
@@ -83,13 +81,39 @@ def test_binary_elision_on_2d_mesh():
         out_b.item_factors, out_e.item_factors, rtol=5e-4, atol=5e-5)
 
 
+def test_wide_catalog_keeps_int32_cols():
+    """Counterpart slot spaces past uint16 must keep int32 col slabs
+    (every small CPU test now exercises the uint16 narrow path, so this
+    pins the wide one): 70k users means the ITEM side's cols index a
+    >65535 slot space."""
+    rng = np.random.default_rng(3)
+    n_users, n_items = 70_000, 25
+    u = rng.integers(0, n_users, 3000).astype(np.int32)
+    i = rng.integers(0, n_items, 3000).astype(np.int32)
+    r = np.ones(3000, np.float32)
+    params = ALSParams(rank=4, num_iterations=1, reg=0.1, block_len=8)
+    out = train_als(u, i, r, n_users, n_items, params, mesh=_mesh_1d(2))
+    # spot-check one solved item against the dense normal equations
+    sel = i == 0
+    yy = out.user_factors[u[sel]].astype(np.float64)
+    ref = np.linalg.solve(yy.T @ yy + 0.1 * np.eye(4), yy.T @ r[sel])
+    np.testing.assert_allclose(out.item_factors[0], ref, rtol=2e-3,
+                               atol=2e-4)
+
+
 def test_non_binary_ratings_keep_explicit_path():
     """Ratings with any non-1.0 value must auto-select the explicit
-    path and train unchanged."""
+    path: auto must agree exactly with binary_ratings=False forced."""
     rng = np.random.default_rng(9)
     u = rng.integers(0, 30, 400).astype(np.int32)
     i = rng.integers(0, 20, 400).astype(np.int32)
     r = (rng.random(400) * 4 + 1).astype(np.float32)
     params = ALSParams(rank=4, num_iterations=2, block_len=4)
-    out = train_als(u, i, r, 30, 20, params, mesh=_mesh_1d(2))
-    assert np.isfinite(out.user_factors).all()
+    out_auto = train_als(u, i, r, 30, 20, params, mesh=_mesh_1d(2))
+    out_forced = train_als(
+        u, i, r, 30, 20,
+        ALSParams(rank=4, num_iterations=2, block_len=4,
+                  binary_ratings=False), mesh=_mesh_1d(2))
+    # same jitted program (auto resolves to the explicit path) → bitwise
+    assert np.array_equal(out_auto.user_factors, out_forced.user_factors)
+    assert np.array_equal(out_auto.item_factors, out_forced.item_factors)
