@@ -168,6 +168,9 @@ class AlgorithmParams(Params):
     # box — see ops.als._resolve_params).
     compute_dtype: str = "auto"
     chunk_tiles: int = -1
+    # None → auto-detect all-ones ratings and elide value-slab upload
+    # (ops.als.ALSParams.binary_ratings); engine.json "binaryRatings".
+    binary_ratings: Optional[bool] = None
 
 
 class ALSAlgorithm(Algorithm):
@@ -184,6 +187,7 @@ class ALSAlgorithm(Algorithm):
         "blockLen": "block_len",
         "computeDtype": "compute_dtype",
         "chunkTiles": "chunk_tiles",
+        "binaryRatings": "binary_ratings",
     }
 
     @staticmethod
@@ -199,6 +203,7 @@ class ALSAlgorithm(Algorithm):
             block_len=p.block_len,
             compute_dtype=p.compute_dtype,
             chunk_tiles=p.chunk_tiles,
+            binary_ratings=p.binary_ratings,
         )
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:

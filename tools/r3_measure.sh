@@ -1,0 +1,29 @@
+#!/bin/bash
+# One-shot round-3 measurement sweep (run when the TPU tunnel is healthy).
+# Writes per-step logs under /tmp/r3m and prints a summary.
+set -u
+cd "$(dirname "$0")/.."
+OUT=/tmp/r3m; mkdir -p $OUT
+
+probe() {
+  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+if ! probe; then echo "TUNNEL STILL WEDGED"; exit 2; fi
+echo "tunnel ok"
+
+run() { # name, timeout, cmd...
+  local name=$1 to=$2; shift 2
+  echo "=== $name"
+  timeout "$to" "$@" >$OUT/$name.log 2>&1
+  echo "rc=$? ($name)"; tail -2 $OUT/$name.log
+}
+
+run bench_rank32 580 python bench.py
+run bench_rank32_ladder105 580 env PIO_ALS_LADDER_GROWTH=1.05 python bench.py
+run bench_rank128 580 env PIO_BENCH_RANK=128 python bench.py
+run tmpl_similar 580 env PIO_BENCH_TEMPLATES=similar_product python bench_templates.py
+run tmpl_text 580 env PIO_BENCH_TEMPLATES=text python bench_templates.py
+run tmpl_ur 580 env PIO_BENCH_TEMPLATES=ur python bench_templates.py
+echo "=== summary"
+grep -h '"metric"' $OUT/*.log 2>/dev/null
