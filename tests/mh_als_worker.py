@@ -71,6 +71,15 @@ def main() -> int:
     if mode == "full":
         mesh = mesh_from_devices(devices=jax.devices())
         out = train_als(u, i, r, n_users, n_items, params, mesh=mesh)
+    elif mode == "sharded-ones":
+        # All-ones ratings: every process must allgather-agree on the
+        # binary (value-slab-elided) jit signature and the elided global
+        # assembly must match the single-process result.
+        r = np.ones_like(r)
+        mesh = mesh_from_devices(devices=jax.devices())
+        us, its = _slices(u, i, r, n_users, n_items, mesh)
+        out = train_als_process_sharded(
+            us, its, n_users, n_items, params, mesh=mesh)
     elif mode == "sharded":
         mesh = mesh_from_devices(devices=jax.devices())
         us, its = _slices(u, i, r, n_users, n_items, mesh)

@@ -83,12 +83,14 @@ def _mh_data():
     return u, i, r, n_users, n_items
 
 
-@pytest.mark.parametrize("mode", ["full", "sharded"])
+@pytest.mark.parametrize("mode", ["full", "sharded", "sharded-ones"])
 def test_two_process_training_matches_single_process(tmp_path, mode):
     """mode="full": every worker holds the whole dataset (shared-store
     reads). mode="sharded": each worker ingests ONLY the event ranges it
     owns (ops.als.train_als_process_sharded) — the partitioned-ingest
-    story; factors must still match the single-process run."""
+    story; factors must still match the single-process run.
+    mode="sharded-ones": all-ones ratings — both processes must
+    allgather-agree on the binary (value-slab-elided) signature."""
     # No pytest-timeout in this image; the communicate(timeout=240) below
     # is the hang guard.
     out_path = str(tmp_path / "mh_factors.npz")
@@ -107,6 +109,8 @@ def test_two_process_training_matches_single_process(tmp_path, mode):
     import jax
 
     u, i, r, n_users, n_items = _mh_data()
+    if mode == "sharded-ones":
+        r = np.ones_like(r)
     mesh = mesh_from_devices(devices=jax.devices()[:4])
     ref = train_als(u, i, r, n_users, n_items,
                     ALSParams(rank=4, num_iterations=3, seed=5),
