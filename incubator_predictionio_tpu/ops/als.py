@@ -129,22 +129,37 @@ def _grams_rows(p, val, *, implicit, alpha, compute_dtype):
     are linear in per-entry outer products, so zero rows contribute
     nothing — and shard-partial p's (each model shard zeroing slots it
     doesn't own) psum to exactly the full-gather result.
+
+    ``val=None``: binary-ratings mode — every real entry is 1.0, so the
+    per-entry weights collapse to scalars and no value slab ever exists
+    (not even as a device-side ones array: a materialized ones slab
+    would re-spend in HBM reads exactly the bytes the upload elision
+    saved).
     """
     cd = compute_dtype
     if implicit:
         # Hu-Koren-Volinsky: A = YᵀY + Yᵀ(C-I)Y + λ·c·I, b = YᵀCp where
         # p=1 for observed. C-I = alpha·r on observed entries only.
-        cw = (alpha * val)[..., None].astype(cd)  # confidence-1 weights
-        w = 1.0 + alpha * val
-        grams = jnp.einsum("rck,rcm->rkm", p * cw, p,
-                           preferred_element_type=jnp.float32)
-        rhs = jnp.einsum("rck,rc->rk", p, w.astype(cd),
-                         preferred_element_type=jnp.float32)
+        if val is None:
+            grams = jnp.einsum("rck,rcm->rkm", p * jnp.asarray(alpha, cd), p,
+                               preferred_element_type=jnp.float32)
+            rhs = (1.0 + alpha) * jnp.sum(p, axis=1,
+                                          dtype=jnp.float32)
+        else:
+            cw = (alpha * val)[..., None].astype(cd)  # confidence-1 weights
+            w = 1.0 + alpha * val
+            grams = jnp.einsum("rck,rcm->rkm", p * cw, p,
+                               preferred_element_type=jnp.float32)
+            rhs = jnp.einsum("rck,rc->rk", p, w.astype(cd),
+                             preferred_element_type=jnp.float32)
     else:
         grams = jnp.einsum("rck,rcm->rkm", p, p,
                            preferred_element_type=jnp.float32)
-        rhs = jnp.einsum("rck,rc->rk", p, val.astype(cd),
-                         preferred_element_type=jnp.float32)
+        if val is None:
+            rhs = jnp.sum(p, axis=1, dtype=jnp.float32)
+        else:
+            rhs = jnp.einsum("rck,rc->rk", p, val.astype(cd),
+                             preferred_element_type=jnp.float32)
     return grams, rhs
 
 
@@ -180,15 +195,20 @@ def _slab_normal_eq(gather, colb, valb, *, sentinel, entries_per_step,
         return _grams_rows(gather(colb), valb, **kw)
     padR = n_sub * chunk_r - R
     cc = jnp.pad(colb, ((0, padR), (0, 0)), constant_values=sentinel)
-    vv = jnp.pad(valb, ((0, padR), (0, 0)))
     cc = cc.reshape(n_sub, chunk_r, C)
-    vv = vv.reshape(n_sub, chunk_r, C)
 
-    def body(chunk):
-        ccol, cval = chunk
-        return _grams_rows(gather(ccol), cval, **kw)
+    if valb is None:  # binary-ratings: no value slab exists
+        grams, rhs = jax.lax.map(
+            lambda ccol: _grams_rows(gather(ccol), None, **kw), cc)
+    else:
+        vv = jnp.pad(valb, ((0, padR), (0, 0)))
+        vv = vv.reshape(n_sub, chunk_r, C)
 
-    grams, rhs = jax.lax.map(body, (cc, vv))
+        def body(chunk):
+            ccol, cval = chunk
+            return _grams_rows(gather(ccol), cval, **kw)
+
+        grams, rhs = jax.lax.map(body, (cc, vv))
     k = grams.shape[-1]
     return (grams.reshape(n_sub * chunk_r, k, k)[:R],
             rhs.reshape(n_sub * chunk_r, k)[:R])
@@ -252,14 +272,20 @@ def _fused_bucket_solve(gather, colb, valb, lam_b, yty, *, sentinel,
         return solve_chunk(colb, valb, lam_b)
     padR = n_sub * chunk_r - R
     cc = jnp.pad(colb, ((0, padR), (0, 0)), constant_values=sentinel)
-    vv = jnp.pad(valb, ((0, padR), (0, 0)))
     # padded lam rows: benign 1.0 ridge keeps the padded systems SPD
     ll = jnp.pad(lam_b, (0, padR), constant_values=1.0)
-    x = jax.lax.map(
-        lambda chunk: solve_chunk(*chunk),
-        (cc.reshape(n_sub, chunk_r, C), vv.reshape(n_sub, chunk_r, C),
-         ll.reshape(n_sub, chunk_r)),
-    )
+    if valb is None:  # binary-ratings: no value slab exists
+        x = jax.lax.map(
+            lambda chunk: solve_chunk(chunk[0], None, chunk[1]),
+            (cc.reshape(n_sub, chunk_r, C), ll.reshape(n_sub, chunk_r)),
+        )
+    else:
+        vv = jnp.pad(valb, ((0, padR), (0, 0)))
+        x = jax.lax.map(
+            lambda chunk: solve_chunk(*chunk),
+            (cc.reshape(n_sub, chunk_r, C), vv.reshape(n_sub, chunk_r, C),
+             ll.reshape(n_sub, chunk_r)),
+        )
     return x.reshape(n_sub * chunk_r, k)[:R]
 
 
@@ -301,19 +327,14 @@ def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
                     platform=platform, k=k)
     # binary mode: value slabs were never uploaded — every real entry is
     # 1.0, and padding/non-owned slots already gather zero factor ROWS,
-    # so a constant-ones val slab is exact (every val use is multiplied
-    # by the gathered row).
+    # so the per-entry weights collapse to scalars inside _grams_rows
+    # (valb=None; no ones array is ever materialized).
     stride = 1 if binary else 2
-
-    def val_of(colb, idx):
-        return (jnp.ones(colb.shape, jnp.float32) if binary
-                else bucket_args[idx])
-
     base = 0
     x_parts = []
     for bi in range(n_fused):
         colb = bucket_args[stride * bi]
-        valb = val_of(colb, stride * bi + 1)
+        valb = None if binary else bucket_args[stride * bi + 1]
         R_b = colb.shape[0]
         x_parts.append(_fused_bucket_solve(
             gather, colb, valb, jax.lax.slice(lam, (base,), (base + R_b,)),
@@ -323,10 +344,10 @@ def _half_step_local(y, lam, yty, *bucket_args, plan: LayoutPlan,
 
     if has_heavy:
         colb = bucket_args[stride * n_fused]
-        valb = val_of(colb, stride * n_fused + 1)
+        valb = None if binary else bucket_args[stride * n_fused + 1]
         if binary:
             v_cols, v_parent = bucket_args[n_buckets:n_buckets + 2]
-            v_vals = jnp.ones(v_cols.shape, jnp.float32)
+            v_vals = None
         else:
             v_cols, v_vals, v_parent = (
                 bucket_args[2 * n_buckets:2 * n_buckets + 3])
