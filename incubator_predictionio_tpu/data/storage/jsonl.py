@@ -30,6 +30,7 @@ import numpy as np
 
 from ...native import ColumnarEvents, parse_events
 from . import base
+from .datamap import PropertyMap
 from .event import Event, new_event_id
 from .memory import event_matches
 
@@ -421,6 +422,110 @@ class JSONLEvents(base.LEvents):
             mask = mask & (cols.time_us != _TIME_ABSENT) & (cols.time_us < u_us)
         return cols, np.nonzero(mask)[0]
 
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        return self.aggregate_columnar(
+            app_id, channel_id, entity_type=entity_type,
+            start_time=start_time, until_time=until_time,
+            required=required)
+
+    def aggregate_columnar(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        entity_type: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> dict[str, PropertyMap]:
+        """$set/$unset/$delete replay directly on the columnar scan.
+
+        Result-identical to ``base.aggregate_property_events`` over
+        ``find()`` but ~4× faster: that path materializes a full Event
+        per row (whole-record reparse + validation + DataMap), while the
+        replay only ever needs each event's ``properties`` span and the
+        interned entity/event/time columns. Rows without an entityId are
+        skipped (the Event path would refuse them at validation).
+        Externally written rows WITHOUT an eventTime mirror from_json's
+        default-to-now: they sort after every timestamped event (file
+        order among themselves) and report the scan time as their
+        update time.
+        """
+        cols, rows = self.scan_columnar(
+            app_id, channel_id, ["$set", "$unset", "$delete"],
+            start_time, until_time)
+        if rows.size == 0:
+            return {}
+        keep = cols.eid[rows] >= 0
+        if entity_type is not None:
+            et_table = cols.table(ColumnarEvents.TABLE_ETYPE)
+            try:
+                keep &= cols.etype[rows] == et_table.index(entity_type)
+            except ValueError:
+                return {}
+        rows = rows[keep]
+        ev_table = cols.table(ColumnarEvents.TABLE_EVENT)
+        codes = {n: ev_table.index(n)
+                 for n in ("$set", "$unset", "$delete") if n in ev_table}
+        # ascending stable time order == sorted(find(), key=event_time),
+        # with absent times treated as "now" (sorts last, file order)
+        sort_t = cols.time_us[rows]
+        sort_t = np.where(sort_t == _TIME_ABSENT,
+                          np.iinfo(np.int64).max, sort_t)
+        rows = rows[np.argsort(sort_t, kind="stable")]
+
+        import json as _json
+
+        loads, raw = _json.loads, cols.raw
+        set_c = codes.get("$set", -1)
+        unset_c = codes.get("$unset", -2)
+        # hot loop over python scalars: tolist() beats per-element
+        # np.int64 indexing, and the props spans are sliced inline
+        ev_l = cols.event[rows].tolist()
+        eid_l = cols.eid[rows].tolist()
+        t_l = cols.time_us[rows].tolist()
+        span_l = cols.props[rows].tolist()
+        # replay keyed on interned entity codes; strings resolved once
+        state: dict[int, tuple[dict, int, int]] = {}
+        for e, c, t, (s0, e0) in zip(ev_l, eid_l, t_l, span_l):
+            if e == set_c:
+                d = loads(raw[s0:e0]) if s0 >= 0 else {}
+                got = state.get(c)
+                if got is not None:
+                    props, first, _ = got
+                    props.update(d)
+                    state[c] = (props, first, t)
+                else:
+                    state[c] = (d, t, t)
+            elif e == unset_c:
+                got = state.get(c)
+                if got is not None:
+                    props, first, _ = got
+                    if s0 >= 0:
+                        for k in loads(raw[s0:e0]):
+                            props.pop(k, None)
+                    state[c] = (props, first, t)
+            else:  # $delete
+                state.pop(c, None)
+
+        now = _dt.datetime.now(_dt.timezone.utc)
+
+        def us_dt(us: int) -> _dt.datetime:
+            if us == _TIME_ABSENT:
+                return now
+            return _EPOCH + _dt.timedelta(microseconds=us)
+
+        eid_table = cols.table(ColumnarEvents.TABLE_EID)
+        out = {
+            eid_table[c]: PropertyMap(props, us_dt(first), us_dt(last))
+            for c, (props, first, last) in state.items()
+        }
+        if required:
+            req = set(required)
+            out = {k: v for k, v in out.items() if req.issubset(v.keyset())}
+        return out
+
     def compact(self, app_id: int, channel_id: Optional[int] = None) -> int:
         """Rewrite the log without tombstoned records; returns live count
         (the reference's SelfCleaningDataSource writes a compacted stream
@@ -466,6 +571,14 @@ class JSONLPEvents(base.PEvents):
         return self._l.scan_columnar(
             app_id, channel_id, event_names, start_time, until_time
         )
+
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        return self._l.aggregate_columnar(
+            app_id, channel_id, entity_type=entity_type,
+            start_time=start_time, until_time=until_time,
+            required=required)
 
 
 class JSONLClient(base.BaseStorageClient):

@@ -432,6 +432,65 @@ def test_insert_without_init_autocreates(storage):
     assert not le.delete("nonexistent", 4242)  # missing table → False, no raise
 
 
+def test_jsonl_columnar_aggregate_matches_generic(tmp_path):
+    """The JSONL backend's columnar $set/$unset/$delete replay must be
+    result-identical (keys, values, first/last times) to the generic
+    Event-replay over find() — fuzzed with ties, windows, tombstones,
+    mixed entity types, and the required filter."""
+    import random
+
+    from incubator_predictionio_tpu.data.storage.base import (
+        aggregate_property_events,
+    )
+    from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
+
+    rng = random.Random(4)
+    le = JSONLEvents(str(tmp_path))
+    base_t = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    evs = []
+    for _ in range(3000):
+        kind = rng.choices(["$set", "$unset", "$delete", "view"],
+                           [0.5, 0.2, 0.1, 0.2])[0]
+        if kind == "$unset":
+            props = {f"a{rng.randrange(4)}": rng.randrange(9)
+                     for _ in range(rng.randrange(1, 3))}
+        elif kind == "$delete":
+            props = {}
+        else:
+            props = {f"a{rng.randrange(4)}": rng.randrange(9)
+                     for _ in range(rng.randrange(0, 3))}
+        evs.append(Event(
+            event=kind, entity_type=rng.choice(["user", "item"]),
+            entity_id=str(rng.randrange(120)), properties=DataMap(props),
+            event_time=base_t + dt.timedelta(
+                seconds=rng.randrange(0, 400))))  # many ties
+    le.insert_batch(evs, 1)
+    ids = [e.event_id for e in le.find(1, limit=40)]
+    le.delete_batch([i for i in ids if i], 1)
+
+    def generic(entity_type, st=None, ut=None, req=None):
+        return aggregate_property_events(
+            le.find(1, None, st, ut, entity_type, None,
+                    ["$set", "$unset", "$delete"]), required=req)
+
+    cases = [
+        ("user", None, None, None),
+        ("item", None, None, None),
+        ("user", base_t + dt.timedelta(seconds=80),
+         base_t + dt.timedelta(seconds=300), None),
+        ("user", None, None, ["a0", "a1"]),
+        ("ghost", None, None, None),
+    ]
+    for et, st, ut, req in cases:
+        g = generic(et, st, ut, req)
+        c = le.aggregate_properties(1, et, None, st, ut, req)
+        assert set(g) == set(c), et
+        for k in g:
+            assert g[k].to_dict() == c[k].to_dict(), k
+            assert g[k].first_updated == c[k].first_updated, k
+            assert g[k].last_updated == c[k].last_updated, k
+
+
 def test_empty_event_names_matches_nothing(storage):
     """event_names=[] must match nothing on every backend (review fix)."""
     le = storage.get_l_events()
