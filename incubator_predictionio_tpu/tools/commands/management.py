@@ -134,19 +134,28 @@ def import_cmd(args: list[str]) -> int:
         channel_id = chans[0].id
     le = s.get_l_events()
     le.init(app_id, channel_id)
-    events, skipped = [], 0
+    # Streamed in batches: buffering the whole file as Event objects
+    # would need ~10 GB of heap at ML-20M scale.
+    batch, imported, skipped = [], 0, 0
     with open(ns.input) as f:
         for line_no, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
-                events.append(Event.from_json(json.loads(line)))
+                batch.append(Event.from_json(json.loads(line)))
             except Exception as e:  # noqa: BLE001 - report and continue
                 skipped += 1
                 print(f"[warn] line {line_no}: {e}", file=sys.stderr)
-    le.insert_batch(events, app_id, channel_id)
-    print(f"[info] Imported {len(events)} events ({skipped} skipped).")
+                continue
+            if len(batch) >= 20_000:
+                le.insert_batch(batch, app_id, channel_id)
+                imported += len(batch)
+                batch = []
+    if batch:
+        le.insert_batch(batch, app_id, channel_id)
+        imported += len(batch)
+    print(f"[info] Imported {imported} events ({skipped} skipped).")
     return 0
 
 
