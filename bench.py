@@ -240,6 +240,24 @@ def main() -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # Remote-PJRT tunnels can wedge so hard that jax.devices() hangs
+        # forever (observed after a SIGTERM'd client); probe device init
+        # in a killable subprocess so a dead tunnel is a clean fast
+        # failure instead of an indefinite hang of the calling harness.
+        import subprocess
+        import sys as _sys
+
+        try:
+            subprocess.run(
+                [_sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=int(os.environ.get("PIO_BENCH_PROBE_TIMEOUT", "300")),
+                check=True, capture_output=True)
+        except Exception as e:  # noqa: BLE001 - any probe failure is fatal
+            log(f"[bench] device platform probe failed ({e!r}) — "
+                "accelerator tunnel unreachable; aborting instead of "
+                "hanging")
+            return 3
 
     import jax
 
