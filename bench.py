@@ -254,9 +254,13 @@ def main() -> int:
                 timeout=int(os.environ.get("PIO_BENCH_PROBE_TIMEOUT", "300")),
                 check=True, capture_output=True)
         except Exception as e:  # noqa: BLE001 - any probe failure is fatal
-            log(f"[bench] device platform probe failed ({e!r}) — "
-                "accelerator tunnel unreachable; aborting instead of "
-                "hanging")
+            detail = ""
+            stderr = getattr(e, "stderr", None)
+            if stderr:
+                detail = " — probe stderr: " + stderr.decode(
+                    errors="replace")[-2000:]
+            log(f"[bench] device platform probe failed ({e!r}){detail}; "
+                "accelerator unreachable — aborting instead of hanging")
             return 3
 
     import jax

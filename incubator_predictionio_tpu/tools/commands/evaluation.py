@@ -26,6 +26,10 @@ def eval_cmd(args: list[str]) -> int:
     p.add_argument("--app-name", default="",
                    help="app whose events the evaluation reads (used when "
                         "the Evaluation/generator classes don't bake one in)")
+    p.add_argument("--parallel-candidates", type=int, default=1,
+                   help="evaluate up to N candidates concurrently, each "
+                        "on its own device of the mesh (task parallelism; "
+                        "candidates train single-device in this mode)")
     ns = p.parse_args(args)
     from ...workflow.evaluation_workflow import run_evaluation
     from ...workflow.json_extractor import resolve_engine_factory
@@ -42,6 +46,7 @@ def eval_cmd(args: list[str]) -> int:
         batch=ns.batch,
         evaluation_name=ns.evaluation,
         generator_name=ns.generator or "",
+        parallelism=ns.parallel_candidates,
     )
     print(result.pretty())
     print(f"[info] Evaluation completed. Instance ID: {instance_id}")

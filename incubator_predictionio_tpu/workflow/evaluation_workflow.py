@@ -25,6 +25,61 @@ def _utcnow():
     return _dt.datetime.now(_dt.timezone.utc)
 
 
+def _eval_candidates_parallel(engine, params_list, ctx, parallelism):
+    """Task parallelism over candidates (SURVEY.md §2.9 task row):
+    independent EngineParams evaluate as independent XLA programs on
+    DISJOINT single-device submeshes of the workflow mesh — an eval
+    sweep on a v5e-8 runs up to 8 candidates concurrently. Each worker
+    thread owns one device for its whole lifetime (so two candidates
+    never contend for one chip's HBM), and jit caches key on that
+    worker's submesh, so same-shape candidates reuse compilations.
+
+    Note: candidates train on ONE device in this mode (layouts plan for
+    1 shard), so scores can differ from a sequential whole-mesh run by
+    float-reduction-order noise.
+    """
+    import concurrent.futures as cf
+    import dataclasses as _dc
+    import threading
+
+    import jax
+
+    from ..parallel.mesh import mesh_from_devices
+
+    if jax.process_count() > 1:
+        raise ValueError(
+            "--parallel-candidates requires a single-controller run: "
+            "per-candidate single-device meshes would hand workers "
+            "devices owned by other processes (their collectives would "
+            "hang). Run the sweep sequentially on multi-host.")
+    devs = list(ctx.get_mesh().devices.flat)
+    n_workers = max(1, min(parallelism, len(devs), len(params_list)))
+    meshes = [mesh_from_devices(devices=[d]) for d in devs[:n_workers]]
+    pool_lock = threading.Lock()
+    local = threading.local()
+
+    def run(idx_ep):
+        idx, ep = idx_ep
+        mesh = getattr(local, "mesh", None)
+        if mesh is None:
+            with pool_lock:
+                mesh = meshes.pop()
+            local.mesh = mesh
+        dev = mesh.devices.flat[0]
+        sub_ctx = _dc.replace(ctx, mesh=mesh)
+        log.info("evaluating candidate %d/%d on %s",
+                 idx + 1, len(params_list), dev)
+        # default_device (thread-local) routes the serve-side arrays —
+        # batch_predict / model device_puts that don't name a device —
+        # onto this worker's chip too, not everyone onto device 0.
+        with jax.default_device(dev):
+            return ep, engine.eval(sub_ctx, ep, ctx.workflow_params)
+
+    with cf.ThreadPoolExecutor(max_workers=n_workers) as ex:
+        # ex.map yields in input order — candidate order is preserved
+        return list(ex.map(run, enumerate(params_list)))
+
+
 def run_evaluation(
     evaluation: Evaluation,
     generator: Optional[EngineParamsGenerator],
@@ -32,6 +87,7 @@ def run_evaluation(
     batch: str = "",
     evaluation_name: str = "",
     generator_name: str = "",
+    parallelism: int = 1,
 ) -> tuple[MetricEvaluatorResult, str]:
     ctx = ctx or WorkflowContext()
     storage = ctx.get_storage()
@@ -61,11 +117,15 @@ def run_evaluation(
     log.info("EvaluationInstance %s EVALRUNNING (%d candidates)",
              instance_id, len(params_list))
     try:
-        candidates = []
-        for i, ep in enumerate(params_list):
-            log.info("evaluating candidate %d/%d", i + 1, len(params_list))
-            eval_data = engine.eval(ctx, ep, ctx.workflow_params)
-            candidates.append((ep, eval_data))
+        if parallelism > 1:
+            candidates = _eval_candidates_parallel(
+                engine, params_list, ctx, parallelism)
+        else:
+            candidates = []
+            for i, ep in enumerate(params_list):
+                log.info("evaluating candidate %d/%d", i + 1, len(params_list))
+                eval_data = engine.eval(ctx, ep, ctx.workflow_params)
+                candidates.append((ep, eval_data))
         evaluator = MetricEvaluator(metric, other_metrics)
         result = evaluator.evaluate_candidates(candidates)
         done = EvaluationInstance(

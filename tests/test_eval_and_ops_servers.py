@@ -62,6 +62,40 @@ def test_evaluation_workflow_end_to_end(memory_storage):
         assert requests.get(st.base + "/instances/nope.json").status_code == 404
 
 
+def test_evaluation_parallel_candidates_matches_sequential(memory_storage):
+    """--parallel-candidates: candidates run concurrently on disjoint
+    single-device submeshes; the leaderboard must agree with a
+    sequential run over the same single-device meshes (task parallelism,
+    SURVEY.md §2.9)."""
+    import jax
+
+    from incubator_predictionio_tpu.models.recommendation_eval import (
+        ParamsList,
+        RecommendationEvaluation,
+    )
+    from incubator_predictionio_tpu.parallel.mesh import mesh_from_devices
+
+    _seed_ratings(memory_storage, n_users=25, n_items=15)
+    one_dev = mesh_from_devices(devices=jax.devices("cpu")[:1])
+    ctx_seq = WorkflowContext(app_name="testapp", storage=memory_storage,
+                              mesh=one_dev)
+    seq, _ = run_evaluation(
+        RecommendationEvaluation(), ParamsList(app_name="testapp"), ctx_seq)
+
+    ctx_par = WorkflowContext(app_name="testapp", storage=memory_storage)
+    par, iid = run_evaluation(
+        RecommendationEvaluation(), ParamsList(app_name="testapp"), ctx_par,
+        parallelism=4)
+    assert len(par.all_results) == len(seq.all_results) == 4
+    # same single-device training → identical candidate order and scores
+    for (_, score_s, _), (_, score_p, _) in zip(seq.all_results,
+                                                par.all_results):
+        assert score_s == score_p
+    assert par.best_score == seq.best_score
+    inst = memory_storage.get_meta_data_evaluation_instances().get(iid)
+    assert inst.status == "EVALCOMPLETED"
+
+
 def test_admin_server(memory_storage):
     from incubator_predictionio_tpu.tools.admin import AdminServer
 
