@@ -47,9 +47,9 @@ _DAO_ROUTES = {
 
 # Wire surface per DAO — exactly the methods the HTTP client classes
 # speak (data/storage/http_backend.py _HTTP*). Anything else 404s:
-# the DAOs carry non-wire methods (aggregate_properties, compact, ...)
-# whose results aren't JSON-encodable and which were never meant to be
-# remote-callable. Model blobs ride the dedicated /models/... routes.
+# the DAOs carry non-wire methods (compact, scan_columnar, ...) that
+# were never meant to be remote-callable. Model blobs ride the
+# dedicated /models/... routes.
 _ALLOWED_METHODS = {
     "apps": {"insert", "get", "get_by_name", "get_all", "update", "delete"},
     "access_keys": {"insert", "get", "get_all", "get_by_appid", "update",
@@ -62,7 +62,11 @@ _ALLOWED_METHODS = {
     "models": set(),  # blob routes only
     "l_events": {"init", "remove", "insert", "insert_batch", "get", "delete",
                  "delete_batch", "find"},
-    "p_events": {"find", "write", "delete"},
+    # aggregate_properties runs server-side: the replay result (one dict
+    # per entity) is orders of magnitude smaller on the wire than the
+    # $set/$unset/$delete event stream it replaces, and the server's
+    # backend may have a columnar fast path (JSONL aggregate_columnar).
+    "p_events": {"find", "write", "delete", "aggregate_properties"},
 }
 
 # Record-valued "record" argument decoders, per DAO.
@@ -106,6 +110,10 @@ def _decode_args(dao: str, method: str, args: dict) -> dict:
 def _encode_result(dao: str, result):
     if isinstance(result, Event):  # l_events.get
         return result.to_json()
+    if dao == "p_events" and isinstance(result, dict):
+        # aggregate_properties: {entity_id: PropertyMap}
+        return {eid: codec.property_map_to_json(pm)
+                for eid, pm in result.items()}
     enc = _RESULT_CODECS.get(dao)
     if enc is None:
         return result

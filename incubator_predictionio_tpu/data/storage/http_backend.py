@@ -55,6 +55,21 @@ def _dt_from_json(s: Optional[str]) -> Optional[_dt.datetime]:
     return None if s is None else _dt.datetime.fromisoformat(s)
 
 
+def property_map_to_json(pm) -> dict:
+    return {
+        "properties": pm.to_dict(),
+        "firstUpdated": _dt_to_json(pm.first_updated),
+        "lastUpdated": _dt_to_json(pm.last_updated),
+    }
+
+
+def property_map_from_json(o: dict):
+    from .datamap import PropertyMap
+
+    return PropertyMap(o["properties"], _dt_from_json(o["firstUpdated"]),
+                       _dt_from_json(o["lastUpdated"]))
+
+
 def app_to_json(a: base.App) -> dict:
     return {"id": a.id, "name": a.name, "description": a.description}
 
@@ -518,6 +533,22 @@ class _HTTPPEvents(base.PEvents):
         ))
         for o in self._t.stream("p_events", "find", self._ns, args):
             yield Event.from_json(o)
+
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        # Server-side replay: one result dict per entity crosses the
+        # wire instead of the whole $set/$unset/$delete event stream,
+        # and the server's backend may aggregate columnar (JSONL).
+        out = self._t.call("p_events", "aggregate_properties", self._ns, {
+            "app_id": app_id, "entity_type": entity_type,
+            "channel_id": channel_id,
+            "start_time": _dt_to_json(start_time),
+            "until_time": _dt_to_json(until_time),
+            "required": list(required) if required else None,
+        })
+        return {eid: property_map_from_json(o)
+                for eid, o in (out or {}).items()}
 
     def write(self, events: Iterable[Event], app_id, channel_id=None):
         # Chunked so arbitrarily large bulk writes stream in bounded

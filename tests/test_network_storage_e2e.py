@@ -118,6 +118,30 @@ def test_shared_store_lifecycle_and_remote_deploy(storage_server, tmp_path):
                 env_a)
     assert "Imported 300 events" in r.stdout
 
+    # server-side aggregate_properties over the wire: replayed result
+    # matches the $set stream just imported
+    props_file = tmp_path / "props.jsonl"
+    with open(props_file, "w") as f:
+        f.write(json.dumps({
+            "event": "$set", "entityType": "item", "entityId": "i1",
+            "properties": {"category": "a", "price": 3},
+            "eventTime": "2024-01-02T00:00:00.000Z"}) + "\n")
+        f.write(json.dumps({
+            "event": "$set", "entityType": "item", "entityId": "i1",
+            "properties": {"price": 5},
+            "eventTime": "2024-01-03T00:00:00.000Z"}) + "\n")
+    run_pio(["import", "--app-name", "NetApp", "--input", str(props_file)],
+            env_a)
+    from incubator_predictionio_tpu.data.storage import Storage as _S
+
+    s_http = _S({k: v for k, v in env_a.items()
+                 if k.startswith("PIO_STORAGE")})
+    agg = s_http.get_p_events().aggregate_properties(1, "item")
+    assert set(agg) == {"i1"}
+    assert agg["i1"].to_dict() == {"category": "a", "price": 5}
+    assert agg["i1"].first_updated.isoformat().startswith("2024-01-02")
+    assert agg["i1"].last_updated.isoformat().startswith("2024-01-03")
+
     proj = str(tmp_path / "engine")
     run_pio(["template", "get", "recommendation", proj], env_a)
     ej = os.path.join(proj, "engine.json")
@@ -195,7 +219,7 @@ def test_auth_rejects_bad_or_missing_secret(storage_server):
     assert post("/rpc/apps/get_all",
                 {"Authorization": f"Bearer {SECRET}"}) == 200
     # non-wire DAO methods are not remotely callable (allowlist)
-    assert post("/rpc/p_events/aggregate_properties",
+    assert post("/rpc/l_events/compact",
                 {"Authorization": f"Bearer {SECRET}"}) == 404
 
 
