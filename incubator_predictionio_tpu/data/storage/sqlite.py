@@ -22,7 +22,7 @@ import threading
 from typing import Iterable, Iterator, Optional, Sequence
 
 from . import base
-from .datamap import DataMap
+from .datamap import DataMap, PropertyMap
 from .event import Event, new_event_id
 
 _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
@@ -672,6 +672,66 @@ class SQLiteLEvents(base.LEvents, _Dao):
             yield self._row_to_event(r)
 
 
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        """$set/$unset/$delete replay on raw rows — result-identical to
+        the generic Event replay over find() (same SQL ordering) without
+        materializing an Event per row; only each row's properties JSON
+        is parsed."""
+        t = self._table(app_id, channel_id)
+        clauses = ["event IN ('$set','$unset','$delete')"]
+        params: list = []
+        if entity_type is not None:
+            clauses.append("entitytype = ?")
+            params.append(entity_type)
+        if start_time is not None:
+            clauses.append("eventtime >= ?")
+            params.append(_to_micros(start_time))
+        if until_time is not None:
+            clauses.append("eventtime < ?")
+            params.append(_to_micros(until_time))
+        sql = (f"SELECT entityid, event, properties, eventtime FROM {t} "
+               f"WHERE {' AND '.join(clauses)} "
+               "ORDER BY eventtime ASC, rowid ASC")
+        with self._lock:
+            try:
+                rows = self._conn.execute(sql, params).fetchall()
+            except sqlite3.OperationalError as e:
+                if not self._missing_table(e):
+                    raise
+                rows = []
+        state: dict[str, tuple[dict, int, int]] = {}
+        for eid, ev, props_s, t_us in rows:
+            if ev == "$set":
+                d = json.loads(props_s) if props_s else {}
+                got = state.get(eid)
+                if got is not None:
+                    props, first, _ = got
+                    props.update(d)
+                    state[eid] = (props, first, t_us)
+                else:
+                    state[eid] = (d, t_us, t_us)
+            elif ev == "$unset":
+                got = state.get(eid)
+                if got is not None:
+                    props, first, _ = got
+                    if props_s:
+                        for k in json.loads(props_s):
+                            props.pop(k, None)
+                    state[eid] = (props, first, t_us)
+            else:  # $delete
+                state.pop(eid, None)
+        out = {
+            eid: PropertyMap(props, _from_micros(first), _from_micros(last))
+            for eid, (props, first, last) in state.items()
+        }
+        if required:
+            req = set(required)
+            out = {k: v for k, v in out.items() if req.issubset(v.keyset())}
+        return out
+
+
 class SQLitePEvents(base.PEvents):
     def __init__(self, l_events: SQLiteLEvents):
         self._l = l_events
@@ -690,3 +750,10 @@ class SQLitePEvents(base.PEvents):
     def delete(self, event_ids: Iterable[str], app_id: int, channel_id: Optional[int] = None) -> None:
         for eid in event_ids:
             self._l.delete(eid, app_id, channel_id)
+
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        return self._l.aggregate_properties(
+            app_id, entity_type, channel_id, start_time, until_time,
+            required)

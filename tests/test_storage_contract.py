@@ -432,8 +432,9 @@ def test_insert_without_init_autocreates(storage):
     assert not le.delete("nonexistent", 4242)  # missing table → False, no raise
 
 
-def test_jsonl_columnar_aggregate_matches_generic(tmp_path):
-    """The JSONL backend's columnar $set/$unset/$delete replay must be
+@pytest.mark.parametrize("backend", ["jsonl", "sqlite"])
+def test_fast_aggregate_matches_generic(tmp_path, backend):
+    """The JSONL columnar replay and the SQLite raw-row replay must be
     result-identical (keys, values, first/last times) to the generic
     Event-replay over find() — fuzzed with ties, windows, tombstones,
     mixed entity types, and the required filter."""
@@ -442,10 +443,22 @@ def test_jsonl_columnar_aggregate_matches_generic(tmp_path):
     from incubator_predictionio_tpu.data.storage.base import (
         aggregate_property_events,
     )
-    from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
 
+    if backend == "jsonl":
+        from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
+
+        le = JSONLEvents(str(tmp_path))
+    else:
+        from incubator_predictionio_tpu.data.storage.sqlite import (
+            SQLiteClient,
+        )
+        from incubator_predictionio_tpu.data.storage.base import (
+            StorageClientConfig,
+        )
+
+        le = SQLiteClient(StorageClientConfig(properties={
+            "PATH": str(tmp_path / "agg.sqlite")})).l_events()
     rng = random.Random(4)
-    le = JSONLEvents(str(tmp_path))
     base_t = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
     evs = []
     for _ in range(3000):
