@@ -61,7 +61,7 @@ _ALLOWED_METHODS = {
                              "update", "delete"},
     "models": set(),  # blob routes only
     "l_events": {"init", "remove", "insert", "insert_batch", "get", "delete",
-                 "delete_batch", "find"},
+                 "delete_batch", "find", "aggregate_properties"},
     # aggregate_properties runs server-side: the replay result (one dict
     # per entity) is orders of magnitude smaller on the wire than the
     # $set/$unset/$delete event stream it replaces, and the server's
@@ -110,7 +110,7 @@ def _decode_args(dao: str, method: str, args: dict) -> dict:
 def _encode_result(dao: str, result):
     if isinstance(result, Event):  # l_events.get
         return result.to_json()
-    if dao == "p_events" and isinstance(result, dict):
+    if dao in ("p_events", "l_events") and isinstance(result, dict):
         # aggregate_properties: {entity_id: PropertyMap}
         return {eid: codec.property_map_to_json(pm)
                 for eid, pm in result.items()}

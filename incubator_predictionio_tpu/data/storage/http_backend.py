@@ -501,6 +501,18 @@ class _HTTPLEvents(base.LEvents):
         return self._call("delete_batch", event_ids=list(event_ids),
                           app_id=app_id, channel_id=channel_id)
 
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        # Server-side replay (see _HTTPPEvents.aggregate_properties).
+        out = self._call(
+            "aggregate_properties", app_id=app_id, entity_type=entity_type,
+            channel_id=channel_id, start_time=_dt_to_json(start_time),
+            until_time=_dt_to_json(until_time),
+            required=list(required) if required else None)
+        return {eid: property_map_from_json(o)
+                for eid, o in (out or {}).items()}
+
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
              target_entity_type=None, target_entity_id=None, limit=None,
