@@ -237,6 +237,70 @@ class PGLEvents(base.LEvents):
         return (Event.from_json(json.loads(r[0])) for r in rows)
 
 
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        """$set/$unset/$delete replay from raw rows (same pattern as the
+        SQLite backend): only each row's eventjson is parsed for its
+        properties — no per-row Event validation — and the ordering is
+        the same (eventtimeus, seq) the generic find() replay sorts by.
+        """
+        from .datamap import PropertyMap
+
+        where = ["appid=$1", "channelid=$2",
+                 "event IN ('$set','$unset','$delete')"]
+        params: list = [app_id, self._chan(channel_id)]
+
+        def arg(v):
+            params.append(v)
+            return f"${len(params)}"
+
+        if entity_type is not None:
+            where.append(f"entitytype = {arg(entity_type)}")
+        if start_time is not None:
+            where.append(f"eventtimeus >= {arg(_time_us(start_time))}")
+        if until_time is not None:
+            where.append(f"eventtimeus < {arg(_time_us(until_time))}")
+        sql = (f"SELECT entityid, event, eventjson, eventtimeus FROM "
+               f"{self._t} WHERE " + " AND ".join(where)
+               + " ORDER BY eventtimeus ASC, seq ASC")
+        _, rows = self._c.query(sql, params)
+
+        state: dict[str, tuple[dict, int, int]] = {}
+        for eid, ev, ej, t_us in rows:
+            t_us = int(t_us)
+            if ev == "$set":
+                d = json.loads(ej).get("properties") or {}
+                got = state.get(eid)
+                if got is not None:
+                    props, first, _ = got
+                    props.update(d)
+                    state[eid] = (props, first, t_us)
+                else:
+                    state[eid] = (d, t_us, t_us)
+            elif ev == "$unset":
+                got = state.get(eid)
+                if got is not None:
+                    props, first, _ = got
+                    for k in json.loads(ej).get("properties") or {}:
+                        props.pop(k, None)
+                    state[eid] = (props, first, t_us)
+            else:  # $delete
+                state.pop(eid, None)
+
+        epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+        out = {
+            eid: PropertyMap(props,
+                             epoch + _dt.timedelta(microseconds=first),
+                             epoch + _dt.timedelta(microseconds=last))
+            for eid, (props, first, last) in state.items()
+        }
+        if required:
+            req = set(required)
+            out = {k: v for k, v in out.items() if req.issubset(v.keyset())}
+        return out
+
+
 class PGPEvents(base.PEvents):
     def __init__(self, l_events: PGLEvents):
         self._l = l_events
@@ -257,6 +321,13 @@ class PGPEvents(base.PEvents):
                channel_id: Optional[int] = None) -> None:
         for eid in event_ids:
             self._l.delete(eid, app_id, channel_id)
+
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        return self._l.aggregate_properties(
+            app_id, entity_type, channel_id, start_time, until_time,
+            required)
 
 
 class PGApps(base.Apps):
