@@ -345,6 +345,66 @@ class ESLEvents(base.LEvents):
                                     sort, limit=limit):
             yield Event.from_json(h["_source"])
 
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        """$set/$unset/$delete replay on raw hit sources (same pattern
+        as the SQLite/PG backends): the transport already JSON-parsed
+        each `_source`, so the replay needs no per-row Event validation
+        or eventTime re-parse (the stored eventTimeUs is the sort key
+        AND the PropertyMap time)."""
+        from .datamap import PropertyMap
+
+        filters: list[dict] = [
+            {"terms": {"event": ["$set", "$unset", "$delete"]}},
+            {"term": {"entityType": entity_type}},
+        ]
+        time_range = {}
+        if start_time is not None:
+            time_range["gte"] = _time_us(start_time)
+        if until_time is not None:
+            time_range["lt"] = _time_us(until_time)
+        if time_range:
+            filters.append({"range": {"eventTimeUs": time_range}})
+        sort = [{"eventTimeUs": {"order": "asc"}},
+                {"_seq_no": {"order": "asc"}}]
+        state: dict[str, tuple[dict, int, int]] = {}
+        for h in self._t.search_all(self._idx(app_id, channel_id),
+                                    {"bool": {"filter": filters}}, sort):
+            src = h["_source"]
+            eid = src["entityId"]
+            ev = src["event"]
+            t_us = int(src["eventTimeUs"])
+            if ev == "$set":
+                got = state.get(eid)
+                if got is not None:
+                    props, first, _ = got
+                    props.update(src.get("properties") or {})
+                    state[eid] = (props, first, t_us)
+                else:
+                    state[eid] = (dict(src.get("properties") or {}),
+                                  t_us, t_us)
+            elif ev == "$unset":
+                got = state.get(eid)
+                if got is not None:
+                    props, first, _ = got
+                    for k in src.get("properties") or {}:
+                        props.pop(k, None)
+                    state[eid] = (props, first, t_us)
+            else:  # $delete
+                state.pop(eid, None)
+        epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+        out = {
+            eid: PropertyMap(props,
+                             epoch + _dt.timedelta(microseconds=first),
+                             epoch + _dt.timedelta(microseconds=last))
+            for eid, (props, first, last) in state.items()
+        }
+        if required:
+            req = set(required)
+            out = {k: v for k, v in out.items() if req.issubset(v.keyset())}
+        return out
+
 
 class ESPEvents(base.PEvents):
     def __init__(self, l_events: ESLEvents):
@@ -365,6 +425,13 @@ class ESPEvents(base.PEvents):
     def delete(self, event_ids: Iterable[str], app_id: int,
                channel_id: Optional[int] = None) -> None:
         self._l.delete_batch(list(event_ids), app_id, channel_id)
+
+    def aggregate_properties(self, app_id, entity_type, channel_id=None,
+                             start_time=None, until_time=None,
+                             required=None):
+        return self._l.aggregate_properties(
+            app_id, entity_type, channel_id, start_time, until_time,
+            required)
 
 
 # -- metadata ---------------------------------------------------------------
