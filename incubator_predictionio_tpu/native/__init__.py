@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 9
+_EXPECTED_VERSION = 10
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -107,7 +107,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64),   # prim_base
         ctypes.POINTER(ctypes.c_int64),   # v_base
         ctypes.POINTER(ctypes.c_int64),   # vc_e
-        ctypes.POINTER(ctypes.c_int32),   # cursor scratch
+        ctypes.POINTER(ctypes.c_int64),   # cursor scratch
         ctypes.c_int64,                   # n_rows
         ctypes.POINTER(ctypes.c_int32),   # flat_cols
         ctypes.POINTER(ctypes.c_float),   # flat_vals
@@ -356,7 +356,7 @@ def fill_entries(row: np.ndarray, col: np.ndarray, val, col_slot_map,
         if flat_vals.dtype != np.float32 or not flat_vals.flags.c_contiguous:
             raise ValueError(
                 "fill_entries: flat_vals must be contiguous float32")
-    cursor = np.empty(n_rows, np.int32)
+    cursor = np.empty(n_rows, np.int64)
 
     def p(a, ct):
         return None if a is None else a.ctypes.data_as(ctypes.POINTER(ct))
@@ -366,7 +366,7 @@ def fill_entries(row: np.ndarray, col: np.ndarray, val, col_slot_map,
         p(val, ctypes.c_float), len(row),
         p(col_slot_map, ctypes.c_int64), len(col_slot_map),
         p(prim_base, ctypes.c_int64), p(v_base, ctypes.c_int64),
-        p(vc_e, ctypes.c_int64), p(cursor, ctypes.c_int32), n_rows,
+        p(vc_e, ctypes.c_int64), p(cursor, ctypes.c_int64), n_rows,
         p(flat_cols, ctypes.c_int32), p(flat_vals, ctypes.c_float),
         len(flat_cols),
     )

@@ -54,7 +54,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from .pallas_kernels import batched_spd_solve
-from .rowblocks import BucketArrays, LayoutPlan, fill_buckets, plan_layout
+from .rowblocks import (
+    BucketArrays, LayoutPlan, fill_buckets, ladder_growth, plan_layout,
+)
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh
 
 
@@ -867,6 +869,29 @@ def train_als_process_sharded(
         gathered = np.asarray(
             multihost_utils.process_allgather(local)).reshape(-1)
         return gathered[:n_rows]
+
+    # The ladder growth shapes the GLOBAL layout plan, so every process
+    # must agree on it before planning — a silent cross-host env mismatch
+    # would yield divergent plans whose shape-mismatched collectives hang
+    # or corrupt. Allgather-verify like binary_ratings below.
+    growth = ladder_growth()
+    # Gather the float64 BIT PATTERN as two int32s: device_put silently
+    # canonicalizes float64→float32 and int64→int32 (x64 mode is never
+    # on), which would corrupt either wider representation; int32 is the
+    # one dtype the gather leaves untouched (binary_ratings below relies
+    # on the same fact).
+    growth_bits = np.frombuffer(np.float64(growth).tobytes(), np.int32)
+    all_growth = np.asarray(multihost_utils.process_allgather(
+        growth_bits)).reshape(-1, 2)
+    if not np.all(all_growth == growth_bits[None, :]):
+        seen = sorted(set(
+            float(np.frombuffer(np.asarray(row, np.int32).tobytes(),
+                                np.float64)[0])
+            for row in all_growth))
+        raise ValueError(
+            "PIO_ALS_LADDER_GROWTH disagrees across processes: "
+            f"{seen} — every host must set the same value (it shapes "
+            "the global factor layout)")
 
     # Both slices use (user_idx, item_idx, rating) tuple order; the
     # solved-side ROW array is user_slice[0] resp. item_slice[1].

@@ -43,14 +43,47 @@ import numpy as np
 #: largest einsum slab.
 OVERFLOW_LEN = 2048
 
-#: Geometric growth of the capacity ladder past 64. Every padded slot is
-#: a wasted gather (the ALS wall), so tighter is faster until the bucket
-#: count (= separate einsum programs inside the one jit) hurts compile
-#: time. Measured at ML-20M shape: 1.15 → mean padding 1.100 (5+15
-#: buckets), 1.05 → 1.052 (12+37 buckets) — ~4.6% fewer gathered rows.
-#: Env-tunable for experiments/deployment (must agree across hosts).
-LADDER_GROWTH = float(__import__("os").environ.get(
-    "PIO_ALS_LADDER_GROWTH", "1.15"))
+#: Default geometric growth of the capacity ladder past 64. Every padded
+#: slot is a wasted gather (the ALS wall), so tighter is faster until the
+#: bucket count (= separate einsum programs inside the one jit) hurts
+#: compile time. Measured at ML-20M shape: 1.15 → mean padding 1.100
+#: (5+15 buckets), 1.05 → 1.052 (12+37 buckets) — ~4.6% fewer gathered
+#: rows.
+DEFAULT_LADDER_GROWTH = 1.15
+
+
+def ladder_growth() -> float:
+    """Effective ladder growth: PIO_ALS_LADDER_GROWTH env or the default.
+
+    Parsed lazily so a malformed value degrades to the default with a
+    warning instead of raising at import time in every entry point.
+    Values outside (1.0, 4.0] also fall back to the default with a
+    warning (≤1.0 never terminates the ladder; >4.0 is effectively a
+    two-bucket ladder, certainly a typo).
+    The value shapes the GLOBAL layout plan, so multi-host runs fold it
+    into the layout fingerprint and allgather-verify agreement (see
+    ops/als.py) — a cross-host mismatch fails fast instead of hanging in
+    shape-mismatched collectives.
+    """
+    import os
+    import warnings
+
+    raw = os.environ.get("PIO_ALS_LADDER_GROWTH")
+    if raw is None:
+        return DEFAULT_LADDER_GROWTH
+    try:
+        g = float(raw)
+    except ValueError:
+        warnings.warn(
+            f"PIO_ALS_LADDER_GROWTH={raw!r} is not a number; using "
+            f"{DEFAULT_LADDER_GROWTH}", stacklevel=2)
+        return DEFAULT_LADDER_GROWTH
+    if not 1.0 < g <= 4.0:
+        warnings.warn(
+            f"PIO_ALS_LADDER_GROWTH={g} outside (1.0, 4.0]; using "
+            f"{DEFAULT_LADDER_GROWTH}", stacklevel=2)
+        return DEFAULT_LADDER_GROWTH
+    return g
 
 
 def length_ladder(max_len: int, overflow_len: int = OVERFLOW_LEN,
@@ -62,7 +95,7 @@ def length_ladder(max_len: int, overflow_len: int = OVERFLOW_LEN,
     count (= separate einsum programs) in the tens. All hosts of a
     multi-host run must agree on ``growth`` (it shapes the global plan).
     """
-    g = LADDER_GROWTH if growth is None else float(growth)
+    g = ladder_growth() if growth is None else float(growth)
     target = max(8, min(int(max_len), overflow_len))
     caps = []
     v = 0

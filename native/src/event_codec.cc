@@ -547,7 +547,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 9; }
+int32_t pio_codec_version() { return 10; }
 
 namespace {
 // FNV-1a over a byte range, continuing from a running state.
@@ -635,7 +635,7 @@ int32_t pio_fill_entries(
     const int64_t* row, const int64_t* col, const float* val, int64_t nnz,
     const int64_t* col_slot_map, int64_t n_cols,
     const int64_t* prim_base, const int64_t* v_base, const int64_t* vc_e,
-    int32_t* cursor, int64_t n_rows,
+    int64_t* cursor, int64_t n_rows,
     int32_t* flat_cols, float* flat_vals, int64_t total) {
   for (int64_t r = 0; r < n_rows; ++r) cursor[r] = 0;
   for (int64_t i = 0; i < nnz; ++i) {

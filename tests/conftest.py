@@ -17,6 +17,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Keep __graft_entry__.dryrun_multichip off its subprocess backend probe in
+# tests: the probe would cold-init the sandbox's remote-PJRT backend (slow,
+# and a hang risk when the tunnel is wedged). Tests that exercise the probe
+# itself clear this.
+os.environ.setdefault("PIO_DRYRUN_FORCE_CPU", "1")
+
 # The sandbox's axon PJRT plugin (sitecustomize) force-selects the TPU
 # backend regardless of JAX_PLATFORMS, so flip the default platform AFTER
 # import — jax.devices() then returns the 8 virtual CPU devices. Storage

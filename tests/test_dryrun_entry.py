@@ -25,6 +25,85 @@ def test_dryrun_multichip_runs():
     __graft_entry__.dryrun_multichip(8)
 
 
+def test_probe_timeout_returns_zero(monkeypatch):
+    """A wedged tunnel = probe subprocess that never answers.
+
+    MULTICHIP_r03 timed out because a cold jax.devices() blocked forever
+    inside the sandbox plugin's backend init. The probe must turn that
+    into a bounded 0 ("default backend unusable"), not a hang.
+    """
+    import time
+
+    import __graft_entry__
+
+    monkeypatch.setenv("PIO_DRYRUN_PROBE_CODE", "import time; time.sleep(300)")
+    monkeypatch.setenv("PIO_DRYRUN_PROBE_TIMEOUT", "1")
+    t0 = time.monotonic()
+    assert __graft_entry__._probe_default_backend() == 0
+    assert time.monotonic() - t0 < 30
+
+
+def test_probe_timeout_with_pipe_holding_grandchild(monkeypatch):
+    """The wedge-prone plugin spawns helper processes that inherit the
+    probe's stdout pipe; killing only the direct child would leave the
+    parent blocked on the pipe forever. The group kill must reap it."""
+    import time
+
+    import __graft_entry__
+
+    monkeypatch.setenv(
+        "PIO_DRYRUN_PROBE_CODE",
+        "import subprocess, sys, time; "
+        "subprocess.Popen([sys.executable, '-c', 'import time; "
+        "time.sleep(300)']); time.sleep(300)")
+    monkeypatch.setenv("PIO_DRYRUN_PROBE_TIMEOUT", "1")
+    t0 = time.monotonic()
+    assert __graft_entry__._probe_default_backend() == 0
+    assert time.monotonic() - t0 < 30
+
+
+def test_probe_failure_returns_zero(monkeypatch):
+    import __graft_entry__
+
+    monkeypatch.setenv("PIO_DRYRUN_PROBE_CODE", "raise SystemExit(7)")
+    assert __graft_entry__._probe_default_backend() == 0
+
+
+def test_ensure_platform_pins_cpu_when_probe_fails(monkeypatch):
+    """With no live backend and a dead probe, the CPU platform is pinned
+    BEFORE any device query (the only hook-bypassing order)."""
+    import jax
+    from jax._src import xla_bridge
+
+    import __graft_entry__
+
+    monkeypatch.delenv("PIO_DRYRUN_FORCE_CPU", raising=False)
+    monkeypatch.setattr(xla_bridge, "_backends", {})
+    monkeypatch.setattr(__graft_entry__, "_probe_default_backend", lambda: 0)
+    updates = []
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: updates.append((k, v)))
+    __graft_entry__._ensure_platform(8)
+    assert ("jax_platforms", "cpu") in updates
+
+
+def test_ensure_platform_skips_probe_with_live_backend(monkeypatch):
+    """Once a backend is live in-process, device queries are cache-served;
+    no subprocess probe (slow, wedge-prone) should be spawned."""
+    import __graft_entry__
+
+    monkeypatch.delenv("PIO_DRYRUN_FORCE_CPU", raising=False)
+
+    def boom():
+        raise AssertionError("probe must not run with a live backend")
+
+    monkeypatch.setattr(__graft_entry__, "_probe_default_backend", boom)
+    import jax
+
+    jax.devices()  # ensure a live backend
+    __graft_entry__._ensure_platform(8)
+
+
 def test_entry_compiles():
     import jax
 

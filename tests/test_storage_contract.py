@@ -432,32 +432,68 @@ def test_insert_without_init_autocreates(storage):
     assert not le.delete("nonexistent", 4242)  # missing table → False, no raise
 
 
-@pytest.mark.parametrize("backend", ["jsonl", "sqlite"])
+@pytest.mark.parametrize(
+    "backend", ["jsonl", "sqlite", "pgsql", "elasticsearch"])
 def test_fast_aggregate_matches_generic(tmp_path, backend):
-    """The JSONL columnar replay and the SQLite raw-row replay must be
+    """Every fast aggregate_properties path — JSONL columnar replay,
+    SQLite raw-row replay, PG raw-row replay, ES raw-hit replay — must be
     result-identical (keys, values, first/last times) to the generic
     Event-replay over find() — fuzzed with ties, windows, tombstones,
     mixed entity types, and the required filter."""
+    import contextlib
+
+    from incubator_predictionio_tpu.data.storage.base import (
+        StorageClientConfig,
+    )
+
+    with contextlib.ExitStack() as stack:
+        if backend == "jsonl":
+            from incubator_predictionio_tpu.data.storage.jsonl import (
+                JSONLEvents,
+            )
+
+            le = JSONLEvents(str(tmp_path))
+        elif backend == "sqlite":
+            from incubator_predictionio_tpu.data.storage.sqlite import (
+                SQLiteClient,
+            )
+
+            le = SQLiteClient(StorageClientConfig(properties={
+                "PATH": str(tmp_path / "agg.sqlite")})).l_events()
+        elif backend == "pgsql":
+            from pg_mock import MockPGServer
+
+            from incubator_predictionio_tpu.data.storage.postgres import (
+                PGClient,
+            )
+
+            srv = stack.enter_context(
+                MockPGServer(user="pio", password="piosecret"))
+            client = PGClient(StorageClientConfig(properties={
+                "HOST": "127.0.0.1", "PORT": str(srv.port),
+                "USERNAME": "pio", "PASSWORD": "piosecret"}))
+            stack.callback(client.close)
+            le = client.l_events()
+        else:
+            from es_mock import build_es_app
+            from server_utils import ServerThread
+
+            from incubator_predictionio_tpu.data.storage.elasticsearch import (
+                ESClient,
+            )
+
+            srv = stack.enter_context(ServerThread(build_es_app()))
+            le = ESClient(StorageClientConfig(properties={
+                "HOSTS": "127.0.0.1", "PORTS": str(srv.port)})).l_events()
+        _fuzz_aggregate_identity(le)
+
+
+def _fuzz_aggregate_identity(le):
     import random
 
     from incubator_predictionio_tpu.data.storage.base import (
         aggregate_property_events,
     )
-
-    if backend == "jsonl":
-        from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
-
-        le = JSONLEvents(str(tmp_path))
-    else:
-        from incubator_predictionio_tpu.data.storage.sqlite import (
-            SQLiteClient,
-        )
-        from incubator_predictionio_tpu.data.storage.base import (
-            StorageClientConfig,
-        )
-
-        le = SQLiteClient(StorageClientConfig(properties={
-            "PATH": str(tmp_path / "agg.sqlite")})).l_events()
     rng = random.Random(4)
     base_t = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
     evs = []
