@@ -331,6 +331,18 @@ class PGPEvents(base.PEvents):
 
 
 class PGApps(base.Apps):
+    #: Wire exception type; MySQL subclasses swap in MySQLError so the
+    #: inherited DAO bodies catch their own transport's errors.
+    _WIRE_ERROR = PGError
+
+    @staticmethod
+    def _is_duplicate(e) -> bool:
+        """Exactly a unique/duplicate-key violation — NOT the broader
+        integrity class (not-null/FK/check must surface, not read as
+        "already exists"). PG: sqlstate 23505; MySQL override: errno
+        1062."""
+        return e.sqlstate == "23505"
+
     def __init__(self, conn: PGConnection, namespace: str):
         self._c = conn
         self._t = f"{_safe_ident(namespace)}_apps".lower()
@@ -354,8 +366,8 @@ class PGApps(base.Apps):
                     f"((SELECT COALESCE(MAX(id),0)+1 FROM {self._t}),"
                     "$1,$2) RETURNING id",
                     (app.name, app.description))
-        except PGError as e:
-            if e.sqlstate == "23505":  # unique_violation
+        except self._WIRE_ERROR as e:
+            if self._is_duplicate(e):
                 return None
             raise
         return int(rows[0][0])
@@ -390,6 +402,9 @@ class PGApps(base.Apps):
 
 
 class PGAccessKeys(base.AccessKeys):
+    _WIRE_ERROR = PGError
+    _is_duplicate = PGApps.__dict__["_is_duplicate"]
+
     def __init__(self, conn: PGConnection, namespace: str):
         self._c = conn
         self._t = f"{_safe_ident(namespace)}_accesskeys".lower()
@@ -406,8 +421,8 @@ class PGAccessKeys(base.AccessKeys):
                 f"INSERT INTO {self._t} (accesskey, appid, events) "
                 "VALUES ($1,$2,$3)",
                 (key, k.appid, json.dumps(list(k.events))))
-        except PGError as e:
-            if e.sqlstate == "23505":
+        except self._WIRE_ERROR as e:
+            if self._is_duplicate(e):
                 return None
             raise
         return key
@@ -443,6 +458,9 @@ class PGAccessKeys(base.AccessKeys):
 
 
 class PGChannels(base.Channels):
+    _WIRE_ERROR = PGError
+    _is_duplicate = PGApps.__dict__["_is_duplicate"]
+
     def __init__(self, conn: PGConnection, namespace: str):
         self._c = conn
         self._t = f"{_safe_ident(namespace)}_channels".lower()
@@ -465,8 +483,8 @@ class PGChannels(base.Channels):
                     f"((SELECT COALESCE(MAX(id),0)+1 FROM {self._t}),"
                     "$1,$2) RETURNING id",
                     (channel.name, channel.appid))
-        except PGError as e:
-            if e.sqlstate == "23505":
+        except self._WIRE_ERROR as e:
+            if self._is_duplicate(e):
                 return None
             raise
         return int(rows[0][0])

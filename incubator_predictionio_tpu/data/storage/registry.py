@@ -32,6 +32,7 @@ from .http_backend import HTTPStorageClient
 from .jsonl import JSONLClient
 from .localfs import LocalFSClient
 from .memory import StorageClient as MemoryClient
+from .mysql import MySQLClient
 from .postgres import PGClient
 from .s3 import S3Client
 from .sqlite import SQLiteClient
@@ -61,6 +62,10 @@ _BACKENDS: dict[str, Callable[[base.StorageClientConfig], base.BaseStorageClient
     # repositories, like the reference's JDBC assembly (postgres.py;
     # connection: pgwire.py, no driver dependency).
     "PGSQL": PGClient,
+    # Real MySQL client/server protocol (caching_sha2/native auth,
+    # prepared-statement binary protocol) — the MySQL half of the
+    # reference's JDBC assembly (mysql.py; connection: mysqlwire.py).
+    "MYSQL": MySQLClient,
     # HBase REST gateway protocol — event data only, the reference's
     # HBase "event store of record" role (hbase.py).
     "HBASE": HBaseClient,

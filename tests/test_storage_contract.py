@@ -59,11 +59,34 @@ def _make_storage(kind, tmp_path):
 
 
 BACKENDS = ["memory", "sqlite", "mixed", "jsonl", "http", "s3",
-            "elasticsearch", "pgsql", "hbase", "hdfs"]
+            "elasticsearch", "pgsql", "mysql", "hbase", "hdfs"]
 
 
 @pytest.fixture(params=BACKENDS)
 def storage(request, tmp_path):
+    if request.param == "mysql":
+        # All three repositories over the REAL MySQL client/server
+        # protocol: caching_sha2_password challenge-response verified
+        # server-side, parameters via the prepared-statement binary
+        # protocol — the MySQL half of the reference's JDBC assembly
+        # (mysql_mock.py).
+        from mysql_mock import MockMySQLServer
+
+        with MockMySQLServer(user="pio", password="piosecret") as srv:
+            env = {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MY",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MY",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MY",
+                "PIO_STORAGE_SOURCES_MY_TYPE": "MYSQL",
+                "PIO_STORAGE_SOURCES_MY_HOST": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_MY_PORT": str(srv.port),
+                "PIO_STORAGE_SOURCES_MY_USERNAME": "pio",
+                "PIO_STORAGE_SOURCES_MY_PASSWORD": "piosecret",
+            }
+            s = Storage(env)
+            yield s
+            s.close()
+        return
     if request.param == "pgsql":
         # All three repositories over the REAL Postgres wire protocol
         # (v3 + SCRAM-SHA-256): the in-process server verifies the
@@ -433,13 +456,13 @@ def test_insert_without_init_autocreates(storage):
 
 
 @pytest.mark.parametrize(
-    "backend", ["jsonl", "sqlite", "pgsql", "elasticsearch"])
+    "backend", ["jsonl", "sqlite", "pgsql", "mysql", "elasticsearch"])
 def test_fast_aggregate_matches_generic(tmp_path, backend):
     """Every fast aggregate_properties path — JSONL columnar replay,
-    SQLite raw-row replay, PG raw-row replay, ES raw-hit replay — must be
-    result-identical (keys, values, first/last times) to the generic
-    Event-replay over find() — fuzzed with ties, windows, tombstones,
-    mixed entity types, and the required filter."""
+    SQLite raw-row replay, PG/MySQL raw-row replay, ES raw-hit replay —
+    must be result-identical (keys, values, first/last times) to the
+    generic Event-replay over find() — fuzzed with ties, windows,
+    tombstones, mixed entity types, and the required filter."""
     import contextlib
 
     from incubator_predictionio_tpu.data.storage.base import (
@@ -474,6 +497,20 @@ def test_fast_aggregate_matches_generic(tmp_path, backend):
                 "USERNAME": "pio", "PASSWORD": "piosecret"}))
             stack.callback(client.close)
             le = client.l_events()
+        elif backend == "mysql":
+            from mysql_mock import MockMySQLServer
+
+            from incubator_predictionio_tpu.data.storage.mysql import (
+                MySQLClient,
+            )
+
+            srv = stack.enter_context(
+                MockMySQLServer(user="pio", password="piosecret"))
+            client = MySQLClient(StorageClientConfig(properties={
+                "HOST": "127.0.0.1", "PORT": str(srv.port),
+                "USERNAME": "pio", "PASSWORD": "piosecret"}))
+            stack.callback(client.close)
+            le = client.l_events()
         else:
             from es_mock import build_es_app
             from server_utils import ServerThread
@@ -483,8 +520,10 @@ def test_fast_aggregate_matches_generic(tmp_path, backend):
             )
 
             srv = stack.enter_context(ServerThread(build_es_app()))
-            le = ESClient(StorageClientConfig(properties={
-                "HOSTS": "127.0.0.1", "PORTS": str(srv.port)})).l_events()
+            client = ESClient(StorageClientConfig(properties={
+                "HOSTS": "127.0.0.1", "PORTS": str(srv.port)}))
+            stack.callback(client.close)
+            le = client.l_events()
         _fuzz_aggregate_identity(le)
 
 
