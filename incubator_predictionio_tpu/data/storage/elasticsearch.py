@@ -160,6 +160,19 @@ class _ESTransport:
             return []
         if status != 200:
             raise ESStorageError(f"search {index}: HTTP {status} {out}")
+        # A 200 can still carry PARTIAL results: failed shards or a
+        # server-side timeout silently drop hits — for an event store
+        # that's data loss, so fail loudly instead.
+        shards = out.get("_shards") or {}
+        if shards.get("failed"):
+            raise ESStorageError(
+                f"search {index}: {shards['failed']}/{shards.get('total')} "
+                f"shards failed — partial results refused "
+                f"(failures: {str(shards.get('failures'))[:300]})")
+        if out.get("timed_out"):
+            raise ESStorageError(
+                f"search {index}: server-side timeout returned partial "
+                "results — refused")
         return out.get("hits", {}).get("hits", [])
 
     def search_all(self, index: str, query: dict, sort,

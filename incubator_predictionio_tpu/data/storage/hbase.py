@@ -23,9 +23,15 @@ pio_event_<appId>[_<channelId>]):
 - index rows: ``i:<eventId>`` → cell ``e:k`` holding the current data
   rowkey — the eventId → rowkey lookup for get/delete/upsert.
 
-Filters beyond the time range are applied client-side on the scan
-stream, like the reference's filter lists evaluate server-side but with
-identical semantics.
+Filters beyond the time range are PUSHED DOWN to the gateway: data rows
+carry the filterable fields as dedicated cells (``e:ev``, ``e:et``,
+``e:eid``, ``e:tet``, ``e:teid``) and filtered scans send a Stargate
+filter spec (FilterList of SingleColumnValueFilters — the same
+HBase-side evaluation the reference's HBEventsUtil filter lists get),
+so a filtered find only transfers matching rows. The client still
+re-checks every returned event (``event_matches``) as a semantic
+backstop, so results are identical even against a gateway that ignores
+the filter parameter.
 """
 
 from __future__ import annotations
@@ -119,6 +125,66 @@ class HBLEvents(storage_base.LEvents):
     def _index_key(event_id: str) -> bytes:
         return b"i:" + event_id.encode()
 
+    @staticmethod
+    def _event_cells(stored: Event) -> dict[str, bytes]:
+        """Data-row cells: the wire JSON plus the filterable fields as
+        dedicated cells so scans can evaluate filters server-side."""
+        cells = {"json": json.dumps(stored.to_json()).encode(),
+                 "ev": stored.event.encode(),
+                 "et": stored.entity_type.encode(),
+                 "eid": stored.entity_id.encode()}
+        if stored.target_entity_type is not None:
+            cells["tet"] = stored.target_entity_type.encode()
+        if stored.target_entity_id is not None:
+            cells["teid"] = stored.target_entity_id.encode()
+        return cells
+
+    def _scv(self, qualifier: str, value: str) -> dict:
+        """SingleColumnValueFilter(EQUAL) in the gateway's JSON spec.
+
+        ifMissing=False: rows LACKING the column pass the server filter
+        and fall through to the client-side ``event_matches`` backstop.
+        That keeps rows written before the filterable cells existed
+        (json-only format) visible to filtered finds — dropping them
+        server-side would be silent data invisibility. Rows written by
+        the current format always carry ev/et/eid, so the common
+        filters still prune server-side exactly; only target-field
+        filters transfer target-less events for the client to drop."""
+        return {"type": "SingleColumnValueFilter", "op": "EQUAL",
+                "family": _b64(self._CF.encode()),
+                "qualifier": _b64(qualifier.encode()),
+                "comparator": {"type": "BinaryComparator",
+                               "value": _b64(value.encode())},
+                "ifMissing": False, "latestVersion": True}
+
+    def _filter_spec(self, entity_type, entity_id, event_names,
+                     target_entity_type, target_entity_id) -> Optional[dict]:
+        """Server-side filter for everything the rowkey range can't do;
+        None when unfiltered (plain scans skip the parameter)."""
+        clauses = []
+        if entity_type is not None:
+            clauses.append(self._scv("et", entity_type))
+        if entity_id is not None:
+            clauses.append(self._scv("eid", entity_id))
+        if target_entity_type is not None:
+            clauses.append(self._scv("tet", target_entity_type))
+        if target_entity_id is not None:
+            clauses.append(self._scv("teid", target_entity_id))
+        if event_names is not None:
+            names = list(event_names)
+            alts = [self._scv("ev", n) for n in names]
+            if len(alts) == 1:
+                clauses.append(alts[0])
+            elif alts:
+                clauses.append({"type": "FilterList",
+                                "op": "MUST_PASS_ONE", "filters": alts})
+        if not clauses:
+            return None
+        if len(clauses) == 1:
+            return clauses[0]
+        return {"type": "FilterList", "op": "MUST_PASS_ALL",
+                "filters": clauses}
+
     # -- table lifecycle ---------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         status, _ = self._t.request(
@@ -189,8 +255,7 @@ class HBLEvents(storage_base.LEvents):
                 self._delete_row(table, old["k"])
         data_key = self._data_key(self._time_us(stored.event_time),
                                   self._next_seq())
-        self._put_cells(table, data_key,
-                        {"json": json.dumps(stored.to_json()).encode()})
+        self._put_cells(table, data_key, self._event_cells(stored))
         self._put_cells(table, self._index_key(eid), {"k": data_key})
         return eid
 
@@ -211,9 +276,10 @@ class HBLEvents(storage_base.LEvents):
             for e in fresh:
                 data_key = self._data_key(self._time_us(e.event_time),
                                           self._next_seq())
-                rows.append({"key": _b64(data_key), "Cell": [{
-                    "column": _b64(f"{self._CF}:json".encode()),
-                    "$": _b64(json.dumps(e.to_json()).encode())}]})
+                rows.append({"key": _b64(data_key), "Cell": [
+                    {"column": _b64(f"{self._CF}:{q}".encode()),
+                     "$": _b64(v)}
+                    for q, v in self._event_cells(e).items()]})
                 rows.append({"key": _b64(self._index_key(e.event_id)),
                              "Cell": [{
                                  "column": _b64(f"{self._CF}:k".encode()),
@@ -263,12 +329,18 @@ class HBLEvents(storage_base.LEvents):
         return True
 
     def _scan(self, table: str, start_key: bytes, end_key: bytes,
-              batch: int = 1000) -> Iterator[Event]:
-        """Rowkey-range scan via the stateful scanner API."""
+              batch: int = 1000,
+              hbase_filter: Optional[dict] = None) -> Iterator[Event]:
+        """Rowkey-range scan via the stateful scanner API; an optional
+        filter spec evaluates server-side (only matches cross the wire)."""
+        body = {"batch": batch, "startRow": _b64(start_key),
+                "endRow": _b64(end_key)}
+        if hbase_filter is not None:
+            # the gateway's scanner model carries the filter as a STRING
+            # holding the filter's own JSON serialization
+            body["filter"] = json.dumps(hbase_filter)
         status, location = self._t.request(
-            "PUT", f"/{table}/scanner",
-            body={"batch": batch, "startRow": _b64(start_key),
-                  "endRow": _b64(end_key)},
+            "PUT", f"/{table}/scanner", body=body,
             want_location=True)
         if status == 404:
             return
@@ -312,8 +384,15 @@ class HBLEvents(storage_base.LEvents):
                      if start_time is not None else b"t:")
         end_key = (self._data_key(self._time_us(until_time), 0)
                    if until_time is not None else b"t;")  # ';' > ':'
+        if event_names is not None and not list(event_names):
+            return iter(())
+        spec = self._filter_spec(entity_type, entity_id, event_names,
+                                 target_entity_type, target_entity_id)
+        # event_matches stays as a semantic backstop: results are
+        # identical even against a gateway that ignores `filter`.
         it = (
-            e for e in self._scan(table, start_key, end_key)
+            e for e in self._scan(table, start_key, end_key,
+                                  hbase_filter=spec)
             if event_matches(e, start_time, until_time, entity_type,
                              entity_id, event_names, target_entity_type,
                              target_entity_id)

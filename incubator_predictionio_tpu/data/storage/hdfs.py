@@ -43,18 +43,32 @@ class _WebHDFS:
 
     def _request(self, method: str, url: str, data: Optional[bytes] = None,
                  redirect_data: Optional[bytes] = None, follow: bool = True):
-        req = urllib.request.Request(url, data=data, method=method)
+        """(status, body, redirected) — ``redirected`` tells CREATE
+        whether its payload actually travelled (the 307 leg carries it)."""
+        headers = {}
+        if data is not None:
+            # HttpFS-style gateways 400 data-bearing CREATE/APPEND
+            # requests that are not application/octet-stream
+            headers["Content-Type"] = "application/octet-stream"
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.status, resp.read()
+                return resp.status, resp.read(), False
         except urllib.error.HTTPError as e:
             if e.code == 307 and follow:
                 # the CREATE/OPEN redirect to a DataNode: only THIS leg
                 # carries the file body (the WebHDFS two-step contract —
                 # the NameNode leg must be data-free)
-                return self._request(method, e.headers["Location"],
-                                     data=redirect_data, follow=False)
-            return e.code, e.read()
+                location = e.headers.get("Location")
+                if not location:
+                    raise HDFSStorageError(
+                        f"WebHDFS 307 without a Location header from "
+                        f"{url.split('?')[0]} — broken NameNode/proxy")
+                st, body, _ = self._request(method, location,
+                                            data=redirect_data, follow=False)
+                return st, body, True
+            return e.code, e.read(), False
         except urllib.error.URLError as e:
             raise HDFSStorageError(
                 f"WebHDFS unreachable: {self.endpoint} ({e.reason})") from e
@@ -62,15 +76,25 @@ class _WebHDFS:
     def create(self, path: str, data: bytes) -> None:
         # two-step: body-free PUT to the NameNode → 307 Location → PUT
         # the data to the DataNode
-        status, body = self._request(
+        status, body, redirected = self._request(
             "PUT", self._url(path, "CREATE", overwrite="true"),
             redirect_data=data)
+        if status in (200, 201) and not redirected and data:
+            # Direct-write gateway (HttpFS / certain proxies answer the
+            # NameNode leg themselves, no redirect): the "success" above
+            # created an EMPTY file because the first leg is body-free.
+            # Re-PUT with the payload attached instead of silently
+            # persisting nothing.
+            status, body, _ = self._request(
+                "PUT", self._url(path, "CREATE", overwrite="true",
+                                 data="true"),
+                data=data, follow=False)
         if status not in (200, 201):
             raise HDFSStorageError(
                 f"WebHDFS CREATE {path}: HTTP {status} {body[:200]!r}")
 
     def open(self, path: str) -> Optional[bytes]:
-        status, body = self._request("GET", self._url(path, "OPEN"))
+        status, body, _ = self._request("GET", self._url(path, "OPEN"))
         if status == 404:
             return None
         if status != 200:
@@ -79,7 +103,7 @@ class _WebHDFS:
         return body
 
     def delete(self, path: str) -> None:
-        status, body = self._request("DELETE", self._url(path, "DELETE"))
+        status, body, _ = self._request("DELETE", self._url(path, "DELETE"))
         if status not in (200, 404):
             raise HDFSStorageError(
                 f"WebHDFS DELETE {path}: HTTP {status} {body[:200]!r}")

@@ -421,12 +421,20 @@ class MySQLConnection:
                     "create a new MySQLConnection")
             try:
                 return self._query_locked(sql, params)
-            except (OSError, MySQLProtocolError):
+            except (OSError, MySQLProtocolError, struct.error, IndexError,
+                    UnicodeDecodeError) as e:
+                # struct/Index/Unicode errors mean malformed server bytes
+                # mid-parse: the stream position is unknown, so reusing
+                # the connection would read leftover packets as the next
+                # query's response — poison it like a transport error.
                 self._broken = True
                 try:
                     self._sock.close()
                 except OSError:
                     pass
+                if not isinstance(e, (OSError, MySQLProtocolError)):
+                    raise MySQLProtocolError(
+                        f"malformed server response ({e!r})") from e
                 raise
 
     def _query_locked(self, sql, params):

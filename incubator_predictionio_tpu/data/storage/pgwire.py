@@ -43,6 +43,29 @@ class PGProtocolError(RuntimeError):
     pass
 
 
+def _bytea_unescape(text: str) -> bytes:
+    """PostgreSQL bytea 'escape' output → bytes: ``\\\\`` is a literal
+    backslash, ``\\NNN`` an octal byte, everything else latin-1."""
+    out = bytearray()
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c != "\\":
+            out.append(ord(c))
+            i += 1
+        elif text[i + 1:i + 2] == "\\":
+            out.append(0x5C)
+            i += 2
+        else:
+            octal = text[i + 1:i + 4]
+            if len(octal) != 3 or not all(ch in "01234567" for ch in octal):
+                raise PGProtocolError(
+                    f"malformed bytea escape sequence {text[i:i + 4]!r}")
+            out.append(int(octal, 8))
+            i += 4
+    return bytes(out)
+
+
 def _md5_password(user: str, password: str, salt: bytes) -> str:
     inner = hashlib.md5(password.encode() + user.encode()).hexdigest()
     return "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
@@ -276,9 +299,15 @@ class PGConnection:
                         # the text — a TEXT value may legitimately start
                         # with "\\x"
                         if (j < len(type_oids)
-                                and type_oids[j] == BYTEA_OID
-                                and text.startswith("\\x")):
-                            row.append(bytes.fromhex(text[2:]))
+                                and type_oids[j] == BYTEA_OID):
+                            if text.startswith("\\x"):
+                                row.append(bytes.fromhex(text[2:]))
+                            else:
+                                # bytea_output='escape' server (the SET
+                                # at startup was ignored — old server or
+                                # pooler): decode the escape format
+                                # instead of silently returning text
+                                row.append(_bytea_unescape(text))
                         else:
                             row.append(text)
                 rows.append(row)

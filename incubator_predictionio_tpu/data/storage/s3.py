@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import hashlib
+import re
 import hmac
 import urllib.error
 import urllib.parse
@@ -106,6 +107,12 @@ def sign_v4(
     }
 
 
+def _xml_error_code(body: bytes) -> str:
+    """<Code> of an S3 error document ('' when absent/unparseable)."""
+    m = re.search(rb"<Code>([^<]+)</Code>", body)
+    return m.group(1).decode(errors="replace") if m else ""
+
+
 class S3StorageError(RuntimeError):
     pass
 
@@ -142,7 +149,19 @@ class _S3Transport:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
-            return e.code, e.read()
+            body = e.read()
+            if e.code == 403:
+                # SigV4 requests embed the client clock (x-amz-date);
+                # skew beyond the server's window 403s every request —
+                # surface the actionable cause instead of a bare 403.
+                code = _xml_error_code(body)
+                if code == "RequestTimeTooSkewed":
+                    raise S3StorageError(
+                        "S3 rejected the request time (RequestTimeTooSkewed)"
+                        " — this host's clock disagrees with the S3 "
+                        "endpoint's by more than the allowed window; sync "
+                        f"the clock (NTP). Server said: {body[:300]!r}")
+            return e.code, body
         except urllib.error.URLError as e:
             raise S3StorageError(
                 f"S3 endpoint unreachable: {self.endpoint} ({e.reason})"

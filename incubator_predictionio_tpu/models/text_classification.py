@@ -39,10 +39,14 @@ class TrainingData(SanityCheck):
 
 @dataclasses.dataclass
 class PreparedData:
-    features: np.ndarray  # [N, D] tf-idf
+    features: np.ndarray  # [N, D] tf-idf (or raw tf, see flag)
     labels: np.ndarray
     label_values: np.ndarray
     vectorizer: TfIdfVectorizer
+    #: features hold RAW term frequencies; the fitted idf column scale
+    #: is applied inside the trainer (commutes with NB's stats
+    #: reduction — skips materializing the scaled [N,D] matrix)
+    features_are_tf: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,8 +118,9 @@ class TextPreparator:
         vec = TfIdfVectorizer(
             n_features=self.params.n_features, ngram=self.params.ngram
         )
-        features = vec.fit_transform(td.texts)
-        return PreparedData(features, td.labels, td.label_values, vec)
+        tf = vec.fit_tf(td.texts)
+        return PreparedData(tf, td.labels, td.label_values, vec,
+                            features_are_tf=True)
 
 
 @dataclasses.dataclass
@@ -152,6 +157,7 @@ class TextNBAlgorithm(Algorithm):
             pd.features, pd.labels, len(pd.label_values),
             smoothing=self.params.smoothing,
             mesh=ctx.get_mesh() if ctx else None,
+            col_scale=(pd.vectorizer.idf if pd.features_are_tf else None),
         )
         return TextModel(inner, pd.vectorizer, pd.label_values)
 
@@ -162,8 +168,13 @@ class TextNBAlgorithm(Algorithm):
 
 class TextLRAlgorithm(TextNBAlgorithm):
     def train(self, ctx, pd: PreparedData) -> TextModel:
+        features = pd.features
+        if pd.features_are_tf:
+            # LR is nonlinear in x — the idf scale can't fold into the
+            # stats like NB's; one explicit scaled materialization
+            features = features * pd.vectorizer.idf
         inner = train_logistic_regression(
-            pd.features, pd.labels, len(pd.label_values),
+            features, pd.labels, len(pd.label_values),
             reg=self.params.reg, max_iters=self.params.max_iters,
             mesh=ctx.get_mesh() if ctx else None,
         )

@@ -90,12 +90,22 @@ class TfIdfVectorizer:
             x[row] = np.bincount(idxs, minlength=D)
         return x
 
-    def fit_transform(self, docs: Sequence[str]) -> np.ndarray:
+    def fit_tf(self, docs: Sequence[str]) -> np.ndarray:
+        """Fit the IDF and return the RAW term-frequency matrix without
+        materializing the scaled one. For linear trainers the column
+        scale commutes with the row reduction (onehotᵀ@(tf·idf) =
+        (onehotᵀ@tf)·idf), so the [N,D] multiply+alloc — the dominant
+        host cost at corpus scale — can fold into the [C,D] stats
+        instead (models/text_classification.TextNBAlgorithm)."""
         tf = self.term_frequencies(docs)
-        df = (tf > 0).sum(axis=0)
+        df = np.count_nonzero(tf, axis=0)
         n = len(docs)
         # MLlib IDF: log((n+1)/(df+1))
         self.idf = np.log((n + 1.0) / (df + 1.0)).astype(np.float32)
+        return tf
+
+    def fit_transform(self, docs: Sequence[str]) -> np.ndarray:
+        tf = self.fit_tf(docs)
         return tf * self.idf
 
     def transform(self, docs: Sequence[str]) -> np.ndarray:

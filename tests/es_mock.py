@@ -25,7 +25,7 @@ class _Index:
         self.seq = 0
 
 
-def build_es_app():
+def build_es_app(mode="default"):
     indices: dict[str, _Index] = {}
 
     def es_json(status, payload):
@@ -157,6 +157,14 @@ def build_es_app():
                 i += 1
             else:
                 return es_json(400, {"error": "unsupported bulk action"})
+        if mode == "bulk_partial_failure" and items:
+            # real ES: HTTP 200, errors=true, per-item error objects —
+            # some actions succeeded, some were rejected (queue full)
+            items[-1] = {"index": {
+                "_id": "whatever", "status": 429,
+                "error": {"type": "es_rejected_execution_exception",
+                          "reason": "rejected execution (queue capacity)"}}}
+            return es_json(200, {"errors": True, "items": items})
         return es_json(200, {"errors": False, "items": items})
 
     async def handle_search(request):
@@ -197,8 +205,18 @@ def build_es_app():
             hits = out
         else:
             hits = hits[:size]
+        shards = {"total": 3, "successful": 3, "skipped": 0, "failed": 0}
+        if mode == "shard_failure":
+            # 200 with a failed shard: hits are silently PARTIAL
+            shards = {"total": 3, "successful": 2, "skipped": 0,
+                      "failed": 1,
+                      "failures": [{"shard": 1, "index": "x",
+                                    "reason": {"type": "node_disconnected"}}]}
+            hits = hits[: max(len(hits) - 1, 0)]
         return es_json(200, {"hits": {"hits": hits,
-                                      "total": {"value": len(hits)}}})
+                                      "total": {"value": len(hits)}},
+                             "_shards": shards,
+                             "timed_out": mode == "search_timeout"})
 
     app = web.Application()
     app.add_routes([

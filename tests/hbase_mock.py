@@ -25,6 +25,32 @@ def _unb64(s: str) -> bytes:
     return base64.b64decode(s)
 
 
+def _eval_filter(spec: dict, cells: dict[str, bytes]) -> bool:
+    """Evaluate a Stargate filter spec against one row's cells — the
+    server-side half of the backend's filter pushdown."""
+    ftype = spec.get("type")
+    if ftype == "FilterList":
+        results = [_eval_filter(f, cells) for f in spec.get("filters", [])]
+        if spec.get("op") == "MUST_PASS_ONE":
+            return any(results)
+        return all(results)
+    if ftype == "SingleColumnValueFilter":
+        col = (_unb64(spec["family"]).decode() + ":"
+               + _unb64(spec["qualifier"]).decode())
+        value = cells.get(col)
+        if value is None:
+            # filterIfMissing: drop rows lacking the column when true
+            return not spec.get("ifMissing", False)
+        want = _unb64(spec["comparator"]["value"])
+        op = spec.get("op", "EQUAL")
+        if op == "EQUAL":
+            return value == want
+        if op == "NOT_EQUAL":
+            return value != want
+        raise ValueError(f"unsupported filter op {op}")
+    raise ValueError(f"unsupported filter type {ftype}")
+
+
 def build_hbase_app():
     tables: dict[str, dict[bytes, dict[str, bytes]]] = {}
     scanners: dict[str, dict] = {}
@@ -84,8 +110,14 @@ def build_hbase_app():
         end = _unb64(body.get("endRow", "")) if body.get("endRow") else None
         keys = sorted(k for k in tables[table]
                       if k >= start and (end is None or k < end))
+        filt = None
+        if body.get("filter"):
+            import json as _json
+
+            filt = _json.loads(body["filter"])  # string-serialized spec
         scanners[sid] = {"table": table, "keys": keys, "pos": 0,
-                         "batch": int(body.get("batch", 100))}
+                         "batch": int(body.get("batch", 100)),
+                         "filter": filt}
         return web.Response(
             status=201,
             headers={"Location": f"http://{request.host}/scanner/{sid}"})
@@ -102,11 +134,15 @@ def build_hbase_app():
             cells = t.get(key)
             if cells is None:  # deleted since the scanner opened
                 continue
+            if s["filter"] is not None and not _eval_filter(
+                    s["filter"], cells):
+                continue
             out.append({
                 "key": _b64(key),
                 "Cell": [{"column": _b64(col.encode()), "timestamp": 1,
                           "$": _b64(v)} for col, v in cells.items()],
             })
+        request.app["rows_served"] += len(out)
         if not out:
             return web.Response(status=204)
         return web.json_response({"Row": out})
@@ -127,4 +163,5 @@ def build_hbase_app():
         web.delete("/{table}/{row}", row_delete),
     ])
     app["tables"] = tables
+    app["rows_served"] = 0  # scanner rows that crossed the "wire"
     return app

@@ -1,6 +1,12 @@
 """In-process WebHDFS gateway for contract tests: implements the
 NameNode side of CREATE (with the real 307-redirect-to-DataNode dance),
-OPEN, and DELETE over an in-memory filesystem."""
+OPEN, and DELETE over an in-memory filesystem.
+
+Adversarial modes: ``"no_redirect"`` answers the CREATE NameNode leg
+directly like an HttpFS-style direct-write gateway (no 307 — the client
+must notice its payload never travelled and re-send it);
+``"redirect_no_location"`` emits a broken 307 without a Location header
+(the client must raise a typed error, not crash)."""
 
 from __future__ import annotations
 
@@ -9,7 +15,7 @@ import urllib.parse
 from aiohttp import web
 
 
-def build_hdfs_app():
+def build_hdfs_app(mode="default"):
     files: dict[str, bytes] = {}
 
     async def handle(request: web.Request) -> web.Response:
@@ -20,6 +26,22 @@ def build_hdfs_app():
             return web.json_response({}, status=404)
         op = (request.query.get("op") or "").upper()
         if request.method == "PUT" and op == "CREATE":
+            if mode == "redirect_no_location" and "datanode" not in request.query:
+                return web.Response(status=307)
+            if mode == "no_redirect":
+                # HttpFS-style direct write: whatever body THIS leg
+                # carries is the file (the two-step client's first leg
+                # is empty — it must re-send with ?data=true). Like real
+                # HttpFS, a data-bearing request must declare
+                # application/octet-stream or be rejected.
+                body = await request.read()
+                if body and request.content_type != "application/octet-stream":
+                    return web.json_response(
+                        {"RemoteException": {"message":
+                         "Data upload requests must have content-type "
+                         "set to 'application/octet-stream'"}}, status=400)
+                files[path] = body
+                return web.Response(status=201)
             if "datanode" not in request.query:
                 # NameNode leg: must be body-free; redirect to the
                 # "DataNode" (same server). raw_path keeps the as-sent

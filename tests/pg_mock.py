@@ -28,6 +28,20 @@ def _cstr(s: str) -> bytes:
     return s.encode() + b"\x00"
 
 
+def _bytea_escape(v: bytes) -> str:
+    """bytea_output='escape' encoding (legacy servers): printable ASCII
+    verbatim, backslash doubled, everything else \\NNN octal."""
+    out = []
+    for b in v:
+        if b == 0x5C:
+            out.append("\\\\")
+        elif 0x20 <= b <= 0x7E:
+            out.append(chr(b))
+        else:
+            out.append("\\%03o" % b)
+    return "".join(out)
+
+
 class _Db:
     def __init__(self):
         self.conn = sqlite3.connect(":memory:", check_same_thread=False)
@@ -68,8 +82,16 @@ class _Handler(socketserver.BaseRequestHandler):
 
     # -- SCRAM server side -------------------------------------------------
     def _scram(self, password: str) -> bool:
-        self._send(b"R", struct.pack("!I", 10) + _cstr("SCRAM-SHA-256")
-                   + b"\x00")
+        if self.server.pg_mode == "scram_plus":
+            # TLS-terminating servers advertise the channel-binding
+            # mechanism first; a non-TLS client must still pick plain
+            # SCRAM-SHA-256
+            self._send(b"R", struct.pack("!I", 10)
+                       + _cstr("SCRAM-SHA-256-PLUS")
+                       + _cstr("SCRAM-SHA-256") + b"\x00")
+        else:
+            self._send(b"R", struct.pack("!I", 10) + _cstr("SCRAM-SHA-256")
+                       + b"\x00")
         t, payload = self._recv_message()
         if t != b"p":
             return False
@@ -186,6 +208,14 @@ class _Handler(socketserver.BaseRequestHandler):
             elif t == b"D":
                 continue  # description is sent with the result set
             elif t == b"E":
+                noisy = self.server.pg_mode == "noisy"
+                if noisy:
+                    # asynchronous messages are legal at ANY point in
+                    # the conversation; clients must skip them
+                    self._send(b"N", b"S" + _cstr("NOTICE") + b"C"
+                               + _cstr("00000") + b"M"
+                               + _cstr("vacuuming in progress") + b"\x00")
+                    self._send(b"S", _cstr("application_name") + _cstr("x"))
                 try:
                     cols, rows = self.server.db.execute(stmt_sql, bound_params)
                 except sqlite3.IntegrityError as e:
@@ -209,14 +239,22 @@ class _Handler(socketserver.BaseRequestHandler):
                                  + struct.pack("!IHIHIH", 0, 0, oid, -1
                                                & 0xFFFF, 0, 0))
                     self._send(b"T", desc)
-                for row in rows:
+                for i, row in enumerate(rows):
+                    if noisy and i == 1:
+                        # mid-result-set notice: must not corrupt rows
+                        self._send(b"N", b"S" + _cstr("NOTICE") + b"C"
+                                   + _cstr("00000") + b"M"
+                                   + _cstr("between rows") + b"\x00")
                     body = struct.pack("!H", len(row))
                     for v in row:
                         if v is None:
                             body += struct.pack("!i", -1)
                         else:
                             if isinstance(v, bytes):
-                                text = "\\x" + v.hex()
+                                if self.server.pg_mode == "bytea_escape":
+                                    text = _bytea_escape(v)
+                                else:
+                                    text = "\\x" + v.hex()
                             elif isinstance(v, float):
                                 text = repr(v)
                             else:
@@ -236,9 +274,10 @@ class MockPGServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, user="pio", password="piosecret"):
+    def __init__(self, user="pio", password="piosecret", mode="default"):
         self.pg_user = user
         self.pg_password = password
+        self.pg_mode = mode
         self.db = _Db()
         super().__init__(("127.0.0.1", 0), _Handler)
         self._thread = threading.Thread(target=self.serve_forever,

@@ -24,7 +24,8 @@ def _hm(key: bytes, msg: str) -> bytes:
     return hmac.new(key, msg.encode(), hashlib.sha256).digest()
 
 
-def build_s3_app(access_key: str, secret_key: str, region: str = "us-east-1"):
+def build_s3_app(access_key: str, secret_key: str, region: str = "us-east-1",
+                 mode: str = "default"):
     objects: dict[str, bytes] = {}
 
     def verify(request: web.Request, payload: bytes) -> str | None:
@@ -80,6 +81,10 @@ def build_s3_app(access_key: str, secret_key: str, region: str = "us-east-1"):
 
     async def handle(request: web.Request) -> web.Response:
         payload = await request.read()
+        if mode == "clock_skew":
+            # AWS rejects x-amz-date outside its 15-minute window with
+            # 403 RequestTimeTooSkewed (NOT an auth failure)
+            return xml_error("RequestTimeTooSkewed", 403)
         err = verify(request, payload)
         if err:
             return xml_error("SignatureDoesNotMatch", 403)

@@ -164,6 +164,22 @@ def test_broken_connection_poisons():
             c.query("SELECT 1")
 
 
+def test_malformed_server_bytes_poison_connection(monkeypatch):
+    """Garbage mid-parse (struct/bounds errors) means the stream
+    position is unknown: the connection must poison itself with a typed
+    error, not stay 'healthy' and serve leftover packets later."""
+    with MockMySQLServer() as srv:
+        c = _connect(srv)
+        real = c._recv_packet
+        # a 2-byte "resultset header" whose lenenc int claims 8 bytes
+        monkeypatch.setattr(c, "_recv_packet", lambda: b"\xfe\x01")
+        with pytest.raises(MySQLProtocolError, match="malformed"):
+            c.query("SELECT 1")
+        monkeypatch.setattr(c, "_recv_packet", real)
+        with pytest.raises(MySQLProtocolError, match="broken"):
+            c.query("SELECT 1")
+
+
 def test_dollar_translation():
     sql, params = _dollar_to_qmark(
         "SELECT * FROM t WHERE a=$2 AND b=$1 AND ev IN ('$set','$unset')",

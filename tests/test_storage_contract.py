@@ -579,6 +579,80 @@ def _fuzz_aggregate_identity(le):
             assert g[k].last_updated == c[k].last_updated, k
 
 
+def test_hbase_filter_pushdown_only_transfers_matches(tmp_path):
+    """Filtered finds must evaluate server-side (Stargate filter spec):
+    only matching rows cross the wire — the reference's HBEventsUtil
+    filter-list behavior — while results stay identical to the generic
+    client-side semantics (event_matches backstop)."""
+    from hbase_mock import build_hbase_app
+    from server_utils import ServerThread
+
+    from incubator_predictionio_tpu.data.storage.base import (
+        StorageClientConfig,
+    )
+    from incubator_predictionio_tpu.data.storage.hbase import HBaseClient
+
+    app = build_hbase_app()
+    with ServerThread(app) as srv:
+        le = HBaseClient(StorageClientConfig(properties={
+            "HOSTS": "127.0.0.1", "PORTS": str(srv.port)})).l_events()
+        evs = []
+        for k in range(60):
+            evs.append(Event("view", "user", str(k % 7), "item",
+                             str(k % 5), DataMap(), _ts(k)))
+        for k in range(8):
+            evs.append(Event("$set", "item", f"i{k}",
+                             properties=DataMap({"a": k}),
+                             event_time=_ts(100 + k)))
+        le.insert_batch(evs, 77)
+
+        app["rows_served"] = 0
+        got = list(le.find(77, entity_type="item", event_names=["$set"]))
+        assert len(got) == 8
+        assert app["rows_served"] == 8  # 60 view rows never crossed
+
+        app["rows_served"] = 0
+        got = list(le.find(77, target_entity_id="3", event_names=["view"]))
+        assert {e.target_entity_id for e in got} == {"3"}
+        assert app["rows_served"] == len(got) == 12
+
+        # multi-name OR + entity filter compose server-side
+        app["rows_served"] = 0
+        got = list(le.find(77, entity_type="user", entity_id="2",
+                           event_names=["view", "buy"]))
+        assert app["rows_served"] == len(got) > 0
+
+        # empty event_names: no scanner is even opened
+        app["rows_served"] = 0
+        assert list(le.find(77, event_names=[])) == []
+        assert app["rows_served"] == 0
+
+        # aggregate rides the same pushdown (only $set/$unset/$delete)
+        app["rows_served"] = 0
+        props = le.aggregate_properties(77, "item")
+        assert set(props) == {f"i{k}" for k in range(8)}
+        assert app["rows_served"] == 8
+
+        # Rows written BEFORE the filterable cells existed (json-only
+        # format) must stay visible to filtered finds: ifMissing=False
+        # passes them server-side for the client backstop to judge —
+        # not silently drop them (review finding).
+        import base64 as _b64mod
+        import json as _json
+
+        legacy = Event("$set", "item", "legacy0",
+                       properties=DataMap({"a": 99}),
+                       event_time=_ts(300), event_id="legacyev")
+        key = le._data_key(le._time_us(legacy.event_time), 1)
+        tbl = le._table(77, None)
+        app["tables"][tbl][key] = {
+            "e:json": _json.dumps(legacy.to_json()).encode()}
+        got = list(le.find(77, entity_type="item", event_names=["$set"]))
+        assert "legacy0" in {e.entity_id for e in got}
+        props = le.aggregate_properties(77, "item")
+        assert props["legacy0"]["a"] == 99
+
+
 def test_empty_event_names_matches_nothing(storage):
     """event_names=[] must match nothing on every backend (review fix)."""
     le = storage.get_l_events()

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 measurement sweep (run when the TPU tunnel is healthy).
+# Supersedes r3_measure.sh: the pending r3 numbers PLUS the CPU/TPU
+# crossover sweeps (classification, text) and the on-chip serving
+# decomposition. Writes per-step logs under /tmp/r4m and prints a summary.
+set -u
+cd "$(dirname "$0")/.."
+OUT=/tmp/r4m; mkdir -p $OUT
+
+probe() {
+  timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+if ! probe; then echo "TUNNEL STILL WEDGED"; exit 2; fi
+echo "tunnel ok"
+
+run() { # name, timeout, cmd...
+  local name=$1 to=$2; shift 2
+  echo "=== $name"
+  timeout "$to" "$@" >$OUT/$name.log 2>&1
+  echo "rc=$? ($name)"; tail -2 $OUT/$name.log
+}
+
+# r3 pending: ALS headline + ladder A/B + rank128 + config 3-5 refresh
+run bench_rank32 580 python bench.py
+run bench_rank32_ladder105 580 env PIO_ALS_LADDER_GROWTH=1.05 python bench.py
+run bench_rank128 580 env PIO_BENCH_RANK=128 python bench.py
+run tmpl_similar 580 env PIO_BENCH_TEMPLATES=similar_product python bench_templates.py
+run tmpl_text 580 env PIO_BENCH_TEMPLATES=text python bench_templates.py
+run tmpl_ur 580 env PIO_BENCH_TEMPLATES=ur python bench_templates.py
+
+# r4: crossover sweeps, both platforms (same host → honest comparison)
+run sweep_cls_tpu 1200 env PIO_BENCH_SWEEP=classification python bench_templates.py
+run sweep_cls_cpu 1200 env PIO_BENCH_SWEEP=classification PIO_BENCH_FORCE_CPU=1 python bench_templates.py
+run sweep_text_tpu 1800 env PIO_BENCH_SWEEP=text python bench_templates.py
+run sweep_text_cpu 1800 env PIO_BENCH_SWEEP=text PIO_BENCH_FORCE_CPU=1 python bench_templates.py
+
+# r4: serving decomposition on the real chip (on-chip slope + QPS)
+run qbench_tpu 900 env PIO_QBENCH_QPS=50,200 python bench_query.py
+
+echo "=== summary"
+grep -h '"metric"' $OUT/*.log 2>/dev/null
