@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 10
+_EXPECTED_VERSION = 11
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -121,6 +121,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int32,                   # n_features
         ctypes.c_int32,                   # ngram
         ctypes.POINTER(ctypes.c_float),   # out [n_docs, n_features]
+        ctypes.POINTER(ctypes.c_int64),   # df [n_features] or NULL
     ]
     return lib
 
@@ -375,11 +376,15 @@ def fill_entries(row: np.ndarray, col: np.ndarray, val, col_slot_map,
             f"fill_entries: {_FILL_ERRORS.get(rc, f'error {rc}')}")
 
 
-def tfidf_tf(docs, n_features: int, ngram: int) -> np.ndarray:
+def tfidf_tf(docs, n_features: int, ngram: int,
+             want_df: bool = False):
     """Native term-frequency rows (see pio_tfidf_tf in event_codec.cc).
 
     Bit-identical to ops/tfidf.TfIdfVectorizer's Python token loop.
-    Raises NativeUnavailable when no toolchain.
+    ``want_df=True`` returns ``(tf, df)`` with the per-bucket document
+    frequency accumulated during the same pass (the IDF fit then needs
+    no second sweep over the [N,D] matrix). Raises NativeUnavailable
+    when no toolchain.
     """
     lib = _load()
     # errors="replace": lone surrogates (legal in Python str, e.g. out
@@ -392,14 +397,17 @@ def tfidf_tf(docs, n_features: int, ngram: int) -> np.ndarray:
     np.cumsum([len(e) for e in enc], out=offs[1:])
     buf = b"".join(enc)
     out = np.zeros((len(enc), n_features), np.float32)
+    df = np.zeros(n_features, np.int64) if want_df else None
     rc = lib.pio_tfidf_tf(
         buf, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(enc), n_features, ngram,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        (df.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+         if df is not None else None),
     )
     if rc != 0:
         raise ValueError(f"tfidf_tf: native tokenizer error {rc}")
-    return out
+    return (out, df) if want_df else out
 
 
 def _scan_object_bytes(rec: bytes, start: int) -> int:

@@ -57,7 +57,11 @@ class TfIdfVectorizer:
         return state
 
     def term_frequencies(self, docs: Sequence[str],
-                         use_native: bool | None = None) -> np.ndarray:
+                         use_native: bool | None = None,
+                         want_df: bool = False):
+        """[N,D] counts; with ``want_df`` returns ``(tf, df)`` where df
+        is the per-bucket document frequency (== count_nonzero(tf, 0),
+        accumulated in the same native pass when available)."""
         D = self.n_features
         # Batch path: the C++ tokenizer+hasher (native.tfidf_tf) is
         # bit-identical to the loop below and ~20x faster; single-doc
@@ -66,7 +70,7 @@ class TfIdfVectorizer:
         if use_native is True or (use_native is None and len(docs) > 4):
             try:
                 from ..native import NativeUnavailable, tfidf_tf
-                return tfidf_tf(docs, D, self.ngram)
+                return tfidf_tf(docs, D, self.ngram, want_df=want_df)
             except NativeUnavailable:
                 if use_native is True:
                     raise
@@ -88,6 +92,8 @@ class TfIdfVectorizer:
                         cache[tok] = h
                 idxs[j] = h
             x[row] = np.bincount(idxs, minlength=D)
+        if want_df:
+            return x, np.count_nonzero(x, axis=0).astype(np.int64)
         return x
 
     def fit_tf(self, docs: Sequence[str]) -> np.ndarray:
@@ -97,8 +103,7 @@ class TfIdfVectorizer:
         (onehotᵀ@tf)·idf), so the [N,D] multiply+alloc — the dominant
         host cost at corpus scale — can fold into the [C,D] stats
         instead (models/text_classification.TextNBAlgorithm)."""
-        tf = self.term_frequencies(docs)
-        df = np.count_nonzero(tf, axis=0)
+        tf, df = self.term_frequencies(docs, want_df=True)
         n = len(docs)
         # MLlib IDF: log((n+1)/(df+1))
         self.idf = np.log((n + 1.0) / (df + 1.0)).astype(np.float32)

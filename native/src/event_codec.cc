@@ -547,7 +547,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 10; }
+int32_t pio_codec_version() { return 11; }
 
 namespace {
 // FNV-1a over a byte range, continuing from a running state.
@@ -570,9 +570,14 @@ inline bool is_token_byte(unsigned char c) {
 // each token (and each " "-joined n-gram up to `ngram`) into n_features
 // buckets, accumulate counts into the caller-zeroed [n_docs, n_features]
 // row-major float32 matrix. Bit-identical to the Python fallback in
-// ops/tfidf.py. Returns 0, or -1 on invalid offsets.
+// ops/tfidf.py. `df` (optional, caller-zeroed [n_features] int64)
+// accumulates document frequency — the count of docs whose row touched
+// each bucket — for free during the fill, so the IDF fit needs no
+// second full pass over the [N,D] matrix. Returns 0, or -1 on invalid
+// offsets.
 int32_t pio_tfidf_tf(const char* buf, const int64_t* offs, int64_t n_docs,
-                     int32_t n_features, int32_t ngram, float* out) {
+                     int32_t n_features, int32_t ngram, float* out,
+                     int64_t* df) {
   if (n_features <= 0 || ngram < 1) return -1;
   std::vector<char> low;        // lowercased doc bytes
   std::vector<int64_t> tok_s;   // token start in `low`
@@ -606,7 +611,9 @@ int32_t pio_tfidf_tf(const char* buf, const int64_t* offs, int64_t n_docs,
     const int64_t nt = static_cast<int64_t>(tok_s.size());
     for (int64_t j = 0; j < nt; ++j) {
       uint32_t h = fnv1a(kFnvInit, low.data() + tok_s[j], tok_e[j] - tok_s[j]);
-      row[mask ? (h & mask) : (h % nf)] += 1.0f;
+      const uint32_t idx = mask ? (h & mask) : (h % nf);
+      if (df != nullptr && row[idx] == 0.0f) df[idx]++;
+      row[idx] += 1.0f;
     }
     for (int32_t n = 2; n <= ngram; ++n) {
       for (int64_t j = 0; j + n <= nt; ++j) {
@@ -615,7 +622,9 @@ int32_t pio_tfidf_tf(const char* buf, const int64_t* offs, int64_t n_docs,
           if (q) h = (h ^ static_cast<uint32_t>(' ')) * 16777619u;
           h = fnv1a(h, low.data() + tok_s[j + q], tok_e[j + q] - tok_s[j + q]);
         }
-        row[mask ? (h & mask) : (h % nf)] += 1.0f;
+        const uint32_t idx = mask ? (h & mask) : (h % nf);
+        if (df != nullptr && row[idx] == 0.0f) df[idx]++;
+        row[idx] += 1.0f;
       }
     }
   }

@@ -88,6 +88,19 @@ def test_tfidf_native_matches_python():
             ref = v.term_frequencies(docs, use_native=False)
             nat = v.term_frequencies(docs, use_native=True)
             assert np.array_equal(ref, nat), (ngram, n_features)
+            # df accumulated by the native first-touch counter must
+            # equal count_nonzero — incl. in-doc hash collisions and
+            # unigram/n-gram same-bucket hits (tiny n_features forces
+            # plenty of both)
+            nat2, df = v.term_frequencies(docs, use_native=True,
+                                          want_df=True)
+            assert np.array_equal(nat2, ref)
+            assert np.array_equal(df, np.count_nonzero(ref, axis=0)), \
+                (ngram, n_features)
+    v = TfIdfVectorizer(n_features=16, ngram=3)  # collision-heavy
+    ref = v.term_frequencies(docs, use_native=False)
+    _, df = v.term_frequencies(docs, use_native=True, want_df=True)
+    assert np.array_equal(df, np.count_nonzero(ref, axis=0))
 
 
 def test_native_matches_oracle():
