@@ -49,10 +49,13 @@ class NaiveBayesModel:
 
 @functools.partial(jax.jit, static_argnames=("n_classes",))
 def _nb_stats(x, y, w, n_classes: int):
-    onehot = jax.nn.one_hot(y, n_classes, dtype=x.dtype) * w[:, None]
+    # x may arrive bfloat16 (lossless narrow upload, see
+    # train_naive_bayes); the one-hot matches its dtype so the einsum
+    # feeds the MXU natively, accumulating in float32 either way.
+    onehot = jax.nn.one_hot(y, n_classes, dtype=x.dtype) * w[:, None].astype(x.dtype)
     feat = jnp.einsum("nc,nd->cd", onehot, x,
                       preferred_element_type=jnp.float32)  # [C, D]
-    counts = onehot.sum(axis=0)  # [C]
+    counts = onehot.astype(jnp.float32).sum(axis=0)  # [C]
     return feat, counts
 
 
@@ -75,6 +78,17 @@ def train_naive_bayes(
     n_dev = int(np.prod(list(mesh.shape.values())))
     x = np.asarray(x, np.float32)
     y = np.asarray(y, np.int32)
+    # Halve the host->device bytes when it costs nothing: attribute
+    # matrices are typically small counts/ratings that round-trip
+    # bfloat16 exactly. Only on an accelerator (there is no transfer to
+    # shrink on the CPU backend, just cast overhead — same gate as
+    # als.py's compute_dtype "auto"), and only when every value is
+    # exactly representable; the stats einsum accumulates in float32
+    # regardless.
+    if mesh.devices.flat[0].platform == "tpu":
+        xb = x.astype(jnp.bfloat16)
+        if np.array_equal(xb.astype(np.float32), x):
+            x = xb
     w = np.ones(x.shape[0], np.float32)
     xp, yp, wp = pad_rows(x, n_dev), pad_rows(y, n_dev), pad_rows(w, n_dev)
     shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
