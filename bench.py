@@ -236,32 +236,9 @@ def main() -> int:
     iters = int(os.environ.get("PIO_BENCH_ITERS", "10"))
     n_users, n_items, nnz = SCALES[scale]
 
-    if os.environ.get("PIO_BENCH_FORCE_CPU") == "1":
-        import jax
+    from bench_common import ensure_platform_or_exit
 
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        # Remote-PJRT tunnels can wedge so hard that jax.devices() hangs
-        # forever (observed after a SIGTERM'd client); probe device init
-        # in a killable subprocess so a dead tunnel is a clean fast
-        # failure instead of an indefinite hang of the calling harness.
-        import subprocess
-        import sys as _sys
-
-        try:
-            subprocess.run(
-                [_sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=int(os.environ.get("PIO_BENCH_PROBE_TIMEOUT", "300")),
-                check=True, capture_output=True)
-        except Exception as e:  # noqa: BLE001 - any probe failure is fatal
-            detail = ""
-            stderr = getattr(e, "stderr", None)
-            if stderr:
-                detail = " — probe stderr: " + stderr.decode(
-                    errors="replace")[-2000:]
-            log(f"[bench] device platform probe failed ({e!r}){detail}; "
-                "accelerator unreachable — aborting instead of hanging")
-            return 3
+    ensure_platform_or_exit()
 
     import jax
 

@@ -1,0 +1,66 @@
+"""Shared bench bootstrap: accelerator liveness guard + CPU forcing.
+
+The r3 evidence chain died because a wedged remote-PJRT tunnel makes any
+cold ``jax.devices()`` hang forever; bench.py grew a killable-subprocess
+probe, but the template/query benches could still hang a caller that
+skipped the probe. Every bench entry point now calls
+``ensure_platform_or_exit()`` first:
+
+- ``PIO_BENCH_FORCE_CPU=1`` pins the CPU platform (the config.update
+  call is the only switch the sandbox's backend-init hook respects) and
+  returns immediately — harness smoke tests never touch the tunnel.
+- Otherwise the default backend is probed in a subprocess with its own
+  session (group-killed on timeout so plugin-spawned pipe holders can't
+  block the parent — the same hardening as __graft_entry__). A dead
+  tunnel is a clean ``SystemExit(3)`` instead of an indefinite hang.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def ensure_platform_or_exit() -> None:
+    if os.environ.get("PIO_BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return
+    timeout = float(os.environ.get("PIO_BENCH_PROBE_TIMEOUT", "300"))
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            start_new_session=True)
+    except Exception as e:  # noqa: BLE001
+        log(f"[bench] could not spawn device probe ({e!r})")
+        raise SystemExit(3)
+    try:
+        _, err = proc.communicate(timeout=timeout)
+        if proc.returncode == 0:
+            return
+        detail = err.decode(errors="replace")[-2000:] if err else ""
+        log(f"[bench] device platform probe failed (rc={proc.returncode})"
+            f" — {detail}; accelerator unreachable — aborting instead of"
+            " hanging")
+    except Exception:  # noqa: BLE001 - timeout → group kill
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except Exception:  # noqa: BLE001
+            proc.kill()
+        try:
+            proc.communicate(timeout=10)
+        except Exception:  # noqa: BLE001
+            if proc.stderr is not None:
+                proc.stderr.close()
+        log("[bench] device platform probe timed out; accelerator "
+            "unreachable (wedged tunnel) — aborting instead of hanging")
+    raise SystemExit(3)

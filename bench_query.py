@@ -97,10 +97,9 @@ def main() -> int:
     rank = int(os.environ.get("PIO_QBENCH_RANK", "32"))
     n_users = int(os.environ.get("PIO_QBENCH_USERS", "3000"))
     n_q = int(os.environ.get("PIO_QBENCH_N", "200"))
-    if os.environ.get("PIO_BENCH_FORCE_CPU") == "1":
-        import jax
+    from bench_common import ensure_platform_or_exit
 
-        jax.config.update("jax_platforms", "cpu")
+    ensure_platform_or_exit()
     import jax
 
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))

@@ -266,10 +266,9 @@ def run_sweep(which: str) -> dict:
 
 
 def main() -> int:
-    if os.environ.get("PIO_BENCH_FORCE_CPU") == "1":
-        import jax
+    from bench_common import ensure_platform_or_exit
 
-        jax.config.update("jax_platforms", "cpu")
+    ensure_platform_or_exit()
     # storage for WorkflowContext.get_storage() (UR keeps a handle)
     os.environ.setdefault("PIO_STORAGE_REPOSITORIES_METADATA_NAME", "pio_meta")
     os.environ.setdefault("PIO_STORAGE_REPOSITORIES_METADATA_SOURCE", "MEM")

@@ -34,24 +34,38 @@ def summarize(doc: dict) -> str:
                          f"(cpu={'yes' if cpu else 'no'}, "
                          f"accel={'yes' if acc else 'no'})")
             continue
+        shared = [p for p in cpu if p in acc]
+        if not shared:
+            lines.append(f"## {sweep}: CPU and {acc_name} sweeps share no "
+                         "ladder points — re-run with matching "
+                         "PIO_BENCH_SWEEP_POINTS")
+            lines.append("")
+            continue
         lines.append(f"## {sweep} (events-or-docs/sec/chip)")
         lines.append(f"| scale | CPU | {acc_name.upper()} | speedup |")
         lines.append("|---|---|---|---|")
-        crossover = None
-        for point in cpu:
-            if point not in acc:
-                continue
+        ratios = []
+        for point in shared:
             c, a = cpu[point], acc[point]
             ratio = a / c if c else float("inf")
+            ratios.append((point, ratio))
             lines.append(f"| {point} | {c:,.0f} | {a:,.0f} | {ratio:.2f}x |")
-            if crossover is None and ratio >= 1.0:
+        # "wins from X upward" must be SUSTAINED: the earliest point
+        # after which every later ladder point also wins — a single
+        # early >1.0 followed by a dip is not a crossover.
+        crossover = None
+        for i, (point, _r) in enumerate(ratios):
+            if all(r > 1.0 for _, r in ratios[i:]):
                 crossover = point
+                break
         if crossover is not None:
             lines.append(f"**Crossover: {acc_name.upper()} wins from "
-                         f"{crossover} upward at these shapes.**")
+                         f"{crossover} through the end of the measured "
+                         "ladder.**")
         else:
-            lines.append(f"**No crossover in the measured ladder: CPU wins "
-                         f"every point (publish this honestly).**")
+            lines.append("**No sustained crossover in the measured ladder: "
+                         "CPU wins at (or ties) the largest measured "
+                         "points (publish this honestly).**")
         lines.append("")
     return "\n".join(lines)
 
