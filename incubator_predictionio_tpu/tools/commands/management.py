@@ -87,13 +87,81 @@ def _resolve_app_id(s: Storage, appid: int | None, app_name: str | None) -> int:
     raise SystemExit("Provide --appid or --app-name.")
 
 
-@verb("export", "export an app's events to JSONL")
+#: Columnar schema for parquet export: scalar event fields as columns,
+#: the schemaless properties map as a JSON-text column (the reference's
+#: Spark export produced a sparse struct per distinct key set; a JSON
+#: column is the stable schemaless equivalent), times as UTC strings in
+#: the wire format so a parquet round trip is bit-identical to JSONL.
+_PARQUET_FIELDS = ("eventId", "event", "entityType", "entityId",
+                   "targetEntityType", "targetEntityId", "properties",
+                   "eventTime", "tags", "prId", "creationTime")
+
+
+def _events_to_parquet(events, output: str) -> int:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    schema = pa.schema([(name, pa.string()) for name in _PARQUET_FIELDS])
+    n = 0
+    writer = pq.ParquetWriter(output, schema)
+    try:
+        cols: dict[str, list] = {k: [] for k in _PARQUET_FIELDS}
+        for e in events:
+            doc = e.to_json()
+            for k in _PARQUET_FIELDS:
+                v = doc.get(k)
+                if k == "properties":
+                    v = json.dumps(v or {})
+                elif k == "tags":
+                    v = json.dumps(v) if v else None
+                cols[k].append(v)
+            n += 1
+            if n % 50_000 == 0:
+                writer.write_table(pa.table(cols, schema=schema))
+                cols = {k: [] for k in _PARQUET_FIELDS}
+        if cols["event"]:
+            writer.write_table(pa.table(cols, schema=schema))
+    finally:
+        writer.close()
+    return n
+
+
+def _parquet_rows(path: str):
+    """Raw string-typed rows; per-row decoding happens at the import
+    loop's per-record try so one bad cell is warn+skip, not an abort."""
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    for batch in pf.iter_batches():
+        yield from batch.to_pylist()
+
+
+def _parquet_row_to_doc(row: dict) -> dict:
+    doc = {k: v for k, v in row.items()
+           if v is not None and k not in ("properties", "tags")}
+    doc["properties"] = json.loads(row.get("properties") or "{}")
+    if row.get("tags"):
+        doc["tags"] = json.loads(row["tags"])
+    return doc
+
+
+def _detect_format(path: str, flag: str) -> str:
+    if flag != "auto":
+        return flag
+    return "parquet" if path.endswith(".parquet") else "jsonl"
+
+
+@verb("export", "export an app's events to JSONL or Parquet")
 def export_cmd(args: list[str]) -> int:
     p = argparse.ArgumentParser(prog="pio export")
     p.add_argument("--appid", type=int, default=None)
     p.add_argument("--app-name", default=None)
     p.add_argument("--channel", default=None)
     p.add_argument("--output", required=True)
+    p.add_argument("--format", choices=["auto", "jsonl", "parquet"],
+                   default="auto",
+                   help="auto = by extension (.parquet); reference parity: "
+                        "EventsToFile wrote json or parquet")
     ns = p.parse_args(args)
     s = Storage.instance()
     app_id = _resolve_app_id(s, ns.appid, ns.app_name)
@@ -105,22 +173,35 @@ def export_cmd(args: list[str]) -> int:
             print(f"Channel {ns.channel!r} not found.", file=sys.stderr)
             return 1
         channel_id = chans[0].id
-    n = 0
-    with open(ns.output, "w") as f:
-        for e in s.get_p_events().find(app_id, channel_id):
-            f.write(json.dumps(e.to_json()) + "\n")
-            n += 1
-    print(f"[info] Exported {n} events to {ns.output}")
+    fmt = _detect_format(ns.output, ns.format)
+    events = s.get_p_events().find(app_id, channel_id)
+    if fmt == "parquet":
+        try:
+            import pyarrow  # noqa: F401
+        except ImportError:
+            print("[error] parquet export needs pyarrow installed",
+                  file=sys.stderr)
+            return 1
+        n = _events_to_parquet(events, ns.output)
+    else:
+        n = 0
+        with open(ns.output, "w") as f:
+            for e in events:
+                f.write(json.dumps(e.to_json()) + "\n")
+                n += 1
+    print(f"[info] Exported {n} events to {ns.output} ({fmt})")
     return 0
 
 
-@verb("import", "import events from JSONL into an app")
+@verb("import", "import events from JSONL or Parquet into an app")
 def import_cmd(args: list[str]) -> int:
     p = argparse.ArgumentParser(prog="pio import")
     p.add_argument("--appid", type=int, default=None)
     p.add_argument("--app-name", default=None)
     p.add_argument("--channel", default=None)
     p.add_argument("--input", required=True)
+    p.add_argument("--format", choices=["auto", "jsonl", "parquet"],
+                   default="auto")
     ns = p.parse_args(args)
     s = Storage.instance()
     app_id = _resolve_app_id(s, ns.appid, ns.app_name)
@@ -132,26 +213,46 @@ def import_cmd(args: list[str]) -> int:
             print(f"Channel {ns.channel!r} not found.", file=sys.stderr)
             return 1
         channel_id = chans[0].id
+    fmt = _detect_format(ns.input, ns.format)
+    if fmt == "parquet":
+        try:
+            import pyarrow  # noqa: F401
+        except ImportError:
+            print("[error] parquet import needs pyarrow installed",
+                  file=sys.stderr)
+            return 1
     le = s.get_l_events()
     le.init(app_id, channel_id)
+
+    def records():
+        """(record_no, raw) pairs; raw decoding happens inside the
+        per-record try below so one malformed record is a warn+skip,
+        not an aborted import."""
+        if fmt == "parquet":
+            yield from enumerate(_parquet_rows(ns.input), 1)
+            return
+        with open(ns.input) as f:
+            for line_no, line in enumerate(f, 1):
+                line = line.strip()
+                if line:
+                    yield line_no, line
+
     # Streamed in batches: buffering the whole file as Event objects
     # would need ~10 GB of heap at ML-20M scale.
     batch, imported, skipped = [], 0, 0
-    with open(ns.input) as f:
-        for line_no, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                batch.append(Event.from_json(json.loads(line)))
-            except Exception as e:  # noqa: BLE001 - report and continue
-                skipped += 1
-                print(f"[warn] line {line_no}: {e}", file=sys.stderr)
-                continue
-            if len(batch) >= 20_000:
-                le.insert_batch(batch, app_id, channel_id)
-                imported += len(batch)
-                batch = []
+    for rec_no, raw in records():
+        try:
+            doc = (_parquet_row_to_doc(raw) if fmt == "parquet"
+                   else json.loads(raw))
+            batch.append(Event.from_json(doc))
+        except Exception as e:  # noqa: BLE001 - report and continue
+            skipped += 1
+            print(f"[warn] record {rec_no}: {e}", file=sys.stderr)
+            continue
+        if len(batch) >= 20_000:
+            le.insert_batch(batch, app_id, channel_id)
+            imported += len(batch)
+            batch = []
     if batch:
         le.insert_batch(batch, app_id, channel_id)
         imported += len(batch)

@@ -162,3 +162,42 @@ def test_mesh_shape_env_parses():
             _mesh_shape_from_env()
     finally:
         os.environ.pop("PIO_MESH_SHAPE", None)
+
+
+def test_parquet_export_import_roundtrip(cli_env, tmp_path):
+    """`pio export --format parquet` → `pio import` must reproduce the
+    event stream exactly (ids, times, properties, tie order) —
+    reference parity: EventsToFile wrote json or parquet."""
+    run_pio(["app", "new", "PqApp"], cli_env)
+    events_file = tmp_path / "events.jsonl"
+    n = _write_events_file(events_file, seed=3)
+    # tags + prId must survive the parquet round trip (review finding)
+    with open(events_file, "a") as f:
+        f.write(json.dumps({
+            "event": "rate", "entityType": "user", "entityId": "tagged",
+            "targetEntityType": "item", "targetEntityId": "i0",
+            "properties": {"rating": 5}, "tags": ["a", "b"],
+            "prId": "pr-77", "eventTime": "2024-02-01T00:00:00.000Z",
+        }) + "\n")
+    n += 1
+    run_pio(["import", "--app-name", "PqApp", "--input",
+             str(events_file)], cli_env)
+
+    pq_file = tmp_path / "events.parquet"
+    r = run_pio(["export", "--app-name", "PqApp", "--output",
+                 str(pq_file)], cli_env)  # format auto-detected
+    assert f"Exported {n} events" in r.stdout and "(parquet)" in r.stdout
+
+    run_pio(["app", "new", "PqApp2"], cli_env)
+    r = run_pio(["import", "--app-name", "PqApp2", "--input",
+                 str(pq_file)], cli_env)
+    assert f"Imported {n} events" in r.stdout
+
+    back = tmp_path / "back.jsonl"
+    run_pio(["export", "--app-name", "PqApp2", "--output",
+             str(back), "--format", "jsonl"], cli_env)
+    run_pio(["export", "--app-name", "PqApp", "--output",
+             str(tmp_path / "orig.jsonl"), "--format", "jsonl"], cli_env)
+    a = [json.loads(x) for x in open(tmp_path / "orig.jsonl")]
+    b = [json.loads(x) for x in open(back)]
+    assert a == b
