@@ -1,0 +1,31 @@
+#!/bin/bash
+# TPU recovery watcher: probe every ~9 min; on a healthy tunnel run the
+# r4 measurement sweep and commit the captured numbers so they survive
+# the session. Log: /tmp/r4_watch.log
+cd "$(dirname "$0")/.."
+for i in $(seq 1 55); do
+  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) tunnel HEALTHY after probe $i — running r4_measure"
+    bash tools/r4_measure.sh
+    rc=$?
+    echo "$(date +%H:%M:%S) r4_measure done rc=$rc"
+    if [ $rc -eq 0 ]; then
+      { echo "# r4_measure sweep summary ($(date -u +%FT%TZ))"
+        echo "# per-config metric lines; full logs were under /tmp/r4m"
+        grep -h '"metric"' /tmp/r4m/*.log 2>/dev/null
+      } > MEASURE_r4_summary.txt
+      git add BASELINE.json MEASURE_r4_summary.txt
+      git commit -m "Record TPU measurements from the tools/r4_measure.sh sweep
+
+Automated capture on tunnel recovery: ALS rank-32/rank-128 + ladder A/B,
+configs 3-5 refreshed post host-path optimizations, CPU/TPU crossover
+sweeps, and the serving on-chip decomposition. Summary lines in
+MEASURE_r4_summary.txt; BASELINE.json measured entries updated by the
+bench harnesses themselves." || true
+    fi
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) watch probe $i: still wedged"
+  sleep 540
+done
+echo "gave up after 55 probes"
