@@ -237,8 +237,8 @@ class PGConnection:
                     pass
                 raise
 
-    def _query_locked(self, sql, params):
-        # Parse (unnamed statement), Bind (unnamed portal), Execute, Sync
+    def _send_parse_bind(self, sql, params) -> None:
+        """Parse (unnamed statement) + Bind (unnamed portal) + Describe."""
         self._send(b"P", self._cstr("") + self._cstr(sql)
                    + struct.pack("!H", 0))
         bind = self._cstr("") + self._cstr("")
@@ -259,6 +259,53 @@ class PGConnection:
         bind += struct.pack("!H", 0)  # all results in text format
         self._send(b"B", bind)
         self._send(b"D", b"P" + self._cstr(""))  # Describe portal
+
+    @staticmethod
+    def _parse_rowdesc(payload) -> tuple[list[str], list[int]]:
+        (n,) = struct.unpack("!H", payload[:2])
+        off = 2
+        columns: list[str] = []
+        type_oids: list[int] = []
+        for _ in range(n):
+            end = payload.index(b"\x00", off)
+            columns.append(payload[off:end].decode())
+            # fixed metadata: tableOID(4) attnum(2) typeOID(4)
+            # typlen(2) typmod(4) fmt(2)
+            (type_oid,) = struct.unpack("!I", payload[end + 7:end + 11])
+            type_oids.append(type_oid)
+            off = end + 1 + 18
+        return columns, type_oids
+
+    @staticmethod
+    def _decode_datarow(payload, type_oids) -> list:
+        BYTEA_OID = 17
+        (n,) = struct.unpack("!H", payload[:2])
+        off = 2
+        row = []
+        for j in range(n):
+            (ln,) = struct.unpack("!i", payload[off:off + 4])
+            off += 4
+            if ln == -1:
+                row.append(None)
+                continue
+            text = payload[off:off + ln].decode()
+            off += ln
+            # decode by declared column type, NOT by sniffing the text —
+            # a TEXT value may legitimately start with "\\x"
+            if j < len(type_oids) and type_oids[j] == BYTEA_OID:
+                if text.startswith("\\x"):
+                    row.append(bytes.fromhex(text[2:]))
+                else:
+                    # bytea_output='escape' server (the SET at startup
+                    # was ignored — old server or pooler): decode the
+                    # escape format instead of silently returning text
+                    row.append(_bytea_unescape(text))
+            else:
+                row.append(text)
+        return row
+
+    def _query_locked(self, sql, params):
+        self._send_parse_bind(sql, params)
         self._send(b"E", self._cstr("") + struct.pack("!i", 0))
         self._send(b"S", b"")
 
@@ -266,51 +313,14 @@ class PGConnection:
         type_oids: list[int] = []
         rows: list[list] = []
         error: Optional[PGError] = None
-        BYTEA_OID = 17
         while True:
             mtype, payload = self._recv_message()
             if mtype == b"E":
                 error = self._parse_error(payload)
             elif mtype == b"T":  # RowDescription
-                (n,) = struct.unpack("!H", payload[:2])
-                off = 2
-                for _ in range(n):
-                    end = payload.index(b"\x00", off)
-                    columns.append(payload[off:end].decode())
-                    # fixed metadata: tableOID(4) attnum(2) typeOID(4)
-                    # typlen(2) typmod(4) fmt(2)
-                    (type_oid,) = struct.unpack(
-                        "!I", payload[end + 7:end + 11])
-                    type_oids.append(type_oid)
-                    off = end + 1 + 18
+                columns, type_oids = self._parse_rowdesc(payload)
             elif mtype == b"D":  # DataRow
-                (n,) = struct.unpack("!H", payload[:2])
-                off = 2
-                row = []
-                for j in range(n):
-                    (ln,) = struct.unpack("!i", payload[off:off + 4])
-                    off += 4
-                    if ln == -1:
-                        row.append(None)
-                    else:
-                        text = payload[off:off + ln].decode()
-                        off += ln
-                        # decode by declared column type, NOT by sniffing
-                        # the text — a TEXT value may legitimately start
-                        # with "\\x"
-                        if (j < len(type_oids)
-                                and type_oids[j] == BYTEA_OID):
-                            if text.startswith("\\x"):
-                                row.append(bytes.fromhex(text[2:]))
-                            else:
-                                # bytea_output='escape' server (the SET
-                                # at startup was ignored — old server or
-                                # pooler): decode the escape format
-                                # instead of silently returning text
-                                row.append(_bytea_unescape(text))
-                        else:
-                            row.append(text)
-                rows.append(row)
+                rows.append(self._decode_datarow(payload, type_oids))
             elif mtype == b"Z":  # ReadyForQuery — the transaction boundary
                 if error is not None:
                     raise error
@@ -323,6 +333,124 @@ class PGConnection:
                 continue
             else:
                 raise PGProtocolError(f"unexpected message {mtype!r}")
+
+    def query_stream(self, sql: str, params: Sequence = (),
+                     fetch_size: int = 5000):
+        """Stream a result set in fetch_size chunks via portal suspension.
+
+        ``query()`` materializes every row — fine for DAO lookups, fatal
+        for the 20M-event "store of record" training feed. This issues
+        Execute with a row limit + Flush (NOT Sync: Sync would close the
+        unnamed portal), buffers ONE chunk, yields its rows, and on
+        PortalSuspended Executes again for the next chunk.
+
+        Locking: the connection lock is held only WHILE A CHUNK IS READ,
+        never across a yield (a lock held across yields could only be
+        released by the owning thread — a GC-finalized generator would
+        wedge the connection forever). Between chunks the wire is quiet,
+        so an interleaved ``query()`` on the same connection is
+        protocol-safe — but its Sync destroys the suspended portal, and
+        the NEXT chunk fetch then raises a clear PGError (34000 "portal
+        does not exist"): don't interleave queries with an unfinished
+        stream; finish or ``close()`` the iterator first.
+
+        Early generator close cleans up (Sync + drain to ReadyForQuery)
+        so the connection stays usable.
+        """
+        self._begin_stream(sql, params)
+        dirty = True  # an un-synced portal conversation is open
+        error: Optional[PGError] = None
+        try:
+            while True:
+                rows, suspended, err = self._fetch_chunk(fetch_size)
+                if err is not None:
+                    error = err
+                    break
+                yield from rows
+                if not suspended:
+                    break
+        finally:
+            # exhausted, errored, or the caller broke early: close the
+            # implicit transaction and drain to ReadyForQuery. Cleanup
+            # failures must not mask the in-flight exception — they
+            # poison the connection instead.
+            if dirty:
+                try:
+                    err = self._end_stream()
+                    error = error or err
+                except Exception:  # noqa: BLE001 - poison, don't mask
+                    self._broken = True
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+        if error is not None:
+            raise error
+
+    def _begin_stream(self, sql, params) -> None:
+        with self._lock:
+            if self._broken:
+                raise PGProtocolError(
+                    "connection is broken by an earlier transport error — "
+                    "create a new PGConnection")
+            try:
+                self._send_parse_bind(sql, params)
+            except OSError:
+                self._broken = True
+                raise
+        self._stream_oids: list[int] = []
+
+    def _fetch_chunk(self, fetch_size):
+        """(rows, suspended, error) for one Execute+Flush round trip;
+        lock held for the duration — the wire is quiet on return."""
+        with self._lock:
+            if self._broken:
+                raise PGProtocolError("connection is broken")
+            try:
+                self._send(b"E", self._cstr("")
+                           + struct.pack("!i", max(int(fetch_size), 1)))
+                self._send(b"H", b"")  # Flush — keep the portal open
+                rows: list = []
+                while True:
+                    mtype, payload = self._recv_message()
+                    if mtype == b"E":
+                        # server skips to Sync after an error
+                        return rows, False, self._parse_error(payload)
+                    if mtype == b"T":
+                        _, self._stream_oids = self._parse_rowdesc(payload)
+                    elif mtype == b"D":
+                        rows.append(
+                            self._decode_datarow(payload, self._stream_oids))
+                    elif mtype == b"s":  # PortalSuspended — more rows
+                        return rows, True, None
+                    elif mtype in (b"C", b"I"):  # complete / empty
+                        return rows, False, None
+                    elif mtype in (b"1", b"2", b"n", b"N", b"S", b"K",
+                                   b"t"):
+                        continue
+                    else:
+                        raise PGProtocolError(
+                            f"unexpected message {mtype!r} in stream")
+            except (OSError, PGProtocolError):
+                self._broken = True
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise
+
+    def _end_stream(self) -> Optional[PGError]:
+        with self._lock:
+            if self._broken:
+                return None
+            self._send(b"S", b"")
+            error: Optional[PGError] = None
+            while True:
+                mtype, payload = self._recv_message()
+                if mtype == b"E":
+                    error = error or self._parse_error(payload)
+                elif mtype == b"Z":
+                    return error
 
     def close(self) -> None:
         try:

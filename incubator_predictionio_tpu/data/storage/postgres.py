@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import os
+import warnings
 from typing import Iterable, Iterator, Optional, Sequence
 
 from . import base
@@ -35,6 +37,19 @@ from .event import (Event, MonotoneNs,
                     event_time_us as _time_us, new_event_id)
 from .pgwire import PGConnection, PGError
 from .sqlite import _safe_ident
+
+
+def _stream_fetch_size() -> int:
+    """PIO_PG_FETCH_SIZE (rows per portal chunk of the streaming
+    training feed), parsed once; malformed values warn and fall back."""
+    raw = os.environ.get("PIO_PG_FETCH_SIZE", "5000")
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        warnings.warn(
+            f"PIO_PG_FETCH_SIZE={raw!r} is not an integer; using 5000",
+            stacklevel=2)
+        return 5000
 
 
 def _from_us(us) -> Optional[_dt.datetime]:
@@ -202,7 +217,15 @@ class PGLEvents(base.LEvents):
         target_entity_id: Optional[str] = None,
         limit: Optional[int] = None,
         reversed_order: bool = False,
+        stream: bool = False,
     ) -> Iterator[Event]:
+        """``stream=True`` pages rows through a suspended portal
+        (pgwire.query_stream) instead of materializing the result —
+        the event-store-of-record training feed at 20M events. The
+        connection lock is held across the whole iteration, so a
+        streaming caller must NOT issue other queries on this client
+        mid-iteration (the portal would be destroyed); PEvents.find is
+        the intended streaming caller."""
         where = ["appid=$1", "channelid=$2"]
         params: list = [app_id, self._chan(channel_id)]
 
@@ -233,6 +256,10 @@ class PGLEvents(base.LEvents):
                + f" ORDER BY eventtimeus {order}, seq ASC")
         if limit is not None and limit >= 0:
             sql += f" LIMIT {arg(int(limit))}"
+        if stream and hasattr(self._c, "query_stream"):
+            return (Event.from_json(json.loads(r[0]))
+                    for r in self._c.query_stream(
+                        sql, params, fetch_size=_stream_fetch_size()))
         _, rows = self._c.query(sql, params)
         return (Event.from_json(json.loads(r[0])) for r in rows)
 
@@ -308,9 +335,12 @@ class PGPEvents(base.PEvents):
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
              target_entity_type=None, target_entity_id=None) -> Iterator[Event]:
+        # bulk read API feeding training: stream through a suspended
+        # portal — 20M events must not materialize as one Python list
         return self._l.find(
             app_id, channel_id, start_time, until_time, entity_type,
             entity_id, event_names, target_entity_type, target_entity_id,
+            stream=True,
         )
 
     def write(self, events: Iterable[Event], app_id: int,

@@ -175,6 +175,9 @@ class _Handler(socketserver.BaseRequestHandler):
 
         stmt_sql = ""
         bound_params: list = []
+        # portal state for Execute-with-row-limit (PortalSuspended):
+        # results cached on first Execute, served in chunks
+        portal = {"cols": None, "rows": None, "pos": 0, "described": False}
         while True:
             t, payload = self._recv_message()
             if t == b"X":
@@ -204,10 +207,17 @@ class _Handler(socketserver.BaseRequestHandler):
                             bound_params.append(bytes.fromhex(text[2:]))
                         else:
                             bound_params.append(text)
+                portal = {"cols": None, "rows": None, "pos": 0,
+                          "described": False}
                 self._send(b"2", b"")
             elif t == b"D":
                 continue  # description is sent with the result set
+            elif t == b"H":
+                continue  # Flush: this mock always writes immediately
             elif t == b"E":
+                self.server.execute_msgs += 1
+                off = payload.index(b"\x00") + 1  # portal name
+                (max_rows,) = struct.unpack("!i", payload[off:off + 4])
                 noisy = self.server.pg_mode == "noisy"
                 if noisy:
                     # asynchronous messages are legal at ANY point in
@@ -216,15 +226,27 @@ class _Handler(socketserver.BaseRequestHandler):
                                + _cstr("00000") + b"M"
                                + _cstr("vacuuming in progress") + b"\x00")
                     self._send(b"S", _cstr("application_name") + _cstr("x"))
-                try:
-                    cols, rows = self.server.db.execute(stmt_sql, bound_params)
-                except sqlite3.IntegrityError as e:
-                    self._error("23505", str(e))
-                    continue
-                except sqlite3.Error as e:
-                    self._error("XX000", str(e))
-                    continue
-                if cols:
+                if portal["rows"] is None:
+                    try:
+                        cols, rows = self.server.db.execute(
+                            stmt_sql, bound_params)
+                    except sqlite3.IntegrityError as e:
+                        self._error("23505", str(e))
+                        continue
+                    except sqlite3.Error as e:
+                        self._error("XX000", str(e))
+                        continue
+                    portal.update(cols=cols, rows=rows, pos=0,
+                                  described=False)
+                cols = portal["cols"]
+                if max_rows > 0:
+                    rows = portal["rows"][portal["pos"]:
+                                          portal["pos"] + max_rows]
+                else:
+                    rows = portal["rows"][portal["pos"]:]
+                portal["pos"] += len(rows)
+                exhausted = portal["pos"] >= len(portal["rows"])
+                if cols and not portal["described"]:
                     # type OID per column: 17 (bytea) when any value in
                     # the result is bytes, else 25 (text) — the client
                     # decodes \\x hex by OID, like a real server's
@@ -232,13 +254,15 @@ class _Handler(socketserver.BaseRequestHandler):
                     oids = []
                     for j in range(len(cols)):
                         oids.append(17 if any(
-                            isinstance(r[j], bytes) for r in rows) else 25)
+                            isinstance(r[j], bytes)
+                            for r in portal["rows"]) else 25)
                     desc = struct.pack("!H", len(cols))
                     for c, oid in zip(cols, oids):
                         desc += (_cstr(c)
                                  + struct.pack("!IHIHIH", 0, 0, oid, -1
                                                & 0xFFFF, 0, 0))
                     self._send(b"T", desc)
+                    portal["described"] = True
                 for i, row in enumerate(rows):
                     if noisy and i == 1:
                         # mid-result-set notice: must not corrupt rows
@@ -262,8 +286,14 @@ class _Handler(socketserver.BaseRequestHandler):
                             raw = text.encode()
                             body += struct.pack("!i", len(raw)) + raw
                     self._send(b"D", body)
-                self._send(b"C", _cstr("SELECT " + str(len(rows))))
+                if exhausted:
+                    self._send(b"C", _cstr("SELECT "
+                                           + str(portal["pos"])))
+                else:
+                    self._send(b"s", b"")  # PortalSuspended
             elif t == b"S":
+                portal = {"cols": None, "rows": None, "pos": 0,
+                          "described": False}
                 self._ready()
             else:
                 self._error("08P01", f"unsupported message {t!r}")
@@ -278,6 +308,7 @@ class MockPGServer(socketserver.ThreadingTCPServer):
         self.pg_user = user
         self.pg_password = password
         self.pg_mode = mode
+        self.execute_msgs = 0  # Execute messages seen (portal-chunk probe)
         self.db = _Db()
         super().__init__(("127.0.0.1", 0), _Handler)
         self._thread = threading.Thread(target=self.serve_forever,

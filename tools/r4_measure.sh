@@ -14,11 +14,14 @@ probe() {
 if ! probe; then echo "TUNNEL STILL WEDGED"; exit 2; fi
 echo "tunnel ok"
 
+FAILED=0
 run() { # name, timeout, cmd...
   local name=$1 to=$2; shift 2
   echo "=== $name"
   timeout "$to" "$@" >$OUT/$name.log 2>&1
-  echo "rc=$? ($name)"; tail -2 $OUT/$name.log
+  local rc=$?
+  echo "rc=$rc ($name)" | tee $OUT/$name.rc; tail -2 $OUT/$name.log
+  [ $rc -ne 0 ] && FAILED=$((FAILED+1))
 }
 
 # r3 pending: ALS headline + ladder A/B + rank128 + config 3-5 refresh
@@ -38,5 +41,9 @@ run sweep_text_cpu 1800 env PIO_BENCH_SWEEP=text PIO_BENCH_FORCE_CPU=1 python be
 # r4: serving decomposition on the real chip (on-chip slope + QPS)
 run qbench_tpu 900 env PIO_QBENCH_QPS=50,200 python bench_query.py
 
-echo "=== summary"
+echo "=== summary ($FAILED step(s) failed)"
+cat $OUT/*.rc 2>/dev/null
 grep -h '"metric"' $OUT/*.log 2>/dev/null
+# exit 0 only when the sweep is complete; partial sweeps exit 1 so the
+# watcher doesn't record a mostly-failed run as refreshed measurements
+[ $FAILED -eq 0 ]
