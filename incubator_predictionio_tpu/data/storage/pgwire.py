@@ -127,6 +127,11 @@ class PGConnection:
         self._sock.settimeout(timeout)
         self._buf = b""
         self._broken = False
+        # True while a request/response conversation is on the wire.
+        # Guards against a GC-finalized stream generator re-entering
+        # the (reentrant) lock from THIS thread mid-conversation and
+        # injecting a Sync (see _end_stream).
+        self._in_conversation = False
         self.user = user
         self._startup(user, password, database)
 
@@ -305,6 +310,13 @@ class PGConnection:
         return row
 
     def _query_locked(self, sql, params):
+        self._in_conversation = True
+        try:
+            return self._query_conversation(sql, params)
+        finally:
+            self._in_conversation = False
+
+    def _query_conversation(self, sql, params):
         self._send_parse_bind(sql, params)
         self._send(b"E", self._cstr("") + struct.pack("!i", 0))
         self._send(b"S", b"")
@@ -358,7 +370,6 @@ class PGConnection:
         so the connection stays usable.
         """
         self._begin_stream(sql, params)
-        dirty = True  # an un-synced portal conversation is open
         error: Optional[PGError] = None
         try:
             while True:
@@ -374,16 +385,15 @@ class PGConnection:
             # implicit transaction and drain to ReadyForQuery. Cleanup
             # failures must not mask the in-flight exception — they
             # poison the connection instead.
-            if dirty:
+            try:
+                err = self._end_stream()
+                error = error or err
+            except Exception:  # noqa: BLE001 - poison, don't mask
+                self._broken = True
                 try:
-                    err = self._end_stream()
-                    error = error or err
-                except Exception:  # noqa: BLE001 - poison, don't mask
-                    self._broken = True
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
+                    self._sock.close()
+                except OSError:
+                    pass
         if error is not None:
             raise error
 
@@ -407,6 +417,7 @@ class PGConnection:
             if self._broken:
                 raise PGProtocolError("connection is broken")
             try:
+                self._in_conversation = True
                 self._send(b"E", self._cstr("")
                            + struct.pack("!i", max(int(fetch_size), 1)))
                 self._send(b"H", b"")  # Flush — keep the portal open
@@ -438,10 +449,20 @@ class PGConnection:
                 except OSError:
                     pass
                 raise
+            finally:
+                self._in_conversation = False
 
     def _end_stream(self) -> Optional[PGError]:
         with self._lock:
             if self._broken:
+                return None
+            if self._in_conversation:
+                # Reentrant call from a GC-finalized generator while
+                # THIS thread is mid-conversation (reentrant lock):
+                # injecting a Sync now would eat the outer query's
+                # rows. Skip — the chunks were fully read, the wire is
+                # consistent, and the next query's own Sync closes the
+                # leaked portal's transaction.
                 return None
             self._send(b"S", b"")
             error: Optional[PGError] = None

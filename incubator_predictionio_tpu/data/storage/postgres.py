@@ -222,10 +222,11 @@ class PGLEvents(base.LEvents):
         """``stream=True`` pages rows through a suspended portal
         (pgwire.query_stream) instead of materializing the result —
         the event-store-of-record training feed at 20M events. The
-        connection lock is held across the whole iteration, so a
-        streaming caller must NOT issue other queries on this client
-        mid-iteration (the portal would be destroyed); PEvents.find is
-        the intended streaming caller."""
+        lock is held per chunk, NOT across the iteration: an
+        interleaved query on this client proceeds, destroys the
+        suspended portal, and the stream's next chunk raises PGError
+        34000 — finish or close() the iterator before other queries.
+        PEvents.find is the intended streaming caller."""
         where = ["appid=$1", "channelid=$2"]
         params: list = [app_id, self._chan(channel_id)]
 

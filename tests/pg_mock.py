@@ -176,8 +176,12 @@ class _Handler(socketserver.BaseRequestHandler):
         stmt_sql = ""
         bound_params: list = []
         # portal state for Execute-with-row-limit (PortalSuspended):
-        # results cached on first Execute, served in chunks
-        portal = {"cols": None, "rows": None, "pos": 0, "described": False}
+        # results cached on first Execute, served in chunks. "bound"
+        # models portal lifetime: Bind creates it, Sync destroys it
+        # (end of the implicit transaction) — Execute on a destroyed
+        # portal is ERROR 34000, like a real server.
+        portal = {"cols": None, "rows": None, "pos": 0, "described": False,
+                  "bound": False}
         while True:
             t, payload = self._recv_message()
             if t == b"X":
@@ -208,7 +212,7 @@ class _Handler(socketserver.BaseRequestHandler):
                         else:
                             bound_params.append(text)
                 portal = {"cols": None, "rows": None, "pos": 0,
-                          "described": False}
+                          "described": False, "bound": True}
                 self._send(b"2", b"")
             elif t == b"D":
                 continue  # description is sent with the result set
@@ -218,6 +222,10 @@ class _Handler(socketserver.BaseRequestHandler):
                 self.server.execute_msgs += 1
                 off = payload.index(b"\x00") + 1  # portal name
                 (max_rows,) = struct.unpack("!i", payload[off:off + 4])
+                if not portal["bound"]:
+                    self._error("34000",
+                                'portal "" does not exist')
+                    continue
                 noisy = self.server.pg_mode == "noisy"
                 if noisy:
                     # asynchronous messages are legal at ANY point in
@@ -293,7 +301,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     self._send(b"s", b"")  # PortalSuspended
             elif t == b"S":
                 portal = {"cols": None, "rows": None, "pos": 0,
-                          "described": False}
+                          "described": False, "bound": False}
                 self._ready()
             else:
                 self._error("08P01", f"unsupported message {t!r}")

@@ -93,6 +93,36 @@ def test_pg_stream_error_mid_portal_is_clean(monkeypatch):
         c.close()
 
 
+def test_pg_interleaved_query_mid_stream_is_typed_error():
+    """An interleaved query() on the same connection destroys the
+    suspended portal (its Sync ends the implicit transaction); the
+    stream's next chunk must surface PGError 34000 — a clear 'don't do
+    that' — never protocol corruption, and the connection survives."""
+    from pg_mock import MockPGServer
+
+    from incubator_predictionio_tpu.data.storage.pgwire import (
+        PGConnection, PGError,
+    )
+
+    with MockPGServer(user="pio", password="piosecret") as srv:
+        c = PGConnection("127.0.0.1", srv.port, "pio", "piosecret", "pio")
+        c.query("CREATE TABLE big (a BIGINT)")
+        for k in range(30):
+            c.query("INSERT INTO big (a) VALUES ($1)", (k,))
+        it = c.query_stream("SELECT a FROM big ORDER BY a", (),
+                            fetch_size=10)
+        assert [r[0] for r in (next(it), next(it))] == ["0", "1"]
+        # chunk 1 (rows 0-9) is buffered; interleave a query now
+        _, rows = c.query("SELECT COUNT(*) FROM big")
+        assert rows == [["30"]]
+        with pytest.raises(PGError) as ei:
+            list(it)  # needs chunk 2 — portal is gone
+        assert ei.value.sqlstate == "34000"
+        _, rows = c.query("SELECT 1")  # connection still clean
+        assert rows == [["1"]]
+        c.close()
+
+
 def test_es_scan_pages_search_after_at_scale(monkeypatch):
     from es_mock import build_es_app
     from server_utils import ServerThread

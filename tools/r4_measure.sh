@@ -5,7 +5,7 @@
 # decomposition. Writes per-step logs under /tmp/r4m and prints a summary.
 set -u
 cd "$(dirname "$0")/.."
-OUT=/tmp/r4m; mkdir -p $OUT
+OUT=/tmp/r4m; mkdir -p $OUT; rm -f $OUT/*.log $OUT/*.rc
 
 probe() {
   timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1
