@@ -95,6 +95,76 @@ class MySQLLEvents(PGLEvents):
             (app_id, self._chan(channel_id), event_id))
         return self._c.affected_rows > 0
 
+    def find(self, app_id, channel_id=None, start_time=None,
+             until_time=None, entity_type=None, entity_id=None,
+             event_names=None, target_entity_type=None,
+             target_entity_id=None, limit=None, reversed_order=False,
+             stream: bool = False):
+        """``stream=True`` pages via KEYSET pagination — repeated
+        self-contained queries ``WHERE (eventtimeus, seq) > (t, s) …
+        LIMIT page`` riding the (appid, channelid, eventtimeus, seq)
+        index — so the 20M-event training feed never materializes as
+        one list (the PG backend's portal streaming, in the dialect
+        MySQL can do without cursor round-trip state). Each page is an
+        independent query: interleaving other queries is safe here."""
+        if not (stream and limit is None and not reversed_order):
+            return super().find(
+                app_id, channel_id, start_time, until_time, entity_type,
+                entity_id, event_names, target_entity_type,
+                target_entity_id, limit, reversed_order)
+        if event_names is not None and not list(event_names):
+            return iter(())
+        return self._find_keyset(
+            app_id, channel_id, start_time, until_time, entity_type,
+            entity_id, event_names, target_entity_type, target_entity_id)
+
+    def _find_keyset(self, app_id, channel_id, start_time, until_time,
+                     entity_type, entity_id, event_names,
+                     target_entity_type, target_entity_id):
+        import json as _json
+        import os as _os
+
+        from .event import event_time_us as _us
+
+        page = max(int(_os.environ.get("PIO_SQL_PAGE_SIZE", "5000")), 1)
+        cursor = None  # (eventtimeus, seq) of the last yielded row
+        while True:
+            where = ["appid=$1", "channelid=$2"]
+            params: list = [app_id, self._chan(channel_id)]
+
+            def arg(v):
+                params.append(v)
+                return f"${len(params)}"
+
+            if cursor is not None:
+                where.append(f"(eventtimeus, seq) > ({arg(cursor[0])},"
+                             f" {arg(cursor[1])})")
+            if start_time is not None:
+                where.append(f"eventtimeus >= {arg(_us(start_time))}")
+            if until_time is not None:
+                where.append(f"eventtimeus < {arg(_us(until_time))}")
+            if entity_type is not None:
+                where.append(f"entitytype = {arg(entity_type)}")
+            if entity_id is not None:
+                where.append(f"entityid = {arg(entity_id)}")
+            if target_entity_type is not None:
+                where.append(
+                    f"targetentitytype = {arg(target_entity_type)}")
+            if target_entity_id is not None:
+                where.append(f"targetentityid = {arg(target_entity_id)}")
+            if event_names is not None:
+                slots = ",".join(arg(n) for n in event_names)
+                where.append(f"event IN ({slots})")
+            sql = (f"SELECT eventjson, eventtimeus, seq FROM {self._t} "
+                   "WHERE " + " AND ".join(where)
+                   + f" ORDER BY eventtimeus ASC, seq ASC LIMIT {page}")
+            _, rows = self._c.query(sql, params)
+            for r in rows:
+                yield Event.from_json(_json.loads(r[0]))
+            if len(rows) < page:
+                return
+            cursor = (int(rows[-1][1]), int(rows[-1][2]))
+
 
 class MySQLPEvents(PGPEvents):
     pass

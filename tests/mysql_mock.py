@@ -270,6 +270,7 @@ class _Handler(socketserver.BaseRequestHandler):
 
     # -- commands ------------------------------------------------------------
     def _run_sql(self, sql: str, params, binary: bool):
+        self.server.sql_count += 1
         try:
             cols, rows, affected, last_id = self.server.db.execute(
                 sql, params)
@@ -370,6 +371,7 @@ class MockMySQLServer(socketserver.ThreadingTCPServer):
         self.my_user = user
         self.my_password = password
         self.mode = mode
+        self.sql_count = 0  # statements executed (paging probe)
         self.db = _Db()
         super().__init__(("127.0.0.1", 0), _Handler)
         self._thread = threading.Thread(target=self.serve_forever,

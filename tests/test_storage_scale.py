@@ -167,3 +167,35 @@ def test_hbase_scanner_streams_batches_at_scale():
         times = [e.event_time for e in got]
         assert times == sorted(times)
         assert app["rows_served"] == N  # all crossed, in scanner batches
+
+
+def test_mysql_training_feed_pages_by_keyset(monkeypatch):
+    """The MySQL training feed streams via keyset pagination — many
+    self-contained LIMIT queries riding the time index — with the same
+    order/completeness contract as PG's portal streaming."""
+    from mysql_mock import MockMySQLServer
+
+    from incubator_predictionio_tpu.data.storage.mysql import MySQLClient
+
+    monkeypatch.setenv("PIO_SQL_PAGE_SIZE", "100")
+    N = 2500
+    with MockMySQLServer(user="pio", password="piosecret") as srv:
+        client = MySQLClient(StorageClientConfig(properties={
+            "HOST": "127.0.0.1", "PORT": str(srv.port),
+            "USERNAME": "pio", "PASSWORD": "piosecret"}))
+        le = client.l_events()
+        le.insert_batch(_events(N), 1)
+
+        srv.sql_count = 0
+        got = list(client.p_events().find(1))
+        assert len(got) == N
+        times = [e.event_time for e in got]
+        assert times == sorted(times)
+        assert [int(e.properties.require("rating")) for e in got[:5]] == \
+            [1, 2, 3, 4, 5]
+        assert srv.sql_count >= N // 100  # many pages, not one query
+
+        # filters compose with the keyset cursor
+        got = list(client.p_events().find(1, entity_id="5"))
+        assert len(got) == len([k for k in range(N) if k % 97 == 5])
+        client.close()
