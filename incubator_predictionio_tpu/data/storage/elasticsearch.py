@@ -198,6 +198,117 @@ class _ESTransport:
             if after is None or len(hits) < page:
                 return
 
+    # -- sliced parallel scan (PIT) -----------------------------------------
+    def open_pit(self, index: str, keep_alive: str = "2m") -> Optional[str]:
+        """Point-in-time handle for sliced scans; None when the server
+        doesn't support PIT (older ES) — callers fall back to the
+        serial search_after scan. Speaks both flavors: Elasticsearch's
+        ``POST /{index}/_pit`` and OpenSearch's
+        ``POST /{index}/_search/point_in_time`` (the search-body usage
+        is identical; only open/close differ)."""
+        status, out = self.request(
+            "POST", f"/{index}/_pit?keep_alive={keep_alive}")
+        if status == 200 and isinstance(out, dict) and "id" in out:
+            self._pit_flavor = getattr(self, "_pit_flavor", {})
+            self._pit_flavor[out["id"]] = "es"
+            return out["id"]
+        status, out = self.request(
+            "POST",
+            f"/{index}/_search/point_in_time?keep_alive={keep_alive}")
+        if status == 200 and isinstance(out, dict) and "pit_id" in out:
+            self._pit_flavor = getattr(self, "_pit_flavor", {})
+            self._pit_flavor[out["pit_id"]] = "opensearch"
+            return out["pit_id"]
+        return None
+
+    def close_pit(self, pit_id: str) -> None:
+        flavor = getattr(self, "_pit_flavor", {}).pop(pit_id, "es")
+        if flavor == "opensearch":
+            self.request("DELETE", "/_search/point_in_time",
+                         body={"pit_id": [pit_id]})
+        else:
+            self.request("DELETE", "/_pit", body={"id": pit_id})
+
+    def _search_pit(self, pit_id: str, query: dict, sort, size: int,
+                    search_after, slice_id: int, slice_max: int) -> list[dict]:
+        body = {"query": query, "size": size, "sort": sort,
+                "pit": {"id": pit_id, "keep_alive": "2m"},
+                "slice": {"id": slice_id, "max": slice_max}}
+        if search_after is not None:
+            body["search_after"] = search_after
+        status, out = self.request("POST", "/_search", body=body)
+        if status != 200:
+            raise ESStorageError(f"sliced search: HTTP {status} {out}")
+        shards = out.get("_shards") or {}
+        if shards.get("failed") or out.get("timed_out"):
+            raise ESStorageError(
+                f"sliced search: partial results refused ({shards})")
+        return out.get("hits", {}).get("hits", [])
+
+    def search_all_sliced(self, index: str, query: dict, sort,
+                          slices: int) -> Iterator[dict]:
+        """Concurrent sliced scan merged back into global sort order.
+
+        N slices page independently (each slice's NEXT page prefetches
+        in a worker thread while the current one drains, overlapping
+        the per-page round trips that serialize a plain search_after
+        scan — the bottleneck feeding training from a 20M-event
+        index); heapq.merge restores the total (sort-key) order, so
+        the stream is indistinguishable from the serial scan. Falls
+        back to search_all when the server has no PIT support."""
+        import heapq
+        from concurrent.futures import ThreadPoolExecutor
+
+        if slices < 2:
+            yield from self.search_all(index, query, sort)
+            return
+        pit = self.open_pit(index)
+        if pit is None:
+            yield from self.search_all(index, query, sort)
+            return
+        pool = ThreadPoolExecutor(max_workers=slices)
+        try:
+            def fetch(sid, after):
+                return self._search_pit(pit, query, sort, _PAGE, after,
+                                        sid, slices)
+
+            # Eager first wave: every slice's first page is in flight
+            # before anything is consumed (heapq.merge pulls the heads
+            # sequentially during heapify — lazy submission would
+            # serialize the first round trips).
+            firsts = [pool.submit(fetch, s, None) for s in range(slices)]
+            try:
+                first_pages = [f.result() for f in firsts]
+            except ESStorageError:
+                # PIT opened but the sliced search body is rejected
+                # (e.g. ES 7.10/7.11: PIT exists, PIT slicing doesn't).
+                # Nothing has been yielded yet — degrade to serial.
+                yield from self.search_all(index, query, sort)
+                return
+
+            def slice_iter(sid, hits):
+                while True:
+                    if not hits:
+                        return
+                    after = hits[-1].get("sort")
+                    fut = (pool.submit(fetch, sid, after)
+                           if after is not None and len(hits) >= _PAGE
+                           else None)
+                    yield from hits
+                    if fut is None:
+                        return
+                    hits = fut.result()
+
+            yield from heapq.merge(
+                *(slice_iter(s, p) for s, p in enumerate(first_pages)),
+                key=lambda h: tuple(h.get("sort") or ()))
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+            try:
+                self.close_pit(pit)
+            except ESStorageError:
+                pass
+
     def next_sequence(self, index: str, name: str) -> int:
         """The reference's ESSequences: re-indexing the same doc id
         returns a strictly increasing _version."""
@@ -328,6 +439,19 @@ class ESLEvents(base.LEvents):
         limit: Optional[int] = None,
         reversed_order: bool = False,
     ) -> Iterator[Event]:
+        query, sort = self._build_query(
+            start_time, until_time, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id, reversed_order)
+        if limit is not None and limit < 0:
+            limit = None
+        for h in self._t.search_all(self._idx(app_id, channel_id), query,
+                                    sort, limit=limit):
+            yield Event.from_json(h["_source"])
+
+    @staticmethod
+    def _build_query(start_time, until_time, entity_type, entity_id,
+                     event_names, target_entity_type, target_entity_id,
+                     reversed_order) -> tuple[dict, list]:
         filters: list[dict] = []
         if event_names is not None:
             filters.append({"terms": {"event": list(event_names)}})
@@ -352,10 +476,19 @@ class ESLEvents(base.LEvents):
         # matching the stable sorts of the embedded backends
         sort = [{"eventTimeUs": {"order": order}},
                 {"_seq_no": {"order": "asc"}}]
-        if limit is not None and limit < 0:
-            limit = None
-        for h in self._t.search_all(self._idx(app_id, channel_id), query,
-                                    sort, limit=limit):
+        return query, sort
+
+    def find_sliced(self, app_id, channel_id, start_time, until_time,
+                    entity_type, entity_id, event_names,
+                    target_entity_type, target_entity_id,
+                    slices: int) -> Iterator[Event]:
+        """Bulk scan via the PIT sliced-parallel path (global order
+        preserved by the merge) — the training feed."""
+        query, sort = self._build_query(
+            start_time, until_time, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id, reversed_order=False)
+        for h in self._t.search_all_sliced(
+                self._idx(app_id, channel_id), query, sort, slices):
             yield Event.from_json(h["_source"])
 
     def aggregate_properties(self, app_id, entity_type, channel_id=None,
@@ -426,6 +559,25 @@ class ESPEvents(base.PEvents):
     def find(self, app_id, channel_id=None, start_time=None, until_time=None,
              entity_type=None, entity_id=None, event_names=None,
              target_entity_type=None, target_entity_id=None) -> Iterator[Event]:
+        import os
+
+        # bulk read feeding training: sliced-parallel PIT scan overlaps
+        # the page round trips that serialize search_after at
+        # store-of-record scale (PIO_ES_SLICES=1 restores serial)
+        try:
+            slices = max(int(os.environ.get("PIO_ES_SLICES", "4")), 1)
+        except ValueError:
+            slices = 4
+        if event_names is not None:
+            event_names = list(event_names)  # materialize once: the
+            # guard below + _build_query both consume it
+            if not event_names:
+                return iter(())
+        if slices > 1:
+            return self._l.find_sliced(
+                app_id, channel_id, start_time, until_time, entity_type,
+                entity_id, event_names, target_entity_type,
+                target_entity_id, slices)
         return self._l.find(
             app_id, channel_id, start_time, until_time, entity_type,
             entity_id, event_names, target_entity_type, target_entity_id,

@@ -26,6 +26,11 @@ class _Index:
 
 
 def build_es_app(mode="default"):
+    import itertools as _it
+    import zlib as _zlib
+
+    pits: dict[str, str] = {}  # pit id -> index name
+    pit_ids = _it.count(1)
     indices: dict[str, _Index] = {}
 
     def es_json(status, payload):
@@ -167,22 +172,77 @@ def build_es_app(mode="default"):
             return es_json(200, {"errors": True, "items": items})
         return es_json(200, {"errors": False, "items": items})
 
+    async def handle_pit_open(request):
+        if mode == "opensearch":
+            # OpenSearch has no /_pit route
+            return es_json(400, {"error": {"type": "illegal_argument_exception"}})
+        index = request.match_info["index"]
+        if index not in indices:
+            return es_json(404, {"error": {"type": "index_not_found_exception"}})
+        pid = f"pit{next(pit_ids)}:{index}"
+        pits[pid] = index
+        return es_json(200, {"id": pid})
+
+    async def handle_pit_close(request):
+        body = await request.json() if request.can_read_body else {}
+        existed = pits.pop(body.get("id"), None) is not None
+        return es_json(200 if existed else 404, {"succeeded": existed})
+
+    async def handle_os_pit_open(request):
+        """OpenSearch flavor: POST /{index}/_search/point_in_time."""
+        if mode != "opensearch":
+            return es_json(400, {"error": {"type": "illegal_argument_exception"}})
+        index = request.match_info["index"]
+        if index not in indices:
+            return es_json(404, {"error": {"type": "index_not_found_exception"}})
+        pid = f"ospit{next(pit_ids)}:{index}"
+        pits[pid] = index
+        return es_json(200, {"pit_id": pid})
+
+    async def handle_os_pit_close(request):
+        body = await request.json() if request.can_read_body else {}
+        ids = body.get("pit_id") or []
+        existed = any(pits.pop(i, None) is not None for i in ids)
+        return es_json(200 if existed else 404, {"succeeded": existed})
+
+    async def handle_search_pit(request):
+        """POST /_search with a body pit id (no index in the path)."""
+        body = await request.json() if request.can_read_body else {}
+        pid = (body.get("pit") or {}).get("id")
+        index = pits.get(pid)
+        if index is None:
+            return es_json(404, {"error": {"type":
+                                           "search_context_missing_exception"}})
+        if mode == "pit_no_slice" and body.get("slice"):
+            # ES 7.10/7.11: PIT exists but PIT slicing does not
+            return es_json(400, {"error": {
+                "type": "illegal_argument_exception",
+                "reason": "slice is not supported in point-in-time"}})
+        return _do_search(index, body)
+
     async def handle_search(request):
+        body = await request.json() if request.can_read_body else {}
+        return _do_search(request.match_info["index"], body)
+
+    def _do_search(index_name, body):
         import functools
 
-        idx = indices.get(request.match_info["index"])
+        idx = indices.get(index_name)
         if idx is None:
             return es_json(404, {"error": {"type": "index_not_found_exception"}})
-        body = await request.json() if request.can_read_body else {}
         query = body.get("query", {"match_all": {}})
         sort_spec = body.get("sort")
         size = int(body.get("size", 10))
         after = body.get("search_after")
 
+        slice_spec = body.get("slice")
         hits = [
             {"_id": doc_id, "_source": d["_source"], "_seq_no": d["_seq_no"]}
             for doc_id, d in idx.docs.items()
             if match(d["_source"], query)
+            and (slice_spec is None
+                 or _zlib.crc32(doc_id.encode()) % int(slice_spec["max"])
+                 == int(slice_spec["id"]))
         ]
         if sort_spec:
             keyed = [(sort_key(sort_spec, h), h) for h in hits]
@@ -226,7 +286,13 @@ def build_es_app(mode="default"):
         web.get("/{index}/_doc/{id}", handle_doc_get),
         web.delete("/{index}/_doc/{id}", handle_doc_delete),
         web.post("/_bulk", handle_bulk),
+        web.post("/_search", handle_search_pit),
+        web.delete("/_pit", handle_pit_close),
+        web.delete("/_search/point_in_time", handle_os_pit_close),
+        web.post("/{index}/_pit", handle_pit_open),
+        web.post("/{index}/_search/point_in_time", handle_os_pit_open),
         web.post("/{index}/_search", handle_search),
     ])
+    app["pits"] = pits
     app["indices"] = indices
     return app

@@ -199,3 +199,69 @@ def test_mysql_training_feed_pages_by_keyset(monkeypatch):
         got = list(client.p_events().find(1, entity_id="5"))
         assert len(got) == len([k for k in range(N) if k % 97 == 5])
         client.close()
+
+
+def test_es_sliced_parallel_scan_preserves_global_order(monkeypatch):
+    """The PIT sliced scan must return the EXACT stream the serial
+    search_after scan returns — same events, same (time, _seq_no)
+    order — while actually using slices (disjoint PIT slice streams
+    merged back)."""
+    from es_mock import build_es_app
+    from server_utils import ServerThread
+
+    from incubator_predictionio_tpu.data.storage import elasticsearch as es
+    from incubator_predictionio_tpu.data.storage.elasticsearch import (
+        ESClient,
+    )
+
+    monkeypatch.setattr(es, "_PAGE", 100)
+    N = 2500
+    app = build_es_app()
+    with ServerThread(app) as srv:
+        client = ESClient(StorageClientConfig(properties={
+            "HOSTS": "127.0.0.1", "PORTS": str(srv.port)}))
+        le = client.l_events()
+        le.insert_batch(_events(N), 1)
+
+        monkeypatch.setenv("PIO_ES_SLICES", "4")
+        sliced = [e.event_id for e in client.p_events().find(1)]
+        monkeypatch.setenv("PIO_ES_SLICES", "1")
+        serial = [e.event_id for e in client.p_events().find(1)]
+        assert sliced == serial
+        assert len(sliced) == N
+        assert not app["pits"]  # every PIT closed after the scan
+
+        # filters compose with slices
+        monkeypatch.setenv("PIO_ES_SLICES", "4")
+        got = list(client.p_events().find(1, entity_id="5"))
+        assert len(got) == len([k for k in range(N) if k % 97 == 5])
+
+
+@pytest.mark.parametrize("mode,expect_pits", [
+    ("opensearch", True),   # PIT via the OpenSearch route
+    ("pit_no_slice", False),  # PIT opens, sliced search rejected → serial
+])
+def test_es_sliced_scan_degrades_gracefully(monkeypatch, mode, expect_pits):
+    """Servers without the ES PIT route (OpenSearch flavor) or without
+    PIT slicing (ES 7.10/7.11) must still serve the training feed —
+    via the flavor-specific PIT or a clean serial fallback — with the
+    identical stream and no leaked PITs."""
+    from es_mock import build_es_app
+    from server_utils import ServerThread
+
+    from incubator_predictionio_tpu.data.storage import elasticsearch as es
+    from incubator_predictionio_tpu.data.storage.elasticsearch import (
+        ESClient,
+    )
+
+    monkeypatch.setattr(es, "_PAGE", 100)
+    monkeypatch.setenv("PIO_ES_SLICES", "4")
+    N = 600
+    app = build_es_app(mode=mode)
+    with ServerThread(app) as srv:
+        client = ESClient(StorageClientConfig(properties={
+            "HOSTS": "127.0.0.1", "PORTS": str(srv.port)}))
+        client.l_events().insert_batch(_events(N), 1)
+        got = [e.event_id for e in client.p_events().find(1)]
+        assert len(got) == N
+        assert not app["pits"]  # opened PITs (if any) were closed
