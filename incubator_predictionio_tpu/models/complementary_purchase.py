@@ -1,0 +1,188 @@
+"""Complementary Purchase engine — basket-level co-purchase suggestions.
+
+Reference ecosystem parity: the `predictionio-template-complementary-
+purchase` template (PredictionIO template gallery; SURVEY.md §2.8 notes
+the examples/ ecosystem beyond the five headline configs) suggested
+items frequently bought IN THE SAME SHOPPING BASKET as the query items
+— association rules mined from per-user time-windowed "buy" sessions.
+
+TPU-native redesign: baskets (user × time-window sessions) take the
+"user" axis of the striped LLR co-occurrence kernel (ops/llr.py — the
+same MXU path the Universal Recommender uses), so mining runs as dense
+[basket-chunk, items]ᵀ×[basket-chunk, items] einsum stripes with
+LLR-thresholded top-k indicators per item, and serving scores a query
+basket on device (gather+dot + top_k, ops/llr.score_user).
+
+DASE shape:
+- DataSource: "buy" events (entity=user, target=item).
+- Algorithm params: ``basketWindowSecs`` (gap that closes a session,
+  default 3600), ``maxCorrelatorsPerItem``, ``minLLR``.
+- Query: ``{"items": ["i1", ...], "num": 4}`` →
+  ``{"itemScores": [{"item": ..., "score": ...}]}`` with the queried
+  items excluded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..controller import Algorithm, Engine, EngineFactory, Params, SanityCheck
+from ..controller.datasource import DataSource
+from ..data.storage.bimap import BiMap
+from ..ops.llr import Indicators, cco_indicators, score_user
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    user_idx: np.ndarray   # [n] int32
+    item_idx: np.ndarray   # [n] int32
+    time_us: np.ndarray    # [n] int64 event time (µs)
+    users: BiMap
+    items: BiMap
+
+    def sanity_check(self) -> None:
+        assert len(self.user_idx) > 0, "no buy events found"
+        assert len(self.user_idx) == len(self.item_idx) == len(self.time_us)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = ""
+    event_name: str = "buy"
+
+
+class ComplementaryDataSource(DataSource):
+    params_cls = DataSourceParams
+    params_aliases = {"appName": "app_name", "eventName": "event_name"}
+
+    def read_training(self, ctx) -> TrainingData:
+        from ..data.store.p_event_store import PEventStore
+
+        p = self.params
+        batch = PEventStore.find_batch(
+            p.app_name or (ctx.app_name if ctx else ""),
+            event_names=[p.event_name],
+            storage=ctx.get_storage() if ctx else None,
+            channel_name=ctx.channel_name if ctx else None)
+        keep = [j for j, tid in enumerate(batch.target_entity_id)
+                if tid is not None]
+        users = BiMap.string_int(batch.entity_id[j] for j in keep)
+        items = BiMap.string_int(batch.target_entity_id[j] for j in keep)
+        return TrainingData(
+            users.map_array([batch.entity_id[j] for j in keep]
+                            ).astype(np.int32),
+            items.map_array([batch.target_entity_id[j] for j in keep]
+                            ).astype(np.int32),
+            batch.event_time_us[keep], users, items)
+
+
+def form_baskets(user_idx: np.ndarray, time_us: np.ndarray,
+                 window_us: int) -> np.ndarray:
+    """Basket id per event: one basket per (user, purchase session),
+    where a gap > window_us between a user's consecutive buys closes
+    the session — the template's time-window basket semantics,
+    vectorized (sort by (user, time), session breaks where the user
+    changes or the gap exceeds the window, cumsum for dense ids)."""
+    n = len(user_idx)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    order = np.lexsort((time_us, user_idx))
+    su, st = user_idx[order], time_us[order]
+    new_basket = np.ones(n, bool)
+    new_basket[1:] = (su[1:] != su[:-1]) | (st[1:] - st[:-1] > window_us)
+    basket_sorted = np.cumsum(new_basket) - 1
+    baskets = np.empty(n, np.int64)
+    baskets[order] = basket_sorted
+    return baskets
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    basket_window_secs: int = 3600
+    max_correlators: int = 20
+    llr_threshold: float = 0.0
+
+
+@dataclasses.dataclass
+class ComplementaryModel:
+    indicators: Indicators
+    items: BiMap
+
+    def suggest(self, basket_items: Sequence[str], num: int
+                ) -> list[tuple[str, float]]:
+        ids = [self.items.get(x) for x in basket_items]
+        known = [x for x in ids if x is not None]
+        n_items = self.indicators.idx.shape[0]
+        if not known or n_items == 0:
+            return []
+        membership = np.zeros(n_items, np.float32)
+        membership[known] = 1.0
+        exclude = np.zeros(n_items, bool)
+        exclude[known] = True
+        scores, idx = score_user(
+            [(self.indicators, membership, 1.0)],
+            k=min(num + len(known), n_items), exclude=exclude)
+        out = []
+        for s, j in zip(scores, idx):
+            if not np.isfinite(s) or s <= 0:
+                break
+            out.append((self.items.inverse(int(j)), float(s)))
+            if len(out) >= num:
+                break
+        return out
+
+
+class ComplementaryAlgorithm(Algorithm):
+    params_cls = AlgoParams
+    params_aliases = {
+        "basketWindowSecs": "basket_window_secs",
+        "maxCorrelatorsPerItem": "max_correlators",
+        "minLLR": "llr_threshold",
+    }
+
+    def train(self, ctx, td: TrainingData) -> ComplementaryModel:
+        p = self.params
+        baskets = form_baskets(
+            td.user_idx, td.time_us, int(p.basket_window_secs) * 1_000_000)
+        n_baskets = int(baskets.max()) + 1 if len(baskets) else 0
+        ind = cco_indicators(
+            baskets, td.item_idx, baskets, td.item_idx,
+            n_users=max(n_baskets, 1), n_items=len(td.items),
+            max_correlators=p.max_correlators,
+            llr_threshold=p.llr_threshold,
+        )
+        return ComplementaryModel(ind, td.items)
+
+    def predict(self, model: ComplementaryModel, query: dict) -> dict:
+        pairs = model.suggest(
+            [str(x) for x in query.get("items", [])],
+            int(query.get("num", 4)))
+        return {"itemScores": [{"item": i, "score": s} for i, s in pairs]}
+
+    def prepare_model_for_persistence(self, model: ComplementaryModel):
+        return {
+            "idx": model.indicators.idx,
+            "score": model.indicators.score,
+            "items": model.items.to_dict(),
+        }
+
+    def restore_model(self, stored, ctx) -> ComplementaryModel:
+        if isinstance(stored, ComplementaryModel):
+            return stored
+        return ComplementaryModel(
+            Indicators(idx=np.asarray(stored["idx"]),
+                       score=np.asarray(stored["score"])),
+            BiMap(dict(stored["items"])),
+        )
+
+
+class ComplementaryPurchaseEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            data_source_class=ComplementaryDataSource,
+            algorithm_class_map={"cooccurrence": ComplementaryAlgorithm,
+                                 "": ComplementaryAlgorithm},
+        )

@@ -68,32 +68,21 @@ def apply_runtime_passthrough(extra: list[str]) -> None:
         ).strip()
 
 
-def _enable_compilation_cache() -> None:
-    """Persistent XLA compilation cache under $PIO_FS_BASEDIR/xla_cache.
-
-    Every `pio` verb is its own process; without this each train/deploy
-    re-pays the full XLA compile (tens of seconds on TPU) for programs
-    compiled identically last run. The reference had no analog (the JVM
-    kept Spark stages alive in-process); here the cache makes repeated
-    CLI runs warm-start. PIO_COMPILATION_CACHE=0 opts out.
-    """
-    if os.environ.get("PIO_COMPILATION_CACHE", "1") == "0":
-        return
-    try:
-        import jax
-
-        from ..data.storage.registry import base_dir
-
-        cache_dir = os.path.join(base_dir(), "xla_cache")
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # (sub-second compiles are skipped by JAX's default
-        # jax_persistent_cache_min_compile_time_secs=1)
-    except Exception:  # noqa: BLE001 - cache is an optimization only
-        pass
-
-
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        from . import commands
+
+        print(commands.usage())
+        return 0
+    if argv[0] == "version":
+        from incubator_predictionio_tpu import __version__
+
+        print(__version__)
+        return 0
+    # (the persistent XLA compilation cache is enabled lazily by
+    # WorkflowContext — the chokepoint every compiling verb passes —
+    # so metadata-only verbs never import jax for it)
     if os.environ.get("PIO_TEST_FORCE_CPU") == "1":
         # Hermetic CI: run workflows on host CPU devices (the sandbox's
         # PJRT plugin ignores JAX_PLATFORMS — see tests/conftest.py).
@@ -104,19 +93,6 @@ def main(argv=None) -> int:
         except ImportError:
             pass
     from . import commands
-
-    argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] in ("-h", "--help", "help"):
-        print(commands.usage())
-        return 0
-    if argv[0] == "version":
-        from incubator_predictionio_tpu import __version__
-
-        print(__version__)
-        return 0
-    # after the help/version fast paths: `pio --help` must not pay a
-    # jax import or touch the filesystem
-    _enable_compilation_cache()
     verb_args = argv[1:]
     if "--" in verb_args:
         split = verb_args.index("--")

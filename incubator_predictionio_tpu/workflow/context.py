@@ -14,6 +14,41 @@ from typing import Any, Optional
 from ..data.storage.registry import Storage
 from ..workflow.workflow_params import WorkflowParams
 
+_cache_enabled = False
+
+
+def _enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache under $PIO_FS_BASEDIR/xla_cache.
+
+    Every `pio` verb is its own process; without this each train/deploy
+    re-pays the full XLA compile (tens of seconds on TPU) for programs
+    compiled identically last run. Wired here — every compiling verb
+    builds a WorkflowContext, and jax is already imported by then —
+    because this jax version ignores the JAX_COMPILATION_CACHE_DIR env
+    var, so the config call is required and metadata-only verbs should
+    not import jax just to make it. PIO_COMPILATION_CACHE=0 opts out;
+    sub-second compiles are skipped by JAX's default
+    jax_persistent_cache_min_compile_time_secs=1.
+    """
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    _cache_enabled = True
+    import os
+
+    if os.environ.get("PIO_COMPILATION_CACHE", "1") == "0":
+        return
+    try:
+        import jax
+
+        from ..data.storage.registry import base_dir
+
+        cache_dir = os.path.join(base_dir(), "xla_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
+
 
 @dataclasses.dataclass
 class WorkflowContext:
@@ -27,6 +62,9 @@ class WorkflowContext:
     # / `--resume` is active; algorithms with iterative loops snapshot
     # through it (see ops/als.py train_als).
     checkpoint_hook: Any = None
+
+    def __post_init__(self):
+        _enable_compilation_cache()
 
     def get_storage(self) -> Storage:
         return self.storage or Storage.instance()

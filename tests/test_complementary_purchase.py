@@ -1,0 +1,138 @@
+"""Complementary Purchase template: basket formation + end-to-end engine.
+
+Reference ecosystem parity: predictionio-template-complementary-purchase
+(items frequently bought in the same time-windowed shopping basket)."""
+
+import datetime as dt
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from incubator_predictionio_tpu.models.complementary_purchase import (  # noqa: E402
+    ComplementaryPurchaseEngine, form_baskets,
+)
+
+
+def test_form_baskets_window_semantics():
+    """One basket per (user, session); a gap > window closes a session;
+    interleaved users don't bleed into each other's baskets."""
+    MIN = 60 * 1_000_000
+    u = np.asarray([0, 1, 0, 0, 1, 0], np.int32)
+    t = np.asarray([0, 5 * MIN, 10 * MIN, 200 * MIN, 6 * MIN, 205 * MIN],
+                   np.int64)
+    b = form_baskets(u, t, window_us=60 * MIN)
+    # user 0: events at 0, 10min (same basket), 200min+205min (new basket)
+    assert b[0] == b[2] and b[3] == b[5] and b[0] != b[3]
+    # user 1: one basket, distinct from user 0's
+    assert b[1] == b[4] and b[1] not in (b[0], b[3])
+    assert form_baskets(np.zeros(0, np.int32), np.zeros(0, np.int64),
+                        MIN).shape == (0,)
+
+
+def test_end_to_end_suggests_co_purchased_items(memory_storage):
+    """Items planted in the same baskets must surface for each other;
+    the queried items themselves are excluded."""
+    import random
+
+    from incubator_predictionio_tpu.controller import EngineParams
+    from incubator_predictionio_tpu.data.storage.base import App
+    from incubator_predictionio_tpu.data.storage.datamap import DataMap
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+
+    storage = memory_storage
+    storage.get_meta_data_apps().insert(App(0, "MyShopApp"))
+    le = storage.get_l_events()
+    rng = random.Random(3)
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    evs = []
+    # 200 shoppers; burger+bun+ketchup co-occur, pasta+sauce co-occur,
+    # plus noise items — all within one basket window per shopper
+    for s in range(200):
+        base = t0 + dt.timedelta(hours=3 * s)
+        combo = ["burger", "bun", "ketchup"] if s % 2 else ["pasta", "sauce"]
+        basket = combo + [f"noise{rng.randrange(40)}"]
+        for j, item in enumerate(basket):
+            evs.append(Event("buy", "user", f"u{s}", "item", item,
+                             DataMap(), base + dt.timedelta(minutes=j)))
+    le.insert_batch(evs, 1)
+
+    from incubator_predictionio_tpu.workflow.core_workflow import (
+        load_deployment, run_train,
+    )
+
+    engine = ComplementaryPurchaseEngine()()
+    ep = EngineParams.from_json({
+        "datasource": {"params": {"appName": "MyShopApp"}},
+        "algorithms": [{"name": "cooccurrence", "params": {
+            "basketWindowSecs": 3600, "maxCorrelatorsPerItem": 10}}],
+    })
+    ctx = WorkflowContext(app_name="MyShopApp", storage=storage)
+    iid = run_train(engine, ep, ctx, engine_factory_name="comp")
+    # deployment path = persistence round trip (save + restore_model)
+    dep, _, _ = load_deployment(
+        engine, iid, WorkflowContext(storage=storage),
+        engine_factory_name="comp")
+
+    out = dep.query({"items": ["burger"], "num": 3})
+    got = [x["item"] for x in out["itemScores"]]
+    assert "bun" in got[:2] and "ketchup" in got[:3]
+    assert "burger" not in got  # queried items excluded
+    assert "pasta" not in got and "sauce" not in got
+
+    out = dep.query({"items": ["pasta"], "num": 2})
+    assert [x["item"] for x in out["itemScores"]][:1] == ["sauce"]
+
+    # unknown items → empty, not an error
+    assert dep.query({"items": ["ghost"], "num": 3}) == {"itemScores": []}
+
+
+def test_window_separates_unrelated_purchases(memory_storage):
+    """The same user buying X and (much later) Y must NOT correlate
+    them: basket windows, not user lifetimes, define co-occurrence."""
+    import datetime as dt
+
+    from incubator_predictionio_tpu.controller import EngineParams
+    from incubator_predictionio_tpu.data.storage.base import App
+    from incubator_predictionio_tpu.data.storage.datamap import DataMap
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+
+    storage = memory_storage
+    storage.get_meta_data_apps().insert(App(0, "MyShopApp"))
+    le = storage.get_l_events()
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    evs = []
+    for s in range(40):
+        base = t0 + dt.timedelta(days=s)
+        evs.append(Event("buy", "user", f"u{s}", "item", "tv",
+                         DataMap(), base))
+        evs.append(Event("buy", "user", f"u{s}", "item", "hdmi",
+                         DataMap(), base + dt.timedelta(minutes=5)))
+        # a week later the same users buy socks — unrelated
+        evs.append(Event("buy", "user", f"u{s}", "item", "socks",
+                         DataMap(), base + dt.timedelta(days=7)))
+    le.insert_batch(evs, 1)
+
+    from incubator_predictionio_tpu.workflow.core_workflow import (
+        load_deployment, run_train,
+    )
+
+    engine = ComplementaryPurchaseEngine()()
+    ep = EngineParams.from_json({
+        "datasource": {"params": {"appName": "MyShopApp"}},
+        "algorithms": [{"name": "cooccurrence", "params": {
+            "basketWindowSecs": 3600}}],
+    })
+    ctx = WorkflowContext(app_name="MyShopApp", storage=storage)
+    iid = run_train(engine, ep, ctx, engine_factory_name="comp2")
+    dep, _, _ = load_deployment(
+        engine, iid, WorkflowContext(storage=storage),
+        engine_factory_name="comp2")
+    out = dep.query({"items": ["tv"], "num": 5})
+    got = [x["item"] for x in out["itemScores"]]
+    assert got[:1] == ["hdmi"]
+    assert "socks" not in got
