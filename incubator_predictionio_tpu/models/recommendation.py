@@ -222,6 +222,31 @@ class ALSAlgorithm(Algorithm):
 
     def predict(self, model: ALSModel, query: dict) -> dict:
         num = int(query.get("num", 10))
+        if query.get("items"):
+            # Product-ranking mode (ecosystem parity:
+            # predictionio-template-product-ranking): rank the GIVEN
+            # candidate list for the user instead of searching the
+            # whole catalog — storefronts reorder a page of products
+            # by affinity. Unknown user → items back in sent order
+            # with score 0 ("isOriginal": the template's fallback
+            # signal); unknown items rank last in sent order.
+            items = [str(x) for x in query["items"]]
+            uid = model.users.get(str(query["user"]))
+            if uid is None:
+                return {"itemScores": [{"item": it, "score": 0.0}
+                                       for it in items],
+                        "isOriginal": True}
+            uvec = model.factors.user_factors[uid]
+            known_ids = [model.items.get(it) for it in items]
+            scored = []
+            for pos, (it, iid) in enumerate(zip(items, known_ids)):
+                s = (float(uvec @ model.factors.item_factors[iid])
+                     if iid is not None else float("-inf"))
+                scored.append((-s, pos, it))
+            scored.sort()
+            return {"itemScores": [
+                {"item": it, "score": (0.0 if s == float("inf") else -s)}
+                for s, _pos, it in scored], "isOriginal": False}
         item_scores = model.recommend_products(str(query["user"]), num)
         return {
             "itemScores": [

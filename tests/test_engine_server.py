@@ -113,3 +113,39 @@ def test_engine_server_micro_batching(memory_storage):
         assert g.status_code == e.status_code, (q, g.text)
         if e.status_code == 200:
             assert g.json() == e.json(), q
+
+
+def test_product_ranking_query_mode(memory_storage):
+    """Query with "items" ranks the GIVEN candidates for the user
+    (ecosystem parity: predictionio-template-product-ranking): ranked
+    by the user's affinity, unknown items last, unknown user returns
+    the list unreordered with isOriginal=true."""
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rank")
+    server = EngineServer(engine, engine_factory_name="rank",
+                          storage=memory_storage)
+    with ServerThread(server.app) as st:
+        plain = requests.post(st.base + "/queries.json",
+                              json={"user": "1", "num": 50}).json()
+        order = [s["item"] for s in plain["itemScores"]]
+        assert len(order) >= 3
+        candidates = [order[2], order[0], "no-such-item", order[1]]
+        r = requests.post(st.base + "/queries.json",
+                          json={"user": "1", "items": candidates})
+        assert r.status_code == 200, r.text
+        out = r.json()
+        got = [s["item"] for s in out["itemScores"]]
+        # affinity order restored; unknown item ranks last
+        assert got == [order[0], order[1], order[2], "no-such-item"]
+        assert out["isOriginal"] is False
+        scores = [s["score"] for s in out["itemScores"]]
+        assert scores[:3] == sorted(scores[:3], reverse=True)
+
+        # unknown user: candidates back in sent order, flagged original
+        r = requests.post(st.base + "/queries.json",
+                          json={"user": "ghost", "items": candidates})
+        out = r.json()
+        assert [s["item"] for s in out["itemScores"]] == candidates
+        assert out["isOriginal"] is True
