@@ -220,33 +220,47 @@ class ALSAlgorithm(Algorithm):
         )
         return ALSModel(factors=factors, users=pd.users, items=pd.items)
 
+    @staticmethod
+    def _is_ranking_query(query: dict) -> bool:
+        # "items" present (even empty) selects ranking mode; absent or
+        # null means catalog recommendation
+        return query.get("items") is not None
+
+    @staticmethod
+    def _rank_candidates(model: ALSModel, query: dict) -> dict:
+        """Product-ranking mode (ecosystem parity:
+        predictionio-template-product-ranking): rank the GIVEN candidate
+        list for the user instead of searching the whole catalog —
+        storefronts reorder a page of products by affinity. Unknown
+        user → items back in sent order with score 0 ("isOriginal": the
+        template's fallback signal); unknown items rank last in sent
+        order."""
+        items = [str(x) for x in query["items"]]
+        uid = model.users.get(str(query["user"]))
+        if uid is None:
+            return {"itemScores": [{"item": it, "score": 0.0}
+                                   for it in items],
+                    "isOriginal": True}
+        uvec = model.factors.user_factors[uid]
+        known = [(pos, model.items.get(it))
+                 for pos, it in enumerate(items)]
+        rows = [iid for _, iid in known if iid is not None]
+        # one gathered matvec for the whole candidate page — no
+        # per-item dispatch on the serving hot path
+        gathered = (model.factors.item_factors[rows] @ uvec
+                    if rows else np.zeros(0, np.float32))
+        scores = np.full(len(items), -np.inf, np.float64)
+        scores[[pos for pos, iid in known if iid is not None]] = gathered
+        order = sorted(range(len(items)), key=lambda p: (-scores[p], p))
+        return {"itemScores": [
+            {"item": items[p],
+             "score": float(scores[p]) if np.isfinite(scores[p]) else 0.0}
+            for p in order], "isOriginal": False}
+
     def predict(self, model: ALSModel, query: dict) -> dict:
+        if self._is_ranking_query(query):
+            return self._rank_candidates(model, query)
         num = int(query.get("num", 10))
-        if query.get("items"):
-            # Product-ranking mode (ecosystem parity:
-            # predictionio-template-product-ranking): rank the GIVEN
-            # candidate list for the user instead of searching the
-            # whole catalog — storefronts reorder a page of products
-            # by affinity. Unknown user → items back in sent order
-            # with score 0 ("isOriginal": the template's fallback
-            # signal); unknown items rank last in sent order.
-            items = [str(x) for x in query["items"]]
-            uid = model.users.get(str(query["user"]))
-            if uid is None:
-                return {"itemScores": [{"item": it, "score": 0.0}
-                                       for it in items],
-                        "isOriginal": True}
-            uvec = model.factors.user_factors[uid]
-            known_ids = [model.items.get(it) for it in items]
-            scored = []
-            for pos, (it, iid) in enumerate(zip(items, known_ids)):
-                s = (float(uvec @ model.factors.item_factors[iid])
-                     if iid is not None else float("-inf"))
-                scored.append((-s, pos, it))
-            scored.sort()
-            return {"itemScores": [
-                {"item": it, "score": (0.0 if s == float("inf") else -s)}
-                for s, _pos, it in scored], "isOriginal": False}
         item_scores = model.recommend_products(str(query["user"]), num)
         return {
             "itemScores": [
@@ -257,6 +271,21 @@ class ALSAlgorithm(Algorithm):
     def batch_predict(self, model: ALSModel, queries: Sequence[dict]) -> list[dict]:
         if not queries:
             return []
+        # ranking-mode queries ("items" present) answer per query — the
+        # serving micro-batch and `pio batchpredict` paths must match
+        # predict() exactly; only catalog queries ride the batched top-k
+        ranking = [j for j, q in enumerate(queries)
+                   if self._is_ranking_query(q)]
+        if ranking:
+            out: list[Optional[dict]] = [None] * len(queries)
+            for j in ranking:
+                out[j] = self._rank_candidates(model, queries[j])
+            rest_idx = [j for j in range(len(queries)) if out[j] is None]
+            rest = self.batch_predict(
+                model, [queries[j] for j in rest_idx])
+            for j, r in zip(rest_idx, rest):
+                out[j] = r
+            return out  # type: ignore[return-value]
         known = [model.users.get(str(q["user"])) is not None for q in queries]
         uvecs = np.stack(
             [

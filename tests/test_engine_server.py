@@ -149,3 +149,41 @@ def test_product_ranking_query_mode(memory_storage):
         out = r.json()
         assert [s["item"] for s in out["itemScores"]] == candidates
         assert out["isOriginal"] is True
+
+
+def test_product_ranking_through_micro_batch_and_batch_predict(memory_storage):
+    """Ranking-mode queries must return identical results through the
+    per-query path, the micro-batching server path, and batch_predict
+    (review finding: the batched paths bypassed the ranking mode)."""
+    import concurrent.futures
+
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rankb")
+    server = EngineServer(engine, engine_factory_name="rankb",
+                          storage=memory_storage,
+                          batch_window_ms=10.0, max_batch=8)
+    direct = EngineServer(engine, engine_factory_name="rankb",
+                          storage=memory_storage)
+    queries = [{"user": "1", "items": ["5", "9", "ghost", "2"]},
+               {"user": "2", "num": 3},  # catalog query mixed in
+               {"user": "zzz", "items": ["5", "9"]},
+               {"user": "3", "items": []}]
+    want = [direct.deployment.query(q) for q in queries]
+    with ServerThread(server.app) as st:
+        with concurrent.futures.ThreadPoolExecutor(4) as pool:
+            got = list(pool.map(
+                lambda q: requests.post(st.base + "/queries.json",
+                                        json=q, timeout=30).json(),
+                queries))
+    # ranking-mode queries share the exact numpy path → bit-identical;
+    # the catalog query's batched matmul may differ by float ULPs from
+    # the single-query matvec, so compare it by items + approx scores
+    assert got[0] == want[0] and got[2] == want[2] and got[3] == want[3]
+    assert ([s["item"] for s in got[1]["itemScores"]]
+            == [s["item"] for s in want[1]["itemScores"]])
+    for a, b in zip(got[1]["itemScores"], want[1]["itemScores"]):
+        assert abs(a["score"] - b["score"]) < 1e-4
+    assert want[3] == {"itemScores": [], "isOriginal": False}
+    assert want[2]["isOriginal"] is True
