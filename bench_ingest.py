@@ -1,0 +1,152 @@
+"""Benchmark: Event Server ingestion throughput (events/sec).
+
+The reference's ★ ingestion hot path (SURVEY.md §3.3: POST /events.json
+→ auth → validate → HBase Put). This drives the REAL event server over
+HTTP — access-key auth, JSON validation, reserved-event rules, storage
+write — measuring:
+
+- single-event POSTs (the SDK default), sequential and concurrent
+- /batch/events.json at the wire cap (50 events/request)
+- bulk import path (`pio import`-equivalent insert_batch) for contrast
+
+against the JSONL event log (the training-fast-path store of record)
+by default; PIO_INGEST_BACKEND=SQLITE|MEMORY switches.
+
+Prints ONE JSON line per mode; persists under
+BASELINE.json.published.measured_ingest_*. No accelerator involved —
+ingestion is a host path, so numbers are valid from any box.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    import requests
+    from server_utils import ServerThread
+
+    from incubator_predictionio_tpu.data.api.event_server import EventServer
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+
+    backend = os.environ.get("PIO_INGEST_BACKEND", "JSONL").upper()
+    n_single = int(os.environ.get("PIO_INGEST_N_SINGLE", "2000"))
+    n_batch = int(os.environ.get("PIO_INGEST_N_BATCH", "40000"))
+    tmp = tempfile.mkdtemp(prefix="pio_ingest_")
+    env = {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        "PIO_STORAGE_SOURCES_M_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_EV_TYPE": backend,
+        "PIO_STORAGE_SOURCES_EV_PATH": os.path.join(tmp, "events"),
+    }
+    if backend == "MEMORY":
+        env["PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE"] = "M"
+    storage = Storage(env)
+    storage.get_meta_data_apps().insert(App(0, "ingest"))
+    storage.get_meta_data_access_keys().insert(AccessKey("k1", 1, ()))
+
+    def ev(k):
+        # deterministic per-index (thread-safe: no shared RNG state)
+        return {"event": "view", "entityType": "user",
+                "entityId": str((k * 7919) % 10000),
+                "targetEntityType": "item",
+                "targetEntityId": str((k * 104729) % 2000),
+                "eventTime": "2026-01-01T00:00:00.000Z"}
+
+    results = {}
+    with ServerThread(EventServer(storage).app) as st:
+        base = st.base + "/events.json?accessKey=k1"
+        bbase = st.base + "/batch/events.json?accessKey=k1"
+        sess = requests.Session()
+        r = sess.post(base, json=ev(0))
+        assert r.status_code == 201, r.text
+
+        t0 = time.perf_counter()
+        ok = sum(sess.post(base, json=ev(k)).status_code == 201
+                 for k in range(n_single))
+        dt = time.perf_counter() - t0
+        assert ok == n_single, f"{n_single - ok} single POSTs failed"
+        results["single_seq"] = ok / dt
+        log(f"[ingest] single sequential: {ok / dt:,.0f} ev/s")
+
+        import concurrent.futures
+
+        per_worker = n_single // 8
+
+        def worker(w):
+            ok = 0
+            with requests.Session() as s2:
+                for j in range(per_worker):
+                    ok += (s2.post(base, json=ev(w * per_worker + j))
+                           .status_code == 201)
+            return ok
+
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            ok = sum(pool.map(worker, range(8)))
+        dt = time.perf_counter() - t0
+        assert ok == per_worker * 8, f"{per_worker * 8 - ok} failed"
+        results["single_conc8"] = ok / dt
+        log(f"[ingest] single x8 concurrent: {ok / dt:,.0f} ev/s")
+
+        n_reqs = max(n_batch // 50, 1)
+        batches = [[ev(b * 50 + j) for j in range(50)]
+                   for b in range(n_reqs)]
+        t0 = time.perf_counter()
+        ok = sum(sess.post(bbase, json=b).status_code == 200
+                 for b in batches)
+        dt = time.perf_counter() - t0
+        assert ok == n_reqs, f"{n_reqs - ok} batch POSTs failed"
+        sent = n_reqs * 50
+        results["batch50"] = sent / dt
+        log(f"[ingest] batch/events.json (50/req): {sent / dt:,.0f} ev/s")
+
+    from incubator_predictionio_tpu.data.storage.event import Event
+
+    le = storage.get_l_events()
+    evs = [Event.from_json({**ev(0), "eventTime": "2026-01-01T00:00:00.000Z"})
+           for _ in range(n_batch)]
+    t0 = time.perf_counter()
+    le.insert_batch(evs, 1)
+    dt = time.perf_counter() - t0
+    results["insert_batch"] = n_batch / dt
+    log(f"[ingest] storage insert_batch: {n_batch / dt:,.0f} ev/s")
+
+    for mode, v in results.items():
+        print(json.dumps({
+            "metric": f"event ingestion {mode} ({backend.lower()})",
+            "value": round(v, 1), "unit": "events/sec",
+        }), flush=True)
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE.json")
+    try:
+        with open(base_path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})[
+            f"measured_ingest_{backend.lower()}"] = {
+                k: round(v, 1) for k, v in results.items()}
+        with open(base_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    except Exception as e:  # noqa: BLE001
+        log(f"[ingest] could not persist: {e}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

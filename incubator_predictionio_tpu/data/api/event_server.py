@@ -171,23 +171,36 @@ class EventServer:
                 400, f"Batch request must have less than or equal to {MAX_BATCH_SIZE} events"
             )
         le = self.storage.get_l_events()
-        results = []
-        for obj in body:
+        # Validate every item first (failures stay per-item, matching
+        # the reference's independent-items semantics), then persist all
+        # valid events in ONE insert_batch off-thread: per-event
+        # to_thread + single inserts cost ~10x at the 50-event wire cap
+        # (one storage append + one executor hop instead of 50+50).
+        results: list[Optional[dict]] = [None] * len(body)
+        valid: list[tuple[int, Event, object]] = []
+        for pos, obj in enumerate(body):
             try:
                 if isinstance(obj, dict):
                     obj = dict(obj)
                     obj.pop("creationTime", None)
                 event = Event.from_json(obj)
                 self._check_event_allowed(access_key, event.event)
-                event_id = await asyncio.to_thread(
-                    le.insert, event, access_key.appid, channel_id
-                )
-                results.append({"status": 201, "eventId": event_id})
-                self._record(access_key.appid, obj, 201)
+                valid.append((pos, event, obj))
             except (EventValidationError, web.HTTPForbidden) as e:
                 message = str(e) if isinstance(e, EventValidationError) else "forbidden"
-                results.append({"status": 400, "message": message})
+                results[pos] = {"status": 400, "message": message}
                 self._record(access_key.appid, obj, 400)
+        if valid:
+            event_ids = await asyncio.to_thread(
+                le.insert_batch, [e for _, e, _ in valid],
+                access_key.appid, channel_id)
+            # strict: a backend returning a short id list (e.g. a
+            # malformed remote response through the HTTP backend) must
+            # surface as a 500, not as silent nulls in a 200 body
+            for (pos, _event, obj), eid in zip(valid, event_ids,
+                                               strict=True):
+                results[pos] = {"status": 201, "eventId": eid}
+                self._record(access_key.appid, obj, 201)
         return web.json_response(results)
 
     async def handle_get(self, request: web.Request) -> web.Response:
