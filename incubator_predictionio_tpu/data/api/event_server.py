@@ -28,6 +28,8 @@ import base64
 import datetime as _dt
 import json
 import logging
+import os
+import time
 from typing import Optional
 
 from aiohttp import web
@@ -59,6 +61,16 @@ class EventServer:
         self.storage = storage or Storage.instance()
         self.stats = Stats() if enable_stats else None
         self.plugins = plugins or EventServerPluginContext()
+        # access-key TTL cache: auth otherwise costs one executor hop +
+        # one metadata lookup PER ingested event — the single-POST hot
+        # path. Key revocation/whitelist edits take effect within the
+        # TTL; PIO_ACCESSKEY_CACHE_SECS=0 restores per-request lookups.
+        try:
+            self._key_ttl = float(
+                os.environ.get("PIO_ACCESSKEY_CACHE_SECS", "5"))
+        except ValueError:
+            self._key_ttl = 5.0
+        self._key_cache: dict = {}  # key -> (expires_monotonic, AccessKey)
         self.app = web.Application(client_max_size=16 * 1024 * 1024)
         self.app.add_routes(
             [
@@ -94,9 +106,36 @@ class EventServer:
                 text=json.dumps({"message": "Missing accessKey."}),
                 content_type="application/json",
             )
-        access_key = await asyncio.to_thread(
-            self.storage.get_meta_data_access_keys().get, key
-        )
+        if self._key_ttl > 0:
+            hit = self._key_cache.get(key)
+            if hit is not None and hit[0] > time.monotonic():
+                access_key = hit[1]
+            else:
+                access_key = await asyncio.to_thread(
+                    self.storage.get_meta_data_access_keys().get, key
+                )
+                # negative results are cached too (same TTL): a flood of
+                # bad keys must not turn into a storage-lookup flood
+                self._key_cache[key] = (
+                    time.monotonic() + self._key_ttl, access_key)
+                if len(self._key_cache) > 10_000:
+                    # drop EXPIRED entries of either sign (fresh
+                    # negatives must survive — they ARE the flood
+                    # shield); if everything is fresh, drop oldest by
+                    # expiry so the bound holds without O(n) rebuilds
+                    # on every subsequent miss
+                    now = time.monotonic()
+                    fresh = {k: v for k, v in self._key_cache.items()
+                             if v[0] > now}
+                    if len(fresh) > 10_000:
+                        keep = sorted(fresh.items(),
+                                      key=lambda kv: kv[1][0])[-5_000:]
+                        fresh = dict(keep)
+                    self._key_cache = fresh
+        else:
+            access_key = await asyncio.to_thread(
+                self.storage.get_meta_data_access_keys().get, key
+            )
         if access_key is None:
             raise web.HTTPUnauthorized(
                 text=json.dumps({"message": "Invalid accessKey."}),

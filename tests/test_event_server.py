@@ -165,3 +165,39 @@ def test_webhooks(memory_storage):
 
         events = list(memory_storage.get_l_events().find(app_id))
         assert {e.event for e in events} == {"track", "subscribe"}
+
+
+def test_access_key_cache_ttl_and_revocation(memory_storage, monkeypatch):
+    """Auth results are cached for PIO_ACCESSKEY_CACHE_SECS: revocation
+    takes effect within the TTL (not never), bad keys stay rejected,
+    and TTL=0 restores strict per-request lookups."""
+    import time
+
+    app_id, key = _setup(memory_storage)
+    monkeypatch.setenv("PIO_ACCESSKEY_CACHE_SECS", "0.3")
+    server = EventServer(memory_storage)
+    body = {"event": "view", "entityType": "user", "entityId": "u1",
+            "eventTime": "2024-01-01T00:00:00.000Z"}
+    with ServerThread(server.app) as st:
+        url = f"{st.base}/events.json?accessKey={key}"
+        assert requests.post(url, json=body).status_code == 201
+        # revoke; the cached verdict may serve briefly...
+        memory_storage.get_meta_data_access_keys().delete(key)
+        time.sleep(0.4)  # ...but not past the TTL
+        assert requests.post(url, json=body).status_code == 401
+        # and bad keys are rejected (cached or not)
+        r = requests.post(f"{st.base}/events.json?accessKey=bogus", json=body)
+        assert r.status_code == 401
+        r = requests.post(f"{st.base}/events.json?accessKey=bogus", json=body)
+        assert r.status_code == 401
+
+    monkeypatch.setenv("PIO_ACCESSKEY_CACHE_SECS", "0")
+    server2 = EventServer(memory_storage)
+    memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("fresh", app_id, ()))
+    with ServerThread(server2.app) as st:
+        url = f"{st.base}/events.json?accessKey=fresh"
+        assert requests.post(url, json=body).status_code == 201
+        memory_storage.get_meta_data_access_keys().delete("fresh")
+        # TTL=0: revocation is immediate
+        assert requests.post(url, json=body).status_code == 401
