@@ -17,7 +17,13 @@ for i in $(seq 1 55); do
         cat /tmp/r4m/*.rc 2>/dev/null
         grep -h '"metric"' /tmp/r4m/*.log 2>/dev/null
       } > MEASURE_r4_summary.txt
-      git add BASELINE.json MEASURE_r4_summary.txt
+      python tools/crossover.py >> MEASURE_r4_summary.txt 2>&1 || true
+      if [ $rc -eq 0 ]; then
+        # full sweep: fold the numbers into BASELINE.md mechanically
+        python tools/update_baseline_from_sweep.py /tmp/r4m \
+          >> MEASURE_r4_summary.txt 2>&1 || true
+      fi
+      git add BASELINE.json BASELINE.md MEASURE_r4_summary.txt
       git commit -m "Record TPU measurements from the tools/r4_measure.sh sweep
 
 Automated capture on tunnel recovery: ALS rank-32/rank-128 + ladder A/B,
