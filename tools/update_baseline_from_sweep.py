@@ -54,51 +54,51 @@ def fmt_m(v: float) -> str:
     return f"{v:.0f}"
 
 
-def update(baseline_md: str, metrics: dict[str, float],
+#: (config row number, row-start regex, metric substring, unit,
+#: preferred log stem or None)
+_ROWS = [
+    (1, r"\| 1 \| Recommendation \(ALS\) \| ML-20M, rank 32 ×10 \| ",
+     "pio train ALS", "events/s/chip", "bench_rank32"),
+    (3, r"\| 3 \| Similar-Product \(implicit ALS\) \| [^|]+\| ",
+     "pio train similar_product", "events/s/chip", None),
+    (4, r"\| 4 \| Text-Classification \(TF-IDF\+NB\) \| [^|]+\| ",
+     "pio train text", "docs/s/chip", None),
+    (5, r"\| 5 \| Universal Recommender \(CCO/LLR\) \| [^|]+\| ",
+     "pio train ur", "events/s/chip", None),
+]
+
+
+def update(baseline_md: str, metrics: dict,
            sweep_tag: str) -> tuple[str, list[str]]:
     s = baseline_md
     changed: list[str] = []
 
-    def metric_like(sub: str, stem: str | None = None):
+    def metric_like(sub: str, stem):
         for (st, k), v in metrics.items():
             if sub in k and "(cpu)" not in k and (stem is None
                                                   or st == stem):
                 return v
         return None
 
-    als = metric_like("pio train ALS", stem="bench_rank32")
-    if als:
-        s = re.sub(
-            r"\| 1 \| Recommendation \(ALS\) \| ML-20M, rank 32 ×10 \| "
-            r"\*\*[^|]+\*\* \(steady-state device\)",
-            f"| 1 | Recommendation (ALS) | ML-20M, rank 32 ×10 | "
-            f"**{fmt_m(als)} events/s/chip** ({sweep_tag})", s)
-        changed.append(f"config 1 -> {fmt_m(als)}")
-    sim = metric_like("pio train similar_product")
-    if sim:
-        s = re.sub(
-            r"(\| 3 \| Similar-Product \(implicit ALS\) \| [^|]+\| )"
-            r"\*\*[^|]+\*\*[^|]*",
-            rf"\g<1>**{fmt_m(sim)} events/s/chip** ({sweep_tag}) ", s)
-        changed.append(f"config 3 -> {fmt_m(sim)}")
-    text = metric_like("pio train text")
-    if text:
-        s = re.sub(
-            r"(\| 4 \| Text-Classification \(TF-IDF\+NB\) \| [^|]+\| )"
-            r"\*\*[^|]+\*\*[^|]*",
-            rf"\g<1>**{fmt_m(text)} docs/s/chip** ({sweep_tag}) ", s)
-        changed.append(f"config 4 -> {fmt_m(text)}")
-    ur = metric_like("pio train ur")
-    if ur:
-        s = re.sub(
-            r"(\| 5 \| Universal Recommender \(CCO/LLR\) \| [^|]+\| )"
-            r"\*\*[^|]+\*\*[^|]*",
-            rf"\g<1>**{fmt_m(ur)} events/s/chip** ({sweep_tag}) ", s)
-        changed.append(f"config 5 -> {fmt_m(ur)}")
-
-    if changed:
-        # the staleness note no longer applies to refreshed rows
-        s = re.sub(
+    for row_no, prefix, sub, unit, stem in _ROWS:
+        v = metric_like(sub, stem)
+        if v is None:
+            continue
+        # idempotent: the measured cell is always **value unit** (tag) —
+        # matched regardless of what tag the previous run left
+        s, n = re.subn(
+            "(" + prefix + r")\*\*[^|]+\*\*[^|]*",
+            rf"\g<1>**{fmt_m(v)} {unit}** ({sweep_tag}) ", s)
+        if n:  # only report rows that actually rewrote
+            changed.append(f"config {row_no} -> {fmt_m(v)}")
+        else:
+            print(f"WARNING: config {row_no} measured ({fmt_m(v)}) but "
+                  "its BASELINE.md row did not match — row text drifted?")
+    if any(c.startswith(("config 3", "config 4", "config 5"))
+           for c in changed):
+        # the staleness caveat covered configs 3-5; drop it only once
+        # those rows really hold fresh numbers
+        s, _ = re.subn(
             r"> Note: the config 3–5 rows were measured BEFORE[^|]*?\n\n",
             f"> Config rows marked ({sweep_tag}) were re-measured by the "
             "driver-side sweep after the r3/r4 host-path optimizations; "
@@ -109,8 +109,9 @@ def update(baseline_md: str, metrics: dict[str, float],
 
 
 def main() -> int:
-    log_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/r4m"
+    args = [a for a in sys.argv[1:] if a != "--dry-run"]
     dry = "--dry-run" in sys.argv
+    log_dir = args[0] if args else "/tmp/r4m"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     metrics = collect_metrics(log_dir)
     if not metrics:
