@@ -18,6 +18,13 @@ from . import verb
 def status_cmd(args: list[str]) -> int:
     s = Storage.instance()
     print("[info] Inspecting storage backend connections...")
+    from ...data.storage.registry import REPOSITORIES
+
+    for repo in REPOSITORIES:
+        try:
+            print(f"[info]   {repo}: {s.repo_source_type(repo)}")
+        except Exception as e:  # noqa: BLE001 - verify below reports it
+            print(f"[info]   {repo}: <unconfigured> ({e})")
     errors = s.verify_all_data_objects()
     if errors:
         for e in errors:
