@@ -235,3 +235,89 @@ def test_nonloopback_bind_requires_secret(tmp_path):
     )
     assert r.returncode != 0
     assert "refusing" in (r.stdout + r.stderr).lower()
+
+
+def test_all_network_backend_topology(tmp_path):
+    """Production-shaped topology with EVERY repository on a network
+    protocol: metadata on MySQL (wire protocol), events on
+    Elasticsearch (REST, sliced PIT training reads), models on S3
+    (SigV4) — full lifecycle: app, ingest, train, persist, deploy from
+    a cold registry, query."""
+    import numpy as np
+
+    from es_mock import build_es_app
+    from mysql_mock import MockMySQLServer
+    from s3_mock import build_s3_app
+    from server_utils import ServerThread
+
+    from incubator_predictionio_tpu.controller import EngineParams
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import App
+    from incubator_predictionio_tpu.data.storage.datamap import DataMap
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine,
+    )
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import (
+        load_deployment, run_train,
+    )
+
+    with MockMySQLServer(user="pio", password="piosecret") as my, \
+            ServerThread(build_es_app()) as es, \
+            ServerThread(build_s3_app("AK", "sk")) as s3:
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MY",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "ES",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "OBJ",
+            "PIO_STORAGE_SOURCES_MY_TYPE": "MYSQL",
+            "PIO_STORAGE_SOURCES_MY_HOST": "127.0.0.1",
+            "PIO_STORAGE_SOURCES_MY_PORT": str(my.port),
+            "PIO_STORAGE_SOURCES_MY_USERNAME": "pio",
+            "PIO_STORAGE_SOURCES_MY_PASSWORD": "piosecret",
+            "PIO_STORAGE_SOURCES_ES_TYPE": "ELASTICSEARCH",
+            "PIO_STORAGE_SOURCES_ES_HOSTS": "127.0.0.1",
+            "PIO_STORAGE_SOURCES_ES_PORTS": str(es.port),
+            "PIO_STORAGE_SOURCES_OBJ_TYPE": "S3",
+            "PIO_STORAGE_SOURCES_OBJ_ENDPOINT":
+                f"http://127.0.0.1:{s3.port}",
+            "PIO_STORAGE_SOURCES_OBJ_BUCKET": "pio-models",
+            "PIO_STORAGE_SOURCES_OBJ_ACCESS_KEY": "AK",
+            "PIO_STORAGE_SOURCES_OBJ_SECRET_KEY": "sk",
+        }
+        storage = Storage(env)
+        storage.get_meta_data_apps().insert(App(0, "netapp"))
+        rng = np.random.default_rng(5)
+        evs = []
+        import datetime as dt
+
+        t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        for k in range(800):
+            evs.append(Event(
+                "rate", "user", str(int(rng.integers(0, 40))),
+                "item", f"i{int(rng.integers(0, 25))}",
+                DataMap({"rating": int(rng.integers(1, 6))}),
+                t0 + dt.timedelta(seconds=k)))
+        storage.get_l_events().insert_batch(evs, 1)
+
+        engine = RecommendationEngine()()
+        ep = EngineParams.from_json({
+            "datasource": {"params": {"appName": "netapp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "numIterations": 5, "lambda": 0.05}}],
+        })
+        ctx = WorkflowContext(app_name="netapp", storage=storage)
+        iid = run_train(engine, ep, ctx, engine_factory_name="net")
+        storage.close()
+
+        # cold start: a FRESH registry (new connections to all three
+        # services) must find the instance in MySQL, the model in S3,
+        # and serve — the deploy-on-a-different-host story
+        storage2 = Storage(env)
+        dep, _, _ = load_deployment(
+            engine, iid, WorkflowContext(storage=storage2),
+            engine_factory_name="net")
+        out = dep.query({"user": "3", "num": 4})
+        assert len(out["itemScores"]) == 4
+        assert all(s["item"].startswith("i") for s in out["itemScores"])
+        storage2.close()
