@@ -237,13 +237,13 @@ def test_nonloopback_bind_requires_secret(tmp_path):
     assert "refusing" in (r.stdout + r.stderr).lower()
 
 
-def test_all_network_backend_topology(tmp_path):
+def test_all_network_backend_topology():
     """Production-shaped topology with EVERY repository on a network
     protocol: metadata on MySQL (wire protocol), events on
     Elasticsearch (REST, sliced PIT training reads), models on S3
     (SigV4) — full lifecycle: app, ingest, train, persist, deploy from
     a cold registry, query."""
-    import numpy as np
+    import datetime as dt
 
     from es_mock import build_es_app
     from mysql_mock import MockMySQLServer
@@ -286,11 +286,9 @@ def test_all_network_backend_topology(tmp_path):
             "PIO_STORAGE_SOURCES_OBJ_SECRET_KEY": "sk",
         }
         storage = Storage(env)
-        storage.get_meta_data_apps().insert(App(0, "netapp"))
+        aid = storage.get_meta_data_apps().insert(App(0, "netapp"))
         rng = np.random.default_rng(5)
         evs = []
-        import datetime as dt
-
         t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
         for k in range(800):
             evs.append(Event(
@@ -298,7 +296,7 @@ def test_all_network_backend_topology(tmp_path):
                 "item", f"i{int(rng.integers(0, 25))}",
                 DataMap({"rating": int(rng.integers(1, 6))}),
                 t0 + dt.timedelta(seconds=k)))
-        storage.get_l_events().insert_batch(evs, 1)
+        storage.get_l_events().insert_batch(evs, aid)
 
         engine = RecommendationEngine()()
         ep = EngineParams.from_json({
