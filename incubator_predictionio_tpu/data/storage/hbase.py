@@ -1,15 +1,24 @@
-"""HBase backend — the `HBASE` source type, over the HBase REST gateway.
+"""HBase backend — the `HBASE` source type, over two real transports.
 
 Reference: storage/hbase/.../{HBLEvents,HBPEvents,HBEventsUtil}
 (SURVEY.md §2.1): the event store of record, rowkeys encoding time so
-scans ride rowkey order. A native HBase RPC client (protobuf + SASL) is
-out of scope here; instead this speaks the **HBase REST gateway**
-protocol (the `hbase rest` service every distribution ships, JSON
-representation with base64 keys/cells): table schema CRUD, row
-GET/PUT/DELETE, and the stateful scanner API with start/stop rows.
+scans ride rowkey order, filters evaluated server-side.  Two wire
+transports implement one shared storage layout:
+
+- ``PROTOCOL=rpc`` — the NATIVE HBase client protocol (protobuf-framed
+  RPC with hbase:meta region routing, Multi-batched puts, reversed
+  scanners, Filter protos pushed down), written from scratch in
+  `hbase_rpc.py`.  This is the reference's own transport family.
+- ``PROTOCOL=rest`` (default) — the HBase REST gateway (the
+  ``hbase rest`` service, JSON representation with base64 keys/cells):
+  table schema CRUD, row GET/PUT/DELETE, stateful scanners, and the
+  Stargate filter spec for the same server-side filtering.
 
     PIO_STORAGE_SOURCES_HB_TYPE=HBASE
-    PIO_STORAGE_SOURCES_HB_HOSTS=hbase-rest-host   PORTS=8080
+    PIO_STORAGE_SOURCES_HB_HOSTS=hbase-host      PORTS=8080
+    PIO_STORAGE_SOURCES_HB_PROTOCOL=rest|rpc
+    # rpc extras (default: same endpoint — HBase standalone topology):
+    PIO_STORAGE_SOURCES_HB_MASTER_HOST=...       MASTER_PORT=16000
 
 Layout (one table per (namespace, app, channel), like the reference's
 pio_event_<appId>[_<channelId>]):
@@ -23,21 +32,22 @@ pio_event_<appId>[_<channelId>]):
 - index rows: ``i:<eventId>`` → cell ``e:k`` holding the current data
   rowkey — the eventId → rowkey lookup for get/delete/upsert.
 
-Filters beyond the time range are PUSHED DOWN to the gateway: data rows
-carry the filterable fields as dedicated cells (``e:ev``, ``e:et``,
-``e:eid``, ``e:tet``, ``e:teid``) and filtered scans send a Stargate
-filter spec (FilterList of SingleColumnValueFilters — the same
-HBase-side evaluation the reference's HBEventsUtil filter lists get),
-so a filtered find only transfers matching rows. The client still
-re-checks every returned event (``event_matches``) as a semantic
-backstop, so results are identical even against a gateway that ignores
-the filter parameter.
+Filters beyond the time range are PUSHED DOWN: data rows carry the
+filterable fields as dedicated cells (``e:ev``, ``e:et``, ``e:eid``,
+``e:tet``, ``e:teid``) and filtered scans send a FilterList of
+SingleColumnValueFilters (as Filter protos on the RPC transport, as the
+Stargate JSON spec on REST — the same HBase-side evaluation the
+reference's HBEventsUtil filter lists get), so a filtered find only
+transfers matching rows.  The client still re-checks every returned
+event (``event_matches``) as a semantic backstop, so results are
+identical even against a server that ignores the filter.
 """
 
 from __future__ import annotations
 
 import base64
 import datetime as _dt
+import itertools
 import json
 import urllib.error
 import urllib.parse
@@ -46,6 +56,7 @@ from typing import Iterable, Iterator, Optional, Sequence
 
 from . import base as storage_base
 from .event import Event, MonotoneNs, event_time_us, new_event_id
+from .hbase_rpc import HBaseRpcError, HBaseRpcTransport
 from .sqlite import _safe_ident
 
 
@@ -62,9 +73,15 @@ def _unb64(s: str) -> bytes:
 
 
 class _HBaseRest:
+    """REST-gateway implementation of the shared transport interface:
+    create/delete table, row get/put/delete, batched puts, range scans
+    with pushdown filters (the Stargate JSON spec)."""
+
+    native_reverse = False
+    _CF = "e"
+
     def __init__(self, endpoint: str, timeout: float = 30.0):
         self.endpoint = endpoint.rstrip("/")
-
         self.timeout = timeout
 
     def request(self, method: str, path: str, body=None,
@@ -89,11 +106,112 @@ class _HBaseRest:
                 f"HBase REST gateway unreachable: {self.endpoint} "
                 f"({e.reason})") from e
 
+    def close(self) -> None:
+        pass
+
+    # -- schema ------------------------------------------------------------
+    def create_table(self, table: str) -> None:
+        status, _ = self.request(
+            "PUT", f"/{table}/schema",
+            body={"name": table, "ColumnSchema": [{"name": self._CF}]})
+        if status not in (200, 201):
+            raise HBaseError(f"create table: HTTP {status}")
+
+    def delete_table(self, table: str) -> bool:
+        status, _ = self.request("DELETE", f"/{table}/schema")
+        return status == 200
+
+    # -- rows --------------------------------------------------------------
+    def _rows_body(self, rows: Sequence[tuple[bytes, dict[str, bytes]]]):
+        return {"Row": [{
+            "key": _b64(key),
+            "Cell": [{"column": _b64(f"{self._CF}:{q}".encode()),
+                      "$": _b64(v)} for q, v in cells.items()],
+        } for key, cells in rows]}
+
+    def put_rows(self, table: str,
+                 rows: Sequence[tuple[bytes, dict[str, bytes]]]) -> None:
+        if not rows:
+            return
+        if len(rows) == 1:
+            row_q = urllib.parse.quote(rows[0][0].decode(), safe="")
+            path = f"/{table}/{row_q}"
+        else:
+            path = f"/{table}/batch"
+        body = self._rows_body(rows)
+        status, _ = self.request("PUT", path, body=body)
+        if status == 404:
+            # auto-create on first write (contract: insert without init)
+            self.create_table(table)
+            status, _ = self.request("PUT", path, body=body)
+        if status not in (200, 201):
+            raise HBaseError(f"put {table}: HTTP {status}")
+
+    def get_row(self, table: str, key: bytes) -> Optional[dict[str, bytes]]:
+        row_q = urllib.parse.quote(key.decode(), safe="")
+        status, out = self.request("GET", f"/{table}/{row_q}")
+        if status == 404 or not out:
+            return None
+        if status != 200:
+            raise HBaseError(f"get {table}/{key!r}: HTTP {status}")
+        cells = {}
+        for row in out.get("Row", []):
+            for cell in row.get("Cell", []):
+                col = _unb64(cell["column"]).decode()
+                cells[col.split(":", 1)[1]] = _unb64(cell["$"])
+        return cells or None
+
+    def delete_row(self, table: str, key: bytes) -> bool:
+        row_q = urllib.parse.quote(key.decode(), safe="")
+        status, _ = self.request("DELETE", f"/{table}/{row_q}")
+        return status == 200
+
+    # -- scans -------------------------------------------------------------
+    def scan(self, table: str, start: bytes, stop: bytes,
+             filter_spec: Optional[dict] = None,
+             reverse: bool = False,
+             batch: int = 1000) -> Iterator[tuple[bytes, dict[str, bytes]]]:
+        """Rowkey-range scan via the stateful scanner API; an optional
+        filter spec evaluates server-side (only matches cross the wire).
+        The gateway has no reversed scanner (native_reverse=False) —
+        callers needing descending order materialize and sort."""
+        assert not reverse, "REST gateway scans are forward-only"
+        body = {"batch": batch, "startRow": _b64(start),
+                "endRow": _b64(stop)}
+        if filter_spec is not None:
+            # the gateway's scanner model carries the filter as a STRING
+            # holding the filter's own JSON serialization
+            body["filter"] = json.dumps(filter_spec)
+        status, location = self.request(
+            "PUT", f"/{table}/scanner", body=body, want_location=True)
+        if status == 404:
+            return
+        if status != 201 or not location:
+            raise HBaseError(f"open scanner on {table}: HTTP {status}")
+        path = urllib.parse.urlsplit(location).path
+        try:
+            while True:
+                status, out = self.request("GET", path)
+                if status == 204:
+                    return
+                if status != 200:
+                    raise HBaseError(f"scanner read: HTTP {status}")
+                for row in (out or {}).get("Row", []):
+                    key = _unb64(row["key"])
+                    cells = {}
+                    for cell in row.get("Cell", []):
+                        col = _unb64(cell["column"]).decode()
+                        cells[col.split(":", 1)[1]] = _unb64(cell["$"])
+                    if cells:
+                        yield key, cells
+        finally:
+            self.request("DELETE", path)
+
 
 class HBLEvents(storage_base.LEvents):
     _CF = "e"
 
-    def __init__(self, transport: _HBaseRest, namespace: str):
+    def __init__(self, transport, namespace: str):
         self._t = transport
         self._ns = _safe_ident(namespace).lower()
         self._seq = MonotoneNs()
@@ -105,12 +223,12 @@ class HBLEvents(storage_base.LEvents):
         return name
 
     def _next_seq(self) -> int:
-        # Caveat vs the PG backend: the REST gateway has no cheap
-        # max-rowkey read to prime the counter from, so a wall clock
-        # stepped BACKWARDS between writer restarts can order an upsert
-        # below its pre-existing tie group (ties are otherwise
-        # insertion-ordered; simultaneous multi-writer ties are
-        # unspecified by the contract either way).
+        # Caveat vs the PG backend: HBase has no cheap max-rowkey read to
+        # prime the counter from, so a wall clock stepped BACKWARDS
+        # between writer restarts can order an upsert below its
+        # pre-existing tie group (ties are otherwise insertion-ordered;
+        # simultaneous multi-writer ties are unspecified by the contract
+        # either way).
         return self._seq.next()
 
     _time_us = staticmethod(event_time_us)
@@ -140,7 +258,9 @@ class HBLEvents(storage_base.LEvents):
         return cells
 
     def _scv(self, qualifier: str, value: str) -> dict:
-        """SingleColumnValueFilter(EQUAL) in the gateway's JSON spec.
+        """SingleColumnValueFilter(EQUAL) in the transport-neutral spec
+        (the Stargate JSON shape; the RPC transport re-serializes it to
+        Filter protos).
 
         ifMissing=False: rows LACKING the column pass the server filter
         and fall through to the client-side ``event_matches`` backstop.
@@ -187,58 +307,18 @@ class HBLEvents(storage_base.LEvents):
 
     # -- table lifecycle ---------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        status, _ = self._t.request(
-            "PUT", f"/{self._table(app_id, channel_id)}/schema",
-            body={"name": self._table(app_id, channel_id),
-                  "ColumnSchema": [{"name": self._CF}]})
-        if status not in (200, 201):
-            raise HBaseError(f"create table: HTTP {status}")
+        try:
+            self._t.create_table(self._table(app_id, channel_id))
+        except HBaseRpcError as e:
+            raise HBaseError(str(e)) from e
         return True
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        status, _ = self._t.request(
-            "DELETE", f"/{self._table(app_id, channel_id)}/schema")
-        return status in (200, 404)
-
-    # -- row helpers -------------------------------------------------------
-    def _put_cells(self, table: str, row_key: bytes,
-                   cells: dict[str, bytes]) -> None:
-        body = {"Row": [{
-            "key": _b64(row_key),
-            "Cell": [{"column": _b64(f"{self._CF}:{q}".encode()),
-                      "$": _b64(v)} for q, v in cells.items()],
-        }]}
-        row_q = urllib.parse.quote(row_key.decode(), safe="")
-        status, _ = self._t.request("PUT", f"/{table}/{row_q}", body=body)
-        if status == 404:
-            # auto-create on first write (contract: insert without init)
-            s, _ = self._t.request(
-                "PUT", f"/{table}/schema",
-                body={"name": table, "ColumnSchema": [{"name": self._CF}]})
-            if s in (200, 201):
-                status, _ = self._t.request(
-                    "PUT", f"/{table}/{row_q}", body=body)
-        if status not in (200, 201):
-            raise HBaseError(f"put {table}/{row_key!r}: HTTP {status}")
-
-    def _get_cells(self, table: str, row_key: bytes) -> Optional[dict]:
-        row_q = urllib.parse.quote(row_key.decode(), safe="")
-        status, out = self._t.request("GET", f"/{table}/{row_q}")
-        if status == 404 or not out:
-            return None
-        if status != 200:
-            raise HBaseError(f"get {table}/{row_key!r}: HTTP {status}")
-        cells = {}
-        for row in out.get("Row", []):
-            for cell in row.get("Cell", []):
-                col = _unb64(cell["column"]).decode()
-                cells[col.split(":", 1)[1]] = _unb64(cell["$"])
-        return cells or None
-
-    def _delete_row(self, table: str, row_key: bytes) -> bool:
-        row_q = urllib.parse.quote(row_key.decode(), safe="")
-        status, _ = self._t.request("DELETE", f"/{table}/{row_q}")
-        return status == 200
+        try:
+            self._t.delete_table(self._table(app_id, channel_id))
+        except HBaseRpcError as e:
+            raise HBaseError(str(e)) from e
+        return True
 
     # -- LEvents contract --------------------------------------------------
     def insert(self, event: Event, app_id: int,
@@ -250,49 +330,30 @@ class HBLEvents(storage_base.LEvents):
         if not fresh:
             # only client-supplied ids can collide (upsert); fresh uuids
             # skip the index round trip
-            old = self._get_cells(table, self._index_key(eid))
+            old = self._t.get_row(table, self._index_key(eid))
             if old and "k" in old:
-                self._delete_row(table, old["k"])
+                self._t.delete_row(table, old["k"])
         data_key = self._data_key(self._time_us(stored.event_time),
                                   self._next_seq())
-        self._put_cells(table, data_key, self._event_cells(stored))
-        self._put_cells(table, self._index_key(eid), {"k": data_key})
+        self._t.put_rows(table, [(data_key, self._event_cells(stored)),
+                                 (self._index_key(eid), {"k": data_key})])
         return eid
 
     def insert_batch(self, events: Sequence[Event], app_id: int,
                      channel_id: Optional[int] = None) -> list[str]:
-        """Bulk ingest via the gateway's multi-row PUT: one request per
-        chunk instead of 2-3 per event. Events carrying client-supplied
-        ids fall back to the upsert-aware single-insert path."""
+        """Bulk ingest via multi-row puts (the REST gateway's /batch, or
+        one Multi per region on RPC): one request per chunk instead of
+        2-3 per event. Events carrying client-supplied ids fall back to
+        the upsert-aware single-insert path."""
         table = self._table(app_id, channel_id)
         ids: list[str] = []
         CHUNK = 500
-        fresh: list[Event] = []
+        rows: list[tuple[bytes, dict[str, bytes]]] = []
 
         def flush():
-            if not fresh:
-                return
-            rows = []
-            for e in fresh:
-                data_key = self._data_key(self._time_us(e.event_time),
-                                          self._next_seq())
-                rows.append({"key": _b64(data_key), "Cell": [
-                    {"column": _b64(f"{self._CF}:{q}".encode()),
-                     "$": _b64(v)}
-                    for q, v in self._event_cells(e).items()]})
-                rows.append({"key": _b64(self._index_key(e.event_id)),
-                             "Cell": [{
-                                 "column": _b64(f"{self._CF}:k".encode()),
-                                 "$": _b64(data_key)}]})
-            status, _ = self._t.request(
-                "PUT", f"/{table}/batch", body={"Row": rows})
-            if status == 404:
-                self.init(app_id, channel_id)
-                status, _ = self._t.request(
-                    "PUT", f"/{table}/batch", body={"Row": rows})
-            if status not in (200, 201):
-                raise HBaseError(f"bulk put {table}: HTTP {status}")
-            fresh.clear()
+            if rows:
+                self._t.put_rows(table, rows)
+                rows.clear()
 
         for e in events:
             if e.event_id:
@@ -300,9 +361,13 @@ class HBLEvents(storage_base.LEvents):
                 ids.append(self.insert(e, app_id, channel_id))
             else:
                 eid = new_event_id()
-                fresh.append(e.with_event_id(eid))
+                stored = e.with_event_id(eid)
+                data_key = self._data_key(self._time_us(stored.event_time),
+                                          self._next_seq())
+                rows.append((data_key, self._event_cells(stored)))
+                rows.append((self._index_key(eid), {"k": data_key}))
                 ids.append(eid)
-                if len(fresh) >= CHUNK:
+                if len(rows) >= 2 * CHUNK:
                     flush()
         flush()
         return ids
@@ -310,10 +375,10 @@ class HBLEvents(storage_base.LEvents):
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         table = self._table(app_id, channel_id)
-        idx = self._get_cells(table, self._index_key(event_id))
+        idx = self._t.get_row(table, self._index_key(event_id))
         if not idx or "k" not in idx:
             return None
-        data = self._get_cells(table, idx["k"])
+        data = self._t.get_row(table, idx["k"])
         if not data or "json" not in data:
             return None
         return Event.from_json(json.loads(data["json"].decode()))
@@ -321,47 +386,46 @@ class HBLEvents(storage_base.LEvents):
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         table = self._table(app_id, channel_id)
-        idx = self._get_cells(table, self._index_key(event_id))
+        idx = self._t.get_row(table, self._index_key(event_id))
         if not idx or "k" not in idx:
             return False
-        self._delete_row(table, idx["k"])
-        self._delete_row(table, self._index_key(event_id))
+        self._t.delete_row(table, idx["k"])
+        self._t.delete_row(table, self._index_key(event_id))
         return True
 
-    def _scan(self, table: str, start_key: bytes, end_key: bytes,
-              batch: int = 1000,
-              hbase_filter: Optional[dict] = None) -> Iterator[Event]:
-        """Rowkey-range scan via the stateful scanner API; an optional
-        filter spec evaluates server-side (only matches cross the wire)."""
-        body = {"batch": batch, "startRow": _b64(start_key),
-                "endRow": _b64(end_key)}
-        if hbase_filter is not None:
-            # the gateway's scanner model carries the filter as a STRING
-            # holding the filter's own JSON serialization
-            body["filter"] = json.dumps(hbase_filter)
-        status, location = self._t.request(
-            "PUT", f"/{table}/scanner", body=body,
-            want_location=True)
-        if status == 404:
-            return
-        if status != 201 or not location:
-            raise HBaseError(f"open scanner on {table}: HTTP {status}")
-        path = urllib.parse.urlsplit(location).path
-        try:
-            while True:
-                status, out = self._t.request("GET", path)
-                if status == 204:
-                    return
-                if status != 200:
-                    raise HBaseError(f"scanner read: HTTP {status}")
-                for row in (out or {}).get("Row", []):
-                    for cell in row.get("Cell", []):
-                        col = _unb64(cell["column"]).decode()
-                        if col == f"{self._CF}:json":
-                            yield Event.from_json(
-                                json.loads(_unb64(cell["$"]).decode()))
-        finally:
-            self._t.request("DELETE", path)
+    def _scan_events(self, table: str, start_key: bytes, end_key: bytes,
+                     spec: Optional[dict],
+                     reverse: bool = False) -> Iterator[Event]:
+        for _key, cells in self._t.scan(table, start_key, end_key,
+                                        filter_spec=spec, reverse=reverse):
+            raw = cells.get("json")
+            if raw is not None:
+                yield Event.from_json(json.loads(raw.decode()))
+
+    def _scan_reversed_native(self, table: str, start_key: bytes,
+                              end_key: bytes,
+                              spec: Optional[dict]) -> Iterator[Event]:
+        """Stream the native reversed scanner while preserving the
+        contract order: time DESC but ties (same time) in insertion
+        (seq) ASC order.  Rows arrive (time DESC, seq DESC); buffering
+        one tie group — consecutive rows sharing the 17-hex time prefix
+        of the rowkey — and flipping it restores seq ASC within ties,
+        with memory bounded by the largest tie group instead of the
+        whole window (what the REST path has to materialize)."""
+        group: list[Event] = []
+        group_time: Optional[bytes] = None
+        for key, cells in self._t.scan(table, start_key, end_key,
+                                       filter_spec=spec, reverse=True):
+            raw = cells.get("json")
+            if raw is None:
+                continue
+            tkey = key[:19]      # b"t:" + 17-hex time
+            if tkey != group_time:
+                yield from reversed(group)
+                group = []
+                group_time = tkey
+            group.append(Event.from_json(json.loads(raw.decode())))
+        yield from reversed(group)
 
     def find(
         self,
@@ -388,31 +452,42 @@ class HBLEvents(storage_base.LEvents):
             return iter(())
         spec = self._filter_spec(entity_type, entity_id, event_names,
                                  target_entity_type, target_entity_id)
-        # event_matches stays as a semantic backstop: results are
-        # identical even against a gateway that ignores `filter`.
-        it = (
-            e for e in self._scan(table, start_key, end_key,
-                                  hbase_filter=spec)
-            if event_matches(e, start_time, until_time, entity_type,
-                             entity_id, event_names, target_entity_type,
-                             target_entity_id)
-        )
         if limit is not None and limit < 0:
             limit = None
-        if reversed_order:
-            # time DESC, tie (insertion) ASC — stable sort of the
-            # already time+seq-ascending stream. KNOWN LIMITATION: the
-            # REST gateway exposes no reversed scanner, so this
-            # materializes the whole matching window before slicing the
-            # limit; bound the scan with start_time/until_time for
-            # "latest N" queries on large apps.
-            events = sorted(it, key=lambda e: self._time_us(e.event_time),
-                            reverse=True)
-            yield from (events[:limit] if limit is not None else events)
-            return
-        import itertools
 
-        yield from (itertools.islice(it, limit) if limit is not None else it)
+        def matches(e: Event) -> bool:
+            # event_matches stays as a semantic backstop: results are
+            # identical even against a server that ignores the filter.
+            return event_matches(e, start_time, until_time, entity_type,
+                                 entity_id, event_names, target_entity_type,
+                                 target_entity_id)
+
+        try:
+            if reversed_order:
+                if getattr(self._t, "native_reverse", False):
+                    # RPC: the native reversed scanner streams — no
+                    # window materialization
+                    it = (e for e in self._scan_reversed_native(
+                        table, start_key, end_key, spec) if matches(e))
+                else:
+                    # REST: no reversed scanner — materialize the window
+                    # (time DESC, tie insertion ASC via stable sort).
+                    # Bound the scan with start_time/until_time for
+                    # "latest N" queries on large apps.
+                    events = sorted(
+                        (e for e in self._scan_events(
+                            table, start_key, end_key, spec)
+                         if matches(e)),
+                        key=lambda e: self._time_us(e.event_time),
+                        reverse=True)
+                    it = iter(events)
+            else:
+                it = (e for e in self._scan_events(
+                    table, start_key, end_key, spec) if matches(e))
+            yield from (itertools.islice(it, limit)
+                        if limit is not None else it)
+        except HBaseRpcError as e:
+            raise HBaseError(str(e)) from e
 
 
 class HBPEvents(storage_base.PEvents):
@@ -439,8 +514,11 @@ class HBPEvents(storage_base.PEvents):
 
 
 class HBaseClient(storage_base.BaseStorageClient):
-    """`TYPE=HBASE`; properties HOSTS (REST gateway host or URL), PORTS
-    (default 8080). Event data only — the reference's HBase role (the
+    """`TYPE=HBASE`; properties HOSTS (gateway/region-server host or
+    URL), PORTS, PROTOCOL (``rest`` default | ``rpc`` native), and for
+    rpc MASTER_HOST/MASTER_PORT (default: the HOSTS endpoint — the
+    HBase standalone topology where one process serves master + meta +
+    user regions).  Event data only — the reference's HBase role (the
     event store of record; metadata/models ride another source)."""
 
     def __init__(self, config: storage_base.StorageClientConfig):
@@ -449,12 +527,26 @@ class HBaseClient(storage_base.BaseStorageClient):
         host = (p.get("HOSTS") or "").split(",")[0].strip()
         if not host:
             raise ValueError(
-                "HBASE source needs PIO_STORAGE_SOURCES_<NAME>_HOSTS "
-                "(the HBase REST gateway)")
-        port = (p.get("PORTS") or "8080").split(",")[0].strip()
-        endpoint = host if "://" in host else f"http://{host}:{port}"
-        self._transport = _HBaseRest(endpoint)
+                "HBASE source needs PIO_STORAGE_SOURCES_<NAME>_HOSTS")
+        protocol = (p.get("PROTOCOL") or "rest").strip().lower()
+        if protocol == "rpc":
+            port = (p.get("PORTS") or "16020").split(",")[0].strip()
+            self._transport = HBaseRpcTransport(
+                host, int(port),
+                master_host=(p.get("MASTER_HOST") or "").strip() or None,
+                master_port=(p.get("MASTER_PORT") or "").strip() or None,
+                user=(p.get("USERNAME") or "pio").strip() or "pio")
+        elif protocol == "rest":
+            port = (p.get("PORTS") or "8080").split(",")[0].strip()
+            endpoint = host if "://" in host else f"http://{host}:{port}"
+            self._transport = _HBaseRest(endpoint)
+        else:
+            raise ValueError(
+                f"HBASE PROTOCOL must be 'rest' or 'rpc', got {protocol!r}")
         self._daos: dict = {}
+
+    def close(self) -> None:
+        self._transport.close()
 
     def l_events(self, namespace: str = "pio_eventdata"):
         dao = self._daos.get(namespace)

@@ -59,7 +59,7 @@ def _make_storage(kind, tmp_path):
 
 
 BACKENDS = ["memory", "sqlite", "mixed", "jsonl", "http", "s3",
-            "elasticsearch", "pgsql", "mysql", "hbase", "hdfs"]
+            "elasticsearch", "pgsql", "mysql", "hbase", "hbase_rpc", "hdfs"]
 
 
 @pytest.fixture(params=BACKENDS)
@@ -129,6 +129,32 @@ def storage(request, tmp_path):
                 "PIO_STORAGE_SOURCES_DFS_HOSTS": "127.0.0.1",
                 "PIO_STORAGE_SOURCES_DFS_PORTS": str(srv.port),
                 "PIO_STORAGE_SOURCES_DFS_PATH": "/pio/models",
+            }
+            s = Storage(env)
+            yield s
+            s.close()
+        return
+    if request.param == "hbase_rpc":
+        # Event data over HBase's NATIVE RPC protocol: protobuf-framed
+        # calls, hbase:meta region routing, Multi-batched puts, Filter
+        # protos pushed down, reversed scanners (hbase_rpc_mock.py) —
+        # the reference's own transport family; metadata+models on
+        # sqlite.  The event table is PRE-SPLIT so the contract runs
+        # against real multi-region routing, not a single region.
+        from hbase_rpc_mock import MockHBaseRpcServer
+
+        splits = {f"pio_eventdata_{app}": [b"t:8"] for app in range(1, 9)}
+        with MockHBaseRpcServer(split_keys=splits) as srv:
+            env = {
+                "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+                "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "HB",
+                "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+                "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+                "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "hbmeta.sqlite"),
+                "PIO_STORAGE_SOURCES_HB_TYPE": "HBASE",
+                "PIO_STORAGE_SOURCES_HB_HOSTS": "127.0.0.1",
+                "PIO_STORAGE_SOURCES_HB_PORTS": str(srv.port),
+                "PIO_STORAGE_SOURCES_HB_PROTOCOL": "rpc",
             }
             s = Storage(env)
             yield s
@@ -834,3 +860,147 @@ def test_hdfs_key_with_reserved_characters(tmp_path):
         assert models.get("id with space+plus").models == b"\x02blob"
         models.delete("id with space+plus")
         assert models.get("id with space+plus") is None
+
+
+def test_hbase_rpc_pushdown_multiregion_and_reversed(tmp_path):
+    """The native-RPC transport: filter protos evaluate server-side
+    (only matches cross the wire), rows route across a PRE-SPLIT
+    table's regions via hbase:meta, and reversed finds stream through
+    the native reversed scanner with the contract order preserved
+    (time DESC, ties in insertion ASC order)."""
+    from hbase_rpc_mock import MockHBaseRpcServer
+
+    from incubator_predictionio_tpu.data.storage.base import (
+        StorageClientConfig,
+    )
+    from incubator_predictionio_tpu.data.storage.event import event_time_us
+    from incubator_predictionio_tpu.data.storage.hbase import (
+        HBaseClient, HBLEvents,
+    )
+
+    split = HBLEvents._data_key(event_time_us(_ts(30)), 0)
+    with MockHBaseRpcServer(
+            split_keys={"pio_eventdata_77": [split]}) as srv:
+        client = HBaseClient(StorageClientConfig(properties={
+            "HOSTS": "127.0.0.1", "PORTS": str(srv.port),
+            "PROTOCOL": "rpc"}))
+        le = client.l_events()
+        evs = []
+        for k in range(60):
+            evs.append(Event("view", "user", str(k % 7), "item",
+                             str(k % 5), DataMap(), _ts(k)))
+        for k in range(8):
+            evs.append(Event("$set", "item", f"i{k}",
+                             properties=DataMap({"a": k}),
+                             event_time=_ts(100 + k)))
+        le.insert_batch(evs, 77)
+
+        # the split actually distributed data rows over BOTH regions
+        t = srv.tables["pio_eventdata_77"]
+        data_counts = [
+            sum(1 for k in t.region_rows(name) if k.startswith(b"t:"))
+            for _s, _e, name in t.regions]
+        assert all(c > 0 for c in data_counts), data_counts
+
+        # unfiltered find crosses the region boundary in time order
+        got = list(le.find(77))
+        assert len(got) == 68
+        times = [e.event_time for e in got]
+        assert times == sorted(times)
+
+        # pushdown: only the 8 matching rows cross the wire
+        srv.rows_served = 0
+        got = list(le.find(77, entity_type="item", event_names=["$set"]))
+        assert len(got) == 8
+        assert srv.rows_served == 8
+
+        srv.rows_served = 0
+        got = list(le.find(77, target_entity_id="3", event_names=["view"]))
+        assert {e.target_entity_id for e in got} == {"3"}
+        assert srv.rows_served == len(got) == 12
+
+        # reversed find: time DESC overall...
+        got = list(le.find(77, reversed_order=True))
+        times = [e.event_time for e in got]
+        assert times == sorted(times, reverse=True)
+        # ...and ties (same event_time) in INSERTION order — the native
+        # reversed scanner yields seq DESC; the streaming tie-group flip
+        # must restore the contract without materializing the window
+        ties = [Event("tie", "u", str(i), properties=DataMap(),
+                      event_time=_ts(200)) for i in range(5)]
+        le.insert_batch(ties, 77)
+        got = list(le.find(77, event_names=["tie"], reversed_order=True))
+        assert [e.entity_id for e in got] == ["0", "1", "2", "3", "4"]
+
+        # reversed + limit only transfers about a batch, not the window
+        got = list(le.find(77, reversed_order=True, limit=3))
+        assert len(got) == 3
+        assert got[0].event_time == _ts(200)
+        client.close()
+
+
+def test_hbase_rpc_region_retry_and_typed_errors(tmp_path):
+    """Stale-region retries are transparent (no loss, no duplication);
+    hard server faults surface as typed errors, never silent
+    truncation or hangs."""
+    import pytest as _pytest
+    from hbase_rpc_mock import MockHBaseRpcServer
+
+    from incubator_predictionio_tpu.data.storage.base import (
+        StorageClientConfig,
+    )
+    from incubator_predictionio_tpu.data.storage.hbase import (
+        HBaseClient, HBaseError,
+    )
+    from incubator_predictionio_tpu.data.storage.hbase_rpc import (
+        HBaseRpcError,
+    )
+
+    with MockHBaseRpcServer() as srv:
+        client = HBaseClient(StorageClientConfig(properties={
+            "HOSTS": "127.0.0.1", "PORTS": str(srv.port),
+            "PROTOCOL": "rpc"}))
+        le = client.l_events()
+        evs = [Event("view", "user", str(k), "item", str(k % 3),
+                     DataMap(), _ts(k)) for k in range(40)]
+        ids = le.insert_batch(evs, 5)
+        assert len(ids) == 40
+
+        # region "moves": every region answers NotServingRegionException
+        # to its next data op — the client must relocate+retry and still
+        # return every event exactly once
+        srv.notserving_once("pio_eventdata_5")
+        got = list(le.find(5))
+        assert len(got) == 40
+        assert len({e.event_id for e in got}) == 40
+
+        # ...same for point ops
+        srv.notserving_once("pio_eventdata_5")
+        assert le.get(ids[7], 5) is not None
+
+        # a mid-conversation UnknownScannerException is a typed error
+        srv.fail_next("Scan",
+                      "org.apache.hadoop.hbase.UnknownScannerException",
+                      do_not_retry=True)
+        with _pytest.raises(HBaseError, match="UnknownScanner"):
+            list(le.find(5))
+
+        # a malformed frame is a typed error, not a hang or misparse
+        srv.garbage_frame_next()
+        with _pytest.raises((HBaseError, HBaseRpcError)):
+            list(le.find(5))
+        # and the connection recovers for the next call
+        assert len(list(le.find(5))) == 40
+
+        # non-region write faults propagate typed with the Java class
+        # (an insert is a data+index Multi; a row delete is a Mutate)
+        srv.fail_next("Multi",
+                      "org.apache.hadoop.hbase.RegionTooBusyException")
+        with _pytest.raises(HBaseRpcError, match="RegionTooBusy"):
+            le.insert(Event("view", "user", "x", "item", "y",
+                            DataMap(), _ts(99)), 5)
+        srv.fail_next("Mutate",
+                      "org.apache.hadoop.hbase.RegionTooBusyException")
+        with _pytest.raises(HBaseRpcError, match="RegionTooBusy"):
+            le.delete(ids[0], 5)
+        client.close()
