@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import base64
 import datetime as _dt
+import functools
 import itertools
 import json
 import urllib.error
@@ -62,6 +63,20 @@ from .sqlite import _safe_ident
 
 class HBaseError(RuntimeError):
     pass
+
+
+def _rpc_wrapped(fn):
+    """Normalize transport errors: every LEvents entry point raises
+    HBaseError regardless of transport (the REST paths raise it
+    natively; RPC-level HBaseRpcError is translated here so callers
+    catch ONE backend error type)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except HBaseRpcError as e:
+            raise HBaseError(str(e)) from e
+    return wrapper
 
 
 def _b64(data: bytes) -> str:
@@ -118,8 +133,14 @@ class _HBaseRest:
             raise HBaseError(f"create table: HTTP {status}")
 
     def delete_table(self, table: str) -> bool:
+        """True when the table is gone on return (deleted, or 404 = was
+        never there); gateway failures RAISE — parity with the RPC
+        transport, so callers never mistake an orphaned table for a
+        removed one."""
         status, _ = self.request("DELETE", f"/{table}/schema")
-        return status == 200
+        if status not in (200, 404):
+            raise HBaseError(f"delete table {table}: HTTP {status}")
+        return True
 
     # -- rows --------------------------------------------------------------
     def _rows_body(self, rows: Sequence[tuple[bytes, dict[str, bytes]]]):
@@ -306,21 +327,17 @@ class HBLEvents(storage_base.LEvents):
                 "filters": clauses}
 
     # -- table lifecycle ---------------------------------------------------
+    @_rpc_wrapped
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        try:
-            self._t.create_table(self._table(app_id, channel_id))
-        except HBaseRpcError as e:
-            raise HBaseError(str(e)) from e
+        self._t.create_table(self._table(app_id, channel_id))
         return True
 
+    @_rpc_wrapped
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        try:
-            self._t.delete_table(self._table(app_id, channel_id))
-        except HBaseRpcError as e:
-            raise HBaseError(str(e)) from e
-        return True
+        return self._t.delete_table(self._table(app_id, channel_id))
 
     # -- LEvents contract --------------------------------------------------
+    @_rpc_wrapped
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
         table = self._table(app_id, channel_id)
@@ -339,6 +356,7 @@ class HBLEvents(storage_base.LEvents):
                                  (self._index_key(eid), {"k": data_key})])
         return eid
 
+    @_rpc_wrapped
     def insert_batch(self, events: Sequence[Event], app_id: int,
                      channel_id: Optional[int] = None) -> list[str]:
         """Bulk ingest via multi-row puts (the REST gateway's /batch, or
@@ -372,6 +390,7 @@ class HBLEvents(storage_base.LEvents):
         flush()
         return ids
 
+    @_rpc_wrapped
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         table = self._table(app_id, channel_id)
@@ -383,6 +402,7 @@ class HBLEvents(storage_base.LEvents):
             return None
         return Event.from_json(json.loads(data["json"].decode()))
 
+    @_rpc_wrapped
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         table = self._table(app_id, channel_id)

@@ -192,14 +192,18 @@ class HBaseRpcError(RuntimeError):
     """Typed RPC failure; remote exceptions carry the Java class name."""
 
     def __init__(self, message: str, exception_class: str = "",
-                 do_not_retry: bool = False):
+                 do_not_retry: bool = False, connection_lost: bool = False):
         super().__init__(message)
         self.exception_class = exception_class
         self.do_not_retry = do_not_retry
+        self.connection_lost = connection_lost
 
     @property
     def retriable_region(self) -> bool:
-        """Region-location staleness: relocate and retry."""
+        """Relocate and retry: region-location staleness, or a lost
+        connection (the retry reconnects — the cache was evicted)."""
+        if self.connection_lost:
+            return True
         short = self.exception_class.rsplit(".", 1)[-1]
         return short in ("NotServingRegionException", "RegionMovedException",
                          "RegionOpeningException")
@@ -246,7 +250,8 @@ class _Conn:
         while len(chunks) < n:
             part = self.sock.recv(n - len(chunks))
             if not part:
-                raise HBaseRpcError("connection closed by region server")
+                raise HBaseRpcError("connection closed by region server",
+                                    connection_lost=True)
             chunks += part
         return bytes(chunks)
 
@@ -264,12 +269,25 @@ class _Conn:
             self.sock.sendall(struct.pack(">I", len(frame)) + frame)
             total = struct.unpack(">I", self._recv(4))[0]
             buf = self._recv(total)
-        header_bytes, pos = read_delimited(buf, 0)
-        header = pb_decode(header_bytes)
+        # a frame that fails to PARSE means the stream framing can't be
+        # trusted anymore — mark connection_lost so the caller evicts
+        # this connection and retries on a fresh one (a server-reported
+        # exception below is a VALID response and stays non-connection)
+        try:
+            header_bytes, pos = read_delimited(buf, 0)
+            header = pb_decode(header_bytes)
+            body_fields: Optional[dict[int, list]] = None
+            if pos < len(buf):
+                body, _pos = read_delimited(buf, pos)
+                body_fields = pb_decode(body)
+        except HBaseRpcError as e:
+            raise HBaseRpcError(f"malformed response frame: {e}",
+                                connection_lost=True) from e
         got_id = _first(header, 1, -1)
         if got_id != call_id:
             raise HBaseRpcError(
-                f"response call_id {got_id} != request {call_id}")
+                f"response call_id {got_id} != request {call_id}",
+                connection_lost=True)
         exc = _first(header, 2)
         if exc is not None:
             e = pb_decode(exc)
@@ -279,10 +297,7 @@ class _Conn:
                 f"{cls}: {stack.splitlines()[0] if stack else method}",
                 exception_class=cls,
                 do_not_retry=bool(_first(e, 5, 0)))
-        if pos < len(buf):
-            body, _pos = read_delimited(buf, pos)
-            return pb_decode(body)
-        return {}
+        return body_fields if body_fields is not None else {}
 
     def close(self):
         if not self._closed:
@@ -352,22 +367,59 @@ class HBaseRpcTransport:
         key = (server[0], server[1], service)
         with self._lock:
             conn = self._conns.get(key)
-            if conn is None:
-                try:
-                    conn = _Conn(server[0], server[1], service, self._user,
-                                 self._timeout)
-                except OSError as e:
-                    raise HBaseRpcError(
-                        f"HBase region server unreachable: "
-                        f"{server[0]}:{server[1]} ({e})") from e
-                self._conns[key] = conn
-            return conn
-
-    def _drop_conn(self, server: tuple[str, int], service: str) -> None:
-        with self._lock:
-            conn = self._conns.pop((server[0], server[1], service), None)
         if conn is not None:
-            conn.close()
+            return conn
+        # connect OUTSIDE the lock: a black-holed server must not stall
+        # other threads' calls to healthy servers for the whole timeout
+        try:
+            fresh = _Conn(server[0], server[1], service, self._user,
+                          self._timeout)
+        except OSError as e:
+            raise HBaseRpcError(
+                f"HBase region server unreachable: "
+                f"{server[0]}:{server[1]} ({e})") from e
+        with self._lock:
+            existing = self._conns.get(key)
+            if existing is not None:
+                fresh.close()
+                return existing
+            self._conns[key] = fresh
+            return fresh
+
+    def _call(self, server: tuple[str, int], service: str, method: str,
+              param: "PB | bytes") -> dict[int, list]:
+        """One RPC with dead-connection hygiene: socket-level failures
+        become typed connection_lost errors (retriable — the retry
+        reconnects) and the broken connection is evicted so it can't
+        poison later calls or desync the length framing."""
+        conn = self._conn(server, service)
+        try:
+            return conn.call(method, param)
+        except HBaseRpcError as e:
+            if e.connection_lost:
+                self._drop_conn(server, service, conn)
+            raise
+        except OSError as e:
+            self._drop_conn(server, service, conn)
+            raise HBaseRpcError(
+                f"connection to {server[0]}:{server[1]} lost: {e}",
+                connection_lost=True) from e
+
+    def _drop_conn(self, server: tuple[str, int], service: str,
+                   conn: Optional[_Conn] = None) -> None:
+        """Evict a connection by IDENTITY: when `conn` is given, only
+        pop the cache entry if it still holds that same object — a
+        concurrent thread may already have replaced a dead connection
+        with a healthy one that must not be closed mid-use."""
+        key = (server[0], server[1], service)
+        with self._lock:
+            cached = self._conns.get(key)
+            if conn is not None and cached is not conn:
+                victim = conn        # close the failed conn, keep the cache
+            else:
+                victim = self._conns.pop(key, None)
+        if victim is not None:
+            victim.close()
 
     def close(self) -> None:
         with self._lock:
@@ -444,32 +496,33 @@ class HBaseRpcTransport:
                   .msg(3, PB().bytes_(1, self._family)))   # ColumnFamilySchema
         req = PB().msg(1, schema)
         try:
-            self._conn(self._master, "MasterService").call(
-                "CreateTable", req)
+            self._call(self._master, "MasterService", "CreateTable", req)
         except HBaseRpcError as e:
             if e.exception_class.rsplit(".", 1)[-1] != "TableExistsException":
                 raise
         self._invalidate(table)
 
     def delete_table(self, table: str) -> bool:
-        master = self._conn(self._master, "MasterService")
+        """True when the table is gone on return (deleted, or was never
+        there — idempotent removal); raises on real failures."""
         name = _table_name_pb(table)
         try:
-            master.call("DisableTable", PB().msg(1, name))
+            self._call(self._master, "MasterService", "DisableTable",
+                       PB().msg(1, name))
         except HBaseRpcError as e:
             short = e.exception_class.rsplit(".", 1)[-1]
             if short == "TableNotFoundException":
-                return False
-            if short != "TableNotDisabledException":
+                return True
+            if short not in ("TableNotDisabledException",
+                             "TableNotEnabledException"):
                 # already-disabled is fine; anything else is real
-                if short != "TableNotEnabledException":
-                    raise
+                raise
         try:
-            master.call("DeleteTable", PB().msg(1, name))
+            self._call(self._master, "MasterService", "DeleteTable",
+                       PB().msg(1, name))
         except HBaseRpcError as e:
-            if e.exception_class.rsplit(".", 1)[-1] == "TableNotFoundException":
-                return False
-            raise
+            if e.exception_class.rsplit(".", 1)[-1] != "TableNotFoundException":
+                raise
         self._invalidate(table)
         return True
 
@@ -544,7 +597,7 @@ class HBaseRpcTransport:
         def do(region: _Region):
             req = (PB().msg(1, _region_spec(region.name))
                    .msg(2, PB().bytes_(1, key)))
-            resp = self._conn(region.server, "ClientService").call("Get", req)
+            resp = self._call(region.server, "ClientService", "Get", req)
             result = _first(resp, 1)
             if result is None:
                 return None
@@ -561,8 +614,8 @@ class HBaseRpcTransport:
         def do(region: _Region):
             req = (PB().msg(1, _region_spec(region.name))
                    .msg(2, self._mutation_delete(key)))
-            resp = self._conn(region.server, "ClientService").call(
-                "Mutate", req)
+            resp = self._call(region.server, "ClientService",
+                              "Mutate", req)
             return bool(_first(resp, 2, 1))
         try:
             return bool(self._with_region_retry(table, key, do))
@@ -595,7 +648,7 @@ class HBaseRpcTransport:
             def do_one(region: _Region):
                 req = (PB().msg(1, _region_spec(region.name))
                        .msg(2, self._mutation_put(key, cells)))
-                self._conn(region.server, "ClientService").call("Mutate", req)
+                self._call(region.server, "ClientService", "Mutate", req)
             self._with_region_retry(table, key, do_one)
             return
         # group per region and send one Multi each; a stale location
@@ -630,8 +683,8 @@ class HBaseRpcTransport:
         for i, (key, cells) in enumerate(batch):
             action.msg(3, PB().varint(1, i)
                        .msg(2, self._mutation_put(key, cells)))
-        resp = self._conn(region.server, "ClientService").call(
-            "Multi", PB().msg(1, action))
+        resp = self._call(region.server, "ClientService", "Multi",
+                          PB().msg(1, action))
         for rar_bytes in resp.get(1, []):
             rar = pb_decode(rar_bytes)
             for exc in ([_first(rar, 2)]
@@ -708,12 +761,12 @@ class HBaseRpcTransport:
                 scan.bytes_(4, stop)
         if filter_spec is not None:
             scan.msg(5, self._filter_pb(filter_spec))
-        conn = self._conn(server, "ClientService")
         open_req = (PB().msg(1, _region_spec(region_name))
                     .msg(2, scan)
                     .varint(4, batch))
-        resp = conn.call("Scan", open_req)
+        resp = self._call(server, "ClientService", "Scan", open_req)
         scanner_id = _first(resp, 2)
+        broken = False
         try:
             while True:
                 for result_bytes in resp.get(5, []):
@@ -721,16 +774,33 @@ class HBaseRpcTransport:
                         pb_decode(result_bytes), all_families=all_families)
                     if cells:
                         yield row, cells
-                if not _first(resp, 3, 0):     # more_results
+                # Per-region termination: more_results_in_region (f8)
+                # is authoritative when present — real servers keep
+                # more_results (f3) TRUE after a region is exhausted
+                # because the scan as a whole may continue in the next
+                # region.  Only fall back to f3 for servers that never
+                # set f8 (pre-1.x wire behavior).
+                mrir = _first(resp, 8)
+                if mrir is not None:
+                    if not mrir:
+                        return
+                elif not _first(resp, 3, 0):   # more_results fallback
                     return
                 if scanner_id is None:
                     return
                 next_req = (PB().varint(3, scanner_id).varint(4, batch))
-                resp = conn.call("Scan", next_req)
+                resp = self._call(server, "ClientService", "Scan", next_req)
+        except HBaseRpcError as e:
+            # don't dial a NEW connection just to close a scanner whose
+            # session died with the old one — the server's scanner lease
+            # reclaims it, and the caller's retry must not wait behind a
+            # reconnect to a possibly black-holed server
+            broken = e.connection_lost
+            raise
         finally:
-            if scanner_id is not None:
+            if scanner_id is not None and not broken:
                 try:
-                    conn.call("Scan", PB().varint(3, scanner_id)
-                              .bool_(5, True))
+                    self._call(server, "ClientService", "Scan",
+                               PB().varint(3, scanner_id).bool_(5, True))
                 except HBaseRpcError:
                     pass     # close is best-effort (scanner may have expired)

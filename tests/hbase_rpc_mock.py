@@ -359,7 +359,17 @@ class _Handler(socketserver.BaseRequestHandler):
                     if k >= lo and (not hi or k < hi) and in_scan(k)]
             if reverse:
                 keys.reverse()
-            state = {"table": table, "keys": keys, "pos": 0, "filter": filt}
+            # does the SCAN (not just this region) end here?  Real
+            # servers set more_results=false only when the scan's stop
+            # row lies within this region's bounds; otherwise the scan
+            # continues in a neighboring region and they answer
+            # more_results=true + more_results_in_region=false.
+            if reverse:
+                ends_here = (not lo) or bool(stop_row and stop_row >= lo)
+            else:
+                ends_here = (not hi) or bool(stop_row and stop_row <= hi)
+            state = {"table": table, "keys": keys, "pos": 0, "filter": filt,
+                     "ends_here": ends_here}
             sid = next(srv.scanner_ids)
             srv.scanners[sid] = state
         self._send_scan_batch(call_id, sid, state, n_rows)
@@ -387,12 +397,16 @@ class _Handler(socketserver.BaseRequestHandler):
                                .bytes_(6, val))
                 body.msg(5, result)
                 sent += 1
-            more = state["pos"] < len(keys)
+            more_in_region = state["pos"] < len(keys)
             srv.rows_served += sent
-            if not more:
+            if not more_in_region:
                 srv.scanners.pop(scanner_id, None)
         body.varint(2, scanner_id)
-        body.bool_(3, more)
+        # the two-flag protocol: f3 stays TRUE while the scan may
+        # continue in ANOTHER region — clients must terminate the
+        # per-region loop on f8, not f3
+        body.bool_(3, more_in_region or not state["ends_here"])
+        body.bool_(8, more_in_region)
         self._send_response(call_id, body)
 
     # -- MasterService -----------------------------------------------------

@@ -936,6 +936,17 @@ def test_hbase_rpc_pushdown_multiregion_and_reversed(tmp_path):
         got = list(le.find(77, reversed_order=True, limit=3))
         assert len(got) == 3
         assert got[0].event_time == _ts(200)
+
+        # small-batch scans page through next-calls: the per-region
+        # loop must terminate on more_results_in_region (f8) — the mock
+        # keeps more_results (f3) TRUE while the scan continues in the
+        # neighboring region, like real servers
+        rows = [k for k, _ in client._transport.scan(
+            "pio_eventdata_77", b"t:", b"t;", batch=7)]
+        assert len(rows) == 73 and rows == sorted(rows)
+        rows_r = [k for k, _ in client._transport.scan(
+            "pio_eventdata_77", b"t:", b"t;", batch=7, reverse=True)]
+        assert rows_r == list(reversed(rows))
         client.close()
 
 
@@ -985,22 +996,22 @@ def test_hbase_rpc_region_retry_and_typed_errors(tmp_path):
         with _pytest.raises(HBaseError, match="UnknownScanner"):
             list(le.find(5))
 
-        # a malformed frame is a typed error, not a hang or misparse
+        # a malformed frame: the scan-level retry reconnects (the
+        # poisoned connection is evicted) and the find still completes
         srv.garbage_frame_next()
-        with _pytest.raises((HBaseError, HBaseRpcError)):
-            list(le.find(5))
-        # and the connection recovers for the next call
+        assert len(list(le.find(5))) == 40
+        # ...and the replacement connection keeps working
         assert len(list(le.find(5))) == 40
 
         # non-region write faults propagate typed with the Java class
         # (an insert is a data+index Multi; a row delete is a Mutate)
         srv.fail_next("Multi",
                       "org.apache.hadoop.hbase.RegionTooBusyException")
-        with _pytest.raises(HBaseRpcError, match="RegionTooBusy"):
+        with _pytest.raises(HBaseError, match="RegionTooBusy"):
             le.insert(Event("view", "user", "x", "item", "y",
                             DataMap(), _ts(99)), 5)
         srv.fail_next("Mutate",
                       "org.apache.hadoop.hbase.RegionTooBusyException")
-        with _pytest.raises(HBaseRpcError, match="RegionTooBusy"):
+        with _pytest.raises(HBaseError, match="RegionTooBusy"):
             le.delete(ids[0], 5)
         client.close()
