@@ -375,9 +375,14 @@ class HBaseRpcTransport:
             fresh = _Conn(server[0], server[1], service, self._user,
                           self._timeout)
         except OSError as e:
+            # connection_lost: a dead server is the COMMONEST reason a
+            # cached region location is stale — the relocate-and-retry
+            # path must fire for dial failures exactly as it does for
+            # mid-call socket loss
             raise HBaseRpcError(
                 f"HBase region server unreachable: "
-                f"{server[0]}:{server[1]} ({e})") from e
+                f"{server[0]}:{server[1]} ({e})",
+                connection_lost=True) from e
         with self._lock:
             existing = self._conns.get(key)
             if existing is not None:
@@ -474,14 +479,16 @@ class HBaseRpcTransport:
         half of HBase's region-move protocol."""
         last: Optional[HBaseRpcError] = None
         for attempt in range(3):
-            regions = self._locate(table, refresh=attempt > 0)
-            region = next((r for r in regions if r.contains(row)), None)
-            if region is None:
-                raise HBaseRpcError(
-                    f"no region of {table} contains row {row!r}")
             try:
+                regions = self._locate(table, refresh=attempt > 0)
+                region = next((r for r in regions if r.contains(row)), None)
+                if region is None:
+                    raise HBaseRpcError(
+                        f"no region of {table} contains row {row!r}")
                 return fn(region)
             except HBaseRpcError as e:
+                # the meta-scan half of the lookup is as retriable as
+                # the data op itself (same desync/dead-server causes)
                 if not e.retriable_region:
                     raise
                 last = e
@@ -656,17 +663,18 @@ class HBaseRpcTransport:
         # moved to different regions, not just different servers)
         last: Optional[HBaseRpcError] = None
         for attempt in range(3):
-            regions = self._locate(table, refresh=attempt > 0)
-            by_region: dict[bytes, list] = {}
-            region_of: dict[bytes, _Region] = {}
-            for key, cells in rows:
-                region = next((r for r in regions if r.contains(key)), None)
-                if region is None:
-                    raise HBaseRpcError(
-                        f"no region of {table} contains row {key!r}")
-                by_region.setdefault(region.name, []).append((key, cells))
-                region_of[region.name] = region
             try:
+                regions = self._locate(table, refresh=attempt > 0)
+                by_region: dict[bytes, list] = {}
+                region_of: dict[bytes, _Region] = {}
+                for key, cells in rows:
+                    region = next((r for r in regions if r.contains(key)),
+                                  None)
+                    if region is None:
+                        raise HBaseRpcError(
+                            f"no region of {table} contains row {key!r}")
+                    by_region.setdefault(region.name, []).append((key, cells))
+                    region_of[region.name] = region
                 for name, batch in by_region.items():
                     self._multi_put(region_of[name], batch)
                 return
@@ -715,6 +723,9 @@ class HBaseRpcTransport:
             except HBaseRpcError as e:
                 if e.table_missing:
                     return
+                if e.retriable_region and attempt < 2:
+                    self._invalidate(table)
+                    continue
                 raise
             overlapping = [r for r in regions
                            if r.overlaps(cur_start, cur_stop)]

@@ -48,8 +48,10 @@ OVERFLOW_LEN = 2048
 #: bucket count (= separate einsum programs inside the one jit) hurts
 #: compile time. Measured at ML-20M shape: 1.15 → mean padding 1.100
 #: (5+15 buckets), 1.05 → 1.052 (12+37 buckets) — ~4.6% fewer gathered
-#: rows.
-DEFAULT_LADDER_GROWTH = 1.15
+#: rows.  The r4 driver-verified A/B on the real chip ran 1.05 at
+#: 18.67M ev/s vs 1.15 at 17.56M (+6.3% end-to-end, compile time
+#: within noise), so 1.05 is the shipped default.
+DEFAULT_LADDER_GROWTH = 1.05
 
 
 def ladder_growth() -> float:
