@@ -196,19 +196,29 @@ def main() -> int:
         np.asarray(model0.factors.item_factors, np.float32))
     mask = jnp.zeros((real_items.shape[0],), bool)
     uv0 = jnp.asarray(rng.standard_normal(rank).astype(np.float32))
+    def _run_to_completion(reps):
+        carry, _ys = _looped_predict(uv0, real_items, mask, reps, 10)
+        # completion barrier MUST be a device_get: through the remote-
+        # PJRT tunnel block_until_ready can return before the device
+        # finishes (same protocol as train_als's timed path)
+        _ = jax.device_get(carry[:1])
+
+    # the per-query on-chip cost is O(10 us) — far below tunnel RTT
+    # noise — so the rep spread must be wide enough that the extra
+    # device work clears the +-few-ms dispatch jitter
+    r_lo, r_hi = 64, 4096
     slope_times = {}
-    for reps in (8, 64):
-        jax.block_until_ready(_looped_predict(uv0, real_items, mask, reps, 10))
+    for reps in (r_lo, r_hi):
+        _run_to_completion(reps)
         t0 = time.perf_counter()
         for _r in range(5):
-            jax.block_until_ready(
-                _looped_predict(uv0, real_items, mask, reps, 10))
+            _run_to_completion(reps)
         slope_times[reps] = (time.perf_counter() - t0) / 5
-    onchip_ms = (slope_times[64] - slope_times[8]) / (64 - 8) * 1000
+    onchip_ms = (slope_times[r_hi] - slope_times[r_lo]) / (r_hi - r_lo) * 1000
     log(f"[qbench] ON-CHIP predict (matvec+top_k @ {real_items.shape}) = "
         f"{onchip_ms:.3f}ms/query (dispatch-amortized scan slope; "
-        f"single-dispatch walls: 8reps {slope_times[8]*1000:.1f}ms, "
-        f"64reps {slope_times[64]*1000:.1f}ms)")
+        f"single-dispatch walls: {r_lo}reps {slope_times[r_lo]*1000:.1f}ms, "
+        f"{r_hi}reps {slope_times[r_hi]*1000:.1f}ms)")
     trace_dir = os.environ.get("PIO_QBENCH_TRACE_DIR")
     if trace_dir:
         with jax.profiler.trace(trace_dir):

@@ -320,14 +320,22 @@ def run_decomposition() -> dict:
         def f(dx, dy, dw):
             feat, counts = _nb_stats(dx, dy, dw, c)
             for i in range(reps - 1):
-                # data dependency defeats CSE/DCE: reweight by a scalar
-                # derived from the previous result
-                wi = dw * (1.0 + 0.0 * counts.sum())
+                # data dependency defeats CSE/DCE: perturb the weights
+                # by a scalar derived from the previous result (a
+                # NON-FOLDABLE coefficient — `0.0 * x` would simplify
+                # away and let XLA collapse the chain)
+                wi = dw + 1e-9 * counts.sum()
                 feat, counts = _nb_stats(dx, dy, wi, c)
             return feat, counts
-        jax.block_until_ready(f(dx, dy, dw))      # compile
+
+        def run():
+            feat, _counts = f(dx, dy, dw)
+            # device_get is the only reliable completion barrier through
+            # the remote-PJRT tunnel (block_until_ready returns early)
+            _ = jax.device_get(feat[:1, :1])
+        run()                                     # compile
         t0 = time.perf_counter()
-        jax.block_until_ready(f(dx, dy, dw))
+        run()
         return time.perf_counter() - t0
 
     jax.block_until_ready(once(dx, dy, dw))

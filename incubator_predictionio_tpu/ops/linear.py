@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, default_mesh, pad_rows
+from ..parallel.mesh import DATA_AXIS, default_mesh, fast_put, pad_rows
 
 
 # ---------------------------------------------------------------------------
@@ -93,9 +93,9 @@ def train_naive_bayes(
     xp, yp, wp = pad_rows(x, n_dev), pad_rows(y, n_dev), pad_rows(w, n_dev)
     shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
     shard1 = NamedSharding(mesh, P(DATA_AXIS))
-    xp = jax.device_put(xp, shard2)
-    yp = jax.device_put(yp, shard1)
-    wp = jax.device_put(wp, shard1)
+    xp = fast_put(xp, shard2)
+    yp = fast_put(yp, shard1)
+    wp = fast_put(wp, shard1)
     feat, counts = jax.device_get(_nb_stats(xp, yp, wp, n_classes))
     if col_scale is not None:
         feat = feat * np.asarray(col_scale, np.float32)
@@ -210,9 +210,9 @@ def train_logistic_regression(
     yp = pad_rows(y, n_dev)
     shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
     shard1 = NamedSharding(mesh, P(DATA_AXIS))
-    xp = jax.device_put(xp, shard2)
-    yp = jax.device_put(yp, shard1)
-    maskp = jax.device_put(mask, shard1)
+    xp = fast_put(xp, shard2)
+    yp = fast_put(yp, shard1)
+    maskp = fast_put(mask, shard1)
 
     params = _lr_fit(xp, yp, maskp, jnp.float32(n), jnp.float32(reg),
                      jnp.float32(tol), jnp.int32(max_iters), n_classes)
