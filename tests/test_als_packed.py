@@ -1,0 +1,120 @@
+"""Packed slab transfers + the device-resident slab cache.
+
+Single-device meshes upload the layout's per-bucket slabs as 2-3
+dtype-grouped buffers and unpack them as static slices inside the
+jitted loop (ops/als.py _pack_flat — the remote-PJRT tunnel pays a
+per-transfer cost that made the upload, not the device math, dominate
+warm implicit-ALS trains). These tests pin:
+
+- numerical identity: the packed single-device path solves the same
+  problem as the per-slab multi-device path (same factors within
+  reduction-order tolerance);
+- the content-hash device cache: repeat trains over identical data
+  reuse device buffers (no re-upload), changed data misses, and a
+  changed regularization re-uploads only the (tiny) lam slab while the
+  big index slabs still hit.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from incubator_predictionio_tpu.ops import als as als_mod
+from incubator_predictionio_tpu.ops.als import ALSParams, train_als
+from incubator_predictionio_tpu.parallel.mesh import (
+    default_mesh, mesh_from_devices,
+)
+
+
+def _data(nnz=20_000, n_users=500, n_items=200, seed=0, binary=False):
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = rng.integers(0, n_items, nnz).astype(np.int32)
+    r = (np.ones(nnz, np.float32) if binary
+         else (rng.random(nnz).astype(np.float32) * 4 + 1))
+    return u, i, r
+
+
+@pytest.mark.parametrize("binary", [False, True])
+def test_packed_single_device_matches_multi_device(binary):
+    u, i, r = _data(binary=binary)
+    params = ALSParams(rank=8, num_iterations=3, reg=0.1, seed=1,
+                       implicit_prefs=binary, alpha=1.0,
+                       compute_dtype="float32")
+    m1 = mesh_from_devices(devices=[jax.devices()[0]])
+    assert m1.devices.size == 1  # the packed path
+    f1 = train_als(u, i, r, n_users=500, n_items=200, params=params,
+                   mesh=m1)
+    f8 = train_als(u, i, r, n_users=500, n_items=200, params=params,
+                   mesh=default_mesh())
+    np.testing.assert_allclose(f1.user_factors, f8.user_factors,
+                               rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(f1.item_factors, f8.item_factors,
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_device_slab_cache_hits_and_misses(monkeypatch):
+    als_mod._dev_buf_cache.clear()
+    als_mod._dev_buf_cache_order.clear()
+    puts = []
+    real_put = jax.device_put
+
+    def counting_put(x, target=None):
+        puts.append(np.asarray(x).nbytes if hasattr(x, "nbytes") else 0)
+        return real_put(x, target)
+
+    monkeypatch.setattr(als_mod.jax, "device_put", counting_put)
+    u, i, r = _data()
+    params = ALSParams(rank=8, num_iterations=2, reg=0.1, seed=1,
+                       compute_dtype="float32")
+    m1 = mesh_from_devices(devices=[jax.devices()[0]])
+
+    train_als(u, i, r, n_users=500, n_items=200, params=params, mesh=m1)
+    n_first = len(puts)
+    assert n_first > 0
+
+    # identical data + params: every slab hits; only x0/y0 re-put
+    puts.clear()
+    train_als(u, i, r, n_users=500, n_items=200, params=params, mesh=m1)
+    assert len(puts) == 2  # the factor inits (x0, y0), nothing else
+
+    # changed reg: the lam slab (small f4) misses, index slabs hit
+    puts.clear()
+    params2 = ALSParams(rank=8, num_iterations=2, reg=0.5, seed=1,
+                        compute_dtype="float32")
+    train_als(u, i, r, n_users=500, n_items=200, params=params2, mesh=m1)
+    assert len(puts) == 3  # x0, y0, and the re-hashed f4 buffer
+
+    # changed ratings: the value-carrying buffer misses too
+    puts.clear()
+    r2 = r.copy()
+    r2[0] += 1.0
+    train_als(u, i, r2, n_users=500, n_items=200, params=params, mesh=m1)
+    assert len(puts) >= 3
+
+    # PIO_ALS_DEVICE_CACHE=0 disables caching entirely
+    als_mod._dev_buf_cache.clear()
+    als_mod._dev_buf_cache_order.clear()
+    monkeypatch.setenv("PIO_ALS_DEVICE_CACHE", "0")
+    puts.clear()
+    train_als(u, i, r, n_users=500, n_items=200, params=params, mesh=m1)
+    first = len(puts)
+    puts.clear()
+    train_als(u, i, r, n_users=500, n_items=200, params=params, mesh=m1)
+    assert len(puts) == first  # no reuse
+    assert not als_mod._dev_buf_cache
+
+
+def test_device_slab_cache_evicts_over_budget(monkeypatch):
+    als_mod._dev_buf_cache.clear()
+    als_mod._dev_buf_cache_order.clear()
+    monkeypatch.setattr(als_mod, "_DEV_BUF_CACHE_BYTES", 1024)
+    dev = jax.devices()[0]
+    a = np.arange(200, dtype=np.int32)      # 800 B
+    b = np.arange(100, dtype=np.int32)      # 400 B
+    als_mod._cached_dev_put(a, dev)
+    als_mod._cached_dev_put(b, dev)         # 1200 B > 1024 → evict a
+    assert len(als_mod._dev_buf_cache) == 1
+    # the survivor is b
+    ((key, _arr),) = als_mod._dev_buf_cache.items()
+    assert key[2] == b.shape
