@@ -13,7 +13,7 @@ Wire format (template parity):
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -23,6 +23,7 @@ from ..ops.linear import (
     NaiveBayesModel,
     train_logistic_regression,
     train_naive_bayes,
+    train_naive_bayes_coo,
 )
 from ..ops.tfidf import TfIdfVectorizer
 
@@ -39,7 +40,7 @@ class TrainingData(SanityCheck):
 
 @dataclasses.dataclass
 class PreparedData:
-    features: np.ndarray  # [N, D] tf-idf (or raw tf, see flag)
+    features: Optional[np.ndarray]  # [N, D] tf-idf / raw tf, or None (COO)
     labels: np.ndarray
     label_values: np.ndarray
     vectorizer: TfIdfVectorizer
@@ -47,6 +48,23 @@ class PreparedData:
     #: is applied inside the trainer (commutes with NB's stats
     #: reduction — skips materializing the scaled [N,D] matrix)
     features_are_tf: bool = False
+    #: COO representation (ops/tfidf.fit_tf_coo): (doc_ptr, feat, cnt).
+    #: The preparator emits THIS by default — NB trains straight from
+    #: it (device segment-sum; the dense matrix never exists) and the
+    #: LR path densifies on demand via dense_tf().
+    coo: Optional[tuple] = None
+
+    def dense_tf(self) -> np.ndarray:
+        """Materialize the raw-tf matrix from the COO (LR needs the
+        full per-doc rows; NB never calls this)."""
+        if self.features is not None:
+            return self.features
+        doc_ptr, feat, cnt = self.coo
+        n, d = len(doc_ptr) - 1, self.vectorizer.n_features
+        x = np.zeros((n, d), np.float32)
+        rows = np.repeat(np.arange(n), np.diff(np.asarray(doc_ptr)))
+        x[rows, feat] = cnt
+        return x
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,9 +136,9 @@ class TextPreparator:
         vec = TfIdfVectorizer(
             n_features=self.params.n_features, ngram=self.params.ngram
         )
-        tf = vec.fit_tf(td.texts)
-        return PreparedData(tf, td.labels, td.label_values, vec,
-                            features_are_tf=True)
+        coo = vec.fit_tf_coo(td.texts)
+        return PreparedData(None, td.labels, td.label_values, vec,
+                            features_are_tf=True, coo=coo)
 
 
 @dataclasses.dataclass
@@ -153,12 +171,23 @@ class TextNBAlgorithm(Algorithm):
     params_aliases = {"lambda": "smoothing", "regParam": "reg"}
 
     def train(self, ctx, pd: PreparedData) -> TextModel:
-        inner = train_naive_bayes(
-            pd.features, pd.labels, len(pd.label_values),
-            smoothing=self.params.smoothing,
-            mesh=ctx.get_mesh() if ctx else None,
-            col_scale=(pd.vectorizer.idf if pd.features_are_tf else None),
-        )
+        mesh = ctx.get_mesh() if ctx else None
+        scale = pd.vectorizer.idf if pd.features_are_tf else None
+        if pd.coo is not None:
+            doc_ptr, feat, cnt = pd.coo
+            inner = train_naive_bayes_coo(
+                doc_ptr, feat, cnt, pd.labels,
+                n_classes=len(pd.label_values),
+                n_features=pd.vectorizer.n_features,
+                smoothing=self.params.smoothing,
+                mesh=mesh, col_scale=scale,
+            )
+        else:
+            inner = train_naive_bayes(
+                pd.features, pd.labels, len(pd.label_values),
+                smoothing=self.params.smoothing,
+                mesh=mesh, col_scale=scale,
+            )
         return TextModel(inner, pd.vectorizer, pd.label_values)
 
     def predict(self, model: TextModel, query: dict) -> dict:
@@ -168,7 +197,7 @@ class TextNBAlgorithm(Algorithm):
 
 class TextLRAlgorithm(TextNBAlgorithm):
     def train(self, ctx, pd: PreparedData) -> TextModel:
-        features = pd.features
+        features = pd.dense_tf()
         if pd.features_are_tf:
             # LR is nonlinear in x — the idf scale can't fold into the
             # stats like NB's; one explicit scaled materialization

@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 11
+_EXPECTED_VERSION = 12
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -121,6 +121,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int32,                   # n_features
         ctypes.c_int32,                   # ngram
         ctypes.POINTER(ctypes.c_float),   # out [n_docs, n_features]
+        ctypes.POINTER(ctypes.c_int64),   # df [n_features] or NULL
+    ]
+    lib.pio_tfidf_tf_coo.restype = ctypes.c_int64
+    lib.pio_tfidf_tf_coo.argtypes = [
+        ctypes.c_char_p,                  # concatenated utf-8 docs
+        ctypes.POINTER(ctypes.c_int64),   # offsets [n_docs + 1]
+        ctypes.c_int64,                   # n_docs
+        ctypes.c_int32,                   # n_features
+        ctypes.c_int32,                   # ngram
+        ctypes.c_int64,                   # cap
+        ctypes.POINTER(ctypes.c_int64),   # doc_ptr [n_docs + 1]
+        ctypes.POINTER(ctypes.c_int32),   # feat_out [cap]
+        ctypes.POINTER(ctypes.c_float),   # cnt_out [cap]
         ctypes.POINTER(ctypes.c_int64),   # df [n_features] or NULL
     ]
     return lib
@@ -374,6 +387,44 @@ def fill_entries(row: np.ndarray, col: np.ndarray, val, col_slot_map,
     if rc != 0:
         raise ValueError(
             f"fill_entries: {_FILL_ERRORS.get(rc, f'error {rc}')}")
+
+
+def tfidf_tf_coo(docs, n_features: int, ngram: int,
+                 want_df: bool = False):
+    """Native per-doc (feature, count) pairs — the COO twin of
+    ``tfidf_tf`` (see pio_tfidf_tf_coo in event_codec.cc). The dense
+    [N, D] matrix never exists: linear trainers reduce over docs, so
+    only the ~150 distinct buckets per doc need to leave the tokenizer
+    (or cross an accelerator link). Returns
+    ``(doc_ptr [N+1] int64, feat [nnz] int32, counts [nnz] float32)``
+    (+ ``df`` when requested), entries per doc in ascending bucket id.
+    """
+    lib = _load()
+    enc = [d.encode(errors="replace") for d in docs]
+    offs = np.zeros(len(enc) + 1, np.int64)
+    np.cumsum([len(e) for e in enc], out=offs[1:])
+    buf = b"".join(enc)
+    # nnz is bounded by token occurrences; every token is >=1 byte with
+    # >=0 separators, and each of the (ngram-1) extra orders adds at
+    # most one occurrence per token position
+    cap = (len(buf) // 2 + len(enc) + 1) * ngram + 1
+    doc_ptr = np.zeros(len(enc) + 1, np.int64)
+    feat = np.empty(cap, np.int32)
+    cnt = np.empty(cap, np.float32)
+    df = np.zeros(n_features, np.int64) if want_df else None
+    nnz = lib.pio_tfidf_tf_coo(
+        buf, offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(enc), n_features, ngram, cap,
+        doc_ptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        feat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        cnt.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        (df.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+         if df is not None else None),
+    )
+    if nnz < 0:
+        raise ValueError(f"tfidf_tf_coo: native tokenizer error {nnz}")
+    out = (doc_ptr, feat[:nnz].copy(), cnt[:nnz].copy())
+    return out + (df,) if want_df else out
 
 
 def tfidf_tf(docs, n_features: int, ngram: int,

@@ -56,6 +56,29 @@ class TfIdfVectorizer:
         state["_hash_cache"] = {}
         return state
 
+    def _doc_hashed_indices(self, doc: str) -> Optional[np.ndarray]:
+        """Hashed bucket id per token occurrence of one doc, through
+        the memoized token→bucket cache — the ONE Python tokenizer loop
+        (term_frequencies and the COO fallback both consume it, keeping
+        them bit-identical to each other and to the native passes)."""
+        toks = tokenize(doc, self.ngram)
+        if not toks:
+            return None
+        D = self.n_features
+        cache = self._hash_cache
+        idxs = np.empty(len(toks), np.int64)
+        for j, tok in enumerate(toks):
+            h = cache.get(tok)
+            if h is None:
+                h = _hash_token(tok, D)
+                # Cap: transform() runs per serving query on arbitrary
+                # user text — an uncapped cache grows monotonically
+                # until OOM on a long-lived server.
+                if len(cache) < 1_000_000:
+                    cache[tok] = h
+            idxs[j] = h
+        return idxs
+
     def term_frequencies(self, docs: Sequence[str],
                          use_native: bool | None = None,
                          want_df: bool = False):
@@ -75,26 +98,52 @@ class TfIdfVectorizer:
                 if use_native is True:
                     raise
         x = np.zeros((len(docs), D), np.float32)
-        cache = self._hash_cache
         for row, doc in enumerate(docs):
-            toks = tokenize(doc, self.ngram)
-            if not toks:
-                continue
-            idxs = np.empty(len(toks), np.int64)
-            for j, tok in enumerate(toks):
-                h = cache.get(tok)
-                if h is None:
-                    h = _hash_token(tok, D)
-                    # Cap: transform() runs per serving query on
-                    # arbitrary user text — an uncapped cache grows
-                    # monotonically until OOM on a long-lived server.
-                    if len(cache) < 1_000_000:
-                        cache[tok] = h
-                idxs[j] = h
-            x[row] = np.bincount(idxs, minlength=D)
+            idxs = self._doc_hashed_indices(doc)
+            if idxs is not None:
+                x[row] = np.bincount(idxs, minlength=D)
         if want_df:
             return x, np.count_nonzero(x, axis=0).astype(np.int64)
         return x
+
+    def fit_tf_coo(self, docs: Sequence[str]):
+        """Fit the IDF and return per-doc (feature, count) pairs —
+        ``(doc_ptr [N+1], feat [nnz] int32, counts [nnz] float32)`` in
+        ascending bucket order per doc — WITHOUT materializing the
+        dense [N, D] matrix anywhere. Linear trainers reduce over docs,
+        so the token-level COO (~150 distinct buckets/doc) is all that
+        ever needs to exist on the host or cross the accelerator link
+        (models/text_classification.TextNBAlgorithm trains straight
+        from this via a device segment-sum)."""
+        D = self.n_features
+        try:
+            from ..native import NativeUnavailable, tfidf_tf_coo
+            doc_ptr, feat, counts, df = tfidf_tf_coo(
+                docs, D, self.ngram, want_df=True)
+        except NativeUnavailable:
+            doc_ptr = np.zeros(len(docs) + 1, np.int64)
+            feats = []
+            cnts = []
+            df = np.zeros(D, np.int64)
+            for row, doc in enumerate(docs):
+                idxs = self._doc_hashed_indices(doc)
+                added = 0
+                if idxs is not None:
+                    # sparse per-doc aggregation (ascending, like C++) —
+                    # no D-length scratch per doc
+                    nz, nz_counts = np.unique(idxs, return_counts=True)
+                    feats.append(nz.astype(np.int32))
+                    cnts.append(nz_counts.astype(np.float32))
+                    df[nz] += 1
+                    added = len(nz)
+                doc_ptr[row + 1] = doc_ptr[row] + added
+            feat = (np.concatenate(feats) if feats
+                    else np.empty(0, np.int32))
+            counts = (np.concatenate(cnts) if cnts
+                      else np.empty(0, np.float32))
+        n = len(docs)
+        self.idf = np.log((n + 1.0) / (df + 1.0)).astype(np.float32)
+        return doc_ptr, feat, counts
 
     def fit_tf(self, docs: Sequence[str]) -> np.ndarray:
         """Fit the IDF and return the RAW term-frequency matrix without

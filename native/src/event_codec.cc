@@ -36,6 +36,7 @@
 #include <cstring>
 #include <string>
 #include <unordered_map>
+#include <algorithm>
 #include <vector>
 
 namespace {
@@ -547,7 +548,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 11; }
+int32_t pio_codec_version() { return 12; }
 
 namespace {
 // FNV-1a over a byte range, continuing from a running state.
@@ -575,60 +576,127 @@ inline bool is_token_byte(unsigned char c) {
 // each bucket — for free during the fill, so the IDF fit needs no
 // second full pass over the [N,D] matrix. Returns 0, or -1 on invalid
 // offsets.
+namespace {
+// Tokenize one doc's byte range and append the hashed bucket id of
+// EVERY token occurrence (unigrams, then each n-gram order) to `out`.
+// The ONE source of truth for the token byte class, lowercasing, and
+// FNV-1a hashing — the dense and COO fills below differ only in how
+// they consume this stream, which is what keeps them bit-identical.
+inline void hash_doc_tokens(const char* buf, int64_t b0, int64_t b1,
+                            uint32_t nf, int32_t ngram,
+                            std::vector<char>& low,
+                            std::vector<int64_t>& tok_s,
+                            std::vector<int64_t>& tok_e,
+                            std::vector<uint32_t>& out) {
+  low.clear();
+  tok_s.clear();
+  tok_e.clear();
+  out.clear();
+  low.reserve(b1 - b0);
+  bool in_tok = false;
+  for (int64_t p = b0; p < b1; ++p) {
+    unsigned char c = static_cast<unsigned char>(buf[p]);
+    if (is_token_byte(c)) {
+      if (!in_tok) {
+        tok_s.push_back(static_cast<int64_t>(low.size()));
+        in_tok = true;
+      }
+      low.push_back(c >= 'A' && c <= 'Z' ? c + 32 : c);
+    } else if (in_tok) {
+      tok_e.push_back(static_cast<int64_t>(low.size()));
+      in_tok = false;
+    }
+  }
+  if (in_tok) tok_e.push_back(static_cast<int64_t>(low.size()));
+  // n_features is 4096 by default — mask instead of divide when pow2
+  const uint32_t mask = (nf & (nf - 1)) == 0 ? nf - 1 : 0;
+  const int64_t nt = static_cast<int64_t>(tok_s.size());
+  for (int64_t j = 0; j < nt; ++j) {
+    uint32_t h = fnv1a(kFnvInit, low.data() + tok_s[j], tok_e[j] - tok_s[j]);
+    out.push_back(mask ? (h & mask) : (h % nf));
+  }
+  for (int32_t n = 2; n <= ngram; ++n) {
+    for (int64_t j = 0; j + n <= nt; ++j) {
+      uint32_t h = kFnvInit;
+      for (int32_t q = 0; q < n; ++q) {
+        if (q) h = (h ^ static_cast<uint32_t>(' ')) * 16777619u;
+        h = fnv1a(h, low.data() + tok_s[j + q], tok_e[j + q] - tok_s[j + q]);
+      }
+      out.push_back(mask ? (h & mask) : (h % nf));
+    }
+  }
+}
+}  // namespace
+
 int32_t pio_tfidf_tf(const char* buf, const int64_t* offs, int64_t n_docs,
                      int32_t n_features, int32_t ngram, float* out,
                      int64_t* df) {
   if (n_features <= 0 || ngram < 1) return -1;
-  std::vector<char> low;        // lowercased doc bytes
-  std::vector<int64_t> tok_s;   // token start in `low`
-  std::vector<int64_t> tok_e;   // token end in `low`
+  std::vector<char> low;
+  std::vector<int64_t> tok_s;
+  std::vector<int64_t> tok_e;
+  std::vector<uint32_t> hashes;
   for (int64_t d = 0; d < n_docs; ++d) {
     const int64_t b0 = offs[d], b1 = offs[d + 1];
     if (b0 < 0 || b1 < b0) return -1;
-    low.clear();
-    tok_s.clear();
-    tok_e.clear();
-    low.reserve(b1 - b0);
-    bool in_tok = false;
-    for (int64_t p = b0; p < b1; ++p) {
-      unsigned char c = static_cast<unsigned char>(buf[p]);
-      if (is_token_byte(c)) {
-        if (!in_tok) {
-          tok_s.push_back(static_cast<int64_t>(low.size()));
-          in_tok = true;
-        }
-        low.push_back(c >= 'A' && c <= 'Z' ? c + 32 : c);
-      } else if (in_tok) {
-        tok_e.push_back(static_cast<int64_t>(low.size()));
-        in_tok = false;
-      }
-    }
-    if (in_tok) tok_e.push_back(static_cast<int64_t>(low.size()));
-    // n_features is 4096 by default — mask instead of divide when pow2
-    const uint32_t nf = static_cast<uint32_t>(n_features);
-    const uint32_t mask = (nf & (nf - 1)) == 0 ? nf - 1 : 0;
+    hash_doc_tokens(buf, b0, b1, static_cast<uint32_t>(n_features), ngram,
+                    low, tok_s, tok_e, hashes);
     float* row = out + d * static_cast<int64_t>(n_features);
-    const int64_t nt = static_cast<int64_t>(tok_s.size());
-    for (int64_t j = 0; j < nt; ++j) {
-      uint32_t h = fnv1a(kFnvInit, low.data() + tok_s[j], tok_e[j] - tok_s[j]);
-      const uint32_t idx = mask ? (h & mask) : (h % nf);
+    for (uint32_t idx : hashes) {
       if (df != nullptr && row[idx] == 0.0f) df[idx]++;
       row[idx] += 1.0f;
     }
-    for (int32_t n = 2; n <= ngram; ++n) {
-      for (int64_t j = 0; j + n <= nt; ++j) {
-        uint32_t h = kFnvInit;
-        for (int32_t q = 0; q < n; ++q) {
-          if (q) h = (h ^ static_cast<uint32_t>(' ')) * 16777619u;
-          h = fnv1a(h, low.data() + tok_s[j + q], tok_e[j + q] - tok_s[j + q]);
-        }
-        const uint32_t idx = mask ? (h & mask) : (h % nf);
-        if (df != nullptr && row[idx] == 0.0f) df[idx]++;
-        row[idx] += 1.0f;
-      }
-    }
   }
   return 0;
+}
+
+// COO variant of pio_tfidf_tf: per-doc (feature, count) pairs instead
+// of dense [N, D] rows — the linear trainers reduce over docs anyway,
+// so the dense matrix (which at corpus scale dwarfs the token stream:
+// ~150 distinct buckets/doc vs D=4096 columns) never needs to exist,
+// on the host or across the accelerator link. Same tokenizer, same
+// FNV-1a hashing, same df semantics as the dense fill (bit-identical
+// counts). doc_ptr is [n_docs+1] (CSR-style row pointers); feat/cnt
+// receive up to `cap` entries. Returns nnz, -1 on invalid offsets, -2
+// when cap is too small (caller bounds cap by the token-occurrence
+// count, which nnz can never exceed).
+int64_t pio_tfidf_tf_coo(const char* buf, const int64_t* offs,
+                         int64_t n_docs, int32_t n_features, int32_t ngram,
+                         int64_t cap, int64_t* doc_ptr, int32_t* feat_out,
+                         float* cnt_out, int64_t* df) {
+  if (n_features <= 0 || ngram < 1) return -1;
+  std::vector<char> low;
+  std::vector<int64_t> tok_s;
+  std::vector<int64_t> tok_e;
+  std::vector<uint32_t> hashes;
+  std::vector<float> row(static_cast<size_t>(n_features), 0.0f);
+  std::vector<int32_t> touched;
+  int64_t nnz = 0;
+  doc_ptr[0] = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    const int64_t b0 = offs[d], b1 = offs[d + 1];
+    if (b0 < 0 || b1 < b0) return -1;
+    hash_doc_tokens(buf, b0, b1, static_cast<uint32_t>(n_features), ngram,
+                    low, tok_s, tok_e, hashes);
+    touched.clear();
+    for (uint32_t idx : hashes) {
+      if (row[idx] == 0.0f) touched.push_back(static_cast<int32_t>(idx));
+      row[idx] += 1.0f;
+    }
+    if (nnz + static_cast<int64_t>(touched.size()) > cap) return -2;
+    // emission order: ascending bucket id (deterministic regardless of
+    // token order; the Python fallback sorts to match)
+    std::sort(touched.begin(), touched.end());
+    for (int32_t idx : touched) {
+      feat_out[nnz] = idx;
+      cnt_out[nnz] = row[idx];
+      if (df != nullptr) df[idx]++;
+      row[idx] = 0.0f;
+      ++nnz;
+    }
+    doc_ptr[d + 1] = nnz;
+  }
+  return nnz;
 }
 
 // Layout fill for ops/rowblocks.fill_buckets: scatter nnz COO entries

@@ -221,3 +221,60 @@ def test_ur_boost_applied_before_topk(memory_storage):
     boost = np.array([1.0, 1.0, 10.0], np.float32)
     scores, idx = score_user([(ind, membership, 1.0)], k=1, item_boost=boost)
     assert idx[0] == 2  # boosted item wins despite lower raw score
+
+
+def test_naive_bayes_coo_matches_dense():
+    """The COO path (tokenizer pairs -> device scatter-add) must produce
+    the same model as the dense einsum path, through the REAL text
+    pipeline (fit_tf vs fit_tf_coo on the same corpus), including the
+    folded idf column scale."""
+    from incubator_predictionio_tpu.ops.linear import (
+        train_naive_bayes, train_naive_bayes_coo,
+    )
+    from incubator_predictionio_tpu.ops.tfidf import TfIdfVectorizer
+
+    rng = np.random.default_rng(7)
+    vocab = [f"tok{i}" for i in range(300)]
+    docs, labels = [], []
+    for d in range(400):
+        c = d % 5
+        words = [vocab[(7 * k + 31 * c) % 300]
+                 for k in range(int(20 + 60 * rng.random()))]
+        docs.append(" ".join(words))
+        labels.append(c)
+    docs.append("")  # empty doc: counts toward the prior, no features
+    labels.append(2)
+    labels = np.asarray(labels, np.int32)
+
+    v1 = TfIdfVectorizer(n_features=128)
+    dense = v1.fit_tf(docs)
+    m_dense = train_naive_bayes(dense, labels, 5, smoothing=1.0,
+                                col_scale=v1.idf)
+
+    v2 = TfIdfVectorizer(n_features=128)
+    doc_ptr, feat, cnt = v2.fit_tf_coo(docs)
+    m_coo = train_naive_bayes_coo(doc_ptr, feat, cnt, labels,
+                                  n_classes=5, n_features=128,
+                                  smoothing=1.0, col_scale=v2.idf)
+
+    np.testing.assert_allclose(m_coo.log_prior, m_dense.log_prior,
+                               rtol=1e-6)
+    np.testing.assert_allclose(m_coo.log_likelihood,
+                               m_dense.log_likelihood,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_text_prepared_data_dense_tf_roundtrip():
+    """LR's on-demand densification of the preparator's COO equals the
+    dense fit exactly."""
+    from incubator_predictionio_tpu.models.text_classification import (
+        TextPreparator, TrainingData,
+    )
+    from incubator_predictionio_tpu.ops.tfidf import TfIdfVectorizer
+
+    docs = ["alpha beta beta gamma", "delta alpha", "", "beta beta beta"]
+    td = TrainingData(docs, np.zeros(4, np.int32), np.array(["a"]))
+    pd = TextPreparator().prepare(None, td)
+    assert pd.coo is not None and pd.features is None
+    ref = TfIdfVectorizer(n_features=pd.vectorizer.n_features).fit_tf(docs)
+    np.testing.assert_array_equal(pd.dense_tf(), ref)
