@@ -33,6 +33,12 @@ import jax.numpy as jnp
 import numpy as np
 
 
+#: Heavy-user rank-range width: drives BOTH the heavy-slab partition
+#: (h_per) and the device scan's u_chunk — a mismatch would silently
+#: treat in-range offsets as padding sentinels and drop events.
+_HEAVY_RANGE = 16
+
+
 def _xlogx(x):
     return jnp.where(x > 0, x * jnp.log(jnp.maximum(x, 1e-30)), 0.0)
 
@@ -59,16 +65,23 @@ def llr_scores(k11, k12, k21, k22):
 
 
 def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
-                       n_ranges: int):
+                       n_ranges: int, n_items: int):
     """Host prep: sort (user, item) pairs by user range and lay them out
-    as [n_ranges, E] slabs (-1 padded) plus a per-row range base offset,
-    so the device scan step for slab row r touches only events of one
-    user range. A range's primary and secondary slabs must be COMPLETE
-    for the per-step product to count every cross pair, so ranges are
-    never split here — skewed heavy users are extracted beforehand (see
-    ``cco_indicators``) to keep E near the mean.
+    as [n_ranges, E] slabs, so the device scan step for slab row r
+    touches only events of one user range. A range's primary and
+    secondary slabs must be COMPLETE for the per-step product to count
+    every cross pair, so ranges are never split here — skewed heavy
+    users are extracted beforehand (see ``cco_indicators``) to keep E
+    near the mean.
 
-    Returns (eu [n_ranges, E], ei [n_ranges, E], row_lo [n_ranges])."""
+    Returns (eu [n_ranges, E], ei [n_ranges, E]): eu holds the user's
+    LOCAL offset within its range (padding sentinel = u_chunk — no
+    per-row base array needed on device), ei the item id (padding 0,
+    masked by the sentinel). Both upload uint16 when their value range
+    fits (they nearly always do: u_chunk defaults to ~1k, catalogs are
+    rarely >65k items) — half the slab bytes of int32, which matters
+    because the slab upload is a dominant warm-train cost on
+    remote-attached chips."""
     # Events whose user id falls outside [0, n_ranges*u_chunk) are dropped
     # (contract: user ids < n_users; the pre-rewrite slab mask silently
     # ignored them too, and a bad id must not corrupt the layout).
@@ -83,47 +96,48 @@ def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
     starts = np.zeros(n_ranges + 1, np.int64)
     np.cumsum(counts, out=starts[1:])
     pos = np.arange(len(us)) - starts[chunk_of]
-    eu = np.full((n_ranges, e), -1, np.int32)
-    ei = np.full((n_ranges, e), -1, np.int32)
-    eu[chunk_of, pos] = us
-    ei[chunk_of, pos] = is_
-    row_lo = np.arange(n_ranges, dtype=np.int32) * u_chunk
-    return eu, ei, row_lo
+    u_dtype = np.uint16 if u_chunk < 0xFFFF else np.int32
+    i_dtype = np.uint16 if n_items <= 0xFFFF else np.int32
+    eu = np.full((n_ranges, e), u_chunk, u_dtype)   # sentinel = u_chunk
+    ei = np.zeros((n_ranges, e), i_dtype)
+    eu[chunk_of, pos] = (us - chunk_of * u_chunk).astype(u_dtype)
+    ei[chunk_of, pos] = is_.astype(i_dtype)
+    return eu, ei
 
 
 @functools.partial(jax.jit, static_argnames=("n_items", "u_chunk", "block"))
-def _cooccurrence_stripe(peu, pei, plo, seu, sei, slo, lo_item,
+def _cooccurrence_stripe(peu, pei, seu, sei, lo_item,
                          n_items: int, u_chunk: int, block: int):
     """One stripe C[lo_item:lo_item+block, :] of the co-occurrence
     matrix: Σ over slab rows of slab_p[:, stripe]ᵀ @ slab_s. Inputs are
-    the host-partitioned [n_rows, E] event slabs with per-row range base
-    offsets (plo/slo); each scan step scatters only its own row's events.
-    Binary slabs are bf16 (exact) so the matmul runs at full MXU rate
-    with f32 accumulation.
+    the host-partitioned [n_rows, E] event slabs (local user offsets,
+    sentinel u_chunk = padding); each scan step scatters only its own
+    row's events. Binary slabs are bf16 (exact) so the matmul runs at
+    full MXU rate with f32 accumulation.
 
     Heavy users are not in the light slabs; ``cco_indicators`` routes
     them through this same kernel with rank-renumbered ids and small
     rank ranges."""
 
-    def slab(uu, ii, lo):
-        ok = uu >= 0
-        rows = jnp.where(ok, uu - lo, u_chunk)  # row u_chunk = scratch
+    def slab(uu, ii):
+        rows = uu.astype(jnp.int32)          # sentinel row = scratch
+        ok = rows < u_chunk
         a = jnp.zeros((u_chunk + 1, n_items), jnp.bfloat16)
-        a = a.at[rows, jnp.maximum(ii, 0)].max(
+        a = a.at[rows, ii.astype(jnp.int32)].max(
             jnp.where(ok, 1.0, 0.0).astype(jnp.bfloat16))
         return a[:u_chunk]
 
     def body(c, chunk):
-        eu_p, ei_p, lo_p, eu_s, ei_s, lo_s = chunk
+        eu_p, ei_p, eu_s, ei_s = chunk
         ap = jax.lax.dynamic_slice(
-            slab(eu_p, ei_p, lo_p), (0, lo_item), (u_chunk, block))
-        asec = slab(eu_s, ei_s, lo_s)
+            slab(eu_p, ei_p), (0, lo_item), (u_chunk, block))
+        asec = slab(eu_s, ei_s)
         c = c + jnp.einsum("ui,uj->ij", ap, asec,
                            preferred_element_type=jnp.float32)
         return c, None
 
     c0 = jnp.zeros((block, n_items), jnp.float32)
-    c, _ = jax.lax.scan(body, c0, (peu, pei, plo, seu, sei, slo))
+    c, _ = jax.lax.scan(body, c0, (peu, pei, seu, sei))
     return c
 
 
@@ -160,6 +174,33 @@ def _stripe_topk(counts, n_i_stripe, n_j, lo_item, n_total,
     if llr_threshold > 0:
         llr = jnp.where(llr >= llr_threshold, llr, 0.0)
     return jax.lax.top_k(llr, k)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_items", "u_chunk", "block", "k", "llr_threshold", "h_chunk"))
+def _all_stripes(lo_effs, light, heavy, n_i, n_j, n_total,
+                 n_items: int, u_chunk: int, block: int, k: int,
+                 llr_threshold: float, h_chunk: int):
+    """Every item stripe in ONE dispatch: lax.scan over the stripe
+    origins runs cooccurrence + LLR + top-k per stripe on device and
+    returns the stacked [n_stripes, block, k] results — one download
+    instead of a dispatch + device_get round trip per stripe (through
+    the remote tunnel each of those cost a full RTT, which dominated
+    the UR warm train)."""
+    def body(carry, lo_eff):
+        counts = _cooccurrence_stripe(
+            *light, lo_eff, n_items=n_items, u_chunk=u_chunk, block=block)
+        if heavy is not None:
+            counts = counts + _cooccurrence_stripe(
+                *heavy, lo_eff, n_items=n_items, u_chunk=h_chunk,
+                block=block)
+        n_i_stripe = jax.lax.dynamic_slice(n_i, (lo_eff,), (block,))
+        s, ix = _stripe_topk(counts, n_i_stripe, n_j, lo_eff, n_total,
+                             k=k, llr_threshold=llr_threshold)
+        return carry, (s, ix)
+
+    _, (ss, ixs) = jax.lax.scan(body, 0, lo_effs)
+    return ss, ixs
 
 
 def cco_indicators(
@@ -226,50 +267,45 @@ def cco_indicators(
         # FEW heavy users per rank range (16), so one range's slab width
         # stays ≈ 16 heavy histories, not u_chunk of them. The slab
         # height is the range size, so heavy slabs are [17, I] — tiny.
-        h_per = 16
-        h_ranges = max((n_heavy + h_per - 1) // h_per, 1)
-        hpeu, hpei, hplo = _partition_by_user(hp_u, hp_i, h_per, h_ranges)
-        hseu, hsei, hslo = _partition_by_user(hs_u, hs_i, h_per, h_ranges)
-        heavy_dev = tuple(map(
-            jnp.asarray, (hpeu, hpei, hplo, hseu, hsei, hslo)))
+        h_ranges = max((n_heavy + _HEAVY_RANGE - 1) // _HEAVY_RANGE, 1)
+        h_per = _HEAVY_RANGE
+        hpeu, hpei = _partition_by_user(hp_u, hp_i, h_per, h_ranges,
+                                        n_items)
+        hseu, hsei = _partition_by_user(hs_u, hs_i, h_per, h_ranges,
+                                        n_items)
+        heavy_dev = tuple(map(jnp.asarray, (hpeu, hpei, hseu, hsei)))
     else:
         pu_l, pi_l, su_l, si_l = pu, pi, su, si
 
-    peu, pei, plo = _partition_by_user(pu_l, pi_l, u_chunk, n_ranges)
-    seu, sei, slo = _partition_by_user(su_l, si_l, u_chunk, n_ranges)
+    peu, pei = _partition_by_user(pu_l, pi_l, u_chunk, n_ranges, n_items)
+    seu, sei = _partition_by_user(su_l, si_l, u_chunk, n_ranges, n_items)
 
     n_i = np.bincount(pi, minlength=n_items).astype(np.float32)
+    n_i_dev = jnp.asarray(n_i)
     n_j = jnp.asarray(np.bincount(si, minlength=n_items).astype(np.float32))
     n_total = jnp.float32(n_users)
 
     k = min(max_correlators, n_items)
     block = min(item_block, n_items)
-    peu_d, pei_d, plo_d, seu_d, sei_d, slo_d = map(
-        jnp.asarray, (peu, pei, plo, seu, sei, slo))
+    light_dev = tuple(map(jnp.asarray, (peu, pei, seu, sei)))
+
+    # Last stripe may be ragged: compute a full block ending at the
+    # catalog edge and slice the overlap off (same compiled shape).
+    los = list(range(0, n_items, block))
+    lo_effs_np = np.array([min(lo, n_items - block) for lo in los], np.int32)
+    ss, ixs = jax.device_get(_all_stripes(
+        jnp.asarray(lo_effs_np), light_dev, heavy_dev if n_heavy else None,
+        n_i_dev, n_j, n_total,
+        n_items=n_items, u_chunk=u_chunk, block=block, k=k,
+        llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE,
+    ))
 
     idx_parts, score_parts = [], []
-    for lo in range(0, n_items, block):
+    for j, lo in enumerate(los):
         b = min(block, n_items - lo)
-        # Last stripe may be ragged: compute a full block ending at the
-        # catalog edge and slice the overlap off (same compiled shape).
-        lo_eff = min(lo, n_items - block)
-        counts = _cooccurrence_stripe(
-            peu_d, pei_d, plo_d, seu_d, sei_d, slo_d, jnp.int32(lo_eff),
-            n_items=n_items, u_chunk=u_chunk, block=block,
-        )
-        if n_heavy:
-            counts = counts + _cooccurrence_stripe(
-                *heavy_dev, jnp.int32(lo_eff),
-                n_items=n_items, u_chunk=16, block=block,
-            )
-        s, ix = _stripe_topk(
-            counts, jnp.asarray(n_i[lo_eff:lo_eff + block]), n_j,
-            jnp.int32(lo_eff), n_total, k=k, llr_threshold=llr_threshold,
-        )
-        s, ix = jax.device_get((s, ix))
-        skip = lo - lo_eff
-        score_parts.append(np.asarray(s)[skip:skip + b])
-        idx_parts.append(np.asarray(ix)[skip:skip + b])
+        skip = lo - int(lo_effs_np[j])
+        score_parts.append(np.asarray(ss[j])[skip:skip + b])
+        idx_parts.append(np.asarray(ixs[j])[skip:skip + b])
 
     score = np.concatenate(score_parts, axis=0)
     idx = np.concatenate(idx_parts, axis=0).astype(np.int32)

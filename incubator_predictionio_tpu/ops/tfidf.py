@@ -106,7 +106,8 @@ class TfIdfVectorizer:
             return x, np.count_nonzero(x, axis=0).astype(np.int64)
         return x
 
-    def fit_tf_coo(self, docs: Sequence[str]):
+    def fit_tf_coo(self, docs: Sequence[str],
+                   use_native: bool | None = None):
         """Fit the IDF and return per-doc (feature, count) pairs —
         ``(doc_ptr [N+1], feat [nnz] int32, counts [nnz] float32)`` in
         ascending bucket order per doc — WITHOUT materializing the
@@ -117,10 +118,15 @@ class TfIdfVectorizer:
         from this via a device segment-sum)."""
         D = self.n_features
         try:
+            if use_native is False:
+                from ..native import NativeUnavailable
+                raise NativeUnavailable("fallback forced (use_native=False)")
             from ..native import NativeUnavailable, tfidf_tf_coo
             doc_ptr, feat, counts, df = tfidf_tf_coo(
                 docs, D, self.ngram, want_df=True)
         except NativeUnavailable:
+            if use_native is True:
+                raise
             doc_ptr = np.zeros(len(docs) + 1, np.int64)
             feats = []
             cnts = []

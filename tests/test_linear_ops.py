@@ -223,6 +223,31 @@ def test_ur_boost_applied_before_topk(memory_storage):
     assert idx[0] == 2  # boosted item wins despite lower raw score
 
 
+import pytest
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_fit_tf_coo_native_and_fallback_parity(use_native):
+    """Both COO producers (C++ and the Python fallback) must emit the
+    identical (doc_ptr, feat, counts, idf) for the same corpus."""
+    from incubator_predictionio_tpu.ops.tfidf import TfIdfVectorizer
+
+    docs = ["Hello world hello", "foo BAR foo foo", "", "a b c a",
+            "\u00dcn\u00efcode test \u00fcn\u00efcode"]
+    ref = TfIdfVectorizer(n_features=64, ngram=2)
+    r_ref = ref.fit_tf_coo(docs)
+    try:
+        v = TfIdfVectorizer(n_features=64, ngram=2)
+        r = v.fit_tf_coo(docs, use_native=use_native)
+    except Exception as e:
+        if use_native and type(e).__name__ == "NativeUnavailable":
+            pytest.skip("no native toolchain")
+        raise
+    for a, b in zip(r_ref, r):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(ref.idf, v.idf)
+
+
 def test_naive_bayes_coo_matches_dense():
     """The COO path (tokenizer pairs -> device scatter-add) must produce
     the same model as the dense einsum path, through the REAL text
