@@ -353,3 +353,44 @@ def upgrade_cmd(args: list[str]) -> int:
     print("[info] Nothing to do: storage schemas are created on demand and "
           "engine templates need no rebuild in this distribution.")
     return 0
+
+
+@verb("shell", "interactive Python shell with the pio environment loaded")
+def shell_cmd(args: list[str]) -> int:
+    """Reference: bin/pio-shell — a REPL wired to the platform (there:
+    spark-shell with the pio assembly on the classpath; here: the
+    Python REPL with `pypio` preloaded and storage reachable).
+
+    Preloaded names: ``pypio`` (the bridge facade, already init()-ed
+    against the configured storage: new_app / delete_app /
+    import_events / find_events / find_ratings / train), ``storage``
+    (the configured Storage), and ``np``. Starting the shell does not
+    touch the accelerator — jax loads only when something trains.
+    ``pio shell -c 'stmt'`` runs one statement and exits (scriptable;
+    also what the tests drive).
+    """
+    p = argparse.ArgumentParser(prog="pio shell")
+    p.add_argument("-c", dest="command", default=None,
+                   help="run one statement and exit")
+    ns = p.parse_args(args)
+
+    import code
+
+    import numpy as np
+
+    from ... import pypio
+    from ...data.storage.registry import Storage
+
+    storage = Storage.instance()
+    pypio.init(storage)
+    banner = (
+        "pio shell — pypio preloaded "
+        "(pypio.new_app / import_events / find_events / train ...; "
+        "`storage` = configured Storage; np available)"
+    )
+    local_ns = {"pypio": pypio, "np": np, "storage": storage}
+    if ns.command is not None:
+        exec(compile(ns.command, "<pio shell -c>", "exec"), local_ns)
+        return 0
+    code.interact(banner=banner, local=local_ns)
+    return 0

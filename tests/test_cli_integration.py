@@ -201,3 +201,16 @@ def test_parquet_export_import_roundtrip(cli_env, tmp_path):
     a = [json.loads(x) for x in open(tmp_path / "orig.jsonl")]
     b = [json.loads(x) for x in open(back)]
     assert a == b
+
+
+def test_pio_shell_scripted(cli_env, tmp_path):
+    """`pio shell -c` runs a statement with pypio init()-ed against the
+    configured storage (reference: bin/pio-shell, the REPL wired to the
+    platform)."""
+    r = run_pio(["shell", "-c",
+                 "aid, key = pypio.new_app('shellapp'); "
+                 "print('created', aid)"], cli_env)
+    assert "created" in r.stdout
+    # state persisted through the real storage config
+    r = run_pio(["app", "list"], cli_env)
+    assert "shellapp" in r.stdout
