@@ -392,5 +392,9 @@ def shell_cmd(args: list[str]) -> int:
     if ns.command is not None:
         exec(compile(ns.command, "<pio shell -c>", "exec"), local_ns)
         return 0
+    try:
+        import readline  # noqa: F401 — line editing/history in the REPL
+    except ImportError:  # pragma: no cover — platform without readline
+        pass
     code.interact(banner=banner, local=local_ns)
     return 0
