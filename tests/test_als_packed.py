@@ -118,3 +118,35 @@ def test_device_slab_cache_evicts_over_budget(monkeypatch):
     # the survivor is b
     ((key, _arr),) = als_mod._dev_buf_cache.items()
     assert key[2] == b.shape
+
+
+def test_executable_cache_survives_candidate_sweeps():
+    """Eval sweeps vary reg / iterations / seed per candidate; none of
+    those shape the compiled program (reg flows in as the lam data,
+    n_iters is a traced operand, seed is host init), so the train-fn
+    cache must serve ONE entry across the sweep — recompiling per
+    candidate was a multi-second tax per eval point."""
+    als_mod._train_fn_cache.clear()
+    u, i, r = _data()
+    m1 = mesh_from_devices(devices=[jax.devices()[0]])
+    base = dict(rank=8, compute_dtype="float32")
+    for reg, iters, seed in [(0.1, 2, 1), (0.5, 2, 1), (0.1, 3, 2),
+                             (0.9, 1, 7)]:
+        train_als(u, i, r, n_users=500, n_items=200,
+                  params=ALSParams(reg=reg, num_iterations=iters,
+                                   seed=seed, **base), mesh=m1)
+    assert len(als_mod._train_fn_cache) == 1
+    # a shaping field (rank) DOES key a new executable
+    train_als(u, i, r, n_users=500, n_items=200,
+              params=ALSParams(rank=16, num_iterations=1,
+                               compute_dtype="float32"), mesh=m1)
+    assert len(als_mod._train_fn_cache) == 2
+    # and regularization actually took effect across the sweep
+    f_lo = train_als(u, i, r, n_users=500, n_items=200,
+                     params=ALSParams(reg=0.001, num_iterations=3,
+                                      **base), mesh=m1)
+    f_hi = train_als(u, i, r, n_users=500, n_items=200,
+                     params=ALSParams(reg=50.0, num_iterations=3,
+                                      **base), mesh=m1)
+    assert (np.linalg.norm(f_hi.user_factors)
+            < 0.5 * np.linalg.norm(f_lo.user_factors))

@@ -1,0 +1,54 @@
+"""Candidate-sweep cost: the `pio eval` pattern measured end-to-end.
+
+`pio eval` trains one dataset under N parameter candidates. Three r4
+mechanisms make the marginal candidate cheap on an accelerator:
+
+- the train-fn cache keys only on executable-SHAPING params
+  (ops/als.py _executable_params_key), so reg/iterations/seed
+  candidates reuse one compiled program — zero recompiles;
+- the content-hash device slab cache skips re-uploading the unchanged
+  layout slabs (only the tiny lam vector re-uploads per reg);
+- the packed transfer path makes what does upload 2-3 buffers.
+
+Run on a QUIET host: `python tools/bench_eval_sweep.py [n_candidates]`.
+Prints per-candidate wall times and the marginal steady-state cost.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    n_cand = int(sys.argv[1]) if len(sys.argv) > 1 else 6
+    from incubator_predictionio_tpu.ops.als import ALSParams, train_als
+
+    n_users, n_items, nnz = 100_000, 20_000, 5_000_000
+    rng = np.random.default_rng(2)
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = np.minimum((n_items * rng.random(nnz) ** 2).astype(np.int32),
+                   n_items - 1)
+    r = np.ones(nnz, np.float32)
+    regs = np.geomspace(0.001, 1.0, n_cand)
+
+    times = []
+    for c, reg in enumerate(regs):
+        t0 = time.perf_counter()
+        train_als(u, i, r, n_users=n_users, n_items=n_items,
+                  params=ALSParams(rank=32, num_iterations=10,
+                                   reg=float(reg), implicit_prefs=True,
+                                   alpha=1.0, seed=3))
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        print(f"candidate {c} (reg={reg:.4g}): {dt:.2f}s", flush=True)
+    marginal = float(np.median(times[1:])) if len(times) > 1 else times[0]
+    print(f"first candidate (compile+upload): {times[0]:.2f}s; "
+          f"marginal candidate: {marginal:.2f}s "
+          f"({nnz / marginal:,.0f} ev/s/candidate)", flush=True)
+
+
+if __name__ == "__main__":
+    main()
