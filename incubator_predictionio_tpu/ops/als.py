@@ -606,17 +606,27 @@ def _pack_flat(flat):
 _packed_fn_cache: dict = {}
 
 
+#: ALSParams fields that do NOT shape the compiled program:
+#: num_iterations is a traced operand, reg/lambda_scaling flow in as
+#: the lam data array, seed only shapes the host init. Everything NOT
+#: listed here keys the executable cache — a DENYLIST, so a future
+#: field added to ALSParams fails safe (spurious recompile) instead of
+#: silently serving a stale program compiled for different params.
+_NON_SHAPING_PARAMS = frozenset(
+    {"num_iterations", "reg", "lambda_scaling", "seed"})
+
+
 def _executable_params_key(params: ALSParams) -> tuple:
-    """The ALSParams fields BAKED into the compiled program, and only
-    those. num_iterations is a traced operand, reg/lambda_scaling flow
-    in as the lam data array, and seed only shapes the host init, so
-    an eval sweep over regularization / iterations / seeds (the
-    `pio eval` candidate pattern) reuses ONE executable with zero
-    recompiles (and, with the device slab cache, zero re-uploads of
-    the unchanged slabs)."""
-    return (params.rank, params.implicit_prefs, params.alpha,
-            params.block_len, params.compute_dtype, params.chunk_tiles,
-            params.binary_ratings)
+    """Cache key over the ALSParams fields BAKED into the compiled
+    program. Lets an eval sweep over regularization / iterations /
+    seeds (the `pio eval` candidate pattern) reuse ONE executable with
+    zero recompiles; with the device slab cache, binary-ratings sweeps
+    additionally re-upload only the small lam vector per candidate
+    (explicit-value sweeps re-upload the f32 buffer that lam is packed
+    with — value slabs and lam share a dtype group)."""
+    return tuple(
+        getattr(params, f.name) for f in dataclasses.fields(params)
+        if f.name not in _NON_SHAPING_PARAMS)
 
 #: Device-resident slab cache: repeat trains over IDENTICAL data skip
 #: the host->device upload entirely — the `pio eval` pattern (N

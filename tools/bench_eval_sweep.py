@@ -7,19 +7,22 @@ mechanisms make the marginal candidate cheap on an accelerator:
   (ops/als.py _executable_params_key), so reg/iterations/seed
   candidates reuse one compiled program — zero recompiles;
 - the content-hash device slab cache skips re-uploading the unchanged
-  layout slabs (only the tiny lam vector re-uploads per reg);
+  layout slabs (binary ratings: only the tiny lam vector re-uploads
+  per reg; explicit-value sweeps re-upload the f32 group lam is packed
+  with);
 - the packed transfer path makes what does upload 2-3 buffers.
 
 Run on a QUIET host: `python tools/bench_eval_sweep.py [n_candidates]`.
 Prints per-candidate wall times and the marginal steady-state cost.
 """
 
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

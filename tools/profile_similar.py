@@ -12,12 +12,13 @@ Run on a QUIET host (no concurrent pytest/bench): `python
 tools/profile_similar.py [repeats]`.
 """
 
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
