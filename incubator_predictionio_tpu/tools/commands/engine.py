@@ -78,11 +78,16 @@ def train_cmd(args: list[str]) -> int:
         resume=ns.resume,
         profile_dir=ns.profile_dir,
     )
+    import time as _time
+
+    t0 = _time.perf_counter()
     instance_id = run_train(
         engine, params, ctx, wp,
         engine_factory_name=factory, engine_variant=variant,
     )
-    print(f"[info] Training completed. Engine instance ID: {instance_id}")
+    train_s = _time.perf_counter() - t0
+    print(f"[info] Training completed in {train_s:.2f}s. "
+          f"Engine instance ID: {instance_id}")
     return 0
 
 
