@@ -319,3 +319,83 @@ def test_all_network_backend_topology():
         assert len(out["itemScores"]) == 4
         assert all(s["item"].startswith("i") for s in out["itemScores"])
         storage2.close()
+
+
+def test_topology_with_hbase_rpc_event_store():
+    """Second production topology, exercising the NATIVE HBase RPC
+    transport as the event store of record (pre-split table → real
+    region routing), metadata on PostgreSQL (wire protocol), models on
+    WebHDFS — full lifecycle incl. a cold-registry deploy."""
+    import datetime as dt
+
+    from hbase_rpc_mock import MockHBaseRpcServer
+    from hdfs_mock import build_hdfs_app
+    from pg_mock import MockPGServer
+    from server_utils import ServerThread
+
+    from incubator_predictionio_tpu.controller import EngineParams
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import App
+    from incubator_predictionio_tpu.data.storage.datamap import DataMap
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine,
+    )
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import (
+        load_deployment, run_train,
+    )
+
+    splits = {"pio_eventdata_1": [b"t:80007"]}
+    with MockPGServer(user="pio", password="piosecret") as pg, \
+            MockHBaseRpcServer(split_keys=splits) as hb, \
+            ServerThread(build_hdfs_app()) as dfs:
+        env = {
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PG",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "HB",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DFS",
+            "PIO_STORAGE_SOURCES_PG_TYPE": "PGSQL",
+            "PIO_STORAGE_SOURCES_PG_HOST": "127.0.0.1",
+            "PIO_STORAGE_SOURCES_PG_PORT": str(pg.port),
+            "PIO_STORAGE_SOURCES_PG_USERNAME": "pio",
+            "PIO_STORAGE_SOURCES_PG_PASSWORD": "piosecret",
+            "PIO_STORAGE_SOURCES_HB_TYPE": "HBASE",
+            "PIO_STORAGE_SOURCES_HB_HOSTS": "127.0.0.1",
+            "PIO_STORAGE_SOURCES_HB_PORTS": str(hb.port),
+            "PIO_STORAGE_SOURCES_HB_PROTOCOL": "rpc",
+            "PIO_STORAGE_SOURCES_DFS_TYPE": "HDFS",
+            "PIO_STORAGE_SOURCES_DFS_HOSTS": "127.0.0.1",
+            "PIO_STORAGE_SOURCES_DFS_PORTS": str(dfs.port),
+            "PIO_STORAGE_SOURCES_DFS_PATH": "/pio/models",
+        }
+        storage = Storage(env)
+        aid = storage.get_meta_data_apps().insert(App(0, "hbapp"))
+        assert aid == 1  # the pre-split table name assumes it
+        rng = np.random.default_rng(6)
+        evs = []
+        t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+        for k in range(600):
+            evs.append(Event(
+                "rate", "user", str(int(rng.integers(0, 30))),
+                "item", f"i{int(rng.integers(0, 20))}",
+                DataMap({"rating": int(rng.integers(1, 6))}),
+                t0 + dt.timedelta(seconds=k)))
+        storage.get_l_events().insert_batch(evs, aid)
+
+        engine = RecommendationEngine()()
+        ep = EngineParams.from_json({
+            "datasource": {"params": {"appName": "hbapp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "numIterations": 4, "lambda": 0.05}}],
+        })
+        ctx = WorkflowContext(app_name="hbapp", storage=storage)
+        iid = run_train(engine, ep, ctx, engine_factory_name="hbnet")
+        storage.close()
+
+        storage2 = Storage(env)
+        dep, _, _ = load_deployment(
+            engine, iid, WorkflowContext(storage=storage2),
+            engine_factory_name="hbnet")
+        out = dep.query({"user": "3", "num": 4})
+        assert len(out["itemScores"]) == 4
+        storage2.close()
