@@ -16,15 +16,21 @@ Scale notes: events are pre-partitioned by user range on the host (sorted
 slabs, like ops/blocked.py), so each scan step scatters only its own
 events — the naive alternative of range-masking the whole event array per
 step is quadratic and ~40x slower on TPU at 1M events. Slabs are bf16
-(binary, so exact) for the MXU matmul with f32 accumulation. The
-co-occurrence matrix is computed in [item_block, I] stripes so catalogs
-far beyond the one-chip [I, I] limit stream through a bounded accumulator;
-LLR + top-k happen per stripe and only the [I, K] indicators materialize.
+(binary, so exact) for the MXU matmul with f32 accumulation. Two
+accumulation strategies, chosen by HBM budget: when the full [I, I]
+f32 matrix fits a fraction of device memory, one scan over user ranges
+builds each membership slab ONCE and accumulates the whole matrix
+(then LLR + top-k per stripe slice — all one dispatch); bigger
+catalogs stream [item_block, I] stripes through a bounded accumulator
+(slabs rebuilt per stripe — the memory/compute trade). Both paths are
+bit-identical (counts are exact small integers in f32; tested). Either
+way only the [I, K] indicators materialize on the host.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import functools
 from typing import Optional
 
@@ -105,6 +111,20 @@ def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
     return eu, ei
 
 
+def _slab(uu, ii, u_chunk: int, n_items: int):
+    """One range's binary membership slab [u_chunk, n_items] bf16 from
+    (local user offset, item) event pairs; the sentinel offset u_chunk
+    lands padding on a scratch row that is sliced away. bf16 is exact
+    for 0/1, so the downstream matmuls run at full MXU rate with f32
+    accumulation."""
+    rows = uu.astype(jnp.int32)          # sentinel row = scratch
+    ok = rows < u_chunk
+    a = jnp.zeros((u_chunk + 1, n_items), jnp.bfloat16)
+    a = a.at[rows, ii.astype(jnp.int32)].max(
+        jnp.where(ok, 1.0, 0.0).astype(jnp.bfloat16))
+    return a[:u_chunk]
+
+
 @functools.partial(jax.jit, static_argnames=("n_items", "u_chunk", "block"))
 def _cooccurrence_stripe(peu, pei, seu, sei, lo_item,
                          n_items: int, u_chunk: int, block: int):
@@ -112,26 +132,18 @@ def _cooccurrence_stripe(peu, pei, seu, sei, lo_item,
     matrix: Σ over slab rows of slab_p[:, stripe]ᵀ @ slab_s. Inputs are
     the host-partitioned [n_rows, E] event slabs (local user offsets,
     sentinel u_chunk = padding); each scan step scatters only its own
-    row's events. Binary slabs are bf16 (exact) so the matmul runs at
-    full MXU rate with f32 accumulation.
+    row's events.
 
     Heavy users are not in the light slabs; ``cco_indicators`` routes
     them through this same kernel with rank-renumbered ids and small
     rank ranges."""
 
-    def slab(uu, ii):
-        rows = uu.astype(jnp.int32)          # sentinel row = scratch
-        ok = rows < u_chunk
-        a = jnp.zeros((u_chunk + 1, n_items), jnp.bfloat16)
-        a = a.at[rows, ii.astype(jnp.int32)].max(
-            jnp.where(ok, 1.0, 0.0).astype(jnp.bfloat16))
-        return a[:u_chunk]
-
     def body(c, chunk):
         eu_p, ei_p, eu_s, ei_s = chunk
         ap = jax.lax.dynamic_slice(
-            slab(eu_p, ei_p), (0, lo_item), (u_chunk, block))
-        asec = slab(eu_s, ei_s)
+            _slab(eu_p, ei_p, u_chunk, n_items), (0, lo_item),
+            (u_chunk, block))
+        asec = _slab(eu_s, ei_s, u_chunk, n_items)
         c = c + jnp.einsum("ui,uj->ij", ap, asec,
                            preferred_element_type=jnp.float32)
         return c, None
@@ -139,6 +151,84 @@ def _cooccurrence_stripe(peu, pei, seu, sei, lo_item,
     c0 = jnp.zeros((block, n_items), jnp.float32)
     c, _ = jax.lax.scan(body, c0, (peu, pei, seu, sei))
     return c
+
+
+@functools.partial(jax.jit, static_argnames=("n_items", "u_chunk", "h_chunk"))
+def _full_cooccurrence(light, heavy, n_items: int, u_chunk: int,
+                       h_chunk: int):
+    """The whole [I, I] co-occurrence matrix in one scan over user
+    ranges — each range's slabs are built ONCE (the striped kernel
+    rebuilds them per stripe; at 20k items that redundant scatter was
+    ~60% of UR's device time). Costs n_items^2 * 4 bytes of HBM for
+    the accumulator, so ``cco_indicators`` only routes here when that
+    fits (PIO_UR_FULL_MATRIX_ELEMS caps it; the striped path remains
+    for big catalogs). Counts are exact small integers in f32, so both
+    paths produce IDENTICAL results (tested)."""
+
+    def mk_body(chunk_rows: int):
+        def body(c, chunk):
+            eu_p, ei_p, eu_s, ei_s = chunk
+            ap = _slab(eu_p, ei_p, chunk_rows, n_items)
+            asec = _slab(eu_s, ei_s, chunk_rows, n_items)
+            c = c + jnp.einsum("ui,uj->ij", ap, asec,
+                               preferred_element_type=jnp.float32)
+            return c, None
+        return body
+
+    c0 = jnp.zeros((n_items, n_items), jnp.float32)
+    c, _ = jax.lax.scan(mk_body(u_chunk), c0, light)
+    if heavy is not None:
+        c, _ = jax.lax.scan(mk_body(h_chunk), c, heavy)
+    return c
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_items", "u_chunk", "h_chunk", "block", "k", "llr_threshold"))
+def _full_cco_topk(light, heavy, lo_effs, n_i, n_j, n_total,
+                   n_items: int, u_chunk: int, h_chunk: int,
+                   block: int, k: int, llr_threshold: float):
+    """Full-matrix accumulate + per-stripe LLR/top-k as ONE dispatch
+    (per-dispatch RTT through remote tunnels is why the striped path
+    got _all_stripes; the full path keeps the same property)."""
+    c = _full_cooccurrence(light, heavy, n_items=n_items,
+                           u_chunk=u_chunk, h_chunk=h_chunk)
+
+    def body(carry, lo_eff):
+        counts = jax.lax.dynamic_slice(c, (lo_eff, 0), (block, n_items))
+        n_i_stripe = jax.lax.dynamic_slice(n_i, (lo_eff,), (block,))
+        s, ix = _stripe_topk(counts, n_i_stripe, n_j, lo_eff, n_total,
+                             k=k, llr_threshold=llr_threshold)
+        return carry, (s, ix)
+
+    _, (ss, ixs) = jax.lax.scan(body, 0, lo_effs)
+    return ss, ixs
+
+
+def _full_matrix_elem_cap() -> int:
+    """Element budget for the [I, I] accumulator: an explicit
+    PIO_UR_FULL_MATRIX_ELEMS wins (malformed values fall back with a
+    warning rather than crashing training); otherwise 1/8 of the
+    device's reported memory (the scan carry double-buffers and the
+    slab/LLR intermediates need head-room), defaulting to 256M
+    elements (1 GiB f32) when the backend reports nothing."""
+    raw = os.environ.get("PIO_UR_FULL_MATRIX_ELEMS")
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            import warnings
+
+            warnings.warn(
+                f"PIO_UR_FULL_MATRIX_ELEMS={raw!r} is not a number; "
+                "using the device-derived default", stacklevel=2)
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit // 8 // 4       # 1/8 of HBM, f32 elements
+    except Exception:
+        pass
+    return 256 * 1024 * 1024
 
 
 @dataclasses.dataclass
@@ -293,12 +383,21 @@ def cco_indicators(
     # catalog edge and slice the overlap off (same compiled shape).
     los = list(range(0, n_items, block))
     lo_effs_np = np.array([min(lo, n_items - block) for lo in los], np.int32)
-    ss, ixs = jax.device_get(_all_stripes(
-        jnp.asarray(lo_effs_np), light_dev, heavy_dev if n_heavy else None,
-        n_i_dev, n_j, n_total,
-        n_items=n_items, u_chunk=u_chunk, block=block, k=k,
-        llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE,
-    ))
+    heavy_arg = heavy_dev if n_heavy else None
+    if n_items * n_items <= _full_matrix_elem_cap():
+        # full-matrix path: every slab built once (see _full_cooccurrence)
+        ss, ixs = jax.device_get(_full_cco_topk(
+            light_dev, heavy_arg, jnp.asarray(lo_effs_np), n_i_dev, n_j,
+            n_total, n_items=n_items, u_chunk=u_chunk,
+            h_chunk=_HEAVY_RANGE, block=block, k=k,
+            llr_threshold=llr_threshold))
+    else:
+        ss, ixs = jax.device_get(_all_stripes(
+            jnp.asarray(lo_effs_np), light_dev, heavy_arg,
+            n_i_dev, n_j, n_total,
+            n_items=n_items, u_chunk=u_chunk, block=block, k=k,
+            llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE,
+        ))
 
     idx_parts, score_parts = [], []
     for j, lo in enumerate(los):

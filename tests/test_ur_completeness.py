@@ -166,3 +166,31 @@ def test_popularity_and_dates_survive_persistence(ur_deployment, memory_storage)
     model = ur_deployment.models[0]
     assert model.popularity is not None and model.popularity.max() >= 40
     assert "i1" in model.item_dates and "availableDate" in model.item_dates["i1"]
+
+
+def test_full_matrix_and_striped_cooccurrence_identical(monkeypatch):
+    """The full-matrix path (slabs built once, [I, I] accumulator) and
+    the striped path must produce IDENTICAL indicators — counts are
+    exact small integers in f32, so no tolerance is needed."""
+    import numpy as np
+
+    from incubator_predictionio_tpu.ops.llr import cco_indicators
+
+    rng = np.random.default_rng(11)
+    n_users, n_items, n = 3000, 300, 60_000
+    pu = rng.integers(0, n_users, n // 3).astype(np.int32)
+    pi = rng.integers(0, n_items, n // 3).astype(np.int32)
+    su = rng.integers(0, n_users, n).astype(np.int32)
+    si = rng.integers(0, n_items, n).astype(np.int32)
+    # a couple of heavy users to exercise the heavy path in both modes
+    pu[:4000] = 7
+    su[:8000] = 7
+
+    monkeypatch.setenv("PIO_UR_FULL_MATRIX_ELEMS", str(n_items * n_items))
+    full = cco_indicators(pu, pi, su, si, n_users=n_users,
+                          n_items=n_items, max_correlators=20)
+    monkeypatch.setenv("PIO_UR_FULL_MATRIX_ELEMS", "1")  # force striped
+    striped = cco_indicators(pu, pi, su, si, n_users=n_users,
+                             n_items=n_items, max_correlators=20)
+    np.testing.assert_array_equal(full.idx, striped.idx)
+    np.testing.assert_array_equal(full.score, striped.score)
