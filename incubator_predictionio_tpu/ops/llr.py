@@ -221,14 +221,22 @@ def _full_matrix_elem_cap() -> int:
             warnings.warn(
                 f"PIO_UR_FULL_MATRIX_ELEMS={raw!r} is not a number; "
                 "using the device-derived default", stacklevel=2)
+    limit = 0
     try:
-        stats = jax.devices()[0].memory_stats() or {}
+        dev = jax.devices()[0]
+        stats = dev.memory_stats() or {}
         limit = int(stats.get("bytes_limit", 0))
-        if limit > 0:
-            return limit // 8 // 4       # 1/8 of HBM, f32 elements
+        if limit <= 0 and dev.platform == "tpu":
+            # remote-PJRT tunnels report no memory stats; the smallest
+            # TPU HBM in the supported fleet is 8 GiB per core
+            limit = 8 * 1024 ** 3
     except Exception:
         pass
-    return 256 * 1024 * 1024
+    if limit <= 0:
+        limit = 4 * 1024 ** 3
+    # 1/4 of memory for the f32 accumulator: scan carries alias (no
+    # double buffer), leaving head-room for slabs + LLR intermediates
+    return limit // 4 // 4
 
 
 @dataclasses.dataclass
