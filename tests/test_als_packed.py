@@ -150,3 +150,34 @@ def test_executable_cache_survives_candidate_sweeps():
                                       **base), mesh=m1)
     assert (np.linalg.norm(f_hi.user_factors)
             < 0.5 * np.linalg.norm(f_lo.user_factors))
+
+
+def test_device_slab_cache_is_per_device():
+    """--parallel-candidates gives each worker its own single-device
+    mesh; the content-hash cache keys on the DEVICE too, so candidate
+    A's slabs on device 0 are never handed to candidate B training on
+    device 1 (a cross-device hit would either crash placement or
+    silently move the train). Both devices end up with their own
+    cached copies and identical results."""
+    als_mod._dev_buf_cache.clear()
+    als_mod._dev_buf_cache_order.clear()
+    devs = jax.devices()
+    if len(devs) < 2:
+        import pytest as _pytest
+
+        _pytest.skip("needs >=2 devices (conftest provides 8 virtual)")
+    u, i, r = _data()
+    params = ALSParams(rank=8, num_iterations=2, reg=0.1, seed=1,
+                       compute_dtype="float32")
+    f0 = train_als(u, i, r, n_users=500, n_items=200, params=params,
+                   mesh=mesh_from_devices(devices=[devs[0]]))
+    n_after_first = len(als_mod._dev_buf_cache)
+    assert n_after_first > 0
+    f1 = train_als(u, i, r, n_users=500, n_items=200, params=params,
+                   mesh=mesh_from_devices(devices=[devs[1]]))
+    # device 1 missed device 0's entries: the cache grew by the same
+    # slab count again, keyed to the second device
+    assert len(als_mod._dev_buf_cache) == 2 * n_after_first
+    dev_ids = {k[3] for k in als_mod._dev_buf_cache}
+    assert dev_ids == {devs[0].id, devs[1].id}
+    np.testing.assert_array_equal(f0.user_factors, f1.user_factors)
