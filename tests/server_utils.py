@@ -17,9 +17,22 @@ def free_port() -> int:
 
 
 class ServerThread:
-    def __init__(self, app: web.Application):
+    def __init__(self, app: web.Application, port: int | None = None):
         self.app = app
-        self.port = free_port()
+        if port is not None:
+            # caller wants a FIXED port (golden tests whose recorded
+            # bytes cover the host); fail fast if taken
+            import socket as _socket
+
+            probe = _socket.socket()
+            probe.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+            try:
+                probe.bind(("127.0.0.1", port))
+            finally:
+                probe.close()
+            self.port = port
+        else:
+            self.port = free_port()
         self.base = f"http://127.0.0.1:{self.port}"
         self._loop = asyncio.new_event_loop()
         self._started = threading.Event()
@@ -35,7 +48,8 @@ class ServerThread:
                 self.app["stopper"] = self._stop.set
             runner = web.AppRunner(self.app)
             await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            site = web.TCPSite(runner, "127.0.0.1", self.port,
+                               reuse_address=True)
             await site.start()
             self._started.set()
             await self._stop.wait()
