@@ -49,9 +49,12 @@ class NaiveBayesModel:
 
 @functools.partial(jax.jit, static_argnames=("n_classes",))
 def _nb_stats(x, y, w, n_classes: int):
-    # x may arrive bfloat16 (lossless narrow upload, see
-    # train_naive_bayes); the one-hot matches its dtype so the einsum
-    # feeds the MXU natively, accumulating in float32 either way.
+    # x may arrive bfloat16 or uint8 (lossless narrow uploads, see
+    # train_naive_bayes); integer wire dtypes widen to bf16 here so the
+    # one-hot einsum feeds the MXU natively, accumulating in float32
+    # either way.
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        x = x.astype(jnp.bfloat16)
     onehot = jax.nn.one_hot(y, n_classes, dtype=x.dtype) * w[:, None].astype(x.dtype)
     feat = jnp.einsum("nc,nd->cd", onehot, x,
                       preferred_element_type=jnp.float32)  # [C, D]
@@ -86,9 +89,17 @@ def train_naive_bayes(
     # exactly representable; the stats einsum accumulates in float32
     # regardless.
     if mesh.devices.flat[0].platform == "tpu":
-        xb = x.astype(jnp.bfloat16)
-        if np.array_equal(xb.astype(np.float32), x):
-            x = xb
+        # Narrowest lossless wire dtype, widened on device by _nb_stats:
+        # small nonneg integer counts (the multinomial NB domain) fit
+        # uint8 — a QUARTER of the f32 bytes; anything bf16-exact still
+        # halves them.
+        x_int = x.astype(np.uint8)
+        if np.array_equal(x_int.astype(np.float32), x):
+            x = x_int
+        else:
+            xb = x.astype(jnp.bfloat16)
+            if np.array_equal(xb.astype(np.float32), x):
+                x = xb
     w = np.ones(x.shape[0], np.float32)
     xp, yp, wp = pad_rows(x, n_dev), pad_rows(y, n_dev), pad_rows(w, n_dev)
     shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
