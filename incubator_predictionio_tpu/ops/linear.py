@@ -232,6 +232,10 @@ def _lr_fit(xp, yp, maskp, n, reg, tol, max_iters, n_classes: int):
     step cost at template shapes)."""
     import optax
 
+    # narrow wire dtypes (uint8 / lossless bf16) widen back to f32
+    # BEFORE any math: results are bit-identical to an f32 upload
+    xp = xp.astype(jnp.float32)
+
     d = xp.shape[1]
 
     def loss_fn(params):
@@ -293,6 +297,17 @@ def train_logistic_regression(
     x = np.asarray(x, np.float32)
     y = np.asarray(y, np.int32)
     n = x.shape[0]
+    if mesh.devices.flat[0].platform == "tpu":
+        # Lossless narrow wire (same gate as train_naive_bayes); _lr_fit
+        # widens back to f32 on device FIRST, so the optimization math
+        # and its results are bit-identical to an f32 upload.
+        x_int = x.astype(np.uint8)
+        if np.array_equal(x_int.astype(np.float32), x):
+            x = x_int
+        else:
+            xb = x.astype(jnp.bfloat16)
+            if np.array_equal(xb.astype(np.float32), x):
+                x = xb
     mask = pad_rows(np.ones(n, np.float32), n_dev)
     xp = pad_rows(x, n_dev)
     yp = pad_rows(y, n_dev)
