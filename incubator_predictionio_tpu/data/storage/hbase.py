@@ -468,8 +468,13 @@ class HBLEvents(storage_base.LEvents):
                      if start_time is not None else b"t:")
         end_key = (self._data_key(self._time_us(until_time), 0)
                    if until_time is not None else b"t;")  # ';' > ':'
-        if event_names is not None and not list(event_names):
-            return iter(())
+        if event_names is not None:
+            # materialize ONCE: a one-shot iterable must survive the
+            # emptiness check, the filter-spec build, AND every
+            # event_matches membership test below
+            event_names = list(event_names)
+            if not event_names:
+                return iter(())
         spec = self._filter_spec(entity_type, entity_id, event_names,
                                  target_entity_type, target_entity_id)
         if limit is not None and limit < 0:

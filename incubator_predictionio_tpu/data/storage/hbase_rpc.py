@@ -361,6 +361,15 @@ class HBaseRpcTransport:
         self._conns: dict[tuple[str, int, str], _Conn] = {}
         self._regions: dict[str, list[_Region]] = {}
         self._lock = threading.Lock()
+        #: scanners whose generator was dropped before exhaustion.
+        #: Closes are DEFERRED to the next transport call on the
+        #: caller's own thread: a generator's finally may run inside a
+        #: GC pass triggered while this thread already holds _lock or a
+        #: connection lock (non-reentrant) — issuing the close RPC from
+        #: the finalizer would deadlock, the bug class pgwire's
+        #: _in_conversation guard fixes. list.append is atomic, so the
+        #: finalizer only ever touches this list.
+        self._pending_scanner_closes: list[tuple[tuple[str, int], int]] = []
 
     # -- connections -------------------------------------------------------
     def _conn(self, server: tuple[str, int], service: str) -> _Conn:
@@ -390,6 +399,22 @@ class HBaseRpcTransport:
                 return existing
             self._conns[key] = fresh
             return fresh
+
+    def _drain_pending_closes(self) -> None:
+        """Best-effort close of scanners abandoned mid-iteration; runs
+        on a normal caller thread OUTSIDE any transport lock (servers
+        also reclaim scanners via their lease timeout, so failures here
+        are harmless)."""
+        while self._pending_scanner_closes:
+            try:
+                server, scanner_id = self._pending_scanner_closes.pop()
+            except IndexError:   # lost a race with another drainer
+                return
+            try:
+                conn = self._conn(server, "ClientService")
+                conn.call("Scan", PB().varint(3, scanner_id).bool_(5, True))
+            except (HBaseRpcError, OSError):
+                pass
 
     def _call(self, server: tuple[str, int], service: str, method: str,
               param: "PB | bytes") -> dict[int, list]:
@@ -427,6 +452,10 @@ class HBaseRpcTransport:
             victim.close()
 
     def close(self) -> None:
+        try:
+            self._drain_pending_closes()
+        except Exception:
+            pass
         with self._lock:
             conns = list(self._conns.values())
             self._conns.clear()
@@ -601,6 +630,8 @@ class HBaseRpcTransport:
 
     # -- data path: transport interface ------------------------------------
     def get_row(self, table: str, key: bytes) -> Optional[dict[str, bytes]]:
+        self._drain_pending_closes()
+
         def do(region: _Region):
             req = (PB().msg(1, _region_spec(region.name))
                    .msg(2, PB().bytes_(1, key)))
@@ -638,6 +669,7 @@ class HBaseRpcTransport:
         TableNotFoundException like the REST transport's 404 path."""
         if not rows:
             return
+        self._drain_pending_closes()
         for attempt in (0, 1):
             try:
                 self._put_rows_once(table, rows)
@@ -716,6 +748,7 @@ class HBaseRpcTransport:
         Stale region locations retry with a RESUME CURSOR: the window
         is narrowed past the rows already yielded before re-locating,
         so a region move mid-scan never duplicates or drops rows."""
+        self._drain_pending_closes()
         cur_start, cur_stop = start, stop
         for attempt in range(3):
             try:
@@ -802,16 +835,14 @@ class HBaseRpcTransport:
                 next_req = (PB().varint(3, scanner_id).varint(4, batch))
                 resp = self._call(server, "ClientService", "Scan", next_req)
         except HBaseRpcError as e:
-            # don't dial a NEW connection just to close a scanner whose
-            # session died with the old one — the server's scanner lease
-            # reclaims it, and the caller's retry must not wait behind a
-            # reconnect to a possibly black-holed server
+            # don't try to close a scanner whose session died with the
+            # connection — the server's scanner lease reclaims it
             broken = e.connection_lost
             raise
         finally:
             if scanner_id is not None and not broken:
-                try:
-                    self._call(server, "ClientService", "Scan",
-                               PB().varint(3, scanner_id).bool_(5, True))
-                except HBaseRpcError:
-                    pass     # close is best-effort (scanner may have expired)
+                # NO RPC here: this finally can run inside a GC pass on
+                # a thread that already holds a transport/connection
+                # lock (abandoned generator). Queue the close; the next
+                # normal call drains it (see _drain_pending_closes).
+                self._pending_scanner_closes.append((server, scanner_id))

@@ -56,7 +56,7 @@ class MySQLLEvents(PGLEvents):
             f"CREATE TABLE IF NOT EXISTS {self._t} ("
             "  appid BIGINT NOT NULL,"
             "  channelid BIGINT NOT NULL,"
-            "  eventid VARCHAR(64) NOT NULL,"
+            "  eventid VARCHAR(255) NOT NULL,"
             "  seq BIGINT NOT NULL,"
             "  event TEXT NOT NULL,"
             "  entitytype TEXT NOT NULL,"
@@ -73,6 +73,15 @@ class MySQLLEvents(PGLEvents):
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
         eid = event.event_id or new_event_id()
+        if len(eid.encode()) > 255:
+            # the PK column is VARCHAR(255): refuse loudly rather than
+            # let a non-strict server silently truncate the id (two ids
+            # sharing a 255-byte prefix would collide and upsert over
+            # each other — silent data loss)
+            raise MySQLError(
+                1406, "22001",
+                f"eventId longer than 255 bytes ({len(eid.encode())}) "
+                "cannot be stored in the MySQL backend")
         stored = event.with_event_id(eid)
         chan = self._chan(channel_id)
         # Same atomic move-to-end-of-tie-group upsert as the PG backend,
@@ -107,12 +116,16 @@ class MySQLLEvents(PGLEvents):
         one list (the PG backend's portal streaming, in the dialect
         MySQL can do without cursor round-trip state). Each page is an
         independent query: interleaving other queries is safe here."""
+        if event_names is not None:
+            # materialize ONCE: a one-shot iterable must survive both
+            # the emptiness check and every keyset page below
+            event_names = list(event_names)
         if not (stream and limit is None and not reversed_order):
             return super().find(
                 app_id, channel_id, start_time, until_time, entity_type,
                 entity_id, event_names, target_entity_type,
                 target_entity_id, limit, reversed_order)
-        if event_names is not None and not list(event_names):
+        if event_names is not None and not event_names:
             return iter(())
         return self._find_keyset(
             app_id, channel_id, start_time, until_time, entity_type,

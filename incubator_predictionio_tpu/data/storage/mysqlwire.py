@@ -248,7 +248,12 @@ class MySQLConnection:
             if caps & CLIENT_SECURE_CONNECTION:
                 part2 = greeting[off:off + max(13, auth_len - 8)]
                 off += len(part2)
-                nonce += part2.rstrip(b"\x00")[:12]
+                # exactly the first 12 bytes: rstrip would eat salt
+                # bytes that legitimately END in 0x00 (MySQL proper
+                # never sends NUL in the salt, but protocol-compatible
+                # proxies need not honor that), breaking auth ~1/256
+                # connections per trailing zero byte
+                nonce += part2[:12]
             if caps & CLIENT_PLUGIN_AUTH:
                 end = greeting.index(b"\x00", off)
                 plugin = greeting[off:end].decode()
@@ -294,7 +299,11 @@ class MySQLConnection:
             if first == 0xFE:  # AuthSwitchRequest
                 end = pkt.index(b"\x00", 1)
                 plugin = pkt[1:end].decode()
-                nonce = pkt[end + 1:].rstrip(b"\x00")
+                raw = pkt[end + 1:]
+                # the AuthSwitch payload is the 20-byte salt + one
+                # trailing NUL terminator: strip exactly that, not
+                # every trailing zero byte of the salt itself
+                nonce = raw[:-1] if raw.endswith(b"\x00") else raw
                 self._send_packet(self._scramble(plugin, password, nonce))
                 continue
             if first == 0x01:  # AuthMoreData (caching_sha2 continuation)

@@ -207,10 +207,11 @@ def _full_cco_topk(light, heavy, lo_effs, n_i, n_j, n_total,
 def _full_matrix_elem_cap() -> int:
     """Element budget for the [I, I] accumulator: an explicit
     PIO_UR_FULL_MATRIX_ELEMS wins (malformed values fall back with a
-    warning rather than crashing training); otherwise 1/8 of the
-    device's reported memory (the scan carry double-buffers and the
-    slab/LLR intermediates need head-room), defaulting to 256M
-    elements (1 GiB f32) when the backend reports nothing."""
+    warning rather than crashing training); otherwise the accumulator
+    may use 1/4 of the device's reported memory — scan carries alias
+    (no double buffer), and the remaining 3/4 leaves head-room for the
+    bf16 slabs and LLR/top-k intermediates. TPUs whose tunnel reports
+    no memory stats assume the fleet-minimum 8 GiB."""
     raw = os.environ.get("PIO_UR_FULL_MATRIX_ELEMS")
     if raw:
         try:

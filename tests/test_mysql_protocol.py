@@ -189,3 +189,32 @@ def test_dollar_translation():
     sql, params = _dollar_to_qmark("SELECT $1, $10, $2", list(range(1, 11)))
     assert sql == "SELECT ?, ?, ?"
     assert params == [1, 10, 2]
+
+
+def test_overlong_event_id_refused_not_truncated():
+    """The events PK is VARCHAR(255): an overlong client-supplied id
+    must fail loudly — a non-strict server would silently truncate it
+    and collide distinct events (silent data loss)."""
+    import datetime as dt
+
+    from incubator_predictionio_tpu.data.storage.base import (
+        StorageClientConfig,
+    )
+    from incubator_predictionio_tpu.data.storage.datamap import DataMap
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.data.storage.mysql import MySQLClient
+    from incubator_predictionio_tpu.data.storage.mysqlwire import MySQLError
+
+    with MockMySQLServer(user="pio", password="piosecret") as srv:
+        le = MySQLClient(StorageClientConfig(properties={
+            "HOST": "127.0.0.1", "PORT": str(srv.port),
+            "USERNAME": "pio", "PASSWORD": "piosecret"})).l_events()
+        ok = Event("view", "u", "1", properties=DataMap(),
+                   event_time=dt.datetime(2026, 1, 1,
+                                          tzinfo=dt.timezone.utc),
+                   event_id="x" * 255)
+        le.insert(ok, 1)
+        assert le.get("x" * 255, 1) is not None
+        bad = ok.with_event_id("x" * 256)
+        with pytest.raises(MySQLError, match="255"):
+            le.insert(bad, 1)
