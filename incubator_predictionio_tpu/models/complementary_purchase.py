@@ -153,6 +153,7 @@ class ComplementaryAlgorithm(Algorithm):
             n_users=max(n_baskets, 1), n_items=len(td.items),
             max_correlators=p.max_correlators,
             llr_threshold=p.llr_threshold,
+            mesh=ctx.get_mesh() if ctx else None,
         )
         return ComplementaryModel(ind, td.items)
 

@@ -333,6 +333,7 @@ class URAlgorithm(Algorithm):
                 max_correlators=p.max_correlators_per_item,
                 llr_threshold=p.llr_threshold,
                 u_chunk=p.user_chunk,
+                mesh=ctx.get_mesh() if ctx else None,
             )
         # Popularity backfill ranking: raw primary-event count per item
         # (reference UR's default "popular" popModel).

@@ -182,6 +182,80 @@ def _full_cooccurrence(light, heavy, n_items: int, u_chunk: int,
     return c
 
 
+def _pad_ranges(arrs, mult: int, u_chunk: int):
+    """Pad the leading (range) axis to a device-count multiple with
+    sentinel-only rows (local offset u_chunk = padding → zero slab →
+    contributes nothing to the accumulate)."""
+    n = arrs[0].shape[0]
+    target = -(-n // mult) * mult
+    if target == n:
+        return arrs
+    out = []
+    for j, a in enumerate(arrs):
+        fill = u_chunk if j % 2 == 0 else 0   # (eu, ei) alternating
+        pad = np.full((target - n, a.shape[1]), fill, a.dtype)
+        out.append(np.concatenate([np.asarray(a), pad], axis=0))
+    return tuple(out)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "n_items", "u_chunk", "h_chunk", "block", "k",
+    "llr_threshold"))
+def _full_cco_topk_sharded(light, heavy, lo_effs, n_i, n_j, n_total, *,
+                           mesh, n_items: int, u_chunk: int, h_chunk: int,
+                           block: int, k: int, llr_threshold: float):
+    """Multi-chip full-matrix path: user ranges shard over DATA_AXIS —
+    each device scans only its local ranges and the per-device partial
+    [I, I] counts psum over ICI (counts are exact small integers in
+    f32, so the psum is exact and the result is bit-identical to the
+    single-device path — tested on the virtual mesh). LLR + top-k run
+    replicated afterwards inside the SAME jit. ``mesh`` is a static
+    arg (Mesh is hashable), so repeat trains at the same shapes reuse
+    one executable like every other kernel here."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as _P
+    from ..parallel.mesh import DATA_AXIS as _D
+
+    def counts_fn(light_l, heavy_l):
+        def mk_body(chunk_rows: int):
+            def body(c, chunk):
+                eu_p, ei_p, eu_s, ei_s = chunk
+                ap = _slab(eu_p, ei_p, chunk_rows, n_items)
+                asec = _slab(eu_s, ei_s, chunk_rows, n_items)
+                return c + jnp.einsum(
+                    "ui,uj->ij", ap, asec,
+                    preferred_element_type=jnp.float32), None
+            return body
+
+        c0 = jnp.zeros((n_items, n_items), jnp.float32)
+        # shard_map's varying-manual-axes typing: the carry starts as a
+        # replicated constant but the body output varies over the data
+        # axis — mark it varying up front
+        c0 = jax.lax.pcast(c0, (_D,), to="varying")
+        c, _ = jax.lax.scan(mk_body(u_chunk), c0, light_l)
+        if heavy_l is not None:
+            c, _ = jax.lax.scan(mk_body(h_chunk), c, heavy_l)
+        return jax.lax.psum(c, _D)
+
+    spec_rows = _P(_D, None)
+    in_specs = (tuple(spec_rows for _ in light),
+                None if heavy is None else tuple(spec_rows for _ in heavy))
+    c = shard_map(
+        counts_fn, mesh=mesh,
+        in_specs=in_specs, out_specs=_P(),
+    )(light, heavy)
+
+    def body(carry, lo_eff):
+        counts = jax.lax.dynamic_slice(c, (lo_eff, 0), (block, n_items))
+        n_i_stripe = jax.lax.dynamic_slice(n_i, (lo_eff,), (block,))
+        s, ix = _stripe_topk(counts, n_i_stripe, n_j, lo_eff, n_total,
+                             k=k, llr_threshold=llr_threshold)
+        return carry, (s, ix)
+
+    _, (ss, ixs) = jax.lax.scan(body, 0, lo_effs)
+    return ss, ixs
+
+
 @functools.partial(jax.jit, static_argnames=(
     "n_items", "u_chunk", "h_chunk", "block", "k", "llr_threshold"))
 def _full_cco_topk(light, heavy, lo_effs, n_i, n_j, n_total,
@@ -313,12 +387,15 @@ def cco_indicators(
     llr_threshold: float = 0.0,
     u_chunk: int = 1024,
     item_block: int = 4096,
+    mesh=None,
 ) -> Indicators:
     """Build the LLR-thresholded cross-occurrence indicator matrix between
     a primary event's items and a secondary event's items (same item-id
-    space; self-co-occurrence when primary==secondary). Streams the
-    co-occurrence matrix in [item_block, I] stripes, so catalog size is
-    bounded by item_block·I, not I²."""
+    space; self-co-occurrence when primary==secondary). Memory strategy
+    per _full_matrix_elem_cap; with a multi-device ``mesh`` the
+    full-matrix accumulate shards user ranges over DATA_AXIS (per-device
+    scans + one exact psum over ICI) — bit-identical results, linear
+    range-scan scaling."""
 
     def dedupe(u, i):
         # Packed-key unique: ~30x faster than np.unique(axis=0) (which
@@ -393,7 +470,22 @@ def cco_indicators(
     los = list(range(0, n_items, block))
     lo_effs_np = np.array([min(lo, n_items - block) for lo in los], np.int32)
     heavy_arg = heavy_dev if n_heavy else None
-    if n_items * n_items <= _full_matrix_elem_cap():
+    n_mesh_dev = int(mesh.devices.size) if mesh is not None else 1
+    if n_mesh_dev > 1 and n_items * n_items <= _full_matrix_elem_cap():
+        # multi-chip: ranges shard over DATA_AXIS, partial counts psum
+        light_sh = _pad_ranges(tuple(map(np.asarray, (peu, pei, seu, sei))),
+                               n_mesh_dev, u_chunk)
+        heavy_sh = None
+        if n_heavy:
+            heavy_sh = _pad_ranges(
+                tuple(map(np.asarray, (hpeu, hpei, hseu, hsei))),
+                n_mesh_dev, _HEAVY_RANGE)
+        ss, ixs = jax.device_get(_full_cco_topk_sharded(
+            light_sh, heavy_sh, jnp.asarray(lo_effs_np),
+            jnp.asarray(n_i), n_j, n_total, mesh=mesh, n_items=n_items,
+            u_chunk=u_chunk, h_chunk=_HEAVY_RANGE, block=block, k=k,
+            llr_threshold=llr_threshold))
+    elif n_items * n_items <= _full_matrix_elem_cap():
         # full-matrix path: every slab built once (see _full_cooccurrence)
         ss, ixs = jax.device_get(_full_cco_topk(
             light_dev, heavy_arg, jnp.asarray(lo_effs_np), n_i_dev, n_j,

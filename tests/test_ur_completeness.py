@@ -194,3 +194,38 @@ def test_full_matrix_and_striped_cooccurrence_identical(monkeypatch):
                              n_items=n_items, max_correlators=20)
     np.testing.assert_array_equal(full.idx, striped.idx)
     np.testing.assert_array_equal(full.score, striped.score)
+
+
+def test_sharded_cooccurrence_matches_single_device(monkeypatch):
+    """The multi-chip full-matrix path (ranges sharded over DATA_AXIS,
+    per-device partial counts psummed over the mesh) must be
+    BIT-IDENTICAL to the single-device path — counts are exact small
+    integers in f32, so the psum is exact."""
+    import jax
+    import numpy as np
+
+    from incubator_predictionio_tpu.ops.llr import cco_indicators
+    from incubator_predictionio_tpu.parallel.mesh import default_mesh
+
+    mesh = default_mesh()
+    if mesh.devices.size < 2:
+        import pytest as _pytest
+
+        _pytest.skip("needs the virtual multi-device mesh")
+    rng = np.random.default_rng(12)
+    n_users, n_items, n = 5000, 300, 80_000
+    pu = rng.integers(0, n_users, n // 4).astype(np.int32)
+    pi = rng.integers(0, n_items, n // 4).astype(np.int32)
+    su = rng.integers(0, n_users, n).astype(np.int32)
+    si = rng.integers(0, n_items, n).astype(np.int32)
+    pu[:5000] = 11   # heavy user exercises the heavy shard too
+    su[:9000] = 11
+
+    monkeypatch.setenv("PIO_UR_FULL_MATRIX_ELEMS", str(n_items * n_items))
+    single = cco_indicators(pu, pi, su, si, n_users=n_users,
+                            n_items=n_items, max_correlators=25)
+    sharded = cco_indicators(pu, pi, su, si, n_users=n_users,
+                             n_items=n_items, max_correlators=25,
+                             mesh=mesh)
+    np.testing.assert_array_equal(single.idx, sharded.idx)
+    np.testing.assert_array_equal(single.score, sharded.score)
