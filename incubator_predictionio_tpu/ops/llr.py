@@ -376,6 +376,62 @@ def _all_stripes(lo_effs, light, heavy, n_i, n_j, n_total,
     return ss, ixs
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "mesh", "n_items", "u_chunk", "block", "k", "llr_threshold",
+    "h_chunk"))
+def _all_stripes_sharded(lo_effs, light, heavy, n_i, n_j, n_total, *,
+                         mesh, n_items: int, u_chunk: int, block: int,
+                         k: int, llr_threshold: float, h_chunk: int):
+    """Multi-chip STRIPED path (catalogs whose [I, I] accumulator does
+    not fit the budget): for each stripe, every device scans its local
+    user ranges into a [block, I] partial and the partials psum over
+    ICI; LLR + top-k stay replicated. Bit-identical to the
+    single-device striped path (exact integer counts)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as _P
+    from ..parallel.mesh import DATA_AXIS as _D
+
+    def all_local(light_l, heavy_l):
+        def one_stripe(lo_eff):
+            def mk_body(chunk_rows: int):
+                def body(c, chunk):
+                    eu_p, ei_p, eu_s, ei_s = chunk
+                    ap = jax.lax.dynamic_slice(
+                        _slab(eu_p, ei_p, chunk_rows, n_items),
+                        (0, lo_eff), (chunk_rows, block))
+                    asec = _slab(eu_s, ei_s, chunk_rows, n_items)
+                    return c + jnp.einsum(
+                        "ui,uj->ij", ap, asec,
+                        preferred_element_type=jnp.float32), None
+                return body
+
+            c0 = jax.lax.pcast(
+                jnp.zeros((block, n_items), jnp.float32), (_D,),
+                to="varying")
+            c, _ = jax.lax.scan(mk_body(u_chunk), c0, light_l)
+            if heavy_l is not None:
+                c, _ = jax.lax.scan(mk_body(h_chunk), c, heavy_l)
+            return jax.lax.psum(c, _D)
+
+        def body(carry, lo_eff):
+            counts = one_stripe(lo_eff)
+            n_i_stripe = jax.lax.dynamic_slice(n_i, (lo_eff,), (block,))
+            s, ix = _stripe_topk(counts, n_i_stripe, n_j, lo_eff,
+                                 n_total, k=k,
+                                 llr_threshold=llr_threshold)
+            return carry, (s, ix)
+
+        _, (ss, ixs) = jax.lax.scan(body, 0, lo_effs)
+        return ss, ixs
+
+    spec_rows = _P(_D, None)
+    in_specs = (tuple(spec_rows for _ in light),
+                None if heavy is None else tuple(spec_rows for _ in heavy))
+    return shard_map(
+        all_local, mesh=mesh, in_specs=in_specs, out_specs=_P(),
+    )(light, heavy)
+
+
 def cco_indicators(
     primary_u: np.ndarray,
     primary_i: np.ndarray,
@@ -492,6 +548,20 @@ def cco_indicators(
             n_total, n_items=n_items, u_chunk=u_chunk,
             h_chunk=_HEAVY_RANGE, block=block, k=k,
             llr_threshold=llr_threshold))
+    elif n_mesh_dev > 1:
+        # multi-chip striped: per-stripe partials psum over the mesh
+        light_sh = _pad_ranges(tuple(map(np.asarray, (peu, pei, seu, sei))),
+                               n_mesh_dev, u_chunk)
+        heavy_sh = None
+        if n_heavy:
+            heavy_sh = _pad_ranges(
+                tuple(map(np.asarray, (hpeu, hpei, hseu, hsei))),
+                n_mesh_dev, _HEAVY_RANGE)
+        ss, ixs = jax.device_get(_all_stripes_sharded(
+            jnp.asarray(lo_effs_np), light_sh, heavy_sh,
+            jnp.asarray(n_i), n_j, n_total, mesh=mesh, n_items=n_items,
+            u_chunk=u_chunk, block=block, k=k,
+            llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE))
     else:
         ss, ixs = jax.device_get(_all_stripes(
             jnp.asarray(lo_effs_np), light_dev, heavy_arg,

@@ -229,3 +229,11 @@ def test_sharded_cooccurrence_matches_single_device(monkeypatch):
                              mesh=mesh)
     np.testing.assert_array_equal(single.idx, sharded.idx)
     np.testing.assert_array_equal(single.score, sharded.score)
+
+    # the STRIPED multi-chip path (big-catalog fallback) is identical too
+    monkeypatch.setenv("PIO_UR_FULL_MATRIX_ELEMS", "1")
+    striped_sharded = cco_indicators(pu, pi, su, si, n_users=n_users,
+                                     n_items=n_items, max_correlators=25,
+                                     mesh=mesh, item_block=128)
+    np.testing.assert_array_equal(single.idx, striped_sharded.idx)
+    np.testing.assert_array_equal(single.score, striped_sharded.score)
