@@ -505,7 +505,6 @@ def cco_indicators(
                                         n_items)
         hseu, hsei = _partition_by_user(hs_u, hs_i, h_per, h_ranges,
                                         n_items)
-        heavy_dev = tuple(map(jnp.asarray, (hpeu, hpei, hseu, hsei)))
     else:
         pu_l, pi_l, su_l, si_l = pu, pi, su, si
 
@@ -513,62 +512,59 @@ def cco_indicators(
     seu, sei = _partition_by_user(su_l, si_l, u_chunk, n_ranges, n_items)
 
     n_i = np.bincount(pi, minlength=n_items).astype(np.float32)
-    n_i_dev = jnp.asarray(n_i)
     n_j = jnp.asarray(np.bincount(si, minlength=n_items).astype(np.float32))
     n_total = jnp.float32(n_users)
 
     k = min(max_correlators, n_items)
     block = min(item_block, n_items)
-    light_dev = tuple(map(jnp.asarray, (peu, pei, seu, sei)))
 
     # Last stripe may be ragged: compute a full block ending at the
     # catalog edge and slice the overlap off (same compiled shape).
     los = list(range(0, n_items, block))
     lo_effs_np = np.array([min(lo, n_items - block) for lo in los], np.int32)
-    heavy_arg = heavy_dev if n_heavy else None
     n_mesh_dev = int(mesh.devices.size) if mesh is not None else 1
-    if n_mesh_dev > 1 and n_items * n_items <= _full_matrix_elem_cap():
-        # multi-chip: ranges shard over DATA_AXIS, partial counts psum
-        light_sh = _pad_ranges(tuple(map(np.asarray, (peu, pei, seu, sei))),
-                               n_mesh_dev, u_chunk)
+    full_fits = n_items * n_items <= _full_matrix_elem_cap()
+    if n_mesh_dev > 1:
+        # multi-chip prep, shared by both strategies: pad the range
+        # axis to a device multiple; the slabs upload ONCE, sharded by
+        # the jit (no eager single-device copy first)
+        light_sh = _pad_ranges((peu, pei, seu, sei), n_mesh_dev, u_chunk)
         heavy_sh = None
         if n_heavy:
-            heavy_sh = _pad_ranges(
-                tuple(map(np.asarray, (hpeu, hpei, hseu, hsei))),
-                n_mesh_dev, _HEAVY_RANGE)
-        ss, ixs = jax.device_get(_full_cco_topk_sharded(
-            light_sh, heavy_sh, jnp.asarray(lo_effs_np),
-            jnp.asarray(n_i), n_j, n_total, mesh=mesh, n_items=n_items,
-            u_chunk=u_chunk, h_chunk=_HEAVY_RANGE, block=block, k=k,
-            llr_threshold=llr_threshold))
-    elif n_items * n_items <= _full_matrix_elem_cap():
-        # full-matrix path: every slab built once (see _full_cooccurrence)
-        ss, ixs = jax.device_get(_full_cco_topk(
-            light_dev, heavy_arg, jnp.asarray(lo_effs_np), n_i_dev, n_j,
-            n_total, n_items=n_items, u_chunk=u_chunk,
-            h_chunk=_HEAVY_RANGE, block=block, k=k,
-            llr_threshold=llr_threshold))
-    elif n_mesh_dev > 1:
-        # multi-chip striped: per-stripe partials psum over the mesh
-        light_sh = _pad_ranges(tuple(map(np.asarray, (peu, pei, seu, sei))),
-                               n_mesh_dev, u_chunk)
-        heavy_sh = None
-        if n_heavy:
-            heavy_sh = _pad_ranges(
-                tuple(map(np.asarray, (hpeu, hpei, hseu, hsei))),
-                n_mesh_dev, _HEAVY_RANGE)
-        ss, ixs = jax.device_get(_all_stripes_sharded(
-            jnp.asarray(lo_effs_np), light_sh, heavy_sh,
-            jnp.asarray(n_i), n_j, n_total, mesh=mesh, n_items=n_items,
-            u_chunk=u_chunk, block=block, k=k,
-            llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE))
+            heavy_sh = _pad_ranges((hpeu, hpei, hseu, hsei),
+                                   n_mesh_dev, _HEAVY_RANGE)
+        fn = _full_cco_topk_sharded if full_fits else _all_stripes_sharded
+        if full_fits:
+            ss, ixs = jax.device_get(fn(
+                light_sh, heavy_sh, jnp.asarray(lo_effs_np),
+                jnp.asarray(n_i), n_j, n_total, mesh=mesh,
+                n_items=n_items, u_chunk=u_chunk, h_chunk=_HEAVY_RANGE,
+                block=block, k=k, llr_threshold=llr_threshold))
+        else:
+            ss, ixs = jax.device_get(fn(
+                jnp.asarray(lo_effs_np), light_sh, heavy_sh,
+                jnp.asarray(n_i), n_j, n_total, mesh=mesh,
+                n_items=n_items, u_chunk=u_chunk, block=block, k=k,
+                llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE))
     else:
-        ss, ixs = jax.device_get(_all_stripes(
-            jnp.asarray(lo_effs_np), light_dev, heavy_arg,
-            n_i_dev, n_j, n_total,
-            n_items=n_items, u_chunk=u_chunk, block=block, k=k,
-            llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE,
-        ))
+        n_i_dev = jnp.asarray(n_i)
+        light_dev = tuple(map(jnp.asarray, (peu, pei, seu, sei)))
+        heavy_arg = (tuple(map(jnp.asarray, (hpeu, hpei, hseu, hsei)))
+                     if n_heavy else None)
+        if full_fits:
+            # full-matrix path: every slab built once (_full_cooccurrence)
+            ss, ixs = jax.device_get(_full_cco_topk(
+                light_dev, heavy_arg, jnp.asarray(lo_effs_np), n_i_dev,
+                n_j, n_total, n_items=n_items, u_chunk=u_chunk,
+                h_chunk=_HEAVY_RANGE, block=block, k=k,
+                llr_threshold=llr_threshold))
+        else:
+            ss, ixs = jax.device_get(_all_stripes(
+                jnp.asarray(lo_effs_np), light_dev, heavy_arg,
+                n_i_dev, n_j, n_total,
+                n_items=n_items, u_chunk=u_chunk, block=block, k=k,
+                llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE,
+            ))
 
     idx_parts, score_parts = [], []
     for j, lo in enumerate(los):
