@@ -24,6 +24,12 @@ from ..data.store.l_event_store import LEventStore
 from ..data.store.p_event_store import PEventStore
 from ..data.storage.bimap import BiMap
 from ..ops.als import ALSFactors, ALSParams, train_als
+from ..ops.sharded_topk import (
+    put_sharded_catalog,
+    serving_mesh_for,
+    sharded_top_k_items,
+    validate_serving_mode,
+)
 from ..ops.topk import top_k_items
 from ._filters import CategoryIndex, build_exclude_mask
 from .similar_product import (
@@ -52,6 +58,16 @@ class ECommerceModel:
     _dev_items: object = dataclasses.field(default=None, repr=False, compare=False)
     _storage: object = dataclasses.field(default=None, repr=False, compare=False)
     _cat_index: object = dataclasses.field(default=None, repr=False, compare=False)
+    # PAlgorithm serving analog: when set, the catalog is sharded over
+    # every mesh device at serve time (ops.sharded_topk).
+    serving_mesh: object = dataclasses.field(default=None, repr=False, compare=False)
+    _sharded_cat: object = dataclasses.field(default=None, repr=False, compare=False)
+
+    def sharded_catalog(self):
+        if self._sharded_cat is None:
+            self._sharded_cat = put_sharded_catalog(
+                self.factors.item_factors, self.serving_mesh)
+        return self._sharded_cat
 
     def category_index(self) -> CategoryIndex:
         if self._cat_index is None:
@@ -66,7 +82,10 @@ class ECommerceModel:
         return self._dev_items
 
     def warm_up(self, num: int = 10):
-        self.device_item_factors()
+        if self.serving_mesh is None:
+            self.device_item_factors()
+        else:
+            self.sharded_catalog()
         if len(self.users):
             self.recommend(next(iter(self.users.keys())), num)
 
@@ -116,10 +135,16 @@ class ECommerceModel:
             self.items, self.category_index(), categories,
             white_list, black_list, extra_excluded_items=extra,
         )
-        scores, idx = top_k_items(
-            self.factors.user_factors[uidx], self.device_item_factors(),
-            num, exclude=exclude,
-        )
+        if self.serving_mesh is not None:
+            scores, idx = sharded_top_k_items(
+                self.factors.user_factors[uidx], self.sharded_catalog(),
+                num, exclude=exclude,
+            )
+        else:
+            scores, idx = top_k_items(
+                self.factors.user_factors[uidx], self.device_item_factors(),
+                num, exclude=exclude,
+            )
         return [
             (self.items.inverse(int(j)), float(s))
             for s, j in zip(scores, idx)
@@ -140,6 +165,8 @@ class ECommerceAlgoParams(Params):
     # reproduce pre-auto runs exactly. -1 → auto HBM-budget chunking.
     compute_dtype: str = "auto"
     chunk_tiles: int = -1
+    # engine.json "shardedServing": auto|always|never (ops.sharded_topk).
+    sharded_serving: str = "auto"
 
 
 class ECommerceAlgorithm(Algorithm):
@@ -148,10 +175,12 @@ class ECommerceAlgorithm(Algorithm):
         "appName": "app_name", "lambda": "reg",
         "numIterations": "num_iterations", "seenEvents": "seen_events",
         "computeDtype": "compute_dtype", "chunkTiles": "chunk_tiles",
+        "shardedServing": "sharded_serving",
     }
 
     def train(self, ctx, pd) -> ECommerceModel:
         p = self.params
+        validate_serving_mode(p.sharded_serving)  # before the expensive run
         factors = train_als(
             pd.user_idx, pd.item_idx, pd.rating,
             n_users=len(pd.users), n_items=len(pd.items),
@@ -172,6 +201,8 @@ class ECommerceAlgorithm(Algorithm):
             seen_event_names=tuple(p.seen_events),
         )
         model._storage = ctx.get_storage()
+        model.serving_mesh = serving_mesh_for(
+            ctx, len(pd.items), p.rank, p.sharded_serving)
         return model
 
     def predict(self, model: ECommerceModel, query: dict) -> dict:
@@ -199,6 +230,11 @@ class ECommerceAlgorithm(Algorithm):
     def restore_model(self, stored, ctx) -> ECommerceModel:
         if isinstance(stored, ECommerceModel):
             stored._storage = ctx.get_storage()
+            if stored.serving_mesh is None:
+                stored.serving_mesh = serving_mesh_for(
+                    ctx, stored.factors.item_factors.shape[0],
+                    stored.factors.item_factors.shape[1],
+                    self.params.sharded_serving)
             return stored
         uf, itf = stored["user_factors"], stored["item_factors"]
         model = ECommerceModel(
@@ -210,6 +246,8 @@ class ECommerceAlgorithm(Algorithm):
             seen_event_names=tuple(stored["seen_event_names"]),
         )
         model._storage = ctx.get_storage()
+        model.serving_mesh = serving_mesh_for(
+            ctx, itf.shape[0], itf.shape[1], self.params.sharded_serving)
         return model
 
 

@@ -34,6 +34,13 @@ from ..controller import (
 from ..data.storage.bimap import BiMap
 from ..data.store.p_event_store import PEventStore
 from ..ops.als import ALSFactors, ALSParams, train_als
+from ..ops.sharded_topk import (
+    put_sharded_catalog,
+    serving_mesh_for,
+    sharded_batch_top_k,
+    sharded_top_k_items,
+    validate_serving_mode,
+)
 from ..ops.topk import batch_top_k, top_k_items
 
 
@@ -65,6 +72,13 @@ class ALSModel:
     # it every query re-uploads the whole matrix and p50 blows past the
     # 10ms budget (the serving hot path uploads only the k-float user vec).
     _dev_items: object = dataclasses.field(default=None, repr=False, compare=False)
+    # When set (a Mesh), the catalog is served SHARDED over every mesh
+    # device instead of replicated on one chip — the PAlgorithm serving
+    # analog for factor matrices beyond one chip's HBM (reference:
+    # core/.../controller/PAlgorithm.scala — batchPredict). Populated by
+    # train/restore_model via ops.sharded_topk.should_shard_serving.
+    serving_mesh: object = dataclasses.field(default=None, repr=False, compare=False)
+    _sharded_cat: object = dataclasses.field(default=None, repr=False, compare=False)
 
     def device_item_factors(self):
         if self._dev_items is None:
@@ -73,9 +87,18 @@ class ALSModel:
             self._dev_items = jax.device_put(self.factors.item_factors)
         return self._dev_items
 
+    def sharded_catalog(self):
+        if self._sharded_cat is None:
+            self._sharded_cat = put_sharded_catalog(
+                self.factors.item_factors, self.serving_mesh)
+        return self._sharded_cat
+
     def warm_up(self, num: int = 10):
         """Compile + cache the serving executable (called at deploy time)."""
-        self.device_item_factors()
+        if self.serving_mesh is None:
+            self.device_item_factors()
+        else:
+            self.sharded_catalog()
         if len(self.users):
             self.recommend_products(next(iter(self.users.keys())), num)
 
@@ -90,9 +113,14 @@ class ALSModel:
         uidx = self.users.get(user)
         if uidx is None:
             return []
-        scores, idx = top_k_items(
-            self.factors.user_factors[uidx], self.device_item_factors(), num
-        )
+        if self.serving_mesh is not None:
+            scores, idx = sharded_top_k_items(
+                self.factors.user_factors[uidx], self.sharded_catalog(), num
+            )
+        else:
+            scores, idx = top_k_items(
+                self.factors.user_factors[uidx], self.device_item_factors(), num
+            )
         return [
             (self.items.inverse(int(i)), float(s))
             for s, i in zip(scores, idx)
@@ -171,6 +199,10 @@ class AlgorithmParams(Params):
     # None → auto-detect all-ones ratings and elide value-slab upload
     # (ops.als.ALSParams.binary_ratings); engine.json "binaryRatings".
     binary_ratings: Optional[bool] = None
+    # "auto" → shard the serving catalog over the mesh when the item
+    # factors exceed one chip's HBM budget (ops.sharded_topk);
+    # engine.json "shardedServing": auto|always|never.
+    sharded_serving: str = "auto"
 
 
 class ALSAlgorithm(Algorithm):
@@ -188,6 +220,7 @@ class ALSAlgorithm(Algorithm):
         "computeDtype": "compute_dtype",
         "chunkTiles": "chunk_tiles",
         "binaryRatings": "binary_ratings",
+        "shardedServing": "sharded_serving",
     }
 
     @staticmethod
@@ -207,6 +240,7 @@ class ALSAlgorithm(Algorithm):
         )
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
+        validate_serving_mode(self.params.sharded_serving)  # before the expensive run
         factors = train_als(
             pd.user_idx, pd.item_idx, pd.rating,
             n_users=len(pd.users), n_items=len(pd.items),
@@ -218,7 +252,10 @@ class ALSAlgorithm(Algorithm):
             # timings dict on the context; absent in normal training.
             timings=getattr(ctx, "bench_timings", None),
         )
-        return ALSModel(factors=factors, users=pd.users, items=pd.items)
+        model = ALSModel(factors=factors, users=pd.users, items=pd.items)
+        model.serving_mesh = serving_mesh_for(
+            ctx, len(pd.items), self.params.rank, self.params.sharded_serving)
+        return model
 
     @staticmethod
     def _is_ranking_query(query: dict) -> bool:
@@ -298,7 +335,11 @@ class ALSAlgorithm(Algorithm):
         num = max(int(q.get("num", 10)) for q in queries)
         # device-resident factors (cached) — passing the host array would
         # re-upload the full catalog matrix on every serving micro-batch
-        scores, idx = batch_top_k(uvecs, model.device_item_factors(), num)
+        if model.serving_mesh is not None:
+            scores, idx = sharded_batch_top_k(
+                uvecs, model.sharded_catalog(), num)
+        else:
+            scores, idx = batch_top_k(uvecs, model.device_item_factors(), num)
         out = []
         for j, (q, ok) in enumerate(zip(queries, known)):
             if not ok:
@@ -326,14 +367,22 @@ class ALSAlgorithm(Algorithm):
 
     def restore_model(self, stored, ctx) -> ALSModel:
         if isinstance(stored, ALSModel):
+            if stored.serving_mesh is None:
+                stored.serving_mesh = serving_mesh_for(
+                    ctx, stored.factors.item_factors.shape[0],
+                    stored.factors.item_factors.shape[1],
+                    self.params.sharded_serving)
             return stored
         uf = stored["user_factors"]
         itf = stored["item_factors"]
-        return ALSModel(
+        model = ALSModel(
             factors=ALSFactors(uf, itf, uf.shape[0], itf.shape[0]),
             users=BiMap(stored["users"]),
             items=BiMap(stored["items"]),
         )
+        model.serving_mesh = serving_mesh_for(
+            ctx, itf.shape[0], itf.shape[1], self.params.sharded_serving)
+        return model
 
 
 class RecommendationEngine(EngineFactory):

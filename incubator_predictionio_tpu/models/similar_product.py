@@ -25,7 +25,13 @@ from ..controller import Algorithm, DataSource, Engine, EngineFactory, Params, S
 from ..data.storage.bimap import BiMap
 from ..data.store.p_event_store import PEventStore
 from ..ops.als import ALSFactors, ALSParams, train_als
-from ..ops.topk import similar_items
+from ..ops.sharded_topk import (
+    put_sharded_catalog,
+    serving_mesh_for,
+    sharded_similar_items,
+    validate_serving_mode,
+)
+from ..ops.topk import normalize_rows, similar_items
 from ._filters import CategoryIndex, build_exclude_mask
 
 
@@ -83,6 +89,10 @@ class SimilarProductModel:
     item_categories: dict[str, set[str]]
     _dev_items: object = dataclasses.field(default=None, repr=False, compare=False)
     _cat_index: object = dataclasses.field(default=None, repr=False, compare=False)
+    # PAlgorithm serving analog: when set, the catalog is sharded over
+    # every mesh device at serve time (ops.sharded_topk).
+    serving_mesh: object = dataclasses.field(default=None, repr=False, compare=False)
+    _sharded_cat: object = dataclasses.field(default=None, repr=False, compare=False)
 
     def category_index(self) -> CategoryIndex:
         if self._cat_index is None:
@@ -90,14 +100,26 @@ class SimilarProductModel:
         return self._cat_index
 
     def device_item_factors(self):
+        """Row-NORMALIZED catalog, resident on device (cosine serving
+        needs unit rows; normalizing once here instead of per query)."""
         if self._dev_items is None:
             import jax
 
-            self._dev_items = jax.device_put(self.factors.item_factors)
+            self._dev_items = jax.device_put(
+                normalize_rows(self.factors.item_factors))
         return self._dev_items
 
+    def sharded_catalog(self):
+        if self._sharded_cat is None:
+            self._sharded_cat = put_sharded_catalog(
+                normalize_rows(self.factors.item_factors), self.serving_mesh)
+        return self._sharded_cat
+
     def warm_up(self, num: int = 10):
-        self.device_item_factors()
+        if self.serving_mesh is None:
+            self.device_item_factors()
+        else:
+            self.sharded_catalog()
         if len(self.items):
             self.similar([next(iter(self.items.keys()))], num)
 
@@ -119,9 +141,14 @@ class SimilarProductModel:
         )
         exclude[idxs] = True  # never return the query items themselves
         qvecs = self.factors.item_factors[idxs]
-        scores, idx = similar_items(
-            qvecs, self.device_item_factors(), num, exclude=exclude
-        )
+        if self.serving_mesh is not None:
+            scores, idx = sharded_similar_items(
+                qvecs, self.sharded_catalog(), num, exclude=exclude
+            )
+        else:
+            scores, idx = similar_items(
+                qvecs, self.device_item_factors(), num, exclude=exclude
+            )
         return [
             (self.items.inverse(int(j)), float(s))
             for s, j in zip(scores, idx)
@@ -140,6 +167,8 @@ class SimilarProductAlgoParams(Params):
     # reproduce pre-auto runs exactly. -1 → auto HBM-budget chunking.
     compute_dtype: str = "auto"
     chunk_tiles: int = -1
+    # engine.json "shardedServing": auto|always|never (ops.sharded_topk).
+    sharded_serving: str = "auto"
 
 
 class SimilarProductAlgorithm(Algorithm):
@@ -147,10 +176,12 @@ class SimilarProductAlgorithm(Algorithm):
     params_aliases = {
         "lambda": "reg", "numIterations": "num_iterations",
         "computeDtype": "compute_dtype", "chunkTiles": "chunk_tiles",
+        "shardedServing": "sharded_serving",
     }
 
     def train(self, ctx, pd: PreparedData) -> SimilarProductModel:
         p = self.params
+        validate_serving_mode(p.sharded_serving)  # before the expensive run
         factors = train_als(
             pd.user_idx, pd.item_idx, pd.rating,
             n_users=len(pd.users), n_items=len(pd.items),
@@ -164,7 +195,10 @@ class SimilarProductAlgorithm(Algorithm):
             checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
             resume=bool(ctx and ctx.workflow_params.resume),
         )
-        return SimilarProductModel(factors, pd.items, pd.item_categories)
+        model = SimilarProductModel(factors, pd.items, pd.item_categories)
+        model.serving_mesh = serving_mesh_for(
+            ctx, len(pd.items), p.rank, p.sharded_serving)
+        return model
 
     def predict(self, model: SimilarProductModel, query: dict) -> dict:
         pairs = model.similar(
@@ -186,13 +220,21 @@ class SimilarProductAlgorithm(Algorithm):
 
     def restore_model(self, stored, ctx) -> SimilarProductModel:
         if isinstance(stored, SimilarProductModel):
+            if stored.serving_mesh is None:
+                stored.serving_mesh = serving_mesh_for(
+                    ctx, stored.factors.item_factors.shape[0],
+                    stored.factors.item_factors.shape[1],
+                    self.params.sharded_serving)
             return stored
         uf, itf = stored["user_factors"], stored["item_factors"]
-        return SimilarProductModel(
+        model = SimilarProductModel(
             factors=ALSFactors(uf, itf, uf.shape[0], itf.shape[0]),
             items=BiMap(stored["items"]),
             item_categories={k: set(v) for k, v in stored["item_categories"].items()},
         )
+        model.serving_mesh = serving_mesh_for(
+            ctx, itf.shape[0], itf.shape[1], self.params.sharded_serving)
+        return model
 
 
 class SimilarProductEngine(EngineFactory):

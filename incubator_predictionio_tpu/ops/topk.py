@@ -18,7 +18,13 @@ import numpy as np
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def _topk_scores(user_vec, item_factors, exclude_mask, k: int):
-    scores = item_factors @ user_vec  # [n_items]
+    # mul+reduce instead of a gemv: the reduction tree over rank is then
+    # independent of the row count, so a MODEL_AXIS-sharded catalog
+    # (ops/sharded_topk.py) produces bitwise-identical scores. A gemv's
+    # row-block tail handling varies with n_items — measured 1-ULP
+    # differences on row slices. Cost: none; the serving matvec is
+    # HBM-bandwidth-bound on reading the catalog either way.
+    scores = (item_factors * user_vec[None, :]).sum(axis=1)  # [n_items]
     scores = jnp.where(exclude_mask, -jnp.inf, scores)
     return jax.lax.top_k(scores, k)
 
@@ -47,6 +53,30 @@ def _batch_topk(user_vecs, item_factors, k: int):
     return jax.lax.top_k(scores, k)
 
 
+def bucket_k(k: int, n_total: int) -> int:
+    """Pow2 (≥8) k buckets so clients varying "num" share executables.
+    Shared by the single-device and sharded (ops/sharded_topk.py) paths —
+    the sharded bit-identity guarantee depends on both bucketing alike."""
+    return min(max(8, 1 << max(k - 1, 0).bit_length()), n_total)
+
+
+def pad_batch_pow2(user_vecs: np.ndarray) -> np.ndarray:
+    """Pad the batch dim to the next power of two (serving batches vary
+    per micro-batch window; unpadded shapes would compile one executable
+    per distinct size). Batches >256 pass through: eval / `pio
+    batchpredict` call once with thousands of fixed-size queries — one
+    compile either way, and pow2 padding there would waste up to 2x the
+    matmul. (EngineServer caps its micro-batch max_batch at 256 to match.)"""
+    b = user_vecs.shape[0]
+    bp = (1 << max(b - 1, 0).bit_length()) if b <= 256 else b
+    if bp == b:
+        return user_vecs
+    return np.concatenate(
+        [user_vecs,
+         np.zeros((bp - b,) + user_vecs.shape[1:], user_vecs.dtype)],
+        axis=0)
+
+
 def batch_top_k(user_vecs, item_factors, k: int):
     """Vectorized top-k for batch_predict/eval sweeps and the serving
     micro-batch path. The batch dim is padded to the next power of two:
@@ -56,43 +86,33 @@ def batch_top_k(user_vecs, item_factors, k: int):
     user_vecs = np.asarray(user_vecs)
     k = min(int(k), item_factors.shape[0])
     b = user_vecs.shape[0]
-    # Pad only serving-scale batches: eval / `pio batchpredict` call this
-    # once with thousands of fixed-size queries — one compile either way,
-    # and pow2 padding there would waste up to 2x the matmul.
-    # (EngineServer caps its micro-batch max_batch at 256 to match.)
-    bp = (1 << max(b - 1, 0).bit_length()) if b <= 256 else b
-    # k is a static jit arg too: bucket it to the next pow2 (≥8) so
-    # clients varying "num" share executables per bucket instead of
-    # compiling one per distinct value.
-    kp = min(max(8, 1 << max(k - 1, 0).bit_length()), item_factors.shape[0])
-    if bp != b:
-        user_vecs = np.concatenate(
-            [user_vecs, np.zeros((bp - b,) + user_vecs.shape[1:],
-                                 user_vecs.dtype)], axis=0)
+    # k is a static jit arg too: bucketed so clients varying "num" share
+    # executables per bucket instead of compiling one per distinct value.
+    kp = bucket_k(k, item_factors.shape[0])
+    user_vecs = pad_batch_pow2(user_vecs)
     scores, idx = jax.device_get(
         _batch_topk(jnp.asarray(user_vecs), jnp.asarray(item_factors), kp)
     )
     return scores[:b, :k], idx[:b, :k]
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _item_sim_topk(query_vecs, item_factors, exclude_mask, k: int):
-    """Cosine similarity of query items against the catalog, summed over
-    query items (similar-product semantics)."""
-    qn = query_vecs / (jnp.linalg.norm(query_vecs, axis=1, keepdims=True) + 1e-9)
-    fn = item_factors / (jnp.linalg.norm(item_factors, axis=1, keepdims=True) + 1e-9)
-    scores = (fn @ qn.T).sum(axis=1)  # [n_items]
-    scores = jnp.where(exclude_mask, -jnp.inf, scores)
-    return jax.lax.top_k(scores, k)
+def normalize_rows(x) -> np.ndarray:
+    """Row-normalize a factor matrix on the host (float32). Done ONCE at
+    deploy/warm-up time: per-query catalog normalization was O(N·rank)
+    wasted work, and device-side norm reductions vary bitwise with the
+    row count at small shapes, which would break the sharded-catalog
+    bit-identity guarantee (ops/sharded_topk.py)."""
+    x = np.asarray(x, np.float32)
+    return x / (np.linalg.norm(x, axis=1, keepdims=True) + 1e-9)
 
 
-def similar_items(query_vecs, item_factors, k: int, exclude=None):
-    n_items = item_factors.shape[0]
-    if exclude is None:
-        exclude = jnp.zeros((n_items,), dtype=bool)
-    k = min(int(k), n_items)
-    return jax.device_get(
-        _item_sim_topk(
-            jnp.asarray(query_vecs), jnp.asarray(item_factors), jnp.asarray(exclude), k
-        )
-    )
+def similar_items(query_vecs, item_factors_normed, k: int, exclude=None):
+    """Summed cosine similarity of query items against the catalog
+    (similar-product semantics). ``item_factors_normed`` must be
+    row-normalized (normalize_rows) — model caches do this once.
+
+    sum_q dot(f, qn_q) == dot(f, sum_q qn_q): the query vectors fold
+    into one, so this is exactly the top_k_items matvec — one kernel,
+    shared executables, and bitwise parity with the sharded path."""
+    qn = normalize_rows(np.atleast_2d(np.asarray(query_vecs, np.float32)))
+    return top_k_items(qn.sum(axis=0), item_factors_normed, k, exclude=exclude)

@@ -1,0 +1,265 @@
+"""Serve-time sharded models (the PAlgorithm serving analog).
+
+Reference: core/.../controller/PAlgorithm.scala — batchPredict: models
+that stay distributed at serve time. Here: item-factor catalogs sharded
+over every device of the 8-CPU virtual mesh, queried via per-shard top-k
++ k-candidate all_gather merge (ops/sharded_topk.py). The invariant under
+test is bit-identity with the single-device kernels for the matvec and
+similarity paths, and identical indices/ordering (scores ≤2 ULP — gemm
+output-shape blocking, documented in the module) for the batched path.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from incubator_predictionio_tpu.ops.sharded_topk import (  # noqa: E402
+    put_sharded_catalog,
+    sharded_batch_top_k,
+    sharded_similar_items,
+    sharded_top_k_items,
+    should_shard_serving,
+)
+from incubator_predictionio_tpu.ops.topk import (  # noqa: E402
+    batch_top_k,
+    similar_items,
+    top_k_items,
+)
+from incubator_predictionio_tpu.parallel.mesh import (  # noqa: E402
+    mesh_from_devices,
+)
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    rng = np.random.default_rng(7)
+    n_items, rank = 1003, 16  # deliberately not a multiple of 8 (padding)
+    items = rng.normal(size=(n_items, rank)).astype(np.float32)
+    return items
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return mesh_from_devices()  # 1-D over the 8 virtual CPU devices
+
+
+# -- kernel-level identity --------------------------------------------------
+
+
+def test_single_query_bit_identical(catalog, mesh8):
+    cat = put_sharded_catalog(catalog, mesh8)
+    rng = np.random.default_rng(1)
+    for _ in range(3):
+        uv = rng.normal(size=(catalog.shape[1],)).astype(np.float32)
+        s0, i0 = top_k_items(uv, catalog, 10)
+        s1, i1 = sharded_top_k_items(uv, cat, 10)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(s0, s1)  # bitwise
+
+
+def test_single_query_with_exclude_bit_identical(catalog, mesh8):
+    cat = put_sharded_catalog(catalog, mesh8)
+    rng = np.random.default_rng(2)
+    uv = rng.normal(size=(catalog.shape[1],)).astype(np.float32)
+    excl = np.zeros(catalog.shape[0], bool)
+    excl[rng.integers(0, catalog.shape[0], 300)] = True
+    s0, i0 = top_k_items(uv, catalog, 25, exclude=excl)
+    s1, i1 = sharded_top_k_items(uv, cat, 25, exclude=excl)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+def test_similarity_bit_identical(catalog, mesh8):
+    from incubator_predictionio_tpu.ops.topk import normalize_rows
+
+    normed = normalize_rows(catalog)
+    cat = put_sharded_catalog(normed, mesh8)
+    qv = catalog[[3, 77, 500]]
+    excl = np.zeros(catalog.shape[0], bool)
+    excl[[3, 77, 500]] = True
+    s0, i0 = similar_items(qv, normed, 9, exclude=excl)
+    s1, i1 = sharded_similar_items(qv, cat, 9, exclude=excl)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+def test_batch_identical_selection(catalog, mesh8):
+    cat = put_sharded_catalog(catalog, mesh8)
+    rng = np.random.default_rng(3)
+    uvs = rng.normal(size=(13, catalog.shape[1])).astype(np.float32)
+    s0, i0 = batch_top_k(uvs, catalog, 7)
+    s1, i1 = sharded_batch_top_k(uvs, cat, 7)
+    np.testing.assert_array_equal(i0, i1)  # same items, same order
+    np.testing.assert_allclose(s0, s1, rtol=0, atol=4e-6)
+
+
+def test_2d_mesh_matches_1d(catalog):
+    """The (d, m)=(4, 2) ALX mesh serves the same answers as the 1-D
+    mesh and as a single device — sharding layout is invisible."""
+    mesh2 = mesh_from_devices(shape=(4, 2), axis_names=("d", "m"))
+    cat = put_sharded_catalog(catalog, mesh2)
+    rng = np.random.default_rng(4)
+    uv = rng.normal(size=(catalog.shape[1],)).astype(np.float32)
+    s0, i0 = top_k_items(uv, catalog, 12)
+    s1, i1 = sharded_top_k_items(uv, cat, 12)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+def test_k_larger_than_shard_rows(mesh8):
+    """k greater than a shard's local row count: every shard contributes
+    all of its rows and the merge is still exact."""
+    rng = np.random.default_rng(5)
+    items = rng.normal(size=(40, 4)).astype(np.float32)  # 5 rows/shard
+    cat = put_sharded_catalog(items, mesh8)
+    uv = rng.normal(size=(4,)).astype(np.float32)
+    s0, i0 = top_k_items(uv, items, 20)
+    s1, i1 = sharded_top_k_items(uv, cat, 20)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+def test_tie_break_matches_lax_top_k(mesh8):
+    """Duplicate scores across shards: the merge must pick the lowest
+    global index first, exactly like lax.top_k on the unsharded row."""
+    items = np.zeros((64, 2), np.float32)
+    items[:, 0] = np.repeat([5.0, 4.0, 3.0, 2.0], 16)  # many exact ties
+    cat = put_sharded_catalog(items, mesh8)
+    uv = np.array([1.0, 0.0], np.float32)
+    s0, i0 = top_k_items(uv, items, 24)
+    s1, i1 = sharded_top_k_items(uv, cat, 24)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+# -- sharding policy --------------------------------------------------------
+
+
+def test_should_shard_policy(mesh8, monkeypatch):
+    assert not should_shard_serving(10**6, 64, None, "always")
+    assert not should_shard_serving(10**6, 64, mesh8, "never")
+    assert should_shard_serving(100, 4, mesh8, "always")
+    monkeypatch.setenv("PIO_SHARDED_SERVING_BYTES", "1000000")
+    assert should_shard_serving(10**6, 64, mesh8, "auto")
+    assert not should_shard_serving(100, 4, mesh8, "auto")
+    single = mesh_from_devices(devices=jax.devices()[:1])
+    assert not should_shard_serving(10**9, 128, single, "always")
+    with pytest.raises(ValueError):
+        should_shard_serving(1, 1, mesh8, "sometimes")
+
+
+# -- template-level: sharded deployment answers like a single chip ----------
+
+
+def _train_recommendation(memory_storage, sharded: str):
+    import datetime as dt
+
+    from incubator_predictionio_tpu.controller import EngineParams
+    from incubator_predictionio_tpu.data.storage import App, DataMap, Event
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine,
+    )
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import (
+        load_deployment,
+        run_train,
+    )
+
+    name = f"shardapp-{sharded}"
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, name))
+    le = memory_storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(11)
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    events = []
+    for n in range(600):
+        u, i = int(rng.integers(0, 40)), int(rng.integers(0, 60))
+        events.append(
+            Event("rate", "user", str(u), "item", str(i),
+                  properties=DataMap({"rating": float(1 + (u * i) % 5)}),
+                  event_time=t0 + dt.timedelta(seconds=n)))
+    le.insert_batch(events, app_id)
+
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name=name, storage=memory_storage)
+    ep = EngineParams.from_json({
+        "datasource": {"params": {"appName": name}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 8, "numIterations": 3, "computeDtype": "float32",
+            "shardedServing": sharded}}],
+    })
+    iid = run_train(engine, ep, ctx, engine_factory_name=f"rec-{sharded}")
+    dep, _, _ = load_deployment(
+        engine, iid, WorkflowContext(storage=memory_storage),
+        engine_factory_name=f"rec-{sharded}")
+    return dep
+
+
+def test_recommendation_template_sharded_matches_single(memory_storage):
+    dep_plain = _train_recommendation(memory_storage, "never")
+    dep_shard = _train_recommendation(memory_storage, "always")
+    model = dep_shard.models[0]
+    assert model.serving_mesh is not None, "always → sharded deployment"
+    for user in ("1", "7", "23", "unknown-user"):
+        q = {"user": user, "num": 5}
+        assert dep_shard.query(q) == dep_plain.query(q)
+    # batched path (the serving micro-batch / pio batchpredict surface)
+    qs = [{"user": str(u), "num": 4} for u in (0, 3, 9, 31, 39)]
+    out_s = dep_shard.batch_query(qs)
+    out_p = dep_plain.batch_query(qs)
+    for a, b in zip(out_s, out_p):
+        assert [x["item"] for x in a["itemScores"]] == [
+            x["item"] for x in b["itemScores"]]
+        np.testing.assert_allclose(
+            [x["score"] for x in a["itemScores"]],
+            [x["score"] for x in b["itemScores"]], rtol=0, atol=4e-6)
+
+
+def test_similar_product_template_sharded_matches_single(memory_storage):
+    import datetime as dt
+
+    from incubator_predictionio_tpu.controller import EngineParams
+    from incubator_predictionio_tpu.data.storage import App, DataMap, Event
+    from incubator_predictionio_tpu.models.similar_product import (
+        SimilarProductEngine,
+    )
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import (
+        load_deployment,
+        run_train,
+    )
+
+    name = "spshard"
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, name))
+    le = memory_storage.get_l_events()
+    le.init(app_id)
+    rng = np.random.default_rng(13)
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    events = [
+        Event("view", "user", str(int(rng.integers(0, 30))),
+              "item", str(int(rng.integers(0, 50))),
+              event_time=t0 + dt.timedelta(seconds=n))
+        for n in range(400)
+    ]
+    le.insert_batch(events, app_id)
+
+    engine = SimilarProductEngine()()
+    ctx = WorkflowContext(app_name=name, storage=memory_storage)
+    deps = {}
+    for mode in ("never", "always"):
+        ep = EngineParams.from_json({
+            "datasource": {"params": {"appName": name}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "numIterations": 3, "computeDtype": "float32",
+                "shardedServing": mode}}],
+        })
+        iid = run_train(engine, ep, ctx, engine_factory_name=f"sp-{mode}")
+        deps[mode], _, _ = load_deployment(
+            engine, iid, WorkflowContext(storage=memory_storage),
+            engine_factory_name=f"sp-{mode}")
+    assert deps["always"].models[0].serving_mesh is not None
+    for q in ({"items": ["1"], "num": 5},
+              {"items": ["2", "9"], "num": 7},
+              {"items": ["3"], "num": 5, "blackList": ["4", "5"]}):
+        assert deps["always"].query(q) == deps["never"].query(q)
