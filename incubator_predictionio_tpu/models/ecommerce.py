@@ -25,12 +25,12 @@ from ..data.store.p_event_store import PEventStore
 from ..data.storage.bimap import BiMap
 from ..ops.als import ALSFactors, ALSParams, train_als
 from ..ops.sharded_topk import (
-    put_sharded_catalog,
     serving_mesh_for,
     sharded_top_k_items,
     validate_serving_mode,
 )
 from ..ops.topk import top_k_items
+from ._sharded_serving import ShardedCatalogServing
 from ._filters import CategoryIndex, build_exclude_mask
 from .similar_product import (
     SimilarProductDataSource,
@@ -48,7 +48,7 @@ class ECommerceDataSource(SimilarProductDataSource):
 
 
 @dataclasses.dataclass
-class ECommerceModel:
+class ECommerceModel(ShardedCatalogServing):
     factors: ALSFactors
     users: BiMap
     items: BiMap
@@ -63,29 +63,13 @@ class ECommerceModel:
     serving_mesh: object = dataclasses.field(default=None, repr=False, compare=False)
     _sharded_cat: object = dataclasses.field(default=None, repr=False, compare=False)
 
-    def sharded_catalog(self):
-        if self._sharded_cat is None:
-            self._sharded_cat = put_sharded_catalog(
-                self.factors.item_factors, self.serving_mesh)
-        return self._sharded_cat
-
     def category_index(self) -> CategoryIndex:
         if self._cat_index is None:
             self._cat_index = CategoryIndex(self.items, self.item_categories)
         return self._cat_index
 
-    def device_item_factors(self):
-        if self._dev_items is None:
-            import jax
-
-            self._dev_items = jax.device_put(self.factors.item_factors)
-        return self._dev_items
-
     def warm_up(self, num: int = 10):
-        if self.serving_mesh is None:
-            self.device_item_factors()
-        else:
-            self.sharded_catalog()
+        self.warm_catalog()
         if len(self.users):
             self.recommend(next(iter(self.users.keys())), num)
 

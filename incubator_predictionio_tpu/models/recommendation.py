@@ -35,13 +35,13 @@ from ..data.storage.bimap import BiMap
 from ..data.store.p_event_store import PEventStore
 from ..ops.als import ALSFactors, ALSParams, train_als
 from ..ops.sharded_topk import (
-    put_sharded_catalog,
     serving_mesh_for,
     sharded_batch_top_k,
     sharded_top_k_items,
     validate_serving_mode,
 )
 from ..ops.topk import batch_top_k, top_k_items
+from ._sharded_serving import ShardedCatalogServing
 
 
 # -- data types ------------------------------------------------------------
@@ -64,41 +64,23 @@ PreparedData = TrainingData  # identity preparation (quickstart parity)
 
 
 @dataclasses.dataclass
-class ALSModel:
+class ALSModel(ShardedCatalogServing):
     factors: ALSFactors
     users: BiMap
     items: BiMap
-    # Device-resident copy of the item factors, populated lazily — without
-    # it every query re-uploads the whole matrix and p50 blows past the
-    # 10ms budget (the serving hot path uploads only the k-float user vec).
+    # Catalog caching + layout selection: ShardedCatalogServing.
     _dev_items: object = dataclasses.field(default=None, repr=False, compare=False)
     # When set (a Mesh), the catalog is served SHARDED over every mesh
     # device instead of replicated on one chip — the PAlgorithm serving
     # analog for factor matrices beyond one chip's HBM (reference:
     # core/.../controller/PAlgorithm.scala — batchPredict). Populated by
-    # train/restore_model via ops.sharded_topk.should_shard_serving.
+    # train/restore_model via ops.sharded_topk.serving_mesh_for.
     serving_mesh: object = dataclasses.field(default=None, repr=False, compare=False)
     _sharded_cat: object = dataclasses.field(default=None, repr=False, compare=False)
 
-    def device_item_factors(self):
-        if self._dev_items is None:
-            import jax
-
-            self._dev_items = jax.device_put(self.factors.item_factors)
-        return self._dev_items
-
-    def sharded_catalog(self):
-        if self._sharded_cat is None:
-            self._sharded_cat = put_sharded_catalog(
-                self.factors.item_factors, self.serving_mesh)
-        return self._sharded_cat
-
     def warm_up(self, num: int = 10):
         """Compile + cache the serving executable (called at deploy time)."""
-        if self.serving_mesh is None:
-            self.device_item_factors()
-        else:
-            self.sharded_catalog()
+        self.warm_catalog()
         if len(self.users):
             self.recommend_products(next(iter(self.users.keys())), num)
 

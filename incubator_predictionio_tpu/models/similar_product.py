@@ -26,12 +26,12 @@ from ..data.storage.bimap import BiMap
 from ..data.store.p_event_store import PEventStore
 from ..ops.als import ALSFactors, ALSParams, train_als
 from ..ops.sharded_topk import (
-    put_sharded_catalog,
     serving_mesh_for,
     sharded_similar_items,
     validate_serving_mode,
 )
 from ..ops.topk import normalize_rows, similar_items
+from ._sharded_serving import ShardedCatalogServing
 from ._filters import CategoryIndex, build_exclude_mask
 
 
@@ -83,7 +83,7 @@ class SimilarProductDataSource(DataSource):
 
 
 @dataclasses.dataclass
-class SimilarProductModel:
+class SimilarProductModel(ShardedCatalogServing):
     factors: ALSFactors
     items: BiMap
     item_categories: dict[str, set[str]]
@@ -99,27 +99,13 @@ class SimilarProductModel:
             self._cat_index = CategoryIndex(self.items, self.item_categories)
         return self._cat_index
 
-    def device_item_factors(self):
-        """Row-NORMALIZED catalog, resident on device (cosine serving
-        needs unit rows; normalizing once here instead of per query)."""
-        if self._dev_items is None:
-            import jax
-
-            self._dev_items = jax.device_put(
-                normalize_rows(self.factors.item_factors))
-        return self._dev_items
-
-    def sharded_catalog(self):
-        if self._sharded_cat is None:
-            self._sharded_cat = put_sharded_catalog(
-                normalize_rows(self.factors.item_factors), self.serving_mesh)
-        return self._sharded_cat
+    def _host_catalog(self):
+        """Cosine serving needs unit rows: normalize ONCE at deploy
+        time, not per query (ops.topk.normalize_rows)."""
+        return normalize_rows(self.factors.item_factors)
 
     def warm_up(self, num: int = 10):
-        if self.serving_mesh is None:
-            self.device_item_factors()
-        else:
-            self.sharded_catalog()
+        self.warm_catalog()
         if len(self.items):
             self.similar([next(iter(self.items.keys()))], num)
 

@@ -47,10 +47,6 @@ def _mesh_axes(mesh: Mesh) -> tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
-def mesh_device_count(mesh: Mesh) -> int:
-    return int(np.prod(mesh.devices.shape))
-
-
 @dataclasses.dataclass
 class ShardedCatalog:
     """Item-factor matrix resident sharded over all devices of a mesh.
@@ -74,13 +70,13 @@ class ShardedCatalog:
 
     @property
     def n_shards(self) -> int:
-        return mesh_device_count(self.mesh)
+        return int(self.mesh.size)
 
 
 def put_sharded_catalog(item_factors, mesh: Mesh) -> ShardedCatalog:
     """Host factors → device catalog sharded over all mesh axes on dim 0."""
     x = np.asarray(item_factors, np.float32)
-    shards = mesh_device_count(mesh)
+    shards = int(mesh.size)
     padded = pad_rows(x, shards)
     sharding = NamedSharding(mesh, P(_mesh_axes(mesh), None))
     return ShardedCatalog(jax.device_put(padded, sharding), x.shape[0], mesh)
@@ -98,13 +94,16 @@ def _serving_shard_threshold_bytes() -> int:
     raw = os.environ.get("PIO_SHARDED_SERVING_BYTES")
     if raw:
         try:
-            return int(float(raw))
-        except (ValueError, OverflowError):  # not a number, or "inf"
+            val = int(float(raw))
+            if val <= 0:
+                raise ValueError("threshold must be positive")
+            return val
+        except (ValueError, OverflowError):  # not a number, "inf", or <= 0
             import warnings
 
             warnings.warn(
-                f"PIO_SHARDED_SERVING_BYTES={raw!r} is not a number; "
-                "using the device-derived default", stacklevel=2)
+                f"PIO_SHARDED_SERVING_BYTES={raw!r} is not a positive "
+                "number; using the device-derived default", stacklevel=2)
     limit = 0
     try:
         dev = jax.devices()[0]
@@ -137,7 +136,7 @@ def should_shard_serving(
     matrix exceeds the per-chip budget). Engine.json spelling:
     "shardedServing". A 1-device mesh never shards (nothing to split)."""
     validate_serving_mode(mode)
-    if mesh is None or mode == "never" or mesh_device_count(mesh) <= 1:
+    if mesh is None or mode == "never" or int(mesh.size) <= 1:
         return False
     if mode == "always":
         return True
@@ -162,7 +161,7 @@ def _sharded_topk_fn(mesh: Mesh, k: int, has_exclude: bool):
     Cached per (mesh, bucketed-k, exclude?) so serving reuses
     executables across queries; jit handles shape specialisation below."""
     axes = _mesh_axes(mesh)
-    shards = mesh_device_count(mesh)
+    shards = int(mesh.size)
     axis_sizes = [mesh.shape[a] for a in axes]
     item_spec = P(axes, None)
     row_spec = P(axes)
