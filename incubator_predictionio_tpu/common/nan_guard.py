@@ -1,0 +1,80 @@
+"""NaN-guard tier — the sanitizer analog (SURVEY.md §5.2).
+
+Reference behaviour: the reference's native sanitizer builds catch memory
+bugs at the point of corruption; the moral equivalent for a numeric
+framework is catching non-finite values at the STAGE that produced them
+instead of persisting a garbage model. Enabled via `pio train
+--nan-guard` (WorkflowParams.nan_guard): every DASE stage output is
+checked, and iterative trainers (ALS) switch to per-iteration dispatch so
+the failure names the iteration — the same speed-for-attribution trade
+``jax_debug_nans``' op-by-op replay makes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+class NaNGuardError(RuntimeError):
+    """A stage produced non-finite values (message carries the stage)."""
+
+
+class _TooDeep(Exception):
+    pass
+
+
+def _iter_arrays(obj, _depth: int = 0):
+    """Yield (path, array) for every float array reachable from obj —
+    dataclasses, dicts, lists/tuples, numpy and jax arrays. A container
+    nested deeper than the cap raises instead of being silently skipped:
+    an unverified subtree must not report as clean."""
+    if obj is None:
+        return
+    if _depth > 6 and isinstance(obj, (np.ndarray, dict, list, tuple)):
+        raise _TooDeep
+    if _depth > 6:
+        return
+    if isinstance(obj, np.ndarray):
+        yield "", obj
+        return
+    # jax.Array without importing jax eagerly
+    if type(obj).__module__.startswith("jax") and hasattr(obj, "dtype"):
+        yield "", np.asarray(obj)
+        return
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            if f.name.startswith("_"):
+                continue  # caches (device buffers, indexes) — not model state
+            for path, arr in _iter_arrays(getattr(obj, f.name), _depth + 1):
+                yield f"{f.name}.{path}".rstrip("."), arr
+        return
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            for path, arr in _iter_arrays(v, _depth + 1):
+                yield f"{k}.{path}".rstrip("."), arr
+        return
+    if isinstance(obj, (list, tuple)):
+        for j, v in enumerate(obj):
+            for path, arr in _iter_arrays(v, _depth + 1):
+                yield f"[{j}].{path}".rstrip("."), arr
+
+
+def check_finite(obj, stage: str) -> None:
+    """Raise NaNGuardError naming ``stage`` and the offending field if any
+    float array reachable from ``obj`` contains NaN/Inf."""
+    try:
+        for path, arr in _iter_arrays(obj):
+            if arr.dtype.kind == "f" and arr.size and not np.isfinite(arr).all():
+                bad = int(np.size(arr) - np.isfinite(arr).sum())
+                raise NaNGuardError(
+                    f"stage: {stage}: non-finite values in "
+                    f"{path or 'array'} ({bad}/{arr.size} elements); "
+                    "rerun with --nan-guard off to persist anyway, or fix the "
+                    "input data / regularization")
+    except _TooDeep:
+        raise NaNGuardError(
+            f"stage: {stage}: object nests containers deeper than the "
+            "guard traverses (6 levels) — cannot verify finiteness; "
+            "flatten the model state or disable --nan-guard") from None

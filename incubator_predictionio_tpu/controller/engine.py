@@ -135,25 +135,36 @@ class Engine:
         return ds, prep, algo_list, serving
 
     @staticmethod
-    def _maybe_sanity_check(obj, label: str, enabled: bool) -> None:
+    def _maybe_sanity_check(obj, label: str, enabled: bool,
+                            nan_guard: bool = False) -> None:
         if enabled and isinstance(obj, SanityCheck):
             log.info("sanity check: %s", label)
             obj.sanity_check()
+        if nan_guard:
+            from ..common.nan_guard import check_finite
+
+            check_finite(obj, label)
 
     # -- training (reference: Engine.train) -------------------------------
     def train(self, ctx, engine_params: EngineParams, workflow_params=None) -> list[Any]:
         from ..workflow.workflow_params import WorkflowParams
 
         wp = workflow_params or WorkflowParams()
+        # Single source of truth: algorithms read flags (nan_guard,
+        # resume) from ctx.workflow_params — sync it even when callers
+        # bypass run_train and invoke Engine.train directly.
+        ctx.workflow_params = wp
         ds, prep, algo_list, _ = self.make_components(engine_params)
 
         td = ds.read_training(ctx)
-        self._maybe_sanity_check(td, "training data", not wp.skip_sanity_check)
+        self._maybe_sanity_check(td, "datasource", not wp.skip_sanity_check,
+                                 wp.nan_guard)
         if wp.stop_after_read:
             log.info("--stop-after-read: halting before prepare")
             return []
         pd = prep.prepare(ctx, td)
-        self._maybe_sanity_check(pd, "prepared data", not wp.skip_sanity_check)
+        self._maybe_sanity_check(pd, "preparator", not wp.skip_sanity_check,
+                                 wp.nan_guard)
         if wp.stop_after_prepare:
             log.info("--stop-after-prepare: halting before train")
             return []
@@ -165,6 +176,9 @@ class Engine:
             from ..workflow.checkpoint import CheckpointHook
         for idx, (name, algo) in enumerate(algo_list):
             log.info("training algorithm %s (%s)", name or "<default>", type(algo).__name__)
+            # Stage label for error attribution inside iterative trainers
+            # (e.g. train_als' per-iteration NaN guard).
+            ctx.stage_label = f"algorithm[{name or 'default'}]"
             if root_hook is not None:
                 # Per-algorithm subdirectory: without it, multiple
                 # algorithms in one engine would collide on orbax step
@@ -180,7 +194,9 @@ class Engine:
                 if root_hook is not None:
                     ctx.checkpoint_hook.close()
                     ctx.checkpoint_hook = root_hook
-            self._maybe_sanity_check(model, f"model[{name}]", not wp.skip_sanity_check)
+            self._maybe_sanity_check(
+                model, f"algorithm[{name or 'default'}]",
+                not wp.skip_sanity_check, wp.nan_guard)
             models.append(model)
         return models
 

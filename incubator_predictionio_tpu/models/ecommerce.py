@@ -177,6 +177,8 @@ class ECommerceAlgorithm(Algorithm):
             mesh=ctx.get_mesh() if ctx else None,
             checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
             resume=bool(ctx and ctx.workflow_params.resume),
+            nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
+            nan_guard_stage=getattr(ctx, "stage_label", "algorithm[als]"),
         )
         model = ECommerceModel(
             factors=factors, users=pd.users, items=pd.items,

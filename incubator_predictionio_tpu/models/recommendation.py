@@ -230,6 +230,8 @@ class ALSAlgorithm(Algorithm):
             mesh=ctx.get_mesh() if ctx else None,
             checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
             resume=bool(ctx and ctx.workflow_params.resume),
+            nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
+            nan_guard_stage=getattr(ctx, "stage_label", "algorithm[als]"),
             # bench.py measures the real product path by planting a
             # timings dict on the context; absent in normal training.
             timings=getattr(ctx, "bench_timings", None),

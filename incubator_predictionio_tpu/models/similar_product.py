@@ -180,6 +180,8 @@ class SimilarProductAlgorithm(Algorithm):
             mesh=ctx.get_mesh() if ctx else None,
             checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
             resume=bool(ctx and ctx.workflow_params.resume),
+            nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
+            nan_guard_stage=getattr(ctx, "stage_label", "algorithm[als]"),
         )
         model = SimilarProductModel(factors, pd.items, pd.item_categories)
         model.serving_mesh = serving_mesh_for(

@@ -730,8 +730,16 @@ def train_als(
     checkpoint_hook=None,
     resume: bool = False,
     timings: Optional[dict] = None,
+    nan_guard: bool = False,
+    nan_guard_stage: str = "algorithm[als]",
 ) -> ALSFactors:
     """Train explicit/implicit ALS from a COO rating triple.
+
+    ``nan_guard``: dispatch one iteration at a time and fail with
+    "stage: algorithm[als], iteration k" on the first non-finite factor
+    (SURVEY.md §5.2 sanitizer tier) instead of returning a garbage
+    model. Trades the fused n-iteration executable's speed for
+    attribution, exactly like jax_debug_nans' op-by-op replay.
 
     ``checkpoint_hook`` (workflow.checkpoint.CheckpointHook): when enabled,
     the loop runs in hook.every_n-iteration chunks through the SAME jitted
@@ -853,7 +861,8 @@ def train_als(
             for b, s in zip(flat, in_shardings[3:])
         )
     chunk = checkpoint_hook.every_n if checkpoint_hook is not None and checkpoint_hook.enabled else 0
-    timed_path = (timings is not None and jax.process_count() == 1
+    timed_path = (not nan_guard
+                  and timings is not None and jax.process_count() == 1
                   and not (chunk and params.num_iterations - start_iter > chunk))
     # Single-device runs pack the slabs: 2-3 large transfers instead of
     # ~70 small ones (see _pack_flat — the remote tunnel re-pays a
@@ -907,6 +916,30 @@ def train_als(
         x, y = compiled(n, dx0, dy0, *dev_args)
         _ = jax.device_get(x[:1, :1])
         timings["device_train_seconds"] = _time.perf_counter() - t0
+    elif nan_guard:
+        # Sanitizer tier: one dispatch per iteration + a device-side
+        # finite reduction (ONE scalar fetched per iteration — pulling
+        # the full factor matrices would be transfer-bound through the
+        # remote tunnel), so the failure names the iteration that
+        # produced it. Checkpoint saves keep their chunk schedule.
+        from ..common.nan_guard import NaNGuardError
+
+        finite_probe = jax.jit(
+            lambda a, b: jnp.isfinite(a).all() & jnp.isfinite(b).all())
+        x, y = x0, y0
+        for it in range(start_iter, params.num_iterations):
+            x, y = run_fn(np.int32(1), x, y, *run_args)
+            if not bool(jax.device_get(finite_probe(x, y))):
+                raise NaNGuardError(
+                    f"stage: {nan_guard_stage}, iteration {it + 1}: "
+                    "non-finite factors (check input ratings for NaN/Inf "
+                    "or raise the regularization)")
+            done = it + 1
+            if chunk and done % chunk == 0 and done < params.num_iterations:
+                checkpoint_hook.save(
+                    done, {"user_factors": x, "item_factors": y,
+                           "fingerprint": np.int64(fingerprint)}
+                )
     elif chunk and params.num_iterations - start_iter > chunk:
         x, y = x0, y0
         it = start_iter

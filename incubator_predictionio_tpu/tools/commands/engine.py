@@ -60,6 +60,10 @@ def train_cmd(args: list[str]) -> int:
                         "last checkpoint")
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace of the train stage here")
+    p.add_argument("--nan-guard", action="store_true",
+                   help="fail fast with stage/iteration attribution when a "
+                        "stage produces NaN/Inf (SURVEY §5.2 sanitizer tier; "
+                        "iterative trainers dispatch per-iteration)")
     ns = p.parse_args(args)
     from ...workflow.core_workflow import run_train
 
@@ -77,6 +81,7 @@ def train_cmd(args: list[str]) -> int:
         checkpoint_every=ns.checkpoint_every,
         resume=ns.resume,
         profile_dir=ns.profile_dir,
+        nan_guard=ns.nan_guard,
     )
     import time as _time
 

@@ -21,3 +21,7 @@ class WorkflowParams:
     # Tracing/profiling (reference relied on the external Spark web UI —
     # SURVEY.md §5.1): write a jax.profiler trace of the train stage here.
     profile_dir: str = ""
+    # NaN-guard tier (SURVEY.md §5.2 sanitizer analog): check every DASE
+    # stage output for non-finite values with stage attribution;
+    # iterative trainers dispatch per-iteration to name the iteration.
+    nan_guard: bool = False

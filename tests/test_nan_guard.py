@@ -1,0 +1,137 @@
+"""NaN-guard tier (SURVEY.md §5.2 sanitizer analog): non-finite values
+fail fast with stage/iteration attribution instead of persisting a
+garbage model."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.common.nan_guard import (
+    NaNGuardError,
+    check_finite,
+)
+
+
+def test_check_finite_names_stage_and_field():
+    @dataclasses.dataclass
+    class FakeModel:
+        weights: np.ndarray
+        _cache: object = None  # underscore fields are skipped
+
+    ok = FakeModel(np.ones((3, 3), np.float32))
+    check_finite(ok, "algorithm[x]")  # no raise
+
+    bad = FakeModel(np.array([1.0, np.nan, np.inf], np.float32))
+    with pytest.raises(NaNGuardError, match=r"stage: algorithm\[x\]") as e:
+        check_finite(bad, "algorithm[x]")
+    assert "weights" in str(e.value)
+    assert "2/3" in str(e.value)
+
+
+def test_check_finite_nested_containers_and_int_arrays():
+    check_finite({"idx": np.array([1, 2, 3])}, "s")  # ints never flagged
+    with pytest.raises(NaNGuardError, match="inner"):
+        check_finite({"outer": [{"inner": np.array([np.nan])}]}, "s")
+    # device arrays are checked too
+    jax = pytest.importorskip("jax")
+    with pytest.raises(NaNGuardError):
+        check_finite({"d": jax.numpy.array([np.inf])}, "s")
+
+
+def test_als_nan_guard_names_iteration():
+    pytest.importorskip("jax")
+    from incubator_predictionio_tpu.ops.als import ALSParams, train_als
+
+    rng = np.random.default_rng(0)
+    u = rng.integers(0, 30, 300).astype(np.int32)
+    i = rng.integers(0, 20, 300).astype(np.int32)
+    r = rng.random(300).astype(np.float32)
+    r[17] = np.nan  # poisoned input → first solve already non-finite
+    with pytest.raises(NaNGuardError,
+                       match=r"algorithm\[als\], iteration 1"):
+        train_als(u, i, r, 30, 20,
+                  ALSParams(rank=4, num_iterations=3), nan_guard=True)
+    # guard off: the old behavior (garbage model, no raise)
+    out = train_als(u, i, r, 30, 20, ALSParams(rank=4, num_iterations=3))
+    assert out.user_factors.shape == (30, 4)
+
+
+def test_engine_train_guards_every_stage(memory_storage):
+    """An algorithm that emits NaN fails at algorithm[name]; poisoned
+    source data fails at datasource — each with stage attribution."""
+    from incubator_predictionio_tpu.controller import (
+        Algorithm, DataSource, Engine, EngineParams,
+    )
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.workflow_params import (
+        WorkflowParams,
+    )
+
+    class TD:
+        def __init__(self, poisoned):
+            self.x = np.array([np.nan if poisoned else 1.0], np.float32)
+
+    class DS(DataSource):
+        poisoned = False
+
+        def read_training(self, ctx):
+            return {"x": TD(self.poisoned).x}
+
+    class NaNAlgo(Algorithm):
+        def train(self, ctx, pd):
+            return {"weights": np.array([np.nan], np.float32)}
+
+        def predict(self, model, q):
+            return {}
+
+    engine = Engine(DS, algorithm_class_map={"bad": NaNAlgo})
+    ctx = WorkflowContext(storage=memory_storage)
+    ep = EngineParams(algorithm_params_list=[("bad", {})])
+
+    with pytest.raises(NaNGuardError, match=r"stage: algorithm\[bad\]"):
+        engine.train(ctx, ep, WorkflowParams(nan_guard=True))
+    # guard off: trains fine (old behavior)
+    models = engine.train(ctx, ep, WorkflowParams())
+    assert len(models) == 1
+
+    DS.poisoned = True
+    with pytest.raises(NaNGuardError, match="stage: datasource"):
+        engine.train(ctx, ep, WorkflowParams(nan_guard=True))
+
+
+def test_train_cli_flag_reaches_workflow_params(tmp_path, monkeypatch):
+    """`pio train --nan-guard` flows through the REAL train_cmd into the
+    WorkflowParams handed to run_train."""
+    import json
+
+    from incubator_predictionio_tpu.tools.commands.engine import train_cmd
+    from incubator_predictionio_tpu.workflow import core_workflow
+
+    (tmp_path / "engine.json").write_text(json.dumps({
+        "id": "default",
+        "engineFactory": "incubator_predictionio_tpu.models."
+                         "recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "flagapp"}},
+        "algorithms": [{"name": "als", "params": {}}],
+    }))
+    seen = {}
+
+    def fake_run_train(engine, params, ctx, wp, **kw):
+        seen["nan_guard"] = wp.nan_guard
+        return "fake-instance"
+
+    monkeypatch.setattr(core_workflow, "run_train", fake_run_train)
+    monkeypatch.chdir(tmp_path)
+    assert train_cmd(["--nan-guard"]) == 0
+    assert seen["nan_guard"] is True
+    assert train_cmd([]) == 0
+    assert seen["nan_guard"] is False
+
+
+def test_check_finite_rejects_unverifiable_depth():
+    deep = np.array([1.0], np.float32)
+    for _ in range(8):
+        deep = {"lvl": deep}
+    with pytest.raises(NaNGuardError, match="deeper than the guard"):
+        check_finite(deep, "s")
