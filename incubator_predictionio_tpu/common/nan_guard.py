@@ -32,9 +32,14 @@ def _iter_arrays(obj, _depth: int = 0):
     an unverified subtree must not report as clean."""
     if obj is None:
         return
-    if _depth > 6 and isinstance(obj, (np.ndarray, dict, list, tuple)):
-        raise _TooDeep
     if _depth > 6:
+        # Anything this walker WOULD traverse must raise, not silently
+        # pass as clean: arrays (incl. jax), containers, dataclasses.
+        if (isinstance(obj, (np.ndarray, dict, list, tuple))
+                or (dataclasses.is_dataclass(obj) and not isinstance(obj, type))
+                or (type(obj).__module__.startswith("jax")
+                    and hasattr(obj, "dtype"))):
+            raise _TooDeep
         return
     if isinstance(obj, np.ndarray):
         yield "", obj

@@ -9,6 +9,7 @@ store before training.
 from __future__ import annotations
 
 import datetime as _dt
+import json as _json
 import logging
 from typing import Optional
 
@@ -28,6 +29,10 @@ class SelfCleaningDataSource:
 
     event_window_duration: Optional[_dt.timedelta] = None
     event_window_remove: bool = False
+    # Content-dedupe (reference: cleanPersistedPEvents' .distinct()):
+    # repeated imports create identical events under fresh eventIds; the
+    # cleaning pass keeps the first copy per content key.
+    event_dedupe: bool = True
 
     def clean_persisted_data(self, ctx, app_name: str) -> int:
         """Compact property events + drop aged-out events. Returns the
@@ -51,7 +56,32 @@ class SelfCleaningDataSource:
             # for — a concurrent writer may have removed some ids already.
             removed += sum(le.delete_batch(doomed, app.id))
 
-        # 2) compact property-event streams per entity type into one $set
+        # 2) content-dedupe: events identical in EVERY user-visible field
+        # (incl. tags/prId — two conversions differing only in prediction
+        # attribution are NOT duplicates) collapse to the first copy in
+        # store order — the reference's RDD .distinct() for re-imported
+        # data. Full-scan is inherent to dedupe (so is the reference's);
+        # memory per unique event is a 16-byte digest, not the event.
+        if self.event_dedupe:
+            import hashlib
+
+            seen: set[bytes] = set()
+            dupes = []
+            for e in le.find(app.id):
+                key = _json.dumps(
+                    [e.event, e.entity_type, e.entity_id,
+                     e.target_entity_type, e.target_entity_id,
+                     e.properties.to_dict(), sorted(e.tags or ()),
+                     e.pr_id, e.event_time],
+                    sort_keys=True, default=str).encode()
+                digest = hashlib.blake2b(key, digest_size=16).digest()
+                if digest in seen:
+                    dupes.append(e.event_id)
+                else:
+                    seen.add(digest)
+            removed += sum(le.delete_batch(dupes, app.id))
+
+        # 3) compact property-event streams per entity type into one $set
         prop_events = list(
             le.find(app.id, event_names=["$set", "$unset", "$delete"])
         )

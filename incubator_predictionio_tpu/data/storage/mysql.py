@@ -104,6 +104,23 @@ class MySQLLEvents(PGLEvents):
             (app_id, self._chan(channel_id), event_id))
         return self._c.affected_rows > 0
 
+    def _delete_chunk(self, chunk, app_id: int, chan: int) -> set[str]:
+        """MySQL has no DELETE..RETURNING, so a SELECT snapshots which
+        ids exist before the DELETE — a writer racing between the two
+        statements can skew individual booleans, the same weak guarantee
+        the per-event loop's affected_rows check gives. Chunk loop +
+        duplicate-id bookkeeping are inherited from PGLEvents."""
+        ph = ",".join(f"${j}" for j in range(3, 3 + len(chunk)))
+        where = f"WHERE appid=$1 AND channelid=$2 AND eventid IN ({ph})"
+        _, rows = self._c.query(
+            f"SELECT eventid FROM {self._t} {where}",
+            (app_id, chan, *chunk))
+        present = {r[0] for r in rows}
+        if present:
+            self._c.query(f"DELETE FROM {self._t} {where}",
+                          (app_id, chan, *chunk))
+        return present
+
     def find(self, app_id, channel_id=None, start_time=None,
              until_time=None, entity_type=None, entity_id=None,
              event_names=None, target_entity_type=None,

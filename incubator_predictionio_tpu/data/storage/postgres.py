@@ -204,6 +204,38 @@ class PGLEvents(base.LEvents):
             (app_id, self._chan(channel_id), event_id))
         return bool(rows)
 
+    def _delete_chunk(self, chunk: Sequence[str], app_id: int,
+                      chan: int) -> set[str]:
+        """Delete one IN-list chunk, returning the ids actually removed.
+        MySQL overrides this (no DELETE..RETURNING in its dialect)."""
+        ph = ",".join(f"${j}" for j in range(3, 3 + len(chunk)))
+        _, rows = self._c.query(
+            f"DELETE FROM {self._t} WHERE appid=$1 AND channelid=$2 "
+            f"AND eventid IN ({ph}) RETURNING eventid",
+            (app_id, chan, *chunk))
+        return {r[0] for r in rows}
+
+    def delete_batch(self, event_ids: Sequence[str], app_id: int,
+                     channel_id: Optional[int] = None) -> list[bool]:
+        """Chunked IN-list deletes: one round trip per ~500 ids instead
+        of one per id (self-cleaning compaction deletes thousands at a
+        time; the per-event default made the wire RTT the whole cost)."""
+        chan = self._chan(channel_id)
+        found: set[str] = set()
+        CHUNK = 500
+        ids = list(event_ids)
+        for lo in range(0, len(ids), CHUNK):
+            found.update(self._delete_chunk(ids[lo:lo + CHUNK], app_id, chan))
+        # Repeated ids in the request: only the first occurrence reports
+        # True (matches the per-event loop's delete-then-miss behavior).
+        out = []
+        for eid in ids:
+            hit = eid in found
+            if hit:
+                found.discard(eid)
+            out.append(hit)
+        return out
+
     def find(
         self,
         app_id: int,

@@ -39,6 +39,13 @@ def app_cmd(args: list[str]) -> int:
     p_dd.add_argument("name")
     p_dd.add_argument("--channel", default=None)
     p_dd.add_argument("-f", "--force", action="store_true")
+    p_dd.add_argument("--clean", action="store_true",
+                      help="self-cleaning pass instead of a full wipe: "
+                           "dedupe re-imported events + compact "
+                           "$set/$unset/$delete streams (default channel)")
+    p_dd.add_argument("--ttl-days", type=float, default=None, metavar="D",
+                      help="with --clean: also delete non-property events "
+                           "older than D days (requires -f)")
     ns = p.parse_args(args)
     s = _storage()
     apps = s.get_meta_data_apps()
@@ -119,6 +126,33 @@ def app_cmd(args: list[str]) -> int:
         return 0
 
     if ns.sub == "data-delete":
+        if ns.clean:
+            # Reference: core/.../core/SelfCleaningDataSource.scala run
+            # standalone — compaction + dedupe preserve query semantics;
+            # only the TTL age-out actually loses data, so only it needs -f.
+            if ns.channel:
+                # refusing beats silently cleaning the DEFAULT channel
+                # while the user believes --channel was honoured
+                print("--clean operates on the default channel only; "
+                      "it cannot be combined with --channel.",
+                      file=sys.stderr)
+                return 1
+            import datetime as _dt
+
+            from ...controller.self_cleaning import SelfCleaningDataSource
+            from ...workflow.context import WorkflowContext
+
+            if ns.ttl_days is not None and not ns.force:
+                print("Pass -f to confirm TTL deletion.", file=sys.stderr)
+                return 1
+            ds = SelfCleaningDataSource()
+            if ns.ttl_days is not None:
+                ds.event_window_duration = _dt.timedelta(days=ns.ttl_days)
+                ds.event_window_remove = True
+            removed = ds.clean_persisted_data(
+                WorkflowContext(storage=s), ns.name)
+            print(f"[info] Self-cleaning removed {removed} events.")
+            return 0
         if not ns.force:
             print("Pass -f to confirm deletion.", file=sys.stderr)
             return 1

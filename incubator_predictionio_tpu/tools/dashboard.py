@@ -1,8 +1,10 @@
 """Dashboard — evaluation-instance leaderboard on :9000.
 
 Reference: tools/.../tools/dashboard/Dashboard.scala (spray + twirl HTML
-listing completed EvaluationInstances with their results; CORS support).
-Here: aiohttp serving a minimal HTML index + JSON API.
+listing completed EvaluationInstances with their results) + CorsSupport
+(the Allow-Origin/Methods/Headers trio on every route). Here: aiohttp
+serving the leaderboard index, a per-instance candidate table with a
+best-params DIFF view, and the JSON API the HTML is built from.
 """
 
 from __future__ import annotations
@@ -15,23 +17,83 @@ from aiohttp import web
 
 from ..data.storage.registry import Storage
 
+_CORS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "GET, OPTIONS",
+    "Access-Control-Allow-Headers": "Content-Type",
+}
+
+_STYLE = """
+body { font-family: system-ui, sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+th, td { border: 1px solid #999; padding: 4px 8px; text-align: left;
+         vertical-align: top; }
+th { background: #eee; }
+tr.best { background: #e8f4e8; }
+pre { margin: 0; max-width: 60em; overflow-x: auto; }
+.diff-add { color: #066; }
+.muted { color: #777; }
+"""
+
+
+def _flatten(obj, prefix="") -> dict:
+    """Nested params JSON → dotted-key leaves, for diffing."""
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+    elif isinstance(obj, list):
+        for j, v in enumerate(obj):
+            out.update(_flatten(v, f"{prefix}{j}."))
+    else:
+        out[prefix.rstrip(".")] = obj
+    return out
+
+
+def params_diff(candidate: dict, best: dict) -> list[tuple[str, object, object]]:
+    """(dotted key, candidate value, best value) for every leaf that
+    differs — the "what would I change in engine.json" view."""
+    c, b = _flatten(candidate), _flatten(best)
+    rows = []
+    for key in sorted(set(c) | set(b)):
+        cv, bv = c.get(key, "<absent>"), b.get(key, "<absent>")
+        if cv != bv:
+            rows.append((key, cv, bv))
+    return rows
+
+
+@web.middleware
+async def _cors_middleware(request: web.Request, handler):
+    if request.method == "OPTIONS":  # preflight (reference: CorsSupport)
+        return web.Response(headers=_CORS)
+    resp = await handler(request)
+    resp.headers.update(_CORS)
+    return resp
+
 
 class Dashboard:
     def __init__(self, storage: Optional[Storage] = None):
         self.storage = storage or Storage.instance()
-        self.app = web.Application()
+        self.app = web.Application(middlewares=[_cors_middleware])
         self.app.add_routes(
             [
                 web.get("/", self.handle_index),
                 web.get("/instances.json", self.handle_instances_json),
+                # .json route FIRST: {iid} would otherwise swallow
+                # "<id>.json" (aiohttp resolves in registration order)
                 web.get("/instances/{iid}.json", self.handle_instance_json),
+                web.get("/instances/{iid}", self.handle_instance_html),
+                web.options("/{tail:.*}", self.handle_preflight),
             ]
         )
 
+    async def handle_preflight(self, request: web.Request) -> web.Response:
+        return web.Response()  # headers via middleware
+
     @staticmethod
     def _parsed_results(i) -> dict:
-        """bestScore / metricHeader / bestEngineParams / candidate count
-        from the stored MetricEvaluatorResult JSON (empty on legacy or
+        """bestScore / metricHeader / bestEngineParams / candidates from
+        the stored MetricEvaluatorResult JSON (empty on legacy or
         malformed rows)."""
         try:
             r = json.loads(i.evaluator_results_json or "{}")
@@ -43,13 +105,21 @@ class Dashboard:
             "metricHeader": r.get("metricHeader"),
             "bestScore": r.get("bestScore"),
             "bestEngineParams": r.get("bestEngineParams"),
+            "results": r.get("results", []) or [],
             "candidates": len(r.get("results", []) or []),
         }
 
+    @staticmethod
+    def _page(title: str, body: str, status: int = 200) -> web.Response:
+        return web.Response(
+            text=(f"<html><head><title>{html.escape(title)}</title>"
+                  f"<style>{_STYLE}</style></head><body>{body}</body></html>"),
+            content_type="text/html", status=status)
+
     async def handle_index(self, request: web.Request) -> web.Response:
-        """The reference dashboard's actual value: a leaderboard with the
-        metric score AND the winning params JSON ready to paste into
-        engine.json (reference: Dashboard.scala twirl table)."""
+        """Leaderboard with the metric score AND the winning params JSON
+        ready to paste into engine.json (reference: Dashboard.scala twirl
+        table)."""
         rows = []
         for i in self.storage.get_meta_data_evaluation_instances().get_completed():
             res = self._parsed_results(i)
@@ -60,13 +130,12 @@ class Dashboard:
             )
             score = res.get("bestScore")
             rows.append(
-                "<tr><td><a href='/instances/{id}.json'>{sid}</a></td>"
+                "<tr><td><a href='/instances/{id}'>{sid}</a> "
+                "<a class=muted href='/instances/{id}.json'>json</a></td>"
                 "<td>{cls}</td><td>{metric}</td><td>{score}</td>"
                 "<td>{cand}</td><td>{start}</td><td>{end}</td>"
                 "<td><details><summary>engine.json params</summary>"
-                "<pre>{params}</pre></details>"
-                "<details><summary>full results</summary><pre>{res}</pre>"
-                "</details></td></tr>".format(
+                "<pre>{params}</pre></details></td></tr>".format(
                     id=html.escape(i.id),
                     sid=html.escape(i.id[:13]),
                     cls=html.escape(i.evaluation_class),
@@ -77,19 +146,79 @@ class Dashboard:
                     start=html.escape(str(i.start_time)),
                     end=html.escape(str(i.end_time)),
                     params=params_pre,
-                    res=html.escape(i.evaluator_results),
                 )
             )
         body = (
-            "<html><head><title>PredictionIO-TPU Dashboard</title></head><body>"
             "<h1>Completed evaluations</h1>"
-            "<table border=1 cellpadding=4><tr><th>ID</th><th>Evaluation</th>"
+            "<table><tr><th>ID</th><th>Evaluation</th>"
             "<th>Metric</th><th>Best score</th><th>Candidates</th>"
-            "<th>Started</th><th>Finished</th><th>Best params / results</th></tr>"
+            "<th>Started</th><th>Finished</th><th>Best params</th></tr>"
             + "".join(rows)
-            + "</table></body></html>"
+            + "</table>"
         )
-        return web.Response(text=body, content_type="text/html")
+        return self._page("PredictionIO-TPU Dashboard", body)
+
+    async def handle_instance_html(self, request: web.Request) -> web.Response:
+        """Per-instance candidate leaderboard: every candidate ranked by
+        score, its params as a DIFF against the winner (the "what should
+        I change" view the reference's twirl pages approximate with raw
+        JSON dumps)."""
+        i = self.storage.get_meta_data_evaluation_instances().get(
+            request.match_info["iid"])
+        if i is None:
+            return self._page("not found", "<h1>Instance not found</h1>",
+                              status=404)
+        res = self._parsed_results(i)
+        best = res.get("bestEngineParams") or {}
+        ranked = sorted(
+            res.get("results", []),
+            key=lambda r: (r.get("score") is not None, r.get("score")),
+            reverse=True)
+        rows = []
+        for rank, cand in enumerate(ranked, 1):
+            ep = cand.get("engineParams") or {}
+            diff = params_diff(ep, best)
+            if not diff:
+                diff_html = "<span class=muted>= best</span>"
+            else:
+                diff_html = "<br>".join(
+                    "<code>{k}</code>: {cv} <span class=muted>(best: {bv})"
+                    "</span>".format(
+                        k=html.escape(str(k)),
+                        cv=html.escape(json.dumps(cv)),
+                        bv=html.escape(json.dumps(bv)))
+                    for k, cv, bv in diff)
+            score = cand.get("score")
+            others = cand.get("others") or []
+            rows.append(
+                "<tr class='{cls}'><td>{rank}</td><td>{score}</td>"
+                "<td>{others}</td><td>{diff}</td>"
+                "<td><details><summary>full params</summary><pre>{full}"
+                "</pre></details></td></tr>".format(
+                    cls="best" if not diff else "",
+                    rank=rank,
+                    score=(f"{score:.6g}"
+                           if isinstance(score, (int, float)) else "—"),
+                    others=html.escape(
+                        ", ".join(f"{o:.6g}" if isinstance(o, (int, float))
+                                  else str(o) for o in others) or "—"),
+                    diff=diff_html,
+                    full=html.escape(json.dumps(ep, indent=2)),
+                ))
+        body = (
+            f"<h1>Evaluation {html.escape(i.id[:13])}</h1>"
+            f"<p>{html.escape(i.evaluation_class)} — metric: "
+            f"{html.escape(str(res.get('metricHeader') or '—'))} — "
+            f"<a href='/'>back</a> · "
+            f"<a href='/instances/{html.escape(i.id)}.json'>json</a></p>"
+            "<h2>Best params (paste into engine.json)</h2>"
+            f"<pre>{html.escape(json.dumps(best, indent=2))}</pre>"
+            "<h2>Candidates</h2>"
+            "<table><tr><th>#</th><th>Score</th><th>Other metrics</th>"
+            "<th>Diff vs best</th><th>Params</th></tr>"
+            + "".join(rows) + "</table>"
+        )
+        return self._page(f"Evaluation {i.id[:13]}", body)
 
     async def handle_instances_json(self, request: web.Request) -> web.Response:
         out = []
@@ -107,7 +236,7 @@ class Dashboard:
                 "bestEngineParams": res.get("bestEngineParams"),
                 "candidates": res.get("candidates"),
             })
-        return web.json_response(out, headers={"Access-Control-Allow-Origin": "*"})
+        return web.json_response(out)
 
     async def handle_instance_json(self, request: web.Request) -> web.Response:
         i = self.storage.get_meta_data_evaluation_instances().get(
@@ -121,7 +250,6 @@ class Dashboard:
             results = {}
         return web.json_response(
             {"id": i.id, "results": results, "pretty": i.evaluator_results},
-            headers={"Access-Control-Allow-Origin": "*"},
         )
 
 

@@ -214,3 +214,45 @@ def test_pio_shell_scripted(cli_env, tmp_path):
     # state persisted through the real storage config
     r = run_pio(["app", "list"], cli_env)
     assert "shellapp" in r.stdout
+
+
+def test_app_data_delete_clean(cli_env, tmp_path):
+    """`pio app data-delete --clean`: the standalone self-cleaning pass
+    (dedupe + compaction; TTL age-out gated behind -f). Reference:
+    SelfCleaningDataSource run outside a training workflow."""
+    run_pio(["app", "new", "cleanapp"], cli_env)
+    # events file with duplicate rows + a property stream
+    events = []
+    for n in range(20):
+        ev = {"event": "view", "entityType": "user", "entityId": str(n % 5),
+              "targetEntityType": "item", "targetEntityId": str(n % 7),
+              "eventTime": f"2024-01-01T00:00:{n:02d}.000Z"}
+        events.append(ev)
+        if n < 10:
+            events.append(dict(ev))  # exact duplicate (re-import)
+    for step in range(4):
+        events.append({"event": "$set", "entityType": "item", "entityId": "i1",
+                       "properties": {f"p{step}": step},
+                       "eventTime": f"2024-01-02T00:00:{step:02d}.000Z"})
+    path = tmp_path / "ev.jsonl"
+    path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    run_pio(["import", "--appid", "1", "--input", str(path)], cli_env)
+
+    # TTL requested without -f → refused
+    r = run_pio(["app", "data-delete", "cleanapp", "--clean",
+                 "--ttl-days", "1"], cli_env, check=False)
+    assert r.returncode == 1 and "-f" in r.stderr
+
+    # --clean is default-channel-only: combining with --channel must
+    # refuse rather than silently clean the wrong channel
+    r = run_pio(["app", "data-delete", "cleanapp", "--clean",
+                 "--channel", "live"], cli_env, check=False)
+    assert r.returncode == 1 and "default channel" in r.stderr
+
+    r = run_pio(["app", "data-delete", "cleanapp", "--clean"], cli_env)
+    # 10 duplicates + (4 property events → 1 snapshot) = 13 removed
+    assert "removed 13 events" in r.stdout
+    # wipe still works and still needs -f
+    assert run_pio(["app", "data-delete", "cleanapp"], cli_env,
+                   check=False).returncode == 1
+    run_pio(["app", "data-delete", "cleanapp", "-f"], cli_env)

@@ -60,6 +60,68 @@ def test_evaluation_workflow_end_to_end(memory_storage):
         detail = requests.get(f"{st.base}/instances/{iid}.json").json()
         assert detail["results"]["metricHeader"] == "HitRate@10"
         assert requests.get(st.base + "/instances/nope.json").status_code == 404
+        # CORS (reference: dashboard CorsSupport) on every route incl. HTML
+        for path in ("/", "/instances.json", f"/instances/{iid}"):
+            r = requests.get(st.base + path)
+            assert r.headers["Access-Control-Allow-Origin"] == "*"
+        pre = requests.options(st.base + "/instances.json")
+        assert pre.status_code == 200
+        assert "GET" in pre.headers["Access-Control-Allow-Methods"]
+
+
+def test_dashboard_candidate_leaderboard_with_diff(memory_storage):
+    """A 6-candidate sweep is browsable end to end: per-instance page
+    ranks every candidate and shows each one's params as a diff against
+    the winner (reference: Dashboard.scala twirl pages)."""
+    import json
+
+    from incubator_predictionio_tpu.data.storage.base import EvaluationInstance
+    from incubator_predictionio_tpu.tools.dashboard import Dashboard, params_diff
+
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    candidates = []
+    for j, (rank, lam) in enumerate(
+            [(8, 0.01), (8, 0.1), (16, 0.01), (16, 0.1), (32, 0.01), (32, 0.1)]):
+        ep = {"datasource": {"name": "", "params": {"appName": "a"}},
+              "preparator": {"name": "", "params": {}},
+              "algorithms": [{"name": "als",
+                              "params": {"rank": rank, "lambda": lam}}],
+              "serving": {"name": "", "params": {}}}
+        candidates.append(
+            {"engineParams": ep, "score": 0.5 + j * 0.05, "others": [j]})
+    best = candidates[-1]["engineParams"]
+    results_json = json.dumps({
+        "metricHeader": "HitRate@10", "bestScore": 0.75,
+        "bestEngineParams": best, "results": candidates,
+    })
+    iid = memory_storage.get_meta_data_evaluation_instances().insert(
+        EvaluationInstance(
+            id="sweep6", status="EVALCOMPLETED", start_time=t0,
+            end_time=t0 + dt.timedelta(minutes=5),
+            evaluation_class="SweepEval", engine_params_generator_class="Gen",
+            evaluator_results="pretty", evaluator_results_json=results_json))
+
+    with ServerThread(Dashboard(memory_storage).app) as st:
+        page = requests.get(f"{st.base}/instances/{iid}").text
+        # all six candidates present, winner first and marked best
+        assert page.count("<tr class=") == 6
+        assert "= best" in page
+        first_row = page.split("<tr class=")[1]
+        assert "0.75" in first_row and "best" in first_row
+        # diff view: losing candidates show ONLY the keys that differ,
+        # with the best value alongside
+        assert "algorithms.0.params.rank" in page
+        assert "algorithms.0.params.lambda" in page
+        assert "appName" not in page.split("Diff vs best")[1].split(
+            "<details")[0]  # unchanged keys never appear in the diff column
+        # index links to the page
+        idx = requests.get(st.base + "/").text
+        assert f"/instances/{iid}" in idx
+
+    # diff helper semantics
+    d = params_diff(candidates[0]["engineParams"], best)
+    assert ("algorithms.0.params.rank", 8, 32) in d
+    assert all(k != "datasource.params.appName" for k, _, _ in d)
 
 
 def test_evaluation_parallel_candidates_matches_sequential(memory_storage):
@@ -161,3 +223,29 @@ def test_self_cleaning_data_source(memory_storage):
     assert len(remaining) == 2
     props = le.aggregate_properties(app_id, "item")
     assert props["i1"] == {"b": 2}  # compaction preserved semantics
+
+
+def test_self_cleaning_dedupe_respects_prid_and_tags(memory_storage):
+    """Events identical except for prId or tags are NOT duplicates:
+    prediction-attribution data must survive the dedupe pass (the
+    reference's .distinct() compares full Event equality)."""
+    from incubator_predictionio_tpu.controller.self_cleaning import (
+        SelfCleaningDataSource,
+    )
+    from incubator_predictionio_tpu.data.storage import App
+
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "prapp"))
+    le = memory_storage.get_l_events()
+    le.init(app_id)
+    t = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    base = dict(event="buy", entity_type="user", entity_id="u1",
+                target_entity_type="item", target_entity_id="i1",
+                event_time=t)
+    le.insert(Event(**base, pr_id="A"), app_id)
+    le.insert(Event(**base, pr_id="B"), app_id)  # different attribution
+    le.insert(Event(**base, tags=["promo"]), app_id)
+    le.insert(Event(**base, tags=["promo"]), app_id)  # TRUE duplicate
+    removed = SelfCleaningDataSource().clean_persisted_data(
+        WorkflowContext(storage=memory_storage), "prapp")
+    assert removed == 1  # only the exact tag-for-tag copy
+    assert len(list(le.find(app_id))) == 3

@@ -1015,3 +1015,66 @@ def test_hbase_rpc_region_retry_and_typed_errors(tmp_path):
         with _pytest.raises(HBaseError, match="RegionTooBusy"):
             le.delete(ids[0], 5)
         client.close()
+
+
+def test_self_cleaning_write_back_contract_10k(storage):
+    """SelfCleaningDataSource write-back at 10k-event scale on EVERY
+    backend (reference: core/.../core/SelfCleaningDataSource.scala run
+    against each storage assembly): dedupe of re-imported events +
+    property-stream compaction must preserve find/aggregate semantics
+    through the real DAO round-trip."""
+    from incubator_predictionio_tpu.controller.self_cleaning import (
+        SelfCleaningDataSource,
+    )
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+
+    apps = storage.get_meta_data_apps()
+    app_id = apps.insert(App(0, "cleanscale"))
+    le = storage.get_l_events()
+    le.init(app_id)
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+
+    def ts(n):
+        return t0 + dt.timedelta(seconds=n)
+
+    events = []
+    # 8,000 unique views
+    for n in range(8000):
+        events.append(Event("view", "user", str(n % 400), "item",
+                            str(n % 250), event_time=ts(n)))
+    # 500 views re-imported 3x (the dedupe target): 1,500 rows → 500
+    for n in range(500):
+        for _ in range(3):
+            events.append(Event("buy", "user", str(n % 400), "item",
+                                str(n % 250), event_time=ts(n)))
+    # 200 items × 5-event property streams: 1,000 rows → 200 snapshots
+    for item in range(200):
+        for step in range(5):
+            events.append(Event(
+                "$set", "item", f"i{item}",
+                properties=DataMap({f"p{step}": step, "last": item}),
+                event_time=ts(100_000 + item * 10 + step)))
+    le.insert_batch(events, app_id)  # 10,500 total
+    assert len(list(le.find(app_id))) == 10_500
+
+    before_props = le.aggregate_properties(app_id, "item")
+
+    ds = SelfCleaningDataSource()
+    removed = ds.clean_persisted_data(
+        WorkflowContext(storage=storage), "cleanscale")
+    # 1,000 duplicate buys + (1,000 property rows - 200 snapshots)
+    assert removed == 1_000 + 800
+
+    remaining = list(le.find(app_id))
+    assert len(remaining) == 8_000 + 500 + 200
+    # dedupe kept exactly one copy per content key
+    keys = [(e.event, e.entity_id, e.target_entity_id, e.event_time)
+            for e in remaining if e.event == "buy"]
+    assert len(keys) == len(set(keys)) == 500
+    # compaction preserved aggregate semantics bit-for-bit
+    after_props = le.aggregate_properties(app_id, "item")
+    assert after_props == before_props
+    assert len(after_props) == 200
+    # idempotent: a second pass finds nothing to clean
+    assert ds.clean_persisted_data(
+        WorkflowContext(storage=storage), "cleanscale") == 0
