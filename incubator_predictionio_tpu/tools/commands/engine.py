@@ -110,6 +110,11 @@ def deploy_cmd(args: list[str]) -> int:
                         "throughput at high QPS for <= window added "
                         "latency)")
     p.add_argument("--max-batch", type=int, default=64)
+    p.add_argument("--probe-latency", action="store_true",
+                   help="at startup, measure and print the full-path "
+                        "query p50/p99 decomposition (HTTP / predict / "
+                        "device RTT / parse) against this attachment and "
+                        "persist it to the EngineInstance row")
     ns = p.parse_args(args)
     from ...workflow.create_server import EngineServer, run_engine_server
 
@@ -128,7 +133,8 @@ def deploy_cmd(args: list[str]) -> int:
         max_batch=ns.max_batch,
     )
     print(f"[info] Engine is deployed and running. Listening on {ns.ip}:{ns.port}")
-    run_engine_server(server, ns.ip, ns.port)
+    run_engine_server(server, ns.ip, ns.port,
+                      probe_latency=ns.probe_latency)
     return 0
 
 

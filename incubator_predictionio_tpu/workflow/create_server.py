@@ -116,13 +116,7 @@ class EngineServer:
             # remote compile service, which would otherwise surface as
             # p99 spikes on live traffic. Models opt in by providing an
             # example_query() the batch path can execute.
-            example = None
-            for model in deployment.models:
-                ex = getattr(model, "example_query", None)
-                if callable(ex):
-                    example = ex()
-                    if example is not None:
-                        break
+            example = self._find_example_query(deployment)
             if example is not None:
                 # up to the next pow2 ≥ max_batch: a live window of
                 # max_batch queries pads to that shape
@@ -139,6 +133,18 @@ class EngineServer:
             self.deployment = deployment
             self.instance = instance
         log.info("deployed engine instance %s", instance.id)
+
+    @staticmethod
+    def _find_example_query(deployment) -> Optional[dict]:
+        """First model offering a non-None example_query() (the warm-up /
+        probe opt-in protocol)."""
+        for model in deployment.models:
+            ex = getattr(model, "example_query", None)
+            if callable(ex):
+                example = ex()
+                if example is not None:
+                    return example
+        return None
 
     # -- handlers ---------------------------------------------------------
     async def handle_status(self, request: web.Request) -> web.Response:
@@ -267,6 +273,8 @@ class EngineServer:
         except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500 w/ message
             log.exception("query failed")
             return web.json_response({"message": str(e)}, status=500)
+        if getattr(self, "_probing", False):
+            return web.json_response(result)
         self._query_count += 1
         if self.feedback:
             # sync DAO write runs in the default executor, never on the loop
@@ -297,6 +305,127 @@ class EngineServer:
         except Exception:  # pragma: no cover
             log.exception("feedback logging failed")
 
+    # -- startup latency probe (reference: CreateServer hot path;
+    # BASELINE.json north star #2 asks for a MEASURED full-path p50) ----
+    def probe_and_record(self, base_url: str, n: int = 60) -> Optional[dict]:
+        """Measure the full-path query latency decomposition against the
+        LIVE server (real HTTP through loopback) and persist it to the
+        EngineInstance row (runtime_conf["probe_latency"]). Components:
+        http_full (wire-to-wire), predict (host gather + device dispatch
+        + on-chip + download), bare device dispatch RTT (the tunnel/queue
+        share), json parse. http − predict = server/HTTP overhead;
+        predict − rtt ≈ on-chip + result transfer."""
+        import ssl
+        import time
+        import urllib.request
+
+        with self._lock:
+            deployment, instance = self.deployment, self.instance
+        example = self._find_example_query(deployment)
+        if example is None:
+            log.warning(
+                "probe-latency: no deployed model provides example_query(); "
+                "skipping")
+            return None
+        body = json.dumps(example).encode()
+        # Loopback self-probe: the server's own cert won't verify for
+        # 127.0.0.1 (hostname-scoped / self-signed), and verification
+        # adds nothing when we ARE the server.
+        tls_ctx = None
+        if base_url.startswith("https"):
+            tls_ctx = ssl.create_default_context()
+            tls_ctx.check_hostname = False
+            tls_ctx.verify_mode = ssl.CERT_NONE
+
+        def post():
+            req = urllib.request.Request(
+                base_url + "/queries.json", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60,
+                                        context=tls_ctx) as r:
+                r.read()
+
+        def pct(a, p):
+            a = sorted(a)
+            return a[min(len(a) - 1, round(p / 100 * (len(a) - 1)))]
+
+        # Synthetic traffic must not masquerade as real: suppress the
+        # feedback self-log and queryCount while the probe runs.
+        self._probing = True
+        try:
+            for _ in range(5):  # warm HTTP keepalive-less path + executables
+                post()
+            http_ms = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                post()
+                http_ms.append((time.perf_counter() - t0) * 1e3)
+        finally:
+            self._probing = False
+        parse_ms, predict_ms = [], []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            q = json.loads(body)
+            parse_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            deployment.query(q)
+            predict_ms.append((time.perf_counter() - t0) * 1e3)
+        rtt_ms = []
+        try:
+            import jax
+            import numpy as _np
+
+            noop = jax.jit(lambda v: v + 1)
+            x = jax.device_put(_np.zeros(8, _np.float32))
+            jax.device_get(noop(x))  # compile
+            for _ in range(n):
+                t0 = time.perf_counter()
+                jax.device_get(noop(x))
+                rtt_ms.append((time.perf_counter() - t0) * 1e3)
+        except Exception:  # noqa: BLE001 - probe must not kill serving
+            log.exception("probe-latency: device RTT probe failed")
+
+        result = {
+            "n": n,
+            "attachment": _device_attachment(),
+            "http_p50_ms": round(pct(http_ms, 50), 3),
+            "http_p99_ms": round(pct(http_ms, 99), 3),
+            "predict_p50_ms": round(pct(predict_ms, 50), 3),
+            "predict_p99_ms": round(pct(predict_ms, 99), 3),
+            "dispatch_rtt_p50_ms": round(pct(rtt_ms, 50), 3) if rtt_ms else None,
+            "parse_p50_ms": round(pct(parse_ms, 50), 4),
+        }
+        result["overhead_p50_ms"] = round(
+            max(result["http_p50_ms"] - result["predict_p50_ms"], 0.0), 3)
+        if rtt_ms:
+            result["onchip_plus_transfer_p50_ms"] = round(
+                max(result["predict_p50_ms"] - result["dispatch_rtt_p50_ms"],
+                    0.0), 3)
+        print(f"[probe] full-path p50={result['http_p50_ms']}ms "
+              f"p99={result['http_p99_ms']}ms over {n} queries "
+              f"({result['attachment']})")
+        print(f"[probe]   predict (gather+dispatch+on-chip+fetch) "
+              f"p50={result['predict_p50_ms']}ms")
+        if rtt_ms:
+            print(f"[probe]   bare device dispatch RTT "
+                  f"p50={result['dispatch_rtt_p50_ms']}ms → on-chip+transfer "
+                  f"≈ {result['onchip_plus_transfer_p50_ms']}ms")
+        print(f"[probe]   http+queue overhead p50="
+              f"{result['overhead_p50_ms']}ms, json parse "
+              f"p50={result['parse_p50_ms']}ms")
+        try:
+            import dataclasses as _dc
+
+            instances = self.storage.get_meta_data_engine_instances()
+            fresh = instances.get(instance.id) or instance
+            instances.update(_dc.replace(
+                fresh,
+                runtime_conf={**fresh.runtime_conf,
+                              "probe_latency": json.dumps(result)}))
+        except Exception:  # noqa: BLE001 - persistence is best-effort
+            log.exception("probe-latency: persisting to instance row failed")
+        return result
+
     async def handle_reload(self, request: web.Request) -> web.Response:
         """Hot-swap to the latest completed instance (reference: /reload →
         MasterActor ! ReloadServer)."""
@@ -317,7 +446,19 @@ class EngineServer:
         return web.json_response({"plugins": self.plugins.plugin_names()})
 
 
-def run_engine_server(server: EngineServer, host: str = "0.0.0.0", port: int = 8000):
+def _device_attachment() -> str:
+    """Human label for where the accelerator lives (probe output)."""
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        return f"{d.platform}:{getattr(d, 'device_kind', '?')}"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def run_engine_server(server: EngineServer, host: str = "0.0.0.0",
+                      port: int = 8000, probe_latency: bool = False):
     """Blocking entry point (reference: CreateServer.main)."""
     loop = asyncio.new_event_loop()
     stop_event = asyncio.Event()
@@ -326,11 +467,19 @@ def run_engine_server(server: EngineServer, host: str = "0.0.0.0", port: int = 8
     async def main():
         from ..common import ssl_context_from_env
 
+        tls = ssl_context_from_env()
         runner = web.AppRunner(server.app)
         await runner.setup()
-        site = web.TCPSite(runner, host, port, ssl_context=ssl_context_from_env())
+        site = web.TCPSite(runner, host, port, ssl_context=tls)
         await site.start()
         log.info("Engine Server listening on %s:%d", host, port)
+        if probe_latency:
+            scheme = "https" if tls else "http"
+            try:
+                await asyncio.to_thread(
+                    server.probe_and_record, f"{scheme}://127.0.0.1:{port}")
+            except Exception:  # noqa: BLE001 - diagnostics must not kill serving
+                log.exception("startup latency probe failed; serving anyway")
         await stop_event.wait()
         await runner.cleanup()
 
