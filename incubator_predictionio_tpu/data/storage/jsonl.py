@@ -255,9 +255,27 @@ class JSONLEvents(base.LEvents):
         for event in events:
             eid = event.event_id or new_event_id()
             ids.append(eid)
-            lines.append(json.dumps(event.with_event_id(eid).to_json()) + "\n")
+            # inject the id into the serialized dict instead of
+            # dataclasses.replace-ing the event: replace re-runs
+            # __init__/__post_init__ and measured 14 µs/event on the
+            # ★ ingestion hot path
+            d = event.to_json()
+            d["eventId"] = eid
+            lines.append(json.dumps(d) + "\n")
         self._append(self._path(app_id, channel_id), lines)
         return ids
+
+    def insert_canonical_lines(
+        self, lines: bytes, app_id: int, channel_id: Optional[int] = None
+    ) -> None:
+        """Append pre-serialized canonical JSONL (the native ingest fast
+        path — native.ingest_batch already validated and formatted every
+        line; re-parsing into Event objects here would throw that work
+        away). The buffer must be newline-terminated canonical records."""
+        path = self._path(app_id, channel_id)
+        with self._lock:
+            with open(path, "ab") as f:
+                f.write(lines)
 
     def _row_event(self, cols: ColumnarEvents, i: int) -> Event:
         return Event.from_json(cols.record_dict(i))

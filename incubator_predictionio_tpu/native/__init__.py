@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 12
+_EXPECTED_VERSION = 14
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -96,6 +96,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.pio_free.restype = None
     lib.pio_free.argtypes = [ctypes.c_void_p]
+    lib.pio_ingest_batch.restype = ctypes.c_void_p
+    lib.pio_ingest_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.pio_ingest_count.restype = ctypes.c_int64
+    lib.pio_ingest_count.argtypes = [ctypes.c_void_p]
+    lib.pio_ingest_all_ok.restype = ctypes.c_int32
+    lib.pio_ingest_all_ok.argtypes = [ctypes.c_void_p]
+    lib.pio_ingest_lines.restype = ctypes.POINTER(ctypes.c_char)
+    lib.pio_ingest_lines.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+    lib.pio_ingest_free.restype = None
+    lib.pio_ingest_free.argtypes = [ctypes.c_void_p]
     lib.pio_fill_entries.restype = ctypes.c_int32
     lib.pio_fill_entries.argtypes = [
         ctypes.POINTER(ctypes.c_int64),   # row
@@ -628,3 +642,39 @@ def parse_events(buf: bytes) -> ColumnarEvents:
         return parse_events_jsonl(buf)
     except NativeUnavailable:
         return parse_events_jsonl_py(buf)
+
+
+def ingest_batch(raw: bytes, max_items: int, creation_iso: str):
+    """Validate + canonicalize a /batch/events.json body in ONE native
+    pass (the ★ ingestion hot path). Returns (event_ids, jsonl_bytes) on
+    the uniform happy case, or None when ANY item needs the Python path
+    (validation failure, client-supplied eventId, over-cap count, syntax
+    error) — the caller then re-parses in Python for exact error
+    semantics. Raises NativeUnavailable when the codec cannot load."""
+    import os as _os2
+
+    lib = _load()
+    try:
+        # Python json.loads decodes the body as strict UTF-8 before any
+        # grammar check; the C scanner is byte-oriented, so invalid UTF-8
+        # must bounce to the Python path here or it would be persisted.
+        raw.decode("utf-8", "strict")
+    except UnicodeDecodeError:
+        return None
+    ids_hex = _os2.urandom(16 * max_items).hex().encode()
+    err = ctypes.create_string_buffer(256)
+    h = lib.pio_ingest_batch(raw, len(raw), ids_hex, max_items,
+                             creation_iso.encode(), err, len(err))
+    if not h:
+        return None
+    try:
+        if not lib.pio_ingest_all_ok(h):
+            return None
+        n = lib.pio_ingest_count(h)
+        nbytes = ctypes.c_int64()
+        ptr = lib.pio_ingest_lines(h, ctypes.byref(nbytes))
+        lines = ctypes.string_at(ptr, nbytes.value)
+        ids = [ids_hex[32 * j:32 * (j + 1)].decode() for j in range(n)]
+        return ids, lines
+    finally:
+        lib.pio_ingest_free(h)

@@ -273,7 +273,10 @@ class EngineServer:
         except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500 w/ message
             log.exception("query failed")
             return web.json_response({"message": str(e)}, status=500)
-        if getattr(self, "_probing", False):
+        if request.headers.get("X-Pio-Probe"):
+            # synthetic startup-probe traffic: excluded from queryCount
+            # and the feedback self-log; REAL queries arriving during the
+            # probe window are unaffected (the marker is per-request)
             return web.json_response(result)
         self._query_count += 1
         if self.feedback:
@@ -340,7 +343,8 @@ class EngineServer:
         def post():
             req = urllib.request.Request(
                 base_url + "/queries.json", data=body,
-                headers={"Content-Type": "application/json"})
+                headers={"Content-Type": "application/json",
+                         "X-Pio-Probe": "1"})
             with urllib.request.urlopen(req, timeout=60,
                                         context=tls_ctx) as r:
                 r.read()
@@ -349,19 +353,13 @@ class EngineServer:
             a = sorted(a)
             return a[min(len(a) - 1, round(p / 100 * (len(a) - 1)))]
 
-        # Synthetic traffic must not masquerade as real: suppress the
-        # feedback self-log and queryCount while the probe runs.
-        self._probing = True
-        try:
-            for _ in range(5):  # warm HTTP keepalive-less path + executables
-                post()
-            http_ms = []
-            for _ in range(n):
-                t0 = time.perf_counter()
-                post()
-                http_ms.append((time.perf_counter() - t0) * 1e3)
-        finally:
-            self._probing = False
+        for _ in range(5):  # warm HTTP keepalive-less path + executables
+            post()
+        http_ms = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            post()
+            http_ms.append((time.perf_counter() - t0) * 1e3)
         parse_ms, predict_ms = [], []
         for _ in range(n):
             t0 = time.perf_counter()

@@ -548,7 +548,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 12; }
+int32_t pio_codec_version() { return 14; }
 
 namespace {
 // FNV-1a over a byte range, continuing from a running state.
@@ -809,5 +809,520 @@ const char* pio_tombstone_get(void* h, int64_t idx, int32_t* len_out) {
 }
 
 void pio_free(void* h) { delete H(h); }
+
+}  // extern "C"
+
+// ===========================================================================
+// Ingest fast path: validate + canonicalize a /batch/events.json body in one
+// pass (reference hot path: data/.../data/api/EventServer.scala — POST →
+// validate → store Put). The Python event server calls this with the RAW
+// request bytes; on all_ok it appends the returned canonical JSONL straight
+// to the event log without constructing a single Python Event. Any anomaly
+// (validation failure, client-supplied eventId, over-cap count, top-level
+// syntax error) flips all_ok off and the server falls back wholesale to the
+// Python path, which produces the exact per-item error messages — so the C
+// path only ever handles the uniform happy case, and semantics stay pinned
+// by the Python implementation and its tests.
+// ===========================================================================
+
+namespace {
+
+struct IngestOut {
+  std::string lines;   // canonical JSONL for every item (valid only)
+  int64_t n_items = 0;
+  bool all_ok = true;
+  std::string err;     // top-level parse error ("" when the array parsed)
+};
+
+// epoch micros → canonical "YYYY-MM-DDTHH:MM:SS.mmmZ" (millis TRUNCATED,
+// matching Python format_event_time's microsecond//1000).
+inline void format_us(int64_t us, std::string& out) {
+  int64_t days = us / 86400000000LL;
+  int64_t rem = us % 86400000000LL;
+  if (rem < 0) { rem += 86400000000LL; days -= 1; }
+  // civil-from-days (Howard Hinnant, public domain)
+  int64_t z = days + 719468;
+  int64_t era = (z >= 0 ? z : z - 146096) / 146097;
+  unsigned long doe = static_cast<unsigned long>(z - era * 146097);
+  unsigned long yoe = (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+  int64_t y = static_cast<int64_t>(yoe) + era * 400;
+  unsigned long doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+  unsigned long mp = (5 * doy + 2) / 153;
+  unsigned long d = doy - (153 * mp + 2) / 5 + 1;
+  unsigned long m = mp + (mp < 10 ? 3 : -9);
+  y += (m <= 2);
+  int64_t secs = rem / 1000000;
+  int ms = static_cast<int>((rem % 1000000) / 1000);
+  char tmp[32];
+  snprintf(tmp, sizeof tmp, "%04lld-%02lu-%02luT%02lld:%02lld:%02lld.%03dZ",
+           static_cast<long long>(y), m, d,
+           static_cast<long long>(secs / 3600),
+           static_cast<long long>((secs / 60) % 60),
+           static_cast<long long>(secs % 60), ms);
+  out += tmp;
+}
+
+struct IngestParser : Parser {
+  using Parser::Parser;
+
+  // -- STRICT JSON layer --------------------------------------------------
+  // The ingest path persists raw byte spans verbatim, so anything the
+  // lenient scan parser tolerates but Python's json.loads rejects
+  // (leading '+', leading zeros, bare '.5'/'1.', raw control characters
+  // in strings) MUST be refused here — a lenient accept would poison the
+  // event log with records read-back cannot parse. Stricter-than-Python
+  // is always safe: the caller falls back to the Python path.
+
+  bool strict_string(std::string& out) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    const char* q = p + 1;
+    bool esc = false;
+    while (q < end) {
+      unsigned char c = static_cast<unsigned char>(*q);
+      if (c < 0x20) return false;  // python json: raw control chars invalid
+      if (esc) esc = false;
+      else if (c == '\\') esc = true;
+      else if (c == '"') break;
+      ++q;
+    }
+    bool ok = parse_string(out);
+    if (!ok) err.clear();
+    return ok;
+  }
+
+  bool strict_value() {
+    ws();
+    if (p >= end) return false;
+    char c = *p;
+    if (c == '"') { std::string s; return strict_string(s); }
+    if (c == '{') {
+      ++p; ws();
+      if (p < end && *p == '}') { ++p; return true; }
+      while (true) {
+        ws();
+        std::string k;
+        if (!strict_string(k)) return false;
+        ws();
+        if (p >= end || *p++ != ':') return false;
+        if (!strict_value()) return false;
+        ws();
+        if (p < end && *p == ',') { ++p; continue; }
+        if (p < end && *p == '}') { ++p; return true; }
+        return false;
+      }
+    }
+    if (c == '[') {
+      ++p; ws();
+      if (p < end && *p == ']') { ++p; return true; }
+      while (true) {
+        if (!strict_value()) return false;
+        ws();
+        if (p < end && *p == ',') { ++p; continue; }
+        if (p < end && *p == ']') { ++p; return true; }
+        return false;
+      }
+    }
+    if (c == 't') { if (end - p >= 4 && !memcmp(p, "true", 4)) { p += 4; return true; } return false; }
+    if (c == 'f') { if (end - p >= 5 && !memcmp(p, "false", 5)) { p += 5; return true; } return false; }
+    if (c == 'n') { if (end - p >= 4 && !memcmp(p, "null", 4)) { p += 4; return true; } return false; }
+    // number per json grammar: -? (0|[1-9][0-9]*) (.[0-9]+)? ([eE][+-]?[0-9]+)?
+    if (c == '-') ++p;
+    if (p >= end) return false;
+    if (*p == '0') ++p;
+    else if (*p >= '1' && *p <= '9') { while (p < end && *p >= '0' && *p <= '9') ++p; }
+    else return false;
+    if (p < end && *p == '.') {
+      ++p;
+      if (p >= end || *p < '0' || *p > '9') return false;
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      ++p;
+      if (p < end && (*p == '+' || *p == '-')) ++p;
+      if (p >= end || *p < '0' || *p > '9') return false;
+      while (p < end && *p >= '0' && *p <= '9') ++p;
+    }
+    return true;
+  }
+
+  // Strict ISO-8601 with FULL range checks (Python fromisoformat parity
+  // or narrower): hh<=23/mm,ss<=59, real day-of-month incl. leap years,
+  // <=6 fractional digits, offset hh<=23/mm<=59, and the final UTC
+  // instant inside Python's year 1..9999.
+  static bool strict_iso_us(const std::string& s, int64_t& out_us) {
+    const char* q = s.c_str();
+    const char* qe = q + s.size();
+    auto dig = [&](int n, long& v) -> bool {
+      v = 0;
+      for (int i = 0; i < n; ++i) {
+        if (q >= qe || *q < '0' || *q > '9') return false;
+        v = v * 10 + (*q++ - '0');
+      }
+      return true;
+    };
+    long Y, M, D, h = 0, m = 0, ss = 0, frac_us = 0;
+    if (!dig(4, Y)) return false;
+    if (q >= qe || *q++ != '-') return false;
+    if (!dig(2, M)) return false;
+    if (q >= qe || *q++ != '-') return false;
+    if (!dig(2, D)) return false;
+    if (Y < 1 || M < 1 || M > 12) return false;
+    static const int mdays[] = {31,28,31,30,31,30,31,31,30,31,30,31};
+    int md = mdays[M - 1] +
+        ((M == 2 && (Y % 4 == 0 && (Y % 100 != 0 || Y % 400 == 0))) ? 1 : 0);
+    if (D < 1 || D > md) return false;
+    if (q < qe && (*q == 'T' || *q == ' ')) {
+      ++q;
+      if (!dig(2, h)) return false;
+      if (q >= qe || *q++ != ':') return false;
+      if (!dig(2, m)) return false;
+      if (q < qe && *q == ':') {
+        ++q;
+        if (!dig(2, ss)) return false;
+        if (q < qe && *q == '.') {
+          ++q;
+          int nd = 0;
+          while (q < qe && *q >= '0' && *q <= '9') {
+            if (nd >= 6) return false;  // >6 digits → python path decides
+            frac_us = frac_us * 10 + (*q++ - '0');
+            ++nd;
+          }
+          if (nd == 0) return false;
+          while (nd < 6) { frac_us *= 10; ++nd; }
+        }
+      }
+      if (h > 23 || m > 59 || ss > 59) return false;
+    }
+    long off = 0;
+    if (q < qe) {
+      if (*q == 'Z') ++q;
+      else if (*q == '+' || *q == '-') {
+        int sg = (*q == '-') ? -1 : 1;
+        ++q;
+        long oh, om = 0;
+        if (!dig(2, oh)) return false;
+        if (q < qe && *q == ':') { ++q; if (!dig(2, om)) return false; }
+        else if (q < qe) { if (!dig(2, om)) return false; }
+        if (oh > 23 || om > 59) return false;
+        off = sg * (oh * 3600 + om * 60);
+      } else return false;
+    }
+    if (q != qe) return false;
+    long y = Y - (M <= 2);
+    long era = (y >= 0 ? y : y - 399) / 400;
+    unsigned long yoe = static_cast<unsigned long>(y - era * 400);
+    unsigned long doy = (153 * (M + (M > 2 ? -3 : 9)) + 2) / 5 + D - 1;
+    unsigned long doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    int64_t days = era * 146097 + static_cast<int64_t>(doe) - 719468;
+    int64_t us = (days * 86400 + h * 3600 + m * 60 + ss - off) * 1000000
+                 + frac_us;
+    // Python datetime years 1..9999 (UTC): outside → fallback
+    if (us < -62135596800000000LL || us > 253402300799999999LL) return false;
+    out_us = us;
+    return true;
+  }
+
+  // Walk a JSON object value: capture its raw span, count keys, and check
+  // the reserved "pio_" key prefix (decoded keys — escapes resolved).
+  bool props_object(int64_t& start, int64_t& stop, int64_t& n_keys,
+                    bool& pio_key) {
+    ws();
+    if (p >= end || *p != '{') return false;
+    start = p - base;
+    ++p;
+    n_keys = 0;
+    std::string key;
+    ws();
+    if (p < end && *p == '}') {
+      ++p;
+      stop = p - base;
+      return true;
+    }
+    while (true) {
+      ws();
+      if (!strict_string(key)) return false;
+      if (key.rfind("pio_", 0) == 0) pio_key = true;
+      ++n_keys;
+      ws();
+      if (p >= end || *p != ':') return false;
+      ++p;
+      ws();
+      if (!strict_value()) return false;
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; stop = p - base; return true; }
+      return false;
+    }
+  }
+
+  // Array of strings (tags); captures raw span.
+  bool string_array(int64_t& start, int64_t& stop) {
+    ws();
+    if (p >= end || *p != '[') return false;
+    start = p - base;
+    ++p;
+    std::string s;
+    ws();
+    if (p < end && *p == ']') { ++p; stop = p - base; return true; }
+    while (true) {
+      ws();
+      if (!strict_string(s)) return false;
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; stop = p - base; return true; }
+      return false;
+    }
+  }
+
+  // String token: decoded value AND raw span (incl. quotes) for verbatim
+  // re-serialization without re-escaping.
+  bool string_token(std::string& out, int64_t& start, int64_t& stop) {
+    ws();
+    start = p - base;
+    if (!strict_string(out)) return false;
+    stop = p - base;
+    return true;
+  }
+
+  // Integer token (ids may be JSON ints; floats/bools are invalid ids).
+  bool int_token(int64_t& start, int64_t& stop) {
+    ws();
+    start = p - base;
+    if (p < end && *p == '-') ++p;
+    if (p >= end || *p < '0' || *p > '9') return false;
+    while (p < end && *p >= '0' && *p <= '9') ++p;
+    if (p < end && (*p == '.' || *p == 'e' || *p == 'E')) return false;
+    stop = p - base;
+    return true;
+  }
+
+  bool is_null() { return p + 4 <= end && memcmp(p, "null", 4) == 0; }
+
+  // One batch item → one canonical line appended to out.lines. ANY
+  // anomaly (wrong type, failed validation, client eventId) sets
+  // all_ok=false and stops — the Python path redoes the whole request,
+  // so no recovery parsing is ever needed. Returns false only on
+  // malformed JSON that also stops the scan.
+  bool item(IngestOut& out, const char* id32, const std::string& creation) {
+    ws();
+    if (p >= end || *p != '{') return false;
+    ++p;
+    std::string ev, etype, key, sval, tet_val;
+    int64_t ev_s = -1, ev_e = -1, et_s = -1, et_e = -1;
+    int64_t ei_s = -1, ei_e = -1;       // entityId span (string or int)
+    bool ei_int = false, ei_empty = true, has_ei = false;
+    int64_t tet_s = -1, tet_e = -1, tei_s = -1, tei_e = -1;
+    bool tei_int = false, tet_null = true, tei_null = true;
+    int64_t pr_s = -1, pr_e = -1, pr_keys = 0;
+    bool pio_key = false;
+    int64_t tg_s = -1, tg_e = -1;
+    int64_t prid_s = -1, prid_e = -1;
+    int64_t t_us = INT64_MIN, d0 = 0, d1 = 0;
+    bool has_time = false;
+
+    ws();
+    if (p < end && *p == '}') {
+      ++p;
+      out.all_ok = false;  // missing required fields → python error path
+      return true;
+    }
+    while (true) {
+      ws();
+      if (!strict_string(key)) return false;
+      ws();
+      if (p >= end || *p != ':') return false;
+      ++p;
+      if (key == "event") {
+        if (!string_token(ev, ev_s, ev_e)) { out.all_ok = false; return true; }
+      } else if (key == "entityType") {
+        if (!string_token(etype, et_s, et_e)) { out.all_ok = false; return true; }
+      } else if (key == "entityId") {
+        ws();
+        has_ei = true;
+        if (p < end && *p == '"') {
+          if (!string_token(sval, ei_s, ei_e)) { out.all_ok = false; return true; }
+          ei_empty = sval.empty();
+        } else if (int_token(ei_s, ei_e)) {
+          ei_int = true; ei_empty = false;
+        } else { out.all_ok = false; return true; }
+      } else if (key == "targetEntityType") {
+        ws();
+        if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
+        else if (p < end && *p == '"') {
+          if (!string_token(tet_val, tet_s, tet_e)) { out.all_ok = false; return true; }
+          tet_null = false;
+        } else { out.all_ok = false; return true; }
+      } else if (key == "targetEntityId") {
+        ws();
+        if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
+        else if (p < end && *p == '"') {
+          if (!string_token(sval, tei_s, tei_e)) { out.all_ok = false; return true; }
+          tei_null = false;
+          if (sval.empty()) { out.all_ok = false; return true; }
+        } else if (int_token(tei_s, tei_e)) { tei_null = false; tei_int = true; }
+        else { out.all_ok = false; return true; }
+      } else if (key == "properties") {
+        ws();
+        if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
+        else if (!props_object(pr_s, pr_e, pr_keys, pio_key))
+          { out.all_ok = false; return true; }
+      } else if (key == "tags") {
+        ws();
+        if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
+        else if (!string_array(tg_s, tg_e)) { out.all_ok = false; return true; }
+      } else if (key == "prId") {
+        ws();
+        if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
+        else if (!string_token(sval, prid_s, prid_e))
+          { out.all_ok = false; return true; }
+      } else if (key == "eventTime") {
+        ws();
+        if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
+        else {
+          if (!string_token(sval, d0, d1)) { out.all_ok = false; return true; }
+          has_time = true;
+          if (!strict_iso_us(sval, t_us)) { out.all_ok = false; return true; }
+        }
+      } else if (key == "eventId") {
+        out.all_ok = false;  // client-supplied id → upsert semantics → python
+        return true;
+      } else if (key == "creationTime") {
+        // server-assigned: the event server pops it from client payloads
+        if (!strict_value()) { out.all_ok = false; return true; }
+      } else {
+        // unknown keys ignored by from_json, but json.loads still
+        // validates them — strict or bust
+        if (!strict_value()) { out.all_ok = false; return true; }
+      }
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; break; }
+      return false;
+    }
+
+    // -- validation (mirror of event.py validate_event + from_json) ------
+    if (ev_s < 0 || ev.empty() || et_s < 0 || etype.empty() || !has_ei ||
+        ei_empty || pio_key)
+      { out.all_ok = false; return true; }
+    if (tet_null != tei_null) { out.all_ok = false; return true; }
+    if (!tet_null && tet_val.empty()) { out.all_ok = false; return true; }
+    if (ev[0] == '$') {
+      bool special = (ev == "$set" || ev == "$unset" || ev == "$delete");
+      bool props_empty = (pr_s < 0 || pr_keys == 0);
+      if (!special || !tet_null ||
+          (ev == "$unset" && props_empty) ||
+          (ev == "$delete" && !props_empty))
+        { out.all_ok = false; return true; }
+    }
+    if (etype.rfind("pio_", 0) == 0 ||
+        (!tet_null && tet_val.rfind("pio_", 0) == 0))
+      { out.all_ok = false; return true; }
+
+    // -- canonical line (field order matches Event.to_json) --------------
+    std::string& L = out.lines;
+    L += "{\"eventId\": \"";
+    L.append(id32, 32);
+    L += "\", \"event\": ";
+    L.append(base + ev_s, ev_e - ev_s);
+    L += ", \"entityType\": ";
+    L.append(base + et_s, et_e - et_s);
+    L += ", \"entityId\": ";
+    if (ei_int) { L += '"'; L.append(base + ei_s, ei_e - ei_s); L += '"'; }
+    else L.append(base + ei_s, ei_e - ei_s);
+    if (!tet_null) {
+      L += ", \"targetEntityType\": ";
+      L.append(base + tet_s, tet_e - tet_s);
+      L += ", \"targetEntityId\": ";
+      if (tei_int) { L += '"'; L.append(base + tei_s, tei_e - tei_s); L += '"'; }
+      else L.append(base + tei_s, tei_e - tei_s);
+    }
+    L += ", \"properties\": ";
+    if (pr_s >= 0) L.append(base + pr_s, pr_e - pr_s);
+    else L += "{}";
+    L += ", \"eventTime\": \"";
+    if (has_time) format_us(t_us, L);
+    else L += creation;  // server time when the client omitted eventTime
+    L += "\"";
+    if (tg_s >= 0) {
+      L += ", \"tags\": ";
+      L.append(base + tg_s, tg_e - tg_s);
+    }
+    if (prid_s >= 0) {
+      L += ", \"prId\": ";
+      L.append(base + prid_s, prid_e - prid_s);
+    }
+    L += ", \"creationTime\": \"";
+    L += creation;
+    L += "\"}\n";
+    return true;
+  }
+
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pio_ingest_batch(const char* buf, int64_t len, const char* ids_hex,
+                       int64_t n_ids, const char* creation_iso,
+                       char* errbuf, int64_t errbuf_len) {
+  auto* out = new IngestOut();
+  IngestParser ps(buf, len);
+  std::string creation(creation_iso ? creation_iso : "");
+  ps.ws();
+  if (ps.p >= ps.end || *ps.p != '[') {
+    out->err = "batch body must be a JSON array";
+    if (errbuf && errbuf_len > 0)
+      snprintf(errbuf, errbuf_len, "%s", out->err.c_str());
+    out->all_ok = false;
+    return out;
+  }
+  ++ps.p;
+  ps.ws();
+  if (ps.p < ps.end && *ps.p == ']') {
+    ++ps.p;
+  } else {
+    while (true) {
+      if (out->n_items >= n_ids) { out->all_ok = false; break; }
+      if (!ps.item(*out, ids_hex + 32 * out->n_items, creation)) {
+        out->err = ps.err.empty() ? "malformed event object" : ps.err;
+        if (errbuf && errbuf_len > 0)
+          snprintf(errbuf, errbuf_len, "%s", out->err.c_str());
+        out->all_ok = false;
+        break;
+      }
+      ++out->n_items;
+      if (!out->all_ok) break;  // python will redo the whole request
+      ps.ws();
+      if (ps.p < ps.end && *ps.p == ',') { ++ps.p; continue; }
+      if (ps.p < ps.end && *ps.p == ']') { ++ps.p; break; }
+      out->err = "expected ',' or ']'";
+      out->all_ok = false;
+      break;
+    }
+  }
+  if (out->all_ok) {
+    ps.ws();
+    if (ps.p != ps.end) out->all_ok = false;  // trailing garbage
+  }
+  return out;
+}
+
+int64_t pio_ingest_count(void* h) {
+  return static_cast<IngestOut*>(h)->n_items;
+}
+
+int32_t pio_ingest_all_ok(void* h) {
+  return static_cast<IngestOut*>(h)->all_ok ? 1 : 0;
+}
+
+const char* pio_ingest_lines(void* h, int64_t* out_len) {
+  auto* o = static_cast<IngestOut*>(h);
+  if (out_len) *out_len = static_cast<int64_t>(o->lines.size());
+  return o->lines.data();
+}
+
+void pio_ingest_free(void* h) { delete static_cast<IngestOut*>(h); }
 
 }  // extern "C"

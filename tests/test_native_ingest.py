@@ -1,0 +1,213 @@
+"""Native batch-ingest fast path (reference ★ hot path: EventServer →
+validate → store Put; here one C pass over the raw /batch/events.json
+body). Parity contract: through the JSONL store the native path must be
+indistinguishable from the Python path — same stored semantics, same
+per-item responses — and every anomaly must fall back to Python for
+exact error messages."""
+
+import datetime as dt
+import json
+
+import pytest
+import requests
+
+from incubator_predictionio_tpu.data.api.event_server import EventServer
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+
+from server_utils import ServerThread
+
+
+@pytest.fixture()
+def jsonl_storage(tmp_path):
+    s = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        "PIO_STORAGE_SOURCES_M_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path / "events"),
+    })
+    s.get_meta_data_apps().insert(App(0, "napp"))
+    s.get_meta_data_access_keys().insert(AccessKey("nk", 1, ()))
+    s.get_l_events().init(1)
+    yield s
+    s.close()
+
+
+BATCH = [
+    {"event": "view", "entityType": "user", "entityId": "u1",
+     "targetEntityType": "item", "targetEntityId": 42,
+     "properties": {"rating": 4.5, "nested": {"a": [1, "ü\"x"]}},
+     "eventTime": "2024-03-05T06:07:08.123456+05:30",
+     "tags": ["a", "b\"q"], "prId": "p1"},
+    {"event": "$set", "entityType": "item", "entityId": "i1",
+     "properties": {"categories": ["x"]}},
+    {"event": "buy", "entityType": "user", "entityId": 7},
+]
+
+
+def _ingest(storage, body, monkeypatch=None, disable_native=False):
+    if monkeypatch is not None:
+        if disable_native:
+            monkeypatch.setenv("PIO_DISABLE_NATIVE", "1")
+        else:
+            monkeypatch.delenv("PIO_DISABLE_NATIVE", raising=False)
+    with ServerThread(EventServer(storage).app) as st:
+        return requests.post(
+            st.base + "/batch/events.json?accessKey=nk", json=body)
+
+
+def _normalized(storage):
+    """Stored events minus server-assigned fields, for cross-path diff."""
+    out = []
+    for e in storage.get_l_events().find(1):
+        d = e.to_json()
+        d.pop("eventId")
+        if d["eventTime"] == d["creationTime"]:
+            d.pop("eventTime")  # server-assigned wall clock, run-dependent
+        d.pop("creationTime")
+        out.append(d)
+    return sorted(out, key=lambda d: (d["event"], str(d["entityId"])))
+
+
+def test_native_path_matches_python_path(jsonl_storage, tmp_path, monkeypatch):
+    r = _ingest(jsonl_storage, BATCH, monkeypatch, disable_native=False)
+    assert r.status_code == 200
+    assert all(x["status"] == 201 and len(x["eventId"]) == 32
+               for x in r.json())
+    native_stored = _normalized(jsonl_storage)
+    assert len(native_stored) == 3
+
+    py = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        "PIO_STORAGE_SOURCES_M_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path / "py_events"),
+    })
+    py.get_meta_data_apps().insert(App(0, "napp"))
+    py.get_meta_data_access_keys().insert(AccessKey("nk", 1, ()))
+    py.get_l_events().init(1)
+    r = _ingest(py, BATCH, monkeypatch, disable_native=True)
+    assert r.status_code == 200
+    assert _normalized(py) == native_stored
+    py.close()
+
+
+def test_mixed_validity_batch_falls_back_with_exact_errors(jsonl_storage):
+    body = [BATCH[0],
+            {"event": "view", "entityType": "user"},  # missing entityId
+            {"event": "$nope", "entityType": "x", "entityId": "1"},
+            BATCH[2]]
+    r = _ingest(jsonl_storage, body)
+    assert r.status_code == 200
+    out = r.json()
+    assert out[0]["status"] == 201 and out[3]["status"] == 201
+    assert out[1]["status"] == 400 and "entityId" in out[1]["message"]
+    assert out[2]["status"] == 400 and "reserved" in out[2]["message"]
+    assert len(_normalized(jsonl_storage)) == 2
+
+
+def test_client_event_id_and_whitelist_fall_back(jsonl_storage):
+    # client-supplied eventId → upsert semantics only the python path has
+    eid = "a" * 32
+    body = [dict(BATCH[2], eventId=eid)]
+    r = _ingest(jsonl_storage, body)
+    assert r.json()[0]["eventId"] == eid
+    assert jsonl_storage.get_l_events().get(eid, 1) is not None
+
+    # per-key whitelist → python path enforces it
+    jsonl_storage.get_meta_data_access_keys().insert(
+        AccessKey("wl", 1, ("view",)))
+    with ServerThread(EventServer(jsonl_storage).app) as st:
+        r = requests.post(st.base + "/batch/events.json?accessKey=wl",
+                          json=[BATCH[0], BATCH[2]])
+    out = r.json()
+    assert out[0]["status"] == 201
+    assert out[1]["status"] == 400  # "buy" not whitelisted
+
+
+def test_over_cap_and_malformed_bodies(jsonl_storage):
+    r = _ingest(jsonl_storage, [BATCH[2]] * 51)
+    assert r.status_code == 400
+    assert "50" in r.json()["message"]
+    with ServerThread(EventServer(jsonl_storage).app) as st:
+        r = requests.post(st.base + "/batch/events.json?accessKey=nk",
+                          data="}{",
+                          headers={"Content-Type": "application/json"})
+        assert r.status_code == 400
+        r = requests.post(st.base + "/batch/events.json?accessKey=nk",
+                          json={"not": "a list"})
+        assert r.status_code == 400
+
+
+def test_native_events_round_trip_through_training_scan(jsonl_storage, monkeypatch):
+    """Events written by the C path must be scannable by the native
+    columnar reader AND the Python row reader (they feed training)."""
+    r = _ingest(jsonl_storage, BATCH, monkeypatch, disable_native=False)
+    assert r.status_code == 200
+    le = jsonl_storage.get_l_events()
+    events = list(le.find(1, event_names=["view"]))
+    assert len(events) == 1
+    e = events[0]
+    assert e.target_entity_id == "42"  # int id stringified, python parity
+    assert e.event_time == dt.datetime(
+        2024, 3, 5, 0, 37, 8, 123000, tzinfo=dt.timezone.utc)
+    assert e.properties.get("nested") == {"a": [1, "ü\"x"]}
+    assert e.tags == ("a", 'b"q')
+
+
+def test_strict_json_never_wider_than_python(jsonl_storage):
+    """Bytes Python's json.loads rejects must NEVER take the native fast
+    path into the log (poisoned records would break every later scan):
+    they fall back and 400 like before."""
+    le = jsonl_storage.get_l_events()
+    base = ('{"event": "view", "entityType": "user", "entityId": "u",'
+            ' "properties": %s}')
+    with ServerThread(EventServer(jsonl_storage).app) as st:
+        url = st.base + "/batch/events.json?accessKey=nk"
+        hdr = {"Content-Type": "application/json"}
+        for props in ('{"a": +1}', '{"a": 007}', '{"a": .5}', '{"a": 1.}',
+                      '{"a": "ctrl\x01char"}'):
+            r = requests.post(url, data=("[" + base % props + "]").encode(),
+                              headers=hdr)
+            assert r.status_code == 400, props
+        # invalid UTF-8 body
+        r = requests.post(url, data=b'[{"event": "\xff\xfe"}]', headers=hdr)
+        assert r.status_code == 400
+        # out-of-range times Python rejects → per-item 400, nothing stored
+        for t in ("2026-02-31T10:00:00Z", "2026-01-01T99:00:00Z",
+                  "0000-01-01T00:00:00Z"):
+            r = requests.post(url, json=[
+                {"event": "view", "entityType": "user", "entityId": "u",
+                 "eventTime": t}])
+            assert r.status_code == 200
+            assert r.json()[0]["status"] == 400, t
+    # the log stayed clean: full scan parses
+    assert list(le.find(1)) == []
+
+
+def test_strict_but_valid_edge_cases_stored_readably(jsonl_storage):
+    """Exotic-but-valid payloads: whichever path takes them, every stored
+    record must read back through the scan."""
+    body = [
+        {"event": "view", "entityType": "user", "entityId": "u1",
+         "properties": {"f": -0.5e3, "z": 0, "neg": -0, "s": "tab\tok",
+                        "uni": "é中"},
+         "eventTime": "2024-12-31T23:59:59.999999Z"},
+        {"event": "view", "entityType": "user", "entityId": "u2",
+         "eventTime": "2024-06-01T12:00:00+14:00"},  # valid extreme offset
+    ]
+    with ServerThread(EventServer(jsonl_storage).app) as st:
+        r = requests.post(st.base + "/batch/events.json?accessKey=nk",
+                          json=body)
+    assert r.status_code == 200
+    assert all(x["status"] == 201 for x in r.json())
+    got = {e.entity_id: e for e in jsonl_storage.get_l_events().find(1)}
+    assert got["u1"].properties.get("f") == -500.0
+    assert got["u1"].properties.get("s") == "tab\tok"
+    assert got["u1"].event_time.microsecond == 999000  # ms truncation
+    assert got["u2"].event_time == dt.datetime(
+        2024, 5, 31, 22, 0, tzinfo=dt.timezone.utc)
