@@ -39,6 +39,16 @@ class Algorithm(AbstractDoer, Generic[PD, M, Q, P]):
         for eval throughput (reference: batchPredict)."""
         return [self.predict(model, q) for q in queries]
 
+    def stage_model(self, prepared_data: PD):
+        """Optional workload description for cost-based device placement
+        (`pio train --device=auto`; workflow/placement.py): return a
+        placement.StageModel sizing the data this train would move and
+        touch, or None to always run on the configured accelerator mesh.
+        Provided by the measured transfer-bound algorithms (NB/LR over
+        dense features, text TF-IDF); iterative compute-dense trainers
+        (ALS, CCO) stay accelerator-pinned."""
+        return None
+
     # -- model persistence hooks (reference: makeSerializableModels) ------
     def prepare_model_for_persistence(self, model: M) -> Any:
         """Convert device arrays → host (numpy) before pickling. Default

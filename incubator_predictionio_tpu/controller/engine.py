@@ -179,6 +179,19 @@ class Engine:
             # Stage label for error attribution inside iterative trainers
             # (e.g. train_als' per-iteration NaN guard).
             ctx.stage_label = f"algorithm[{name or 'default'}]"
+            # Cost-based placement (--device=auto): run this stage's
+            # train on whichever mesh the measured stage model prices
+            # cheaper (workflow/placement.py); restored afterwards.
+            from ..workflow.placement import mesh_for_stage
+
+            prev_mesh = ctx.mesh
+            try:
+                sm = algo.stage_model(pd)
+            except Exception:  # noqa: BLE001 - sizing must never kill training
+                log.exception("stage_model failed; using configured mesh")
+                sm = None
+            stage_mesh = mesh_for_stage(
+                ctx, sm, getattr(wp, "device", "auto"), ctx.stage_label)
             if root_hook is not None:
                 # Per-algorithm subdirectory: without it, multiple
                 # algorithms in one engine would collide on orbax step
@@ -189,8 +202,12 @@ class Engine:
                     max_to_keep=root_hook.max_to_keep,
                 )
             try:
+                # swap INSIDE the try: an exception between swap and
+                # train (e.g. checkpoint-hook setup) must still restore
+                ctx.mesh = stage_mesh
                 model = algo.train(ctx, pd)
             finally:
+                ctx.mesh = prev_mesh
                 if root_hook is not None:
                     ctx.checkpoint_hook.close()
                     ctx.checkpoint_hook = root_hook

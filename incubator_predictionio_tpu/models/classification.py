@@ -133,6 +133,15 @@ class NaiveBayesAlgorithm(Algorithm):
     params_cls = NaiveBayesParams
     params_aliases = {"lambda": "smoothing"}
 
+    def stage_model(self, pd: PreparedData):
+        """One pass of sufficient stats over [N, D] — transfer-bound
+        through a slow link (BASELINE.md crossover: CPU won every
+        measured point via the tunnel); --device=auto prices it."""
+        from ..workflow.placement import StageModel
+
+        return StageModel(bytes_to_device=pd.features.nbytes,
+                          device_passes=1.0, cpu_passes=1.0)
+
     def train(self, ctx, pd: PreparedData) -> ClassifierModel:
         model = train_naive_bayes(
             pd.features, pd.labels, n_classes=len(pd.label_values),
@@ -157,6 +166,15 @@ class LogisticRegressionParams(Params):
 class LogisticRegressionAlgorithm(Algorithm):
     params_cls = LogisticRegressionParams
     params_aliases = {"regParam": "reg", "maxIterations": "max_iters"}
+
+    def stage_model(self, pd: PreparedData):
+        """L-BFGS passes over resident [N, D]: upload once, iterate on
+        device vs iterate on host (same jitted program either way)."""
+        from ..workflow.placement import StageModel
+
+        iters = float(self.params.max_iters)
+        return StageModel(bytes_to_device=pd.features.nbytes,
+                          device_passes=iters, cpu_passes=iters)
 
     def train(self, ctx, pd: PreparedData) -> ClassifierModel:
         model = train_logistic_regression(

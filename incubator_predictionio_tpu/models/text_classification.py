@@ -170,6 +170,20 @@ class TextNBAlgorithm(Algorithm):
     params_cls = TextAlgorithmParams
     params_aliases = {"lambda": "smoothing", "regParam": "reg"}
 
+    def stage_model(self, pd: PreparedData):
+        """One scatter-add pass over the COO term counts (or the dense
+        matrix): transfer-bound through a slow link — the BASELINE.md
+        crossover tables measured CPU ahead at every tunnel point."""
+        from ..workflow.placement import StageModel
+
+        if pd.coo is not None:
+            doc_ptr, feat, cnt = pd.coo
+            nbytes = feat.nbytes + cnt.nbytes + doc_ptr.nbytes
+        else:
+            nbytes = pd.features.nbytes
+        return StageModel(bytes_to_device=nbytes, device_passes=1.0,
+                          cpu_passes=1.0)
+
     def train(self, ctx, pd: PreparedData) -> TextModel:
         mesh = ctx.get_mesh() if ctx else None
         scale = pd.vectorizer.idf if pd.features_are_tf else None

@@ -45,6 +45,12 @@ def build_cmd(args: list[str]) -> int:
     return 0
 
 
+def _placement_default() -> str:
+    from ...workflow.placement import device_mode_from_env
+
+    return device_mode_from_env("auto")
+
+
 @verb("train", "run the training workflow")
 def train_cmd(args: list[str]) -> int:
     p = argparse.ArgumentParser(prog="pio train")
@@ -64,6 +70,11 @@ def train_cmd(args: list[str]) -> int:
                    help="fail fast with stage/iteration attribution when a "
                         "stage produces NaN/Inf (SURVEY §5.2 sanitizer tier; "
                         "iterative trainers dispatch per-iteration)")
+    p.add_argument("--device", choices=("tpu", "cpu", "auto"), default=None,
+                   help="where to train: auto (default) prices "
+                        "accelerator-vs-CPU per algorithm with measured "
+                        "link/host rates and picks the faster; tpu/cpu "
+                        "force one side (PIO_TRAIN_DEVICE sets the default)")
     ns = p.parse_args(args)
     from ...workflow.core_workflow import run_train
 
@@ -82,6 +93,7 @@ def train_cmd(args: list[str]) -> int:
         resume=ns.resume,
         profile_dir=ns.profile_dir,
         nan_guard=ns.nan_guard,
+        device=ns.device or _placement_default(),
     )
     import time as _time
 

@@ -25,3 +25,7 @@ class WorkflowParams:
     # stage output for non-finite values with stage attribution;
     # iterative trainers dispatch per-iteration to name the iteration.
     nan_guard: bool = False
+    # Cost-based device placement (workflow/placement.py): auto prices
+    # accelerator-vs-CPU per algorithm with measured link/host rates and
+    # runs each stage where it is fastest; tpu/cpu force one side.
+    device: str = "auto"
