@@ -30,7 +30,7 @@ from ..controller import Algorithm, DataSource, Engine, EngineFactory, Params, S
 from ..data.storage.bimap import BiMap
 from ..data.store.l_event_store import LEventStore
 from ..data.store.p_event_store import PEventStore
-from ..ops.llr import Indicators, cco_indicators, score_user
+from ..ops.llr import Indicators, cco_indicators_multi, score_user
 from ._filters import CategoryIndex, build_exclude_mask
 
 
@@ -322,19 +322,24 @@ class URAlgorithm(Algorithm):
         names = list(pd.events.keys())
         primary_name = names[0]
         pu, pi = pd.events[primary_name]
-        indicators = {}
-        for name in names:
-            su, si = pd.events[name]
-            if len(su) == 0:
-                continue
-            indicators[name] = cco_indicators(
-                pu, pi, su, si,
-                n_users=len(pd.users), n_items=len(pd.items),
-                max_correlators=p.max_correlators_per_item,
-                llr_threshold=p.llr_threshold,
-                u_chunk=p.user_chunk,
-                mesh=ctx.get_mesh() if ctx else None,
-            )
+        # One fused device program for every event-type pair: the
+        # primary's dedupe/partition/upload/membership slabs are shared
+        # across pairs and the self-pair rides the primary slabs
+        # outright (ops.llr.cco_indicators_multi; falls back to per-pair
+        # calls on multi-chip meshes or when the fused accumulators
+        # exceed the HBM budget — bit-identical either way).
+        secondaries = {
+            name: pd.events[name]
+            for name in names if len(pd.events[name][0])
+        }
+        indicators = cco_indicators_multi(
+            pu, pi, secondaries,
+            n_users=len(pd.users), n_items=len(pd.items),
+            max_correlators=p.max_correlators_per_item,
+            llr_threshold=p.llr_threshold,
+            u_chunk=p.user_chunk,
+            mesh=ctx.get_mesh() if ctx else None,
+        )
         # Popularity backfill ranking: raw primary-event count per item
         # (reference UR's default "popular" popModel).
         popularity = np.bincount(

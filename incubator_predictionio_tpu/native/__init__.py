@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 14
+_EXPECTED_VERSION = 16
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -110,6 +110,21 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
     lib.pio_ingest_free.restype = None
     lib.pio_ingest_free.argtypes = [ctypes.c_void_p]
+    lib.pio_cco_partition.restype = ctypes.c_void_p
+    lib.pio_cco_partition.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int64,
+    ]
+    lib.pio_ccop_dim.restype = ctypes.c_int64
+    lib.pio_ccop_dim.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.pio_ccop_slab.restype = ctypes.POINTER(ctypes.c_uint16)
+    lib.pio_ccop_slab.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.pio_ccop_item_counts.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.pio_ccop_item_counts.argtypes = [ctypes.c_void_p]
+    lib.pio_ccop_free.restype = None
+    lib.pio_ccop_free.argtypes = [ctypes.c_void_p]
     lib.pio_fill_entries.restype = ctypes.c_int32
     lib.pio_fill_entries.argtypes = [
         ctypes.POINTER(ctypes.c_int64),   # row
@@ -678,3 +693,50 @@ def ingest_batch(raw: bytes, max_items: int, creation_iso: str):
         return ids, lines
     finally:
         lib.pio_ingest_free(h)
+
+
+def cco_partition(u: np.ndarray, i: np.ndarray, rank, n_users: int,
+                  u_chunk: int, n_ranges: int, n_items: int,
+                  h_chunk: int, h_ranges: int):
+    """One-pass C partition of deduped user-sorted (u, i) pairs into the
+    CCO slab layout (ops/llr.py): ((light_eu, light_ei), (heavy_eu,
+    heavy_ei) or None, item_counts). The numpy version's fancy-index
+    scatter + bincounts measured ~1.0 s at 10M pairs on the 1-core
+    host; this is ~10x. Requires the uint16 wire (u_chunk < 0xFFFF,
+    n_items <= 0xFFFF); raises NativeUnavailable otherwise or when the
+    codec cannot load — callers fall back to numpy (identical layout,
+    tested)."""
+    if u_chunk >= 0xFFFF or n_items > 0xFFFF or h_chunk >= 0xFFFF:
+        raise NativeUnavailable("cco_partition: ids exceed the uint16 wire")
+    lib = _load()
+    u = np.ascontiguousarray(u, np.int32)
+    i = np.ascontiguousarray(i, np.int32)
+    rank_ptr = None
+    if rank is not None:
+        rank = np.ascontiguousarray(rank, np.int32)
+        rank_ptr = rank.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    h = lib.pio_cco_partition(
+        u.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        i.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        u.size, rank_ptr, n_users, u_chunk, n_ranges, n_items,
+        h_chunk, h_ranges if rank is not None else 0)
+    if not h:
+        raise NativeUnavailable("cco_partition failed")
+    try:
+        le = lib.pio_ccop_dim(h, 0)
+        light = tuple(
+            np.ctypeslib.as_array(lib.pio_ccop_slab(h, w),
+                                  shape=(n_ranges, le)).copy()
+            for w in (0, 1))
+        heavy = None
+        if rank is not None:
+            he = lib.pio_ccop_dim(h, 1)
+            heavy = tuple(
+                np.ctypeslib.as_array(lib.pio_ccop_slab(h, w),
+                                      shape=(h_ranges, he)).copy()
+                for w in (2, 3))
+        counts = np.ctypeslib.as_array(
+            lib.pio_ccop_item_counts(h), shape=(n_items,)).copy()
+        return light, heavy, counts
+    finally:
+        lib.pio_ccop_free(h)

@@ -15,15 +15,16 @@ see models/universal_recommender.py).
 Scale notes: events are pre-partitioned by user range on the host (sorted
 slabs, like ops/blocked.py), so each scan step scatters only its own
 events — the naive alternative of range-masking the whole event array per
-step is quadratic and ~40x slower on TPU at 1M events. Slabs are bf16
-(binary, so exact) for the MXU matmul with f32 accumulation. Two
+step is quadratic and ~40x slower on TPU at 1M events. Slabs are int8
+(binary, so exact) for the MXU's double-rate int8 mode with exact int32
+accumulation (f32 only from the LLR stage on). Two
 accumulation strategies, chosen by HBM budget: when the full [I, I]
 f32 matrix fits a fraction of device memory, one scan over user ranges
 builds each membership slab ONCE and accumulates the whole matrix
 (then LLR + top-k per stripe slice — all one dispatch); bigger
 catalogs stream [item_block, I] stripes through a bounded accumulator
 (slabs rebuilt per stripe — the memory/compute trade). Both paths are
-bit-identical (counts are exact small integers in f32; tested). Either
+bit-identical (counts are exact integers; tested). Either
 way only the [I, K] indicators materialize on the host.
 """
 
@@ -71,7 +72,8 @@ def llr_scores(k11, k12, k21, k22):
 
 
 def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
-                       n_ranges: int, n_items: int):
+                       n_ranges: int, n_items: int,
+                       assume_sorted: bool = False):
     """Host prep: sort (user, item) pairs by user range and lay them out
     as [n_ranges, E] slabs, so the device scan step for slab row r
     touches only events of one user range. A range's primary and
@@ -93,8 +95,13 @@ def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
     # ignored them too, and a bad id must not corrupt the layout).
     valid = (u >= 0) & (u < n_ranges * u_chunk)
     u, i = u[valid], i[valid]
-    order = np.argsort(u, kind="stable")
-    us, is_ = u[order], i[order]
+    if assume_sorted:
+        # dedupe already emits (user, item)-sorted pairs; re-argsorting
+        # 8M rows cost ~0.3 s of pure host time per event set
+        us, is_ = u, i
+    else:
+        order = np.argsort(u, kind="stable")
+        us, is_ = u[order], i[order]
     chunk_of = (us // u_chunk).astype(np.int64)
     counts = np.bincount(chunk_of, minlength=n_ranges)
     e = max(int(counts.max()), 1) if counts.size else 1
@@ -112,17 +119,28 @@ def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
 
 
 def _slab(uu, ii, u_chunk: int, n_items: int):
-    """One range's binary membership slab [u_chunk, n_items] bf16 from
+    """One range's binary int8 membership slab [u_chunk, n_items] from
     (local user offset, item) event pairs; the sentinel offset u_chunk
-    lands padding on a scratch row that is sliced away. bf16 is exact
-    for 0/1, so the downstream matmuls run at full MXU rate with f32
-    accumulation."""
-    rows = uu.astype(jnp.int32)          # sentinel row = scratch
-    ok = rows < u_chunk
-    a = jnp.zeros((u_chunk + 1, n_items), jnp.bfloat16)
-    a = a.at[rows, ii.astype(jnp.int32)].max(
-        jnp.where(ok, 1.0, 0.0).astype(jnp.bfloat16))
-    return a[:u_chunk]
+    lands padding on a scratch row that is sliced away.
+
+    int8, not bf16: binary membership is exact in any dtype, and the
+    v5e MXU runs int8 contractions at ~2x its bf16 rate (197 TOPS vs
+    98 TFLOPs — measured 2.8x on the UR shapes). Counts accumulate in
+    int32 (≤ n_users, exact) and widen to f32 only at the LLR stage.
+
+    Built as a FLAT 1-D scatter-add then reshaped: the 2-D scatter-max
+    lowered to TPU's serialized scatter path (~457 ns/element — measured
+    3.6 s just building slabs for the UR bench), while the 1-D add runs
+    ~28x faster. Events are deduped upstream, so each (u, i) lands
+    exactly once and add ≡ max ≡ set (bit-identical counts)."""
+    # int64 flat indices when the slab exceeds int32 addressing (the
+    # striped path serves multi-million-item catalogs)
+    idx_dtype = (jnp.int32 if (u_chunk + 1) * n_items < 2**31
+                 else jnp.int64)
+    flat = uu.astype(idx_dtype) * n_items + ii.astype(idx_dtype)
+    a = jnp.zeros(((u_chunk + 1) * n_items,), jnp.int8)
+    a = a.at[flat].add(jnp.int8(1))
+    return a.reshape(u_chunk + 1, n_items)[:u_chunk]
 
 
 @functools.partial(jax.jit, static_argnames=("n_items", "u_chunk", "block"))
@@ -145,10 +163,10 @@ def _cooccurrence_stripe(peu, pei, seu, sei, lo_item,
             (u_chunk, block))
         asec = _slab(eu_s, ei_s, u_chunk, n_items)
         c = c + jnp.einsum("ui,uj->ij", ap, asec,
-                           preferred_element_type=jnp.float32)
+                           preferred_element_type=jnp.int32)
         return c, None
 
-    c0 = jnp.zeros((block, n_items), jnp.float32)
+    c0 = jnp.zeros((block, n_items), jnp.int32)
     c, _ = jax.lax.scan(body, c0, (peu, pei, seu, sei))
     return c
 
@@ -162,7 +180,7 @@ def _full_cooccurrence(light, heavy, n_items: int, u_chunk: int,
     ~60% of UR's device time). Costs n_items^2 * 4 bytes of HBM for
     the accumulator, so ``cco_indicators`` only routes here when that
     fits (PIO_UR_FULL_MATRIX_ELEMS caps it; the striped path remains
-    for big catalogs). Counts are exact small integers in f32, so both
+    for big catalogs). Counts are exact integers in int32, so both
     paths produce IDENTICAL results (tested)."""
 
     def mk_body(chunk_rows: int):
@@ -171,11 +189,11 @@ def _full_cooccurrence(light, heavy, n_items: int, u_chunk: int,
             ap = _slab(eu_p, ei_p, chunk_rows, n_items)
             asec = _slab(eu_s, ei_s, chunk_rows, n_items)
             c = c + jnp.einsum("ui,uj->ij", ap, asec,
-                               preferred_element_type=jnp.float32)
+                               preferred_element_type=jnp.int32)
             return c, None
         return body
 
-    c0 = jnp.zeros((n_items, n_items), jnp.float32)
+    c0 = jnp.zeros((n_items, n_items), jnp.int32)
     c, _ = jax.lax.scan(mk_body(u_chunk), c0, light)
     if heavy is not None:
         c, _ = jax.lax.scan(mk_body(h_chunk), c, heavy)
@@ -224,10 +242,10 @@ def _full_cco_topk_sharded(light, heavy, lo_effs, n_i, n_j, n_total, *,
                 asec = _slab(eu_s, ei_s, chunk_rows, n_items)
                 return c + jnp.einsum(
                     "ui,uj->ij", ap, asec,
-                    preferred_element_type=jnp.float32), None
+                    preferred_element_type=jnp.int32), None
             return body
 
-        c0 = jnp.zeros((n_items, n_items), jnp.float32)
+        c0 = jnp.zeros((n_items, n_items), jnp.int32)
         # shard_map's varying-manual-axes typing: the carry starts as a
         # replicated constant but the body output varies over the data
         # axis — mark it varying up front
@@ -254,6 +272,69 @@ def _full_cco_topk_sharded(light, heavy, lo_effs, n_i, n_j, n_total, *,
 
     _, (ss, ixs) = jax.lax.scan(body, 0, lo_effs)
     return ss, ixs
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_items", "u_chunk", "h_chunk", "block", "k", "llr_threshold",
+    "self_flags"))
+def _full_cco_topk_multi(light_p, light_secs, heavy_p, heavy_secs, lo_effs,
+                         n_i, n_js, n_total, *, n_items: int, u_chunk: int,
+                         h_chunk: int, block: int, k: int,
+                         llr_threshold: float, self_flags: tuple):
+    """ALL of one primary's cross-occurrence pairs in ONE dispatch: the
+    user-range scan builds each range's PRIMARY membership slab once and
+    accumulates every secondary's [I, I] matrix against it (self-pairs
+    reuse the primary slab outright — no second scatter, no second
+    upload). The per-pair path scatters the primary slab S times and
+    uploads the primary events S times; for the UR bench (buy→buy +
+    buy→view) the fusion removes a third of the event-slab upload bytes
+    and half the primary scatters. Counts stay exact small integers in
+    f32 → bit-identical to per-pair calls (tested).
+
+    light_secs/heavy_secs: (eu, ei) pairs for NON-self secondaries, in
+    output order; self_flags marks which outputs take the primary slab.
+    n_js: [S, I] per-secondary distinct-user item counts."""
+
+    def mk_body(chunk_rows: int):
+        def body(cs, chunk):
+            ap = _slab(chunk[0], chunk[1], chunk_rows, n_items)
+            outs, r = [], 2
+            for is_self in self_flags:
+                if is_self:
+                    a2 = ap
+                else:
+                    a2 = _slab(chunk[r], chunk[r + 1], chunk_rows, n_items)
+                    r += 2
+                outs.append(cs[len(outs)] + jnp.einsum(
+                    "ui,uj->ij", ap, a2,
+                    preferred_element_type=jnp.int32))
+            return tuple(outs), None
+        return body
+
+    n_sec = len(self_flags)
+    c0 = tuple(jnp.zeros((n_items, n_items), jnp.int32)
+               for _ in range(n_sec))
+    xs = tuple(light_p) + tuple(x for pair in light_secs for x in pair)
+    cs, _ = jax.lax.scan(mk_body(u_chunk), c0, xs)
+    if heavy_p is not None:
+        xs_h = tuple(heavy_p) + tuple(x for pair in heavy_secs for x in pair)
+        cs, _ = jax.lax.scan(mk_body(h_chunk), cs, xs_h)
+
+    outs = []
+    for s_idx in range(n_sec):
+        c = cs[s_idx]
+        n_j = n_js[s_idx]
+
+        def body(carry, lo_eff, c=c, n_j=n_j):
+            counts = jax.lax.dynamic_slice(c, (lo_eff, 0), (block, n_items))
+            n_i_stripe = jax.lax.dynamic_slice(n_i, (lo_eff,), (block,))
+            s, ix = _stripe_topk(counts, n_i_stripe, n_j, lo_eff, n_total,
+                                 k=k, llr_threshold=llr_threshold)
+            return carry, (s, ix)
+
+        _, (ss, ixs) = jax.lax.scan(body, 0, lo_effs)
+        outs.append((ss, ixs))
+    return tuple(outs)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -334,6 +415,9 @@ def _stripe_topk(counts, n_i_stripe, n_j, lo_item, n_total,
     did the primary event on item i, n_j likewise for the secondary
     event, N = total users."""
     block, n_items = counts.shape
+    # counts arrive as exact int32 from the int8 MXU accumulate; LLR
+    # math runs in f32 (counts <= n_users << 2^24, exact)
+    counts = counts.astype(jnp.float32)
     k11 = counts
     k12 = jnp.maximum(n_i_stripe[:, None] - counts, 0.0)
     k21 = jnp.maximum(n_j[None, :] - counts, 0.0)
@@ -402,11 +486,11 @@ def _all_stripes_sharded(lo_effs, light, heavy, n_i, n_j, n_total, *,
                     asec = _slab(eu_s, ei_s, chunk_rows, n_items)
                     return c + jnp.einsum(
                         "ui,uj->ij", ap, asec,
-                        preferred_element_type=jnp.float32), None
+                        preferred_element_type=jnp.int32), None
                 return body
 
             c0 = jax.lax.pcast(
-                jnp.zeros((block, n_items), jnp.float32), (_D,),
+                jnp.zeros((block, n_items), jnp.int32), (_D,),
                 to="varying")
             c, _ = jax.lax.scan(mk_body(u_chunk), c0, light_l)
             if heavy_l is not None:
@@ -453,21 +537,10 @@ def cco_indicators(
     scans + one exact psum over ICI) — bit-identical results, linear
     range-scan scaling."""
 
-    def dedupe(u, i):
-        # Packed-key unique: ~30x faster than np.unique(axis=0) (which
-        # lexsorts void-dtype rows) at 1M-event scale. Out-of-range user
-        # AND item ids are dropped BEFORE packing (a bad id would alias
-        # into a different pair or break the bincounts downstream).
-        u = np.asarray(u, np.int64)
-        i = np.asarray(i, np.int64)
-        valid = (i >= 0) & (i < n_items) & (u >= 0) & (u < n_users)
-        u, i = u[valid], i[valid]
-        key = np.unique(u * n_items + i)
-        return ((key // n_items).astype(np.int32),
-                (key % n_items).astype(np.int32))
-
-    pu, pi = dedupe(primary_u, primary_i)
-    su, si = dedupe(secondary_u, secondary_i)
+    # Packed-key dedupe (native radix sort when available); output is
+    # (user, item)-sorted, which every partition below relies on.
+    pu, pi = _dedupe_pair(primary_u, primary_i, n_users, n_items)
+    su, si = _dedupe_pair(secondary_u, secondary_i, n_users, n_items)
     n_ranges = max((n_users + u_chunk - 1) // u_chunk, 1)
 
     # Heavy-user extraction: a user with far more interactions than the
@@ -502,14 +575,14 @@ def cco_indicators(
         h_ranges = max((n_heavy + _HEAVY_RANGE - 1) // _HEAVY_RANGE, 1)
         h_per = _HEAVY_RANGE
         hpeu, hpei = _partition_by_user(hp_u, hp_i, h_per, h_ranges,
-                                        n_items)
+                                        n_items, assume_sorted=True)
         hseu, hsei = _partition_by_user(hs_u, hs_i, h_per, h_ranges,
-                                        n_items)
+                                        n_items, assume_sorted=True)
     else:
         pu_l, pi_l, su_l, si_l = pu, pi, su, si
 
-    peu, pei = _partition_by_user(pu_l, pi_l, u_chunk, n_ranges, n_items)
-    seu, sei = _partition_by_user(su_l, si_l, u_chunk, n_ranges, n_items)
+    peu, pei = _partition_by_user(pu_l, pi_l, u_chunk, n_ranges, n_items, assume_sorted=True)
+    seu, sei = _partition_by_user(su_l, si_l, u_chunk, n_ranges, n_items, assume_sorted=True)
 
     n_i = np.bincount(pi, minlength=n_items).astype(np.float32)
     n_j = jnp.asarray(np.bincount(si, minlength=n_items).astype(np.float32))
@@ -566,17 +639,173 @@ def cco_indicators(
                 llr_threshold=llr_threshold, h_chunk=_HEAVY_RANGE,
             ))
 
+    return _gather_indicators(ss, ixs, los, lo_effs_np, block, n_items)
+
+
+def _dedupe_pair(u, i, n_users: int, n_items: int):
+    """Distinct (user, item) pairs sorted by (user, item), out-of-range
+    ids dropped — packed-key np.unique (a 16-bit-radix C sort was tried
+    and LOST to numpy's introsort at 8M keys: 0.76 s vs 0.31 s; the
+    random-access digit buckets thrash this host's cache)."""
+    u = np.asarray(u, np.int64)
+    i = np.asarray(i, np.int64)
+    valid = (i >= 0) & (i < n_items) & (u >= 0) & (u < n_users)
+    u, i = u[valid], i[valid]
+    key = np.unique(u * n_items + i)
+    return ((key // n_items).astype(np.int32),
+            (key % n_items).astype(np.int32))
+
+
+def _gather_indicators(ss, ixs, los, lo_effs_np, block, n_items) -> Indicators:
+    """Stacked per-stripe device results → host [I, K] Indicators
+    (ragged last stripe sliced; zero-score slots → -1)."""
     idx_parts, score_parts = [], []
     for j, lo in enumerate(los):
         b = min(block, n_items - lo)
         skip = lo - int(lo_effs_np[j])
         score_parts.append(np.asarray(ss[j])[skip:skip + b])
         idx_parts.append(np.asarray(ixs[j])[skip:skip + b])
-
     score = np.concatenate(score_parts, axis=0)
     idx = np.concatenate(idx_parts, axis=0).astype(np.int32)
     idx[score <= 0] = -1
     return Indicators(idx=idx, score=score.astype(np.float32))
+
+
+def cco_indicators_multi(
+    primary_u: np.ndarray,
+    primary_i: np.ndarray,
+    secondaries: dict,
+    n_users: int,
+    n_items: int,
+    max_correlators: int = 50,
+    llr_threshold: float = 0.0,
+    u_chunk: int = 1024,
+    item_block: int = 4096,
+    mesh=None,
+) -> dict:
+    """All cross-occurrence indicator matrices of ONE primary event in a
+    single fused device program (reference: the UR trains Mahout
+    SimilarityAnalysis per event-type pair; here the pairs share the
+    primary's dedupe, host partition, upload, and per-range membership
+    slab — see _full_cco_topk_multi). ``secondaries`` maps name →
+    (u, i); passing the primary's OWN arrays (by identity) marks a
+    self-pair, which reuses the primary slabs end to end.
+
+    Falls back to per-pair ``cco_indicators`` calls when the fused
+    accumulators would not fit the HBM budget (each pair then gets the
+    full-vs-striped choice independently) or on a multi-device mesh
+    (the sharded kernels stay per-pair). Results are bit-identical to
+    per-pair calls either way (exact integer counts; tested)."""
+    names = list(secondaries.keys())
+    n_sec = len(names)
+    n_mesh_dev = int(mesh.devices.size) if mesh is not None else 1
+    # fused path budget: all S accumulators together may use HALF the
+    # device memory (the single-pair cap allows one accumulator a
+    # quarter — same headroom reasoning, S of them share it)
+    fused_fits = n_sec * n_items * n_items <= 2 * _full_matrix_elem_cap()
+    if n_sec == 0:
+        return {}
+    if n_mesh_dev > 1 or not fused_fits or n_sec == 1:
+        return {
+            name: cco_indicators(
+                primary_u, primary_i, su, si, n_users, n_items,
+                max_correlators=max_correlators,
+                llr_threshold=llr_threshold, u_chunk=u_chunk,
+                item_block=item_block, mesh=mesh)
+            for name, (su, si) in secondaries.items()
+        }
+
+    pu, pi = _dedupe_pair(primary_u, primary_i, n_users, n_items)
+    deduped = {}
+    for name, (su, si) in secondaries.items():
+        if su is primary_u and si is primary_i:
+            deduped[name] = None  # self-pair: reuse primary everywhere
+        else:
+            deduped[name] = _dedupe_pair(su, si, n_users, n_items)
+
+    # Heavy-user extraction over the COMBINED activity (primary + every
+    # distinct secondary): the threshold only shapes the layout, never
+    # the counts, so any consistent choice keeps results identical.
+    per_user = np.bincount(pu, minlength=n_users).astype(np.int64)
+    for pair in deduped.values():
+        if pair is not None:
+            per_user += np.bincount(pair[0], minlength=n_users)
+    mean_pu = max(float(per_user.sum()) / max(n_users, 1), 1.0)
+    heavy_cap = max(int(16 * mean_pu), 256)
+    heavy_users = np.nonzero(per_user > heavy_cap)[0]
+    n_heavy = int(len(heavy_users))
+    rank = None
+    if n_heavy:
+        rank = np.full(n_users, -1, np.int64)
+        rank[heavy_users] = np.arange(n_heavy)
+
+    def split_heavy(u, i):
+        if rank is None:
+            return u, i, None, None
+        hm = rank[u] >= 0
+        return (u[~hm], i[~hm],
+                rank[u[hm]].astype(np.int32), i[hm].astype(np.int32))
+
+    n_ranges = max((n_users + u_chunk - 1) // u_chunk, 1)
+    h_ranges = max((n_heavy + _HEAVY_RANGE - 1) // _HEAVY_RANGE, 1)
+
+    def partition_put(u, i):
+        """Partition (one-pass native C when available — the numpy
+        fancy-index layout measured ~1.0 s of pure host time at the UR
+        bench's 10M pairs) + START the async uploads immediately, so a
+        later secondary's host partition overlaps this one's transfer."""
+        try:
+            from ..native import cco_partition
+
+            light, heavy, counts = cco_partition(
+                u, i, rank, n_users, u_chunk, n_ranges, n_items,
+                _HEAVY_RANGE, h_ranges)
+        except Exception:  # noqa: BLE001 - native optional; layout identical
+            lu, li, hu, hi = split_heavy(u, i)
+            light = _partition_by_user(lu, li, u_chunk, n_ranges, n_items,
+                                       assume_sorted=True)
+            heavy = None
+            if n_heavy:
+                heavy = _partition_by_user(hu, hi, _HEAVY_RANGE, h_ranges,
+                                           n_items, assume_sorted=True)
+            counts = np.bincount(i, minlength=n_items)
+        light_dev = tuple(jax.device_put(x) for x in light)
+        heavy_dev = (tuple(jax.device_put(x) for x in heavy)
+                     if heavy is not None else None)
+        return light_dev, heavy_dev, counts.astype(np.float32)
+
+    p_light, p_heavy, n_i = partition_put(pu, pi)
+    self_flags = tuple(deduped[name] is None for name in names)
+    sec_light, sec_heavy, n_js = [], [], []
+    for name in names:
+        pair = deduped[name]
+        if pair is None:
+            n_js.append(n_i)
+            continue
+        su, si = pair
+        sl, sh, cnt = partition_put(su, si)
+        sec_light.append(sl)
+        if n_heavy:
+            sec_heavy.append(sh)
+        n_js.append(cnt)
+    k = min(max_correlators, n_items)
+    block = min(item_block, n_items)
+    los = list(range(0, n_items, block))
+    lo_effs_np = np.array([min(lo, n_items - block) for lo in los], np.int32)
+
+    outs = _full_cco_topk_multi(
+        p_light, tuple(sec_light),
+        p_heavy, tuple(sec_heavy) if n_heavy else (),
+        jnp.asarray(lo_effs_np), jnp.asarray(n_i),
+        jnp.asarray(np.stack(n_js)), jnp.float32(n_users),
+        n_items=n_items, u_chunk=u_chunk, h_chunk=_HEAVY_RANGE,
+        block=block, k=k, llr_threshold=llr_threshold,
+        self_flags=self_flags)
+    outs = jax.device_get(outs)
+    return {
+        name: _gather_indicators(ss, ixs, los, lo_effs_np, block, n_items)
+        for name, (ss, ixs) in zip(names, outs)
+    }
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

@@ -548,7 +548,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 14; }
+int32_t pio_codec_version() { return 16; }
 
 namespace {
 // FNV-1a over a byte range, continuing from a running state.
@@ -1324,5 +1324,117 @@ const char* pio_ingest_lines(void* h, int64_t* out_len) {
 }
 
 void pio_ingest_free(void* h) { delete static_cast<IngestOut*>(h); }
+
+}  // extern "C"
+
+
+// ===========================================================================
+// CCO host partition: deduped (user, item) pairs — already sorted by user
+// from the packed-key dedupe — laid out as [n_ranges, E] slabs of (local
+// offset, item) uint16, with heavy users routed to their own rank-range
+// slabs, plus the per-item distinct-user counts, all in two linear passes.
+// The numpy version (fancy-index scatter writes + bincounts) measured
+// ~1.0 s of the UR train's host time at 10M pairs; this runs ~10x faster.
+// ===========================================================================
+
+namespace {
+
+struct CcoPart {
+  std::vector<uint16_t> light_eu, light_ei;
+  std::vector<uint16_t> heavy_eu, heavy_ei;
+  std::vector<int64_t> item_counts;
+  int64_t light_e = 1, heavy_e = 1;
+  int64_t n_ranges = 0, h_ranges = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// u/ii: deduped pairs SORTED BY USER; rank: per-user heavy rank or NULL.
+// Requires u_chunk < 0xFFFF and n_items <= 0xFFFF (uint16 wire — the
+// caller falls back to the numpy path otherwise).
+void* pio_cco_partition(const int32_t* u, const int32_t* ii, int64_t n,
+                        const int32_t* rank, int64_t n_users,
+                        int32_t u_chunk, int64_t n_ranges, int64_t n_items,
+                        int32_t h_chunk, int64_t h_ranges) {
+  auto* out = new CcoPart();
+  out->n_ranges = n_ranges;
+  out->h_ranges = h_ranges;
+  out->item_counts.assign(static_cast<size_t>(n_items), 0);
+  std::vector<int64_t> lcount(static_cast<size_t>(n_ranges), 0);
+  std::vector<int64_t> hcount(static_cast<size_t>(h_ranges), 0);
+  const int64_t max_u = n_ranges * u_chunk;
+  // pass 1: per-range counts (+ per-item counts over ALL kept pairs)
+  for (int64_t j = 0; j < n; ++j) {
+    int32_t uu = u[j];
+    int32_t it = ii[j];
+    if (uu < 0 || it < 0 || it >= n_items) continue;
+    ++out->item_counts[it];
+    int32_t r;
+    if (rank && uu < n_users && (r = rank[uu]) >= 0) {
+      ++hcount[r / h_chunk];
+    } else if (uu < max_u) {
+      ++lcount[uu / u_chunk];
+    }
+  }
+  for (int64_t c : lcount) out->light_e = std::max(out->light_e, c);
+  for (int64_t c : hcount) out->heavy_e = std::max(out->heavy_e, c);
+  // pass 2: fill (sentinel offset = chunk width, item 0)
+  out->light_eu.assign(static_cast<size_t>(n_ranges * out->light_e),
+                       static_cast<uint16_t>(u_chunk));
+  out->light_ei.assign(static_cast<size_t>(n_ranges * out->light_e), 0);
+  if (h_ranges) {
+    out->heavy_eu.assign(static_cast<size_t>(h_ranges * out->heavy_e),
+                         static_cast<uint16_t>(h_chunk));
+    out->heavy_ei.assign(static_cast<size_t>(h_ranges * out->heavy_e), 0);
+  }
+  std::vector<int64_t> lpos(static_cast<size_t>(n_ranges), 0);
+  std::vector<int64_t> hpos(static_cast<size_t>(h_ranges), 0);
+  for (int64_t j = 0; j < n; ++j) {
+    int32_t uu = u[j];
+    int32_t it = ii[j];
+    if (uu < 0 || it < 0 || it >= n_items) continue;
+    int32_t r = -1;
+    if (rank && uu < n_users && (r = rank[uu]) >= 0) {
+      int64_t rg = r / h_chunk;
+      int64_t at = rg * out->heavy_e + hpos[rg]++;
+      out->heavy_eu[at] = static_cast<uint16_t>(r - rg * h_chunk);
+      out->heavy_ei[at] = static_cast<uint16_t>(it);
+    } else if (uu < max_u) {
+      int64_t rg = uu / u_chunk;
+      int64_t at = rg * out->light_e + lpos[rg]++;
+      out->light_eu[at] = static_cast<uint16_t>(uu - rg * u_chunk);
+      out->light_ei[at] = static_cast<uint16_t>(it);
+    }
+  }
+  return out;
+}
+
+int64_t pio_ccop_dim(void* h, int32_t which) {
+  auto* o = static_cast<CcoPart*>(h);
+  switch (which) {
+    case 0: return o->light_e;
+    case 1: return o->heavy_e;
+    default: return 0;
+  }
+}
+
+const uint16_t* pio_ccop_slab(void* h, int32_t which) {
+  auto* o = static_cast<CcoPart*>(h);
+  switch (which) {
+    case 0: return o->light_eu.data();
+    case 1: return o->light_ei.data();
+    case 2: return o->heavy_eu.data();
+    case 3: return o->heavy_ei.data();
+    default: return nullptr;
+  }
+}
+
+const int64_t* pio_ccop_item_counts(void* h) {
+  return static_cast<CcoPart*>(h)->item_counts.data();
+}
+
+void pio_ccop_free(void* h) { delete static_cast<CcoPart*>(h); }
 
 }  // extern "C"

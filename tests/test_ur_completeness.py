@@ -237,3 +237,48 @@ def test_sharded_cooccurrence_matches_single_device(monkeypatch):
                                      mesh=mesh, item_block=128)
     np.testing.assert_array_equal(single.idx, striped_sharded.idx)
     np.testing.assert_array_equal(single.score, striped_sharded.score)
+
+
+def test_cco_multi_matches_per_pair(monkeypatch):
+    """cco_indicators_multi (fused shared-primary program) must be
+    bit-identical to independent per-pair cco_indicators calls —
+    self-pair slab reuse, shared heavy extraction, and the fused scan
+    change layout only, never counts."""
+    import numpy as np
+
+    from incubator_predictionio_tpu.ops.llr import (
+        cco_indicators, cco_indicators_multi,
+    )
+
+    # isolate from an externally-set budget knob: the fused half must
+    # genuinely take the fused path
+    monkeypatch.delenv("PIO_UR_FULL_MATRIX_ELEMS", raising=False)
+    rng = np.random.default_rng(9)
+    n_users, n_items = 600, 150
+    pu = rng.integers(0, n_users, 5000).astype(np.int32)
+    pi = rng.integers(0, n_items, 5000).astype(np.int32)
+    vu = rng.integers(0, n_users, 12000).astype(np.int32)
+    vi = rng.integers(0, n_items, 12000).astype(np.int32)
+    # heavy users: one user with a huge history (forces the heavy path)
+    pu[:900] = 7
+    vu[:2000] = 7
+
+    multi = cco_indicators_multi(
+        pu, pi, {"buy": (pu, pi), "view": (vu, vi)},
+        n_users=n_users, n_items=n_items, max_correlators=8, u_chunk=64)
+    assert set(multi) == {"buy", "view"}
+    for name, (su, si) in {"buy": (pu, pi), "view": (vu, vi)}.items():
+        single = cco_indicators(pu, pi, su, si, n_users, n_items,
+                                max_correlators=8, u_chunk=64)
+        np.testing.assert_array_equal(multi[name].idx, single.idx, err_msg=name)
+        np.testing.assert_array_equal(multi[name].score, single.score,
+                                      err_msg=name)
+
+    # budget fallback (tiny cap → per-pair path) is also identical
+    monkeypatch.setenv("PIO_UR_FULL_MATRIX_ELEMS", "10")
+    fb = cco_indicators_multi(
+        pu, pi, {"buy": (pu, pi), "view": (vu, vi)},
+        n_users=n_users, n_items=n_items, max_correlators=8, u_chunk=64)
+    for name in multi:
+        np.testing.assert_array_equal(multi[name].idx, fb[name].idx)
+        np.testing.assert_array_equal(multi[name].score, fb[name].score)
