@@ -64,3 +64,65 @@ class BiMap:
 
     def inverse_array(self, values: Sequence[int]) -> list:
         return [self._inv[int(v)] for v in values]
+
+
+class IdentityBiMap(BiMap):
+    """``str(i) ↔ i`` over [0, n) WITHOUT materializing n entries.
+
+    ALX-scale catalogs (tens of millions of items served sharded —
+    ops/sharded_topk.py) only ever need the arithmetic mapping; a dict
+    BiMap at 36M items costs multiple GiB of host RAM and minutes of
+    construction for information that is pure ``int()``/``str()``."""
+
+    def __init__(self, n: int):
+        self._n = int(n)
+
+    def __call__(self, key: Hashable) -> int:
+        v = self.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+    def get(self, key: Hashable, default: Optional[int] = None) -> Optional[int]:
+        try:
+            v = int(str(key), 10)
+        except (TypeError, ValueError):
+            return default
+        # reject non-canonical spellings ("07", "+3", " 5"): a dict
+        # BiMap keyed by str(i) would miss them too
+        if 0 <= v < self._n and str(key) == str(v):
+            return v
+        return default
+
+    def inverse(self, value: int) -> str:
+        v = int(value)
+        if not 0 <= v < self._n:
+            raise KeyError(value)
+        return str(v)
+
+    def inverse_get(self, value: int, default=None):
+        try:
+            return self.inverse(value)
+        except (KeyError, TypeError, ValueError):
+            return default
+
+    def contains(self, key: Hashable) -> bool:
+        return self.get(key) is not None
+
+    __contains__ = contains
+
+    def __len__(self) -> int:
+        return self._n
+
+    def keys(self):
+        return (str(j) for j in range(self._n))
+
+    def to_dict(self) -> dict:
+        return {str(j): j for j in range(self._n)}
+
+    def map_array(self, keys: Sequence[Hashable]) -> np.ndarray:
+        return np.fromiter((self(k) for k in keys), dtype=np.int32,
+                           count=len(keys))
+
+    def inverse_array(self, values: Sequence[int]) -> list:
+        return [self.inverse(v) for v in values]

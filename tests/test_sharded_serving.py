@@ -263,3 +263,27 @@ def test_similar_product_template_sharded_matches_single(memory_storage):
               {"items": ["2", "9"], "num": 7},
               {"items": ["3"], "num": 5, "blackList": ["4", "5"]}):
         assert deps["always"].query(q) == deps["never"].query(q)
+
+
+def test_identity_bimap_semantics():
+    """IdentityBiMap (huge-catalog serving) must behave exactly like a
+    materialized str(i)->i BiMap on every surface models touch."""
+    from incubator_predictionio_tpu.data.storage.bimap import (
+        BiMap, IdentityBiMap,
+    )
+
+    real = BiMap({str(j): j for j in range(10)})
+    lazy = IdentityBiMap(10)
+    assert len(lazy) == len(real)
+    for k in ("0", "7", "9", "10", "-1", "07", "+3", " 5", "x", None):
+        assert lazy.get(k) == real.get(k), k
+        assert (k in lazy) == (k in real), k
+    for v in range(10):
+        assert lazy.inverse(v) == real.inverse(v)
+    assert lazy.inverse_get(10) is None
+    assert list(lazy.keys()) == list(real.keys())
+    assert lazy.to_dict() == real.to_dict()
+    np = __import__("numpy")
+    assert np.array_equal(lazy.map_array(["3", "1"]),
+                          real.map_array(["3", "1"]))
+    assert lazy.inverse_array([2, 5]) == real.inverse_array([2, 5])
