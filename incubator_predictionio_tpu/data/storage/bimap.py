@@ -58,6 +58,22 @@ class BiMap:
     def to_dict(self) -> dict:
         return dict(self._fwd)
 
+    # -- persistence (identity-aware) -------------------------------------
+    def to_persisted(self):
+        """Model-blob form. IdentityBiMap overrides with a compact
+        marker so persisting a 36M-item identity mapping doesn't
+        materialize 36M dict entries."""
+        return self.to_dict()
+
+    @staticmethod
+    def from_persisted(obj) -> "BiMap":
+        """Inverse of to_persisted: detects the identity marker."""
+        if isinstance(obj, Mapping) and "__identity_n__" in obj and len(obj) == 1:
+            return IdentityBiMap(obj["__identity_n__"])
+        if isinstance(obj, BiMap):
+            return obj
+        return BiMap(obj)
+
     def map_array(self, keys: Sequence[Hashable]) -> np.ndarray:
         """Vectorized lookup → int32 numpy array (device-ready)."""
         return np.fromiter((self._fwd[k] for k in keys), dtype=np.int32, count=len(keys))
@@ -84,13 +100,17 @@ class IdentityBiMap(BiMap):
         return v
 
     def get(self, key: Hashable, default: Optional[int] = None) -> Optional[int]:
-        try:
-            v = int(str(key), 10)
-        except (TypeError, ValueError):
+        # STRICT str keys: a dict BiMap keyed by str(i) rejects the int 4
+        # even though str(4) would canonicalize — query JSON sends both,
+        # and the two BiMap kinds must answer identically
+        if not isinstance(key, str):
             return default
-        # reject non-canonical spellings ("07", "+3", " 5"): a dict
-        # BiMap keyed by str(i) would miss them too
-        if 0 <= v < self._n and str(key) == str(v):
+        try:
+            v = int(key, 10)
+        except ValueError:
+            return default
+        # reject non-canonical spellings ("07", "+3", " 5") likewise
+        if 0 <= v < self._n and key == str(v):
             return v
         return default
 
@@ -115,10 +135,13 @@ class IdentityBiMap(BiMap):
         return self._n
 
     def keys(self):
-        return (str(j) for j in range(self._n))
+        return _IdentityKeys(self._n)
 
     def to_dict(self) -> dict:
         return {str(j): j for j in range(self._n)}
+
+    def to_persisted(self):
+        return {"__identity_n__": self._n}
 
     def map_array(self, keys: Sequence[Hashable]) -> np.ndarray:
         return np.fromiter((self(k) for k in keys), dtype=np.int32,
@@ -126,3 +149,20 @@ class IdentityBiMap(BiMap):
 
     def inverse_array(self, values: Sequence[int]) -> list:
         return [self.inverse(v) for v in values]
+
+
+class _IdentityKeys:
+    """Reusable view over str(0..n) — matches dict_keys' re-iterability
+    and len() (a one-shot generator would silently diverge)."""
+
+    def __init__(self, n: int):
+        self._n = n
+
+    def __iter__(self):
+        return (str(j) for j in range(self._n))
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __contains__(self, key) -> bool:
+        return IdentityBiMap(self._n).get(key) is not None

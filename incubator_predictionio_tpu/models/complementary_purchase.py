@@ -167,7 +167,7 @@ class ComplementaryAlgorithm(Algorithm):
         return {
             "idx": model.indicators.idx,
             "score": model.indicators.score,
-            "items": model.items.to_dict(),
+            "items": model.items.to_persisted(),
         }
 
     def restore_model(self, stored, ctx) -> ComplementaryModel:

@@ -206,8 +206,8 @@ class ECommerceAlgorithm(Algorithm):
         return {
             "user_factors": np.asarray(model.factors.user_factors),
             "item_factors": np.asarray(model.factors.item_factors),
-            "users": model.users.to_dict(),
-            "items": model.items.to_dict(),
+            "users": model.users.to_persisted(),
+            "items": model.items.to_persisted(),
             "item_categories": {k: sorted(v) for k, v in model.item_categories.items()},
             "app_name": model.app_name,
             "seen_event_names": list(model.seen_event_names),
@@ -225,8 +225,8 @@ class ECommerceAlgorithm(Algorithm):
         uf, itf = stored["user_factors"], stored["item_factors"]
         model = ECommerceModel(
             factors=ALSFactors(uf, itf, uf.shape[0], itf.shape[0]),
-            users=BiMap(stored["users"]),
-            items=BiMap(stored["items"]),
+            users=BiMap.from_persisted(stored["users"]),
+            items=BiMap.from_persisted(stored["items"]),
             item_categories={k: set(v) for k, v in stored["item_categories"].items()},
             app_name=stored["app_name"],
             seen_event_names=tuple(stored["seen_event_names"]),

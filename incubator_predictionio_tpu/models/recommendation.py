@@ -345,8 +345,8 @@ class ALSAlgorithm(Algorithm):
         return {
             "user_factors": np.asarray(model.factors.user_factors),
             "item_factors": np.asarray(model.factors.item_factors),
-            "users": model.users.to_dict(),
-            "items": model.items.to_dict(),
+            "users": model.users.to_persisted(),
+            "items": model.items.to_persisted(),
         }
 
     def restore_model(self, stored, ctx) -> ALSModel:
@@ -361,8 +361,8 @@ class ALSAlgorithm(Algorithm):
         itf = stored["item_factors"]
         model = ALSModel(
             factors=ALSFactors(uf, itf, uf.shape[0], itf.shape[0]),
-            users=BiMap(stored["users"]),
-            items=BiMap(stored["items"]),
+            users=BiMap.from_persisted(stored["users"]),
+            items=BiMap.from_persisted(stored["items"]),
         )
         model.serving_mesh = serving_mesh_for(
             ctx, itf.shape[0], itf.shape[1], self.params.sharded_serving)

@@ -202,7 +202,7 @@ class SimilarProductAlgorithm(Algorithm):
         return {
             "user_factors": np.asarray(model.factors.user_factors),
             "item_factors": np.asarray(model.factors.item_factors),
-            "items": model.items.to_dict(),
+            "items": model.items.to_persisted(),
             "item_categories": {k: sorted(v) for k, v in model.item_categories.items()},
         }
 
@@ -217,7 +217,7 @@ class SimilarProductAlgorithm(Algorithm):
         uf, itf = stored["user_factors"], stored["item_factors"]
         model = SimilarProductModel(
             factors=ALSFactors(uf, itf, uf.shape[0], itf.shape[0]),
-            items=BiMap(stored["items"]),
+            items=BiMap.from_persisted(stored["items"]),
             item_categories={k: set(v) for k, v in stored["item_categories"].items()},
         )
         model.serving_mesh = serving_mesh_for(

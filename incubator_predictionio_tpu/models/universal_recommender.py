@@ -381,8 +381,8 @@ class URAlgorithm(Algorithm):
                 n: {"idx": ind.idx, "score": ind.score}
                 for n, ind in model.indicators.items()
             },
-            "users": model.users.to_dict(),
-            "items": model.items.to_dict(),
+            "users": model.users.to_persisted(),
+            "items": model.items.to_persisted(),
             "item_categories": {k: sorted(v) for k, v in model.item_categories.items()},
             "app_name": model.app_name,
             "event_names": list(model.event_names),
@@ -400,8 +400,8 @@ class URAlgorithm(Algorithm):
                 n: Indicators(idx=v["idx"], score=v["score"])
                 for n, v in stored["indicators"].items()
             },
-            users=BiMap(stored["users"]),
-            items=BiMap(stored["items"]),
+            users=BiMap.from_persisted(stored["users"]),
+            items=BiMap.from_persisted(stored["items"]),
             item_categories={k: set(v) for k, v in stored["item_categories"].items()},
             app_name=stored["app_name"],
             event_names=tuple(stored["event_names"]),

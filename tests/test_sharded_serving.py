@@ -287,3 +287,47 @@ def test_identity_bimap_semantics():
     assert np.array_equal(lazy.map_array(["3", "1"]),
                           real.map_array(["3", "1"]))
     assert lazy.inverse_array([2, 5]) == real.inverse_array([2, 5])
+
+
+def test_identity_bimap_persistence_round_trip(memory_storage):
+    """An IdentityBiMap-backed model persists as a compact marker and
+    restores as IdentityBiMap — never materializing the huge dict."""
+    from incubator_predictionio_tpu.controller.base import doer
+    from incubator_predictionio_tpu.data.storage.bimap import (
+        BiMap, IdentityBiMap,
+    )
+    from incubator_predictionio_tpu.models.recommendation import (
+        ALSAlgorithm, ALSModel,
+    )
+    from incubator_predictionio_tpu.ops.als import ALSFactors
+
+    rng = np.random.default_rng(0)
+    model = ALSModel(
+        factors=ALSFactors(rng.random((4, 3)).astype(np.float32),
+                           rng.random((6, 3)).astype(np.float32), 4, 6),
+        users=BiMap({str(j): j for j in range(4)}),
+        items=IdentityBiMap(6),
+    )
+    algo = doer(ALSAlgorithm, {})
+    stored = algo.prepare_model_for_persistence(model)
+    assert stored["items"] == {"__identity_n__": 6}  # compact, not 6 entries
+    restored = algo.restore_model(stored, None)
+    assert isinstance(restored.items, IdentityBiMap)
+    assert restored.items.inverse(5) == "5"
+    assert isinstance(restored.users, BiMap)
+    assert restored.users("2") == 2
+
+
+def test_identity_bimap_rejects_non_str_keys_like_dict_bimap():
+    from incubator_predictionio_tpu.data.storage.bimap import (
+        BiMap, IdentityBiMap,
+    )
+
+    real = BiMap({str(j): j for j in range(10)})
+    lazy = IdentityBiMap(10)
+    for k in (4, np.int32(4), 4.0, True):
+        assert lazy.get(k) == real.get(k) is None, k
+    ks = lazy.keys()
+    assert len(ks) == 10
+    assert list(ks) == list(ks)  # re-iterable, unlike a generator
+    assert "7" in ks and "10" not in ks
