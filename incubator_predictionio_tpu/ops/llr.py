@@ -338,6 +338,85 @@ def _full_cco_topk_multi(light_p, light_secs, heavy_p, heavy_secs, lo_effs,
 
 
 @functools.partial(jax.jit, static_argnames=(
+    "mesh", "n_items", "u_chunk", "h_chunk", "block", "k",
+    "llr_threshold", "self_flags"))
+def _full_cco_topk_multi_sharded(light_p, light_secs, heavy_p, heavy_secs,
+                                 lo_effs, n_i, n_js, n_total, *, mesh,
+                                 n_items: int, u_chunk: int, h_chunk: int,
+                                 block: int, k: int, llr_threshold: float,
+                                 self_flags: tuple):
+    """Multi-chip variant of _full_cco_topk_multi: user ranges shard
+    over DATA_AXIS, every device scans only its local ranges building
+    the primary slab once per range for ALL pairs, and the per-device
+    partial count matrices psum over ICI (exact int32 → bit-identical
+    to per-pair and to single-device; tested on the virtual mesh).
+    heavy_p/heavy_secs use () for absent (static pytree shape)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as _P
+    from ..parallel.mesh import DATA_AXIS as _D
+
+    n_sec = len(self_flags)
+
+    def counts_fn(lp, lsecs, hp, hsecs):
+        def mk_body(chunk_rows: int):
+            def body(cs, chunk):
+                ap = _slab(chunk[0], chunk[1], chunk_rows, n_items)
+                outs, r = [], 2
+                for is_self in self_flags:
+                    if is_self:
+                        a2 = ap
+                    else:
+                        a2 = _slab(chunk[r], chunk[r + 1], chunk_rows,
+                                   n_items)
+                        r += 2
+                    outs.append(cs[len(outs)] + jnp.einsum(
+                        "ui,uj->ij", ap, a2,
+                        preferred_element_type=jnp.int32))
+                return tuple(outs), None
+            return body
+
+        c0 = tuple(
+            jax.lax.pcast(jnp.zeros((n_items, n_items), jnp.int32),
+                          (_D,), to="varying")
+            for _ in range(n_sec))
+        xs = tuple(lp) + tuple(x for pair in lsecs for x in pair)
+        cs, _ = jax.lax.scan(mk_body(u_chunk), c0, xs)
+        if len(hp):
+            xs_h = tuple(hp) + tuple(x for pair in hsecs for x in pair)
+            cs, _ = jax.lax.scan(mk_body(h_chunk), cs, xs_h)
+        return tuple(jax.lax.psum(c, _D) for c in cs)
+
+    rows = _P(_D, None)
+
+    def specs_like(tree):
+        return jax.tree.map(lambda _: rows, tree,
+                            is_leaf=lambda x: x is None)
+
+    cs = shard_map(
+        counts_fn, mesh=mesh,
+        in_specs=(specs_like(light_p), specs_like(light_secs),
+                  specs_like(heavy_p), specs_like(heavy_secs)),
+        out_specs=tuple(_P() for _ in range(n_sec)),
+    )(light_p, light_secs, heavy_p, heavy_secs)
+
+    outs = []
+    for s_idx in range(n_sec):
+        c = cs[s_idx]
+        n_j = n_js[s_idx]
+
+        def body(carry, lo_eff, c=c, n_j=n_j):
+            counts = jax.lax.dynamic_slice(c, (lo_eff, 0), (block, n_items))
+            n_i_stripe = jax.lax.dynamic_slice(n_i, (lo_eff,), (block,))
+            s, ix = _stripe_topk(counts, n_i_stripe, n_j, lo_eff, n_total,
+                                 k=k, llr_threshold=llr_threshold)
+            return carry, (s, ix)
+
+        _, (ss, ixs) = jax.lax.scan(body, 0, lo_effs)
+        outs.append((ss, ixs))
+    return tuple(outs)
+
+
+@functools.partial(jax.jit, static_argnames=(
     "n_items", "u_chunk", "h_chunk", "block", "k", "llr_threshold"))
 def _full_cco_topk(light, heavy, lo_effs, n_i, n_j, n_total,
                    n_items: int, u_chunk: int, h_chunk: int,
@@ -691,10 +770,11 @@ def cco_indicators_multi(
     (u, i); passing the primary's OWN arrays (by identity) marks a
     self-pair, which reuses the primary slabs end to end.
 
+    On a multi-device mesh the same fusion shards user ranges over
+    DATA_AXIS with psum'd partial counts (_full_cco_topk_multi_sharded).
     Falls back to per-pair ``cco_indicators`` calls when the fused
     accumulators would not fit the HBM budget (each pair then gets the
-    full-vs-striped choice independently) or on a multi-device mesh
-    (the sharded kernels stay per-pair). Results are bit-identical to
+    full-vs-striped choice independently). Results are bit-identical to
     per-pair calls either way (exact integer counts; tested)."""
     names = list(secondaries.keys())
     n_sec = len(names)
@@ -705,7 +785,7 @@ def cco_indicators_multi(
     fused_fits = n_sec * n_items * n_items <= 2 * _full_matrix_elem_cap()
     if n_sec == 0:
         return {}
-    if n_mesh_dev > 1 or not fused_fits or n_sec == 1:
+    if not fused_fits or n_sec == 1:
         return {
             name: cco_indicators(
                 primary_u, primary_i, su, si, n_users, n_items,
@@ -769,6 +849,14 @@ def cco_indicators_multi(
                 heavy = _partition_by_user(hu, hi, _HEAVY_RANGE, h_ranges,
                                            n_items, assume_sorted=True)
             counts = np.bincount(i, minlength=n_items)
+        if n_mesh_dev > 1:
+            # multi-chip: pad the range axis to a device multiple and
+            # hand the jit the HOST arrays — it uploads them sharded
+            # (an eager put would land everything on one device first)
+            light = _pad_ranges(light, n_mesh_dev, u_chunk)
+            if heavy is not None:
+                heavy = _pad_ranges(heavy, n_mesh_dev, _HEAVY_RANGE)
+            return light, heavy, counts.astype(np.float32)
         light_dev = tuple(jax.device_put(x) for x in light)
         heavy_dev = (tuple(jax.device_put(x) for x in heavy)
                      if heavy is not None else None)
@@ -793,14 +881,25 @@ def cco_indicators_multi(
     los = list(range(0, n_items, block))
     lo_effs_np = np.array([min(lo, n_items - block) for lo in los], np.int32)
 
-    outs = _full_cco_topk_multi(
-        p_light, tuple(sec_light),
-        p_heavy, tuple(sec_heavy) if n_heavy else (),
-        jnp.asarray(lo_effs_np), jnp.asarray(n_i),
-        jnp.asarray(np.stack(n_js)), jnp.float32(n_users),
-        n_items=n_items, u_chunk=u_chunk, h_chunk=_HEAVY_RANGE,
-        block=block, k=k, llr_threshold=llr_threshold,
-        self_flags=self_flags)
+    if n_mesh_dev > 1:
+        outs = _full_cco_topk_multi_sharded(
+            p_light, tuple(sec_light),
+            p_heavy if p_heavy is not None else (),
+            tuple(sec_heavy) if n_heavy else (),
+            jnp.asarray(lo_effs_np), jnp.asarray(n_i),
+            jnp.asarray(np.stack(n_js)), jnp.float32(n_users),
+            mesh=mesh, n_items=n_items, u_chunk=u_chunk,
+            h_chunk=_HEAVY_RANGE, block=block, k=k,
+            llr_threshold=llr_threshold, self_flags=self_flags)
+    else:
+        outs = _full_cco_topk_multi(
+            p_light, tuple(sec_light),
+            p_heavy, tuple(sec_heavy) if n_heavy else (),
+            jnp.asarray(lo_effs_np), jnp.asarray(n_i),
+            jnp.asarray(np.stack(n_js)), jnp.float32(n_users),
+            n_items=n_items, u_chunk=u_chunk, h_chunk=_HEAVY_RANGE,
+            block=block, k=k, llr_threshold=llr_threshold,
+            self_flags=self_flags)
     outs = jax.device_get(outs)
     return {
         name: _gather_indicators(ss, ixs, los, lo_effs_np, block, n_items)

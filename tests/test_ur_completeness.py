@@ -282,3 +282,43 @@ def test_cco_multi_matches_per_pair(monkeypatch):
     for name in multi:
         np.testing.assert_array_equal(multi[name].idx, fb[name].idx)
         np.testing.assert_array_equal(multi[name].score, fb[name].score)
+
+
+def test_cco_multi_sharded_matches_single_device(monkeypatch):
+    """The fused multi-pair program on the 8-device mesh (user ranges
+    sharded over DATA_AXIS, partial counts psum'd) must be bit-identical
+    to the fused single-device run AND to per-pair calls."""
+    import jax
+    import numpy as np
+
+    from incubator_predictionio_tpu.ops.llr import (
+        cco_indicators, cco_indicators_multi,
+    )
+    from incubator_predictionio_tpu.parallel.mesh import mesh_from_devices
+
+    monkeypatch.delenv("PIO_UR_FULL_MATRIX_ELEMS", raising=False)
+    rng = np.random.default_rng(21)
+    n_users, n_items = 500, 120
+    pu = rng.integers(0, n_users, 4000).astype(np.int32)
+    pi = rng.integers(0, n_items, 4000).astype(np.int32)
+    vu = rng.integers(0, n_users, 9000).astype(np.int32)
+    vi = rng.integers(0, n_items, 9000).astype(np.int32)
+    pu[:700] = 3  # heavy user exercises the heavy shard too
+    secs = {"buy": (pu, pi), "view": (vu, vi)}
+
+    mesh = mesh_from_devices(devices=jax.devices("cpu"))
+    sharded = cco_indicators_multi(pu, pi, secs, n_users=n_users,
+                                   n_items=n_items, max_correlators=7,
+                                   u_chunk=64, mesh=mesh)
+    single = cco_indicators_multi(pu, pi, secs, n_users=n_users,
+                                  n_items=n_items, max_correlators=7,
+                                  u_chunk=64, mesh=None)
+    for name in secs:
+        np.testing.assert_array_equal(sharded[name].idx, single[name].idx,
+                                      err_msg=name)
+        np.testing.assert_array_equal(sharded[name].score,
+                                      single[name].score, err_msg=name)
+        per_pair = cco_indicators(pu, pi, *secs[name], n_users, n_items,
+                                  max_correlators=7, u_chunk=64)
+        np.testing.assert_array_equal(sharded[name].idx, per_pair.idx)
+        np.testing.assert_array_equal(sharded[name].score, per_pair.score)
