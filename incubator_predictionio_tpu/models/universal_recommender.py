@@ -325,9 +325,10 @@ class URAlgorithm(Algorithm):
         # One fused device program for every event-type pair: the
         # primary's dedupe/partition/upload/membership slabs are shared
         # across pairs and the self-pair rides the primary slabs
-        # outright (ops.llr.cco_indicators_multi; falls back to per-pair
-        # calls on multi-chip meshes or when the fused accumulators
-        # exceed the HBM budget — bit-identical either way).
+        # outright (ops.llr.cco_indicators_multi; multi-chip meshes run
+        # the same fusion sharded over DATA_AXIS with psum'd counts;
+        # per-pair fallback only when the fused accumulators exceed the
+        # HBM budget — bit-identical either way).
         secondaries = {
             name: pd.events[name]
             for name in names if len(pd.events[name][0])

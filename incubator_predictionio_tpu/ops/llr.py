@@ -295,33 +295,47 @@ def _full_cco_topk_multi(light_p, light_secs, heavy_p, heavy_secs, lo_effs,
     output order; self_flags marks which outputs take the primary slab.
     n_js: [S, I] per-secondary distinct-user item counts."""
 
-    def mk_body(chunk_rows: int):
-        def body(cs, chunk):
-            ap = _slab(chunk[0], chunk[1], chunk_rows, n_items)
-            outs, r = [], 2
-            for is_self in self_flags:
-                if is_self:
-                    a2 = ap
-                else:
-                    a2 = _slab(chunk[r], chunk[r + 1], chunk_rows, n_items)
-                    r += 2
-                outs.append(cs[len(outs)] + jnp.einsum(
-                    "ui,uj->ij", ap, a2,
-                    preferred_element_type=jnp.int32))
-            return tuple(outs), None
-        return body
-
     n_sec = len(self_flags)
     c0 = tuple(jnp.zeros((n_items, n_items), jnp.int32)
                for _ in range(n_sec))
     xs = tuple(light_p) + tuple(x for pair in light_secs for x in pair)
-    cs, _ = jax.lax.scan(mk_body(u_chunk), c0, xs)
+    cs, _ = jax.lax.scan(_mk_multi_body(self_flags, n_items, u_chunk),
+                         c0, xs)
     if heavy_p is not None:
         xs_h = tuple(heavy_p) + tuple(x for pair in heavy_secs for x in pair)
-        cs, _ = jax.lax.scan(mk_body(h_chunk), cs, xs_h)
+        cs, _ = jax.lax.scan(_mk_multi_body(self_flags, n_items, h_chunk),
+                             cs, xs_h)
 
+    return _topk_per_secondary(cs, n_js, n_i, lo_effs, n_total,
+                               n_items=n_items, block=block, k=k,
+                               llr_threshold=llr_threshold)
+
+
+def _mk_multi_body(self_flags: tuple, n_items: int, chunk_rows: int):
+    """Scan body shared by the fused single-device and sharded kernels:
+    build the primary slab once, accumulate every pair against it."""
+    def body(cs, chunk):
+        ap = _slab(chunk[0], chunk[1], chunk_rows, n_items)
+        outs, r = [], 2
+        for is_self in self_flags:
+            if is_self:
+                a2 = ap
+            else:
+                a2 = _slab(chunk[r], chunk[r + 1], chunk_rows, n_items)
+                r += 2
+            outs.append(cs[len(outs)] + jnp.einsum(
+                "ui,uj->ij", ap, a2,
+                preferred_element_type=jnp.int32))
+        return tuple(outs), None
+    return body
+
+
+def _topk_per_secondary(cs, n_js, n_i, lo_effs, n_total, *, n_items: int,
+                        block: int, k: int, llr_threshold: float):
+    """Per-secondary stripe LLR + top-k loop shared by every full-matrix
+    kernel variant (single/sharded, single-pair/fused)."""
     outs = []
-    for s_idx in range(n_sec):
+    for s_idx in range(len(cs)):
         c = cs[s_idx]
         n_j = n_js[s_idx]
 
@@ -358,32 +372,17 @@ def _full_cco_topk_multi_sharded(light_p, light_secs, heavy_p, heavy_secs,
     n_sec = len(self_flags)
 
     def counts_fn(lp, lsecs, hp, hsecs):
-        def mk_body(chunk_rows: int):
-            def body(cs, chunk):
-                ap = _slab(chunk[0], chunk[1], chunk_rows, n_items)
-                outs, r = [], 2
-                for is_self in self_flags:
-                    if is_self:
-                        a2 = ap
-                    else:
-                        a2 = _slab(chunk[r], chunk[r + 1], chunk_rows,
-                                   n_items)
-                        r += 2
-                    outs.append(cs[len(outs)] + jnp.einsum(
-                        "ui,uj->ij", ap, a2,
-                        preferred_element_type=jnp.int32))
-                return tuple(outs), None
-            return body
-
         c0 = tuple(
             jax.lax.pcast(jnp.zeros((n_items, n_items), jnp.int32),
                           (_D,), to="varying")
             for _ in range(n_sec))
         xs = tuple(lp) + tuple(x for pair in lsecs for x in pair)
-        cs, _ = jax.lax.scan(mk_body(u_chunk), c0, xs)
+        cs, _ = jax.lax.scan(_mk_multi_body(self_flags, n_items, u_chunk),
+                             c0, xs)
         if len(hp):
             xs_h = tuple(hp) + tuple(x for pair in hsecs for x in pair)
-            cs, _ = jax.lax.scan(mk_body(h_chunk), cs, xs_h)
+            cs, _ = jax.lax.scan(
+                _mk_multi_body(self_flags, n_items, h_chunk), cs, xs_h)
         return tuple(jax.lax.psum(c, _D) for c in cs)
 
     rows = _P(_D, None)
@@ -399,21 +398,9 @@ def _full_cco_topk_multi_sharded(light_p, light_secs, heavy_p, heavy_secs,
         out_specs=tuple(_P() for _ in range(n_sec)),
     )(light_p, light_secs, heavy_p, heavy_secs)
 
-    outs = []
-    for s_idx in range(n_sec):
-        c = cs[s_idx]
-        n_j = n_js[s_idx]
-
-        def body(carry, lo_eff, c=c, n_j=n_j):
-            counts = jax.lax.dynamic_slice(c, (lo_eff, 0), (block, n_items))
-            n_i_stripe = jax.lax.dynamic_slice(n_i, (lo_eff,), (block,))
-            s, ix = _stripe_topk(counts, n_i_stripe, n_j, lo_eff, n_total,
-                                 k=k, llr_threshold=llr_threshold)
-            return carry, (s, ix)
-
-        _, (ss, ixs) = jax.lax.scan(body, 0, lo_effs)
-        outs.append((ss, ixs))
-    return tuple(outs)
+    return _topk_per_secondary(cs, n_js, n_i, lo_effs, n_total,
+                               n_items=n_items, block=block, k=k,
+                               llr_threshold=llr_threshold)
 
 
 @functools.partial(jax.jit, static_argnames=(

@@ -298,13 +298,27 @@ def test_cco_multi_sharded_matches_single_device(monkeypatch):
 
     monkeypatch.delenv("PIO_UR_FULL_MATRIX_ELEMS", raising=False)
     rng = np.random.default_rng(21)
-    n_users, n_items = 500, 120
+    n_users, n_items = 500, 400
     pu = rng.integers(0, n_users, 4000).astype(np.int32)
     pi = rng.integers(0, n_items, 4000).astype(np.int32)
     vu = rng.integers(0, n_users, 9000).astype(np.int32)
     vi = rng.integers(0, n_items, 9000).astype(np.int32)
-    pu[:700] = 3  # heavy user exercises the heavy shard too
+    # user 3 holds ~390 distinct items — verified below to clear the
+    # heavy cap, so the sharded HEAVY scan genuinely executes
+    pu[:3000] = 3
+    pi[:3000] = rng.permutation(n_items)[
+        rng.integers(0, 390, 3000)].astype(np.int32)
     secs = {"buy": (pu, pi), "view": (vu, vi)}
+
+    # prove the heavy branch triggers (same formula as the prep code)
+    def distinct(u, i):
+        return np.unique(u.astype(np.int64) * n_items + i)
+
+    per_user = np.bincount(distinct(pu, pi) // n_items, minlength=n_users)
+    per_user = per_user + np.bincount(distinct(vu, vi) // n_items,
+                                      minlength=n_users)
+    cap = max(int(16 * max(per_user.sum() / n_users, 1.0)), 256)
+    assert per_user[3] > cap, "test setup must create a heavy user"
 
     mesh = mesh_from_devices(devices=jax.devices("cpu"))
     sharded = cco_indicators_multi(pu, pi, secs, n_users=n_users,
