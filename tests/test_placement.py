@@ -3,6 +3,8 @@ the measured stage model must route transfer-bound trains to the host CPU
 when the link is slow, keep iterative dense trains on the accelerator,
 and honor forced modes."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -120,9 +122,15 @@ def test_template_algorithms_expose_stage_models():
     )
     from incubator_predictionio_tpu.controller.base import doer
 
+    # all-ones features ride the lossless uint8 wire → 1 byte/element
     nb = doer(NaiveBayesAlgorithm, {}).stage_model(pd)
-    assert nb.bytes_to_device == 100 * 8 * 4 and nb.device_passes == 1
+    assert nb.bytes_to_device == 100 * 8 * 1 and nb.device_passes == 1
     lr = doer(LogisticRegressionAlgorithm, {"max_iters": 7}).stage_model(pd)
     assert lr.device_passes == 7
+    # f32-only features price the full width
+    pd_f32 = dataclasses.replace(
+        pd, features=pd.features + np.float32(0.123456))
+    assert doer(NaiveBayesAlgorithm, {}).stage_model(
+        pd_f32).bytes_to_device == 100 * 8 * 4
     # iterative dense trainer: accelerator-pinned by design
     assert doer(ALSAlgorithm, {}).stage_model(object()) is None
