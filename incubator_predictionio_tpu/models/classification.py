@@ -132,16 +132,20 @@ class NaiveBayesParams(Params):
 def _wire_bytes(features: "np.ndarray") -> int:
     """Bytes this feature matrix actually crosses the link as: the NB/LR
     trainers upload the narrowest LOSSLESS dtype (uint8 for small
-    nonneg integer counts, bf16 when exactly representable — see
-    ops/linear.py). The placement stage model must price THOSE bytes;
-    pricing the f32 width overstated the link cost 4x and mis-routed LR
-    off the chip (measured 879k CPU vs 2.7M on-device)."""
+    nonneg integer counts, bf16 when exactly representable — the SAME
+    gates ops/linear.py applies). The placement stage model must price
+    THOSE bytes on the device side (pricing f32 overstated the link 4x
+    and mis-routed LR off the chip: measured 879k CPU vs 2.7M
+    on-device) while the CPU side streams the full f32 width
+    (host_bytes) — the narrowing is a TPU-upload feature."""
     x8 = features.astype(np.uint8)
     if np.array_equal(x8.astype(np.float32), features):
         return x8.nbytes
-    x16 = features.astype(np.float16)  # bf16-width proxy: 2 bytes/elem
-    if np.array_equal(x16.astype(np.float32), features):
-        return x16.nbytes
+    import jax.numpy as jnp
+
+    xb = features.astype(jnp.bfloat16)  # real bf16 gate, not an f16 proxy
+    if np.array_equal(np.asarray(xb, np.float32), features):
+        return features.size * 2
     return features.nbytes
 
 
@@ -156,7 +160,8 @@ class NaiveBayesAlgorithm(Algorithm):
         from ..workflow.placement import StageModel
 
         return StageModel(bytes_to_device=_wire_bytes(pd.features),
-                          device_passes=1.0, cpu_passes=1.0)
+                          device_passes=1.0,
+                          host_bytes=pd.features.nbytes, cpu_passes=1.0)
 
     def train(self, ctx, pd: PreparedData) -> ClassifierModel:
         model = train_naive_bayes(
@@ -196,7 +201,9 @@ class LogisticRegressionAlgorithm(Algorithm):
 
         iters = float(self.params.max_iters)
         return StageModel(bytes_to_device=_wire_bytes(pd.features),
-                          device_passes=iters, cpu_passes=iters * 10.0)
+                          device_passes=iters,
+                          host_bytes=pd.features.nbytes,
+                          cpu_passes=iters * 10.0)
 
     def train(self, ctx, pd: PreparedData) -> ClassifierModel:
         model = train_logistic_regression(

@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 16
+_EXPECTED_VERSION = 17
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -125,6 +125,21 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pio_ccop_item_counts.argtypes = [ctypes.c_void_p]
     lib.pio_ccop_free.restype = None
     lib.pio_ccop_free.argtypes = [ctypes.c_void_p]
+    lib.pio_pair_dedupe.restype = ctypes.c_void_p
+    lib.pio_pair_dedupe.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.pio_pdd_count.restype = ctypes.c_int64
+    lib.pio_pdd_count.argtypes = [ctypes.c_void_p]
+    for name in ("pio_pdd_users", "pio_pdd_items"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.POINTER(ctypes.c_int32)
+        fn.argtypes = [ctypes.c_void_p]
+    lib.pio_pdd_per_user.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.pio_pdd_per_user.argtypes = [ctypes.c_void_p]
+    lib.pio_pdd_free.restype = None
+    lib.pio_pdd_free.argtypes = [ctypes.c_void_p]
     lib.pio_fill_entries.restype = ctypes.c_int32
     lib.pio_fill_entries.argtypes = [
         ctypes.POINTER(ctypes.c_int64),   # row
@@ -740,3 +755,46 @@ def cco_partition(u: np.ndarray, i: np.ndarray, rank, n_users: int,
         return light, heavy, counts
     finally:
         lib.pio_ccop_free(h)
+
+def pair_dedupe(u: np.ndarray, i: np.ndarray, n_users: int, n_items: int):
+    """Distinct (user, item) pairs sorted by (user, item) + per-user
+    distinct counts, via counting-sort by user + small per-user sorts —
+    replaces np.unique's global comparison sort (0.39 s at 10M events on
+    the 1-core host) with two linear passes. Identical output order to
+    the packed-key np.unique (tested). Raises NativeUnavailable when
+    the codec cannot load."""
+    lib = _load()
+    u = np.asarray(u)
+    i = np.asarray(i)
+    if u.dtype != np.int32 or i.dtype != np.int32:
+        # range-check in the WIDE dtype first: an unsafe int64→int32
+        # cast would wrap an out-of-range id INTO the valid range and
+        # keep a pair the numpy fallback drops
+        u64 = u.astype(np.int64)
+        i64 = i.astype(np.int64)
+        valid = ((u64 >= 0) & (u64 < n_users)
+                 & (i64 >= 0) & (i64 < n_items))
+        u = u64[valid].astype(np.int32)
+        i = i64[valid].astype(np.int32)
+    u = np.ascontiguousarray(u, np.int32)
+    i = np.ascontiguousarray(i, np.int32)
+    h = lib.pio_pair_dedupe(
+        u.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        i.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        u.size, n_users, n_items)
+    if not h:
+        raise NativeUnavailable("pair_dedupe failed")
+    try:
+        n = lib.pio_pdd_count(h)
+        if n:  # empty vectors hand back NULL data pointers
+            du = np.ctypeslib.as_array(lib.pio_pdd_users(h), shape=(n,)).copy()
+            di = np.ctypeslib.as_array(lib.pio_pdd_items(h), shape=(n,)).copy()
+        else:
+            du = np.zeros(0, np.int32)
+            di = np.zeros(0, np.int32)
+        per_user = (np.ctypeslib.as_array(
+            lib.pio_pdd_per_user(h), shape=(n_users,)).copy()
+            if n_users else np.zeros(0, np.int64))
+        return du, di, per_user
+    finally:
+        lib.pio_pdd_free(h)

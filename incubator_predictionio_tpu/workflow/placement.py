@@ -80,7 +80,11 @@ def _measured_put_bps() -> float:
             jax.block_until_ready(
                 jax.jit(lambda v: v + 1)(jnp.zeros(8, jnp.float32)))
             buf = np.empty(_PROBE_BYTES, np.uint8)
-            jax.block_until_ready(jax.device_put(buf, dev))  # warm path
+            # warm BOTH the put path and the x[:1] barrier executable —
+            # a first-time slice compile inside the timed window would
+            # bill a compile round-trip to the link rate
+            warm = jax.device_put(buf, dev)
+            _ = jax.device_get(warm[:1])
             t0 = time.perf_counter()
             x = jax.device_put(buf, dev)
             # device_get is the only true completion barrier through the

@@ -548,7 +548,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 16; }
+int32_t pio_codec_version() { return 17; }
 
 namespace {
 // FNV-1a over a byte range, continuing from a running state.
@@ -1436,5 +1436,88 @@ const int64_t* pio_ccop_item_counts(void* h) {
 }
 
 void pio_ccop_free(void* h) { delete static_cast<CcoPart*>(h); }
+
+}  // extern "C"
+
+// ===========================================================================
+// CCO pair dedupe: raw (user, item) events → distinct pairs sorted by
+// (user, item) + per-user distinct counts, via counting-sort by user and
+// small per-user sorts — two linear passes instead of np.unique's global
+// comparison sort (0.39 s at the UR bench's 10M events).
+// ===========================================================================
+
+namespace {
+
+struct PairDedupe {
+  std::vector<int32_t> du, di;      // deduped pairs, (user, item)-sorted
+  std::vector<int64_t> per_user;    // distinct-pair count per user
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pio_pair_dedupe(const int32_t* u, const int32_t* ii, int64_t n,
+                      int64_t n_users, int64_t n_items) {
+  auto* out = new PairDedupe();
+  out->per_user.assign(static_cast<size_t>(n_users), 0);
+  // pass 1: events per user (invalid ids dropped, matching the numpy path)
+  std::vector<int64_t> count(static_cast<size_t>(n_users), 0);
+  for (int64_t j = 0; j < n; ++j) {
+    int32_t uu = u[j], it = ii[j];
+    if (uu < 0 || uu >= n_users || it < 0 || it >= n_items) continue;
+    ++count[uu];
+  }
+  std::vector<int64_t> start(static_cast<size_t>(n_users) + 1, 0);
+  for (int64_t s = 0; s < n_users; ++s) start[s + 1] = start[s] + count[s];
+  // pass 2: bucket items by user
+  std::vector<int32_t> items(static_cast<size_t>(start[n_users]));
+  std::vector<int64_t> cursor(start.begin(), start.end() - 1);
+  for (int64_t j = 0; j < n; ++j) {
+    int32_t uu = u[j], it = ii[j];
+    if (uu < 0 || uu >= n_users || it < 0 || it >= n_items) continue;
+    items[cursor[uu]++] = it;
+  }
+  // per-user sort + adjacent-unique emit (matches np.unique's
+  // (user, item) order exactly — layout-identity tested)
+  out->du.reserve(items.size());
+  out->di.reserve(items.size());
+  for (int64_t s = 0; s < n_users; ++s) {
+    int32_t* lo = items.data() + start[s];
+    int32_t* hi = items.data() + start[s + 1];
+    if (lo == hi) continue;
+    std::sort(lo, hi);
+    int32_t prev = -1;
+    int64_t distinct = 0;
+    for (int32_t* q = lo; q < hi; ++q) {
+      if (*q != prev) {
+        out->du.push_back(static_cast<int32_t>(s));
+        out->di.push_back(*q);
+        prev = *q;
+        ++distinct;
+      }
+    }
+    out->per_user[s] = distinct;
+  }
+  return out;
+}
+
+int64_t pio_pdd_count(void* h) {
+  return static_cast<int64_t>(static_cast<PairDedupe*>(h)->du.size());
+}
+
+const int32_t* pio_pdd_users(void* h) {
+  return static_cast<PairDedupe*>(h)->du.data();
+}
+
+const int32_t* pio_pdd_items(void* h) {
+  return static_cast<PairDedupe*>(h)->di.data();
+}
+
+const int64_t* pio_pdd_per_user(void* h) {
+  return static_cast<PairDedupe*>(h)->per_user.data();
+}
+
+void pio_pdd_free(void* h) { delete static_cast<PairDedupe*>(h); }
 
 }  // extern "C"

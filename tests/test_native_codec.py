@@ -307,3 +307,56 @@ def test_jsonl_reversed_order_tie_semantics(tmp_path):
                         for e in le.find(app_id, reversed_order=True)]
         s.close()
     assert orders["memory"] == orders["jsonl"]
+
+
+def test_native_pair_dedupe_matches_numpy():
+    """pio_pair_dedupe (counting-sort + per-user sorts) must emit the
+    exact (user, item)-sorted distinct pairs + per-user counts that the
+    packed-key np.unique path produces, incl. out-of-range drops."""
+    import numpy as np
+    import pytest
+
+    native = pytest.importorskip("incubator_predictionio_tpu.native")
+    try:
+        native._load()
+    except native.NativeUnavailable:
+        pytest.skip("no toolchain")
+
+    rng = np.random.default_rng(3)
+    n_users, n_items = 300, 90
+    u = rng.integers(-5, n_users + 5, 20_000).astype(np.int32)
+    i = rng.integers(-5, n_items + 5, 20_000).astype(np.int32)
+    u[:4000] = 7  # heavy user with many duplicate pairs
+
+    du, di, per_user = native.pair_dedupe(u, i, n_users, n_items)
+
+    uu, ii = u.astype(np.int64), i.astype(np.int64)
+    valid = (ii >= 0) & (ii < n_items) & (uu >= 0) & (uu < n_users)
+    key = np.unique(uu[valid] * n_items + ii[valid])
+    np.testing.assert_array_equal(du, (key // n_items).astype(np.int32))
+    np.testing.assert_array_equal(di, (key % n_items).astype(np.int32))
+    np.testing.assert_array_equal(
+        per_user, np.bincount(du, minlength=n_users))
+    # empty input
+    e_u, e_i, e_pu = native.pair_dedupe(
+        np.zeros(0, np.int32), np.zeros(0, np.int32), 10, 10)
+    assert len(e_u) == 0 and len(e_i) == 0 and e_pu.sum() == 0
+
+
+def test_native_pair_dedupe_int64_ids_never_wrap():
+    """64-bit ids out of int32 range must be DROPPED (as the numpy path
+    drops them), never wrapped into the valid range by the cast."""
+    import numpy as np
+    import pytest
+
+    native = pytest.importorskip("incubator_predictionio_tpu.native")
+    try:
+        native._load()
+    except native.NativeUnavailable:
+        pytest.skip("no toolchain")
+
+    u = np.array([1, 2**32 + 7, 3], np.int64)  # wraps to 7 if cast unsafely
+    i = np.array([0, 1, 2], np.int64)
+    du, di, per_user = native.pair_dedupe(u, i, n_users=100, n_items=10)
+    assert du.tolist() == [1, 3] and di.tolist() == [0, 2]
+    assert per_user[7] == 0  # the phantom pair must not exist
