@@ -179,19 +179,6 @@ class Engine:
             # Stage label for error attribution inside iterative trainers
             # (e.g. train_als' per-iteration NaN guard).
             ctx.stage_label = f"algorithm[{name or 'default'}]"
-            # Cost-based placement (--device=auto): run this stage's
-            # train on whichever mesh the measured stage model prices
-            # cheaper (workflow/placement.py); restored afterwards.
-            from ..workflow.placement import mesh_for_stage
-
-            prev_mesh = ctx.mesh
-            try:
-                sm = algo.stage_model(pd)
-            except Exception:  # noqa: BLE001 - sizing must never kill training
-                log.exception("stage_model failed; using configured mesh")
-                sm = None
-            stage_mesh = mesh_for_stage(
-                ctx, sm, getattr(wp, "device", "auto"), ctx.stage_label)
             if root_hook is not None:
                 # Per-algorithm subdirectory: without it, multiple
                 # algorithms in one engine would collide on orbax step
@@ -202,12 +189,12 @@ class Engine:
                     max_to_keep=root_hook.max_to_keep,
                 )
             try:
-                # swap INSIDE the try: an exception between swap and
-                # train (e.g. checkpoint-hook setup) must still restore
-                ctx.mesh = stage_mesh
-                model = algo.train(ctx, pd)
+                # cost-based placement (--device=auto): _train_placed
+                # swaps the mesh for this stage and restores it on every
+                # exit path (workflow/placement.py)
+                model = self._train_placed(
+                    ctx, algo, name, pd, getattr(wp, "device", "auto"))
             finally:
-                ctx.mesh = prev_mesh
                 if root_hook is not None:
                     ctx.checkpoint_hook.close()
                     ctx.checkpoint_hook = root_hook
@@ -218,14 +205,37 @@ class Engine:
         return models
 
     # -- evaluation (reference: Engine.eval) ------------------------------
+    def _train_placed(self, ctx, algo, name: str, pd, device_mode: str):
+        """One algorithm train under cost-based placement (the same
+        mesh swap Engine.train applies — eval sweeps train many
+        candidates, so a mis-placed transfer-bound stage costs per
+        candidate, not once)."""
+        from ..workflow.placement import mesh_for_stage
+
+        try:
+            sm = algo.stage_model(pd)
+        except Exception:  # noqa: BLE001 - sizing must never kill training
+            log.exception("stage_model failed; using configured mesh")
+            sm = None
+        prev_mesh = ctx.mesh
+        try:
+            ctx.mesh = mesh_for_stage(
+                ctx, sm, device_mode, f"algorithm[{name or 'default'}]")
+            return algo.train(ctx, pd)
+        finally:
+            ctx.mesh = prev_mesh
+
     def eval(self, ctx, engine_params: EngineParams, workflow_params=None):
         """Per-fold: train on fold TD, batch-predict fold queries.
         Yields (eval_info, [(query, predicted, actual), ...]) per fold."""
+        device_mode = getattr(workflow_params, "device", None) or getattr(
+            getattr(ctx, "workflow_params", None), "device", "auto")
         ds, prep, algo_list, serving = self.make_components(engine_params)
         results = []
         for fold_i, (td, eval_info, qa) in enumerate(ds.read_eval(ctx)):
             pd = prep.prepare(ctx, td)
-            models = [algo.train(ctx, pd) for _, algo in algo_list]
+            models = [self._train_placed(ctx, algo, name, pd, device_mode)
+                      for name, algo in algo_list]
             qa = list(qa)
             queries = [serving.supplement(q) for q, _ in qa]
             per_algo = [

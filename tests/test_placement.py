@@ -134,3 +134,47 @@ def test_template_algorithms_expose_stage_models():
         pd_f32).bytes_to_device == 100 * 8 * 4
     # iterative dense trainer: accelerator-pinned by design
     assert doer(ALSAlgorithm, {}).stage_model(object()) is None
+
+
+def test_eval_sweeps_apply_placement(memory_storage):
+    """Engine.eval trains many candidates — each one must get the same
+    cost-based placement Engine.train applies (a mis-placed
+    transfer-bound stage would cost once PER candidate)."""
+    from incubator_predictionio_tpu.controller import (
+        Algorithm, DataSource, Engine, EngineParams,
+    )
+    from incubator_predictionio_tpu.parallel.mesh import mesh_from_devices
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.workflow_params import (
+        WorkflowParams,
+    )
+
+    meshes = []
+
+    class DS(DataSource):
+        def read_training(self, ctx):
+            return {"x": np.ones(4, np.float32)}
+
+        def read_eval(self, ctx):
+            td = self.read_training(ctx)
+            return [(td, None, [({"q": 1}, {"a": 1})])]
+
+    class Algo(Algorithm):
+        def stage_model(self, pd):
+            return StageModel(bytes_to_device=16)
+
+        def train(self, ctx, pd):
+            meshes.append(ctx.get_mesh())
+            return {}
+
+        def predict(self, model, q):
+            return {"p": 0}
+
+    engine = Engine(DS, algorithm_class_map={"a": Algo})
+    sentinel = mesh_from_devices(shape=(4, 2), axis_names=("d", "m"))
+    ctx = WorkflowContext(storage=memory_storage, mesh=sentinel)
+    ctx.workflow_params = WorkflowParams(device="cpu")
+    engine.eval(ctx, EngineParams(algorithm_params_list=[("a", {})]))
+    assert meshes and meshes[-1] is not sentinel
+    assert {d.platform for d in meshes[-1].devices.flat} == {"cpu"}
+    assert ctx.mesh is sentinel  # restored after the fold
