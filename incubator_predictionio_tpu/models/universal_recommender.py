@@ -307,9 +307,10 @@ class URAlgorithmParams(Params):
     max_correlators_per_item: int = 50
     llr_threshold: float = 0.0
     # 2048 measured best at the bench shapes once host prep went
-    # native (3.77M vs 3.24M ev/s at 1024): deeper MXU contractions and
-    # half the [I, I] accumulator read-write passes outweigh the wider
-    # slabs. Results are layout-invariant (exact counts either way).
+    # native (product path 3.57M ev/s vs 3.09M at 1024; direct-call
+    # sweep best 3.77M): deeper MXU contractions and half the [I, I]
+    # accumulator read-write passes outweigh the wider slabs. Results
+    # are layout-invariant (exact counts either way).
     user_chunk: int = 2048
 
 

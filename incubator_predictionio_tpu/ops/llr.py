@@ -86,7 +86,7 @@ def _partition_by_user(u: np.ndarray, i: np.ndarray, u_chunk: int,
     LOCAL offset within its range (padding sentinel = u_chunk — no
     per-row base array needed on device), ei the item id (padding 0,
     masked by the sentinel). Both upload uint16 when their value range
-    fits (they nearly always do: u_chunk defaults to ~1k, catalogs are
+    fits (they nearly always do: u_chunk defaults to 2048, catalogs are
     rarely >65k items) — half the slab bytes of int32, which matters
     because the slab upload is a dominant warm-train cost on
     remote-attached chips."""
@@ -591,7 +591,7 @@ def cco_indicators(
     n_items: int,
     max_correlators: int = 50,
     llr_threshold: float = 0.0,
-    u_chunk: int = 1024,
+    u_chunk: int = 2048,
     item_block: int = 4096,
     mesh=None,
 ) -> Indicators:
@@ -754,7 +754,7 @@ def cco_indicators_multi(
     n_items: int,
     max_correlators: int = 50,
     llr_threshold: float = 0.0,
-    u_chunk: int = 1024,
+    u_chunk: int = 2048,
     item_block: int = 4096,
     mesh=None,
 ) -> dict:
