@@ -151,17 +151,25 @@ class EngineServer:
         """Reference: CreateServer status page — JSON here."""
         with self._lock:
             instance = self.instance
-        return web.json_response(
-            {
-                "status": "alive",
-                "engineInstanceId": instance.id if instance else None,
-                "engineFactory": self.engine_factory_name,
-                "engineVariant": self.engine_variant,
-                "startTime": self.start_time.isoformat(),
-                "queryCount": self._query_count,
-                "plugins": self.plugins.plugin_names(),
-            }
-        )
+        out = {
+            "status": "alive",
+            "engineInstanceId": instance.id if instance else None,
+            "engineFactory": self.engine_factory_name,
+            "engineVariant": self.engine_variant,
+            "startTime": self.start_time.isoformat(),
+            "queryCount": self._query_count,
+            "plugins": self.plugins.plugin_names(),
+        }
+        # measured serving-latency decomposition, when a probe ran
+        # (pio deploy --probe-latency persists it to the instance row)
+        probe = (instance.runtime_conf.get("probe_latency")
+                 if instance is not None else None)
+        if probe:
+            try:
+                out["probeLatency"] = json.loads(probe)
+            except (TypeError, json.JSONDecodeError):
+                pass
+        return web.json_response(out)
 
     # -- micro-batching ---------------------------------------------------
     async def _start_batcher(self, app) -> None:
@@ -416,10 +424,15 @@ class EngineServer:
 
             instances = self.storage.get_meta_data_engine_instances()
             fresh = instances.get(instance.id) or instance
-            instances.update(_dc.replace(
+            updated = _dc.replace(
                 fresh,
                 runtime_conf={**fresh.runtime_conf,
-                              "probe_latency": json.dumps(result)}))
+                              "probe_latency": json.dumps(result)})
+            instances.update(updated)
+            with self._lock:
+                # keep the live status page in sync with the stored row
+                if self.instance is not None and self.instance.id == updated.id:
+                    self.instance = updated
         except Exception:  # noqa: BLE001 - persistence is best-effort
             log.exception("probe-latency: persisting to instance row failed")
         return result

@@ -219,3 +219,7 @@ def test_probe_latency_measures_and_persists(memory_storage):
     stored = json.loads(row.runtime_conf["probe_latency"])
     assert stored["http_p50_ms"] == result["http_p50_ms"]
     assert stored["n"] == 12
+    # ...and surfaced live on the status page
+    with ServerThread(server.app) as st:
+        status = requests.get(st.base + "/").json()
+    assert status["probeLatency"]["http_p50_ms"] == result["http_p50_ms"]
