@@ -205,7 +205,11 @@ def test_probe_latency_measures_and_persists(memory_storage):
                           storage=memory_storage)
     with ServerThread(server.app) as st:
         result = server.probe_and_record(st.base, n=12)
+        # surfaced live on the status page (same serving session — an
+        # aiohttp app cannot be restarted once cleaned up)
+        status = requests.get(st.base + "/").json()
     assert result is not None
+    assert status["probeLatency"]["http_p50_ms"] == result["http_p50_ms"]
     # decomposition is roughly consistent — independently sampled
     # distributions on a contended 1-core host need slack, not equality
     assert result["predict_p50_ms"] > 0
@@ -219,7 +223,3 @@ def test_probe_latency_measures_and_persists(memory_storage):
     stored = json.loads(row.runtime_conf["probe_latency"])
     assert stored["http_p50_ms"] == result["http_p50_ms"]
     assert stored["n"] == 12
-    # ...and surfaced live on the status page
-    with ServerThread(server.app) as st:
-        status = requests.get(st.base + "/").json()
-    assert status["probeLatency"]["http_p50_ms"] == result["http_p50_ms"]
