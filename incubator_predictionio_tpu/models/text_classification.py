@@ -210,6 +210,19 @@ class TextNBAlgorithm(Algorithm):
 
 
 class TextLRAlgorithm(TextNBAlgorithm):
+    def stage_model(self, pd: PreparedData):
+        """Inheriting NB's single-pass model would mis-price this as
+        transfer-bound: text LR materializes the dense scaled [N, D]
+        f32 matrix and runs max_iters L-BFGS passes over it — the same
+        iterate-on-resident-data shape as classification LR, with the
+        same measured 10x CPU compute-intensity factor."""
+        from ..workflow.placement import StageModel
+
+        n_bytes = len(pd.labels) * pd.vectorizer.n_features * 4
+        iters = float(self.params.max_iters)
+        return StageModel(bytes_to_device=n_bytes, device_passes=iters,
+                          cpu_passes=iters * 10.0)
+
     def train(self, ctx, pd: PreparedData) -> TextModel:
         features = pd.dense_tf()
         if pd.features_are_tf:

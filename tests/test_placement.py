@@ -178,3 +178,24 @@ def test_eval_sweeps_apply_placement(memory_storage):
     assert meshes and meshes[-1] is not sentinel
     assert {d.platform for d in meshes[-1].devices.flat} == {"cpu"}
     assert ctx.mesh is sentinel  # restored after the fold
+
+
+def test_text_lr_stage_model_reflects_iterations():
+    """TextLR must NOT inherit NB's single-pass pricing (it runs
+    max_iters L-BFGS passes over the dense matrix)."""
+    from incubator_predictionio_tpu.controller.base import doer
+    from incubator_predictionio_tpu.models.text_classification import (
+        PreparedData, TextLRAlgorithm, TextNBAlgorithm,
+    )
+    from incubator_predictionio_tpu.ops.tfidf import TfIdfVectorizer
+
+    vec = TfIdfVectorizer(n_features=64)
+    vec.fit_tf_coo(["a b c", "b c d"])
+    pd = PreparedData(None, np.zeros(2, np.int32), np.array(["x", "y"]),
+                      vec, features_are_tf=True,
+                      coo=vec.fit_tf_coo(["a b c", "b c d"]))
+    lr = doer(TextLRAlgorithm, {"max_iters": 50}).stage_model(pd)
+    assert lr.device_passes == 50 and lr.cpu_passes == 500
+    assert lr.bytes_to_device == 2 * 64 * 4  # the dense f32 matrix
+    nb = doer(TextNBAlgorithm, {}).stage_model(pd)
+    assert nb.device_passes == 1
