@@ -33,6 +33,17 @@ def status_cmd(args: list[str]) -> int:
     print(f"[info] Storage OK. Base dir: {base_dir()}")
     apps = s.get_meta_data_apps().get_all()
     print(f"[info] {len(apps)} app(s) registered.")
+    # Native runtime status: which codec the ingest/scan/CCO hot paths
+    # will actually use (reference `pio status` verifies its build jars).
+    try:
+        from ...native import _EXPECTED_VERSION, _load
+
+        _load()
+        print(f"[info] Native codec: v{_EXPECTED_VERSION} loaded "
+              "(ingest, columnar scans, CCO host prep accelerated).")
+    except Exception as e:  # noqa: BLE001 - informational only
+        print(f"[info] Native codec: unavailable ({e}); pure-Python "
+              "fallbacks active (identical behavior, slower).")
     print("[info] Your system is all ready to go.")
     return 0
 
