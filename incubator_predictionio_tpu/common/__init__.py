@@ -1,6 +1,20 @@
 """Shared server utilities (reference: common/src/main/scala/.../predictionio/
-{KeyAuthentication,SSLConfiguration}.scala)."""
+{KeyAuthentication,SSLConfiguration}.scala) plus the cross-stack
+resilience layer (resilience.py, faultinject.py)."""
 
+from .resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudgetExceeded,
+    RetryPolicy,
+    breaker_snapshots,
+    is_retryable,
+    resilient_urlopen,
+)
 from .ssl_config import ssl_context_from_env
 
-__all__ = ["ssl_context_from_env"]
+__all__ = [
+    "CircuitBreaker", "CircuitOpenError", "RetryBudgetExceeded",
+    "RetryPolicy", "breaker_snapshots", "is_retryable",
+    "resilient_urlopen", "ssl_context_from_env",
+]

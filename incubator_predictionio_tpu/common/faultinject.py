@@ -1,0 +1,172 @@
+"""Deterministic fault injection for chaos tests — no flaky network needed.
+
+The storage transports consult this module at named *fault points*
+(e.g. ``http.call``, ``http.stream``, ``hbase.rpc``, ``es.request``)
+before touching the wire. The active fault plan comes from the
+``PIO_FAULT_SPEC`` environment variable, so chaos scenarios work
+identically in-process, across subprocesses, and in CI:
+
+    PIO_FAULT_SPEC="rule[;rule...]"
+    rule = <point-pattern>:<mode>:<count>[:<param>]
+
+- ``point-pattern`` — fnmatch pattern against the fault-point name
+  (``http.call``, ``http.*``, ``*``).
+- ``fail:N`` — the first N matching calls raise :class:`InjectedFault`
+  (a ``ConnectionError``, so it classifies as retryable exactly like a
+  real torn socket).
+- ``latency:N:SECONDS`` — the first N matching calls sleep SECONDS
+  before proceeding.
+- ``drop:N:AFTER`` — streaming points only: the first N matching
+  streams raise :class:`InjectedFault` after AFTER items have been
+  produced (a connection dropped mid-stream).
+
+Counts are per-rule and deterministic: "fail first 2 calls" means
+exactly the first two matching calls in this process fail, then the
+rule is spent. ``reset()`` re-arms the plan (tests call it after
+setting the env var); parsing is cached and re-checked against the env
+value on every fault point, so flipping the variable mid-process takes
+effect immediately.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["InjectedFault", "fault_point", "stream_fault", "reset",
+           "active_spec"]
+
+ENV_VAR = "PIO_FAULT_SPEC"
+
+
+class InjectedFault(ConnectionError):
+    """A deterministic, injected transport failure (retryable class)."""
+
+
+class _Rule:
+    __slots__ = ("pattern", "mode", "remaining", "param")
+
+    def __init__(self, pattern: str, mode: str, count: int, param: float):
+        self.pattern = pattern
+        self.mode = mode
+        self.remaining = count
+        self.param = param
+
+
+def _parse(spec: str) -> list[_Rule]:
+    rules: list[_Rule] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) < 3:
+            raise ValueError(
+                f"{ENV_VAR}: malformed rule {raw!r} "
+                "(want point:mode:count[:param])")
+        pattern, mode, count = parts[0], parts[1].lower(), parts[2]
+        if mode not in ("fail", "latency", "drop"):
+            raise ValueError(f"{ENV_VAR}: unknown fault mode {mode!r}")
+        try:
+            n = int(count)
+        except ValueError as e:
+            raise ValueError(f"{ENV_VAR}: bad count in {raw!r}") from e
+        param = 0.0
+        if len(parts) > 3:
+            try:
+                param = float(parts[3])
+            except ValueError as e:
+                raise ValueError(f"{ENV_VAR}: bad param in {raw!r}") from e
+        elif mode in ("latency", "drop"):
+            raise ValueError(f"{ENV_VAR}: mode {mode!r} needs a param "
+                             f"({raw!r})")
+        rules.append(_Rule(pattern, mode, n, param))
+    return rules
+
+
+_lock = threading.Lock()
+_cached_spec: Optional[str] = None
+_rules: list[_Rule] = []
+
+
+def _active_rules() -> list[_Rule]:
+    """Current rule set, re-parsed whenever the env value changes.
+    A changed value re-arms all counts (it is a NEW plan)."""
+    global _cached_spec, _rules
+    spec = os.environ.get(ENV_VAR, "")
+    if spec != _cached_spec:
+        _rules = _parse(spec)
+        _cached_spec = spec
+    return _rules
+
+
+def reset() -> None:
+    """Forget the cached plan so counts re-arm from the env value."""
+    global _cached_spec, _rules
+    with _lock:
+        _cached_spec = None
+        _rules = []
+
+
+def active_spec() -> str:
+    """The raw spec currently in force ('' when chaos is off)."""
+    return os.environ.get(ENV_VAR, "")
+
+
+def fault_point(name: str) -> None:
+    """Declare a unit of wire work. Applies ``fail`` and ``latency``
+    rules matching ``name``; no-op (one dict lookup) when chaos is off."""
+    if not os.environ.get(ENV_VAR):
+        return
+    delay = 0.0
+    boom: Optional[InjectedFault] = None
+    with _lock:
+        for rule in _active_rules():
+            if rule.remaining <= 0 or rule.mode == "drop":
+                continue
+            if not fnmatch.fnmatch(name, rule.pattern):
+                continue
+            rule.remaining -= 1
+            if rule.mode == "fail":
+                boom = InjectedFault(
+                    f"injected fault at {name!r} ({ENV_VAR})")
+                break
+            delay += rule.param
+    if delay > 0:
+        time.sleep(delay)
+    if boom is not None:
+        raise boom
+
+
+class StreamFault:
+    """Armed mid-stream drop: call :meth:`on_item` once per produced
+    item; raises :class:`InjectedFault` when the drop threshold hits."""
+
+    def __init__(self, name: str, after: int):
+        self.name = name
+        self.after = after
+        self._produced = 0
+
+    def on_item(self) -> None:
+        self._produced += 1
+        if self._produced > self.after:
+            raise InjectedFault(
+                f"injected mid-stream drop at {self.name!r} after "
+                f"{self.after} item(s) ({ENV_VAR})")
+
+
+def stream_fault(name: str) -> Optional[StreamFault]:
+    """Arm a ``drop`` rule for one stream (consumes one count), or
+    ``None`` when no drop rule matches."""
+    if not os.environ.get(ENV_VAR):
+        return None
+    with _lock:
+        for rule in _active_rules():
+            if (rule.mode == "drop" and rule.remaining > 0
+                    and fnmatch.fnmatch(name, rule.pattern)):
+                rule.remaining -= 1
+                return StreamFault(name, int(rule.param))
+    return None

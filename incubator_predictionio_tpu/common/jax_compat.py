@@ -1,0 +1,38 @@
+"""JAX API compatibility shims.
+
+The sharded ops target the modern ``jax.shard_map`` entry point
+(``check_vma`` spelling); older installs (< 0.5) only ship
+``jax.experimental.shard_map.shard_map`` with the ``check_rep``
+spelling. Import :func:`shard_map` from here instead of from ``jax`` so
+the whole training/serving stack degrades gracefully across the JAX
+versions the container may carry instead of dying at import time.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.5
+
+    _MODERN = True
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _MODERN = False
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the modern keyword surface on any JAX.
+
+    On older JAX the ``check_vma`` flag maps onto ``check_rep=False``
+    unconditionally: the old replication checker predates several
+    collective patterns these kernels emit and rejects valid programs
+    the new varying-manual-axes checker accepts, and the flag only
+    controls validation strictness, never numerics.
+    """
+    if _MODERN:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)

@@ -35,6 +35,7 @@ from typing import Optional
 from aiohttp import web
 
 from ...common import ssl_context_from_env
+from ...common.resilience import CircuitOpenError
 from ...workflow.plugins import EventServerPluginContext
 from ..storage.base import AccessKey
 from ..storage.event import Event, EventValidationError, parse_event_time
@@ -71,7 +72,11 @@ class EventServer:
         except ValueError:
             self._key_ttl = 5.0
         self._key_cache: dict = {}  # key -> (expires_monotonic, AccessKey)
-        self.app = web.Application(client_max_size=16 * 1024 * 1024)
+        # load-shed accounting: requests refused because the storage
+        # backend's circuit breaker is open (reported on GET /)
+        self._shed_count = 0
+        self.app = web.Application(client_max_size=16 * 1024 * 1024,
+                                   middlewares=[self._shed_middleware])
         self.app.add_routes(
             [
                 web.get("/", self.handle_root),
@@ -84,6 +89,27 @@ class EventServer:
                 web.post("/webhooks/{connector}.json", self.handle_webhook),
             ]
         )
+
+    # -- load shedding -----------------------------------------------------
+    @web.middleware
+    async def _shed_middleware(self, request: web.Request, handler):
+        """Backend breaker open → shed with 503 + Retry-After.
+
+        Hammering a dead store with one blocking DAO call per request
+        would tie up the executor for the full timeout each time; the
+        breaker fails those calls fast and this middleware converts the
+        refusal into the HTTP backpressure contract (SDKs honour
+        Retry-After), instead of a misleading per-request 500."""
+        try:
+            return await handler(request)
+        except CircuitOpenError as e:
+            self._shed_count += 1
+            return web.json_response(
+                {"message": "event store temporarily unavailable "
+                            f"({e.breaker_name}); retry later"},
+                status=503,
+                headers={"Retry-After": str(max(1, int(e.retry_after)))},
+            )
 
     # -- auth -------------------------------------------------------------
     def _access_key_str(self, request: web.Request) -> Optional[str]:
@@ -171,7 +197,10 @@ class EventServer:
 
     # -- handlers ---------------------------------------------------------
     async def handle_root(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "alive"})
+        out = {"status": "alive"}
+        if self._shed_count:
+            out["shedRequests"] = self._shed_count
+        return web.json_response(out)
 
     async def handle_create(self, request: web.Request) -> web.Response:
         access_key = await self._authorize(request)

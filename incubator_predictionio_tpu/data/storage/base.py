@@ -433,5 +433,13 @@ class BaseStorageClient(abc.ABC):
     def p_events(self, namespace: str = "pio_eventdata") -> PEvents:
         raise NotImplementedError(f"{type(self).__name__} does not serve eventdata")
 
+    def breaker_states(self) -> list[dict]:
+        """Circuit-breaker snapshots for this client's endpoints.
+
+        Wire-protocol backends override this (one entry per endpoint
+        breaker, see common/resilience.py); embedded backends have no
+        circuits — an empty list means "always reachable"."""
+        return []
+
     def close(self) -> None:
         pass

@@ -35,6 +35,7 @@ import urllib.parse
 import urllib.request
 from typing import Iterable, Iterator, Optional, Sequence
 
+from ...common import resilience
 from . import base
 from .event import Event, event_time_us as _time_us, new_event_id
 
@@ -47,7 +48,9 @@ class ESStorageError(RuntimeError):
 
 class _ESTransport:
     def __init__(self, endpoint: str, username: str = "", password: str = "",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 policy: Optional[resilience.RetryPolicy] = None,
+                 breaker: Optional[resilience.CircuitBreaker] = None):
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout
         self._auth = None
@@ -55,6 +58,9 @@ class _ESTransport:
             token = base64.b64encode(
                 f"{username}:{password}".encode()).decode()
             self._auth = f"Basic {token}"
+        self.policy = policy or resilience.RetryPolicy()
+        self.breaker = breaker or resilience.CircuitBreaker(
+            f"es:{self.endpoint}")
 
     def request(self, method: str, path: str, body=None,
                 ndjson: Optional[str] = None) -> tuple[int, dict]:
@@ -73,7 +79,10 @@ class _ESTransport:
         req = urllib.request.Request(url, data=data, headers=headers,
                                      method=method)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with resilience.resilient_urlopen(
+                req, timeout=self.timeout, policy=self.policy,
+                breaker=self.breaker, point="es.request",
+            ) as resp:
                 raw = resp.read()
                 return resp.status, (json.loads(raw) if raw else {})
         except urllib.error.HTTPError as e:
@@ -82,9 +91,12 @@ class _ESTransport:
                 return e.code, json.loads(raw) if raw else {}
             except json.JSONDecodeError:
                 return e.code, {"error": raw.decode(errors="replace")}
-        except urllib.error.URLError as e:
+        except resilience.CircuitOpenError:
+            raise
+        except (OSError, resilience.RetryBudgetExceeded) as e:
+            reason = getattr(e, "reason", e)
             raise ESStorageError(
-                f"Elasticsearch unreachable: {self.endpoint} ({e.reason})"
+                f"Elasticsearch unreachable: {self.endpoint} ({reason})"
             ) from e
 
     # -- helpers ----------------------------------------------------------
@@ -877,8 +889,13 @@ class ESClient(base.BaseStorageClient):
         endpoint = host if "://" in host else f"http://{host}:{port}"
         self._transport = _ESTransport(
             endpoint, username=p.get("USERNAME", ""),
-            password=p.get("PASSWORD", ""))
+            password=p.get("PASSWORD", ""),
+            policy=resilience.policy_from_props(p),
+            breaker=resilience.breaker_from_props(p, f"es:{endpoint}"))
         self._daos: dict = {}
+
+    def breaker_states(self) -> list[dict]:
+        return [self._transport.breaker.snapshot()]
 
     def _dao(self, cls, namespace: str):
         # metadata DAO constructors ensure their index (a network round

@@ -55,6 +55,7 @@ import urllib.parse
 import urllib.request
 from typing import Iterable, Iterator, Optional, Sequence
 
+from ...common import resilience
 from . import base as storage_base
 from .event import Event, MonotoneNs, event_time_us, new_event_id
 from .hbase_rpc import HBaseRpcError, HBaseRpcTransport
@@ -95,9 +96,14 @@ class _HBaseRest:
     native_reverse = False
     _CF = "e"
 
-    def __init__(self, endpoint: str, timeout: float = 30.0):
+    def __init__(self, endpoint: str, timeout: float = 30.0,
+                 policy: Optional["resilience.RetryPolicy"] = None,
+                 breaker: Optional["resilience.CircuitBreaker"] = None):
         self.endpoint = endpoint.rstrip("/")
         self.timeout = timeout
+        self.policy = policy or resilience.RetryPolicy()
+        self.breaker = breaker or resilience.CircuitBreaker(
+            f"hbase-rest:{self.endpoint}")
 
     def request(self, method: str, path: str, body=None,
                 want_location: bool = False):
@@ -108,7 +114,10 @@ class _HBaseRest:
             headers={"Accept": "application/json",
                      "Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with resilience.resilient_urlopen(
+                req, timeout=self.timeout, policy=self.policy,
+                breaker=self.breaker, point="hbase.rest",
+            ) as resp:
                 raw = resp.read()
                 loc = resp.headers.get("Location")
                 out = json.loads(raw) if raw else None
@@ -116,10 +125,13 @@ class _HBaseRest:
         except urllib.error.HTTPError as e:
             e.read()
             return e.code, None
-        except urllib.error.URLError as e:
+        except resilience.CircuitOpenError:
+            raise
+        except (OSError, resilience.RetryBudgetExceeded) as e:
+            reason = getattr(e, "reason", e)
             raise HBaseError(
                 f"HBase REST gateway unreachable: {self.endpoint} "
-                f"({e.reason})") from e
+                f"({reason})") from e
 
     def close(self) -> None:
         pass
@@ -560,15 +572,32 @@ class HBaseClient(storage_base.BaseStorageClient):
                 host, int(port),
                 master_host=(p.get("MASTER_HOST") or "").strip() or None,
                 master_port=(p.get("MASTER_PORT") or "").strip() or None,
-                user=(p.get("USERNAME") or "pio").strip() or "pio")
+                user=(p.get("USERNAME") or "pio").strip() or "pio",
+                policy=resilience.policy_from_props(
+                    p, max_attempts=3, max_delay=1.0),
+                breaker=resilience.breaker_from_props(
+                    p, f"hbase-rpc:{host}:{port}"))
+            # fail fast on an unreachable cluster (reference: per-backend
+            # StorageClient constructors surface dead stores in `pio
+            # status`), with the policy's paced retry bridging restarts
+            self._transport.ping()
         elif protocol == "rest":
             port = (p.get("PORTS") or "8080").split(",")[0].strip()
             endpoint = host if "://" in host else f"http://{host}:{port}"
-            self._transport = _HBaseRest(endpoint)
+            self._transport = _HBaseRest(
+                endpoint,
+                policy=resilience.policy_from_props(p),
+                breaker=resilience.breaker_from_props(
+                    p, f"hbase-rest:{endpoint}"))
         else:
             raise ValueError(
                 f"HBASE PROTOCOL must be 'rest' or 'rpc', got {protocol!r}")
         self._daos: dict = {}
+
+    def breaker_states(self) -> list[dict]:
+        b = getattr(self._transport, "breaker", None) or getattr(
+            self._transport, "_breaker", None)
+        return [b.snapshot()] if b is not None else []
 
     def close(self) -> None:
         self._transport.close()

@@ -62,7 +62,10 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Iterator, Optional, Sequence
+
+from ...common import faultinject, resilience
 
 __all__ = ["HBaseRpcError", "HBaseRpcTransport", "PB", "pb_decode",
            "pb_delimited", "read_delimited"]
@@ -351,13 +354,22 @@ class HBaseRpcTransport:
                  master_host: Optional[str] = None,
                  master_port: Optional[int] = None,
                  family: str = "e", user: str = "pio",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 policy: Optional[resilience.RetryPolicy] = None,
+                 breaker: Optional[resilience.CircuitBreaker] = None):
         self._bootstrap = (host, int(port))
         self._master = (master_host or host,
                         int(master_port) if master_port else int(port))
         self._family = family.encode()
         self._user = user
         self._timeout = timeout
+        # Shared resilience plumbing: the policy paces the relocate/retry
+        # loops (jittered backoff instead of immediate hammering) and the
+        # per-endpoint breaker fails fast once the cluster is clearly gone.
+        self._policy = policy or resilience.RetryPolicy(
+            max_attempts=3, base_delay=0.05, max_delay=1.0)
+        self._breaker = breaker or resilience.CircuitBreaker(
+            f"hbase-rpc:{host}:{port}")
         self._conns: dict[tuple[str, int, str], _Conn] = {}
         self._regions: dict[str, list[_Region]] = {}
         self._lock = threading.Lock()
@@ -421,19 +433,33 @@ class HBaseRpcTransport:
         """One RPC with dead-connection hygiene: socket-level failures
         become typed connection_lost errors (retriable — the retry
         reconnects) and the broken connection is evicted so it can't
-        poison later calls or desync the length framing."""
-        conn = self._conn(server, service)
+        poison later calls or desync the length framing. Every outcome
+        feeds the endpoint breaker: connectivity failures count against
+        it, while server-reported application exceptions count as
+        SUCCESSES (the endpoint answered — it is healthy)."""
+        self._breaker.check()
+        conn: Optional[_Conn] = None
         try:
-            return conn.call(method, param)
+            faultinject.fault_point("hbase.rpc")
+            conn = self._conn(server, service)
+            result = conn.call(method, param)
         except HBaseRpcError as e:
             if e.connection_lost:
-                self._drop_conn(server, service, conn)
+                if conn is not None:
+                    self._drop_conn(server, service, conn)
+                self._breaker.record_failure()
+            else:
+                self._breaker.record_success()
             raise
         except OSError as e:
-            self._drop_conn(server, service, conn)
+            if conn is not None:
+                self._drop_conn(server, service, conn)
+            self._breaker.record_failure()
             raise HBaseRpcError(
                 f"connection to {server[0]}:{server[1]} lost: {e}",
                 connection_lost=True) from e
+        self._breaker.record_success()
+        return result
 
     def _drop_conn(self, server: tuple[str, int], service: str,
                    conn: Optional[_Conn] = None) -> None:
@@ -503,9 +529,26 @@ class HBaseRpcTransport:
         with self._lock:
             self._regions.pop(table, None)
 
+    def ping(self) -> None:
+        """Health probe through the retry policy: reach the bootstrap
+        region server (connection preamble handshake) with jittered
+        backoff; repeated failures trip the endpoint breaker."""
+        def probe():
+            faultinject.fault_point("hbase.ping")
+            self._conn(self._bootstrap, "ClientService")
+        self._policy.call(probe, breaker=self._breaker)
+
+    def _pace_retry(self, attempt: int) -> None:
+        """Jittered backoff between relocate-and-retry rounds — a dead
+        region server must not be hammered in a tight loop."""
+        delay = self._policy.backoff(attempt)
+        if delay > 0:
+            time.sleep(delay)
+
     def _with_region_retry(self, table: str, row: bytes, fn):
         """Run fn(region) with stale-location retries — the client-side
-        half of HBase's region-move protocol."""
+        half of HBase's region-move protocol, paced by the retry
+        policy's jittered backoff."""
         last: Optional[HBaseRpcError] = None
         for attempt in range(3):
             try:
@@ -522,6 +565,8 @@ class HBaseRpcTransport:
                     raise
                 last = e
                 self._invalidate(table)
+                if attempt < 2:
+                    self._pace_retry(attempt)
         assert last is not None
         raise last
 
@@ -715,6 +760,8 @@ class HBaseRpcTransport:
                     raise
                 last = e
                 self._invalidate(table)
+                if attempt < 2:
+                    self._pace_retry(attempt)
         assert last is not None
         raise last
 
@@ -758,6 +805,7 @@ class HBaseRpcTransport:
                     return
                 if e.retriable_region and attempt < 2:
                     self._invalidate(table)
+                    self._pace_retry(attempt)
                     continue
                 raise
             overlapping = [r for r in regions
@@ -779,6 +827,7 @@ class HBaseRpcTransport:
                 if not e.retriable_region or attempt == 2:
                     raise
                 self._invalidate(table)
+                self._pace_retry(attempt)
 
     def _scan_region(self, server: tuple[str, int], region_name: bytes,
                      start: bytes, stop: Optional[bytes],

@@ -21,6 +21,7 @@ import urllib.parse
 import urllib.request
 from typing import Optional
 
+from ...common import resilience
 from . import base
 
 
@@ -29,10 +30,15 @@ class HDFSStorageError(RuntimeError):
 
 
 class _WebHDFS:
-    def __init__(self, endpoint: str, user: str = "", timeout: float = 30.0):
+    def __init__(self, endpoint: str, user: str = "", timeout: float = 30.0,
+                 policy: Optional[resilience.RetryPolicy] = None,
+                 breaker: Optional[resilience.CircuitBreaker] = None):
         self.endpoint = endpoint.rstrip("/")
         self.user = user
         self.timeout = timeout
+        self.policy = policy or resilience.RetryPolicy()
+        self.breaker = breaker or resilience.CircuitBreaker(
+            f"hdfs:{self.endpoint}")
 
     def _url(self, path: str, op: str, **params) -> str:
         q = {"op": op, **params}
@@ -53,7 +59,10 @@ class _WebHDFS:
         req = urllib.request.Request(url, data=data, method=method,
                                      headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with resilience.resilient_urlopen(
+                req, timeout=self.timeout, policy=self.policy,
+                breaker=self.breaker, point="hdfs.request",
+            ) as resp:
                 return resp.status, resp.read(), False
         except urllib.error.HTTPError as e:
             if e.code == 307 and follow:
@@ -69,9 +78,12 @@ class _WebHDFS:
                                             data=redirect_data, follow=False)
                 return st, body, True
             return e.code, e.read(), False
-        except urllib.error.URLError as e:
+        except resilience.CircuitOpenError:
+            raise
+        except (OSError, resilience.RetryBudgetExceeded) as e:
+            reason = getattr(e, "reason", e)
             raise HDFSStorageError(
-                f"WebHDFS unreachable: {self.endpoint} ({e.reason})") from e
+                f"WebHDFS unreachable: {self.endpoint} ({reason})") from e
 
     def create(self, path: str, data: bytes) -> None:
         # two-step: body-free PUT to the NameNode → 307 Location → PUT
@@ -144,8 +156,14 @@ class HDFSClient(base.BaseStorageClient):
                 "(the WebHDFS gateway)")
         port = (p.get("PORTS") or "9870").split(",")[0].strip()
         endpoint = host if "://" in host else f"http://{host}:{port}"
-        self._transport = _WebHDFS(endpoint, user=p.get("USERNAME", ""))
+        self._transport = _WebHDFS(
+            endpoint, user=p.get("USERNAME", ""),
+            policy=resilience.policy_from_props(p),
+            breaker=resilience.breaker_from_props(p, f"hdfs:{endpoint}"))
         self._base = p.get("PATH", "/pio/models")
+
+    def breaker_states(self) -> list[dict]:
+        return [self._transport.breaker.snapshot()]
 
     def models(self, namespace: str = "pio_modeldata") -> base.Models:
         return HDFSModels(self._transport, self._base, namespace)

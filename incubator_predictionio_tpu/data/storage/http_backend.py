@@ -33,11 +33,13 @@ from __future__ import annotations
 import datetime as _dt
 import http.client as _http_client
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Iterable, Iterator, Optional, Sequence
 
+from ...common import faultinject, resilience
 from . import base
 from .event import Event
 
@@ -182,13 +184,33 @@ _MAX_STREAM_LINE = 64 * 1024 * 1024
 
 
 class _Transport:
+    """Resilient HTTP transport: every wire operation runs through the
+    shared :mod:`common.resilience` policy/breaker pair and declares a
+    fault point (``http.ping`` / ``http.call`` / ``http.stream`` /
+    ``http.blob``) for deterministic chaos testing.
+
+    Retry semantics: all operations retry on retryable failures
+    (connection refused/reset, timeouts, 429/502/503/504). RPC POSTs are
+    retried too — DAO reads are idempotent, and write retries are
+    at-least-once (a response lost AFTER the server committed may
+    duplicate an insert; the alternative, dying on the first transient
+    socket error, loses the write outright). Repeated failures trip the
+    per-endpoint circuit breaker; while it is open every operation fails
+    fast with :class:`~...common.resilience.CircuitOpenError` (surfaced
+    by the event server as 503 + Retry-After).
+    """
+
     def __init__(self, url: str, timeout: float = 30.0,
                  stream_timeout: float = 600.0,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 policy: Optional[resilience.RetryPolicy] = None,
+                 breaker: Optional[resilience.CircuitBreaker] = None):
         self.url = url.rstrip("/")
         self.timeout = timeout
         self.stream_timeout = stream_timeout
         self.secret = secret
+        self.policy = policy or resilience.RetryPolicy()
+        self.breaker = breaker or resilience.CircuitBreaker(self.url)
 
     def _headers(self, base: Optional[dict] = None) -> dict:
         h = dict(base or {})
@@ -196,14 +218,28 @@ class _Transport:
             h["Authorization"] = f"Bearer {self.secret}"
         return h
 
-    def ping(self) -> None:
+    def ping(self, policy: Optional[resilience.RetryPolicy] = None,
+             use_breaker: bool = True) -> None:
+        """Health check, retried under ``policy`` (default: the
+        transport policy). The constructor passes a short bounded policy
+        and ``use_breaker=False`` so `pio deploy` no longer loses the
+        race against a storage server still binding its port — the
+        pre-service connect refusals must neither trip the breaker open
+        mid-retry (which would abort the startup grace window early)
+        nor leave failure counts behind on a breaker that should start
+        clean once the server answers."""
         try:
-            with urllib.request.urlopen(
-                self.url + "/health", timeout=self.timeout
+            with resilience.resilient_urlopen(
+                self.url + "/health", timeout=self.timeout,
+                policy=policy or self.policy,
+                breaker=self.breaker if use_breaker else None,
+                point="http.ping",
             ) as r:
                 if json.loads(r.read()).get("status") != "ok":
                     raise StorageServerError("storage server unhealthy")
-        except OSError as e:
+        except resilience.CircuitOpenError:
+            raise
+        except (OSError, resilience.RetryBudgetExceeded) as e:
             raise StorageServerError(
                 f"storage server unreachable at {self.url}: {e}"
             ) from e
@@ -215,7 +251,11 @@ class _Transport:
             headers=self._headers({"Content-Type": "application/json"}),
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with resilience.resilient_urlopen(
+                req, timeout=self.timeout, policy=self.policy,
+                breaker=self.breaker, point="http.call",
+                retry_non_idempotent=True,
+            ) as r:
                 return json.loads(r.read()).get("result")
         except urllib.error.HTTPError as e:
             try:
@@ -225,58 +265,140 @@ class _Transport:
             raise StorageServerError(
                 f"{dao}.{method} failed ({e.code}): {detail}"
             ) from e
-        except OSError as e:
+        except resilience.CircuitOpenError:
+            raise
+        except (OSError, resilience.RetryBudgetExceeded) as e:
             raise StorageServerError(
                 f"{dao}.{method}: storage server unreachable: {e}"
             ) from e
 
     def stream(self, dao: str, method: str, namespace: str,
                args: dict) -> Iterator[dict]:
+        """NDJSON scan stream with mid-stream RESUME: when the
+        connection drops partway, the request is re-issued and the
+        rows already delivered are skipped, so the consumer sees every
+        row exactly once instead of the whole scan restarting (the
+        server's scan order is deterministic for identical args)."""
+        produced = 0
+        state = {
+            "produced_at_window": 0,
+            "window_start": time.monotonic(),
+            "attempt": 0,
+        }
+
+        def pace_or_raise(e: BaseException, desc: str) -> None:
+            """Shared retry bookkeeping: sleep a jittered backoff, or
+            raise StorageServerError when out of budget. The budget
+            bounds time WITHOUT PROGRESS, not scan lifetime: a drop
+            after 20 minutes of healthy streaming still deserves its
+            full resume budget."""
+            if produced > state["produced_at_window"]:
+                state["attempt"] = 0
+                state["window_start"] = time.monotonic()
+                state["produced_at_window"] = produced
+            state["attempt"] += 1
+            delay = self.policy.backoff(state["attempt"] - 1)
+            if (state["attempt"] >= self.policy.max_attempts
+                    or not resilience.is_retryable(e)
+                    or (time.monotonic() - state["window_start"] + delay
+                        > self.policy.deadline)):
+                raise StorageServerError(
+                    f"{dao}.{method}: {desc} (after {produced} row(s), "
+                    f"attempt {state['attempt']}): {e}") from e
+            if delay > 0:
+                time.sleep(delay)
+
+        own_probe = False
+        in_flight = False
+        try:
+            while True:
+                own_probe = self.breaker.check()
+                in_flight = True
+                try:
+                    for i, obj in enumerate(
+                            self._stream_once(dao, method, namespace, args)):
+                        if i < produced:
+                            continue        # resume: already delivered
+                        produced += 1
+                        yield obj
+                    self.breaker.record_success()
+                    in_flight = False
+                    return
+                except urllib.error.HTTPError as e:
+                    # the endpoint ANSWERED: application-level statuses
+                    # are breaker successes and fatal; transient infra
+                    # statuses (429/502/503/504) count against the
+                    # breaker and retry like a dropped connection
+                    retryable = resilience.is_retryable(e)
+                    if retryable:
+                        self.breaker.record_failure()
+                    else:
+                        self.breaker.record_success()
+                    in_flight = False
+                    if not retryable:
+                        try:
+                            detail = json.loads(e.read()).get("error", "")
+                        except Exception:
+                            detail = ""
+                        raise StorageServerError(
+                            f"{dao}.{method} failed ({e.code}): {detail}"
+                        ) from e
+                    try:
+                        e.close()  # drop the 429/5xx socket before retrying
+                    except Exception:
+                        pass
+                    pace_or_raise(e, f"storage server answered {e.code}")
+                except (OSError, _http_client.HTTPException) as e:
+                    self.breaker.record_failure()
+                    in_flight = False
+                    pace_or_raise(e, "storage server stream failed")
+        finally:
+            if in_flight and own_probe:
+                # our half-open probe ended with no verdict (consumer
+                # dropped the generator mid-scan, or an unexpected
+                # error): free the slot we hold, bias nothing
+                self.breaker.release_probe()
+
+    def _stream_once(self, dao: str, method: str, namespace: str,
+                     args: dict) -> Iterator[dict]:
+        faultinject.fault_point("http.stream")
+        drop = faultinject.stream_fault("http.stream")
         body = json.dumps({"namespace": namespace, "args": args}).encode()
         req = urllib.request.Request(
             f"{self.url}/rpc/{dao}/{method}", data=body,
             headers=self._headers({"Content-Type": "application/json",
                                    "Accept": "application/x-ndjson"}),
         )
-        try:
-            # Streaming scans use their own (much longer) timeout: a
-            # selective filter over a big store can be silent on the wire
-            # for a while between slabs without being dead.
-            with urllib.request.urlopen(
-                req, timeout=self.stream_timeout
-            ) as r:
-                while True:
-                    # Bounded readline: a server-side bug emitting an
-                    # unterminated line must not buffer unboundedly here.
-                    line = r.readline(_MAX_STREAM_LINE + 1)
-                    if not line:
-                        break
-                    if len(line) > _MAX_STREAM_LINE and not line.endswith(b"\n"):
-                        raise StorageServerError(
-                            f"{dao}.{method}: stream line exceeds "
-                            f"{_MAX_STREAM_LINE} bytes (malformed NDJSON "
-                            "from server)")
-                    line = line.strip()
-                    if not line:
-                        continue
-                    obj = json.loads(line)
-                    if isinstance(obj, dict) and "__error__" in obj:
-                        # Server hit an error mid-stream (headers were
-                        # already sent) and reported it in-band.
-                        raise StorageServerError(
-                            f"{dao}.{method} failed mid-scan: "
-                            f"{obj['__error__']}")
-                    yield obj
-        except urllib.error.HTTPError as e:
-            try:
-                detail = json.loads(e.read()).get("error", "")
-            except Exception:
-                detail = ""
-            raise StorageServerError(
-                f"{dao}.{method} failed ({e.code}): {detail}") from e
-        except (OSError, _http_client.HTTPException) as e:
-            raise StorageServerError(
-                f"{dao}.{method}: storage server stream failed: {e}") from e
+        # Streaming scans use their own (much longer) timeout: a
+        # selective filter over a big store can be silent on the wire
+        # for a while between slabs without being dead.
+        with urllib.request.urlopen(
+            req, timeout=self.stream_timeout
+        ) as r:
+            while True:
+                # Bounded readline: a server-side bug emitting an
+                # unterminated line must not buffer unboundedly here.
+                line = r.readline(_MAX_STREAM_LINE + 1)
+                if not line:
+                    break
+                if len(line) > _MAX_STREAM_LINE and not line.endswith(b"\n"):
+                    raise StorageServerError(
+                        f"{dao}.{method}: stream line exceeds "
+                        f"{_MAX_STREAM_LINE} bytes (malformed NDJSON "
+                        "from server)")
+                line = line.strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                if isinstance(obj, dict) and "__error__" in obj:
+                    # Server hit an error mid-stream (headers were
+                    # already sent) and reported it in-band.
+                    raise StorageServerError(
+                        f"{dao}.{method} failed mid-scan: "
+                        f"{obj['__error__']}")
+                if drop is not None:
+                    drop.on_item()
+                yield obj
 
     def blob(self, method: str, path: str, data: Optional[bytes] = None):
         req = urllib.request.Request(
@@ -286,7 +408,10 @@ class _Transport:
                 if data is not None else {}),
         )
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with resilience.resilient_urlopen(
+                req, timeout=self.timeout, policy=self.policy,
+                breaker=self.breaker, point="http.blob",
+            ) as r:
                 return r.read()
         except urllib.error.HTTPError as e:
             # 404 is an expected answer only for reads/deletes of a
@@ -296,7 +421,9 @@ class _Transport:
             if e.code == 404 and method in ("GET", "DELETE"):
                 return None
             raise StorageServerError(f"{method} {path} failed ({e.code})") from e
-        except OSError as e:
+        except resilience.CircuitOpenError:
+            raise
+        except (OSError, resilience.RetryBudgetExceeded) as e:
             raise StorageServerError(
                 f"{method} {path}: storage server unreachable: {e}") from e
 
@@ -598,17 +725,34 @@ class HTTPStorageClient(base.BaseStorageClient):
         host = (props.get("HOSTS") or "127.0.0.1").split(",")[0].strip()
         port = (props.get("PORTS") or "7072").split(",")[0].strip()
         scheme = props.get("SCHEME", "http")
-        timeout = float(props.get("TIMEOUT", "30"))
-        stream_timeout = float(props.get("STREAM_TIMEOUT", "600"))
+        timeout = resilience.prop_float(props, "TIMEOUT", 30.0)
+        stream_timeout = resilience.prop_float(props, "STREAM_TIMEOUT", 600.0)
         # Shared-secret auth: PIO_STORAGE_SOURCES_<N>_SECRET, falling back
         # to the server-side var so one-box setups configure it once.
         import os as _os
 
         secret = (props.get("SECRET")
                   or _os.environ.get("PIO_STORAGESERVER_SECRET") or None)
-        self._t = _Transport(f"{scheme}://{host}:{port}", timeout=timeout,
-                             stream_timeout=stream_timeout, secret=secret)
-        self._t.ping()
+        url = f"{scheme}://{host}:{port}"
+        self._t = _Transport(
+            url, timeout=timeout, stream_timeout=stream_timeout,
+            secret=secret,
+            policy=resilience.policy_from_props(props),
+            breaker=resilience.breaker_from_props(props, f"http:{url}"))
+        # Bounded startup retry: `pio deploy` / workers racing a storage
+        # server that is still binding its port keep probing until the
+        # CONNECT_DEADLINE budget is spent (CONNECT_ATTEMPTS is a
+        # generous backstop — the deadline is the real bound) instead of
+        # dying on the first refused connect.
+        self._t.ping(policy=resilience.RetryPolicy(
+            max_attempts=int(resilience.prop_float(
+                props, "CONNECT_ATTEMPTS", 20)),
+            base_delay=0.1, max_delay=0.5,
+            deadline=resilience.prop_float(props, "CONNECT_DEADLINE", 5.0)),
+            use_breaker=False)
+
+    def breaker_states(self) -> list[dict]:
+        return [self._t.breaker.snapshot()]
 
     def apps(self, namespace="pio_metadata"):
         return _HTTPApps(self._t, namespace)

@@ -216,6 +216,29 @@ class Storage:
     def get_p_events(self) -> base.PEvents:
         return self._client("EVENTDATA").p_events(self.repo_namespace("EVENTDATA"))
 
+    def breaker_states(self) -> dict[str, list[dict]]:
+        """Circuit-breaker snapshots per INSTANTIATED source (sources
+        never touched have no client and no circuits yet)."""
+        with self._lock:
+            clients = dict(self._clients)
+        return {name: client.breaker_states()
+                for name, client in clients.items()}
+
+    def backend_health(self) -> dict[str, dict]:
+        """Per-repository backend + circuit state for operators
+        (`pio status`, the serving /readyz probe)."""
+        out: dict[str, dict] = {}
+        for repo in REPOSITORIES:
+            source = self._repo_source_name(repo)
+            entry: dict = {"source": source,
+                           "type": self.repo_source_type(repo)}
+            with self._lock:
+                client = self._clients.get(source)
+            if client is not None:
+                entry["breakers"] = client.breaker_states()
+            out[repo] = entry
+        return out
+
     def verify_all_data_objects(self) -> list[str]:
         """`pio status` support: try constructing every DAO, return errors."""
         errors = []

@@ -34,6 +34,7 @@ import urllib.parse
 import urllib.request
 from typing import Optional
 
+from ...common import resilience
 from . import base
 
 
@@ -120,7 +121,9 @@ class S3StorageError(RuntimeError):
 class _S3Transport:
     def __init__(self, endpoint: str, bucket: str, access_key: str,
                  secret_key: str, region: str, path_style: bool = True,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 policy: Optional["resilience.RetryPolicy"] = None,
+                 breaker: Optional["resilience.CircuitBreaker"] = None):
         self.endpoint = endpoint.rstrip("/")
         self.bucket = bucket
         self.access_key = access_key
@@ -128,6 +131,9 @@ class _S3Transport:
         self.region = region
         self.path_style = path_style
         self.timeout = timeout
+        self.policy = policy or resilience.RetryPolicy()
+        self.breaker = breaker or resilience.CircuitBreaker(
+            f"s3:{self.endpoint}/{bucket}")
 
     def _url(self, key: str) -> str:
         qkey = urllib.parse.quote(key, safe="/-_.~")
@@ -146,7 +152,10 @@ class _S3Transport:
         req = urllib.request.Request(url, data=payload or None,
                                      headers=headers, method=method)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with resilience.resilient_urlopen(
+                req, timeout=self.timeout, policy=self.policy,
+                breaker=self.breaker, point="s3.request",
+            ) as resp:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
             body = e.read()
@@ -162,9 +171,12 @@ class _S3Transport:
                         "endpoint's by more than the allowed window; sync "
                         f"the clock (NTP). Server said: {body[:300]!r}")
             return e.code, body
-        except urllib.error.URLError as e:
+        except resilience.CircuitOpenError:
+            raise
+        except (OSError, resilience.RetryBudgetExceeded) as e:
+            reason = getattr(e, "reason", e)
             raise S3StorageError(
-                f"S3 endpoint unreachable: {self.endpoint} ({e.reason})"
+                f"S3 endpoint unreachable: {self.endpoint} ({reason})"
             ) from e
 
 
@@ -240,7 +252,13 @@ class S3Client(base.BaseStorageClient):
             secret_key=p["SECRET_KEY"],
             region=p.get("REGION", "us-east-1"),
             path_style=p.get("PATH_STYLE", "true").lower() != "false",
+            policy=resilience.policy_from_props(p),
+            breaker=resilience.breaker_from_props(
+                p, f"s3:{p['ENDPOINT']}/{p['BUCKET']}"),
         )
+
+    def breaker_states(self) -> list[dict]:
+        return [self._transport.breaker.snapshot()]
 
     def models(self, namespace: str = "pio_modeldata") -> base.Models:
         return S3Models(self._transport, namespace)

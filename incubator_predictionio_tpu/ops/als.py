@@ -51,7 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ..common.jax_compat import shard_map
 
 from .pallas_kernels import batched_spd_solve
 from .rowblocks import (

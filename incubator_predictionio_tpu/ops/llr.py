@@ -230,7 +230,7 @@ def _full_cco_topk_sharded(light, heavy, lo_effs, n_i, n_j, n_total, *,
     replicated afterwards inside the SAME jit. ``mesh`` is a static
     arg (Mesh is hashable), so repeat trains at the same shapes reuse
     one executable like every other kernel here."""
-    from jax import shard_map
+    from ..common.jax_compat import shard_map
     from jax.sharding import PartitionSpec as _P
     from ..parallel.mesh import DATA_AXIS as _D
 
@@ -365,7 +365,7 @@ def _full_cco_topk_multi_sharded(light_p, light_secs, heavy_p, heavy_secs,
     partial count matrices psum over ICI (exact int32 → bit-identical
     to per-pair and to single-device; tested on the virtual mesh).
     heavy_p/heavy_secs use () for absent (static pytree shape)."""
-    from jax import shard_map
+    from ..common.jax_compat import shard_map
     from jax.sharding import PartitionSpec as _P
     from ..parallel.mesh import DATA_AXIS as _D
 
@@ -537,7 +537,7 @@ def _all_stripes_sharded(lo_effs, light, heavy, n_i, n_j, n_total, *,
     user ranges into a [block, I] partial and the partials psum over
     ICI; LLR + top-k stay replicated. Bit-identical to the
     single-device striped path (exact integer counts)."""
-    from jax import shard_map
+    from ..common.jax_compat import shard_map
     from jax.sharding import PartitionSpec as _P
     from ..parallel.mesh import DATA_AXIS as _D
 

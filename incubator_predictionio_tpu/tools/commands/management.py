@@ -26,6 +26,14 @@ def status_cmd(args: list[str]) -> int:
         except Exception as e:  # noqa: BLE001 - verify below reports it
             print(f"[info]   {repo}: <unconfigured> ({e})")
     errors = s.verify_all_data_objects()
+    # Per-backend circuit-breaker state (common/resilience.py): which
+    # wire endpoints are healthy, tripped open, or probing half-open.
+    for repo, health in s.backend_health().items():
+        for b in health.get("breakers", []):
+            marker = "[info]" if b["state"] == "closed" else "[warn]"
+            print(f"{marker}   {repo}: breaker {b['name']} is "
+                  f"{b['state']} (failures={b['failure']}, "
+                  f"opened={b['opened']})")
     if errors:
         for e in errors:
             print(f"[error] {e}", file=sys.stderr)

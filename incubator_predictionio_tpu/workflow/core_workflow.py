@@ -223,11 +223,21 @@ def run_train(
         log.info("EngineInstance %s COMPLETED", instance_id)
         return instance_id
     except Exception:
-        instances.update(
-            EngineInstance(**{**instance.__dict__, "id": instance_id}).with_status(
-                "ABORTED", _utcnow()
+        # Best-effort ABORTED stamp: when the failure IS the storage
+        # backend (dead store, open breaker), this second write fails
+        # too — it must never mask the original training error, and the
+        # row heals later (`--resume` liveness-checks RUNNING rows by
+        # pid/host, so an unstamped row is still recoverable).
+        try:
+            instances.update(
+                EngineInstance(
+                    **{**instance.__dict__, "id": instance_id}
+                ).with_status("ABORTED", _utcnow())
             )
-        )
+        except Exception:  # noqa: BLE001 - the original error wins
+            log.exception(
+                "could not stamp EngineInstance %s ABORTED (storage "
+                "unavailable?); surfacing the original failure", instance_id)
         if ctx.checkpoint_hook is not None:
             ctx.checkpoint_hook.close()  # keep snapshots for --resume
             ctx.checkpoint_hook = None

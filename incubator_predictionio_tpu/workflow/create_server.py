@@ -72,6 +72,10 @@ class EngineServer:
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
         self._lock = threading.Lock()
         self._query_count = 0
+        # degraded mode: serving continues on the last-good model after a
+        # failed reload / feedback outage; /status and /readyz surface it
+        self._degraded_reason: Optional[str] = None
+        self._dropped_feedback = 0
         self.deployment = None
         self.instance = None
         self._load(instance_id)
@@ -80,6 +84,8 @@ class EngineServer:
         self.app.add_routes(
             [
                 web.get("/", self.handle_status),
+                web.get("/healthz", self.handle_healthz),
+                web.get("/readyz", self.handle_readyz),
                 web.post("/queries.json", self.handle_query),
                 web.get("/reload", self.handle_reload),
                 web.post("/reload", self.handle_reload),
@@ -159,6 +165,12 @@ class EngineServer:
             "startTime": self.start_time.isoformat(),
             "queryCount": self._query_count,
             "plugins": self.plugins.plugin_names(),
+            # resilience surface: serving on a stale model after a failed
+            # reload (degraded=true), and feedback events dropped because
+            # the event store write failed (counter — ops alert on growth)
+            "degraded": self._degraded_reason is not None,
+            "degradedReason": self._degraded_reason,
+            "droppedFeedback": self._dropped_feedback,
         }
         # measured serving-latency decomposition, when a probe ran
         # (pio deploy --probe-latency persists it to the instance row)
@@ -170,6 +182,42 @@ class EngineServer:
             except (TypeError, json.JSONDecodeError):
                 pass
         return web.json_response(out)
+
+    async def handle_healthz(self, request: web.Request) -> web.Response:
+        """Liveness: the process serves HTTP (mirrors the storage
+        server's /health). Restart-worthy failures never answer at all."""
+        return web.json_response({"status": "alive"})
+
+    async def handle_readyz(self, request: web.Request) -> web.Response:
+        """Readiness: a model is loaded AND no storage circuit breaker
+        is open; not-ready answers 503 so load balancers rotate this
+        replica out. The degraded flag (serving the last-good model
+        after a failed reload) is deliberately NOT part of readiness —
+        a degraded replica still answers queries correctly and draining
+        it would trade a stale-but-valid model for no capacity; it is
+        surfaced here and on /status as telemetry only."""
+        with self._lock:
+            loaded = self.deployment is not None
+        open_breakers = [
+            b["name"] for b in self._storage_breakers()
+            if b.get("state") == "open"
+        ]
+        ready = loaded and not open_breakers
+        out = {
+            "ready": ready,
+            "modelLoaded": loaded,
+            "degraded": self._degraded_reason is not None,
+            "openBreakers": open_breakers,
+        }
+        return web.json_response(out, status=200 if ready else 503)
+
+    def _storage_breakers(self) -> list[dict]:
+        try:
+            return [b for states in
+                    self.storage.breaker_states().values() for b in states]
+        except Exception:  # noqa: BLE001 - readiness must never crash
+            log.exception("breaker state collection failed")
+            return []
 
     # -- micro-batching ---------------------------------------------------
     async def _start_batcher(self, app) -> None:
@@ -288,33 +336,47 @@ class EngineServer:
             return web.json_response(result)
         self._query_count += 1
         if self.feedback:
-            # sync DAO write runs in the default executor, never on the loop
-            asyncio.get_running_loop().run_in_executor(
+            # sync DAO write runs in the default executor, never on the
+            # loop. The future must not be fire-and-forget: a failing
+            # event store would otherwise drop feedback events with the
+            # exception swallowed by the orphaned future — the
+            # done-callback logs every failure and counts it into the
+            # droppedFeedback counter on /status.
+            fut = asyncio.get_running_loop().run_in_executor(
                 None, self._log_feedback, query, result
             )
+            fut.add_done_callback(self._feedback_done)
         return web.json_response(result)
+
+    def _feedback_done(self, fut: "asyncio.Future") -> None:
+        if fut.cancelled():
+            self._dropped_feedback += 1
+            return
+        exc = fut.exception()
+        if exc is not None:
+            self._dropped_feedback += 1
+            log.error("feedback logging failed (dropped=%d): %s",
+                      self._dropped_feedback, exc)
 
     def _log_feedback(self, query: Any, result: Any) -> None:
         """Self-log the prediction as a "predict" event (reference:
-        CreateServer feedback loop → event server)."""
+        CreateServer feedback loop → event server). Raises on failure —
+        the done-callback owns logging and the dropped counter."""
         app_name = self.feedback_app_name
         if not app_name:
             return
-        try:
-            app = self.storage.get_meta_data_apps().get_by_name(app_name)
-            if app is None:
-                return
-            self.storage.get_l_events().insert(
-                Event(
-                    event="predict",
-                    entity_type="pio_pr",  # server-generated: prefix allowed internally
-                    entity_id=str(query.get("user", "")) if isinstance(query, dict) else "",
-                    properties=DataMap({"query": query, "result": result}),
-                ),
-                app.id,
-            )
-        except Exception:  # pragma: no cover
-            log.exception("feedback logging failed")
+        app = self.storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            return
+        self.storage.get_l_events().insert(
+            Event(
+                event="predict",
+                entity_type="pio_pr",  # server-generated: prefix allowed internally
+                entity_id=str(query.get("user", "")) if isinstance(query, dict) else "",
+                properties=DataMap({"query": query, "result": result}),
+            ),
+            app.id,
+        )
 
     # -- startup latency probe (reference: CreateServer hot path;
     # BASELINE.json north star #2 asks for a MEASURED full-path p50) ----
@@ -439,11 +501,24 @@ class EngineServer:
 
     async def handle_reload(self, request: web.Request) -> web.Response:
         """Hot-swap to the latest completed instance (reference: /reload →
-        MasterActor ! ReloadServer)."""
+        MasterActor ! ReloadServer). A failed reload NEVER takes down
+        serving: the last-good model stays live and the server enters
+        degraded mode (visible on /status and /readyz) until a reload
+        succeeds."""
         try:
             await asyncio.to_thread(self._load, None)
         except Exception as e:  # noqa: BLE001
-            return web.json_response({"message": str(e)}, status=500)
+            self._degraded_reason = (
+                f"reload failed at "
+                f"{_dt.datetime.now(_dt.timezone.utc).isoformat()}: {e}; "
+                "serving last-good model")
+            log.exception("reload failed; continuing on last-good model")
+            return web.json_response(
+                {"message": str(e), "degraded": True,
+                 "engineInstanceId":
+                     self.instance.id if self.instance else None},
+                status=500)
+        self._degraded_reason = None
         return web.json_response(
             {"message": "Reloaded", "engineInstanceId": self.instance.id}
         )

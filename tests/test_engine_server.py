@@ -189,6 +189,80 @@ def test_product_ranking_through_micro_batch_and_batch_predict(memory_storage):
     assert want[2]["isOriginal"] is True
 
 
+def test_healthz_readyz_and_degraded_reload(memory_storage):
+    """Liveness (/healthz) is unconditional; readiness (/readyz) means
+    model loaded + no open storage breaker; a failed /reload keeps the
+    last-good model serving and flips /status into degraded mode."""
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rec")
+    server = EngineServer(engine, engine_factory_name="rec",
+                          storage=memory_storage)
+    with ServerThread(server.app) as st:
+        assert requests.get(st.base + "/healthz").json() == {"status": "alive"}
+        r = requests.get(st.base + "/readyz")
+        assert r.status_code == 200
+        ready = r.json()
+        assert ready["ready"] is True and ready["modelLoaded"] is True
+        assert ready["openBreakers"] == []
+        status = requests.get(st.base + "/").json()
+        assert status["degraded"] is False
+        assert status["droppedFeedback"] == 0
+
+        # make the next reload fail: no COMPLETED instance left to load
+        insts = memory_storage.get_meta_data_engine_instances()
+        for inst in insts.get_all():
+            insts.delete(inst.id)
+        r = requests.get(st.base + "/reload")
+        assert r.status_code == 500
+        assert r.json()["degraded"] is True
+
+        # degraded, but the last-good model still serves
+        status = requests.get(st.base + "/").json()
+        assert status["degraded"] is True
+        assert "reload failed" in status["degradedReason"]
+        r = requests.post(st.base + "/queries.json",
+                          json={"user": "1", "num": 3})
+        assert r.status_code == 200 and r.json()["itemScores"]
+        # a loaded model with healthy storage is still READY (the
+        # degraded flag is telemetry, not a rotation signal)
+        assert requests.get(st.base + "/readyz").status_code == 200
+
+
+def test_feedback_write_failure_counts_dropped(memory_storage):
+    """The feedback self-log is async; a failing event store must not
+    fail the query, but the failure may not vanish either — it is
+    logged and counted on /status (droppedFeedback)."""
+    import time as _time
+
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rec")
+    server = EngineServer(engine, engine_factory_name="rec",
+                          storage=memory_storage, feedback=True,
+                          feedback_app_name="testapp")
+
+    class _DeadLEvents:
+        def insert(self, *a, **k):
+            raise RuntimeError("event store down")
+
+    memory_storage.get_l_events = lambda: _DeadLEvents()  # instance shadow
+    with ServerThread(server.app) as st:
+        r = requests.post(st.base + "/queries.json",
+                          json={"user": "1", "num": 2})
+        assert r.status_code == 200, r.text  # query unaffected
+        dropped = 0
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            dropped = requests.get(st.base + "/").json()["droppedFeedback"]
+            if dropped:
+                break
+            _time.sleep(0.05)
+        assert dropped >= 1
+
+
 def test_probe_latency_measures_and_persists(memory_storage):
     """pio deploy --probe-latency: the startup probe measures the
     full-path p50/p99 decomposition against the LIVE server and persists

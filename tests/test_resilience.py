@@ -15,6 +15,14 @@ import numpy as np
 import pytest
 import requests
 
+from incubator_predictionio_tpu.common import faultinject
+from incubator_predictionio_tpu.common.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudgetExceeded,
+    RetryPolicy,
+)
+
 from incubator_predictionio_tpu.controller import EngineParams
 from incubator_predictionio_tpu.data.storage import DataMap, Event, Storage
 from incubator_predictionio_tpu.models.recommendation import RecommendationEngine
@@ -167,3 +175,358 @@ def test_wire_backend_outage_raises_named_error(backend_env):
     msg = str(err.value).lower()
     assert ("unreachable" in msg or "refused" in msg or "connect" in msg
             or "errno" in msg), f"{btype}: {err.value}"
+
+
+# ---------------------------------------------------------------------------
+# Resilience layer: retries, breakers, deterministic fault injection
+# (common/resilience.py + common/faultinject.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fault_spec(monkeypatch):
+    """Install a PIO_FAULT_SPEC plan (re-armed counts) for one test."""
+    def install(spec: str) -> None:
+        monkeypatch.setenv("PIO_FAULT_SPEC", spec)
+        faultinject.reset()
+    yield install
+    monkeypatch.delenv("PIO_FAULT_SPEC", raising=False)
+    faultinject.reset()
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+@pytest.mark.chaos
+def test_retry_transient_then_success(fault_spec):
+    """Two injected transient failures, then the call goes through —
+    the caller sees only the success."""
+    fault_spec("unit.tr:fail:2")
+    calls = []
+    pol = RetryPolicy(max_attempts=4, base_delay=0.001, max_delay=0.002,
+                      deadline=5.0)
+
+    def op():
+        calls.append(1)
+        faultinject.fault_point("unit.tr")
+        return 42
+
+    assert pol.call(op) == 42
+    assert len(calls) == 3  # 2 injected failures + 1 success
+
+
+@pytest.mark.chaos
+def test_retry_deadline_budget_exhaustion(fault_spec):
+    """Persistent failure: the overall deadline budget caps total retry
+    time — the policy raises RetryBudgetExceeded instead of burning all
+    max_attempts."""
+    fault_spec("unit.dl:fail:1000")
+    pol = RetryPolicy(max_attempts=1000, base_delay=0.05, max_delay=0.05,
+                      deadline=0.15)
+    t0 = time.monotonic()
+    with pytest.raises(RetryBudgetExceeded):
+        pol.call(lambda: faultinject.fault_point("unit.dl"))
+    assert time.monotonic() - t0 < 2.0  # budget, not 1000 attempts
+
+
+@pytest.mark.chaos
+def test_breaker_open_half_open_reclose_cycle(fault_spec):
+    """closed → open (fail fast) → half-open probe fails → re-open →
+    half-open probe succeeds → closed, with transition counters."""
+    clock = _FakeClock()
+    br = CircuitBreaker("unit:endpoint", failure_threshold=2,
+                        reset_timeout=10.0, clock=clock)
+    pol = RetryPolicy(max_attempts=1, base_delay=0.0, deadline=5.0)
+    # 2 injected failures to trip it + 1 more for the failed probe
+    fault_spec("unit.br:fail:3")
+
+    def op():
+        faultinject.fault_point("unit.br")
+        return "ok"
+
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            pol.call(op, breaker=br)
+    assert br.state == "open"
+    with pytest.raises(CircuitOpenError) as ei:
+        pol.call(op, breaker=br)
+    assert ei.value.retry_after > 0
+    assert br.snapshot()["rejected"] == 1
+
+    clock.advance(10.0)  # reset timeout elapses → half-open probe slot
+    assert br.state == "half-open"
+    with pytest.raises(ConnectionError):  # probe eats the 3rd injected fault
+        pol.call(op, breaker=br)
+    assert br.state == "open"  # failed probe slams it shut again
+
+    clock.advance(10.0)
+    assert pol.call(op, breaker=br) == "ok"  # plan exhausted: probe succeeds
+    assert br.state == "closed"
+    snap = br.snapshot()
+    assert snap["opened"] == 2
+    assert snap["half_opened"] == 2
+    assert snap["closed"] == 1
+    assert snap["failure"] == 3
+
+
+def test_application_errors_do_not_trip_breaker():
+    """Only connectivity failures count against the circuit: a healthy
+    endpoint answering 404s (missing docs, polling for a model that is
+    not written yet) must never open the breaker."""
+    import io
+    import urllib.error
+
+    br = CircuitBreaker("unit:app-errors", failure_threshold=2,
+                        reset_timeout=10.0)
+    pol = RetryPolicy(max_attempts=3, base_delay=0.0, deadline=5.0)
+
+    def miss():
+        raise urllib.error.HTTPError("http://x", 404, "not found", {},
+                                     io.BytesIO(b""))
+
+    for _ in range(5):  # way past the threshold
+        with pytest.raises(urllib.error.HTTPError):
+            pol.call(miss, breaker=br)
+    snap = br.snapshot()
+    assert snap["state"] == "closed"
+    assert snap["opened"] == 0
+    assert snap["success"] == 5  # the endpoint answered every time
+
+
+def _http_topology(srv_port: int, *, fast: bool = True) -> dict:
+    env = {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "NET",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "NET",
+        "PIO_STORAGE_SOURCES_NET_TYPE": "HTTP",
+        "PIO_STORAGE_SOURCES_NET_HOSTS": "127.0.0.1",
+        "PIO_STORAGE_SOURCES_NET_PORTS": str(srv_port),
+    }
+    if fast:  # keep jittered backoff floors tiny — chaos tests stay fast
+        env.update({
+            "PIO_STORAGE_SOURCES_NET_RETRY_ATTEMPTS": "3",
+            "PIO_STORAGE_SOURCES_NET_RETRY_BASE": "0.01",
+            "PIO_STORAGE_SOURCES_NET_RETRY_MAX": "0.05",
+            "PIO_STORAGE_SOURCES_NET_RETRY_DEADLINE": "5",
+            "PIO_STORAGE_SOURCES_NET_BREAKER_THRESHOLD": "3",
+            "PIO_STORAGE_SOURCES_NET_BREAKER_RESET": "5",
+        })
+    return env
+
+
+def _seed_event_app(backing):
+    from incubator_predictionio_tpu.data.storage import AccessKey, App
+
+    app_id = backing.get_meta_data_apps().insert(App(0, "chaosapp"))
+    key = backing.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    backing.get_l_events().init(app_id)
+    return app_id, key
+
+
+@pytest.mark.chaos
+def test_two_transient_faults_retry_write_and_read_through(fault_spec):
+    """Acceptance: with PIO_FAULT_SPEC injecting 2 transient failures,
+    an event-server write (through the HTTP storage backend) and an
+    http_backend read BOTH succeed via retry — no caller-visible
+    error."""
+    from incubator_predictionio_tpu.data.api.event_server import EventServer
+    from incubator_predictionio_tpu.data.api.storage_server import build_app
+
+    backing = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        "PIO_STORAGE_SOURCES_S_TYPE": "MEMORY",
+    })
+    app_id, key = _seed_event_app(backing)
+    with ServerThread(build_app(backing)) as store_srv:
+        client_storage = Storage(_http_topology(store_srv.port))
+        es = EventServer(client_storage)
+        with ServerThread(es.app) as ev:
+            body = {"event": "buy", "entityType": "user", "entityId": "u1"}
+            # warm the access-key cache so the injected faults hit the
+            # event WRITE itself, not the auth lookup
+            r = requests.post(f"{ev.base}/events.json?accessKey={key}",
+                              json=body)
+            assert r.status_code == 201, r.text
+
+            fault_spec("http.call:fail:2")
+            r = requests.post(f"{ev.base}/events.json?accessKey={key}",
+                              json=body)
+            assert r.status_code == 201, r.text  # retried through 2 faults
+            event_id = r.json()["eventId"]
+
+            # read half: 2 fresh transient faults on the storage RPC path
+            fault_spec("http.call:fail:2")
+            got = client_storage.get_l_events().get(event_id, app_id)
+            assert got is not None and got.event == "buy"
+        # no fault counts left over to leak into other operations
+        assert client_storage.breaker_states()["NET"][0]["state"] == "closed"
+
+
+@pytest.mark.chaos
+def test_persistent_failure_opens_breaker_event_server_sheds_503(fault_spec):
+    """Acceptance: persistent storage failure trips the circuit breaker;
+    the event server sheds load with 503 + Retry-After instead of
+    burning a full retry cycle per request."""
+    from incubator_predictionio_tpu.data.api.event_server import EventServer
+    from incubator_predictionio_tpu.data.api.storage_server import build_app
+
+    backing = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        "PIO_STORAGE_SOURCES_S_TYPE": "MEMORY",
+    })
+    _app_id, key = _seed_event_app(backing)
+    with ServerThread(build_app(backing)) as store_srv:
+        client_storage = Storage(_http_topology(store_srv.port))
+        es = EventServer(client_storage)
+        with ServerThread(es.app) as ev:
+            body = {"event": "buy", "entityType": "user", "entityId": "u1"}
+            r = requests.post(f"{ev.base}/events.json?accessKey={key}",
+                              json=body)
+            assert r.status_code == 201, r.text  # healthy + auth cached
+
+            fault_spec("http.call:fail:100000")
+            saw_503 = None
+            for _ in range(8):
+                r = requests.post(f"{ev.base}/events.json?accessKey={key}",
+                                  json=body)
+                if r.status_code == 503:
+                    saw_503 = r
+                    break
+                assert r.status_code == 500  # retries exhausted, pre-trip
+            assert saw_503 is not None, "breaker never opened"
+            assert int(saw_503.headers["Retry-After"]) >= 1
+            assert "unavailable" in saw_503.json()["message"]
+            # breaker state is visible to operators via the registry
+            states = client_storage.breaker_states()["NET"]
+            assert states[0]["state"] == "open"
+            assert states[0]["opened"] >= 1
+            # shed accounting on the event server root status
+            assert requests.get(ev.base + "/").json()["shedRequests"] >= 1
+
+
+@pytest.mark.chaos
+def test_scan_stream_resumes_after_mid_stream_drop(fault_spec):
+    """A connection dropped mid-scan resumes from the last delivered
+    row instead of restarting: every event arrives exactly once."""
+    from incubator_predictionio_tpu.data.api.storage_server import build_app
+
+    backing = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        "PIO_STORAGE_SOURCES_S_TYPE": "MEMORY",
+    })
+    import datetime as dt
+
+    app_id, _key = _seed_event_app(backing)
+    t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+    backing.get_l_events().insert_batch(
+        [Event("view", "user", f"u{i}", None, None, DataMap({"i": i}),
+               t0 + dt.timedelta(seconds=i))
+         for i in range(25)],
+        app_id)
+    with ServerThread(build_app(backing)) as store_srv:
+        client_storage = Storage(_http_topology(store_srv.port))
+        # drop the FIRST scan stream after 10 rows
+        fault_spec("http.stream:drop:1:10")
+        events = list(client_storage.get_l_events().find(app_id))
+        ids = [e.properties.get("i") for e in events]
+        assert sorted(ids) == list(range(25))      # nothing lost
+        assert len(ids) == len(set(ids)) == 25     # nothing duplicated
+        assert ids == sorted(ids)                  # order preserved
+
+
+@pytest.mark.chaos
+def test_http_client_construction_survives_storage_bind_race():
+    """The deploy/storage startup race: constructing the HTTP client
+    while the storage server is still binding its port must succeed via
+    the bounded startup ping retry — and leave the breaker CLEAN (the
+    pre-service refusals must not count against it)."""
+    from incubator_predictionio_tpu.data.api.storage_server import build_app
+    from server_utils import free_port
+
+    backing = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "S",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "S",
+        "PIO_STORAGE_SOURCES_S_TYPE": "MEMORY",
+    })
+    _seed_event_app(backing)
+    port = free_port()
+    holder: dict = {}
+
+    def late_bind():
+        time.sleep(1.0)  # the window a simultaneous `pio deploy` loses
+        holder["srv"] = ServerThread(build_app(backing), port=port)
+        holder["srv"].__enter__()
+
+    th = threading.Thread(target=late_bind)
+    th.start()
+    try:
+        t0 = time.monotonic()
+        client = Storage(_http_topology(port))
+        apps = client.get_meta_data_apps().get_all()
+        assert time.monotonic() - t0 >= 0.9  # it genuinely waited
+        assert [a.name for a in apps] == ["chaosapp"]
+        snap = client.breaker_states()["NET"][0]
+        assert snap["state"] == "closed"
+        assert snap["consecutiveFailures"] == 0
+    finally:
+        th.join()
+        if "srv" in holder:
+            holder["srv"].__exit__(None, None, None)
+
+
+def test_no_raw_urlopen_outside_resilient_transport():
+    """Guard: every storage backend must reach HTTP through the
+    resilience layer (common.resilience.resilient_urlopen) or the
+    resilient _Transport — a future backend calling
+    urllib.request.urlopen directly would silently bypass retries,
+    breakers AND fault injection."""
+    import ast
+    import pathlib
+
+    import incubator_predictionio_tpu
+
+    storage_dir = (pathlib.Path(incubator_predictionio_tpu.__file__).parent
+                   / "data" / "storage")
+
+    def urlopen_calls(tree):
+        return [n.lineno for n in ast.walk(tree)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "urlopen"]
+
+    offenders = []
+    for path in sorted(storage_dir.glob("*.py")):
+        tree = ast.parse(path.read_text())
+        calls = urlopen_calls(tree)
+        if not calls:
+            continue
+        if path.name != "http_backend.py":
+            offenders.extend((path.name, ln) for ln in calls)
+            continue
+        # http_backend.py: urlopen is legal ONLY inside the resilient
+        # _Transport (whose every path applies policy/breaker/faults)
+        transport = next(
+            n for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef) and n.name == "_Transport")
+        allowed = set(urlopen_calls(transport))
+        offenders.extend(
+            (path.name, ln) for ln in calls if ln not in allowed)
+    assert not offenders, (
+        f"urllib.request.urlopen outside the resilience layer: {offenders}; "
+        "use incubator_predictionio_tpu.common.resilience.resilient_urlopen")
