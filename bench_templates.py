@@ -270,14 +270,26 @@ def run_decomposition() -> dict:
     weak #3 follow-through): the tunneled `pio train` wall time for
     classification/text is dominated by feeding the chip THROUGH THE
     SANDBOX TUNNEL, not by device compute.  This measures each stage
-    separately at the config-2 scale (2M x 4):
+    separately at the config-2 scale (default 2M x 4; override with
+    PIO_BENCH_DECOMP_SCALE="NxD"):
 
     - host featurize (bf16 cast + losslessness check),
     - upload (device_put + block) — tunnel-bandwidth bound here; a
       host-attached chip moves the same bytes at PCIe/DMA rates,
     - on-chip NB stats pass via the dispatch-amortized slope (one
       dispatch chains R dependent passes; RTT cancels in the slope,
-      the same protocol bench_query.py uses for predict).
+      the same protocol bench_query.py uses for predict),
+
+    then runs the REAL trainer both ways — single-shot vs the streaming
+    double-buffered input pipeline (workflow/input_pipeline) — and
+    reports the overlap-efficiency ratio:
+
+        overlap_efficiency = pipelined_end_to_end
+                             / max(featurize, upload, compute)
+
+    1.0 is perfect overlap (the pipeline is exactly as slow as its
+    slowest stage); the serial path's ratio is ~the sum/max of the
+    stages. ``pipeline_speedup`` is single-shot / pipelined end-to-end.
 
     Prints one JSON line; persisted as measured_<platform>_decomp_nb.
     """
@@ -285,6 +297,9 @@ def run_decomposition() -> dict:
     import jax.numpy as jnp
 
     n, d, c = 2_000_000, 4, 3
+    scale_env = os.environ.get("PIO_BENCH_DECOMP_SCALE")
+    if scale_env:
+        n, d = (int(v) for v in scale_env.lower().split("x"))
     rng = np.random.default_rng(1)
     centers = rng.random((c, d)) * 3 + 0.5
     y = rng.integers(0, c, n).astype(np.int32)
@@ -344,6 +359,33 @@ def run_decomposition() -> dict:
     # slope can come out <= 0 from timing noise at tiny on-chip cost;
     # publish null rather than a non-JSON Infinity token
     device_eps = round(n / slope_s, 1) if slope_s > 0 else None
+
+    # -- overlapped vs single-shot through the REAL trainer ------------
+    from incubator_predictionio_tpu.ops.linear import train_naive_bayes
+    from incubator_predictionio_tpu.workflow.input_pipeline import (
+        PipelineConfig, PipelineStats,
+    )
+
+    def timed_train(cfg):
+        # warm (second) run, like every bench here: steady-state wall
+        # with all executables compiled; fresh stats per run so the
+        # reported stage seconds are the warm run's alone
+        best = stats = None
+        for _ in range(2):
+            stats = PipelineStats()
+            t0 = time.perf_counter()
+            train_naive_bayes(x, y, c, pipeline=cfg, pipeline_stats=stats)
+            best = time.perf_counter() - t0
+        return best, stats
+
+    import dataclasses
+
+    single_s, _ = timed_train(PipelineConfig(mode="off"))
+    cfg_on = dataclasses.replace(PipelineConfig.from_env(), mode="on")
+    pipelined_s, pstats = timed_train(cfg_on)
+
+    compute_s = max(slope_s, 0.0)
+    max_stage = max(host_s, upload_s, compute_s)
     out = {
         "host_featurize_s": round(host_s, 4),
         "upload_s": round(upload_s, 4),
@@ -351,10 +393,25 @@ def run_decomposition() -> dict:
                            1),
         "onchip_pass_ms": round(slope_s * 1e3, 3),
         "device_only_events_per_sec": device_eps,
+        "single_shot_train_s": round(single_s, 4),
+        "pipelined_train_s": round(pipelined_s, 4),
+        "pipeline_chunks": pstats.n_chunks,
+        "pipeline_stage_s": {
+            "featurize": round(pstats.featurize_seconds, 4),
+            "upload_enqueue": round(pstats.upload_seconds, 4),
+            "consume_dispatch": round(pstats.consume_seconds, 4),
+        },
+        # end-to-end vs the slowest serial stage: 1.0 = perfect overlap
+        "overlap_efficiency": (round(pipelined_s / max_stage, 3)
+                               if max_stage > 0 else None),
+        "pipeline_speedup": (round(single_s / pipelined_s, 3)
+                             if pipelined_s > 0 else None),
+        "pipelined_events_per_sec": (round(n / pipelined_s, 1)
+                                     if pipelined_s > 0 else None),
         "scale": f"{n}x{d}",
     }
     print(json.dumps({
-        "metric": f"decomp classification NB 2000000x4 "
+        "metric": f"decomp classification NB {n}x{d} "
                   f"({jax.default_backend()})",
         "value": out["onchip_pass_ms"], "unit": "ms/on-chip-pass",
         "detail": out,

@@ -34,15 +34,19 @@ def _iter_arrays(obj, _depth: int = 0):
         return
     if _depth > 6:
         # Anything this walker WOULD traverse must raise, not silently
-        # pass as clean: arrays (incl. jax), containers, dataclasses.
-        if (isinstance(obj, (np.ndarray, dict, list, tuple))
+        # pass as clean: arrays (incl. jax), scalars, containers,
+        # dataclasses.
+        if (isinstance(obj, (np.ndarray, np.generic, dict, list, tuple))
                 or (dataclasses.is_dataclass(obj) and not isinstance(obj, type))
                 or (type(obj).__module__.startswith("jax")
                     and hasattr(obj, "dtype"))):
             raise _TooDeep
         return
-    if isinstance(obj, np.ndarray):
-        yield "", obj
+    if isinstance(obj, (np.ndarray, np.generic)):
+        # bare numpy scalars (np.float32(nan) etc.) check as 0-d arrays —
+        # a non-finite scalar in model state must be caught, not
+        # silently reported clean
+        yield "", np.asarray(obj)
         return
     # jax.Array without importing jax eagerly
     if type(obj).__module__.startswith("jax") and hasattr(obj, "dtype"):

@@ -78,17 +78,33 @@ class PEventStore:
         )
 
     @staticmethod
-    def find_batch(
+    def find_batches(
         app_name: str,
         event_names: Optional[Sequence[str]] = None,
         storage: Optional[Storage] = None,
+        chunk_size: int = 65536,
         **kwargs,
-    ) -> EventBatch:
-        """Columnar scan (the hot path for DataSources)."""
+    ) -> Iterator[EventBatch]:
+        """Chunked columnar scan: yields EventBatch slices of at most
+        ``chunk_size`` events in scan order. This is the batch iterator
+        the streaming input pipeline's featurize workers pull from
+        (workflow/input_pipeline.prefetch) — decode of chunk N+1
+        overlaps featurize/upload of chunk N instead of the whole scan
+        materializing first. Concatenating the chunks reproduces
+        find_batch exactly."""
         events = PEventStore.find(
             app_name, event_names=event_names, storage=storage, **kwargs
         )
+        step = max(1, int(chunk_size))
         ev, et, eid, tid, props, times = [], [], [], [], [], []
+
+        def flush() -> EventBatch:
+            return EventBatch(
+                event=ev, entity_type=et, entity_id=eid,
+                target_entity_id=tid, properties=props,
+                event_time_us=np.asarray(times, dtype=np.int64),
+            )
+
         for e in events:
             ev.append(e.event)
             et.append(e.entity_type)
@@ -98,10 +114,36 @@ class PEventStore:
             times.append(
                 int((e.event_time - _EPOCH).total_seconds() * 1_000_000)
             )
+            if len(ev) >= step:
+                yield flush()
+                ev, et, eid, tid, props, times = [], [], [], [], [], []
+        if ev:
+            yield flush()
+
+    @staticmethod
+    def find_batch(
+        app_name: str,
+        event_names: Optional[Sequence[str]] = None,
+        storage: Optional[Storage] = None,
+        **kwargs,
+    ) -> EventBatch:
+        """Columnar scan (the hot path for DataSources) — the
+        concatenation of find_batches."""
+        ev, et, eid, tid, props = [], [], [], [], []
+        times: list[np.ndarray] = []
+        for b in PEventStore.find_batches(
+                app_name, event_names=event_names, storage=storage, **kwargs):
+            ev += b.event
+            et += b.entity_type
+            eid += b.entity_id
+            tid += b.target_entity_id
+            props += b.properties
+            times.append(b.event_time_us)
         return EventBatch(
             event=ev, entity_type=et, entity_id=eid, target_entity_id=tid,
             properties=props,
-            event_time_us=np.asarray(times, dtype=np.int64),
+            event_time_us=(np.concatenate(times) if times
+                           else np.asarray([], dtype=np.int64)),
         )
 
     @staticmethod

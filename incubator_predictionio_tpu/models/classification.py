@@ -36,6 +36,7 @@ from ..ops.linear import (
     train_logistic_regression,
     train_naive_bayes,
 )
+from ..workflow.input_pipeline import pipeline_of as _pipeline_of
 
 
 @dataclasses.dataclass
@@ -168,6 +169,7 @@ class NaiveBayesAlgorithm(Algorithm):
             pd.features, pd.labels, n_classes=len(pd.label_values),
             smoothing=self.params.smoothing,
             mesh=ctx.get_mesh() if ctx else None,
+            pipeline=_pipeline_of(ctx),
         )
         return ClassifierModel(model, pd.attribute_names, pd.label_values)
 
@@ -210,6 +212,7 @@ class LogisticRegressionAlgorithm(Algorithm):
             pd.features, pd.labels, n_classes=len(pd.label_values),
             reg=self.params.reg, max_iters=self.params.max_iters,
             mesh=ctx.get_mesh() if ctx else None,
+            pipeline=_pipeline_of(ctx),
         )
         return ClassifierModel(model, pd.attribute_names, pd.label_values)
 

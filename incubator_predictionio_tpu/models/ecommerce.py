@@ -24,6 +24,7 @@ from ..data.store.l_event_store import LEventStore
 from ..data.store.p_event_store import PEventStore
 from ..data.storage.bimap import BiMap
 from ..ops.als import ALSFactors, ALSParams, train_als
+from ..workflow.input_pipeline import pipeline_of
 from ..ops.sharded_topk import (
     serving_mesh_for,
     sharded_top_k_items,
@@ -179,6 +180,7 @@ class ECommerceAlgorithm(Algorithm):
             resume=bool(ctx and ctx.workflow_params.resume),
             nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
             nan_guard_stage=getattr(ctx, "stage_label", "algorithm[als]"),
+            pipeline=pipeline_of(ctx),
         )
         model = ECommerceModel(
             factors=factors, users=pd.users, items=pd.items,

@@ -34,6 +34,7 @@ from ..controller import (
 from ..data.storage.bimap import BiMap
 from ..data.store.p_event_store import PEventStore
 from ..ops.als import ALSFactors, ALSParams, train_als
+from ..workflow.input_pipeline import pipeline_of
 from ..ops.sharded_topk import (
     serving_mesh_for,
     sharded_batch_top_k,
@@ -235,6 +236,7 @@ class ALSAlgorithm(Algorithm):
             # bench.py measures the real product path by planting a
             # timings dict on the context; absent in normal training.
             timings=getattr(ctx, "bench_timings", None),
+            pipeline=pipeline_of(ctx),
         )
         model = ALSModel(factors=factors, users=pd.users, items=pd.items)
         model.serving_mesh = serving_mesh_for(

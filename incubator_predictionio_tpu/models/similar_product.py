@@ -25,6 +25,7 @@ from ..controller import Algorithm, DataSource, Engine, EngineFactory, Params, S
 from ..data.storage.bimap import BiMap
 from ..data.store.p_event_store import PEventStore
 from ..ops.als import ALSFactors, ALSParams, train_als
+from ..workflow.input_pipeline import pipeline_of
 from ..ops.sharded_topk import (
     serving_mesh_for,
     sharded_similar_items,
@@ -182,6 +183,7 @@ class SimilarProductAlgorithm(Algorithm):
             resume=bool(ctx and ctx.workflow_params.resume),
             nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
             nan_guard_stage=getattr(ctx, "stage_label", "algorithm[als]"),
+            pipeline=pipeline_of(ctx),
         )
         model = SimilarProductModel(factors, pd.items, pd.item_categories)
         model.serving_mesh = serving_mesh_for(

@@ -26,6 +26,7 @@ from ..ops.linear import (
     train_naive_bayes_coo,
 )
 from ..ops.tfidf import TfIdfVectorizer
+from ..workflow.input_pipeline import pipeline_of as _pipeline_of
 
 
 @dataclasses.dataclass
@@ -53,13 +54,27 @@ class PreparedData:
     #: it (device segment-sum; the dense matrix never exists) and the
     #: LR path densifies on demand via dense_tf().
     coo: Optional[tuple] = None
+    #: Streaming mode (workflow/input_pipeline): the preparator DEFERS
+    #: featurization — coo is None and the raw corpus rides along so the
+    #: NB trainer can overlap tokenize/upload/scatter chunk-by-chunk
+    #: (TextNBAlgorithm.train). Non-streaming consumers (LR, dense_tf)
+    #: fall back to a one-shot fit of the same vectorizer.
+    texts: Optional[list] = None
+
+    def ensure_coo(self):
+        """Materialize the one-shot COO from a deferred (streaming)
+        preparation — the fallback for consumers that need every doc's
+        rows at once."""
+        if self.coo is None and self.texts is not None:
+            self.coo = self.vectorizer.fit_tf_coo(self.texts)
+        return self.coo
 
     def dense_tf(self) -> np.ndarray:
         """Materialize the raw-tf matrix from the COO (LR needs the
         full per-doc rows; NB never calls this)."""
         if self.features is not None:
             return self.features
-        doc_ptr, feat, cnt = self.coo
+        doc_ptr, feat, cnt = self.ensure_coo()
         n, d = len(doc_ptr) - 1, self.vectorizer.n_features
         x = np.zeros((n, d), np.float32)
         rows = np.repeat(np.arange(n), np.diff(np.asarray(doc_ptr)))
@@ -82,18 +97,20 @@ class TextDataSource(DataSource):
 
     def read_training(self, ctx) -> TrainingData:
         p: DataSourceParams = self.params
-        batch = PEventStore.find_batch(
-            p.app_name or ctx.app_name,
-            event_names=list(p.event_names),
-            entity_type=p.entity_type,
-            storage=ctx.get_storage(),
-            channel_name=ctx.channel_name,
-        )
         texts, labels = [], []
-        for props in batch.properties:
-            if p.text_property in props and p.label_property in props:
-                texts.append(str(props[p.text_property]))
-                labels.append(props[p.label_property])
+        # chunked scan: only one chunk's Event objects are ever live
+        # alongside the extracted text/label columns
+        for batch in PEventStore.find_batches(
+                p.app_name or ctx.app_name,
+                event_names=list(p.event_names),
+                entity_type=p.entity_type,
+                storage=ctx.get_storage(),
+                channel_name=ctx.channel_name,
+        ):
+            for props in batch.properties:
+                if p.text_property in props and p.label_property in props:
+                    texts.append(str(props[p.text_property]))
+                    labels.append(props[p.label_property])
         label_values, y = np.unique(np.asarray(labels), return_inverse=True)
         return TrainingData(texts, y.astype(np.int32), label_values)
 
@@ -136,6 +153,16 @@ class TextPreparator:
         vec = TfIdfVectorizer(
             n_features=self.params.n_features, ngram=self.params.ngram
         )
+        cfg = _pipeline_of(ctx)
+        if cfg is not None and cfg.enabled_for(len(td.texts),
+                                               chunk=cfg.chunk_docs):
+            # Defer featurization into the training stream: tokenizing
+            # here would serialize the dominant host cost of this
+            # template in front of upload + compute (the exact stall the
+            # input pipeline exists to remove).
+            return PreparedData(None, td.labels, td.label_values, vec,
+                                features_are_tf=True, coo=None,
+                                texts=list(td.texts))
         coo = vec.fit_tf_coo(td.texts)
         return PreparedData(None, td.labels, td.label_values, vec,
                             features_are_tf=True, coo=coo)
@@ -179,6 +206,11 @@ class TextNBAlgorithm(Algorithm):
         if pd.coo is not None:
             doc_ptr, feat, cnt = pd.coo
             nbytes = feat.nbytes + cnt.nbytes + doc_ptr.nbytes
+        elif pd.texts is not None:
+            # deferred (streaming) featurize: the COO doesn't exist yet;
+            # the corpus byte count is the right order-of-magnitude
+            # proxy (~1 COO entry per ~6 chars of text)
+            nbytes = sum(len(t) for t in pd.texts)
         else:
             nbytes = pd.features.nbytes
         return StageModel(bytes_to_device=nbytes, device_passes=1.0,
@@ -187,22 +219,69 @@ class TextNBAlgorithm(Algorithm):
     def train(self, ctx, pd: PreparedData) -> TextModel:
         mesh = ctx.get_mesh() if ctx else None
         scale = pd.vectorizer.idf if pd.features_are_tf else None
-        if pd.coo is not None:
+        cfg = _pipeline_of(ctx)
+        if pd.coo is None and pd.texts is not None:
+            inner = self._train_streamed(pd, mesh, cfg)
+        elif pd.coo is not None:
             doc_ptr, feat, cnt = pd.coo
             inner = train_naive_bayes_coo(
                 doc_ptr, feat, cnt, pd.labels,
                 n_classes=len(pd.label_values),
                 n_features=pd.vectorizer.n_features,
                 smoothing=self.params.smoothing,
-                mesh=mesh, col_scale=scale,
+                mesh=mesh, col_scale=scale, pipeline=cfg,
             )
         else:
             inner = train_naive_bayes(
                 pd.features, pd.labels, len(pd.label_values),
                 smoothing=self.params.smoothing,
-                mesh=mesh, col_scale=scale,
+                mesh=mesh, col_scale=scale, pipeline=cfg,
             )
         return TextModel(inner, pd.vectorizer, pd.label_values)
+
+    def _train_streamed(self, pd: PreparedData, mesh, cfg) -> NaiveBayesModel:
+        """Fully overlapped text path: tokenizer workers featurize doc
+        chunk N+2 while chunk N+1 uploads and chunk N scatter-adds into
+        the device stats. Produces the same model as the one-shot
+        prepare+train (same integer additions; the idf column scale is
+        finalized from the accumulated dfs after the last chunk)."""
+        from ..workflow.input_pipeline import (
+            PipelineConfig, chunk_ranges, prefetch,
+        )
+        from ..ops.linear import train_naive_bayes_coo_stream
+
+        cfg = cfg or PipelineConfig.from_env()
+        vec = pd.vectorizer
+        texts, labels = pd.texts, pd.labels
+        n_docs = len(texts)
+        df_acc = np.zeros(vec.n_features, np.int64)
+
+        def featurize(rng):
+            s, e = rng
+            doc_ptr, feat, cnt, df = vec.tf_coo_block(texts[s:e])
+            cls = np.repeat(labels[s:e], np.diff(np.asarray(doc_ptr)))
+            return cls, feat, cnt, df
+
+        def blocks():
+            # df accumulates on the CONSUMER side in arrival (=corpus)
+            # order; int64 sums are exact so order is moot, but keeping
+            # mutation out of the worker threads keeps them pure
+            for cls, feat, cnt, df in prefetch(
+                    chunk_ranges(n_docs, cfg.chunk_docs), featurize,
+                    workers=cfg.workers, lookahead=cfg.depth + 1):
+                np.add(df_acc, df, out=df_acc)
+                yield cls, feat, cnt
+
+        def idf_scale():
+            return vec.set_idf_from_df(df_acc, n_docs)
+
+        return train_naive_bayes_coo_stream(
+            blocks(), labels, n_classes=len(pd.label_values),
+            n_features=vec.n_features, smoothing=self.params.smoothing,
+            mesh=mesh,
+            col_scale=idf_scale if pd.features_are_tf else None,
+            pipeline=cfg,
+        )
 
     def predict(self, model: TextModel, query: dict) -> dict:
         category, confidence = model.classify(str(query["text"]))
@@ -233,6 +312,7 @@ class TextLRAlgorithm(TextNBAlgorithm):
             features, pd.labels, len(pd.label_values),
             reg=self.params.reg, max_iters=self.params.max_iters,
             mesh=ctx.get_mesh() if ctx else None,
+            pipeline=_pipeline_of(ctx),
         )
         return TextModel(inner, pd.vectorizer, pd.label_values)
 

@@ -26,7 +26,7 @@ from typing import Optional
 
 import numpy as np
 
-_EXPECTED_VERSION = 17
+_EXPECTED_VERSION = 18
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

@@ -55,7 +55,8 @@ from ..common.jax_compat import shard_map
 
 from .pallas_kernels import batched_spd_solve
 from .rowblocks import (
-    BucketArrays, LayoutPlan, fill_buckets, ladder_growth, plan_layout,
+    BucketArrays, LayoutPlan, fill_buckets, ladder_growth, plan_and_fill_both,
+    plan_layout,
 )
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, default_mesh, fast_put
 
@@ -732,6 +733,7 @@ def train_als(
     timings: Optional[dict] = None,
     nan_guard: bool = False,
     nan_guard_stage: str = "algorithm[als]",
+    pipeline=None,
 ) -> ALSFactors:
     """Train explicit/implicit ALS from a COO rating triple.
 
@@ -764,18 +766,19 @@ def train_als(
             params,
             binary_ratings=bool(np.all(np.asarray(rating) == 1.0)))
 
-    counts_u = np.bincount(np.asarray(user_idx, np.int64), minlength=n_users)
-    counts_i = np.bincount(np.asarray(item_idx, np.int64), minlength=n_items)
-    plan_u = plan_layout(counts_u, d_size, m_div=m_size)
-    plan_i = plan_layout(counts_i, d_size, m_div=m_size)
-    arrs_u = fill_buckets(plan_u, user_idx, item_idx, rating,
-                          col_slot_map=plan_i.slot_of_row,
-                          sentinel=plan_i.total_slots,
-                          fill_vals=not params.binary_ratings)
-    arrs_i = fill_buckets(plan_i, item_idx, user_idx, rating,
-                          col_slot_map=plan_u.slot_of_row,
-                          sentinel=plan_u.total_slots,
-                          fill_vals=not params.binary_ratings)
+    # Both sides' layout prep overlapped on input-pipeline worker
+    # threads (rowblocks.plan_and_fill_both) — the host scatters are the
+    # serial front of every ALS train and their GIL-releasing cores run
+    # genuinely concurrent. ``pipeline`` (workflow ctx config, else env)
+    # turns the overlap off with the rest of the streaming layer.
+    if pipeline is None:
+        from ..workflow.input_pipeline import PipelineConfig
+
+        pipeline = PipelineConfig.from_env()
+    plan_u, plan_i, arrs_u, arrs_i = plan_and_fill_both(
+        user_idx, item_idx, rating, n_users, n_items, d_size,
+        m_div=m_size, fill_vals=not params.binary_ratings,
+        parallel=pipeline.mode != "off")
 
     k = params.rank
     x_shape = (plan_u.total_slots, k)

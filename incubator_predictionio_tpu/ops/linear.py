@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional
+from typing import Iterable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +30,27 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS, default_mesh, fast_put, pad_rows
+from ..workflow.input_pipeline import (
+    PipelineConfig, PipelineStats, chunk_ranges, prefetch, run_pipeline,
+)
+
+
+def _narrow_wire(x: np.ndarray, on_tpu: bool):
+    """Narrowest LOSSLESS wire dtype for a feature block: small nonneg
+    integer counts fit uint8 (a quarter of the f32 bytes); anything
+    bf16-exact still halves them. Only on an accelerator — there is no
+    transfer to shrink on the CPU backend, just cast overhead. The
+    device side widens back to f32 BEFORE any math, so results are
+    bit-identical to an f32 upload."""
+    if not on_tpu:
+        return x
+    x_int = x.astype(np.uint8)
+    if np.array_equal(x_int.astype(np.float32), x):
+        return x_int
+    xb = x.astype(jnp.bfloat16)
+    if np.array_equal(xb.astype(np.float32), x):
+        return xb
+    return x
 
 
 # ---------------------------------------------------------------------------
@@ -47,8 +68,7 @@ class NaiveBayesModel:
         return x @ self.log_likelihood.T + self.log_prior  # [B, C]
 
 
-@functools.partial(jax.jit, static_argnames=("n_classes",))
-def _nb_stats(x, y, w, n_classes: int):
+def _nb_stats_body(x, y, w, n_classes: int):
     # x may arrive bfloat16 or uint8 (lossless narrow uploads, see
     # train_naive_bayes); integer wire dtypes widen to bf16 here so the
     # one-hot einsum feeds the MXU natively, accumulating in float32
@@ -62,6 +82,70 @@ def _nb_stats(x, y, w, n_classes: int):
     return feat, counts
 
 
+_nb_stats = functools.partial(jax.jit, static_argnames=("n_classes",))(
+    _nb_stats_body)
+
+
+@functools.partial(jax.jit, static_argnames=("n_classes",),
+                   donate_argnums=(0, 1))
+def _nb_stats_acc(feat_acc, counts_acc, x, y, w, n_classes: int):
+    """One streamed chunk folded into the running [C,D]/[C] stats.
+    Accumulators are donated so the ring's steady-state HBM is the
+    in-flight chunks plus ONE accumulator. Zero-weight pad rows add
+    exact zeros; with count-valued features every partial sum is an
+    integer exactly representable in f32, so the chunked reduction
+    matches the single-shot einsum bit-for-bit."""
+    feat, counts = _nb_stats_body(x, y, w, n_classes)
+    # third output: a tiny NON-donated per-chunk value — the ring blocks
+    # on it as its completion token (the accumulators themselves are
+    # donated into the NEXT step before the ring ever waits on them)
+    return feat_acc + feat, counts_acc + counts, counts
+
+
+def _stream_nb_dense(x, y, n_classes, mesh, on_tpu,
+                     cfg: PipelineConfig, stats: Optional[PipelineStats]):
+    """Double-buffered featurize→upload→accumulate over row chunks.
+    Returns host (feat [C,D], counts [C]) identical to the single-shot
+    path (see _nb_stats_acc exactness note)."""
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    n = x.shape[0]
+    # fixed chunk geometry: one compiled program per wire dtype
+    step = max(n_dev, -(-min(cfg.chunk_rows, n) // n_dev) * n_dev)
+    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+
+    def featurize(rng):
+        s, e = rng
+        # per-chunk narrowing: each chunk ships its own narrowest
+        # lossless dtype (a late non-uint8 chunk costs one extra
+        # compile, never correctness)
+        xc = pad_rows(_narrow_wire(x[s:e], on_tpu), step)
+        yc = pad_rows(y[s:e], step)
+        wc = pad_rows(np.ones(e - s, np.float32), step)  # pad w=0: no-op rows
+        return xc, yc, wc
+
+    def upload(chunk):
+        xc, yc, wc = chunk
+        return (fast_put(xc, shard2), fast_put(yc, shard1),
+                fast_put(wc, shard1))
+
+    acc = (jnp.zeros((n_classes, x.shape[1]), jnp.float32),
+           jnp.zeros((n_classes,), jnp.float32))
+
+    def consume(dev):
+        nonlocal acc
+        feat_acc, counts_acc, ready = _nb_stats_acc(
+            acc[0], acc[1], *dev, n_classes)
+        acc = (feat_acc, counts_acc)
+        return ready
+
+    chunks = prefetch(chunk_ranges(n, step), featurize,
+                      workers=cfg.workers, lookahead=cfg.depth + 1,
+                      stats=stats)
+    run_pipeline(chunks, upload, consume, depth=cfg.depth, stats=stats)
+    return jax.device_get(acc)
+
+
 def train_naive_bayes(
     x: np.ndarray,
     y: np.ndarray,
@@ -69,6 +153,8 @@ def train_naive_bayes(
     smoothing: float = 1.0,
     mesh: Optional[Mesh] = None,
     col_scale: Optional[np.ndarray] = None,
+    pipeline: Optional[PipelineConfig] = None,
+    pipeline_stats: Optional[PipelineStats] = None,
 ) -> NaiveBayesModel:
     """x [N,D] nonneg features, y [N] int labels. Mesh-sharded stats.
 
@@ -76,38 +162,38 @@ def train_naive_bayes(
     CLASS STATS instead of the examples — mathematically the same as
     training on ``x * col_scale`` (the scale commutes with the row
     reduction) without ever materializing that [N,D] product.
+
+    ``pipeline`` (default: env via PipelineConfig.from_env): when the
+    input is large enough, the narrowing cast, host→device upload, and
+    on-device stats pass run as an overlapped chunk stream
+    (workflow/input_pipeline) instead of three serial full-data phases;
+    ``mode='off'`` pins the single-shot path. With count-valued
+    features (the multinomial NB domain) the two paths are bit-identical
+    (exact f32 integer partial sums).
     """
     mesh = mesh or default_mesh()
     n_dev = int(np.prod(list(mesh.shape.values())))
     x = np.asarray(x, np.float32)
     y = np.asarray(y, np.int32)
-    # Halve the host->device bytes when it costs nothing: attribute
-    # matrices are typically small counts/ratings that round-trip
-    # bfloat16 exactly. Only on an accelerator (there is no transfer to
-    # shrink on the CPU backend, just cast overhead — same gate as
-    # als.py's compute_dtype "auto"), and only when every value is
-    # exactly representable; the stats einsum accumulates in float32
-    # regardless.
-    if mesh.devices.flat[0].platform == "tpu":
-        # Narrowest lossless wire dtype, widened on device by _nb_stats:
-        # small nonneg integer counts (the multinomial NB domain) fit
-        # uint8 — a QUARTER of the f32 bytes; anything bf16-exact still
-        # halves them.
-        x_int = x.astype(np.uint8)
-        if np.array_equal(x_int.astype(np.float32), x):
-            x = x_int
-        else:
-            xb = x.astype(jnp.bfloat16)
-            if np.array_equal(xb.astype(np.float32), x):
-                x = xb
-    w = np.ones(x.shape[0], np.float32)
-    xp, yp, wp = pad_rows(x, n_dev), pad_rows(y, n_dev), pad_rows(w, n_dev)
-    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
-    shard1 = NamedSharding(mesh, P(DATA_AXIS))
-    xp = fast_put(xp, shard2)
-    yp = fast_put(yp, shard1)
-    wp = fast_put(wp, shard1)
-    feat, counts = jax.device_get(_nb_stats(xp, yp, wp, n_classes))
+    on_tpu = mesh.devices.flat[0].platform == "tpu"
+    cfg = pipeline or PipelineConfig.from_env()
+    if cfg.enabled_for(x.shape[0]):
+        feat, counts = _stream_nb_dense(x, y, n_classes, mesh, on_tpu,
+                                        cfg, pipeline_stats)
+    else:
+        # Single-shot fallback: narrow the whole matrix (halve/quarter
+        # the host->device bytes when it costs nothing — only on an
+        # accelerator, same gate as als.py's compute_dtype "auto"), one
+        # put per operand, one stats dispatch.
+        x = _narrow_wire(x, on_tpu)
+        w = np.ones(x.shape[0], np.float32)
+        xp, yp, wp = pad_rows(x, n_dev), pad_rows(y, n_dev), pad_rows(w, n_dev)
+        shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+        shard1 = NamedSharding(mesh, P(DATA_AXIS))
+        xp = fast_put(xp, shard2)
+        yp = fast_put(yp, shard1)
+        wp = fast_put(wp, shard1)
+        feat, counts = jax.device_get(_nb_stats(xp, yp, wp, n_classes))
     if col_scale is not None:
         feat = feat * np.asarray(col_scale, np.float32)
 
@@ -134,6 +220,75 @@ def _nb_stats_coo(cls_idx, feat_idx, counts, n_classes: int,
     return feat.reshape(n_classes, n_features)
 
 
+@functools.partial(jax.jit, static_argnames=("n_features",),
+                   donate_argnums=(0,))
+def _nb_stats_coo_acc(acc_flat, cls_idx, feat_idx, counts, n_features: int):
+    """One streamed COO entry chunk scatter-added into the running flat
+    [C*D] stats (donated). Pad entries carry count 0 — adding +0.0 at
+    bucket 0 is an exact no-op — and per-doc term counts are integers,
+    so the chunked scatter matches the single-shot one bit-for-bit."""
+    idx = cls_idx.astype(jnp.int32) * n_features + feat_idx.astype(jnp.int32)
+    new_acc = acc_flat.at[idx].add(counts.astype(jnp.float32))
+    # second output: non-donated completion token for the ring (see
+    # _nb_stats_acc)
+    return new_acc, counts.astype(jnp.float32).sum()
+
+
+def _narrow_coo_chunk(cls_e, feat_e, cnt_e, n_classes: int, n_features: int):
+    """Lossless narrow wire dtypes for one COO entry chunk (widened on
+    device): feature ids uint16 when D fits, class ids uint8 when C
+    fits, counts uint16 when every count does."""
+    if n_features <= np.iinfo(np.uint16).max + 1:
+        feat_e = feat_e.astype(np.uint16)
+    if n_classes <= np.iinfo(np.uint8).max + 1:
+        cls_e = cls_e.astype(np.uint8)
+    if cnt_e.size and float(cnt_e.max()) <= np.iinfo(np.uint16).max \
+            and np.array_equal(cnt_e.astype(np.uint16), cnt_e):
+        cnt_e = cnt_e.astype(np.uint16)
+    return cls_e, feat_e, cnt_e
+
+
+def rebatch_entries(chunks: Iterable[tuple], chunk_entries: int):
+    """Re-chunk a ragged stream of (cls, feat, counts) COO entry blocks
+    into FIXED-size entry chunks (the last one short) so the device
+    consumer compiles one program instead of one per ragged shape.
+    Pure host-side carry logic on the consumer thread; entry order is
+    preserved exactly."""
+    step = max(1, int(chunk_entries))
+    carry: list[tuple] = []
+    held = 0
+
+    def drain(parts, take):
+        out, rest, got = [], [], 0
+        for p in parts:
+            n = len(p[0])
+            if got + n <= take:
+                out.append(p)
+                got += n
+            else:
+                k = take - got
+                if k > 0:
+                    out.append(tuple(a[:k] for a in p))
+                    rest.append(tuple(a[k:] for a in p))
+                    got = take
+                else:
+                    rest.append(p)
+        cat = tuple(np.concatenate([p[j] for p in out])
+                    if len(out) != 1 else out[0][j] for j in range(3))
+        return cat, rest
+
+    for block in chunks:
+        carry.append(block)
+        held += len(block[0])
+        while held >= step:
+            full, carry = drain(carry, step)
+            held -= step
+            yield full
+    if held:
+        last, carry = drain(carry, held)
+        yield last
+
+
 def train_naive_bayes_coo(
     doc_ptr: np.ndarray,
     feat_idx: np.ndarray,
@@ -144,6 +299,8 @@ def train_naive_bayes_coo(
     smoothing: float = 1.0,
     mesh: Optional[Mesh] = None,
     col_scale: Optional[np.ndarray] = None,
+    pipeline: Optional[PipelineConfig] = None,
+    pipeline_stats: Optional[PipelineStats] = None,
 ) -> NaiveBayesModel:
     """NB from the tokenizer's COO output (ops/tfidf.fit_tf_coo): the
     dense [N, D] matrix never exists — only the ~150 distinct buckets
@@ -157,6 +314,11 @@ def train_naive_bayes_coo(
     Uploads narrow where lossless: feature ids as uint16 when D fits,
     class ids as uint8 when C fits, counts as uint16 when all counts do
     (per-doc term frequencies overwhelmingly fit).
+
+    ``pipeline``: when the entry stream is large enough, upload and
+    scatter-add run as an overlapped fixed-size chunk stream (see
+    train_naive_bayes_coo_stream, which additionally overlaps the
+    tokenizer itself when fed from a chunked corpus).
     """
     mesh = mesh or default_mesh()
     n_dev = int(np.prod(list(mesh.shape.values())))
@@ -165,18 +327,20 @@ def train_naive_bayes_coo(
     feat_idx = np.asarray(feat_idx)
     counts = np.asarray(counts, np.float32)
 
-    # lossless narrow uploads (widened on device by _nb_stats_coo)
-    if n_features <= np.iinfo(np.uint16).max + 1:
-        feat_idx = feat_idx.astype(np.uint16)
-    if n_classes <= np.iinfo(np.uint8).max + 1:
-        cls_per_entry = cls_per_entry.astype(np.uint8)
-    cnt_up = counts
-    if counts.size and float(counts.max()) <= np.iinfo(np.uint16).max \
-            and np.array_equal(counts.astype(np.uint16), counts):
-        cnt_up = counts.astype(np.uint16)
+    cfg = pipeline or PipelineConfig.from_env()
+    if cfg.enabled_for(len(feat_idx)):
+        return train_naive_bayes_coo_stream(
+            iter([(cls_per_entry, feat_idx, counts)]), y, n_classes,
+            n_features, smoothing=smoothing, mesh=mesh, col_scale=col_scale,
+            pipeline=cfg, pipeline_stats=pipeline_stats,
+        )
 
-    cp = pad_rows(cls_per_entry, n_dev)
-    fp = pad_rows(feat_idx, n_dev)
+    # lossless narrow uploads (widened on device by _nb_stats_coo)
+    cls_up, feat_up, cnt_up = _narrow_coo_chunk(
+        cls_per_entry, feat_idx, counts, n_classes, n_features)
+
+    cp = pad_rows(cls_up, n_dev)
+    fp = pad_rows(feat_up, n_dev)
     wp = pad_rows(cnt_up, n_dev)      # pad counts are 0: contribute nothing
     shard1 = NamedSharding(mesh, P(DATA_AXIS))
     cp = fast_put(cp, shard1)
@@ -184,9 +348,12 @@ def train_naive_bayes_coo(
     wp = fast_put(wp, shard1)
     feat = np.asarray(jax.device_get(
         _nb_stats_coo(cp, fp, wp, n_classes, n_features)))
+    return _nb_model_from_stats(feat, y, n_classes, smoothing, col_scale)
+
+
+def _nb_model_from_stats(feat, y, n_classes, smoothing, col_scale):
     if col_scale is not None:
         feat = feat * np.asarray(col_scale, np.float32)
-
     class_counts = np.bincount(y, minlength=n_classes).astype(np.float64)
     total = class_counts.sum()
     log_prior = np.log((class_counts + 1e-12) / max(total, 1e-12))
@@ -197,6 +364,59 @@ def train_naive_bayes_coo(
         log_likelihood=log_likelihood.astype(np.float32),
         n_classes=n_classes,
     )
+
+
+def train_naive_bayes_coo_stream(
+    entry_blocks: Iterable[tuple],
+    y: np.ndarray,
+    n_classes: int,
+    n_features: int,
+    smoothing: float = 1.0,
+    mesh: Optional[Mesh] = None,
+    col_scale=None,
+    pipeline: Optional[PipelineConfig] = None,
+    pipeline_stats: Optional[PipelineStats] = None,
+) -> NaiveBayesModel:
+    """NB from a STREAM of COO entry blocks — the fully overlapped text
+    path: tokenizer workers (prefetch over doc chunks) feed ragged
+    (cls, feat, counts) blocks, which are rebatched into fixed-size
+    entry chunks, uploaded narrow, and scatter-added into the running
+    device stats while the next chunk tokenizes. Bit-identical to
+    train_naive_bayes_coo on the concatenated stream (same integer
+    additions, different association order — exact in f32).
+
+    ``col_scale`` may be a ZERO-ARG CALLABLE evaluated after the stream
+    is exhausted: TF-IDF's idf only exists once the last chunk's
+    document frequencies are counted.
+    """
+    mesh = mesh or default_mesh()
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    y = np.asarray(y, np.int32)
+    cfg = pipeline or PipelineConfig.from_env()
+    step = max(n_dev, -(-cfg.chunk_rows // n_dev) * n_dev)
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+
+    def upload(chunk):
+        cls_e, feat_e, cnt_e = _narrow_coo_chunk(
+            np.asarray(chunk[0]), np.asarray(chunk[1]),
+            np.asarray(chunk[2], np.float32), n_classes, n_features)
+        return (fast_put(pad_rows(cls_e, step), shard1),
+                fast_put(pad_rows(feat_e, step), shard1),
+                fast_put(pad_rows(cnt_e, step), shard1))
+
+    acc = jnp.zeros((n_classes * n_features,), jnp.float32)
+
+    def consume(dev):
+        nonlocal acc
+        acc, ready = _nb_stats_coo_acc(acc, *dev, n_features)
+        return ready
+
+    run_pipeline(rebatch_entries(entry_blocks, step), upload, consume,
+                 depth=cfg.depth, stats=pipeline_stats)
+    feat = np.asarray(jax.device_get(acc)).reshape(n_classes, n_features)
+    if callable(col_scale):
+        col_scale = col_scale()
+    return _nb_model_from_stats(feat, y, n_classes, smoothing, col_scale)
 
 
 # ---------------------------------------------------------------------------
@@ -265,7 +485,12 @@ def _lr_fit(xp, yp, maskp, n, reg, tol, max_iters, n_classes: int):
             grad, state, params, value=value, grad=grad, value_fn=loss_fn
         )
         params = optax.apply_updates(params, updates)
-        gnorm = optax.tree.norm(grad)
+        # optax.tree.norm is the 0.2.4+ spelling; older optax (this
+        # container ships 0.2.3) has the same function as
+        # tree_utils.tree_l2_norm
+        tree_ns = getattr(optax, "tree", None)
+        gnorm = (tree_ns.norm(grad) if tree_ns is not None
+                 else optax.tree_utils.tree_l2_norm(grad))
         done = (jnp.abs(prev - value)
                 < tol * jnp.maximum(1.0, jnp.abs(prev))) & (gnorm < 1e-4)
         return it + 1, params, state, value, done
@@ -281,6 +506,57 @@ def _lr_fit(xp, yp, maskp, n, reg, tol, max_iters, n_classes: int):
     return carry[1]
 
 
+@functools.lru_cache(maxsize=8)
+def _cached_concat_widen(n_chunks: int, sharding):
+    """jit'd on-device assembly of the full row-sharded f32 matrix from
+    the streamed chunks (module-cached so warm trains reuse the
+    executable). Chunks are donated — XLA reclaims their HBM into the
+    result instead of holding both."""
+    def cat(*chunks):
+        return jnp.concatenate([c.astype(jnp.float32) for c in chunks],
+                               axis=0)
+
+    # CPU can't alias into a concatenate — donating there only emits a
+    # "donated buffers were not usable" warning per call
+    donate = (tuple(range(n_chunks))
+              if jax.default_backend() != "cpu" else ())
+    return jax.jit(cat, out_shardings=sharding, donate_argnums=donate)
+
+
+def _stream_lr_upload(x, mesh, on_tpu, cfg: PipelineConfig,
+                      stats: Optional[PipelineStats]):
+    """Overlapped narrow-cast + upload of the LR feature matrix: workers
+    cast chunk N+1 to its narrowest lossless wire dtype while chunk N
+    uploads; the sharded full array is then assembled on device. Row
+    content (incl. zero pad rows) matches pad_rows(x, n_dev) exactly."""
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    n = x.shape[0]
+    step = max(n_dev, -(-min(cfg.chunk_rows, n) // n_dev) * n_dev)
+    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+
+    def featurize(rng):
+        s, e = rng
+        xc = _narrow_wire(x[s:e], on_tpu)
+        # only the LAST chunk can be non-divisible: pad it like the
+        # single-shot global pad (same total row count, same zeros)
+        return pad_rows(xc, n_dev) if (e - s) % n_dev else xc
+
+    dev_chunks = []
+
+    def consume(dev):
+        dev_chunks.append(dev)
+        return dev
+
+    chunks = prefetch(chunk_ranges(n, step), featurize,
+                      workers=cfg.workers, lookahead=cfg.depth + 1,
+                      stats=stats)
+    run_pipeline(chunks, lambda hc: fast_put(hc, shard2), consume,
+                 depth=cfg.depth, stats=stats)
+    if len(dev_chunks) == 1:
+        return dev_chunks[0]
+    return _cached_concat_widen(len(dev_chunks), shard2)(*dev_chunks)
+
+
 def train_logistic_regression(
     x: np.ndarray,
     y: np.ndarray,
@@ -289,33 +565,41 @@ def train_logistic_regression(
     max_iters: int = 100,
     tol: float = 1e-6,
     mesh: Optional[Mesh] = None,
+    pipeline: Optional[PipelineConfig] = None,
+    pipeline_stats: Optional[PipelineStats] = None,
 ) -> LogisticRegressionModel:
     """Full-batch multinomial LR via optax L-BFGS; data row-sharded over
-    the mesh, gradient psum inserted by XLA."""
+    the mesh, gradient psum inserted by XLA.
+
+    ``pipeline``: L-BFGS needs the whole matrix resident, so the stream
+    cannot reduce chunks away like NB — instead the narrowing cast and
+    the upload overlap per chunk, and the full sharded [Np, D] array is
+    assembled ON DEVICE from the uploaded chunks (one concatenate; the
+    chunks are donated into it). The assembled array is bit-identical
+    to the single-shot upload, so the fitted model is too.
+    """
     mesh = mesh or default_mesh()
     n_dev = int(np.prod(list(mesh.shape.values())))
     x = np.asarray(x, np.float32)
     y = np.asarray(y, np.int32)
     n = x.shape[0]
-    if mesh.devices.flat[0].platform == "tpu":
+    on_tpu = mesh.devices.flat[0].platform == "tpu"
+    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+    cfg = pipeline or PipelineConfig.from_env()
+    if cfg.enabled_for(n):
+        xp = _stream_lr_upload(x, mesh, on_tpu, cfg, pipeline_stats)
+        yp = fast_put(pad_rows(y, n_dev), shard1)
+        maskp = fast_put(pad_rows(np.ones(n, np.float32), n_dev), shard1)
+    else:
         # Lossless narrow wire (same gate as train_naive_bayes); _lr_fit
         # widens back to f32 on device FIRST, so the optimization math
         # and its results are bit-identical to an f32 upload.
-        x_int = x.astype(np.uint8)
-        if np.array_equal(x_int.astype(np.float32), x):
-            x = x_int
-        else:
-            xb = x.astype(jnp.bfloat16)
-            if np.array_equal(xb.astype(np.float32), x):
-                x = xb
-    mask = pad_rows(np.ones(n, np.float32), n_dev)
-    xp = pad_rows(x, n_dev)
-    yp = pad_rows(y, n_dev)
-    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
-    shard1 = NamedSharding(mesh, P(DATA_AXIS))
-    xp = fast_put(xp, shard2)
-    yp = fast_put(yp, shard1)
-    maskp = fast_put(mask, shard1)
+        x = _narrow_wire(x, on_tpu)
+        mask = pad_rows(np.ones(n, np.float32), n_dev)
+        xp = fast_put(pad_rows(x, n_dev), shard2)
+        yp = fast_put(pad_rows(y, n_dev), shard1)
+        maskp = fast_put(mask, shard1)
 
     params = _lr_fit(xp, yp, maskp, jnp.float32(n), jnp.float32(reg),
                      jnp.float32(tol), jnp.int32(max_iters), n_classes)

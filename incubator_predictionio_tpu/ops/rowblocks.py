@@ -277,6 +277,61 @@ def plan_layout(counts: np.ndarray, n_shards: int, m_div: int = 1,
     )
 
 
+def plan_and_fill_both(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    rating: np.ndarray,
+    n_users: int,
+    n_items: int,
+    n_shards: int,
+    m_div: int = 1,
+    fill_vals: bool = True,
+    parallel: bool | None = None,
+):
+    """Plan and fill BOTH sides' slabs for an ALS train:
+    ``(plan_u, plan_i, arrs_u, arrs_i)``.
+
+    The two sides' plans (and then their fills) are independent host
+    passes over the same COO triple, and both the native single-pass
+    scatter (a ctypes call) and the numpy fallback's radix argsort
+    release the GIL — so with ``parallel`` (default: on unless
+    PIO_PIPELINE=off) each pair runs on input-pipeline worker threads,
+    overlapping the dominant host cost of ALS layout prep. Results are
+    identical to the serial path: nothing is shared but read-only
+    inputs.
+    """
+    if parallel is None:
+        from ..workflow.input_pipeline import PipelineConfig
+
+        parallel = PipelineConfig.from_env().mode != "off"
+
+    counts_u = np.bincount(np.asarray(user_idx, np.int64), minlength=n_users)
+    counts_i = np.bincount(np.asarray(item_idx, np.int64), minlength=n_items)
+
+    def _run(*thunks):
+        if parallel:
+            from ..workflow.input_pipeline import host_parallel
+
+            return host_parallel(*thunks)
+        return [t() for t in thunks]
+
+    plan_u, plan_i = _run(
+        lambda: plan_layout(counts_u, n_shards, m_div=m_div),
+        lambda: plan_layout(counts_i, n_shards, m_div=m_div),
+    )
+    arrs_u, arrs_i = _run(
+        lambda: fill_buckets(plan_u, user_idx, item_idx, rating,
+                             col_slot_map=plan_i.slot_of_row,
+                             sentinel=plan_i.total_slots,
+                             fill_vals=fill_vals),
+        lambda: fill_buckets(plan_i, item_idx, user_idx, rating,
+                             col_slot_map=plan_u.slot_of_row,
+                             sentinel=plan_u.total_slots,
+                             fill_vals=fill_vals),
+    )
+    return plan_u, plan_i, arrs_u, arrs_i
+
+
 @dataclasses.dataclass(frozen=True)
 class BucketArrays:
     """Dense per-bucket entry slabs for a contiguous range of shards.

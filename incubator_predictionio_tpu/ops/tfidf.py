@@ -106,6 +106,55 @@ class TfIdfVectorizer:
             return x, np.count_nonzero(x, axis=0).astype(np.int64)
         return x
 
+    def tf_coo_block(self, docs: Sequence[str],
+                     use_native: bool | None = None):
+        """Per-doc COO of one document block WITHOUT touching fit state:
+        ``(doc_ptr [N+1], feat [nnz] int32, counts [nnz] float32, df
+        [D] int64)`` — the pure building block that fit_tf_coo runs once
+        over the whole corpus and the streaming input pipeline runs per
+        chunk from worker threads (thread-safe: the only shared state is
+        the memoized token cache, whose entries are idempotent). Block
+        COOs concatenate to the full-corpus COO bit-for-bit; block dfs
+        sum to the corpus df exactly (int64)."""
+        D = self.n_features
+        try:
+            if use_native is False:
+                from ..native import NativeUnavailable
+                raise NativeUnavailable("fallback forced (use_native=False)")
+            from ..native import NativeUnavailable, tfidf_tf_coo
+            return tfidf_tf_coo(docs, D, self.ngram, want_df=True)
+        except NativeUnavailable:
+            if use_native is True:
+                raise
+        doc_ptr = np.zeros(len(docs) + 1, np.int64)
+        feats = []
+        cnts = []
+        df = np.zeros(D, np.int64)
+        for row, doc in enumerate(docs):
+            idxs = self._doc_hashed_indices(doc)
+            added = 0
+            if idxs is not None:
+                # sparse per-doc aggregation (ascending, like C++) —
+                # no D-length scratch per doc
+                nz, nz_counts = np.unique(idxs, return_counts=True)
+                feats.append(nz.astype(np.int32))
+                cnts.append(nz_counts.astype(np.float32))
+                df[nz] += 1
+                added = len(nz)
+            doc_ptr[row + 1] = doc_ptr[row] + added
+        feat = (np.concatenate(feats) if feats
+                else np.empty(0, np.int32))
+        counts = (np.concatenate(cnts) if cnts
+                  else np.empty(0, np.float32))
+        return doc_ptr, feat, counts, df
+
+    def set_idf_from_df(self, df: np.ndarray, n_docs: int) -> np.ndarray:
+        """Finalize the fit from accumulated document frequencies
+        (MLlib IDF: log((n+1)/(df+1))) — the last step of both the
+        one-shot fit and the streamed fit."""
+        self.idf = np.log((n_docs + 1.0) / (df + 1.0)).astype(np.float32)
+        return self.idf
+
     def fit_tf_coo(self, docs: Sequence[str],
                    use_native: bool | None = None):
         """Fit the IDF and return per-doc (feature, count) pairs —
@@ -116,39 +165,8 @@ class TfIdfVectorizer:
         ever needs to exist on the host or cross the accelerator link
         (models/text_classification.TextNBAlgorithm trains straight
         from this via a device segment-sum)."""
-        D = self.n_features
-        try:
-            if use_native is False:
-                from ..native import NativeUnavailable
-                raise NativeUnavailable("fallback forced (use_native=False)")
-            from ..native import NativeUnavailable, tfidf_tf_coo
-            doc_ptr, feat, counts, df = tfidf_tf_coo(
-                docs, D, self.ngram, want_df=True)
-        except NativeUnavailable:
-            if use_native is True:
-                raise
-            doc_ptr = np.zeros(len(docs) + 1, np.int64)
-            feats = []
-            cnts = []
-            df = np.zeros(D, np.int64)
-            for row, doc in enumerate(docs):
-                idxs = self._doc_hashed_indices(doc)
-                added = 0
-                if idxs is not None:
-                    # sparse per-doc aggregation (ascending, like C++) —
-                    # no D-length scratch per doc
-                    nz, nz_counts = np.unique(idxs, return_counts=True)
-                    feats.append(nz.astype(np.int32))
-                    cnts.append(nz_counts.astype(np.float32))
-                    df[nz] += 1
-                    added = len(nz)
-                doc_ptr[row + 1] = doc_ptr[row] + added
-            feat = (np.concatenate(feats) if feats
-                    else np.empty(0, np.int32))
-            counts = (np.concatenate(cnts) if cnts
-                      else np.empty(0, np.float32))
-        n = len(docs)
-        self.idf = np.log((n + 1.0) / (df + 1.0)).astype(np.float32)
+        doc_ptr, feat, counts, df = self.tf_coo_block(docs, use_native)
+        self.set_idf_from_df(df, len(docs))
         return doc_ptr, feat, counts
 
     def fit_tf(self, docs: Sequence[str]) -> np.ndarray:

@@ -62,6 +62,10 @@ class WorkflowContext:
     # / `--resume` is active; algorithms with iterative loops snapshot
     # through it (see ops/als.py train_als).
     checkpoint_hook: Any = None
+    # workflow.input_pipeline.PipelineConfig — resolved lazily from
+    # WorkflowParams + the PIO_PIPELINE_* envs (get_input_pipeline);
+    # algorithms pass it to the streaming trainers.
+    input_pipeline: Any = None
 
     def __post_init__(self):
         _enable_compilation_cache()
@@ -75,3 +79,23 @@ class WorkflowContext:
 
             self.mesh = default_mesh()
         return self.mesh
+
+    def get_input_pipeline(self):
+        """Resolved streaming-input config: WorkflowParams fields win
+        over the PIO_PIPELINE_* envs, envs over built-in defaults."""
+        if self.input_pipeline is None:
+            import dataclasses as _dc
+
+            from .input_pipeline import PipelineConfig
+
+            wp = self.workflow_params
+            cfg = PipelineConfig.from_env(mode=wp.pipeline or None)
+            over = {}
+            if wp.pipeline_chunk > 0:
+                over["chunk_rows"] = wp.pipeline_chunk
+            if wp.pipeline_depth > 0:
+                over["depth"] = wp.pipeline_depth
+            if wp.pipeline_workers > 0:
+                over["workers"] = wp.pipeline_workers
+            self.input_pipeline = _dc.replace(cfg, **over) if over else cfg
+        return self.input_pipeline

@@ -79,6 +79,14 @@ def run_train(
     ctx = ctx or WorkflowContext()
     wp = workflow_params or WorkflowParams()
     ctx.workflow_params = wp
+    # Resolve the streaming-input config ONCE per run (pins the env
+    # snapshot for every stage of this train) and record it — whether a
+    # train streamed or single-shot must be readable from its log.
+    pl = ctx.get_input_pipeline()
+    log.info(
+        "input pipeline: mode=%s chunk_rows=%d chunk_docs=%d depth=%d "
+        "workers=%d", pl.mode, pl.chunk_rows, pl.chunk_docs, pl.depth,
+        pl.workers)
     storage = ctx.get_storage()
     instances = storage.get_meta_data_engine_instances()
 

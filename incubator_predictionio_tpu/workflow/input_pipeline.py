@@ -1,0 +1,334 @@
+"""Streaming double-buffered host→device input pipeline.
+
+BASELINE.json's decomposition shows the on-chip NB pass at ~420M
+events/sec while end-to-end ``Engine.train`` delivers 43M — and at the
+16M×32 sweep point the TPU path collapses to ~545k events/sec — because
+host featurization, event decode, and device upload run SERIALLY before
+any compute starts. This module overlaps the three stages:
+
+- **featurize** — background worker threads (``prefetch``) pull work
+  items from a batch iterator (the event store's chunked scans, a slice
+  schedule over a materialized matrix, a document corpus) and produce
+  fixed-size host chunks, with optional lossless bf16/int narrowing on
+  the wire;
+- **upload** — async ``jax.device_put`` of each chunk into a small ring
+  of device buffers (``run_pipeline`` bounds the in-flight count, and
+  consumers donate the chunk buffers so steady-state HBM stays at
+  ``depth`` chunks + the accumulator);
+- **compute** — the consume callback dispatches the per-chunk device
+  program for chunk N while chunk N+1 uploads and chunk N+2 featurizes.
+
+The design follows the overlapped-transfer lesson of the ALX and
+TensorFlow system papers (arxiv 2112.02194, 1605.08695): an accelerator
+that waits for its input pipeline is idle silicon, and the fix is a
+bounded producer/consumer ring, not a bigger batch.
+
+Knobs (env, overridable per-call via ``PipelineConfig``):
+
+- ``PIO_PIPELINE``        — ``auto`` (default: stream when the input is
+  at least two chunks long), ``1``/``on`` (force), ``0``/``off``
+  (single-shot fallback — the guard-tested exact path).
+- ``PIO_PIPELINE_CHUNK``  — rows per chunk (default 1_000_000).
+- ``PIO_PIPELINE_DEPTH``  — device buffer ring depth (default 2:
+  double-buffered).
+- ``PIO_PIPELINE_WORKERS``— host featurize worker threads (default 2).
+
+Multi-process (multi-controller) runs fall back to single-shot: their
+arrays are built with ``jax.make_array_from_callback`` and every process
+must agree on the layout, which a per-process stream cannot guarantee.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+__all__ = [
+    "PipelineConfig",
+    "PipelineStats",
+    "PipelineWorkerError",
+    "pipeline_of",
+    "prefetch",
+    "run_pipeline",
+    "host_parallel",
+    "chunk_ranges",
+]
+
+
+def pipeline_of(ctx) -> Optional["PipelineConfig"]:
+    """Streaming-input config from a workflow context (None → callers
+    resolve from env); tolerates the bare test contexts that predate
+    WorkflowContext.get_input_pipeline."""
+    getter = getattr(ctx, "get_input_pipeline", None) if ctx else None
+    return getter() if callable(getter) else None
+
+
+DEFAULT_CHUNK_ROWS = 1_000_000
+DEFAULT_CHUNK_DOCS = 16_384
+DEFAULT_DEPTH = 2
+DEFAULT_WORKERS = 2
+
+
+class PipelineWorkerError(RuntimeError):
+    """A featurize worker raised; the original exception is __cause__."""
+
+
+def _env_int(name: str, default: int, lo: int = 1, hi: int = 1 << 30) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"{name}={raw!r} is not an integer; using {default}",
+                      stacklevel=3)
+        return default
+    return max(lo, min(v, hi))
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Resolved streaming knobs. ``mode`` ∈ {'auto', 'on', 'off'}."""
+
+    mode: str = "auto"
+    chunk_rows: int = DEFAULT_CHUNK_ROWS
+    #: chunk size when a "row" is a document (text featurize: the host
+    #: cost per row is ~3 orders of magnitude higher than an attribute
+    #: row, so chunks are correspondingly smaller)
+    chunk_docs: int = DEFAULT_CHUNK_DOCS
+    depth: int = DEFAULT_DEPTH
+    workers: int = DEFAULT_WORKERS
+
+    @classmethod
+    def from_env(cls, mode: Optional[str] = None) -> "PipelineConfig":
+        raw = (mode or os.environ.get("PIO_PIPELINE") or "auto").strip().lower()
+        if raw in ("1", "on", "true", "yes"):
+            raw = "on"
+        elif raw in ("0", "off", "false", "no"):
+            raw = "off"
+        elif raw != "auto":
+            import warnings
+
+            warnings.warn(
+                f"PIO_PIPELINE={raw!r}: expected auto/on/off; using auto",
+                stacklevel=2)
+            raw = "auto"
+        return cls(
+            mode=raw,
+            chunk_rows=_env_int("PIO_PIPELINE_CHUNK", DEFAULT_CHUNK_ROWS),
+            chunk_docs=_env_int("PIO_PIPELINE_CHUNK_DOCS",
+                                DEFAULT_CHUNK_DOCS),
+            depth=_env_int("PIO_PIPELINE_DEPTH", DEFAULT_DEPTH, lo=1, hi=64),
+            workers=_env_int("PIO_PIPELINE_WORKERS", DEFAULT_WORKERS,
+                             lo=1, hi=64),
+        )
+
+    def enabled_for(self, n_rows: int, chunk: Optional[int] = None) -> bool:
+        """Should this input stream? ``auto`` streams only on an
+        accelerator backend (on CPU there is no host→device transfer to
+        overlap — same gate as the wire-narrowing casts) and only when
+        there are at least two full chunks (below that the single-shot
+        path's one put is already optimal); never under multi-controller
+        jax (see module docstring). ``mode='on'`` forces streaming
+        anywhere — the CPU bit-identity guard tests rely on it.
+        ``chunk`` overrides the row chunk size for inputs measured in
+        other units (documents)."""
+        if self.mode == "off":
+            return False
+        try:
+            import jax
+
+            if jax.process_count() > 1:
+                return False
+            if self.mode == "on":
+                return n_rows > 0
+            if jax.default_backend() == "cpu":
+                return False
+        except Exception:  # noqa: BLE001 - no jax → nothing to stream to
+            return False
+        return n_rows >= 2 * (self.chunk_rows if chunk is None else chunk)
+
+
+@dataclasses.dataclass
+class PipelineStats:
+    """Per-run stage accounting (bench-grade, best effort).
+
+    ``featurize_seconds`` sums time INSIDE worker featurize calls (the
+    host-stage busy time, not wall); ``upload_seconds`` sums the
+    device_put enqueue calls; ``consume_seconds`` sums the compute
+    dispatch calls; ``wall_seconds`` is end-to-end. With perfect overlap
+    ``wall ≈ max(stage)``; the bench derives its overlap-efficiency
+    ratio from exactly these numbers."""
+
+    n_chunks: int = 0
+    featurize_seconds: float = 0.0
+    upload_seconds: float = 0.0
+    consume_seconds: float = 0.0
+    wall_seconds: float = 0.0
+    max_inflight: int = 0
+
+    def _add_featurize(self, dt: float) -> None:
+        # workers call this concurrently; += on a float is not atomic
+        with self._lock:
+            self.featurize_seconds += dt
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+
+
+def chunk_ranges(n_rows: int, chunk_rows: int) -> list[tuple[int, int]]:
+    """[(start, stop), ...] covering [0, n_rows) in chunk_rows steps."""
+    if n_rows <= 0:
+        return []
+    step = max(1, int(chunk_rows))
+    return [(s, min(s + step, n_rows)) for s in range(0, n_rows, step)]
+
+
+def prefetch(
+    items: Iterable[Any],
+    fn: Callable[[Any], Any],
+    workers: int = DEFAULT_WORKERS,
+    lookahead: int = DEFAULT_DEPTH,
+    stats: Optional[PipelineStats] = None,
+) -> Iterator[Any]:
+    """Yield ``fn(item)`` in order, computed by background threads.
+
+    At most ``lookahead`` results are completed-or-running ahead of the
+    consumer (backpressure: a slow consumer stalls the workers instead
+    of accumulating unbounded host chunks). A worker exception is
+    re-raised at the corresponding yield point as PipelineWorkerError
+    (original as ``__cause__``); remaining work is cancelled. Closing
+    the generator mid-stream (``gen.close()`` / loop break) cancels
+    pending work and joins the pool — no leaked threads.
+
+    Worker threads genuinely overlap featurize with upload/compute when
+    the featurize body releases the GIL (large-array numpy casts, the
+    ctypes calls into the native tokenizer/codec).
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    items = iter(items)
+    bound = max(1, int(lookahead))
+
+    def timed_fn(item):
+        t0 = time.perf_counter()
+        out = fn(item)
+        if stats is not None:
+            stats._add_featurize(time.perf_counter() - t0)
+        return out
+
+    pool = ThreadPoolExecutor(max_workers=max(1, int(workers)),
+                              thread_name_prefix="pio-pipeline")
+    pending: collections.deque = collections.deque()
+    try:
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < bound:
+                try:
+                    item = next(items)
+                except StopIteration:
+                    exhausted = True
+                    break
+                pending.append(pool.submit(timed_fn, item))
+            if not pending:
+                break
+            fut = pending.popleft()
+            try:
+                result = fut.result()
+            except Exception as e:  # noqa: BLE001 - re-raise with context
+                raise PipelineWorkerError(
+                    f"input-pipeline featurize worker failed: {e}") from e
+            yield result
+    finally:
+        for fut in pending:
+            fut.cancel()
+        pool.shutdown(wait=True, cancel_futures=True)
+
+
+def run_pipeline(
+    host_chunks: Iterable[Any],
+    upload: Callable[[Any], Any],
+    consume: Callable[[Any], Any],
+    depth: int = DEFAULT_DEPTH,
+    stats: Optional[PipelineStats] = None,
+) -> int:
+    """Drive the double-buffered upload/consume loop; returns #chunks.
+
+    ``upload(host_chunk)`` starts the async host→device transfer and
+    returns the device chunk; ``consume(dev_chunk)`` dispatches the
+    per-chunk device program and returns a *token* (any jax array of the
+    dispatch, e.g. the running accumulator). Both return immediately —
+    jax transfers and dispatches are async — so the loop's only blocking
+    point is the ring bound: before uploading chunk N, it blocks on the
+    token of chunk N−depth. Combined with consume donating its chunk
+    buffers, that caps live HBM at ~``depth + 1`` chunks plus
+    accumulator regardless of stream length.
+
+    Exceptions (from the chunk iterator, upload, or consume) propagate
+    to the caller after in-flight tokens are drained best-effort; the
+    ``host_chunks`` generator is closed either way, which is what stops
+    ``prefetch`` workers mid-stream.
+    """
+    inflight: collections.deque = collections.deque()
+    bound = max(1, int(depth))
+    n = 0
+    t_start = time.perf_counter()
+    try:
+        for hc in host_chunks:
+            if len(inflight) >= bound:
+                _block_on(inflight.popleft())
+            t0 = time.perf_counter()
+            dev = upload(hc)
+            if stats is not None:
+                stats.upload_seconds += time.perf_counter() - t0
+            del hc  # the host buffer is the transfer's source; drop our ref
+            t0 = time.perf_counter()
+            token = consume(dev)
+            if stats is not None:
+                stats.consume_seconds += time.perf_counter() - t0
+            del dev
+            inflight.append(token)
+            n += 1
+            if stats is not None:
+                stats.n_chunks = n
+                stats.max_inflight = max(stats.max_inflight, len(inflight))
+        while inflight:
+            _block_on(inflight.popleft())
+    finally:
+        close = getattr(host_chunks, "close", None)
+        if callable(close):
+            close()
+        if stats is not None:
+            stats.wall_seconds = time.perf_counter() - t_start
+    return n
+
+
+def _block_on(token) -> None:
+    if token is None:
+        return
+    import jax
+
+    jax.block_until_ready(token)
+
+
+def host_parallel(*thunks: Callable[[], Any]) -> list:
+    """Run independent host-side thunks on worker threads, return their
+    results in order. Used for coarse-grained overlap where a stream
+    does not fit — e.g. ALS filling the user-side and item-side bucket
+    slabs concurrently (the native fill and numpy argsort both release
+    the GIL). Exceptions propagate (first failure wins); all threads are
+    joined before returning either way."""
+    if len(thunks) <= 1:
+        return [t() for t in thunks]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=len(thunks),
+                            thread_name_prefix="pio-hostpar") as pool:
+        futs = [pool.submit(t) for t in thunks]
+        return [f.result() for f in futs]

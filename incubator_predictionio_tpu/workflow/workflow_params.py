@@ -29,3 +29,12 @@ class WorkflowParams:
     # accelerator-vs-CPU per algorithm with measured link/host rates and
     # runs each stage where it is fastest; tpu/cpu force one side.
     device: str = "auto"
+    # Streaming input pipeline (workflow/input_pipeline.py): overlap
+    # host featurize, host→device upload, and on-device compute as a
+    # double-buffered chunk stream. "" defers to the PIO_PIPELINE env
+    # (default auto); auto/on/off select per-run. The 0 values defer to
+    # the PIO_PIPELINE_{CHUNK,DEPTH,WORKERS} envs / built-in defaults.
+    pipeline: str = ""
+    pipeline_chunk: int = 0
+    pipeline_depth: int = 0
+    pipeline_workers: int = 0

@@ -548,7 +548,7 @@ extern "C" {
 
 // Bump when the ABI or semantics change — the Python wrapper rebuilds the
 // cached .so when this does not match its expected version.
-int32_t pio_codec_version() { return 17; }
+int32_t pio_codec_version() { return 18; }
 
 namespace {
 // FNV-1a over a byte range, continuing from a running state.
@@ -1120,6 +1120,17 @@ struct IngestParser : Parser {
     int64_t prid_s = -1, prid_e = -1;
     int64_t t_us = INT64_MIN, d0 = 0, d1 = 0;
     bool has_time = false;
+    // Duplicate-key guard (ADVICE r5): json.loads is last-wins, but the
+    // single-pass state above is NOT safely overwritable (e.g. a second
+    // null targetEntityType would leave tet_null=false from the first).
+    // Any repeated known key forces the Python fallback, which produces
+    // the exact last-wins semantics. Bit per known key:
+    uint32_t seen_keys = 0;
+    auto dup = [&](uint32_t bit) {
+      bool already = seen_keys & bit;
+      seen_keys |= bit;
+      return already;
+    };
 
     ws();
     if (p < end && *p == '}') {
@@ -1134,10 +1145,13 @@ struct IngestParser : Parser {
       if (p >= end || *p != ':') return false;
       ++p;
       if (key == "event") {
+        if (dup(1u << 0)) { out.all_ok = false; return true; }
         if (!string_token(ev, ev_s, ev_e)) { out.all_ok = false; return true; }
       } else if (key == "entityType") {
+        if (dup(1u << 1)) { out.all_ok = false; return true; }
         if (!string_token(etype, et_s, et_e)) { out.all_ok = false; return true; }
       } else if (key == "entityId") {
+        if (dup(1u << 2)) { out.all_ok = false; return true; }
         ws();
         has_ei = true;
         if (p < end && *p == '"') {
@@ -1147,6 +1161,7 @@ struct IngestParser : Parser {
           ei_int = true; ei_empty = false;
         } else { out.all_ok = false; return true; }
       } else if (key == "targetEntityType") {
+        if (dup(1u << 3)) { out.all_ok = false; return true; }
         ws();
         if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
         else if (p < end && *p == '"') {
@@ -1154,6 +1169,7 @@ struct IngestParser : Parser {
           tet_null = false;
         } else { out.all_ok = false; return true; }
       } else if (key == "targetEntityId") {
+        if (dup(1u << 4)) { out.all_ok = false; return true; }
         ws();
         if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
         else if (p < end && *p == '"') {
@@ -1163,20 +1179,24 @@ struct IngestParser : Parser {
         } else if (int_token(tei_s, tei_e)) { tei_null = false; tei_int = true; }
         else { out.all_ok = false; return true; }
       } else if (key == "properties") {
+        if (dup(1u << 5)) { out.all_ok = false; return true; }
         ws();
         if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
         else if (!props_object(pr_s, pr_e, pr_keys, pio_key))
           { out.all_ok = false; return true; }
       } else if (key == "tags") {
+        if (dup(1u << 6)) { out.all_ok = false; return true; }
         ws();
         if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
         else if (!string_array(tg_s, tg_e)) { out.all_ok = false; return true; }
       } else if (key == "prId") {
+        if (dup(1u << 7)) { out.all_ok = false; return true; }
         ws();
         if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
         else if (!string_token(sval, prid_s, prid_e))
           { out.all_ok = false; return true; }
       } else if (key == "eventTime") {
+        if (dup(1u << 8)) { out.all_ok = false; return true; }
         ws();
         if (is_null()) { if (!strict_value()) { out.all_ok = false; return true; } }
         else {
@@ -1188,6 +1208,7 @@ struct IngestParser : Parser {
         out.all_ok = false;  // client-supplied id → upsert semantics → python
         return true;
       } else if (key == "creationTime") {
+        if (dup(1u << 9)) { out.all_ok = false; return true; }
         // server-assigned: the event server pops it from client payloads
         if (!strict_value()) { out.all_ok = false; return true; }
       } else {

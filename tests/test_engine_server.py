@@ -297,3 +297,27 @@ def test_probe_latency_measures_and_persists(memory_storage):
     stored = json.loads(row.runtime_conf["probe_latency"])
     assert stored["http_p50_ms"] == result["http_p50_ms"]
     assert stored["n"] == 12
+
+
+def test_forged_probe_marker_still_counts(memory_storage):
+    """The X-Pio-Probe queryCount/feedback bypass is gated on a
+    per-process random token: an external client sending a bare
+    "X-Pio-Probe: 1" must be accounted like any real query."""
+    _seed_ratings(memory_storage)
+    engine = RecommendationEngine()()
+    ctx = WorkflowContext(app_name="testapp", storage=memory_storage)
+    run_train(engine, ENGINE_PARAMS, ctx, engine_factory_name="rec")
+    server = EngineServer(engine, engine_factory_name="rec",
+                          storage=memory_storage)
+    with ServerThread(server.app) as st:
+        r = requests.post(st.base + "/queries.json",
+                          json={"user": "1", "num": 2},
+                          headers={"X-Pio-Probe": "1"})
+        assert r.status_code == 200, r.text
+        assert requests.get(st.base + "/").json()["queryCount"] == 1
+        # the real token (same process) IS excluded
+        r = requests.post(st.base + "/queries.json",
+                          json={"user": "1", "num": 2},
+                          headers={"X-Pio-Probe": server._probe_token})
+        assert r.status_code == 200, r.text
+        assert requests.get(st.base + "/").json()["queryCount"] == 1

@@ -1,0 +1,394 @@
+"""Streaming double-buffered input pipeline (workflow/input_pipeline):
+chunk-boundary correctness (pipelined model bit-identical to the
+single-shot path on CPU), worker-exception propagation, backpressure /
+bounded-buffer behavior, and clean shutdown mid-stream."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.workflow.input_pipeline import (
+    PipelineConfig,
+    PipelineStats,
+    PipelineWorkerError,
+    chunk_ranges,
+    host_parallel,
+    prefetch,
+    run_pipeline,
+)
+
+OFF = PipelineConfig(mode="off")
+
+
+def _on(**kw):
+    kw.setdefault("mode", "on")
+    return PipelineConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_ranges_cover_exactly():
+    assert chunk_ranges(0, 10) == []
+    assert chunk_ranges(5, 10) == [(0, 5)]
+    assert chunk_ranges(10, 10) == [(0, 10)]
+    assert chunk_ranges(25, 10) == [(0, 10), (10, 20), (20, 25)]
+
+
+def test_prefetch_preserves_order():
+    out = list(prefetch(range(50), lambda v: v * v, workers=4, lookahead=3))
+    assert out == [v * v for v in range(50)]
+
+
+def test_prefetch_backpressure_bounds_lookahead():
+    """Workers must stall on a slow consumer: at any time at most
+    ``lookahead`` items are started-but-not-consumed (bounded host
+    memory), never the whole input."""
+    lookahead = 3
+    started, consumed = [], []
+    lock = threading.Lock()
+    max_ahead = 0
+
+    def fn(v):
+        with lock:
+            started.append(v)
+        return v
+
+    gen = prefetch(range(40), fn, workers=4, lookahead=lookahead)
+    for v in gen:
+        time.sleep(0.002)  # slow consumer
+        with lock:
+            consumed.append(v)
+            max_ahead = max(max_ahead, len(started) - len(consumed))
+    assert consumed == list(range(40))
+    assert max_ahead <= lookahead + 1  # +1: the item being yielded
+
+
+def test_prefetch_worker_exception_propagates():
+    def fn(v):
+        if v == 7:
+            raise ValueError("boom at 7")
+        return v
+
+    gen = prefetch(range(20), fn, workers=2, lookahead=2)
+    got = []
+    with pytest.raises(PipelineWorkerError) as e:
+        for v in gen:
+            got.append(v)
+    assert got == list(range(7))
+    assert isinstance(e.value.__cause__, ValueError)
+    assert "boom at 7" in str(e.value)
+
+
+def test_prefetch_clean_shutdown_midstream():
+    """Breaking out of the consumer loop (generator close) must stop
+    the workers — no runaway featurize of the remaining input, no
+    leaked threads."""
+    processed = []
+    lock = threading.Lock()
+
+    def fn(v):
+        with lock:
+            processed.append(v)
+        return v
+
+    before = threading.active_count()
+    gen = prefetch(range(10_000), fn, workers=2, lookahead=2)
+    for v in gen:
+        if v >= 2:
+            break
+    gen.close()  # explicit close; a dropped generator does the same
+    # pool joined: only items already submitted before the close ran
+    assert len(processed) <= 2 + 2 + 2 + 1  # consumed + lookahead margin
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before
+
+
+def test_run_pipeline_bounds_inflight_ring():
+    uploads, consumed = [], []
+
+    def upload(c):
+        uploads.append(c)
+        return np.asarray([c])
+
+    def consume(dev):
+        consumed.append(int(dev[0]))
+        return dev  # token: numpy passes block_until_ready untouched
+
+    stats = PipelineStats()
+    n = run_pipeline(iter(range(9)), upload, consume, depth=2, stats=stats)
+    assert n == 9
+    assert consumed == list(range(9))
+    assert stats.max_inflight <= 2
+    assert stats.n_chunks == 9
+
+
+def test_run_pipeline_closes_source_on_consume_error():
+    closed = []
+
+    def chunks():
+        try:
+            for v in range(100):
+                yield v
+        finally:
+            closed.append(True)
+
+    def consume(dev):
+        if dev >= 3:
+            raise RuntimeError("device exploded")
+        return None
+
+    with pytest.raises(RuntimeError, match="device exploded"):
+        run_pipeline(chunks(), lambda c: c, consume, depth=2)
+    assert closed == [True]
+
+
+def test_host_parallel_results_and_errors():
+    assert host_parallel(lambda: 1, lambda: 2) == [1, 2]
+    with pytest.raises(KeyError):
+        host_parallel(lambda: 1, lambda: (_ for _ in ()).throw(KeyError("x")))
+
+
+def test_config_auto_threshold_and_env(monkeypatch):
+    import jax
+
+    cfg = PipelineConfig(mode="auto", chunk_rows=100)
+    # auto only streams on an accelerator backend (no transfer to
+    # overlap on CPU); forced 'on' streams anywhere (guard tests)
+    assert not cfg.enabled_for(10**9)
+    assert _on(chunk_rows=100).enabled_for(1)
+    assert not OFF.enabled_for(10**9)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert not cfg.enabled_for(150)
+    assert cfg.enabled_for(200)
+    monkeypatch.setenv("PIO_PIPELINE", "on")
+    monkeypatch.setenv("PIO_PIPELINE_CHUNK", "12345")
+    monkeypatch.setenv("PIO_PIPELINE_DEPTH", "5")
+    cfg = PipelineConfig.from_env()
+    assert (cfg.mode, cfg.chunk_rows, cfg.depth) == ("on", 12345, 5)
+
+
+# ---------------------------------------------------------------------------
+# trainer identity: pipelined == single-shot, bit for bit (CPU)
+# ---------------------------------------------------------------------------
+
+
+def _cls_data(n, d=4, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.poisson(2.0, (n, d)).astype(np.float32)
+    y = rng.integers(0, c, n).astype(np.int32)
+    return x, y, c
+
+
+@pytest.mark.parametrize("n,chunk", [
+    (10_000, 1024),   # uneven final chunk
+    (4_096, 1024),    # exact chunk multiple
+    (700, 1024),      # single short chunk (mode=on forces streaming)
+])
+def test_nb_dense_stream_bit_identical(n, chunk):
+    from incubator_predictionio_tpu.ops.linear import train_naive_bayes
+
+    x, y, c = _cls_data(n)
+    m0 = train_naive_bayes(x, y, c, pipeline=OFF)
+    stats = PipelineStats()
+    m1 = train_naive_bayes(x, y, c, pipeline=_on(chunk_rows=chunk),
+                           pipeline_stats=stats)
+    assert np.array_equal(m0.log_prior, m1.log_prior)
+    assert np.array_equal(m0.log_likelihood, m1.log_likelihood)
+    assert stats.n_chunks == len(chunk_ranges(n, max(chunk, 1)))
+
+
+def test_nb_coo_stream_bit_identical():
+    from incubator_predictionio_tpu.ops.linear import train_naive_bayes_coo
+    from incubator_predictionio_tpu.ops.tfidf import TfIdfVectorizer
+
+    rng = np.random.default_rng(1)
+    docs = [" ".join(f"w{int(v)}" for v in rng.integers(0, 60, 25))
+            for _ in range(2_000)]
+    y = rng.integers(0, 7, len(docs)).astype(np.int32)
+    vec = TfIdfVectorizer(n_features=256)
+    dp, ft, cnt = vec.fit_tf_coo(docs, use_native=False)
+    m0 = train_naive_bayes_coo(dp, ft, cnt, y, 7, 256, pipeline=OFF)
+    m1 = train_naive_bayes_coo(dp, ft, cnt, y, 7, 256,
+                               pipeline=_on(chunk_rows=4_000))
+    assert np.array_equal(m0.log_prior, m1.log_prior)
+    assert np.array_equal(m0.log_likelihood, m1.log_likelihood)
+
+
+def test_lr_stream_bit_identical():
+    from incubator_predictionio_tpu.ops.linear import train_logistic_regression
+
+    x, y, c = _cls_data(3_000, seed=2)
+    m0 = train_logistic_regression(x, y, c, reg=0.01, max_iters=12,
+                                   pipeline=OFF)
+    m1 = train_logistic_regression(x, y, c, reg=0.01, max_iters=12,
+                                   pipeline=_on(chunk_rows=700))
+    assert np.array_equal(m0.weights, m1.weights)
+    assert np.array_equal(m0.intercept, m1.intercept)
+
+
+def test_rebatch_entries_preserves_stream():
+    from incubator_predictionio_tpu.ops.linear import rebatch_entries
+
+    rng = np.random.default_rng(3)
+    blocks = []
+    for ln in (0, 5, 17, 1, 0, 40, 3):
+        blocks.append((rng.integers(0, 9, ln).astype(np.int32),
+                       rng.integers(0, 99, ln).astype(np.int32),
+                       rng.random(ln).astype(np.float32)))
+    out = list(rebatch_entries(iter(blocks), 16))
+    assert all(len(ch[0]) == 16 for ch in out[:-1])
+    assert sum(len(ch[0]) for ch in out) == sum(len(b[0]) for b in blocks)
+    for j in range(3):
+        got = np.concatenate([ch[j] for ch in out])
+        want = np.concatenate([b[j] for b in blocks])
+        assert np.array_equal(got, want)
+
+
+def test_nb_coo_stream_propagates_source_error():
+    from incubator_predictionio_tpu.ops.linear import (
+        train_naive_bayes_coo_stream,
+    )
+
+    def blocks():
+        yield (np.zeros(10, np.int32), np.zeros(10, np.int32),
+               np.ones(10, np.float32))
+        raise OSError("event store died mid-scan")
+
+    with pytest.raises(OSError, match="died mid-scan"):
+        train_naive_bayes_coo_stream(
+            blocks(), np.zeros(4, np.int32), 3, 16,
+            pipeline=_on(chunk_rows=8))
+
+
+# ---------------------------------------------------------------------------
+# template-level identity (the product path: Preparator → Algorithm)
+# ---------------------------------------------------------------------------
+
+
+def _text_corpus(n_docs=600, n_classes=5, vocab=80, seed=4):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, n_classes, n_docs).astype(np.int32)
+    texts = [" ".join(f"w{(int(v) + int(y[j]) * 13) % vocab}"
+                      for v in rng.integers(0, vocab, 30))
+             for j in range(n_docs)]
+    return texts, y, n_classes
+
+
+def test_text_template_stream_identity():
+    """The full text path — deferred TF-IDF featurize streamed through
+    tokenizer workers into the device scatter — must produce the same
+    model (stats, idf, priors) as the one-shot prepare+train."""
+    from incubator_predictionio_tpu.models.text_classification import (
+        TextNBAlgorithm, TextPreparator, TrainingData,
+    )
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+
+    texts, y, c = _text_corpus()
+    td = TrainingData(texts, y, np.arange(c).astype(str))
+
+    def run(cfg):
+        ctx = WorkflowContext(app_name="t")
+        ctx.input_pipeline = cfg
+        prep = TextPreparator(TextPreparator.params_cls(n_features=512))
+        pd = prep.prepare(ctx, td)
+        algo = TextNBAlgorithm(TextNBAlgorithm.params_cls())
+        return pd, algo.train(ctx, pd)
+
+    pd0, m0 = run(OFF)
+    pd1, m1 = run(_on(chunk_rows=2_048, chunk_docs=128, workers=2))
+    assert pd0.coo is not None          # one-shot prepared eagerly
+    assert pd1.coo is None and pd1.texts is not None  # streaming deferred
+    assert np.array_equal(m0.inner.log_prior, m1.inner.log_prior)
+    assert np.array_equal(m0.inner.log_likelihood, m1.inner.log_likelihood)
+    assert np.array_equal(m0.vectorizer.idf, m1.vectorizer.idf)
+
+
+def test_classification_template_stream_identity():
+    from incubator_predictionio_tpu.models.classification import (
+        NaiveBayesAlgorithm, TrainingData,
+    )
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+
+    x, y, c = _cls_data(5_000, seed=5)
+    td = TrainingData(x, y, tuple(f"a{j}" for j in range(4)),
+                      np.arange(c).astype(np.float64))
+
+    def run(cfg):
+        ctx = WorkflowContext(app_name="t")
+        ctx.input_pipeline = cfg
+        algo = NaiveBayesAlgorithm(NaiveBayesAlgorithm.params_cls())
+        return algo.train(ctx, td)
+
+    m0, m1 = run(OFF), run(_on(chunk_rows=512))
+    assert np.array_equal(m0.inner.log_prior, m1.inner.log_prior)
+    assert np.array_equal(m0.inner.log_likelihood, m1.inner.log_likelihood)
+
+
+def test_workflow_params_override_env(monkeypatch):
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.workflow_params import (
+        WorkflowParams,
+    )
+
+    monkeypatch.setenv("PIO_PIPELINE", "off")
+    monkeypatch.setenv("PIO_PIPELINE_CHUNK", "111")
+    ctx = WorkflowContext(workflow_params=WorkflowParams(
+        pipeline="on", pipeline_chunk=222, pipeline_depth=3))
+    cfg = ctx.get_input_pipeline()
+    assert (cfg.mode, cfg.chunk_rows, cfg.depth) == ("on", 222, 3)
+    # resolved once: a later env flip doesn't change this run
+    monkeypatch.setenv("PIO_PIPELINE", "auto")
+    assert ctx.get_input_pipeline() is cfg
+
+
+# ---------------------------------------------------------------------------
+# event-store batch iterator
+# ---------------------------------------------------------------------------
+
+
+def test_find_batches_concat_equals_find_batch():
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.data.store.p_event_store import (
+        PEventStore,
+    )
+
+    s = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        "PIO_STORAGE_SOURCES_M_TYPE": "MEMORY",
+    })
+    try:
+        app_id = s.get_meta_data_apps().insert(App(0, "chunks", None))
+        s.get_l_events().init(app_id)
+        s.get_meta_data_access_keys().insert(AccessKey("K", app_id, ()))
+        events = [Event.from_json({
+            "event": "view", "entityType": "user", "entityId": f"u{j}",
+            "targetEntityType": "item", "targetEntityId": f"i{j % 7}",
+            "properties": {"rating": float(j % 5)},
+            "eventTime": "2024-02-%02dT00:00:00Z" % (1 + j % 28),
+        }) for j in range(55)]
+        s.get_l_events().insert_batch(events, app_id)
+
+        whole = PEventStore.find_batch("chunks", storage=s)
+        chunks = list(PEventStore.find_batches("chunks", storage=s,
+                                               chunk_size=10))
+        assert len(whole) == 55
+        assert [len(b) for b in chunks] == [10, 10, 10, 10, 10, 5]
+        assert sum((b.event for b in chunks), []) == whole.event
+        assert sum((b.entity_id for b in chunks), []) == whole.entity_id
+        assert sum((b.properties for b in chunks), []) == whole.properties
+        assert np.array_equal(
+            np.concatenate([b.event_time_us for b in chunks]),
+            whole.event_time_us)
+    finally:
+        s.close()

@@ -135,3 +135,24 @@ def test_check_finite_rejects_unverifiable_depth():
         deep = {"lvl": deep}
     with pytest.raises(NaNGuardError, match="deeper than the guard"):
         check_finite(deep, "s")
+
+
+def test_check_finite_catches_bare_numpy_scalars():
+    """np.generic scalars (a jax scalar fetched via float()/item() paths
+    or a stats field like NaiveBayes' smoothing) must be checked as 0-d
+    arrays — previously they fell through every isinstance branch and
+    non-finite scalars reported clean."""
+    check_finite({"loss": np.float32(1.5)}, "s")  # finite scalar: clean
+    with pytest.raises(NaNGuardError, match="loss"):
+        check_finite({"loss": np.float32(np.nan)}, "s")
+    with pytest.raises(NaNGuardError, match="norm"):
+        check_finite({"norm": np.float64(np.inf)}, "s")
+
+    @dataclasses.dataclass
+    class M:
+        scale: np.floating
+
+    with pytest.raises(NaNGuardError, match="scale"):
+        check_finite(M(np.float32(-np.inf)), "s")
+    # integer scalars never flagged (no NaN in int)
+    check_finite({"count": np.int64(7)}, "s")

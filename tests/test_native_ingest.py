@@ -211,3 +211,53 @@ def test_strict_but_valid_edge_cases_stored_readably(jsonl_storage):
     assert got["u1"].event_time.microsecond == 999000  # ms truncation
     assert got["u2"].event_time == dt.datetime(
         2024, 5, 31, 22, 0, tzinfo=dt.timezone.utc)
+
+
+def test_duplicate_json_keys_match_json_loads(jsonl_storage, tmp_path,
+                                              monkeypatch):
+    """Duplicate keys in one event object: json.loads (the Python path)
+    is last-wins; the native parser's single-pass field state is not
+    safely overwritable (a second null targetEntityType would leave the
+    first value's state behind), so any duplicate known key must force
+    the Python fallback — stored semantics identical either way."""
+    raw = ('[{"event": "view", "entityType": "user", "entityId": "u1", '
+           '"targetEntityType": "item", "targetEntityId": "i9", '
+           '"targetEntityType": null, "targetEntityId": null, '
+           '"properties": {"rating": 1}, "properties": {"rating": 9}, '
+           '"eventTime": "2024-01-01T00:00:00Z", '
+           '"eventTime": "2024-02-02T00:00:00Z"}]')
+
+    def post(storage):
+        with ServerThread(EventServer(storage).app) as st:
+            return requests.post(
+                st.base + "/batch/events.json?accessKey=nk", data=raw,
+                headers={"Content-Type": "application/json"})
+
+    monkeypatch.delenv("PIO_DISABLE_NATIVE", raising=False)
+    r = post(jsonl_storage)
+    assert r.status_code == 200 and r.json()[0]["status"] == 201
+    native_stored = _normalized(jsonl_storage)
+    assert len(native_stored) == 1
+    e = native_stored[0]
+    # last-wins semantics, exactly like json.loads:
+    assert e.get("targetEntityType") is None
+    assert e.get("targetEntityId") is None
+    assert e["properties"] == {"rating": 9}
+    assert e["eventTime"].startswith("2024-02-02")
+
+    py = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        "PIO_STORAGE_SOURCES_M_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path / "py_events_dup"),
+    })
+    py.get_meta_data_apps().insert(App(0, "napp"))
+    py.get_meta_data_access_keys().insert(AccessKey("nk", 1, ()))
+    py.get_l_events().init(1)
+    monkeypatch.setenv("PIO_DISABLE_NATIVE", "1")
+    r = post(py)
+    assert r.status_code == 200 and r.json()[0]["status"] == 201
+    assert _normalized(py) == native_stored
+    py.close()
