@@ -5,16 +5,24 @@ The reference's ★ ingestion hot path (SURVEY.md §3.3: POST /events.json
 HTTP — access-key auth, JSON validation, reserved-event rules, storage
 write — measuring:
 
-- single-event POSTs (the SDK default), sequential and concurrent
-- /batch/events.json at the wire cap (50 events/request)
+- single-event POSTs across a concurrency sweep (`PIO_INGEST_CONC`,
+  default "1,8,32,128"), with the write-behind group-commit buffer OFF
+  and ON (`PIO_INGEST_GROUP`), reporting enqueue→ack latency p50/p99
+  per point alongside throughput so the buffer's latency cost is
+  visible next to its throughput win
+- /batch/events.json at the wire cap (50 events/request), both modes
 - bulk import path (`pio import`-equivalent insert_batch) for contrast
 
 against the JSONL event log (the training-fast-path store of record)
-by default; PIO_INGEST_BACKEND=SQLITE|MEMORY switches.
+by default; PIO_INGEST_BACKEND=SQLITE|MEMORY switches. Ack semantics
+default to commit (PIO_INGEST_ACK) — durability unchanged.
 
 Prints ONE JSON line per mode; persists under
-BASELINE.json.published.measured_ingest_*. No accelerator involved —
-ingestion is a host path, so numbers are valid from any box.
+BASELINE.json.published.measured_ingest_* (`..._nogroup` holds the
+buffer-off sweep). `host_loop_mops` is a single-thread Python
+calibration so numbers from differently-sized hosts stay comparable —
+ingestion is a host path, CPU-bound, so cross-host absolute numbers
+are only meaningful relative to it. No accelerator involved.
 """
 
 from __future__ import annotations
@@ -32,20 +40,87 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> int:
-    sys.path.insert(0, os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "tests"))
-    import requests
-    from server_utils import ServerThread
+def host_calibration() -> float:
+    """Single-thread Python Mops — the common denominator for
+    comparing ingest numbers measured on different hosts."""
+    t0 = time.perf_counter()
+    s = 0
+    for i in range(2_000_000):
+        s += i
+    return 2.0 / (time.perf_counter() - t0)
 
-    from incubator_predictionio_tpu.data.api.event_server import EventServer
+
+import socket  # noqa: E402
+
+
+class HttpClient:
+    """Minimal keep-alive HTTP/1.1 client. `requests` costs ~1 ms of
+    CLIENT-side Python per call; on this shared-core host client and
+    server split the CPU, so a fat client measures mostly itself.
+    Ingestion is a SERVER benchmark — the client must be as thin as
+    real SDK traffic from another box. Requests are pre-serialized to
+    raw bytes before the timed region."""
+
+    def __init__(self, base_url):
+        host, port = base_url.replace("http://", "").split(":")
+        self.sock = socket.create_connection((host, int(port)))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.buf = b""
+
+    @staticmethod
+    def encode(path, obj) -> bytes:
+        body = json.dumps(obj).encode()
+        return ((f"POST {path} HTTP/1.1\r\nHost: b\r\n"
+                 f"Content-Type: application/json\r\n"
+                 f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
+
+    def send_raw(self, req: bytes) -> None:
+        self.sock.sendall(req)
+
+    def recv_response(self) -> int:
+        def recv():
+            chunk = self.sock.recv(65536)
+            if not chunk:  # server closed: fail, don't spin forever
+                raise ConnectionError("server closed connection")
+            return chunk
+
+        while b"\r\n\r\n" not in self.buf:
+            self.buf += recv()
+        head, rest = self.buf.split(b"\r\n\r\n", 1)
+        status = int(head.split(None, 2)[1])
+        clen = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":", 1)[1])
+        while len(rest) < clen:
+            rest += recv()
+        self.buf = rest[clen:]
+        return status
+
+    def post_raw(self, req: bytes) -> int:
+        self.send_raw(req)
+        return self.recv_response()
+
+    def post(self, path, obj) -> int:
+        return self.post_raw(self.encode(path, obj))
+
+    def close(self):
+        self.sock.close()
+
+
+def ev(k):
+    # deterministic per-index (thread-safe: no shared RNG state)
+    return {"event": "view", "entityType": "user",
+            "entityId": str((k * 7919) % 10000),
+            "targetEntityType": "item",
+            "targetEntityId": str((k * 104729) % 2000),
+            "eventTime": "2026-01-01T00:00:00.000Z"}
+
+
+def make_storage(backend: str, tmp: str):
     from incubator_predictionio_tpu.data.storage import Storage
     from incubator_predictionio_tpu.data.storage.base import AccessKey, App
 
-    backend = os.environ.get("PIO_INGEST_BACKEND", "JSONL").upper()
-    n_single = int(os.environ.get("PIO_INGEST_N_SINGLE", "2000"))
-    n_batch = int(os.environ.get("PIO_INGEST_N_BATCH", "40000"))
-    tmp = tempfile.mkdtemp(prefix="pio_ingest_")
     env = {
         "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
@@ -59,132 +134,184 @@ def main() -> int:
     storage = Storage(env)
     storage.get_meta_data_apps().insert(App(0, "ingest"))
     storage.get_meta_data_access_keys().insert(AccessKey("k1", 1, ()))
+    return storage
 
-    def ev(k):
-        # deterministic per-index (thread-safe: no shared RNG state)
-        return {"event": "view", "entityType": "user",
-                "entityId": str((k * 7919) % 10000),
-                "targetEntityType": "item",
-                "targetEntityId": str((k * 104729) % 2000),
-                "eventTime": "2026-01-01T00:00:00.000Z"}
 
-    import socket
+def run_single_sweep(st, concs, n_per_point):
+    """Single-event POSTs at each concurrency level; returns
+    {conc: {"events_per_sec", "p50_ms", "p99_ms"}}.
 
-    class HttpClient:
-        """Minimal keep-alive HTTP/1.1 client. `requests` costs ~1 ms of
-        CLIENT-side Python per call; on this 1-core host client and
-        server share the core, so the old numbers measured mostly the
-        client (a no-op aiohttp route serves ~11k req/s through a raw
-        socket but ~1k through requests.Session). Ingestion is a SERVER
-        benchmark — the client must be as thin as real SDK traffic from
-        another box."""
+    Concurrency = number of keep-alive CONNECTIONS, each with one
+    request in flight (the SDK pattern). A thread per connection would
+    measure GIL thrash on this shared-core host, so a bounded worker
+    pool drives conc/threads sockets each in lockstep: send on every
+    socket, then collect every response. Latency is per request,
+    send→ack."""
+    import concurrent.futures
 
-        def __init__(self, base_url):
-            host, port = base_url.replace("http://", "").split(":")
-            self.sock = socket.create_connection((host, int(port)))
-            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self.buf = b""
-
-        def post(self, path, obj) -> int:
-            body = json.dumps(obj).encode()
-            self.sock.sendall(
-                (f"POST {path} HTTP/1.1\r\nHost: b\r\n"
-                 f"Content-Type: application/json\r\n"
-                 f"Content-Length: {len(body)}\r\n\r\n").encode() + body)
-
-            def recv():
-                chunk = self.sock.recv(65536)
-                if not chunk:  # server closed: fail, don't spin forever
-                    raise ConnectionError("server closed connection")
-                return chunk
-
-            while b"\r\n\r\n" not in self.buf:
-                self.buf += recv()
-            head, rest = self.buf.split(b"\r\n\r\n", 1)
-            status = int(head.split(None, 2)[1])
-            clen = 0
-            for line in head.split(b"\r\n"):
-                if line.lower().startswith(b"content-length:"):
-                    clen = int(line.split(b":", 1)[1])
-            while len(rest) < clen:
-                rest += recv()
-            self.buf = rest[clen:]
-            return status
-
-        def close(self):
-            self.sock.close()
-
-    results = {}
-    with ServerThread(EventServer(storage).app) as st:
-        base = "/events.json?accessKey=k1"
-        bbase = "/batch/events.json?accessKey=k1"
-        cli = HttpClient(st.base)
-        assert cli.post(base, ev(0)) == 201
-
-        t0 = time.perf_counter()
-        ok = sum(cli.post(base, ev(k)) == 201 for k in range(n_single))
-        dt = time.perf_counter() - t0
-        assert ok == n_single, f"{n_single - ok} single POSTs failed"
-        results["single_seq"] = ok / dt
-        log(f"[ingest] single sequential: {ok / dt:,.0f} ev/s")
-
-        import concurrent.futures
-
-        per_worker = n_single // 8
+    base = "/events.json?accessKey=k1"
+    out = {}
+    for conc in concs:
+        n = max(n_per_point, conc * 10)
+        # largest divisor of conc that is <= 8, so threads * conns/thread
+        # covers conc EXACTLY for any sweep value (12, 20, 100, ...)
+        threads = max(t for t in range(1, min(8, conc) + 1)
+                      if conc % t == 0)
+        conns_per_worker = conc // threads
+        per_conn = max(1, n // conc)
 
         def worker(w):
-            c = HttpClient(st.base)
+            socks = [HttpClient(st.base) for _ in range(conns_per_worker)]
+            reqs = [[HttpClient.encode(
+                base, ev((w * conns_per_worker + i) * per_conn + j))
+                for j in range(per_conn)] for i in range(conns_per_worker)]
+            lat = np.empty(per_conn * conns_per_worker)
+            t0s = [0.0] * conns_per_worker
+            ok = 0
             try:
-                return sum(c.post(base, ev(w * per_worker + j)) == 201
-                           for j in range(per_worker))
+                for j in range(per_conn):
+                    for i, c in enumerate(socks):
+                        t0s[i] = time.perf_counter()
+                        c.send_raw(reqs[i][j])
+                    for i, c in enumerate(socks):
+                        ok += c.recv_response() == 201
+                        lat[j * conns_per_worker + i] = (
+                            time.perf_counter() - t0s[i])
             finally:
-                c.close()
+                for c in socks:
+                    c.close()
+            return ok, lat
 
         t0 = time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(8) as pool:
-            ok = sum(pool.map(worker, range(8)))
+        if threads == 1:
+            ok, lats = worker(0)
+            lats = [lats]
+        else:
+            with concurrent.futures.ThreadPoolExecutor(threads) as pool:
+                got = list(pool.map(worker, range(threads)))
+            ok = sum(g[0] for g in got)
+            lats = [g[1] for g in got]
         dt = time.perf_counter() - t0
-        assert ok == per_worker * 8, f"{per_worker * 8 - ok} failed"
-        results["single_conc8"] = ok / dt
-        log(f"[ingest] single x8 concurrent: {ok / dt:,.0f} ev/s")
+        sent = per_conn * conc
+        assert ok == sent, f"{sent - ok} single POSTs failed at c{conc}"
+        lat = np.concatenate(lats) * 1000.0
+        out[conc] = {
+            "events_per_sec": round(ok / dt, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        }
+        log(f"[ingest]   single x{conc}: {ok / dt:,.0f} ev/s  "
+            f"p50 {out[conc]['p50_ms']} ms  p99 {out[conc]['p99_ms']} ms")
+    return out
 
-        n_reqs = max(n_batch // 50, 1)
-        batches = [[ev(b * 50 + j) for j in range(50)]
-                   for b in range(n_reqs)]
+
+def run_batch50(st, n_batch):
+    bbase = "/batch/events.json?accessKey=k1"
+    n_reqs = max(n_batch // 50, 1)
+    cli = HttpClient(st.base)
+    try:
+        reqs = [HttpClient.encode(bbase, [ev(b * 50 + j) for j in range(50)])
+                for b in range(n_reqs)]
         t0 = time.perf_counter()
-        ok = sum(cli.post(bbase, b) == 200 for b in batches)
+        ok = sum(cli.post_raw(r) == 200 for r in reqs)
         dt = time.perf_counter() - t0
-        assert ok == n_reqs, f"{n_reqs - ok} batch POSTs failed"
-        sent = n_reqs * 50
-        results["batch50"] = sent / dt
-        log(f"[ingest] batch/events.json (50/req): {sent / dt:,.0f} ev/s")
+    finally:
         cli.close()
+    assert ok == n_reqs, f"{n_reqs - ok} batch POSTs failed"
+    return n_reqs * 50 / dt
 
+
+def main() -> int:
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests"))
+    from server_utils import ServerThread
+
+    from incubator_predictionio_tpu.data.api.event_server import EventServer
+
+    backend = os.environ.get("PIO_INGEST_BACKEND", "JSONL").upper()
+    n_single = int(os.environ.get("PIO_INGEST_N_SINGLE", "2000"))
+    n_batch = int(os.environ.get("PIO_INGEST_N_BATCH", "40000"))
+    concs = [int(c) for c in os.environ.get(
+        "PIO_INGEST_CONC", "1,8,32,128").split(",") if c.strip()]
+    mops = host_calibration()
+    log(f"[ingest] host calibration: {mops:.1f} python Mops")
+
+    by_mode = {}
+    for group in ("off", "on"):
+        os.environ["PIO_INGEST_GROUP"] = group
+        tmp = tempfile.mkdtemp(prefix=f"pio_ingest_{group}_")
+        storage = make_storage(backend, tmp)
+        server = EventServer(storage)
+        log(f"[ingest] --- group-commit {group} "
+            f"({server.ingest.config.to_json()}) ---")
+        with ServerThread(server.app) as st:
+            cli = HttpClient(st.base)
+            assert cli.post("/events.json?accessKey=k1", ev(0)) == 201
+            cli.close()
+            sweep = run_single_sweep(st, concs, n_single)
+            batch50 = run_batch50(st, n_batch)
+            log(f"[ingest]   batch/events.json (50/req): {batch50:,.0f} ev/s")
+        if group == "on":
+            snap = server.ingest.snapshot()
+            log(f"[ingest]   groups={snap['groupsCommitted']} "
+                f"events={snap['eventsCommitted']} "
+                f"maxGroup={snap['maxGroup']}")
+        by_mode[group] = {"sweep": sweep, "batch50": round(batch50, 1),
+                          "storage": storage}
+    os.environ.pop("PIO_INGEST_GROUP", None)
+
+    # bulk import path for contrast (storage-level, no HTTP)
     from incubator_predictionio_tpu.data.storage.event import Event
 
-    le = storage.get_l_events()
-    evs = [Event.from_json({**ev(0), "eventTime": "2026-01-01T00:00:00.000Z"})
-           for _ in range(n_batch)]
+    le = by_mode["on"]["storage"].get_l_events()
+    evs = [Event.from_json(ev(0)) for _ in range(n_batch)]
     t0 = time.perf_counter()
     le.insert_batch(evs, 1)
-    dt = time.perf_counter() - t0
-    results["insert_batch"] = n_batch / dt
-    log(f"[ingest] storage insert_batch: {n_batch / dt:,.0f} ev/s")
+    insert_batch_rate = n_batch / (time.perf_counter() - t0)
+    log(f"[ingest] storage insert_batch: {insert_batch_rate:,.0f} ev/s")
 
-    for mode, v in results.items():
-        print(json.dumps({
-            "metric": f"event ingestion {mode} ({backend.lower()})",
-            "value": round(v, 1), "unit": "events/sec",
-        }), flush=True)
+    def flat(mode):
+        sweep = by_mode[mode]["sweep"]
+        out = {f"single_c{c}": v["events_per_sec"] for c, v in sweep.items()}
+        out.update({f"single_c{c}_p50_ms": v["p50_ms"] for c, v in sweep.items()})
+        out.update({f"single_c{c}_p99_ms": v["p99_ms"] for c, v in sweep.items()})
+        out["batch50"] = by_mode[mode]["batch50"]
+        # legacy keys (r05 continuity)
+        if 1 in sweep:
+            out["single_seq"] = sweep[1]["events_per_sec"]
+        if 8 in sweep:
+            out["single_conc8"] = sweep[8]["events_per_sec"]
+        return out
+
+    results_on = flat("on")
+    results_on["insert_batch"] = round(insert_batch_rate, 1)
+    results_on["host_loop_mops"] = round(mops, 1)
+    results_off = flat("off")
+    results_off["host_loop_mops"] = round(mops, 1)
+
+    for conc in concs:
+        on = by_mode["on"]["sweep"][conc]["events_per_sec"]
+        off = by_mode["off"]["sweep"][conc]["events_per_sec"]
+        log(f"[ingest] group-commit speedup x{conc}: {on / off:.2f}x "
+            f"({off:,.0f} -> {on:,.0f} ev/s)")
+
+    for mode, res in (("group_on", results_on), ("group_off", results_off)):
+        for k, v in res.items():
+            unit = ("ms" if k.endswith("_ms") else
+                    "Mops" if k.endswith("_mops") else "events/sec")
+            print(json.dumps({
+                "metric": f"event ingestion {mode} {k} ({backend.lower()})",
+                "value": v, "unit": unit,
+            }), flush=True)
 
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BASELINE.json")
     try:
         with open(base_path) as f:
             doc = json.load(f)
-        doc.setdefault("published", {})[
-            f"measured_ingest_{backend.lower()}"] = {
-                k: round(v, 1) for k, v in results.items()}
+        pub = doc.setdefault("published", {})
+        pub[f"measured_ingest_{backend.lower()}"] = results_on
+        pub[f"measured_ingest_{backend.lower()}_nogroup"] = results_off
         with open(base_path, "w") as f:
             json.dump(doc, f, indent=2)
     except Exception as e:  # noqa: BLE001

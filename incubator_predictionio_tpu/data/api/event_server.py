@@ -41,6 +41,8 @@ from ..storage.base import AccessKey
 from ..storage.event import Event, EventValidationError, parse_event_time
 from ..storage.registry import Storage
 from ..webhooks import get_connector
+from .ingest_buffer import (ForbiddenEventError, IngestBuffer, IngestConfig,
+                            IngestOverloadError, parse_single_event)
 from .stats import Stats
 
 log = logging.getLogger("pio.eventserver")
@@ -73,10 +75,17 @@ class EventServer:
             self._key_ttl = 5.0
         self._key_cache: dict = {}  # key -> (expires_monotonic, AccessKey)
         # load-shed accounting: requests refused because the storage
-        # backend's circuit breaker is open (reported on GET /)
+        # backend's circuit breaker is open or the ingest buffer is full
+        # (reported on GET /)
         self._shed_count = 0
+        # write-behind group commit: every write handler feeds this
+        # buffer; the flusher coalesces concurrent requests into one
+        # insert_batch/append per (app, channel) group
+        self.ingest = IngestBuffer(self.storage, self.stats, self.plugins,
+                                   IngestConfig.from_env())
         self.app = web.Application(client_max_size=16 * 1024 * 1024,
                                    middlewares=[self._shed_middleware])
+        self.app.on_shutdown.append(self._drain_ingest)
         self.app.add_routes(
             [
                 web.get("/", self.handle_root),
@@ -110,6 +119,18 @@ class EventServer:
                 status=503,
                 headers={"Retry-After": str(max(1, int(e.retry_after)))},
             )
+        except IngestOverloadError as e:
+            # the write-behind buffer hit its in-flight cap (or is
+            # draining for shutdown): same backpressure contract
+            self._shed_count += 1
+            return web.json_response(
+                {"message": str(e)},
+                status=503,
+                headers={"Retry-After": str(max(1, int(e.retry_after)))},
+            )
+
+    async def _drain_ingest(self, app) -> None:
+        await self.ingest.drain()
 
     # -- auth -------------------------------------------------------------
     def _access_key_str(self, request: web.Request) -> Optional[str]:
@@ -200,29 +221,45 @@ class EventServer:
         out = {"status": "alive"}
         if self._shed_count:
             out["shedRequests"] = self._shed_count
+        snap = self.ingest.snapshot()
+        if snap["groupsCommitted"] or snap["pending"] or snap["droppedEvents"]:
+            out["ingest"] = snap
         return web.json_response(out)
 
     async def handle_create(self, request: web.Request) -> web.Response:
         access_key = await self._authorize(request)
         channel_id = await self._channel_id(request, access_key)
+        raw = await request.read()
+        if self.ingest.ack_on_enqueue:
+            # fire-and-forget ack: validate inline (same canonical path
+            # the group commit uses, so the modes cannot drift) so
+            # 400/403 are still real, then respond once queued
+            try:
+                event, body = parse_single_event(
+                    raw, access_key.events or ())
+            except EventValidationError as e:
+                self._record(access_key.appid, getattr(e, "body", None), 400)
+                return _json_error(400, str(e))
+            except ForbiddenEventError as e:
+                return _json_error(403, str(e))
+            event_id = self.ingest.enqueue_event(
+                event, body, access_key, channel_id)
+            return web.json_response({"eventId": event_id}, status=201)
+        # default (ack=commit): the raw body rides the write-behind
+        # buffer as-is — validation, id assignment, stats and plugin
+        # dispatch all happen inside the group commit, which encodes
+        # whole runs through the native codec's batch path
         try:
-            body = await request.json()
-        except (json.JSONDecodeError, UnicodeDecodeError):
-            return _json_error(400, "invalid JSON body")
-        try:
-            body = dict(body) if isinstance(body, dict) else body
-            if isinstance(body, dict):
-                body.pop("creationTime", None)  # server-assigned on ingest
-            event = Event.from_json(body)
-            self._check_event_allowed(access_key, event.event)
+            event_id = await self.ingest.ingest_raw(
+                raw, access_key, channel_id)
         except EventValidationError as e:
-            self._record(access_key.appid, body, 400)
             return _json_error(400, str(e))
-        le = self.storage.get_l_events()
-        event_id = await asyncio.to_thread(
-            le.insert, event, access_key.appid, channel_id
-        )
-        self._record(access_key.appid, body, 201)
+        except ForbiddenEventError as e:
+            return _json_error(403, str(e))
+        except (CircuitOpenError, IngestOverloadError):
+            raise  # the shed middleware owns the 503 contract
+        except Exception as e:  # noqa: BLE001 — storage fault, per request
+            return _json_error(500, f"event store error: {e}")
         return web.json_response({"eventId": event_id}, status=201)
 
     async def handle_batch(self, request: web.Request) -> web.Response:
@@ -232,9 +269,10 @@ class EventServer:
         fast = self._try_native_batch(raw, access_key, channel_id)
         if fast is not None:
             ids, lines = fast
-            le = self.storage.get_l_events()
-            await asyncio.to_thread(
-                le.insert_canonical_lines, lines, access_key.appid, channel_id)
+            # pre-encoded canonical lines ride the same buffer as single
+            # POSTs: concurrent batch requests group-commit together
+            await self.ingest.ingest_lines(
+                lines, ids, access_key, channel_id)
             return web.json_response(
                 [{"status": 201, "eventId": eid} for eid in ids])
         try:
@@ -247,12 +285,11 @@ class EventServer:
             return _json_error(
                 400, f"Batch request must have less than or equal to {MAX_BATCH_SIZE} events"
             )
-        le = self.storage.get_l_events()
         # Validate every item first (failures stay per-item, matching
         # the reference's independent-items semantics), then persist all
-        # valid events in ONE insert_batch off-thread: per-event
-        # to_thread + single inserts cost ~10x at the 50-event wire cap
-        # (one storage append + one executor hop instead of 50+50).
+        # valid events through ONE buffer submission: the group commit
+        # coalesces them — and whatever else is queued — into a single
+        # storage call instead of 50 round-trips.
         results: list[Optional[dict]] = [None] * len(body)
         valid: list[tuple[int, Event, object]] = []
         for pos, obj in enumerate(body):
@@ -268,16 +305,24 @@ class EventServer:
                 results[pos] = {"status": 400, "message": message}
                 self._record(access_key.appid, obj, 400)
         if valid:
-            event_ids = await asyncio.to_thread(
-                le.insert_batch, [e for _, e, _ in valid],
-                access_key.appid, channel_id)
-            # strict: a backend returning a short id list (e.g. a
-            # malformed remote response through the HTTP backend) must
-            # surface as a 500, not as silent nulls in a 200 body
-            for (pos, _event, obj), eid in zip(valid, event_ids,
-                                               strict=True):
+            # one atomic buffer entry for the whole request: either every
+            # valid item commits (201s below) or none did (the raised
+            # error — a retry cannot duplicate a partial prefix)
+            try:
+                event_ids = await self.ingest.ingest_events(
+                    [(event, obj if isinstance(obj, dict) else None)
+                     for _, event, obj in valid],
+                    access_key, channel_id)
+            except (CircuitOpenError, IngestOverloadError):
+                raise  # whole-request shed, PR 1 contract
+            except Exception as e:  # noqa: BLE001 — storage fault
+                for pos, _event, _obj in valid:
+                    results[pos] = {"status": 500,
+                                    "message": f"event store error: {e}"}
+                return web.json_response(results)
+            for (pos, _event, _obj), eid in zip(valid, event_ids,
+                                                strict=True):
                 results[pos] = {"status": 201, "eventId": eid}
-                self._record(access_key.appid, obj, 201)
         return web.json_response(results)
 
     async def handle_get(self, request: web.Request) -> web.Response:
@@ -374,10 +419,18 @@ class EventServer:
             self._check_event_allowed(access_key, event.event)
         except EventValidationError as e:
             return _json_error(400, str(e))
-        event_id = await asyncio.to_thread(
-            self.storage.get_l_events().insert, event, access_key.appid, channel_id
-        )
-        self._record(access_key.appid, event_json, 201)
+        # webhooks feed the same write-behind buffer as direct POSTs
+        if self.ingest.ack_on_enqueue:
+            event_id = self.ingest.enqueue_event(
+                event, event_json, access_key, channel_id)
+            return web.json_response({"eventId": event_id}, status=201)
+        try:
+            event_id = await self.ingest.ingest_event(
+                event, event_json, access_key, channel_id)
+        except (CircuitOpenError, IngestOverloadError):
+            raise
+        except Exception as e:  # noqa: BLE001 — storage fault, per request
+            return _json_error(500, f"event store error: {e}")
         return web.json_response({"eventId": event_id}, status=201)
 
     def _try_native_batch(self, raw: bytes, access_key, channel_id):

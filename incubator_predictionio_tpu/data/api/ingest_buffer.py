@@ -1,0 +1,635 @@
+"""Write-behind group-commit layer for event ingestion.
+
+The wire-batched ingest path (`/batch/events.json`, `pio import`) beats
+single-event POSTs by >20x in the baseline measurements — and the gap
+is per-event storage round-trips, not I/O capacity. This module closes
+it from the server side: every write handler enqueues into a
+per-(app_id, channel_id) queue and a flusher task coalesces queued
+events into ONE ``insert_batch``/``insert_canonical_lines`` call per
+group, so concurrent single-event POSTs transparently ride the batch
+path (the same overlap-and-coalesce discipline the training input
+pipeline applies to host->device transfers).
+
+Group formation
+    A group commits when ``PIO_INGEST_GROUP_MAX`` events are queued or
+    ``PIO_INGEST_GROUP_MS`` milliseconds have passed since the first
+    queued event, whichever comes first. The default window is 0 ms:
+    pure write-behind, where a commit starts as soon as the previous
+    one finishes and everything that arrived meanwhile rides along —
+    zero added latency for a lone client, natural batching under
+    concurrency (the discipline WAL group commit uses). A positive
+    window trades bounded latency for bigger groups; worth it when the
+    per-commit cost is high (``PIO_INGEST_FSYNC=1``).
+
+Ack semantics (``PIO_INGEST_ACK``)
+    ``commit`` (default) — each request's response waits for its
+    group's storage commit; durability is unchanged from the
+    per-event path, and each POST still gets its real event_id and its
+    real per-event error.
+    ``enqueue`` — the response is sent as soon as the (validated)
+    event is queued, for fire-and-forget SDKs; commit failures are
+    counted (``droppedEvents`` on ``GET /``) and logged, not reported
+    to the (long gone) client.
+
+Backpressure
+    Queued-but-uncommitted events are capped at
+    ``PIO_INGEST_MAX_PENDING``; beyond it :class:`IngestOverloadError`
+    is raised and the event server converts it to 503 + ``Retry-After``
+    (the PR 1 resilience convention — SDKs honour Retry-After instead
+    of piling onto a backed-up store).
+
+Shutdown
+    :meth:`IngestBuffer.drain` (wired to the aiohttp ``on_shutdown``
+    signal) stops intake, flushes every queue, and resolves or fails
+    every waiting request — none hang.
+
+Group encoding rides the native codec where possible: a run of raw
+single-event bodies is joined into one JSON array and validated +
+canonicalized by ``native.ingest_batch`` in a single C pass (the same
+fast path `/batch/events.json` uses), then appended with one write.
+The fault point ``ingest.commit`` (common.faultinject) fires once per
+group commit so chaos tests can fail a mid-group storage write
+deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import logging
+import os
+from collections import Counter
+from typing import Optional, Sequence
+
+from ...common.faultinject import fault_point
+from ..storage.event import (Event, EventValidationError, _utcnow,
+                             format_event_time, new_event_id)
+
+log = logging.getLogger("pio.ingest")
+
+Key = tuple[int, Optional[int]]
+
+
+class IngestOverloadError(RuntimeError):
+    """The in-flight cap is hit (or the buffer is draining): shed with
+    503 + Retry-After instead of queueing unboundedly."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+class ForbiddenEventError(PermissionError):
+    """Event name not in the access key's whitelist (maps to 403)."""
+
+
+class _WouldBlock(Exception):
+    """Internal: the inline (on-loop) commit found the table lock held
+    — retry the whole group off-loop. Nothing was persisted."""
+
+
+def parse_single_event(raw: bytes, whitelist=()) -> tuple[Event, dict]:
+    """The one canonical raw-body → Event path (shared by the group
+    commit and the ack=enqueue handler, so the two modes can never
+    drift): strict JSON, dict-shaped, server-assigned creationTime,
+    Event validation, whitelist. Raises EventValidationError (400) or
+    ForbiddenEventError (403)."""
+    try:
+        body = json.loads(raw)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        raise EventValidationError("invalid JSON body") from None
+    if not isinstance(body, dict):
+        raise EventValidationError("event body must be a JSON object")
+    body.pop("creationTime", None)  # server-assigned on ingest
+    try:
+        event = Event.from_json(body)
+    except EventValidationError as e:
+        e.body = body  # stats labelling without a re-parse
+        raise
+    if whitelist and event.event not in whitelist:
+        err = ForbiddenEventError(
+            f"event {event.event!r} is not allowed for this access key")
+        err.body = body
+        raise err
+    return event, body
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class IngestConfig:
+    """Resolved group-commit knobs (all overridable via environment)."""
+
+    __slots__ = ("enabled", "group_max", "group_ms", "ack", "max_pending")
+
+    def __init__(self, enabled: bool = True, group_max: int = 256,
+                 group_ms: float = 0.0, ack: str = "commit",
+                 max_pending: int = 10_000):
+        self.enabled = enabled
+        self.group_max = max(1, group_max)
+        self.group_ms = max(0.0, group_ms)
+        self.ack = ack if ack in ("commit", "enqueue") else "commit"
+        self.max_pending = max(1, max_pending)
+
+    @classmethod
+    def from_env(cls) -> "IngestConfig":
+        mode = os.environ.get("PIO_INGEST_GROUP", "auto").strip().lower()
+        return cls(
+            enabled=mode not in ("off", "0", "false", "no"),
+            group_max=_env_int("PIO_INGEST_GROUP_MAX", 256),
+            group_ms=_env_float("PIO_INGEST_GROUP_MS", 0.0),
+            ack=os.environ.get("PIO_INGEST_ACK", "commit").strip().lower(),
+            max_pending=_env_int("PIO_INGEST_MAX_PENDING", 10_000),
+        )
+
+    def to_json(self) -> dict:
+        return {"enabled": self.enabled, "groupMax": self.group_max,
+                "groupMs": self.group_ms, "ack": self.ack,
+                "maxPending": self.max_pending}
+
+
+_RAW, _EVENT, _EVENTS, _LINES = 0, 1, 2, 3
+
+
+class _Pending:
+    """One queued submission: a raw single-event body (hot path), a
+    validated Event, a whole validated multi-event request (`/batch` —
+    one entry so it can never straddle a group boundary and partially
+    commit), or pre-encoded canonical lines (the batch native fast
+    path). ``future`` is None for fire-and-forget (ack=enqueue)."""
+
+    __slots__ = ("kind", "payload", "body", "ids", "whitelist", "future",
+                 "n")
+
+    def __init__(self, kind: int, payload, body=None, ids=None,
+                 whitelist=(), future=None, n=1):
+        self.kind = kind
+        self.payload = payload
+        self.body = body          # parsed dict(s) for stats/plugins
+        self.ids = ids            # preset event id(s)
+        self.whitelist = whitelist
+        self.future = future
+        self.n = n                # events carried (EVENTS/LINES may be > 1)
+
+
+class _KeyState:
+    __slots__ = ("deque", "wake", "full", "task", "pending_events",
+                 "pending_multi")
+
+    def __init__(self):
+        self.deque: collections.deque[_Pending] = collections.deque()
+        self.wake = asyncio.Event()
+        self.full = asyncio.Event()
+        self.task: Optional[asyncio.Task] = None
+        self.pending_events = 0
+        self.pending_multi = 0  # queued entries already carrying >1 event
+
+
+class IngestBuffer:
+    """Per-key write-behind queues + flusher tasks over one storage."""
+
+    def __init__(self, storage, stats, plugins,
+                 config: Optional[IngestConfig] = None):
+        self.storage = storage
+        self.stats = stats
+        self.plugins = plugins
+        self.config = config or IngestConfig.from_env()
+        self._keys: dict[Key, _KeyState] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pending = 0
+        self._draining = False
+        # observability (GET / and tests)
+        self.groups_committed = 0
+        self.events_committed = 0
+        self.max_group = 0
+        self.dropped = 0
+
+    @property
+    def ack_on_enqueue(self) -> bool:
+        return self.config.enabled and self.config.ack == "enqueue"
+
+    def _inline_commit_ok(self) -> bool:
+        """True when the event store advertises sub-millisecond,
+        non-blocking-ish commits (embedded backends); remote backends
+        (HTTP/HBase/ES) always commit off-loop."""
+        try:
+            probe = getattr(self.storage.get_l_events(),
+                            "inline_commit_ok", None)
+            return bool(probe and probe())
+        except Exception:  # noqa: BLE001 — storage down; commit will report
+            return False
+
+    def snapshot(self) -> dict:
+        return {
+            "enabled": self.config.enabled,
+            "pending": self._pending,
+            "groupsCommitted": self.groups_committed,
+            "eventsCommitted": self.events_committed,
+            "maxGroup": self.max_group,
+            "droppedEvents": self.dropped,
+        }
+
+    # -- submission (event-loop side) --------------------------------------
+    def _bind_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        if self._loop is not loop:
+            # a fresh server loop (tests restart servers): any state from
+            # a previous, now-closed loop is unusable — start clean
+            self._loop = loop
+            self._keys = {}
+            self._pending = 0
+            self._draining = False
+
+    def _admit(self, n: int) -> None:
+        if self._draining:
+            raise IngestOverloadError("event server is shutting down")
+        if self._pending + n > self.config.max_pending:
+            raise IngestOverloadError(
+                f"ingest buffer full ({self._pending} events pending); "
+                "retry later",
+                retry_after=max(1.0, self.config.group_ms / 1000.0))
+
+    def _enqueue(self, key: Key, entry: _Pending, admit: bool = True) -> None:
+        self._bind_loop()
+        if admit:
+            self._admit(entry.n)
+        st = self._keys.get(key)
+        if st is None:
+            st = self._keys[key] = _KeyState()
+            st.task = self._loop.create_task(self._run_key(key, st))
+        st.deque.append(entry)
+        st.pending_events += entry.n
+        if entry.n > 1:
+            st.pending_multi += 1
+        self._pending += entry.n
+        st.wake.set()
+        if st.pending_events >= self.config.group_max or st.pending_multi:
+            st.full.set()
+
+    async def _passthrough(self, key: Key, entry: _Pending):
+        results = await asyncio.to_thread(self._commit_group, key, [entry])
+        self._note_group(entry.n)
+        res = results[0]
+        if isinstance(res, Exception):
+            raise res
+        return res
+
+    async def ingest_raw(self, raw: bytes, access_key, channel_id) -> str:
+        """Single-event POST hot path: the raw body is enqueued as-is and
+        validated inside the group commit (native C pass when the whole
+        run qualifies). Returns the stored event id; raises
+        EventValidationError / ForbiddenEventError / storage errors."""
+        key = (access_key.appid, channel_id)
+        entry = _Pending(_RAW, raw, whitelist=access_key.events or ())
+        if not self.config.enabled:
+            return await self._passthrough(key, entry)
+        entry.future = asyncio.get_running_loop().create_future()
+        self._enqueue(key, entry)
+        return await entry.future
+
+    async def ingest_event(self, event: Event, body: Optional[dict],
+                           access_key, channel_id) -> str:
+        """Pre-validated single event (webhooks)."""
+        key = (access_key.appid, channel_id)
+        entry = _Pending(_EVENT, event, body=body)
+        if not self.config.enabled:
+            return await self._passthrough(key, entry)
+        entry.future = asyncio.get_running_loop().create_future()
+        self._enqueue(key, entry)
+        return await entry.future
+
+    def enqueue_event(self, event: Event, body: Optional[dict],
+                      access_key, channel_id) -> str:
+        """Fire-and-forget (ack=enqueue): assign the id now, return
+        immediately; the commit happens behind the ack."""
+        key = (access_key.appid, channel_id)
+        eid = event.event_id or new_event_id()
+        entry = _Pending(_EVENT, event, body=body, ids=[eid])
+        self._enqueue(key, entry)
+        return eid
+
+    async def ingest_events(self, events_bodies: Sequence[tuple],
+                            access_key, channel_id) -> list[str]:
+        """Validated multi-event submission (`/batch/events.json` python
+        path). ONE queue entry for the whole request — it commits
+        atomically (never split across groups), so a storage failure
+        means NOTHING of this request persisted and the client may
+        safely retry without duplicating. Returns the event ids in
+        order; raises on commit failure."""
+        key = (access_key.appid, channel_id)
+        entry = _Pending(_EVENTS, [ev for ev, _ in events_bodies],
+                         body=[b for _, b in events_bodies],
+                         n=len(events_bodies))
+        if not self.config.enabled:
+            return await self._passthrough(key, entry)
+        entry.future = asyncio.get_running_loop().create_future()
+        self._enqueue(key, entry)
+        return await entry.future
+
+    async def ingest_lines(self, lines: bytes, ids: list[str],
+                           access_key, channel_id) -> list[str]:
+        """Pre-encoded canonical JSONL (the batch native fast path —
+        ids already assigned); commits with the group."""
+        key = (access_key.appid, channel_id)
+        entry = _Pending(_LINES, lines, ids=ids, n=len(ids))
+        if not self.config.enabled:
+            return await self._passthrough(key, entry)
+        entry.future = asyncio.get_running_loop().create_future()
+        self._enqueue(key, entry)
+        return await entry.future
+
+    async def drain(self) -> None:
+        """Stop intake, flush every queue, settle every future."""
+        self._draining = True
+        tasks = [st.task for st in self._keys.values() if st.task]
+        for st in self._keys.values():
+            st.wake.set()
+            st.full.set()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    # -- flusher (one task per key) ----------------------------------------
+    async def _run_key(self, key: Key, st: _KeyState) -> None:
+        """Outer shell: the loop in _flush_loop must never die silently —
+        if it somehow does, every queued request is failed (not hung)
+        and the key slot is cleared so the next submit starts a fresh
+        flusher."""
+        try:
+            await self._flush_loop(key, st)
+        except Exception as e:  # noqa: BLE001 — defensive backstop
+            log.exception("ingest flusher for %s died; failing its queue",
+                          key)
+            while st.deque:
+                entry = st.deque.popleft()
+                st.pending_events -= entry.n
+                self._pending -= entry.n
+                if entry.future is not None and not entry.future.done():
+                    entry.future.set_exception(e)
+            if self._keys.get(key) is st:
+                del self._keys[key]
+
+    async def _flush_loop(self, key: Key, st: _KeyState) -> None:
+        cfg = self.config
+        while True:
+            if not st.deque:
+                if self._draining:
+                    break
+                st.wake.clear()
+                if st.deque or self._draining:
+                    continue
+                await st.wake.wait()
+                continue
+            if (cfg.group_ms > 0 and not self._draining
+                    and st.pending_events < cfg.group_max
+                    and not st.pending_multi):
+                # collection window: up to group_ms since the first queued
+                # event, cut short the moment the group fills. Skipped
+                # when a wire-batched entry is queued — those are already
+                # coalesced, and stalling a lone /batch client for the
+                # window would cost more than further grouping buys.
+                st.full.clear()
+                if not (st.pending_events >= cfg.group_max
+                        or st.pending_multi):
+                    try:
+                        await asyncio.wait_for(
+                            st.full.wait(), cfg.group_ms / 1000.0)
+                    except asyncio.TimeoutError:
+                        pass
+            group: list[_Pending] = []
+            n_events = 0
+            while st.deque and n_events < cfg.group_max:
+                nxt = st.deque[0]
+                if group and n_events + nxt.n > cfg.group_max:
+                    break
+                st.deque.popleft()
+                group.append(nxt)
+                n_events += nxt.n
+                if nxt.n > 1:
+                    st.pending_multi -= 1
+            try:
+                if self._inline_commit_ok():
+                    # embedded fast store (JSONL/memory, no fsync): the
+                    # write is a lock-protected buffered append — cheaper
+                    # to run on the loop than to pay an executor
+                    # round-trip per group. If the table lock is held
+                    # (e.g. a reader mid scan-refresh), the store
+                    # refuses instead of blocking the loop and the
+                    # group retries off-loop.
+                    try:
+                        results = self._commit_group(key, group,
+                                                     inline=True)
+                    except _WouldBlock:
+                        results = await asyncio.to_thread(
+                            self._commit_group, key, group)
+                else:
+                    results = await asyncio.to_thread(
+                        self._commit_group, key, group)
+            except Exception as e:  # noqa: BLE001 — backstop, must not die
+                log.exception("ingest group commit failed")
+                results = [e] * len(group)
+            st.pending_events -= n_events
+            self._pending -= n_events
+            self._note_group(n_events)
+            for entry, res in zip(group, results):
+                if entry.future is None:
+                    if isinstance(res, Exception):
+                        self.dropped += entry.n
+                        log.error("dropped %d enqueue-acked event(s): %s",
+                                  entry.n, res)
+                    continue
+                if entry.future.done():  # client gone (await cancelled)
+                    continue
+                if isinstance(res, Exception):
+                    entry.future.set_exception(res)
+                else:
+                    entry.future.set_result(res)
+
+    def _note_group(self, n_events: int) -> None:
+        self.groups_committed += 1
+        self.events_committed += n_events
+        if n_events > self.max_group:
+            self.max_group = n_events
+
+    # -- commit (worker-thread or inline loop side) ------------------------
+    def _commit_group(self, key: Key, group: list[_Pending],
+                      inline: bool = False) -> list:
+        """Validate/encode every entry, persist all surviving events in
+        ONE storage call, record stats once. Returns one result per
+        entry in order: event id (RAW/EVENT), id list (EVENTS/LINES),
+        or the exception that failed it. Per-entry validation failures
+        stay per-entry; a storage fault fails exactly the entries that
+        were part of the write. With ``inline`` the storage append must
+        not block (raises :class:`_WouldBlock` — nothing persisted, no
+        stats recorded — and the caller retries off-loop)."""
+        app_id, channel_id = key
+        le = self.storage.get_l_events()
+        supports_lines = hasattr(le, "insert_canonical_lines")
+        results: list = [None] * len(group)
+        stat_counts: Counter = Counter()
+        # ordered write plan: canonical lines OR (entry, event, id) rows
+        lines_parts: list[bytes] = []
+        events_plan: list[tuple[Event, str]] = []
+        committed: list[int] = []  # entry positions riding the write
+
+        def plan_event(event: Event, preset: Optional[str]) -> str:
+            eid = preset or event.event_id or new_event_id()
+            if supports_lines:
+                # same encoding insert_batch uses: inject the id into the
+                # serialized dict (dataclasses.replace costs 14 us/event)
+                d = event.to_json()
+                d["eventId"] = eid
+                lines_parts.append(json.dumps(d).encode("utf-8") + b"\n")
+            else:
+                events_plan.append((event, eid))
+            return eid
+
+        def parse_raw(pos: int, entry: _Pending) -> None:
+            try:
+                event, body = parse_single_event(entry.payload,
+                                                 entry.whitelist)
+            except (EventValidationError, ForbiddenEventError) as e:
+                results[pos] = e
+                b = getattr(e, "body", None) or {}
+                status = 403 if isinstance(e, ForbiddenEventError) else 400
+                stat_counts[(app_id, b.get("event", "?"),
+                             b.get("entityType", "?"), status)] += 1
+                return
+            entry.body = body
+            results[pos] = plan_event(
+                event, entry.ids[0] if entry.ids else None)
+            committed.append(pos)
+
+        native_ok = (supports_lines and self.stats is None
+                     and not self.plugins.plugins)
+        i = 0
+        while i < len(group):
+            entry = group[i]
+            if entry.kind == _LINES:
+                lines_parts.append(entry.payload)
+                results[i] = entry.ids
+                committed.append(i)
+                i += 1
+                continue
+            if entry.kind == _EVENT:
+                results[i] = plan_event(
+                    entry.payload, entry.ids[0] if entry.ids else None)
+                committed.append(i)
+                i += 1
+                continue
+            if entry.kind == _EVENTS:
+                # a whole /batch request: atomic within the group
+                results[i] = [plan_event(ev, None) for ev in entry.payload]
+                committed.append(i)
+                i += 1
+                continue
+            # RAW: take the longest contiguous run and try ONE native pass
+            j = i
+            while (j < len(group) and group[j].kind == _RAW
+                   and not group[j].whitelist and group[j].ids is None):
+                j += 1
+            run = group[i:j] if (native_ok and j > i) else []
+            nat = None
+            if run:
+                try:
+                    from ...native import NativeUnavailable, ingest_batch
+
+                    nat = ingest_batch(
+                        b"[" + b",".join(e.payload for e in run) + b"]",
+                        len(run), format_event_time(_utcnow()))
+                except NativeUnavailable:
+                    nat = None
+                except Exception:  # noqa: BLE001 — never 500 on fast path
+                    log.exception(
+                        "native group encode failed; using python path")
+                    nat = None
+            if nat is not None:
+                ids, lines = nat
+                lines_parts.append(lines)
+                for off, eid in enumerate(ids):
+                    results[i + off] = eid
+                    committed.append(i + off)
+                i = j
+                continue
+            if run:
+                # native bounced the run (a validation failure or a
+                # client-supplied id somewhere in it): python-parse the
+                # WHOLE run once — per-event error semantics, no rescans
+                for off, e in enumerate(run):
+                    parse_raw(i + off, e)
+                i = j
+                continue
+            parse_raw(i, entry)
+            i += 1
+
+        if committed:
+            storage_error = None
+            try:
+                fault_point("ingest.commit")
+                if supports_lines:
+                    if events_plan:  # pragma: no cover — plans are exclusive
+                        raise AssertionError("mixed write plan")
+                    data = b"".join(lines_parts)
+                    nowait = (getattr(le, "try_insert_canonical_lines",
+                                      None) if inline else None)
+                    if nowait is not None:
+                        if not nowait(data, app_id, channel_id):
+                            raise _WouldBlock()
+                    else:
+                        le.insert_canonical_lines(data, app_id, channel_id)
+                else:
+                    # preset ids make the returned list a pure echo; the
+                    # strict zip still catches a short remote response
+                    ids = le.insert_batch(
+                        [e.with_event_id(eid) for e, eid in events_plan],
+                        app_id, channel_id)
+                    for (_e, eid), got in zip(events_plan, ids,
+                                              strict=True):
+                        if got != eid:  # pragma: no cover — contract
+                            raise RuntimeError(
+                                f"backend rewrote event id {eid} -> {got}")
+            except _WouldBlock:
+                raise  # nothing persisted, no stats: safe to retry
+            except Exception as e:  # noqa: BLE001 — surfaced per request
+                storage_error = e
+            if storage_error is not None:
+                for pos in committed:
+                    results[pos] = storage_error
+            else:
+                for pos in committed:
+                    entry = group[pos]
+                    if self.stats is not None:
+                        if entry.kind == _LINES:
+                            stat_counts[(app_id, "?", "?", 201)] += entry.n
+                        elif entry.kind == _EVENTS:
+                            for b in (entry.body or []):
+                                b = b or {}
+                                stat_counts[(app_id, b.get("event", "?"),
+                                             b.get("entityType", "?"),
+                                             201)] += 1
+                        else:
+                            b = entry.body or {}
+                            stat_counts[(app_id, b.get("event", "?"),
+                                         b.get("entityType", "?"),
+                                         201)] += 1
+                    if self.plugins.plugins and entry.body is not None:
+                        if entry.kind == _EVENTS:
+                            for b in entry.body:
+                                if b is not None:
+                                    self.plugins.on_event(b)
+                        else:
+                            self.plugins.on_event(entry.body)
+        if self.stats is not None and stat_counts:
+            self.stats.record_many(stat_counts)
+        return results

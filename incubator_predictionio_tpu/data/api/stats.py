@@ -18,6 +18,15 @@ class Stats:
         with self._lock:
             self._counts[(app_id, event_name, entity_type, status)] += 1
 
+    def record_many(self, counts) -> None:
+        """Batched accounting: ONE lock acquisition for a whole commit
+        group (the group-commit flusher records every event of a group
+        here — taking the contended lock once per event would serialize
+        the flusher against `/stats.json` readers). ``counts`` maps
+        (app_id, event, entityType, status) -> increment."""
+        with self._lock:
+            self._counts.update(counts)
+
     def to_json(self, app_id: int | None = None) -> dict:
         with self._lock:
             items = [

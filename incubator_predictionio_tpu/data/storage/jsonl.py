@@ -193,13 +193,55 @@ class _LogScan:
         return mask
 
 
+def _fsync_enabled() -> bool:
+    return os.environ.get("PIO_INGEST_FSYNC", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+class _TableState:
+    """Per-(app, channel) log state: its own lock plus a persistent
+    append handle. One event POST used to pay open()+write+close under a
+    single store-wide RLock — serializing every app and channel behind
+    one mutex and three syscalls per event. Now appends to different
+    tables run concurrently and each group commit is one write (plus an
+    optional fsync) on a long-lived handle."""
+
+    __slots__ = ("lock", "fh")
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.fh = None
+
+    def append(self, path: str, data: bytes) -> None:
+        """Caller holds ``lock``."""
+        fh = self.fh
+        if fh is None or fh.closed:
+            fh = self.fh = open(path, "ab")
+        fh.write(data)
+        fh.flush()
+        if _fsync_enabled():
+            os.fsync(fh.fileno())
+
+    def close(self) -> None:
+        """Caller holds ``lock``."""
+        if self.fh is not None:
+            try:
+                self.fh.close()
+            finally:
+                self.fh = None
+
+
 class JSONLEvents(base.LEvents):
     """LEvents + bulk scan over append-only logs."""
 
     def __init__(self, basedir: str) -> None:
         self._dir = basedir
         os.makedirs(basedir, exist_ok=True)
-        self._lock = threading.RLock()
+        # _meta guards only the table/scan REGISTRIES; all file and scan
+        # work happens under the per-table lock. Lock order: a table
+        # lock may be held while taking _meta, never the reverse.
+        self._meta = threading.Lock()
+        self._tables: dict[str, _TableState] = {}
         self._scans: dict[str, _LogScan] = {}
 
     # -- paths ------------------------------------------------------------
@@ -207,30 +249,75 @@ class JSONLEvents(base.LEvents):
         suffix = f"_{channel_id}" if channel_id is not None else ""
         return os.path.join(self._dir, f"events_{app_id}{suffix}.jsonl")
 
+    def _state(self, path: str) -> _TableState:
+        with self._meta:
+            state = self._tables.get(path)
+            if state is None:
+                state = self._tables[path] = _TableState()
+            return state
+
     def _scan(self, app_id: int, channel_id: Optional[int]) -> _LogScan:
         path = self._path(app_id, channel_id)
-        with self._lock:
+        state = self._state(path)
+        with self._meta:
             scan = self._scans.setdefault(path, _LogScan())
+        with state.lock:
             scan.refresh(path)
             return scan
 
     def _append(self, path: str, lines: list[str]) -> None:
-        with self._lock:
-            with open(path, "a", encoding="utf-8") as f:
-                f.write("".join(lines))
+        state = self._state(path)
+        with state.lock:
+            state.append(path, "".join(lines).encode("utf-8"))
+
+    def close(self) -> None:
+        """Release cached append handles (drain/shutdown path)."""
+        with self._meta:
+            states = list(self._tables.values())
+        for state in states:
+            with state.lock:
+                state.close()
+
+    def inline_commit_ok(self) -> bool:
+        """Group-commit hint: a buffered append is cheap enough to run
+        on the server's event loop — unless every group fsyncs."""
+        return not _fsync_enabled()
+
+    def try_insert_canonical_lines(
+        self, lines: bytes, app_id: int, channel_id: Optional[int] = None
+    ) -> bool:
+        """Non-blocking ``insert_canonical_lines`` for the group-commit
+        flusher's inline (on-loop) path: appends only if the table lock
+        is immediately free. A concurrent reader may hold that lock for
+        a full scan refresh (seconds on a cold multi-GB log) — the
+        event loop must never wait behind it. False = take the blocking
+        path off-loop."""
+        path = self._path(app_id, channel_id)
+        state = self._state(path)
+        if not state.lock.acquire(blocking=False):
+            return False
+        try:
+            state.append(path, lines)
+        finally:
+            state.lock.release()
+        return True
 
     # -- LEvents contract -------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         path = self._path(app_id, channel_id)
-        with self._lock:
+        state = self._state(path)
+        with state.lock:
             if not os.path.exists(path):
                 open(path, "a").close()
         return True
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         path = self._path(app_id, channel_id)
-        with self._lock:
-            self._scans.pop(path, None)
+        state = self._state(path)
+        with state.lock:
+            state.close()
+            with self._meta:
+                self._scans.pop(path, None)
             try:
                 os.remove(path)
             except OSError:
@@ -271,11 +358,13 @@ class JSONLEvents(base.LEvents):
         """Append pre-serialized canonical JSONL (the native ingest fast
         path — native.ingest_batch already validated and formatted every
         line; re-parsing into Event objects here would throw that work
-        away). The buffer must be newline-terminated canonical records."""
+        away). The buffer must be newline-terminated canonical records.
+        One write (+ optional fsync, PIO_INGEST_FSYNC) per call — this
+        is the group-commit landing point."""
         path = self._path(app_id, channel_id)
-        with self._lock:
-            with open(path, "ab") as f:
-                f.write(lines)
+        state = self._state(path)
+        with state.lock:
+            state.append(path, lines)
 
     def _row_event(self, cols: ColumnarEvents, i: int) -> Event:
         return Event.from_json(cols.record_dict(i))
@@ -308,7 +397,8 @@ class JSONLEvents(base.LEvents):
         import json
 
         event_ids = list(event_ids)
-        with self._lock:
+        state = self._state(self._path(app_id, channel_id))
+        with state.lock:
             scan = self._scan(app_id, channel_id)
             if scan.cols is None:
                 return [False] * len(event_ids)
@@ -550,7 +640,8 @@ class JSONLEvents(base.LEvents):
         (the reference's SelfCleaningDataSource writes a compacted stream
         back — core/.../core/SelfCleaningDataSource.scala)."""
         path = self._path(app_id, channel_id)
-        with self._lock:
+        state = self._state(path)
+        with state.lock:
             scan = self._scan(app_id, channel_id)
             cols = scan.cols
             if cols is None:
@@ -562,8 +653,10 @@ class JSONLEvents(base.LEvents):
                 for i in rows:
                     s, e = cols.span[i]
                     f.write(cols.raw[s:e] + b"\n")
+            state.close()  # the cached append handle points at the old file
             os.replace(tmp, path)
-            self._scans.pop(path, None)
+            with self._meta:
+                self._scans.pop(path, None)
             return int(rows.size)
 
 
@@ -622,3 +715,9 @@ class JSONLClient(base.BaseStorageClient):
 
     def p_events(self, namespace: str = "pio_eventdata") -> JSONLPEvents:
         return JSONLPEvents(self.l_events(namespace))
+
+    def close(self) -> None:
+        with self._lock:
+            stores = list(self._l.values())
+        for store in stores:
+            store.close()

@@ -1,0 +1,548 @@
+"""Write-behind group-commit ingestion (data/api/ingest_buffer.py).
+
+Covers the acceptance contract of the group-commit layer:
+- stored-event parity between buffered and unbuffered paths (same
+  events, same order within a key, same event_ids returned)
+- real per-request errors through the buffer (400/403/500)
+- mid-group storage faults (PIO_FAULT_SPEC) fail exactly the affected
+  requests, leave no partial writes, and a retry does not duplicate
+- drain-on-shutdown settles every queued request — none hang
+- bounded in-flight cap sheds with 503 + Retry-After
+- ack=enqueue fire-and-forget semantics
+- batched stats accounting
+- webhooks ride the same buffer (e2e through the event server)
+- guard: the event server's hot handlers contain no per-event insert()
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+import requests
+
+from incubator_predictionio_tpu.common import faultinject
+from incubator_predictionio_tpu.data.api.event_server import EventServer
+from incubator_predictionio_tpu.data.api.ingest_buffer import (
+    IngestBuffer, IngestConfig)
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import AccessKey, App, Channel
+
+from server_utils import ServerThread
+
+T = "2026-01-01T00:00:00.000Z"
+
+
+def _jsonl_storage(tmp_path, name="ev"):
+    env = {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "M",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "M",
+        "PIO_STORAGE_SOURCES_M_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_EV_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path / name),
+    }
+    storage = Storage(env)
+    app_id = storage.get_meta_data_apps().insert(App(0, "ingestapp"))
+    key = storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    cid = storage.get_meta_data_channels().insert(
+        Channel(0, "mobile", app_id))
+    return storage, app_id, key, cid
+
+
+def _ev(i, **kw):
+    d = {"event": "view", "entityType": "user", "entityId": f"u{i}",
+         "targetEntityType": "item", "targetEntityId": f"i{i}",
+         "eventTime": T}
+    d.update(kw)
+    return d
+
+
+def _strip(e):
+    d = e.to_json()
+    d.pop("eventId", None)
+    d.pop("creationTime", None)
+    return d
+
+
+def _drive_workload(storage, key):
+    """The mixed workload used for cross-mode parity: singles (valid,
+    invalid, client-supplied id), a batch with a bad item, a webhook,
+    and a channelled event. Returns (responses, stored, stored_chan)."""
+    server = EventServer(storage)
+    out = []
+    with ServerThread(server.app) as st:
+        u = f"{st.base}/events.json?accessKey={key}"
+        for i in range(3):
+            out.append(requests.post(u, json=_ev(i)))
+        out.append(requests.post(u, json={"event": "", "entityType": "u",
+                                          "entityId": "x"}))  # 400
+        out.append(requests.post(u, json=_ev(7, eventId="ab" * 16)))
+        out.append(requests.post(
+            f"{st.base}/batch/events.json?accessKey={key}",
+            json=[_ev(10), {"event": "$unset", "entityType": "user",
+                            "entityId": "u11"},  # missing properties → 400
+                  _ev(12, properties={"a": 1})]))
+        out.append(requests.post(
+            f"{st.base}/webhooks/segmentio.json?accessKey={key}",
+            json={"type": "track", "userId": "u9", "event": "Signed Up",
+                  "properties": {"plan": "Pro"}, "timestamp": T}))
+        out.append(requests.post(u + "&channel=mobile", json=_ev(20)))
+    app_id = 1
+    stored = list(storage.get_l_events().find(app_id))
+    stored_chan = list(storage.get_l_events().find(app_id, channel_id=1))
+    return out, stored, stored_chan
+
+
+def test_parity_buffered_vs_unbuffered(tmp_path, monkeypatch):
+    """Same workload, buffer off vs on: identical statuses, identical
+    stored events in identical order per key, and every 201's returned
+    eventId is the stored eventId at that position."""
+    runs = {}
+    for mode in ("off", "on"):
+        monkeypatch.setenv("PIO_INGEST_GROUP", mode)
+        storage, _app_id, key, _cid = _jsonl_storage(tmp_path, f"ev_{mode}")
+        resp, stored, stored_chan = _drive_workload(storage, key)
+        runs[mode] = (resp, stored, stored_chan)
+
+    off_resp, off_stored, off_chan = runs["off"]
+    on_resp, on_stored, on_chan = runs["on"]
+    assert [r.status_code for r in off_resp] == \
+        [r.status_code for r in on_resp]
+    # batch per-item statuses match
+    i_batch = 5
+    assert [x["status"] for x in off_resp[i_batch].json()] == \
+        [x["status"] for x in on_resp[i_batch].json()] == [201, 400, 201]
+    # same events, same order, both keys
+    assert [_strip(e) for e in off_stored] == [_strip(e) for e in on_stored]
+    assert [_strip(e) for e in off_chan] == [_strip(e) for e in on_chan]
+    assert len(on_stored) == 7  # 3 singles + id'd single + 2 batch + webhook
+
+    def returned_ids(resp):
+        ids = []
+        for r in resp:
+            if r.status_code == 201 and "eventId" in r.json():
+                ids.append(r.json()["eventId"])
+            elif r.request.url and "batch" in r.request.url:
+                ids.extend(x["eventId"] for x in r.json()
+                           if x["status"] == 201)
+        return ids
+
+    for resp, stored, chan in (runs["off"], runs["on"]):
+        got = returned_ids(resp)
+        stored_ids = [e.event_id for e in stored] + [e.event_id for e in chan]
+        assert sorted(got) == sorted(stored_ids)
+    # the client-supplied id round-trips
+    assert any(e.event_id == "ab" * 16 for e in on_stored)
+
+
+@pytest.mark.ingest
+def test_concurrent_coalescing_no_loss_no_dup(tmp_path, monkeypatch):
+    """Concurrent single POSTs on one key: every request acked with a
+    unique id, every id stored exactly once, and the flusher actually
+    coalesced (some group > 1 event)."""
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    storage, app_id, key, _cid = _jsonl_storage(tmp_path)
+    server = EventServer(storage)
+    N, W = 60, 6
+    ids = []
+    lock = threading.Lock()
+    with ServerThread(server.app) as st:
+        u = f"{st.base}/events.json?accessKey={key}"
+
+        def worker(w):
+            s = requests.Session()
+            for j in range(N // W):
+                r = s.post(u, json=_ev(w * 100 + j))
+                assert r.status_code == 201, r.text
+                with lock:
+                    ids.append(r.json()["eventId"])
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(W)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    stored = list(storage.get_l_events().find(app_id))
+    assert len(ids) == N == len(set(ids))
+    assert sorted(e.event_id for e in stored) == sorted(ids)
+    snap = server.ingest.snapshot()
+    assert snap["eventsCommitted"] >= N
+    assert snap["maxGroup"] > 1, "no coalescing happened under concurrency"
+
+
+@pytest.mark.chaos
+def test_mid_group_fault_fails_only_affected_requests(tmp_path, monkeypatch):
+    """A storage fault during one group commit fails exactly that
+    group's requests with the real error, leaves NO partial write, and
+    a client retry stores the event exactly once."""
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    monkeypatch.setenv("PIO_FAULT_SPEC", "ingest.commit:fail:1")
+    faultinject.reset()
+    try:
+        storage, app_id, key, _cid = _jsonl_storage(tmp_path)
+        server = EventServer(storage)
+        with ServerThread(server.app) as st:
+            u = f"{st.base}/events.json?accessKey={key}"
+            r1 = requests.post(u, json=_ev(1))
+            assert r1.status_code == 500
+            assert "injected fault" in r1.json()["message"]
+            assert list(storage.get_l_events().find(app_id)) == []
+            # retry after the fault: exactly one copy, no duplicates
+            r2 = requests.post(u, json=_ev(1))
+            assert r2.status_code == 201
+            # an unrelated key is unaffected
+            r3 = requests.post(u + "&channel=mobile", json=_ev(2))
+            assert r3.status_code == 201
+        stored = list(storage.get_l_events().find(app_id))
+        assert len(stored) == 1 and stored[0].entity_id == "u1"
+        assert stored[0].event_id == r2.json()["eventId"]
+    finally:
+        monkeypatch.delenv("PIO_FAULT_SPEC")
+        faultinject.reset()
+
+
+@pytest.mark.chaos
+def test_mid_group_fault_batch_reports_per_item(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    monkeypatch.setenv("PIO_FAULT_SPEC", "ingest.commit:fail:1")
+    faultinject.reset()
+    try:
+        storage, app_id, key, _cid = _jsonl_storage(tmp_path)
+        # stats on → python batch path → per-item outcomes via buffer
+        server = EventServer(storage, enable_stats=True)
+        with ServerThread(server.app) as st:
+            r = requests.post(
+                f"{st.base}/batch/events.json?accessKey={key}",
+                json=[_ev(1), {"event": "", "entityType": "u",
+                               "entityId": "x"}, _ev(2)])
+            assert r.status_code == 200
+            statuses = [x["status"] for x in r.json()]
+            assert statuses == [500, 400, 500]  # fault hits the valid pair
+            assert "injected fault" in r.json()[0]["message"]
+        assert list(storage.get_l_events().find(app_id)) == []
+    finally:
+        monkeypatch.delenv("PIO_FAULT_SPEC")
+        faultinject.reset()
+
+
+@pytest.mark.chaos
+@pytest.mark.ingest
+def test_drain_on_shutdown_settles_all_requests(tmp_path, monkeypatch):
+    """Shutdown with requests queued behind a slow commit: every
+    request completes (none hang, none lost)."""
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    # fsync forces the off-loop commit path; latency holds the first
+    # group in flight while more requests queue behind it
+    monkeypatch.setenv("PIO_INGEST_FSYNC", "1")
+    monkeypatch.setenv("PIO_FAULT_SPEC", "ingest.commit:latency:1:0.4")
+    faultinject.reset()
+    try:
+        storage, app_id, key, _cid = _jsonl_storage(tmp_path)
+        server = EventServer(storage)
+        results = {}
+        st = ServerThread(server.app)
+        st.__enter__()
+        u = f"{st.base}/events.json?accessKey={key}"
+
+        def post(i):
+            results[i] = requests.post(u, json=_ev(i), timeout=30).status_code
+
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)  # first group is inside its slow commit
+        st.__exit__(None, None, None)  # on_shutdown → buffer.drain()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "request hung through shutdown"
+        assert sorted(results.values()) == [201] * 5
+        assert len(list(storage.get_l_events().find(app_id))) == 5
+    finally:
+        monkeypatch.delenv("PIO_FAULT_SPEC")
+        faultinject.reset()
+
+
+@pytest.mark.chaos
+def test_overload_sheds_503_with_retry_after(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    monkeypatch.setenv("PIO_INGEST_MAX_PENDING", "1")
+    monkeypatch.setenv("PIO_INGEST_FSYNC", "1")  # commit off-loop
+    monkeypatch.setenv("PIO_FAULT_SPEC", "ingest.commit:latency:1:0.6")
+    faultinject.reset()
+    try:
+        storage, _app_id, key, _cid = _jsonl_storage(tmp_path)
+        server = EventServer(storage)
+        with ServerThread(server.app) as st:
+            u = f"{st.base}/events.json?accessKey={key}"
+            codes = {}
+
+            def post(i):
+                codes[i] = requests.post(u, json=_ev(i), timeout=30)
+
+            t1 = threading.Thread(target=post, args=(1,))
+            t1.start()
+            time.sleep(0.2)  # first event is in its slow commit
+            r2 = requests.post(u, json=_ev(2), timeout=30)
+            assert r2.status_code == 503
+            assert int(r2.headers["Retry-After"]) >= 1
+            assert "full" in r2.json()["message"]
+            t1.join()
+            assert codes[1].status_code == 201
+            # capacity freed → accepted again
+            assert requests.post(u, json=_ev(3)).status_code == 201
+        assert server._shed_count >= 1
+    finally:
+        monkeypatch.delenv("PIO_FAULT_SPEC")
+        faultinject.reset()
+
+
+def test_enqueue_ack_mode(tmp_path, monkeypatch):
+    """ack=enqueue: 201 + id before the commit; the event still lands;
+    validation failures are still real 400s."""
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    monkeypatch.setenv("PIO_INGEST_ACK", "enqueue")
+    storage, app_id, key, _cid = _jsonl_storage(tmp_path)
+    server = EventServer(storage)
+    with ServerThread(server.app) as st:
+        u = f"{st.base}/events.json?accessKey={key}"
+        r = requests.post(u, json=_ev(1))
+        assert r.status_code == 201
+        eid = r.json()["eventId"]
+        assert requests.post(
+            u, json={"event": "", "entityType": "u", "entityId": "x"}
+        ).status_code == 400
+        # commit happens behind the ack; poll briefly
+        for _ in range(100):
+            got = storage.get_l_events().get(eid, app_id)
+            if got is not None:
+                break
+            time.sleep(0.02)
+        assert got is not None and got.entity_id == "u1"
+
+
+@pytest.mark.chaos
+def test_enqueue_ack_drops_are_counted(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    monkeypatch.setenv("PIO_INGEST_ACK", "enqueue")
+    monkeypatch.setenv("PIO_FAULT_SPEC", "ingest.commit:fail:1")
+    faultinject.reset()
+    try:
+        storage, app_id, key, _cid = _jsonl_storage(tmp_path)
+        server = EventServer(storage)
+        with ServerThread(server.app) as st:
+            u = f"{st.base}/events.json?accessKey={key}"
+            assert requests.post(u, json=_ev(1)).status_code == 201  # dropped
+            for _ in range(100):
+                if server.ingest.dropped:
+                    break
+                time.sleep(0.02)
+            assert server.ingest.dropped == 1
+            r = requests.get(st.base + "/")
+            assert r.json()["ingest"]["droppedEvents"] == 1
+        assert list(storage.get_l_events().find(app_id)) == []
+    finally:
+        monkeypatch.delenv("PIO_FAULT_SPEC")
+        faultinject.reset()
+
+
+def test_stats_batched_accounting(tmp_path, monkeypatch):
+    """Stats recorded once per commit group still count every event —
+    201s and 400s — exactly as the per-event path did."""
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    storage, _app_id, key, _cid = _jsonl_storage(tmp_path)
+    server = EventServer(storage, enable_stats=True)
+    with ServerThread(server.app) as st:
+        u = f"{st.base}/events.json?accessKey={key}"
+        for i in range(3):
+            assert requests.post(u, json=_ev(i)).status_code == 201
+        assert requests.post(u, json={"event": "", "entityType": "u",
+                                      "entityId": "x"}).status_code == 400
+        r = requests.post(f"{st.base}/batch/events.json?accessKey={key}",
+                          json=[_ev(10), _ev(11)])
+        assert [x["status"] for x in r.json()] == [201, 201]
+        counts = {(c["event"], c["status"]): c["count"]
+                  for c in requests.get(
+                      f"{st.base}/stats.json?accessKey={key}"
+                  ).json()["counts"]}
+    assert counts[("view", 201)] == 5
+    assert counts[("", 400)] == 1
+
+
+def test_webhooks_e2e_parity(tmp_path, monkeypatch):
+    """Webhook connectors through the full server, buffered vs not:
+    same stored events (segmentio JSON + mailchimp form), and webhook
+    events interleave in order with direct POSTs on the same key."""
+    stored_by_mode = {}
+    for mode in ("off", "on"):
+        monkeypatch.setenv("PIO_INGEST_GROUP", mode)
+        storage, app_id, key, _cid = _jsonl_storage(tmp_path, f"wh_{mode}")
+        server = EventServer(storage, enable_stats=True)
+        with ServerThread(server.app) as st:
+            r = requests.post(
+                f"{st.base}/webhooks/segmentio.json?accessKey={key}",
+                json={"type": "track", "userId": "u9", "event": "Signed Up",
+                      "properties": {"plan": "Pro"}, "timestamp": T})
+            assert r.status_code == 201, r.text
+            seg_id = r.json()["eventId"]
+            assert requests.post(
+                f"{st.base}/events.json?accessKey={key}",
+                json=_ev(1, eventTime=T)).status_code == 201
+            r = requests.post(
+                f"{st.base}/webhooks/mailchimp.json?accessKey={key}",
+                data={"type": "subscribe",
+                      "fired_at": "2026-01-01 10:00:00",
+                      "data[id]": "8a25ff1d98",
+                      "data[email]": "api@mailchimp.com"})
+            assert r.status_code == 201, r.text
+            # bad payload still a clean 400 through the buffer
+            assert requests.post(
+                f"{st.base}/webhooks/segmentio.json?accessKey={key}",
+                json={"type": "bogus", "userId": "x"}).status_code == 400
+            # stats saw the webhook events (recorded at commit)
+            counts = {(c["event"], c["status"]): c["count"]
+                      for c in requests.get(
+                          f"{st.base}/stats.json?accessKey={key}"
+                      ).json()["counts"]}
+            assert counts[("track", 201)] == 1
+            assert counts[("subscribe", 201)] == 1
+        stored = list(storage.get_l_events().find(app_id))
+        assert seg_id in [e.event_id for e in stored]
+        stored_by_mode[mode] = [_strip(e) for e in stored]
+    assert stored_by_mode["off"] == stored_by_mode["on"]
+    assert {e["event"] for e in stored_by_mode["on"]} == \
+        {"track", "view", "subscribe"}
+
+
+def test_collection_window_coalesces(tmp_path, monkeypatch):
+    """PIO_INGEST_GROUP_MS: two submissions inside the window commit as
+    ONE group (direct buffer test, no HTTP jitter)."""
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    storage, _app_id, key, _cid = _jsonl_storage(tmp_path)
+    access_key = storage.get_meta_data_access_keys().get(key)
+
+    async def drive():
+        from incubator_predictionio_tpu.workflow.plugins import (
+            EventServerPluginContext)
+
+        buf = IngestBuffer(storage, None, EventServerPluginContext(),
+                           IngestConfig(enabled=True, group_ms=200.0))
+
+        async def one(i):
+            return await buf.ingest_raw(
+                json.dumps(_ev(i)).encode(), access_key, None)
+
+        ids = await asyncio.gather(one(1), one(2))
+        await buf.drain()
+        return ids, buf
+
+    ids, buf = asyncio.run(drive())
+    assert len(set(ids)) == 2
+    assert buf.groups_committed == 1, "window did not coalesce"
+    assert buf.max_group == 2
+
+
+def test_buffer_rebinds_across_event_loops(tmp_path, monkeypatch):
+    """A buffer drained in one event loop keeps working from a fresh
+    loop (an aiohttp Application is one-loop, but storage + buffer
+    state outlive it — e.g. CLI restart paths and direct embedding)."""
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    storage, app_id, key, _cid = _jsonl_storage(tmp_path)
+    access_key = storage.get_meta_data_access_keys().get(key)
+    from incubator_predictionio_tpu.workflow.plugins import (
+        EventServerPluginContext)
+
+    buf = IngestBuffer(storage, None, EventServerPluginContext(),
+                       IngestConfig(enabled=True))
+
+    async def one(i):
+        eid = await buf.ingest_raw(
+            json.dumps(_ev(i)).encode(), access_key, None)
+        await buf.drain()
+        return eid
+
+    ids = {asyncio.run(one(1)), asyncio.run(one(2))}  # two distinct loops
+    assert len(ids) == 2
+    assert {e.event_id for e in storage.get_l_events().find(app_id)} == ids
+
+
+def test_jsonl_per_table_handles_lifecycle(tmp_path, monkeypatch):
+    """Cached append handles survive interleaved reads and reopen
+    cleanly across compact()/remove()/close(); fsync knob is honoured
+    without corrupting the log."""
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
+
+    le = JSONLEvents(str(tmp_path / "logs"))
+    e = Event.from_json(_ev(1))
+    id1 = le.insert(e, 1)
+    assert le.get(id1, 1).entity_id == "u1"  # read between cached appends
+    monkeypatch.setenv("PIO_INGEST_FSYNC", "1")
+    id2 = le.insert(Event.from_json(_ev(2)), 1)
+    monkeypatch.delenv("PIO_INGEST_FSYNC")
+    assert {ev.event_id for ev in le.find(1)} == {id1, id2}
+    assert le.delete(id1, 1)
+    assert le.compact(1) == 1  # rewrites the file under the handle
+    id3 = le.insert(Event.from_json(_ev(3)), 1)  # append after compact
+    assert {ev.event_id for ev in le.find(1)} == {id2, id3}
+    # different apps append through independent locks/handles
+    le.insert(Event.from_json(_ev(9)), 2)
+    assert len(list(le.find(2))) == 1
+    le.close()
+    id4 = le.insert(Event.from_json(_ev(4)), 1)  # reopens after close
+    assert {ev.event_id for ev in le.find(1)} == {id2, id3, id4}
+    assert le.remove(1)
+    assert list(le.find(1)) == []
+
+
+def test_guard_no_per_event_insert_in_hot_handlers():
+    """Guard (pattern of PR 1's raw-urlopen ban): the event server's
+    write handlers must feed the ingest buffer — a future edit calling
+    the per-event `insert(` DAO directly would silently bypass group
+    commit, drain and overload shedding."""
+    import ast
+    import pathlib
+
+    import incubator_predictionio_tpu
+
+    src = (pathlib.Path(incubator_predictionio_tpu.__file__).parent
+           / "data" / "api" / "event_server.py").read_text()
+    tree = ast.parse(src)
+    cls = next(n for n in ast.walk(tree)
+               if isinstance(n, ast.ClassDef) and n.name == "EventServer")
+    hot = {"handle_create", "handle_batch", "handle_webhook"}
+    seen = set()
+    offenders = []
+    for fn in ast.walk(cls):
+        if not isinstance(fn, ast.AsyncFunctionDef) or fn.name not in hot:
+            continue
+        seen.add(fn.name)
+        uses_buffer = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                if n.func.attr in ("insert", "insert_batch",
+                                   "insert_canonical_lines"):
+                    offenders.append((fn.name, n.lineno, n.func.attr))
+            if isinstance(n, ast.Attribute) and n.attr == "ingest":
+                uses_buffer = True
+        assert uses_buffer, f"{fn.name} does not feed the ingest buffer"
+    assert seen == hot
+    assert not offenders, (
+        f"per-event storage writes in hot handlers: {offenders}; "
+        "route writes through EventServer.ingest (the group-commit buffer)")
+
+
+def test_ingest_marker_registered():
+    """The `ingest` pytest marker must stay registered so the
+    load-shaped tests can be selected/deselected in CI."""
+    import pathlib
+
+    import incubator_predictionio_tpu
+
+    pyproject = (pathlib.Path(incubator_predictionio_tpu.__file__)
+                 .parent.parent / "pyproject.toml").read_text()
+    assert "ingest:" in pyproject, "ingest marker missing from pyproject"
