@@ -244,11 +244,40 @@ def main() -> int:
         server = EventServer(storage)
         log(f"[ingest] --- group-commit {group} "
             f"({server.ingest.config.to_json()}) ---")
+        tele_off_sweep = None
+        tele_ratio: dict = {}
         with ServerThread(server.app) as st:
             cli = HttpClient(st.base)
             assert cli.post("/events.json?accessKey=k1", ev(0)) == 201
             cli.close()
             sweep = run_single_sweep(st, concs, n_single)
+            if group == "on" and os.environ.get(
+                    "PIO_BENCH_TELEMETRY", "").lower() in ("1", "ab", "on"):
+                # telemetry overhead A/B/A: rerun the buffered sweep with
+                # metric recording disabled, then enabled again, IN THE
+                # SAME PROCESS/run. The off-sweep is compared against the
+                # MEAN of the two bracketing on-sweeps so monotonic
+                # drift (cache warm-up, store growth, host CPU swings —
+                # see host_loop_mops) cancels to first order instead of
+                # being booked as telemetry cost.
+                from incubator_predictionio_tpu.common import telemetry
+                telemetry.set_metrics_enabled(False)
+                try:
+                    tele_off_sweep = run_single_sweep(st, concs, n_single)
+                finally:
+                    telemetry.set_metrics_enabled(True)
+                on2 = run_single_sweep(st, concs, n_single)
+                for c in concs:
+                    mean_on = (sweep[c]["events_per_sec"]
+                               + on2[c]["events_per_sec"]) / 2
+                    without = tele_off_sweep[c]["events_per_sec"]
+                    tele_ratio[c] = mean_on / without
+                    log(f"[ingest]   telemetry on/off x{c}: "
+                        f"{tele_ratio[c]:.3f} "
+                        f"({without:,.0f} ev/s off vs "
+                        f"{mean_on:,.0f} mean-on; bracket "
+                        f"{sweep[c]['events_per_sec']:,.0f}/"
+                        f"{on2[c]['events_per_sec']:,.0f})")
             batch50 = run_batch50(st, n_batch)
             log(f"[ingest]   batch/events.json (50/req): {batch50:,.0f} ev/s")
         if group == "on":
@@ -257,7 +286,9 @@ def main() -> int:
                 f"events={snap['eventsCommitted']} "
                 f"maxGroup={snap['maxGroup']}")
         by_mode[group] = {"sweep": sweep, "batch50": round(batch50, 1),
-                          "storage": storage}
+                          "storage": storage,
+                          "tele_off_sweep": tele_off_sweep,
+                          "tele_ratio": tele_ratio}
     os.environ.pop("PIO_INGEST_GROUP", None)
 
     # bulk import path for contrast (storage-level, no HTTP)
@@ -286,6 +317,11 @@ def main() -> int:
     results_on = flat("on")
     results_on["insert_batch"] = round(insert_batch_rate, 1)
     results_on["host_loop_mops"] = round(mops, 1)
+    if by_mode["on"]["tele_off_sweep"] is not None:
+        for c, v in by_mode["on"]["tele_off_sweep"].items():
+            results_on[f"single_c{c}_telemetry_off"] = v["events_per_sec"]
+            results_on[f"single_c{c}_telemetry_ratio"] = round(
+                by_mode["on"]["tele_ratio"][c], 3)
     results_off = flat("off")
     results_off["host_loop_mops"] = round(mops, 1)
 

@@ -19,7 +19,7 @@ except ImportError:  # pragma: no cover - depends on installed jax
 
     _MODERN = False
 
-__all__ = ["shard_map"]
+__all__ = ["pcast", "shard_map"]
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
@@ -36,3 +36,24 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
                           out_specs=out_specs, check_vma=check_vma)
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_rep=False)
+
+
+def pcast(x, axes, to: str = "varying"):
+    """``jax.lax.pcast`` where it exists, identity elsewhere.
+
+    The varying-manual-axes type system (jax >= 0.5 shard_map with
+    ``check_vma``) requires a replicated scan carry to be explicitly
+    cast varying before a body whose output varies over the mesh axis.
+    Old jax (0.4.x) has no ``lax.pcast`` — but this shim's
+    :func:`shard_map` always runs those installs with
+    ``check_rep=False``, where no replication typing is enforced and
+    every value is already treated as varying, so the cast is a
+    semantic no-op there: drop it. The flag only controls validation,
+    never numerics, on both paths.
+    """
+    import jax
+
+    cast = getattr(jax.lax, "pcast", None)
+    if cast is None:
+        return x
+    return cast(x, axes, to=to)

@@ -34,12 +34,56 @@ import urllib.error
 import urllib.request
 from typing import Callable, Iterable, Optional
 
-from . import faultinject
+from . import faultinject, telemetry
 
 __all__ = [
     "CircuitBreaker", "CircuitOpenError", "RetryPolicy", "RetryBudgetExceeded",
     "all_breakers", "breaker_snapshots", "is_retryable", "resilient_urlopen",
 ]
+
+
+# ---------------------------------------------------------------------------
+# telemetry: every wire transport reports through these two families
+# (labelled by the transport's fault point, e.g. "es.request",
+# "http.call", "hbase.rpc"), and the breaker registry doubles as the
+# live source of the per-endpoint breaker-state gauge.
+# ---------------------------------------------------------------------------
+
+STORAGE_OP_SECONDS = telemetry.registry().histogram(
+    "pio_storage_op_seconds",
+    "Storage transport operation latency per backend endpoint "
+    "(one observation per attempt, including failed attempts)",
+    ("backend",))
+STORAGE_OP_ERRORS = telemetry.registry().counter(
+    "pio_storage_op_errors_total",
+    "Storage transport operation failures per backend endpoint",
+    ("backend",))
+
+#: breaker-state gauge encoding (Prometheus has no string values)
+_BREAKER_STATE_CODE = {"closed": 0, "half-open": 1, "open": 2}
+
+
+def _breaker_collector():
+    """Render-time gauge family from the live breaker registry —
+    breakers are owned by storage clients (and vanish with them), so
+    their state is collected, not recorded."""
+    fam = telemetry.GaugeFamily(
+        "pio_storage_breaker_state",
+        "Circuit breaker state per endpoint (0=closed, 1=half-open, "
+        "2=open)", ("endpoint",))
+    fails = telemetry.GaugeFamily(
+        "pio_storage_breaker_failures_total",
+        "Connectivity failures accounted to each endpoint breaker",
+        ("endpoint",))
+    for snap in breaker_snapshots():
+        fam.labels(snap["name"]).set(
+            _BREAKER_STATE_CODE.get(snap["state"], -1))
+        fails.labels(snap["name"]).set(snap["failure"])
+    return [fam, fails]
+
+
+telemetry.registry().register_collector("resilience.breakers",
+                                        _breaker_collector)
 
 
 # ---------------------------------------------------------------------------
@@ -379,11 +423,21 @@ def resilient_urlopen(req: "urllib.request.Request | str", *,
     if method not in IDEMPOTENT_METHODS and not retry_non_idempotent:
         def retryable(_e: BaseException) -> bool:
             return False
+    op_lat = STORAGE_OP_SECONDS.labels(point)
+    op_err = STORAGE_OP_ERRORS.labels(point)
+
     def attempt():
         faultinject.fault_point(point)
         t = (policy.attempt_timeout(timeout)
              if policy is not None else timeout)
-        return urllib.request.urlopen(req, timeout=t, context=context)
+        t0 = telemetry.timer_start()
+        try:
+            return urllib.request.urlopen(req, timeout=t, context=context)
+        except BaseException:
+            op_err.inc()
+            raise
+        finally:
+            op_lat.observe_since(t0)
 
     if policy is None:
         # single attempt, but with the SAME breaker accounting as the

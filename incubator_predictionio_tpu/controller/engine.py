@@ -8,8 +8,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Any, Mapping, Optional, Sequence, Type
 
+from ..common import telemetry
 from .algorithm import Algorithm
 from .base import SanityCheck, doer
 from .datasource import DataSource
@@ -17,6 +19,24 @@ from .preparator import IdentityPreparator, Preparator
 from .serving import FirstServing, Serving
 
 log = logging.getLogger("pio.engine")
+
+# Per-query serving-stage latency (featurize = Serving.supplement query
+# massage, predict = every algorithm's device dispatch, serve = result
+# blend). Children pre-bound at import so the hot path pays one dict-get
+# nothing, just an observe. The batched path records the same stages
+# once per coalesced batch under batched="1".
+_STAGE_SECONDS = telemetry.registry().histogram(
+    "pio_query_stage_seconds",
+    "Per-query serving stage latency by stage "
+    "(featurize/predict/serve); batched=1 rows are one observation "
+    "per micro-batch dispatch",
+    ("stage", "batched"))
+_ST_FEATURIZE = _STAGE_SECONDS.labels("featurize", "0")
+_ST_PREDICT = _STAGE_SECONDS.labels("predict", "0")
+_ST_SERVE = _STAGE_SECONDS.labels("serve", "0")
+_ST_FEATURIZE_B = _STAGE_SECONDS.labels("featurize", "1")
+_ST_PREDICT_B = _STAGE_SECONDS.labels("predict", "1")
+_ST_SERVE_B = _STAGE_SECONDS.labels("serve", "1")
 
 
 def _as_class_map(spec) -> dict[str, Type]:
@@ -274,26 +294,51 @@ class Deployment:
         self.serving = serving
 
     def query(self, q) -> Any:
+        # Stage telemetry: histogram observations per stage, and —
+        # when the HTTP layer sampled this request (trace context
+        # propagates through asyncio.to_thread) — one span per stage.
+        tr = telemetry.current_trace()
+        t0 = (time.perf_counter_ns()
+              if tr is not None else telemetry.timer_start())
         q = self.serving.supplement(q)
+        t1 = time.perf_counter_ns() if t0 else 0
+        _ST_FEATURIZE.observe_since(t0)
         predictions = [
             algo.predict(model, q)
             for (_, algo), model in zip(self.algo_list, self.models)
         ]
-        return self.serving.serve(q, predictions)
+        t2 = time.perf_counter_ns() if t0 else 0
+        _ST_PREDICT.observe_since(t1)
+        result = self.serving.serve(q, predictions)
+        _ST_SERVE.observe_since(t2)
+        if tr is not None:
+            t3 = time.perf_counter_ns()
+            tr.add_span("query.featurize", t1 - t0)
+            tr.add_span("query.predict", t2 - t1,
+                        algorithms=len(self.algo_list))
+            tr.add_span("query.serve", t3 - t2)
+        return result
 
     def batch_query(self, queries) -> list[Any]:
         """Vectorized multi-query path (one device dispatch per
         algorithm instead of one per query) — used by the engine
         server's micro-batching window and `pio batchpredict`."""
+        t0 = telemetry.timer_start()
         qs = [self.serving.supplement(q) for q in queries]
+        t1 = time.perf_counter_ns() if t0 else 0
+        _ST_FEATURIZE_B.observe_since(t0)
         per_algo = [
             algo.batch_predict(model, qs)
             for (_, algo), model in zip(self.algo_list, self.models)
         ]
-        return [
+        t2 = time.perf_counter_ns() if t0 else 0
+        _ST_PREDICT_B.observe_since(t1)
+        out = [
             self.serving.serve(q, [pred[j] for pred in per_algo])
             for j, q in enumerate(qs)
         ]
+        _ST_SERVE_B.observe_since(t2)
+        return out
 
 
 class SimpleEngine(Engine):

@@ -34,7 +34,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from ...common import ssl_context_from_env
+from ...common import ssl_context_from_env, telemetry
 from ...common.resilience import CircuitOpenError
 from ...workflow.plugins import EventServerPluginContext
 from ..storage.base import AccessKey
@@ -83,12 +83,20 @@ class EventServer:
         # insert_batch/append per (app, channel) group
         self.ingest = IngestBuffer(self.storage, self.stats, self.plugins,
                                    IngestConfig.from_env())
-        self.app = web.Application(client_max_size=16 * 1024 * 1024,
-                                   middlewares=[self._shed_middleware])
+        # telemetry: per-instance stats counters join the process-wide
+        # registry exposition via a collector (replaced per instance —
+        # the LIVE server's counters are what /metrics shows)
+        telemetry.registry().register_collector(
+            "eventserver", self._collect_metrics)
+        self.app = web.Application(
+            client_max_size=16 * 1024 * 1024,
+            middlewares=[self._shed_middleware,
+                         telemetry.trace_middleware()])
         self.app.on_shutdown.append(self._drain_ingest)
         self.app.add_routes(
             [
                 web.get("/", self.handle_root),
+                web.get("/metrics", self.handle_metrics),
                 web.post("/events.json", self.handle_create),
                 web.get("/events.json", self.handle_find),
                 web.get("/events/{event_id}.json", self.handle_get),
@@ -225,6 +233,20 @@ class EventServer:
         if snap["groupsCommitted"] or snap["pending"] or snap["droppedEvents"]:
             out["ingest"] = snap
         return web.json_response(out)
+
+    def _collect_metrics(self):
+        """Render-time families owned by THIS server instance."""
+        if self.stats is not None:
+            return [self.stats.family]
+        return []
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of the process registry: ingest
+        histograms/counters, storage transport latency + breaker state,
+        and (with --stats) the per-app event counters. Unauthenticated
+        like GET / — scrapers don't carry access keys."""
+        return web.Response(text=telemetry.render_all(),
+                            content_type="text/plain")
 
     async def handle_create(self, request: web.Request) -> web.Response:
         access_key = await self._authorize(request)

@@ -62,11 +62,32 @@ import os
 from collections import Counter
 from typing import Optional, Sequence
 
+from ...common import telemetry
 from ...common.faultinject import fault_point
 from ..storage.event import (Event, EventValidationError, _utcnow,
                              format_event_time, new_event_id)
 
 log = logging.getLogger("pio.ingest")
+
+# Telemetry (process-wide registry): the group-commit accounting that
+# used to live only in ad-hoc instance counters now ALSO feeds scrapable
+# histograms — queue wait (enqueue → group formation), commit duration,
+# and group size. The JSON snapshot() view stays per-instance below.
+_M_QUEUE_WAIT = telemetry.registry().histogram(
+    "pio_ingest_queue_wait_seconds",
+    "Time an event waits in the write-behind buffer before its group "
+    "commit is formed").labels()
+_M_COMMIT = telemetry.registry().histogram(
+    "pio_ingest_commit_seconds",
+    "Storage commit duration per ingest group").labels()
+_M_GROUP_SIZE = telemetry.registry().histogram(
+    "pio_ingest_group_size",
+    "Events coalesced per group commit",
+    lo_exp=0, n_buckets=14, scale=1).labels()
+_M_DROPPED = telemetry.registry().counter(
+    "pio_ingest_dropped_events_total",
+    "Enqueue-acked events dropped because their group commit "
+    "failed").labels()
 
 Key = tuple[int, Optional[int]]
 
@@ -171,7 +192,7 @@ class _Pending:
     path). ``future`` is None for fire-and-forget (ack=enqueue)."""
 
     __slots__ = ("kind", "payload", "body", "ids", "whitelist", "future",
-                 "n")
+                 "n", "t_enq")
 
     def __init__(self, kind: int, payload, body=None, ids=None,
                  whitelist=(), future=None, n=1):
@@ -182,6 +203,7 @@ class _Pending:
         self.whitelist = whitelist
         self.future = future
         self.n = n                # events carried (EVENTS/LINES may be > 1)
+        self.t_enq = 0            # queue-wait timer (0 = not stamped)
 
 
 class _KeyState:
@@ -269,6 +291,7 @@ class IngestBuffer:
         if st is None:
             st = self._keys[key] = _KeyState()
             st.task = self._loop.create_task(self._run_key(key, st))
+        entry.t_enq = telemetry.timer_start()
         st.deque.append(entry)
         st.pending_events += entry.n
         if entry.n > 1:
@@ -279,7 +302,9 @@ class IngestBuffer:
             st.full.set()
 
     async def _passthrough(self, key: Key, entry: _Pending):
+        t_commit = telemetry.timer_start()
         results = await asyncio.to_thread(self._commit_group, key, [entry])
+        _M_COMMIT.observe_since(t_commit)
         self._note_group(entry.n)
         res = results[0]
         if isinstance(res, Exception):
@@ -414,10 +439,12 @@ class IngestBuffer:
                 if group and n_events + nxt.n > cfg.group_max:
                     break
                 st.deque.popleft()
+                _M_QUEUE_WAIT.observe_since(nxt.t_enq)
                 group.append(nxt)
                 n_events += nxt.n
                 if nxt.n > 1:
                     st.pending_multi -= 1
+            t_commit = telemetry.timer_start()
             try:
                 if self._inline_commit_ok():
                     # embedded fast store (JSONL/memory, no fsync): the
@@ -439,6 +466,7 @@ class IngestBuffer:
             except Exception as e:  # noqa: BLE001 — backstop, must not die
                 log.exception("ingest group commit failed")
                 results = [e] * len(group)
+            _M_COMMIT.observe_since(t_commit)
             st.pending_events -= n_events
             self._pending -= n_events
             self._note_group(n_events)
@@ -446,6 +474,7 @@ class IngestBuffer:
                 if entry.future is None:
                     if isinstance(res, Exception):
                         self.dropped += entry.n
+                        _M_DROPPED.inc(entry.n)
                         log.error("dropped %d enqueue-acked event(s): %s",
                                   entry.n, res)
                     continue
@@ -461,6 +490,7 @@ class IngestBuffer:
         self.events_committed += n_events
         if n_events > self.max_group:
             self.max_group = n_events
+        _M_GROUP_SIZE.observe_raw(n_events)
 
     # -- commit (worker-thread or inline loop side) ------------------------
     def _commit_group(self, key: Key, group: list[_Pending],

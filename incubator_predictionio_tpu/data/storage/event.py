@@ -33,13 +33,34 @@ def _utcnow() -> _dt.datetime:
     return _dt.datetime.now(_dt.timezone.utc)
 
 
+import re as _re
+
+#: fractional-seconds normalizer for Python 3.10's fromisoformat, which
+#: accepts only exactly 3 or 6 fractional digits. ISO-8601 (and joda,
+#: the reference's time parser) allow any count — "12:00:00.5" is a
+#: legal wire time, and the native C codec parses it — so the fraction
+#: is padded/truncated to 6 digits (µs, the storage resolution) before
+#: the stdlib parse. Python 3.11+ never reaches the fallback.
+_FRACTION_RE = _re.compile(r"(?<=\d)\.(\d+)")
+
+
+def _normalize_fraction(value: str) -> str:
+    return _FRACTION_RE.sub(
+        lambda m: "." + m.group(1)[:6].ljust(6, "0"), value, count=1)
+
+
 def parse_event_time(value: str) -> _dt.datetime:
     """ISO-8601 → aware datetime (reference uses joda DateTime)."""
+    iso = value.replace("Z", "+00:00")
     try:
         # Python 3.11+ fromisoformat handles 'Z' and offsets.
-        t = _dt.datetime.fromisoformat(value.replace("Z", "+00:00"))
+        t = _dt.datetime.fromisoformat(iso)
     except ValueError as e:
-        raise EventValidationError(f"Invalid eventTime {value!r}: {e}") from e
+        try:
+            t = _dt.datetime.fromisoformat(_normalize_fraction(iso))
+        except ValueError:
+            raise EventValidationError(
+                f"Invalid eventTime {value!r}: {e}") from e
     if t.tzinfo is None:
         t = t.replace(tzinfo=_dt.timezone.utc)
     return t

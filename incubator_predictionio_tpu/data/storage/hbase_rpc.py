@@ -65,7 +65,13 @@ import threading
 import time
 from typing import Iterator, Optional, Sequence
 
-from ...common import faultinject, resilience
+from ...common import faultinject, resilience, telemetry
+
+#: transport op metrics, same families every urlopen-based backend
+#: reports into (common/resilience.py) — this client speaks raw
+#: sockets, so it records its RPCs itself
+_RPC_SECONDS = resilience.STORAGE_OP_SECONDS.labels("hbase.rpc")
+_RPC_ERRORS = resilience.STORAGE_OP_ERRORS.labels("hbase.rpc")
 
 __all__ = ["HBaseRpcError", "HBaseRpcTransport", "PB", "pb_decode",
            "pb_delimited", "read_delimited"]
@@ -439,11 +445,13 @@ class HBaseRpcTransport:
         SUCCESSES (the endpoint answered — it is healthy)."""
         self._breaker.check()
         conn: Optional[_Conn] = None
+        t0 = telemetry.timer_start()
         try:
             faultinject.fault_point("hbase.rpc")
             conn = self._conn(server, service)
             result = conn.call(method, param)
         except HBaseRpcError as e:
+            _RPC_ERRORS.inc()
             if e.connection_lost:
                 if conn is not None:
                     self._drop_conn(server, service, conn)
@@ -452,12 +460,15 @@ class HBaseRpcTransport:
                 self._breaker.record_success()
             raise
         except OSError as e:
+            _RPC_ERRORS.inc()
             if conn is not None:
                 self._drop_conn(server, service, conn)
             self._breaker.record_failure()
             raise HBaseRpcError(
                 f"connection to {server[0]}:{server[1]} lost: {e}",
                 connection_lost=True) from e
+        finally:
+            _RPC_SECONDS.observe_since(t0)
         self._breaker.record_success()
         return result
 

@@ -230,7 +230,7 @@ def _full_cco_topk_sharded(light, heavy, lo_effs, n_i, n_j, n_total, *,
     replicated afterwards inside the SAME jit. ``mesh`` is a static
     arg (Mesh is hashable), so repeat trains at the same shapes reuse
     one executable like every other kernel here."""
-    from ..common.jax_compat import shard_map
+    from ..common.jax_compat import pcast, shard_map
     from jax.sharding import PartitionSpec as _P
     from ..parallel.mesh import DATA_AXIS as _D
 
@@ -248,8 +248,9 @@ def _full_cco_topk_sharded(light, heavy, lo_effs, n_i, n_j, n_total, *,
         c0 = jnp.zeros((n_items, n_items), jnp.int32)
         # shard_map's varying-manual-axes typing: the carry starts as a
         # replicated constant but the body output varies over the data
-        # axis — mark it varying up front
-        c0 = jax.lax.pcast(c0, (_D,), to="varying")
+        # axis — mark it varying up front (no-op on jax 0.4.x, where
+        # check_rep=False already treats every value as varying)
+        c0 = pcast(c0, (_D,), to="varying")
         c, _ = jax.lax.scan(mk_body(u_chunk), c0, light_l)
         if heavy_l is not None:
             c, _ = jax.lax.scan(mk_body(h_chunk), c, heavy_l)
@@ -365,7 +366,7 @@ def _full_cco_topk_multi_sharded(light_p, light_secs, heavy_p, heavy_secs,
     partial count matrices psum over ICI (exact int32 → bit-identical
     to per-pair and to single-device; tested on the virtual mesh).
     heavy_p/heavy_secs use () for absent (static pytree shape)."""
-    from ..common.jax_compat import shard_map
+    from ..common.jax_compat import pcast, shard_map
     from jax.sharding import PartitionSpec as _P
     from ..parallel.mesh import DATA_AXIS as _D
 
@@ -373,8 +374,8 @@ def _full_cco_topk_multi_sharded(light_p, light_secs, heavy_p, heavy_secs,
 
     def counts_fn(lp, lsecs, hp, hsecs):
         c0 = tuple(
-            jax.lax.pcast(jnp.zeros((n_items, n_items), jnp.int32),
-                          (_D,), to="varying")
+            pcast(jnp.zeros((n_items, n_items), jnp.int32),
+                  (_D,), to="varying")
             for _ in range(n_sec))
         xs = tuple(lp) + tuple(x for pair in lsecs for x in pair)
         cs, _ = jax.lax.scan(_mk_multi_body(self_flags, n_items, u_chunk),
@@ -537,7 +538,7 @@ def _all_stripes_sharded(lo_effs, light, heavy, n_i, n_j, n_total, *,
     user ranges into a [block, I] partial and the partials psum over
     ICI; LLR + top-k stay replicated. Bit-identical to the
     single-device striped path (exact integer counts)."""
-    from ..common.jax_compat import shard_map
+    from ..common.jax_compat import pcast, shard_map
     from jax.sharding import PartitionSpec as _P
     from ..parallel.mesh import DATA_AXIS as _D
 
@@ -555,7 +556,7 @@ def _all_stripes_sharded(lo_effs, light, heavy, n_i, n_j, n_total, *,
                         preferred_element_type=jnp.int32), None
                 return body
 
-            c0 = jax.lax.pcast(
+            c0 = pcast(
                 jnp.zeros((block, n_items), jnp.int32), (_D,),
                 to="varying")
             c, _ = jax.lax.scan(mk_body(u_chunk), c0, light_l)

@@ -16,6 +16,11 @@ from . import verb
 
 @verb("status", "verify storage configuration and connectivity")
 def status_cmd(args: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="pio status")
+    p.add_argument("--metrics", action="store_true",
+                   help="print a Prometheus-format snapshot of this "
+                        "process's telemetry registry after the checks")
+    ns = p.parse_args(args)
     s = Storage.instance()
     print("[info] Inspecting storage backend connections...")
     from ...data.storage.registry import REPOSITORIES
@@ -52,6 +57,15 @@ def status_cmd(args: list[str]) -> int:
     except Exception as e:  # noqa: BLE001 - informational only
         print(f"[info] Native codec: unavailable ({e}); pure-Python "
               "fallbacks active (identical behavior, slower).")
+    if ns.metrics:
+        # Snapshot of THIS process's registry: after the checks above
+        # it carries the storage op latencies + breaker states the
+        # verification itself just exercised. Servers expose the same
+        # families continuously at GET /metrics.
+        from ...common import telemetry
+
+        print("[info] Telemetry snapshot (Prometheus text format):")
+        sys.stdout.write(telemetry.render_all())
     print("[info] Your system is all ready to go.")
     return 0
 

@@ -15,6 +15,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..common import telemetry
 from ..data.storage.registry import Storage
 
 _CORS = {
@@ -83,6 +84,8 @@ class Dashboard:
                 # "<id>.json" (aiohttp resolves in registration order)
                 web.get("/instances/{iid}.json", self.handle_instance_json),
                 web.get("/instances/{iid}", self.handle_instance_html),
+                web.get("/metrics", self.handle_metrics),
+                web.get("/metrics/html", self.handle_metrics_html),
                 web.options("/{tail:.*}", self.handle_preflight),
             ]
         )
@@ -150,6 +153,8 @@ class Dashboard:
             )
         body = (
             "<h1>Completed evaluations</h1>"
+            "<p><a href='/metrics/html'>telemetry</a> · "
+            "<a href='/metrics'>/metrics</a></p>"
             "<table><tr><th>ID</th><th>Evaluation</th>"
             "<th>Metric</th><th>Best score</th><th>Candidates</th>"
             "<th>Started</th><th>Finished</th><th>Best params</th></tr>"
@@ -219,6 +224,42 @@ class Dashboard:
             + "".join(rows) + "</table>"
         )
         return self._page(f"Evaluation {i.id[:13]}", body)
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition of this process's registry (the
+        scrape target when the dashboard process also trains/serves)."""
+        return web.Response(text=telemetry.render_all(),
+                            content_type="text/plain")
+
+    async def handle_metrics_html(self, request: web.Request) -> web.Response:
+        """Human-readable metrics page: every family in the process
+        registry as a table (name, labels, value)."""
+        rows = []
+        for fam in telemetry.registry().collect():
+            for values, child in fam.samples():
+                if fam.kind == "histogram":
+                    _counts, total, sum_raw = child.snapshot()
+                    shown = (f"count={total}, "
+                             f"sum={sum_raw * child.scale:.6g}")
+                else:
+                    shown = f"{child.value():.10g}"
+                labels = ", ".join(
+                    f"{n}={v}" for n, v in zip(fam.labelnames, values))
+                rows.append(
+                    "<tr><td><code>{name}</code></td><td>{kind}</td>"
+                    "<td>{labels}</td><td>{value}</td></tr>".format(
+                        name=html.escape(fam.name),
+                        kind=html.escape(fam.kind),
+                        labels=html.escape(labels) or "—",
+                        value=html.escape(shown)))
+        body = (
+            "<h1>Telemetry</h1>"
+            "<p><a href='/'>back</a> · <a href='/metrics'>raw "
+            "(Prometheus text format)</a></p>"
+            "<table><tr><th>Metric</th><th>Type</th><th>Labels</th>"
+            "<th>Value</th></tr>" + "".join(rows) + "</table>"
+        )
+        return self._page("Telemetry", body)
 
     async def handle_instances_json(self, request: web.Request) -> web.Response:
         out = []

@@ -21,8 +21,11 @@ import logging
 import threading
 from typing import Any, Optional
 
+import time as _time
+
 from aiohttp import web
 
+from ..common import telemetry
 from ..controller.engine import Engine
 from ..data.storage.datamap import DataMap
 from ..data.storage.event import Event
@@ -86,14 +89,25 @@ class EngineServer:
         # failed reload / feedback outage; /status and /readyz surface it
         self._degraded_reason: Optional[str] = None
         self._dropped_feedback = 0
+        # per-algorithm warm-up compile accounting (instance families,
+        # exported via the registry collector below; gauges because a
+        # reload re-measures the new instance's compiles from scratch —
+        # _load rebuilds them so a reload to a different variant drops
+        # the dead instance's algorithm labels)
+        self._m_compile_count, self._m_compile_seconds = \
+            self._new_compile_families()
+        telemetry.registry().register_collector(
+            "engineserver", self._collect_metrics)
         self.deployment = None
         self.instance = None
         self._load(instance_id)
 
-        self.app = web.Application()
+        self.app = web.Application(
+            middlewares=[telemetry.trace_middleware()])
         self.app.add_routes(
             [
                 web.get("/", self.handle_status),
+                web.get("/metrics", self.handle_metrics),
                 web.get("/healthz", self.handle_healthz),
                 web.get("/readyz", self.handle_readyz),
                 web.post("/queries.json", self.handle_query),
@@ -108,6 +122,17 @@ class EngineServer:
             self.app.on_startup.append(self._start_batcher)
             self.app.on_cleanup.append(self._stop_batcher)
 
+    @staticmethod
+    def _new_compile_families():
+        return (telemetry.GaugeFamily(
+                    "pio_engine_compile_count",
+                    "Warm-up compilations performed for the live engine "
+                    "instance, per algorithm", ("algorithm",)),
+                telemetry.GaugeFamily(
+                    "pio_engine_compile_seconds",
+                    "Warm-up compilation wall seconds for the live engine "
+                    "instance, per algorithm", ("algorithm",)))
+
     # -- lifecycle --------------------------------------------------------
     def _load(self, instance_id: Optional[str]) -> None:
         ctx = WorkflowContext(storage=self.storage)
@@ -118,14 +143,29 @@ class EngineServer:
             engine_factory_name=self.engine_factory_name,
             engine_variant=self.engine_variant,
         )
-        # Warm up every model that supports it (compile + device placement)
-        for model in deployment.models:
+        # Fresh compile families for this instance: the collector reads
+        # the attributes live, so swapping them drops labels that only
+        # existed on the previous variant (nothing merges stale rows)
+        m_count, m_seconds = self._new_compile_families()
+        # Warm up every model that supports it (compile + device
+        # placement); wall time per algorithm feeds the compile gauges —
+        # on a cold deploy this is almost entirely XLA compilation, the
+        # number an operator needs when a reload suddenly takes 30 s.
+        for (algo_name, _algo), model in zip(deployment.algo_list,
+                                             deployment.models):
             warm = getattr(model, "warm_up", None)
             if callable(warm):
+                label = algo_name or type(model).__name__
+                t0 = _time.perf_counter()
                 try:
                     warm()
                 except Exception:  # pragma: no cover - warmup best-effort
                     log.exception("model warm-up failed")
+                else:
+                    m_count.labels(label).set(1)
+                    m_seconds.labels(label).set(
+                        _time.perf_counter() - t0)
+        self._m_compile_count, self._m_compile_seconds = m_count, m_seconds
         if self.batch_window_ms > 0:
             # Pre-compile every power-of-two batch shape the micro-batch
             # path can produce — a cold shape showed ~1.5s p99 through a
@@ -138,13 +178,19 @@ class EngineServer:
                 # max_batch queries pads to that shape
                 top = 1 << max(self.max_batch - 1, 0).bit_length()
                 b = 1
+                n_shapes = 0
+                t0 = _time.perf_counter()
                 while b <= top:
                     try:
                         deployment.batch_query([dict(example)] * b)
                     except Exception:  # noqa: BLE001 - warmup best-effort
                         log.exception("batch warm-up failed at size %d", b)
                         break
+                    n_shapes += 1
                     b *= 2
+                self._m_compile_count.labels("batch").set(n_shapes)
+                self._m_compile_seconds.labels("batch").set(
+                    _time.perf_counter() - t0)
         with self._lock:
             self.deployment = deployment
             self.instance = instance
@@ -192,6 +238,27 @@ class EngineServer:
             except (TypeError, json.JSONDecodeError):
                 pass
         return web.json_response(out)
+
+    def _collect_metrics(self):
+        """Render-time families owned by THIS server instance."""
+        qc = telemetry.GaugeFamily(
+            "pio_engine_query_count",
+            "Queries served by the live engine server (excludes "
+            "synthetic startup probes)")
+        qc.labels().set(self._query_count)
+        dropped = telemetry.GaugeFamily(
+            "pio_engine_dropped_feedback_total",
+            "Feedback self-log events dropped by event-store failures")
+        dropped.labels().set(self._dropped_feedback)
+        return [self._m_compile_count, self._m_compile_seconds, qc,
+                dropped]
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition: query stage histograms, compile
+        gauges, storage transport + breaker families — the engine
+        server's share of the process-wide registry."""
+        return web.Response(text=telemetry.render_all(),
+                            content_type="text/plain")
 
     async def handle_healthz(self, request: web.Request) -> web.Response:
         """Liveness: the process serves HTTP (mirrors the storage

@@ -47,6 +47,25 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
+from ..common import telemetry
+
+# Last-run pipeline stage gauges (training is episodic, so the natural
+# exposition is "the most recent run's decomposition", not a histogram
+# of runs): per-stage busy seconds plus the overlap-efficiency ratio
+# the bench derives from the same PipelineStats fields.
+_M_STAGE = telemetry.registry().gauge(
+    "pio_pipeline_stage_seconds",
+    "Input-pipeline stage busy seconds for the most recent streamed "
+    "train (featurize/upload/consume are per-stage sums, wall is "
+    "end-to-end)", ("stage",))
+_M_CHUNKS = telemetry.registry().gauge(
+    "pio_pipeline_chunks",
+    "Chunks streamed by the most recent pipelined train")
+_M_EFFICIENCY = telemetry.registry().gauge(
+    "pio_pipeline_overlap_efficiency",
+    "wall / max(stage) for the most recent streamed train (1.0 = "
+    "perfect stage overlap, higher = serialization waste)")
+
 __all__ = [
     "PipelineConfig",
     "PipelineStats",
@@ -181,6 +200,19 @@ class PipelineStats:
     def __post_init__(self):
         self._lock = threading.Lock()
 
+    def publish(self) -> None:
+        """Export this run's decomposition to the telemetry registry
+        (gauges — last run wins; see the family docstrings)."""
+        _M_STAGE.labels("featurize").set(self.featurize_seconds)
+        _M_STAGE.labels("upload").set(self.upload_seconds)
+        _M_STAGE.labels("consume").set(self.consume_seconds)
+        _M_STAGE.labels("wall").set(self.wall_seconds)
+        _M_CHUNKS.labels().set(self.n_chunks)
+        max_stage = max(self.featurize_seconds, self.upload_seconds,
+                        self.consume_seconds)
+        if max_stage > 0:
+            _M_EFFICIENCY.labels().set(self.wall_seconds / max_stage)
+
 
 def chunk_ranges(n_rows: int, chunk_rows: int) -> list[tuple[int, int]]:
     """[(start, stop), ...] covering [0, n_rows) in chunk_rows steps."""
@@ -306,6 +338,7 @@ def run_pipeline(
             close()
         if stats is not None:
             stats.wall_seconds = time.perf_counter() - t_start
+            stats.publish()
     return n
 
 

@@ -42,6 +42,21 @@ def _bytea_escape(v: bytes) -> str:
     return "".join(out)
 
 
+#: sqlite grew RETURNING in 3.35; older engines (this image ships
+#: 3.34) reject the clause with a syntax error, so the mock emulates it
+#: below — the pg client under test must keep speaking real Postgres.
+_SQLITE_RETURNING = sqlite3.sqlite_version_info >= (3, 35, 0)
+
+_RETURNING_RE = re.compile(
+    r"^\s*(INSERT|DELETE)\b.*?\s+RETURNING\s+([A-Za-z0-9_,\s]+?)\s*$",
+    re.IGNORECASE | re.DOTALL)
+_INSERT_TABLE_RE = re.compile(r"INSERT\s+INTO\s+([A-Za-z0-9_]+)",
+                              re.IGNORECASE)
+_DELETE_RE = re.compile(
+    r"^\s*DELETE\s+FROM\s+([A-Za-z0-9_]+)\s*(.*?)\s+RETURNING\s+",
+    re.IGNORECASE | re.DOTALL)
+
+
 class _Db:
     def __init__(self):
         self.conn = sqlite3.connect(":memory:", check_same_thread=False)
@@ -53,10 +68,44 @@ class _Db:
         sql = re.sub(r"\$(\d+)", r"?\1", sql)
         sql = re.sub(r"\bBYTEA\b", "BLOB", sql)
         with self.lock:
-            cur = self.conn.execute(sql, params)
-            rows = cur.fetchall()
-            cols = [d[0] for d in cur.description] if cur.description else []
+            m = None if _SQLITE_RETURNING else _RETURNING_RE.match(sql)
+            if m is not None:
+                cols, rows = self._execute_returning(
+                    sql, params, m.group(1).upper(), m.group(2))
+            else:
+                cur = self.conn.execute(sql, params)
+                rows = cur.fetchall()
+                cols = ([d[0] for d in cur.description]
+                        if cur.description else [])
             self.conn.commit()
+        return cols, rows
+
+    def _execute_returning(self, sql: str, params, verb: str,
+                           returning: str):
+        """Old-sqlite RETURNING emulation (caller holds the lock, one
+        implicit transaction around both statements like the real
+        server's). INSERT: run the stripped statement, then read the
+        returned columns back off ``last_insert_rowid()``. DELETE:
+        snapshot the returned columns with the same WHERE *before*
+        deleting — exactly the rows the statement removes, since the
+        connection is locked across both."""
+        cols = [c.strip() for c in returning.split(",") if c.strip()]
+        col_sql = ", ".join(cols)
+        stripped = re.sub(r"\s+RETURNING\s+[A-Za-z0-9_,\s]+?\s*$", "",
+                          sql, flags=re.IGNORECASE | re.DOTALL)
+        if verb == "INSERT":
+            table = _INSERT_TABLE_RE.search(sql).group(1)
+            self.conn.execute(stripped, params)
+            cur = self.conn.execute(
+                f"SELECT {col_sql} FROM {table} "
+                "WHERE rowid = last_insert_rowid()")
+            return cols, cur.fetchall()
+        d = _DELETE_RE.match(sql)
+        table, where = d.group(1), d.group(2)
+        cur = self.conn.execute(
+            f"SELECT {col_sql} FROM {table} {where}", params)
+        rows = cur.fetchall()
+        self.conn.execute(stripped, params)
         return cols, rows
 
 
