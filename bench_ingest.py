@@ -19,7 +19,9 @@ default to commit (PIO_INGEST_ACK) — durability unchanged.
 
 Prints ONE JSON line per mode; persists under
 BASELINE.json.published.measured_ingest_* (`..._nogroup` holds the
-buffer-off sweep). `host_loop_mops` is a single-thread Python
+buffer-off sweep, `..._wal` the same sweep with the crash-durability
+write-ahead log armed — PIO_WAL=1, fsync=group — so the durability
+cost is a same-run bracket next to the group-commit numbers). `host_loop_mops` is a single-thread Python
 calibration so numbers from differently-sized hosts stay comparable —
 ingestion is a host path, CPU-bound, so cross-host absolute numbers
 are only meaningful relative to it. No accelerator involved.
@@ -237,9 +239,17 @@ def main() -> int:
     log(f"[ingest] host calibration: {mops:.1f} python Mops")
 
     by_mode = {}
-    for group in ("off", "on"):
-        os.environ["PIO_INGEST_GROUP"] = group
+    for group in ("off", "on", "wal"):
+        # "wal" = group commit ON + the write-ahead log armed (PIO_WAL=1,
+        # default fsync=group): the same-run bracket that prices crash
+        # durability next to the plain group-commit numbers.
+        os.environ["PIO_INGEST_GROUP"] = "on" if group == "wal" else group
         tmp = tempfile.mkdtemp(prefix=f"pio_ingest_{group}_")
+        if group == "wal":
+            os.environ["PIO_WAL"] = "1"
+            os.environ["PIO_WAL_DIR"] = os.path.join(tmp, "wal")
+        else:
+            os.environ.pop("PIO_WAL", None)
         storage = make_storage(backend, tmp)
         server = EventServer(storage)
         log(f"[ingest] --- group-commit {group} "
@@ -280,16 +290,22 @@ def main() -> int:
                         f"{on2[c]['events_per_sec']:,.0f})")
             batch50 = run_batch50(st, n_batch)
             log(f"[ingest]   batch/events.json (50/req): {batch50:,.0f} ev/s")
-        if group == "on":
+        if group in ("on", "wal"):
             snap = server.ingest.snapshot()
+            extra = ""
+            if "wal" in snap:
+                extra = (f" walRecords={snap['wal']['appendedRecords']}"
+                         f" walBytes={snap['wal']['appendedBytes']}")
             log(f"[ingest]   groups={snap['groupsCommitted']} "
                 f"events={snap['eventsCommitted']} "
-                f"maxGroup={snap['maxGroup']}")
+                f"maxGroup={snap['maxGroup']}{extra}")
         by_mode[group] = {"sweep": sweep, "batch50": round(batch50, 1),
                           "storage": storage,
                           "tele_off_sweep": tele_off_sweep,
                           "tele_ratio": tele_ratio}
     os.environ.pop("PIO_INGEST_GROUP", None)
+    os.environ.pop("PIO_WAL", None)
+    os.environ.pop("PIO_WAL_DIR", None)
 
     # bulk import path for contrast (storage-level, no HTTP)
     from incubator_predictionio_tpu.data.storage.event import Event
@@ -324,14 +340,21 @@ def main() -> int:
                 by_mode["on"]["tele_ratio"][c], 3)
     results_off = flat("off")
     results_off["host_loop_mops"] = round(mops, 1)
+    results_wal = flat("wal")
+    results_wal["host_loop_mops"] = round(mops, 1)
 
     for conc in concs:
         on = by_mode["on"]["sweep"][conc]["events_per_sec"]
         off = by_mode["off"]["sweep"][conc]["events_per_sec"]
+        wal = by_mode["wal"]["sweep"][conc]["events_per_sec"]
         log(f"[ingest] group-commit speedup x{conc}: {on / off:.2f}x "
             f"({off:,.0f} -> {on:,.0f} ev/s)")
+        # the durability bill, same run: WAL-on vs plain group commit
+        log(f"[ingest] WAL cost x{conc}: {wal / on:.2f}x of group-on "
+            f"({on:,.0f} -> {wal:,.0f} ev/s)")
 
-    for mode, res in (("group_on", results_on), ("group_off", results_off)):
+    for mode, res in (("group_on", results_on), ("group_off", results_off),
+                      ("wal_on", results_wal)):
         for k, v in res.items():
             unit = ("ms" if k.endswith("_ms") else
                     "Mops" if k.endswith("_mops") else "events/sec")
@@ -348,6 +371,7 @@ def main() -> int:
         pub = doc.setdefault("published", {})
         pub[f"measured_ingest_{backend.lower()}"] = results_on
         pub[f"measured_ingest_{backend.lower()}_nogroup"] = results_off
+        pub[f"measured_ingest_{backend.lower()}_wal"] = results_wal
         with open(base_path, "w") as f:
             json.dump(doc, f, indent=2)
     except Exception as e:  # noqa: BLE001

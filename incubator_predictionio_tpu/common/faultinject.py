@@ -19,6 +19,13 @@ identically in-process, across subprocesses, and in CI:
 - ``drop:N:AFTER`` — streaming points only: the first N matching
   streams raise :class:`InjectedFault` after AFTER items have been
   produced (a connection dropped mid-stream).
+- ``crash:N`` — the N-th matching call kills the process dead:
+  SIGKILL to self (``os._exit(137)`` fallback), no Python cleanup, no
+  atexit, no flushing beyond what already reached the OS — the
+  deterministic `kill -9` used by the WAL crash-recovery harness.
+  Unlike ``fail``, the count selects WHICH call crashes (a process
+  only crashes once): ``ingest.commit:crash:3`` survives two group
+  commits and dies inside the third.
 
 Counts are per-rule and deterministic: "fail first 2 calls" means
 exactly the first two matching calls in this process fail, then the
@@ -68,7 +75,7 @@ def _parse(spec: str) -> list[_Rule]:
                 f"{ENV_VAR}: malformed rule {raw!r} "
                 "(want point:mode:count[:param])")
         pattern, mode, count = parts[0], parts[1].lower(), parts[2]
-        if mode not in ("fail", "latency", "drop"):
+        if mode not in ("fail", "latency", "drop", "crash"):
             raise ValueError(f"{ENV_VAR}: unknown fault mode {mode!r}")
         try:
             n = int(count)
@@ -116,13 +123,28 @@ def active_spec() -> str:
     return os.environ.get(ENV_VAR, "")
 
 
+def _crash(name: str) -> None:  # pragma: no cover — the process dies
+    """Deterministic `kill -9` of THIS process: no Python-level
+    cleanup runs, so whatever the code under test had flushed to the
+    OS is exactly what a recovery pass gets to see."""
+    import signal
+
+    try:
+        os.kill(os.getpid(), signal.SIGKILL)
+    except (OSError, AttributeError, ValueError):
+        pass
+    os._exit(137)
+
+
 def fault_point(name: str) -> None:
-    """Declare a unit of wire work. Applies ``fail`` and ``latency``
-    rules matching ``name``; no-op (one dict lookup) when chaos is off."""
+    """Declare a unit of wire work. Applies ``fail``, ``latency`` and
+    ``crash`` rules matching ``name``; no-op (one dict lookup) when
+    chaos is off."""
     if not os.environ.get(ENV_VAR):
         return
     delay = 0.0
     boom: Optional[InjectedFault] = None
+    die = False
     with _lock:
         for rule in _active_rules():
             if rule.remaining <= 0 or rule.mode == "drop":
@@ -130,11 +152,20 @@ def fault_point(name: str) -> None:
             if not fnmatch.fnmatch(name, rule.pattern):
                 continue
             rule.remaining -= 1
+            if rule.mode == "crash":
+                # the count selects WHICH call crashes: survive the
+                # first N-1 matches, die inside the N-th
+                if rule.remaining <= 0:
+                    die = True
+                    break
+                continue
             if rule.mode == "fail":
                 boom = InjectedFault(
                     f"injected fault at {name!r} ({ENV_VAR})")
                 break
             delay += rule.param
+    if die:
+        _crash(name)
     if delay > 0:
         time.sleep(delay)
     if boom is not None:

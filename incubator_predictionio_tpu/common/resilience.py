@@ -39,7 +39,25 @@ from . import faultinject, telemetry
 __all__ = [
     "CircuitBreaker", "CircuitOpenError", "RetryPolicy", "RetryBudgetExceeded",
     "all_breakers", "breaker_snapshots", "is_retryable", "resilient_urlopen",
+    "retry_after_jitter",
 ]
+
+_jitter_rng = random.Random()
+
+
+def retry_after_jitter(base: float,
+                       rng: Optional[random.Random] = None) -> int:
+    """Full-jittered integer ``Retry-After`` seconds for a 503 shed.
+
+    A constant Retry-After synchronizes every SDK that honoured it into
+    one retry wave exactly N seconds later — the thundering herd the
+    shed was meant to prevent. Same cure as :meth:`RetryPolicy.backoff`:
+    full jitter, here ``1 + U(0, 2·base)`` truncated to whole seconds
+    (the header is integer delta-seconds per RFC 9110), so the mean
+    stays ~``1 + base`` while the herd spreads over ``[1, 2·base + 1]``.
+    """
+    spread = (rng or _jitter_rng).uniform(0.0, 2.0 * max(0.0, base))
+    return 1 + int(spread)
 
 
 # ---------------------------------------------------------------------------

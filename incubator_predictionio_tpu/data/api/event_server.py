@@ -35,12 +35,13 @@ from typing import Optional
 from aiohttp import web
 
 from ...common import ssl_context_from_env, telemetry
-from ...common.resilience import CircuitOpenError
+from ...common.resilience import CircuitOpenError, retry_after_jitter
 from ...workflow.plugins import EventServerPluginContext
 from ..storage.base import AccessKey
 from ..storage.event import Event, EventValidationError, parse_event_time
 from ..storage.registry import Storage
 from ..webhooks import get_connector
+from . import ingest_wal
 from .ingest_buffer import (ForbiddenEventError, IngestBuffer, IngestConfig,
                             IngestOverloadError, parse_single_event)
 from .stats import Stats
@@ -78,11 +79,32 @@ class EventServer:
         # backend's circuit breaker is open or the ingest buffer is full
         # (reported on GET /)
         self._shed_count = 0
+        # crash durability (PIO_WAL=1): BEFORE serving, replay any
+        # uncommitted write-ahead-log records a previous process left
+        # behind (kill -9 mid-group), deduped by event_id against what
+        # did land. A dead backing store is logged, not fatal — the
+        # server comes up shedding (breaker) and the operator can
+        # `pio wal replay` once storage is back.
+        wal_config = ingest_wal.WalConfig.from_env()
+        wal = None
+        if wal_config.enabled:
+            try:
+                recovered = ingest_wal.recover(
+                    self.storage, wal_config, stats=self.stats,
+                    plugins=self.plugins)
+                if recovered["replayed"] or recovered["deduped"]:
+                    log.info("WAL recovery replayed %d event(s), "
+                             "deduped %d", recovered["replayed"],
+                             recovered["deduped"])
+            except Exception:  # noqa: BLE001 — serve; operator replays
+                log.exception("WAL recovery failed; uncommitted records "
+                              "remain until `pio wal replay` succeeds")
+            wal = ingest_wal.IngestWal(wal_config)
         # write-behind group commit: every write handler feeds this
         # buffer; the flusher coalesces concurrent requests into one
         # insert_batch/append per (app, channel) group
         self.ingest = IngestBuffer(self.storage, self.stats, self.plugins,
-                                   IngestConfig.from_env())
+                                   IngestConfig.from_env(), wal=wal)
         # telemetry: per-instance stats counters join the process-wide
         # registry exposition via a collector (replaced per instance —
         # the LIVE server's counters are what /metrics shows)
@@ -125,7 +147,10 @@ class EventServer:
                 {"message": "event store temporarily unavailable "
                             f"({e.breaker_name}); retry later"},
                 status=503,
-                headers={"Retry-After": str(max(1, int(e.retry_after)))},
+                # full-jittered: a constant value would synchronize every
+                # honouring SDK into one retry wave (thundering herd)
+                headers={"Retry-After":
+                         str(retry_after_jitter(e.retry_after))},
             )
         except IngestOverloadError as e:
             # the write-behind buffer hit its in-flight cap (or is
@@ -134,11 +159,29 @@ class EventServer:
             return web.json_response(
                 {"message": str(e)},
                 status=503,
-                headers={"Retry-After": str(max(1, int(e.retry_after)))},
+                headers={"Retry-After":
+                         str(retry_after_jitter(e.retry_after))},
             )
 
     async def _drain_ingest(self, app) -> None:
-        await self.ingest.drain()
+        """Shutdown: drain the buffer, then ALWAYS release the cached
+        file handles (JSONL append handles, WAL segments) — a drain
+        that raises must not leak open fds or keep a WAL segment from
+        a clean last fsync."""
+        try:
+            await self.ingest.drain()
+        finally:
+            try:
+                close = getattr(self.storage.get_l_events(), "close", None)
+                if close is not None:
+                    await asyncio.to_thread(close)
+            except Exception:  # noqa: BLE001 — best-effort on shutdown
+                log.exception("event store close failed on shutdown")
+            if self.ingest.wal is not None:
+                try:
+                    self.ingest.wal.close()
+                except Exception:  # noqa: BLE001 — best-effort on shutdown
+                    log.exception("WAL close failed on shutdown")
 
     # -- auth -------------------------------------------------------------
     def _access_key_str(self, request: web.Request) -> Optional[str]:
@@ -230,7 +273,8 @@ class EventServer:
         if self._shed_count:
             out["shedRequests"] = self._shed_count
         snap = self.ingest.snapshot()
-        if snap["groupsCommitted"] or snap["pending"] or snap["droppedEvents"]:
+        if (snap["groupsCommitted"] or snap["pending"]
+                or snap["droppedEvents"] or "wal" in snap):
             out["ingest"] = snap
         return web.json_response(out)
 
@@ -264,7 +308,7 @@ class EventServer:
                 return _json_error(400, str(e))
             except ForbiddenEventError as e:
                 return _json_error(403, str(e))
-            event_id = self.ingest.enqueue_event(
+            event_id = await self.ingest.enqueue_event(
                 event, body, access_key, channel_id)
             return web.json_response({"eventId": event_id}, status=201)
         # default (ack=commit): the raw body rides the write-behind
@@ -293,8 +337,17 @@ class EventServer:
             ids, lines = fast
             # pre-encoded canonical lines ride the same buffer as single
             # POSTs: concurrent batch requests group-commit together
-            await self.ingest.ingest_lines(
-                lines, ids, access_key, channel_id)
+            try:
+                await self.ingest.ingest_lines(
+                    lines, ids, access_key, channel_id)
+            except (CircuitOpenError, IngestOverloadError):
+                raise  # the shed middleware owns the 503 contract
+            except Exception as e:  # noqa: BLE001 — storage fault
+                # same per-item shape the python path returns: the whole
+                # entry commits atomically, so every item failed together
+                return web.json_response(
+                    [{"status": 500, "message": f"event store error: {e}"}
+                     for _ in ids])
             return web.json_response(
                 [{"status": 201, "eventId": eid} for eid in ids])
         try:
@@ -443,7 +496,7 @@ class EventServer:
             return _json_error(400, str(e))
         # webhooks feed the same write-behind buffer as direct POSTs
         if self.ingest.ack_on_enqueue:
-            event_id = self.ingest.enqueue_event(
+            event_id = await self.ingest.enqueue_event(
                 event, event_json, access_key, channel_id)
             return web.json_response({"eventId": event_id}, status=201)
         try:

@@ -50,6 +50,17 @@ fast path `/batch/events.json` uses), then appended with one write.
 The fault point ``ingest.commit`` (common.faultinject) fires once per
 group commit so chaos tests can fail a mid-group storage write
 deterministically.
+
+Durability (``PIO_WAL=1``, see ingest_wal.py)
+    With the write-ahead log enabled, an enqueue-mode event is appended
+    to its key's WAL segment BEFORE the ack is sent, and a commit-mode
+    group's lines are appended (one frame) before the backing-store
+    write. After the store confirms, a commit marker covers the group's
+    records; a store FAILURE reported to waiting clients writes an
+    abort marker instead (the client saw the error — replay must not
+    resurrect what it will retry), while enqueue-acked events whose
+    commit failed stay uncommitted in the WAL: they are *deferred* to
+    the next recovery pass instead of dropped.
 """
 
 from __future__ import annotations
@@ -88,6 +99,10 @@ _M_DROPPED = telemetry.registry().counter(
     "pio_ingest_dropped_events_total",
     "Enqueue-acked events dropped because their group commit "
     "failed").labels()
+_M_DEFERRED = telemetry.registry().counter(
+    "pio_wal_deferred_events_total",
+    "Enqueue-acked events whose group commit failed but which remain "
+    "in the WAL for the next recovery pass (not lost)").labels()
 
 Key = tuple[int, Optional[int]]
 
@@ -192,7 +207,7 @@ class _Pending:
     path). ``future`` is None for fire-and-forget (ack=enqueue)."""
 
     __slots__ = ("kind", "payload", "body", "ids", "whitelist", "future",
-                 "n", "t_enq")
+                 "n", "t_enq", "lsns", "wal_line")
 
     def __init__(self, kind: int, payload, body=None, ids=None,
                  whitelist=(), future=None, n=1):
@@ -204,6 +219,8 @@ class _Pending:
         self.future = future
         self.n = n                # events carried (EVENTS/LINES may be > 1)
         self.t_enq = 0            # queue-wait timer (0 = not stamped)
+        self.lsns = None          # WAL record LSNs (pre-ack append)
+        self.wal_line = None      # the exact bytes the WAL holds
 
 
 class _KeyState:
@@ -223,11 +240,12 @@ class IngestBuffer:
     """Per-key write-behind queues + flusher tasks over one storage."""
 
     def __init__(self, storage, stats, plugins,
-                 config: Optional[IngestConfig] = None):
+                 config: Optional[IngestConfig] = None, wal=None):
         self.storage = storage
         self.stats = stats
         self.plugins = plugins
         self.config = config or IngestConfig.from_env()
+        self.wal = wal            # IngestWal or None (PIO_WAL off)
         self._keys: dict[Key, _KeyState] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._pending = 0
@@ -237,6 +255,7 @@ class IngestBuffer:
         self.events_committed = 0
         self.max_group = 0
         self.dropped = 0
+        self.deferred = 0         # enqueue-acked, commit failed, in WAL
 
     @property
     def ack_on_enqueue(self) -> bool:
@@ -245,7 +264,13 @@ class IngestBuffer:
     def _inline_commit_ok(self) -> bool:
         """True when the event store advertises sub-millisecond,
         non-blocking-ish commits (embedded backends); remote backends
-        (HTTP/HBase/ES) always commit off-loop."""
+        (HTTP/HBase/ES) always commit off-loop. With the WAL on, every
+        commit also writes (and per policy fsyncs) a WAL frame, so the
+        group always commits off-loop — this also guarantees the WAL
+        append happens exactly once (the inline path's _WouldBlock
+        retry would re-run _commit_group)."""
+        if self.wal is not None:
+            return False
         try:
             probe = getattr(self.storage.get_l_events(),
                             "inline_commit_ok", None)
@@ -254,7 +279,7 @@ class IngestBuffer:
             return False
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             "enabled": self.config.enabled,
             "pending": self._pending,
             "groupsCommitted": self.groups_committed,
@@ -262,6 +287,10 @@ class IngestBuffer:
             "maxGroup": self.max_group,
             "droppedEvents": self.dropped,
         }
+        if self.wal is not None:
+            out["deferredEvents"] = self.deferred
+            out["wal"] = self.wal.snapshot()
+        return out
 
     # -- submission (event-loop side) --------------------------------------
     def _bind_loop(self) -> None:
@@ -335,15 +364,61 @@ class IngestBuffer:
         self._enqueue(key, entry)
         return await entry.future
 
-    def enqueue_event(self, event: Event, body: Optional[dict],
-                      access_key, channel_id) -> str:
-        """Fire-and-forget (ack=enqueue): assign the id now, return
-        immediately; the commit happens behind the ack."""
+    async def enqueue_event(self, event: Event, body: Optional[dict],
+                            access_key, channel_id) -> str:
+        """Fire-and-forget (ack=enqueue): assign the id now, return as
+        soon as the event is queued; the commit happens behind the ack.
+        With the WAL on the record is appended (and per policy fsynced)
+        BEFORE this returns — the ack is only sent for events a crash
+        cannot eat. Ordering matters here: admission runs FIRST (an
+        overload-shed 503 must leave nothing in the WAL — the client
+        retries, and a leftover record would replay into a duplicate),
+        and there is never a shed AFTER the append for the same
+        reason."""
         key = (access_key.appid, channel_id)
         eid = event.event_id or new_event_id()
         entry = _Pending(_EVENT, event, body=body, ids=[eid])
-        self._enqueue(key, entry)
+        self._bind_loop()
+        self._admit(1)
+        if self.wal is None or not self.wal.fsyncs_on_commit:
+            self._wal_append_entry(key, entry)
+        else:
+            # fsync=always syncs inside this append; fsync=group can
+            # stall behind a commit thread holding this key's lock
+            # across a group fsync — either way the append goes
+            # off-loop so one event's durability wait never freezes
+            # every other connection. _pending stays reserved across
+            # the await so concurrent requests can't all pass admission
+            # against the same count and overshoot max_pending.
+            self._pending += 1
+            try:
+                await asyncio.to_thread(self._wal_append_entry, key, entry)
+            finally:
+                self._pending -= 1
+            if self._draining:
+                # drain ran during the append: enqueueing now would
+                # spawn a fresh flusher racing the shutdown close of
+                # the store/WAL handles. The record is already durable
+                # in the WAL — defer it to the next recovery pass
+                # (startup or `pio wal replay`); the ack stays honest.
+                self.deferred += 1
+                _M_DEFERRED.inc(1)
+                log.warning("deferred 1 enqueue-acked event to WAL "
+                            "replay: accepted during drain")
+                return eid
+        self._enqueue(key, entry, admit=False)
         return eid
+
+    def _wal_append_entry(self, key: Key, entry: _Pending) -> None:
+        """WAL-append one pre-validated entry ahead of its ack. Stashes
+        the canonical line on the entry so the later storage commit
+        appends the byte-identical record the WAL holds."""
+        if self.wal is None:
+            return
+        d = entry.payload.to_json()
+        d["eventId"] = entry.ids[0]
+        entry.wal_line = json.dumps(d).encode("utf-8") + b"\n"
+        entry.lsns = [self.wal.append_events(key, entry.wal_line, 1)]
 
     async def ingest_events(self, events_bodies: Sequence[tuple],
                             access_key, channel_id) -> list[str]:
@@ -473,10 +548,21 @@ class IngestBuffer:
             for entry, res in zip(group, results):
                 if entry.future is None:
                     if isinstance(res, Exception):
-                        self.dropped += entry.n
-                        _M_DROPPED.inc(entry.n)
-                        log.error("dropped %d enqueue-acked event(s): %s",
-                                  entry.n, res)
+                        if self.wal is not None and entry.lsns:
+                            # the pre-ack WAL record is still uncommitted:
+                            # the event is NOT lost — the next recovery
+                            # pass (startup or `pio wal replay`) lands it
+                            self.deferred += entry.n
+                            _M_DEFERRED.inc(entry.n)
+                            log.error(
+                                "deferred %d enqueue-acked event(s) to "
+                                "WAL replay: %s", entry.n, res)
+                        else:
+                            self.dropped += entry.n
+                            _M_DROPPED.inc(entry.n)
+                            log.error(
+                                "dropped %d enqueue-acked event(s): %s",
+                                entry.n, res)
                     continue
                 if entry.future.done():  # client gone (await cancelled)
                     continue
@@ -506,21 +592,32 @@ class IngestBuffer:
         app_id, channel_id = key
         le = self.storage.get_l_events()
         supports_lines = hasattr(le, "insert_canonical_lines")
+        wal_on = self.wal is not None
         results: list = [None] * len(group)
         stat_counts: Counter = Counter()
         # ordered write plan: canonical lines OR (entry, event, id) rows
         lines_parts: list[bytes] = []
         events_plan: list[tuple[Event, str]] = []
         committed: list[int] = []  # entry positions riding the write
+        wal_parts: list[bytes] = []   # lines not yet in the WAL
+        wal_events = 0
+        prewal_lsns: list[int] = []   # enqueue-mode records already there
 
         def plan_event(event: Event, preset: Optional[str]) -> str:
+            nonlocal wal_events
             eid = preset or event.event_id or new_event_id()
-            if supports_lines:
+            line = None
+            if supports_lines or wal_on:
                 # same encoding insert_batch uses: inject the id into the
                 # serialized dict (dataclasses.replace costs 14 us/event)
                 d = event.to_json()
                 d["eventId"] = eid
-                lines_parts.append(json.dumps(d).encode("utf-8") + b"\n")
+                line = json.dumps(d).encode("utf-8") + b"\n"
+            if wal_on:
+                wal_parts.append(line)
+                wal_events += 1
+            if supports_lines:
+                lines_parts.append(line)
             else:
                 events_plan.append((event, eid))
             return eid
@@ -548,13 +645,28 @@ class IngestBuffer:
             entry = group[i]
             if entry.kind == _LINES:
                 lines_parts.append(entry.payload)
+                if wal_on:
+                    wal_parts.append(entry.payload)
+                    wal_events += entry.n
                 results[i] = entry.ids
                 committed.append(i)
                 i += 1
                 continue
             if entry.kind == _EVENT:
-                results[i] = plan_event(
-                    entry.payload, entry.ids[0] if entry.ids else None)
+                if entry.lsns is not None:
+                    # pre-ack WAL'd (enqueue mode): reuse the exact bytes
+                    # the WAL holds so store and WAL can never drift; its
+                    # LSN rides this group's commit marker
+                    prewal_lsns.extend(entry.lsns)
+                    eid = entry.ids[0]
+                    if supports_lines:
+                        lines_parts.append(entry.wal_line)
+                    else:
+                        events_plan.append((entry.payload, eid))
+                    results[i] = eid
+                else:
+                    results[i] = plan_event(
+                        entry.payload, entry.ids[0] if entry.ids else None)
                 committed.append(i)
                 i += 1
                 continue
@@ -587,6 +699,9 @@ class IngestBuffer:
             if nat is not None:
                 ids, lines = nat
                 lines_parts.append(lines)
+                if wal_on:
+                    wal_parts.append(lines)
+                    wal_events += len(ids)
                 for off, eid in enumerate(ids):
                     results[i + off] = eid
                     committed.append(i + off)
@@ -605,7 +720,21 @@ class IngestBuffer:
 
         if committed:
             storage_error = None
+            group_lsn = None
             try:
+                if wal_on:
+                    # WAL-before-store: the group's not-yet-logged lines
+                    # become ONE CRC'd frame, then the segment is fsynced
+                    # per policy — all BEFORE the backing store can
+                    # confirm (or the crash point ingest.commit can
+                    # fire). Inside the try: a sync failure AFTER the
+                    # frame landed must take the abort path below, or the
+                    # clients being told "failed" would retry while
+                    # replay resurrects the frame — every event twice.
+                    if wal_parts:
+                        group_lsn = self.wal.append_events(
+                            key, b"".join(wal_parts), wal_events)
+                    self.wal.sync(key)
                 fault_point("ingest.commit")
                 if supports_lines:
                     if events_plan:  # pragma: no cover — plans are exclusive
@@ -634,9 +763,31 @@ class IngestBuffer:
             except Exception as e:  # noqa: BLE001 — surfaced per request
                 storage_error = e
             if storage_error is not None:
+                if wal_on and group_lsn is not None:
+                    # every event in the group frame belongs to a request
+                    # that is being TOLD the commit failed (it owns the
+                    # retry) — an abort marker keeps replay from
+                    # resurrecting them into duplicates. Pre-ack'd
+                    # (enqueue-mode) records stay uncommitted: deferred
+                    # to replay, not dropped.
+                    try:
+                        self.wal.abort(key, [group_lsn])
+                    except Exception:  # noqa: BLE001 — keep the real error
+                        log.exception("WAL abort marker failed")
                 for pos in committed:
                     results[pos] = storage_error
             else:
+                if wal_on:
+                    try:
+                        fault_point("wal.mark")
+                        covered = prewal_lsns + (
+                            [group_lsn] if group_lsn is not None else [])
+                        self.wal.commit(key, covered)
+                    except Exception:  # noqa: BLE001 — marker is advisory
+                        # the data IS durable in the backing store; a
+                        # missing marker only costs a replay that dedups
+                        log.exception(
+                            "WAL commit marker failed; replay will dedup")
                 for pos in committed:
                     entry = group[pos]
                     if self.stats is not None:

@@ -198,6 +198,52 @@ def _fsync_enabled() -> bool:
         "1", "true", "yes", "on")
 
 
+class AppendHandle:
+    """Lazily-(re)opened long-lived append handle over one file.
+
+    The shared append/fsync machinery for every append-only log in the
+    tree (the JSONL event tables below, the ingest WAL segments in
+    data/api/ingest_wal.py): one ``write`` + ``flush`` per append, so the
+    bytes reach the OS page cache — they survive a SIGKILL of THIS
+    process — and an explicit per-call ``fsync`` decision for the callers
+    that need crash-of-the-HOST durability. Not thread-safe; callers
+    serialize (the JSONL per-table lock, the WAL per-key lock)."""
+
+    __slots__ = ("path", "fh")
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.fh = None
+
+    def append(self, data: bytes, fsync: bool = False) -> None:
+        fh = self.fh
+        if fh is None or fh.closed:
+            fh = self.fh = open(self.path, "ab")
+        fh.write(data)
+        fh.flush()
+        if fsync:
+            os.fsync(fh.fileno())
+
+    def fsync(self) -> None:
+        """fsync without writing (deferred-durability callers: the WAL's
+        ``PIO_WAL_FSYNC=group`` policy syncs once per commit group)."""
+        if self.fh is not None and not self.fh.closed:
+            os.fsync(self.fh.fileno())
+
+    def tell(self) -> int:
+        """Current append offset (0 when the handle was never opened)."""
+        if self.fh is None or self.fh.closed:
+            return 0
+        return self.fh.tell()
+
+    def close(self) -> None:
+        if self.fh is not None:
+            try:
+                self.fh.close()
+            finally:
+                self.fh = None
+
+
 class _TableState:
     """Per-(app, channel) log state: its own lock plus a persistent
     append handle. One event POST used to pay open()+write+close under a
@@ -206,29 +252,22 @@ class _TableState:
     tables run concurrently and each group commit is one write (plus an
     optional fsync) on a long-lived handle."""
 
-    __slots__ = ("lock", "fh")
+    __slots__ = ("lock", "_handle")
 
     def __init__(self) -> None:
         self.lock = threading.RLock()
-        self.fh = None
+        self._handle: Optional[AppendHandle] = None
 
     def append(self, path: str, data: bytes) -> None:
         """Caller holds ``lock``."""
-        fh = self.fh
-        if fh is None or fh.closed:
-            fh = self.fh = open(path, "ab")
-        fh.write(data)
-        fh.flush()
-        if _fsync_enabled():
-            os.fsync(fh.fileno())
+        if self._handle is None or self._handle.path != path:
+            self._handle = AppendHandle(path)
+        self._handle.append(data, fsync=_fsync_enabled())
 
     def close(self) -> None:
         """Caller holds ``lock``."""
-        if self.fh is not None:
-            try:
-                self.fh.close()
-            finally:
-                self.fh = None
+        if self._handle is not None:
+            self._handle.close()
 
 
 class JSONLEvents(base.LEvents):

@@ -41,6 +41,16 @@ def initialize_distributed(
         return
     num_processes = num_processes or int(os.environ.get("PIO_NUM_PROCESSES", "1"))
     process_id = process_id if process_id is not None else int(os.environ.get("PIO_PROCESS_ID", "0"))
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # The CPU PJRT client ships WITHOUT cross-process collectives by
+        # default ("Multiprocess computations aren't implemented on the
+        # CPU backend") — select the gloo TCP implementation before the
+        # backend initializes. TPU/GPU pods use their own interconnect
+        # collectives and never read this flag.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):  # older/newer jax: no flag
+            log.debug("jax_cpu_collectives_implementation not supported")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -57,6 +57,32 @@ def status_cmd(args: list[str]) -> int:
     except Exception as e:  # noqa: BLE001 - informational only
         print(f"[info] Native codec: unavailable ({e}); pure-Python "
               "fallbacks active (identical behavior, slower).")
+    # Ingest WAL state: whether crash durability is armed, and whether a
+    # previous process left uncommitted records behind (replay needed).
+    from ...data.api import ingest_wal
+
+    wal_cfg = ingest_wal.WalConfig.from_env()
+    if wal_cfg.enabled:
+        rows = ingest_wal.inspect(wal_cfg)
+        pending = sum(r["uncommittedEvents"] for r in rows)
+        torn = sum(r["tornTailBytes"] for r in rows)
+        print(f"[info] Ingest WAL: enabled (fsync={wal_cfg.fsync}, "
+              f"dir={wal_cfg.dir})")
+        if pending or torn:
+            if ingest_wal.dir_is_live(wal_cfg):
+                print(f"[info]   a live event server owns this WAL dir — "
+                      f"the {pending} uncommitted event(s) / {torn} "
+                      "torn-tail byte(s) include in-flight writes and "
+                      "are expected; its commits (or startup replay "
+                      "after a crash) settle them")
+            else:
+                print(f"[warn]   {pending} uncommitted event(s) across "
+                      f"{len(rows)} key(s), {torn} torn-tail byte(s) — "
+                      "replayed at event-server start, or run `pio wal "
+                      "replay` now")
+    else:
+        print("[info] Ingest WAL: disabled (PIO_WAL=1 to arm crash-"
+              "durable ingestion)")
     if ns.metrics:
         # Snapshot of THIS process's registry: after the checks above
         # it carries the storage op latencies + breaker states the
@@ -67,6 +93,65 @@ def status_cmd(args: list[str]) -> int:
         print("[info] Telemetry snapshot (Prometheus text format):")
         sys.stdout.write(telemetry.render_all())
     print("[info] Your system is all ready to go.")
+    return 0
+
+
+@verb("wal", "inspect or replay the ingest write-ahead log")
+def wal_cmd(args: list[str]) -> int:
+    """Operator surface for the crash-durability WAL (PIO_WAL=1, see
+    data/api/ingest_wal.py): `inspect` lists per-(app, channel) segment
+    state without touching storage; `replay` runs the same recovery
+    pass the event server runs at startup — replays uncommitted
+    records (deduped by event_id) and truncates the segments."""
+    p = argparse.ArgumentParser(prog="pio wal")
+    sub = p.add_subparsers(dest="sub", required=True)
+    sub.add_parser("inspect", help="list WAL segments and uncommitted "
+                                   "record counts per (app, channel)")
+    sub.add_parser("replay", help="replay uncommitted records into the "
+                                  "configured event store, then truncate")
+    ns = p.parse_args(args)
+    from ...data.api import ingest_wal
+
+    cfg = ingest_wal.WalConfig.from_env()
+    if ns.sub == "inspect":
+        rows = ingest_wal.inspect(cfg)
+        print(f"[info] WAL dir: {cfg.dir} (fsync={cfg.fsync})")
+        if not rows:
+            print("[info] No WAL segments on disk — nothing to replay.")
+            return 0
+        live = ingest_wal.dir_is_live(cfg)
+        if live:
+            print("[info] A live event server owns this WAL dir: counts "
+                  "below include in-flight writes (uncommitted records "
+                  "and even a transient torn tail are expected, not "
+                  "corruption).")
+        for r in rows:
+            chan = "" if r["channelId"] is None else f" channel {r['channelId']}"
+            marker = "[warn]" if (not live and (r["uncommittedEvents"]
+                                                or r["tornTailBytes"])) \
+                else "[info]"
+            print(f"{marker}   app {r['appId']}{chan}: "
+                  f"{r['segments']} segment(s), {r['bytes']} bytes, "
+                  f"{r['uncommittedEvents']} uncommitted event(s), "
+                  f"{r['committedRecords']} committed / "
+                  f"{r['abortedRecords']} aborted record(s), "
+                  f"{r['tornTailBytes']} torn-tail byte(s)")
+        return 0
+    # replay
+    s = Storage.instance()
+    try:
+        summary = ingest_wal.recover(s, cfg)
+    except ingest_wal.WalLockedError as e:
+        print(f"[error] {e}", file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001 — operator-facing
+        print(f"[error] WAL replay failed (storage unreachable?): {e}",
+              file=sys.stderr)
+        return 1
+    print(f"[info] WAL replay: {summary['replayed']} event(s) replayed, "
+          f"{summary['deduped']} deduped, {summary['discardedBytes']} "
+          f"torn-tail byte(s) discarded, {summary['segmentsRemoved']} "
+          f"segment(s) truncated across {summary['keys']} key(s).")
     return 0
 
 

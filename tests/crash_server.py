@@ -1,0 +1,31 @@
+"""Event-server subprocess for the kill -9 crash-recovery harness
+(tests/test_crash_recovery.py).
+
+Runs the REAL event server against the storage configured in the
+inherited environment (SQLITE metadata + JSONL eventdata in the test's
+tmp dir, PIO_WAL armed). The test process kills this one with the
+deterministic SIGKILL fault (`PIO_FAULT_SPEC=...:crash:N`), restarts
+it without the fault, and asserts exactly-once recovery. Storage
+metadata (app + access key) is created by the TEST process before
+launch, so a restart sees the same world.
+
+Usage: python crash_server.py <port>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    port = int(sys.argv[1])
+    from incubator_predictionio_tpu.data.api.event_server import (
+        run_event_server)
+
+    run_event_server("127.0.0.1", port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,288 @@
+"""kill -9 crash-recovery harness (ISSUE 5 acceptance).
+
+A REAL event server runs in a subprocess with the WAL armed; the
+deterministic `crash` fault (common/faultinject.py) SIGKILLs it at a
+named point mid-commit; the test restarts it and asserts every ACKED
+event is present exactly once — no loss (enqueue-mode acks that never
+reached the store are replayed from the WAL) and no duplicates (records
+whose store write landed but whose commit marker didn't are deduped by
+event_id at replay). A torn WAL tail (garbage appended by the crash)
+recovers cleanly.
+
+Storage: SQLITE metadata (survives the restart), JSONL eventdata.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+pytestmark = [pytest.mark.crash, pytest.mark.chaos]
+
+T = "2026-01-01T00:00:00.000Z"
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _ev(i, **kw):
+    d = {"event": "view", "entityType": "user", "entityId": f"u{i}",
+         "eventTime": T}
+    d.update(kw)
+    return d
+
+
+def _make_env(tmp_path, **extra):
+    env = {
+        **os.environ,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "meta.sqlite"),
+        "PIO_STORAGE_SOURCES_EV_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_EV_PATH": str(tmp_path / "events"),
+        "PIO_WAL": "1",
+        "PIO_WAL_DIR": str(tmp_path / "wal"),
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.pop("PIO_FAULT_SPEC", None)
+    env.update(extra)
+    return env
+
+
+def _prepare_metadata(env) -> str:
+    """Create app + access key in the SQLITE metadata the subprocess
+    will read; returns the access key string."""
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+
+    storage = Storage({k: v for k, v in env.items()
+                       if k.startswith("PIO_STORAGE")})
+    app_id = storage.get_meta_data_apps().insert(App(0, "crashapp"))
+    key = storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    storage.close()
+    return key
+
+
+def _launch(env, port):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "crash_server.py"), str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _wait_ready(proc, port, timeout=60) -> str:
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode(errors="replace")
+            raise AssertionError(
+                f"server died before ready (rc={proc.returncode}):\n"
+                f"{out[-3000:]}")
+        try:
+            if requests.get(base + "/", timeout=2).status_code == 200:
+                return base
+        except requests.RequestException:
+            time.sleep(0.1)
+    proc.kill()
+    raise AssertionError("server not ready within timeout")
+
+
+def _reap(proc, timeout=30):
+    try:
+        proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+
+
+def _all_events(base, key):
+    r = requests.get(f"{base}/events.json?accessKey={key}&limit=-1",
+                     timeout=30)
+    assert r.status_code == 200, r.text
+    return r.json()
+
+
+@pytest.fixture()
+def crashbox(tmp_path):
+    """(env, key, port) + subprocess cleanup."""
+    procs = []
+    env = _make_env(tmp_path)
+    key = _prepare_metadata(env)
+
+    def launch(port=None, **extra):
+        port = port or _free_port()
+        p = _launch(dict(env, **extra), port)
+        procs.append(p)
+        return p, port
+
+    yield env, key, launch
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        _reap(p, timeout=10)
+
+
+def test_kill9_mid_group_replays_acked_exactly_once(crashbox):
+    """The headline acceptance: enqueue-mode singles (acked before any
+    store write) + commit-mode batches, SIGKILL inside the 3rd group
+    commit, restart, and every acked event is present exactly once."""
+    env, key, launch = crashbox
+    proc, port = launch(
+        PIO_INGEST_ACK="enqueue",          # singles ack on enqueue...
+        PIO_INGEST_GROUP_MS="60",          # ...and groups collect 60 ms
+        PIO_FAULT_SPEC="ingest.commit:crash:3")
+    base = _wait_ready(proc, port)
+
+    acked = []
+    i = 0
+    deadline = time.monotonic() + 120
+    died = False
+    while time.monotonic() < deadline:
+        try:
+            if i % 7 == 6:
+                # a commit-acked batch rides along (mixed stream): in
+                # enqueue mode batches still await their group's commit
+                r = requests.post(
+                    f"{base}/batch/events.json?accessKey={key}",
+                    json=[_ev(1000 + i * 10 + j) for j in range(3)],
+                    timeout=10)
+                if r.status_code == 200:
+                    acked.extend(x["eventId"] for x in r.json()
+                                 if x["status"] == 201)
+            else:
+                r = requests.post(
+                    f"{base}/events.json?accessKey={key}",
+                    json=_ev(i), timeout=10)
+                if r.status_code == 201:
+                    acked.append(r.json()["eventId"])
+            i += 1
+            time.sleep(0.005)
+        except requests.RequestException:
+            died = True
+            break
+    assert died, "server never crashed — crash fault did not fire"
+    _reap(proc)
+    assert proc.returncode in (-signal.SIGKILL, 137), proc.returncode
+    assert len(acked) > 3
+
+    # the crash must have eaten acked-but-unstored events (else the
+    # test proves nothing): read the JSONL log directly
+    log_path = os.path.join(env["PIO_STORAGE_SOURCES_EV_PATH"],
+                            "pio_eventdata", "events_1.jsonl")
+    stored_before = set()
+    if os.path.exists(log_path):
+        with open(log_path, "rb") as f:
+            for line in f:
+                if line.strip():
+                    stored_before.add(json.loads(line)["eventId"])
+    lost = [eid for eid in acked if eid not in stored_before]
+    assert lost, "no acked event was missing from the store at crash " \
+                 "time — the kill did not land mid-group"
+
+    # restart WITHOUT the fault: __init__ recovery replays the WAL
+    proc2, port2 = launch(PIO_INGEST_ACK="enqueue")
+    base2 = _wait_ready(proc2, port2)
+    events = _all_events(base2, key)
+    got = [e["eventId"] for e in events]
+    counts = {eid: got.count(eid) for eid in acked}
+    missing = [e for e, c in counts.items() if c == 0]
+    dupes = [e for e, c in counts.items() if c > 1]
+    assert not missing, f"{len(missing)} acked event(s) lost: {missing[:5]}"
+    assert not dupes, f"acked event(s) duplicated: {dupes[:5]}"
+    # nothing else got duplicated either (unacked replays are allowed
+    # to land, but only once)
+    assert len(got) == len(set(got)), "duplicate event ids after replay"
+    proc2.terminate()
+    _reap(proc2)
+
+
+def test_kill9_after_store_before_marker_no_duplicates(crashbox):
+    """Crash in the window between the backing-store write and the WAL
+    commit marker (`wal.mark`): the record is in BOTH the store and the
+    uncommitted WAL — replay must dedup by event_id, yielding exactly
+    one copy after restart."""
+    env, key, launch = crashbox
+    proc, port = launch(PIO_FAULT_SPEC="wal.mark:crash:1")
+    base = _wait_ready(proc, port)
+    with pytest.raises(requests.RequestException):
+        # ack=commit: the response waits on the commit, whose success
+        # path crashes before the marker — the client never hears back
+        requests.post(f"{base}/events.json?accessKey={key}",
+                      json=_ev(1), timeout=10)
+    _reap(proc)
+    assert proc.returncode in (-signal.SIGKILL, 137), proc.returncode
+
+    # the store DID get the write (crash was after it)
+    log_path = os.path.join(env["PIO_STORAGE_SOURCES_EV_PATH"],
+                            "pio_eventdata", "events_1.jsonl")
+    with open(log_path, "rb") as f:
+        stored = [json.loads(x) for x in f if x.strip()]
+    assert len(stored) == 1
+
+    proc2, port2 = launch()
+    base2 = _wait_ready(proc2, port2)
+    events = _all_events(base2, key)
+    assert len([e for e in events if e["entityId"] == "u1"]) == 1, \
+        "replay duplicated a stored-but-unmarked record"
+    proc2.terminate()
+    _reap(proc2)
+
+
+def test_kill9_with_torn_wal_tail_recovers(crashbox):
+    """Garbage appended to the last WAL segment (the torn write a crash
+    can leave) is discarded by CRC at recovery; every acked event still
+    lands exactly once."""
+    env, key, launch = crashbox
+    proc, port = launch(
+        PIO_INGEST_ACK="enqueue",
+        PIO_INGEST_GROUP_MS="60",
+        PIO_FAULT_SPEC="ingest.commit:crash:2")
+    base = _wait_ready(proc, port)
+    acked = []
+    deadline = time.monotonic() + 120
+    died = False
+    i = 0
+    while time.monotonic() < deadline:
+        try:
+            r = requests.post(f"{base}/events.json?accessKey={key}",
+                              json=_ev(i), timeout=10)
+            if r.status_code == 201:
+                acked.append(r.json()["eventId"])
+            i += 1
+            time.sleep(0.005)
+        except requests.RequestException:
+            died = True
+            break
+    assert died and acked
+    _reap(proc)
+
+    # tear the tail: half a frame header + junk, as an interrupted
+    # write would leave
+    keydir = os.path.join(env["PIO_WAL_DIR"], "1")
+    segs = sorted(os.listdir(keydir))
+    assert segs, "no WAL segment on disk after crash"
+    with open(os.path.join(keydir, segs[-1]), "ab") as f:
+        f.write(b"\x45\x99\x00")
+
+    proc2, port2 = launch(PIO_INGEST_ACK="enqueue")
+    base2 = _wait_ready(proc2, port2)
+    events = _all_events(base2, key)
+    got = [e["eventId"] for e in events]
+    assert len(got) == len(set(got))
+    for eid in acked:
+        assert got.count(eid) == 1, f"acked {eid} count {got.count(eid)}"
+    proc2.terminate()
+    _reap(proc2)

@@ -301,6 +301,50 @@ def test_overload_sheds_503_with_retry_after(tmp_path, monkeypatch):
         faultinject.reset()
 
 
+def test_retry_after_is_jittered():
+    """The shed path's Retry-After must spread retries (full jitter):
+    a constant value synchronizes every honouring SDK into one wave."""
+    import random
+
+    from incubator_predictionio_tpu.common.resilience import (
+        retry_after_jitter)
+
+    rng = random.Random(7)
+    vals = {retry_after_jitter(2.0, rng) for _ in range(200)}
+    assert len(vals) > 1, "Retry-After is constant — thundering herd"
+    assert min(vals) >= 1 and max(vals) <= 5  # 1 + U(0, 2*base)
+    # tiny bases still produce a valid integer header
+    assert retry_after_jitter(0.0, rng) == 1
+
+
+def test_shutdown_releases_handles_even_when_drain_raises(
+        tmp_path, monkeypatch):
+    """ISSUE 5 satellite: the on_shutdown drain → store close sequence
+    must close the JSONL cached append handles even when drain()
+    raises (a leaked fd would pin the log file past shutdown)."""
+    monkeypatch.setenv("PIO_INGEST_GROUP", "on")
+    storage, app_id, key, _cid = _jsonl_storage(tmp_path)
+    server = EventServer(storage)
+    with ServerThread(server.app) as st:
+        u = f"{st.base}/events.json?accessKey={key}"
+        assert requests.post(u, json=_ev(1)).status_code == 201
+        le = storage.get_l_events()
+        state = le._tables[le._path(app_id, None)]
+        assert state._handle is not None \
+            and state._handle.fh is not None, "no cached handle to test"
+
+        real_drain = server.ingest.drain
+
+        async def boom():
+            await real_drain()  # settle the flusher, THEN explode
+            raise RuntimeError("drain exploded")
+
+        server.ingest.drain = boom
+    # ServerThread.__exit__ ran on_shutdown: drain raised, close ran
+    assert state._handle.fh is None or state._handle.fh.closed, \
+        "JSONL append handle leaked through a failing drain"
+
+
 def test_enqueue_ack_mode(tmp_path, monkeypatch):
     """ack=enqueue: 201 + id before the commit; the event still lands;
     validation failures are still real 400s."""
