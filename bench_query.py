@@ -24,10 +24,20 @@ protocol; a closed loop hides queueing), async aiohttp clients,
 reporting p50/p95/p99 + achieved throughput at each offered rate, with
 the micro-batching window off and on (PIO_QBENCH_BATCH_MS, default 5).
 
+Overload bracket (ISSUE 6 acceptance): unless PIO_QBENCH_OVERLOAD=0,
+the run ALSO measures behavior at offered load ≫ capacity — a small
+admission-gated server (conc 2 + pending 8) with an injected slow model
+(PIO_FAULT_SPEC latency on query.predict) under an open-loop flood —
+and persists goodput, shed rate and ACCEPTED-query p99 next to the QPS
+numbers, plus whether sheds carried a jittered Retry-After. The honest
+overload protocol: arrivals keep coming regardless of completions, so
+an unbounded queue would show unbounded p99 here, not a hidden one.
+
 Env: PIO_QBENCH_ITEMS (default 26744), PIO_QBENCH_RANK (32),
 PIO_QBENCH_USERS (3000), PIO_QBENCH_N (200 queries),
 PIO_QBENCH_QPS ("50,100,200"), PIO_QBENCH_DURATION (seconds per rate),
-PIO_QBENCH_BATCH_MS (5), PIO_BENCH_FORCE_CPU=1 to smoke off-TPU.
+PIO_QBENCH_BATCH_MS (5), PIO_QBENCH_OVERLOAD (1), PIO_BENCH_FORCE_CPU=1
+to smoke off-TPU.
 """
 
 from __future__ import annotations
@@ -90,6 +100,105 @@ def load_test(base_url: str, qps: float, duration: float, n_users: int,
         return lat, errors[0], len(lat) / wall
 
     return asyncio.run(run())
+
+
+def overload_bracket(engine, storage, n_users, *, conc=2, max_pending=8,
+                     service_ms=50.0, overload_factor=4.0, duration=4.0):
+    """Open-loop flood at offered load ≫ capacity against an
+    admission-gated server with an injected slow model. Returns
+    {goodput_qps, shed_rate, accepted_p99_ms, ...} — the numbers an
+    operator sizes PIO_QUERY_* from."""
+    import asyncio
+
+    import aiohttp
+
+    from incubator_predictionio_tpu.common import faultinject
+    from incubator_predictionio_tpu.workflow.create_server import EngineServer
+    from server_utils import ServerThread
+
+    capacity = conc / (service_ms / 1000.0)
+    offered = capacity * overload_factor
+    prev_spec = os.environ.get("PIO_FAULT_SPEC")
+    srv = EngineServer(
+        engine, engine_factory_name="qbench", storage=storage,
+        query_conc=conc, query_max_pending=max_pending,
+        query_deadline_ms=30_000)
+    # armed AFTER construction so warm-up queries don't consume counts
+    os.environ["PIO_FAULT_SPEC"] = \
+        f"query.predict:latency:100000000:{service_ms / 1000.0}"
+    faultinject.reset()
+
+    async def run(base):
+        ok_lat, sheds, retry_afters, errors = [], [0], set(), [0]
+        timeout = aiohttp.ClientTimeout(total=60)
+        async with aiohttp.ClientSession(timeout=timeout) as sess:
+
+            async def one(delay, user):
+                await asyncio.sleep(delay)
+                t0 = time.perf_counter()
+                try:
+                    async with sess.post(
+                            base + "/queries.json",
+                            json={"user": user, "num": 10}) as resp:
+                        await resp.read()
+                        if resp.status == 200:
+                            ok_lat.append(
+                                (time.perf_counter() - t0) * 1000)
+                        elif resp.status == 503:
+                            sheds[0] += 1
+                            ra = resp.headers.get("Retry-After")
+                            if ra is not None:
+                                retry_afters.add(ra)
+                        else:
+                            errors[0] += 1
+                except Exception:  # noqa: BLE001
+                    errors[0] += 1
+
+            n = int(offered * duration)
+            t0 = time.perf_counter()
+            await asyncio.gather(*[
+                asyncio.create_task(one(k / offered, str(k % n_users)))
+                for k in range(n)])
+            wall = time.perf_counter() - t0
+        return ok_lat, sheds[0], retry_afters, errors[0], wall, n
+
+    try:
+        with ServerThread(srv.app) as st:
+            ok_lat, sheds, retry_afters, errors, wall, n = \
+                asyncio.run(run(st.base))
+    finally:
+        if prev_spec is None:
+            os.environ.pop("PIO_FAULT_SPEC", None)
+        else:
+            os.environ["PIO_FAULT_SPEC"] = prev_spec
+        faultinject.reset()
+
+    def pct(a, p):
+        return float(np.percentile(np.asarray(a), p)) if a else None
+
+    ov = srv.overload_snapshot()
+    out = {
+        "conc": conc, "max_pending": max_pending,
+        "service_ms": service_ms,
+        "capacity_qps": round(capacity, 1),
+        "offered_qps": round(offered, 1),
+        "goodput_qps": round(len(ok_lat) / wall, 1),
+        "shed_rate": round(sheds / n, 3),
+        "accepted_p50_ms": round(pct(ok_lat, 50), 1) if ok_lat else None,
+        "accepted_p99_ms": round(pct(ok_lat, 99), 1) if ok_lat else None,
+        "errors": errors,
+        "peak_pending": ov["peakPending"],
+        "pending_limit": ov["pendingLimit"],
+        "retry_after_jittered": len(retry_afters) > 1,
+    }
+    log(f"[qbench:overload] offered={out['offered_qps']}qps "
+        f"(capacity≈{out['capacity_qps']}qps): goodput="
+        f"{out['goodput_qps']}qps shed_rate={out['shed_rate']} "
+        f"accepted p99={out['accepted_p99_ms']}ms peak_pending="
+        f"{out['peak_pending']}/{out['pending_limit']} "
+        f"retry_after_jittered={out['retry_after_jittered']} "
+        f"errors={errors}")
+    return out
 
 
 def main() -> int:
@@ -293,6 +402,11 @@ def main() -> int:
                         f"p50={load_detail[key]['p50_ms']}ms "
                         f"p99={load_detail[key]['p99_ms']}ms errors={errs}")
 
+    # -- overload bracket: offered load ≫ capacity (ISSUE 6) --------------
+    overload_detail = None
+    if os.environ.get("PIO_QBENCH_OVERLOAD", "1") != "0":
+        overload_detail = overload_bracket(engine, storage, n_users)
+
     p50 = pct(lat_http, 50)
     print(json.dumps({
         "metric": f"pio query p50 /queries.json {n_items}-item catalog "
@@ -306,6 +420,7 @@ def main() -> int:
             "http_p99_ms": round(pct(lat_http, 99), 2),
             "dispatch_rtt_ms": round(rtt_ms, 2),
             **({"load": load_detail} if load_detail else {}),
+            **({"overload": overload_detail} if overload_detail else {}),
         },
     }))
     return 0

@@ -34,6 +34,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, Iterable, Optional
 
+from . import deadline as _deadline
 from . import faultinject, telemetry
 
 __all__ = [
@@ -188,10 +189,20 @@ class RetryPolicy:
         attempt — it only gates whether ANOTHER attempt may start, so a
         legitimately slow single operation (a multi-GB model blob
         transfer) keeps its full configured TIMEOUT; worst-case total
-        time is bounded by deadline + one attempt timeout."""
+        time is bounded by deadline + one attempt timeout.
+
+        A request-scoped deadline (``common/deadline.py`` contextvar —
+        storage egress running inside a served query) is the exception:
+        it DOES truncate the attempt, because past that point nobody is
+        waiting for the answer. A small floor keeps a nearly-spent
+        budget from degenerating into timeout=0 (invalid for sockets)."""
+        t = default
         if self.per_attempt_timeout is not None:
-            return min(default, self.per_attempt_timeout)
-        return default
+            t = min(t, self.per_attempt_timeout)
+        dl = _deadline.current()
+        if dl is not None:
+            t = min(t, max(dl.remaining(), 0.05))
+        return t
 
     def call(self, fn: Callable[[], object], *,
              breaker: Optional["CircuitBreaker"] = None,
@@ -210,8 +221,18 @@ class RetryPolicy:
         not to retry it."""
         classify = retryable or self.retryable
         started = time.monotonic()
+        # Request-scoped deadline (serving a query): the retry budget
+        # is capped to the request's remaining balance, and an already-
+        # spent budget refuses to start at all — a dead store must not
+        # hold a query thread for this policy's full 15 s default when
+        # the client's 504 fires in 200 ms.
+        dl = _deadline.current()
+        budget = self.deadline if dl is None \
+            else min(self.deadline, dl.remaining())
         last: Optional[BaseException] = None
         for attempt in range(self.max_attempts):
+            if dl is not None:
+                dl.check("storage egress")
             if breaker is not None:
                 breaker.check()
             try:
@@ -226,9 +247,9 @@ class RetryPolicy:
                     raise
                 last = e
                 delay = self.backoff(attempt)
-                if time.monotonic() - started + delay > self.deadline:
+                if time.monotonic() - started + delay > budget:
                     raise RetryBudgetExceeded(
-                        f"retry deadline budget ({self.deadline:.3g}s) "
+                        f"retry deadline budget ({budget:.3g}s) "
                         f"exhausted after {attempt + 1} attempt(s): {e}"
                     ) from e
                 if on_retry is not None:

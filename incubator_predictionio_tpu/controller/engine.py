@@ -11,7 +11,7 @@ import logging
 import time
 from typing import Any, Mapping, Optional, Sequence, Type
 
-from ..common import telemetry
+from ..common import deadline, faultinject, telemetry
 from .algorithm import Algorithm
 from .base import SanityCheck, doer
 from .datasource import DataSource
@@ -297,18 +297,31 @@ class Deployment:
         # Stage telemetry: histogram observations per stage, and —
         # when the HTTP layer sampled this request (trace context
         # propagates through asyncio.to_thread) — one span per stage.
+        # Each stage opens with a chaos fault point (latency/hang/fail
+        # injection on the serving path, the overload harness's slow-
+        # model lever) and a deadline spend-point: a worker thread past
+        # its request's budget frees itself at the next stage boundary
+        # instead of finishing work for a client that already got 504.
+        dl = deadline.current()
         tr = telemetry.current_trace()
         t0 = (time.perf_counter_ns()
               if tr is not None else telemetry.timer_start())
+        faultinject.fault_point("query.featurize")
         q = self.serving.supplement(q)
         t1 = time.perf_counter_ns() if t0 else 0
         _ST_FEATURIZE.observe_since(t0)
+        if dl is not None:
+            dl.check("query.predict")
+        faultinject.fault_point("query.predict")
         predictions = [
             algo.predict(model, q)
             for (_, algo), model in zip(self.algo_list, self.models)
         ]
         t2 = time.perf_counter_ns() if t0 else 0
         _ST_PREDICT.observe_since(t1)
+        if dl is not None:
+            dl.check("query.serve")
+        faultinject.fault_point("query.serve")
         result = self.serving.serve(q, predictions)
         _ST_SERVE.observe_since(t2)
         if tr is not None:
@@ -323,7 +336,14 @@ class Deployment:
         """Vectorized multi-query path (one device dispatch per
         algorithm instead of one per query) — used by the engine
         server's micro-batching window and `pio batchpredict`."""
+        # One fault point per coalesced dispatch (not per query): a
+        # latency injection here models ONE slow vectorized forward,
+        # exactly what a wedged device queue looks like to the batcher.
+        # No deadline spend-points — a batch mixes requests with
+        # different budgets; expiry is enforced per-request at the
+        # future level by the admission gate.
         t0 = telemetry.timer_start()
+        faultinject.fault_point("query.batch_predict")
         qs = [self.serving.supplement(q) for q in queries]
         t1 = time.perf_counter_ns() if t0 else 0
         _ST_FEATURIZE_B.observe_since(t0)

@@ -127,6 +127,21 @@ def deploy_cmd(args: list[str]) -> int:
                         "query p50/p99 decomposition (HTTP / predict / "
                         "device RTT / parse) against this attachment and "
                         "persist it to the EngineInstance row")
+    p.add_argument("--query-conc", type=int, default=None,
+                   help="bounded query executor width (default "
+                        "$PIO_QUERY_CONC, else cpu+4 capped at 32)")
+    p.add_argument("--query-max-pending", type=int, default=None,
+                   help="admission queue depth beyond --query-conc; "
+                        "excess load sheds 503 + jittered Retry-After "
+                        "(default $PIO_QUERY_MAX_PENDING, else 128)")
+    p.add_argument("--query-deadline-ms", type=float, default=None,
+                   help="per-query deadline budget; exceeded → 504 "
+                        "(X-Pio-Deadline-Ms overrides per request; 0 "
+                        "disables; default $PIO_QUERY_DEADLINE_MS, "
+                        "else 30000)")
+    p.add_argument("--drain-deadline-ms", type=float, default=None,
+                   help="graceful-drain budget on SIGTERM or /stop "
+                        "(default $PIO_DRAIN_DEADLINE_MS, else 10000)")
     ns = p.parse_args(args)
     from ...workflow.create_server import EngineServer, run_engine_server
 
@@ -143,6 +158,10 @@ def deploy_cmd(args: list[str]) -> int:
         feedback_app_name=app_name,
         batch_window_ms=ns.batch_window_ms,
         max_batch=ns.max_batch,
+        query_conc=ns.query_conc,
+        query_max_pending=ns.query_max_pending,
+        query_deadline_ms=ns.query_deadline_ms,
+        drain_deadline_ms=ns.drain_deadline_ms,
     )
     print(f"[info] Engine is deployed and running. Listening on {ns.ip}:{ns.port}")
     run_engine_server(server, ns.ip, ns.port,

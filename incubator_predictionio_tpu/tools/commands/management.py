@@ -20,6 +20,12 @@ def status_cmd(args: list[str]) -> int:
     p.add_argument("--metrics", action="store_true",
                    help="print a Prometheus-format snapshot of this "
                         "process's telemetry registry after the checks")
+    p.add_argument("--engine-url",
+                   default=os.environ.get("PIO_ENGINE_URL"),
+                   help="also query a running engine server's GET "
+                        "/status and report its serving overload "
+                        "counters (shed / deadline / drain) — defaults "
+                        "to $PIO_ENGINE_URL")
     ns = p.parse_args(args)
     s = Storage.instance()
     print("[info] Inspecting storage backend connections...")
@@ -83,6 +89,8 @@ def status_cmd(args: list[str]) -> int:
     else:
         print("[info] Ingest WAL: disabled (PIO_WAL=1 to arm crash-"
               "durable ingestion)")
+    if ns.engine_url:
+        _print_engine_overload(ns.engine_url)
     if ns.metrics:
         # Snapshot of THIS process's registry: after the checks above
         # it carries the storage op latencies + breaker states the
@@ -94,6 +102,42 @@ def status_cmd(args: list[str]) -> int:
         sys.stdout.write(telemetry.render_all())
     print("[info] Your system is all ready to go.")
     return 0
+
+
+def _print_engine_overload(url: str) -> None:
+    """Operator view of a live engine server's admission gate: the
+    /status overload counters, without scraping /metrics (ISSUE 6 —
+    `pio status` must show overload at a glance)."""
+    import urllib.error
+    import urllib.request
+
+    base = url if "://" in url else f"http://{url}"
+    try:
+        with urllib.request.urlopen(
+                base.rstrip("/") + "/status", timeout=5) as resp:
+            doc = json.load(resp)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"[warn] engine server at {base} unreachable: {e}")
+        return
+    ov = doc.get("overload")
+    if not ov:
+        print(f"[warn] engine server at {base} predates the overload "
+              "surface (no `overload` on /status)")
+        return
+    marker = "[warn]" if (ov.get("draining") or ov.get("shed")
+                          or ov.get("deadlineExceeded")
+                          or ov.get("drainStragglers")) else "[info]"
+    print(f"[info] Engine server {base}: instance "
+          f"{doc.get('engineInstanceId')}, {doc.get('queryCount')} "
+          "queries served"
+          + (", DEGRADED" if doc.get("degraded") else ""))
+    print(f"{marker}   serving: pending {ov.get('pending')}"
+          f"/{ov.get('pendingLimit')} (peak {ov.get('peakPending')}, "
+          f"conc {ov.get('conc')}), shed={ov.get('shed')}, "
+          f"deadlineExceeded={ov.get('deadlineExceeded')}, "
+          f"orphaned={ov.get('orphaned')}, "
+          f"draining={ov.get('draining')}, "
+          f"drainStragglers={ov.get('drainStragglers')}")
 
 
 @verb("wal", "inspect or replay the ingest write-ahead log")

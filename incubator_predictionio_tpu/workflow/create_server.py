@@ -14,10 +14,14 @@ JSON parse → host gather → one device dispatch → one host fetch.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
+import contextvars
 import datetime as _dt
 import hmac
 import json
 import logging
+import math
+import os
 import threading
 from typing import Any, Optional
 
@@ -25,7 +29,8 @@ import time as _time
 
 from aiohttp import web
 
-from ..common import telemetry
+from ..common import deadline, telemetry
+from ..common.resilience import retry_after_jitter
 from ..controller.engine import Engine
 from ..data.storage.datamap import DataMap
 from ..data.storage.event import Event
@@ -35,6 +40,29 @@ from .core_workflow import load_deployment
 from .plugins import EngineServerPluginContext
 
 log = logging.getLogger("pio.engineserver")
+
+
+def _env_int(name: str, default: int) -> int:
+    """Tolerant integer knob: unset/unparsable degrades to the default
+    (a typo'd env var must not crash a deploy)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(float(raw))
+    except (ValueError, OverflowError):   # "bananas", "inf", 1e999
+        return default
+
+
+class AdmissionShed(Exception):
+    """The admission gate refused this query (queue full or server
+    draining). Maps to HTTP 503 + jittered ``Retry-After`` — the query
+    never started, so a retry elsewhere/later is safe and cheap."""
+
+    def __init__(self, message: str, retry_after_base: float, reason: str):
+        super().__init__(message)
+        self.retry_after_base = retry_after_base
+        self.reason = reason
 
 
 class EngineServer:
@@ -50,6 +78,10 @@ class EngineServer:
         plugins: Optional[EngineServerPluginContext] = None,
         batch_window_ms: float = 0.0,
         max_batch: int = 64,
+        query_conc: Optional[int] = None,
+        query_max_pending: Optional[int] = None,
+        query_deadline_ms: Optional[float] = None,
+        drain_deadline_ms: Optional[float] = None,
     ):
         self.engine = engine
         self.engine_factory_name = engine_factory_name
@@ -76,6 +108,8 @@ class EngineServer:
         self.start_time = _dt.datetime.now(_dt.timezone.utc)
         self._lock = threading.Lock()
         self._query_count = 0
+        self._init_overload_state(query_conc, query_max_pending,
+                                  query_deadline_ms, drain_deadline_ms)
         # Probe marker secret: synthetic startup-probe traffic is
         # excluded from queryCount/feedback, so the marker must not be
         # spoofable — an external client sending a bare "X-Pio-Probe: 1"
@@ -107,6 +141,7 @@ class EngineServer:
         self.app.add_routes(
             [
                 web.get("/", self.handle_status),
+                web.get("/status", self.handle_status),
                 web.get("/metrics", self.handle_metrics),
                 web.get("/healthz", self.handle_healthz),
                 web.get("/readyz", self.handle_readyz),
@@ -121,6 +156,54 @@ class EngineServer:
         if self.batch_window_ms > 0:
             self.app.on_startup.append(self._start_batcher)
             self.app.on_cleanup.append(self._stop_batcher)
+        self.app.on_cleanup.append(self._shutdown_executor)
+
+    def _init_overload_state(self, query_conc=None, query_max_pending=None,
+                             query_deadline_ms=None,
+                             drain_deadline_ms=None) -> None:
+        """Admission control: the query path gets a DEDICATED bounded
+        executor (query_conc workers) plus a bounded waiting budget
+        (query_max_pending); offered load beyond conc+pending is shed
+        with 503 + jittered Retry-After instead of queueing without
+        limit in the default executor. Args override the PIO_QUERY_*
+        env knobs; see docs/operations.md "Serving: overload safety".
+        (Separate from __init__ so harness code building a skeleton
+        server via __new__ — tools/big_catalog_demo.py — can arm the
+        gate without the storage-backed load.)"""
+        self.query_conc = max(1, int(
+            query_conc if query_conc is not None
+            else _env_int("PIO_QUERY_CONC",
+                          min(32, (os.cpu_count() or 4) + 4))))
+        self.query_max_pending = max(0, int(
+            query_max_pending if query_max_pending is not None
+            else _env_int("PIO_QUERY_MAX_PENDING", 128)))
+        # Deadline budget per query (0 = unbounded); the X-Pio-Deadline-Ms
+        # request header overrides per request. Exceeded → 504.
+        self.query_deadline_ms = float(
+            query_deadline_ms if query_deadline_ms is not None
+            else _env_int("PIO_QUERY_DEADLINE_MS", 30_000))
+        # Ceiling on what the client header may loosen the budget TO
+        # (0 = uncapped). Without it a client could grant itself an
+        # effectively unbounded budget and park unkillable workers on a
+        # hung model — defeating the operator's overload protection.
+        self.query_deadline_max_ms = max(0.0, float(
+            _env_int("PIO_QUERY_DEADLINE_MAX_MS", 600_000)))
+        # Graceful-drain budget for SIGTERM / /stop.
+        self.drain_deadline_ms = max(0.0, float(
+            drain_deadline_ms if drain_deadline_ms is not None
+            else _env_int("PIO_DRAIN_DEADLINE_MS", 10_000)))
+        self._query_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.query_conc, thread_name_prefix="pio-query")
+        self._adm_lock = threading.Lock()   # pending count is touched
+        self._adm_pending = 0               # from loop AND worker threads
+        self._adm_peak = 0
+        self._shed_count = 0
+        self._deadline_count = 0
+        self._orphaned = 0
+        self._draining = False
+        self._drain_stragglers = 0
+        self._reload_lock = asyncio.Lock()
+        self._reload_conflicts = 0
 
     @staticmethod
     def _new_compile_families():
@@ -227,6 +310,9 @@ class EngineServer:
             "degraded": self._degraded_reason is not None,
             "degradedReason": self._degraded_reason,
             "droppedFeedback": self._dropped_feedback,
+            # overload surface: the operator's no-scrape view of the
+            # admission gate (`pio status --engine-url` prints this)
+            "overload": self.overload_snapshot(),
         }
         # measured serving-latency decomposition, when a probe ran
         # (pio deploy --probe-latency persists it to the instance row)
@@ -250,8 +336,40 @@ class EngineServer:
             "pio_engine_dropped_feedback_total",
             "Feedback self-log events dropped by event-store failures")
         dropped.labels().set(self._dropped_feedback)
-        return [self._m_compile_count, self._m_compile_seconds, qc,
+        ov = self.overload_snapshot()
+        fams = [self._m_compile_count, self._m_compile_seconds, qc,
                 dropped]
+        for name, help_, value in (
+            ("pio_engine_query_pending",
+             "Accepted queries currently queued or running in the "
+             "admission-gated executor", ov["pending"]),
+            ("pio_engine_query_pending_limit",
+             "Admission cap: PIO_QUERY_CONC + PIO_QUERY_MAX_PENDING",
+             ov["pendingLimit"]),
+            ("pio_engine_query_pending_peak",
+             "High-water mark of accepted in-flight + queued queries",
+             ov["peakPending"]),
+            ("pio_engine_query_shed_total",
+             "Queries refused 503 at admission (queue full or "
+             "draining)", ov["shed"]),
+            ("pio_engine_query_deadline_exceeded_total",
+             "Queries answered 504 because their deadline budget ran "
+             "out", ov["deadlineExceeded"]),
+            ("pio_engine_query_orphaned_total",
+             "Deadline-exceeded queries whose worker thread was still "
+             "running at 504 time (freed at the next spend-point)",
+             ov["orphaned"]),
+            ("pio_engine_draining",
+             "1 while the server drains for shutdown (readyz answers "
+             "503)", 1 if ov["draining"] else 0),
+            ("pio_engine_drain_stragglers",
+             "Accepted queries still unfinished when the drain "
+             "deadline expired", ov["drainStragglers"]),
+        ):
+            fam = telemetry.GaugeFamily(name, help_)
+            fam.labels().set(value)
+            fams.append(fam)
+        return fams
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         """Prometheus text exposition: query stage histograms, compile
@@ -272,21 +390,170 @@ class EngineServer:
         after a failed reload) is deliberately NOT part of readiness —
         a degraded replica still answers queries correctly and draining
         it would trade a stale-but-valid model for no capacity; it is
-        surfaced here and on /status as telemetry only."""
+        surfaced here and on /status as telemetry only.
+
+        A DRAINING server is not-ready by design — SIGTERM / /stop flip
+        this to 503 FIRST so load balancers rotate the replica out
+        while the in-flight queries finish."""
         with self._lock:
             loaded = self.deployment is not None
         open_breakers = [
             b["name"] for b in self._storage_breakers()
             if b.get("state") == "open"
         ]
-        ready = loaded and not open_breakers
+        ready = loaded and not open_breakers and not self._draining
         out = {
             "ready": ready,
             "modelLoaded": loaded,
             "degraded": self._degraded_reason is not None,
+            "draining": self._draining,
             "openBreakers": open_breakers,
         }
         return web.json_response(out, status=200 if ready else 503)
+
+    # -- admission control / deadlines / drain ----------------------------
+    def overload_snapshot(self) -> dict:
+        """Shed/deadline/drain counters for /status and `pio status`."""
+        with self._adm_lock:
+            pending, peak = self._adm_pending, self._adm_peak
+        return {
+            "conc": self.query_conc,
+            "pending": pending,
+            "pendingLimit": self.query_conc + self.query_max_pending,
+            "peakPending": peak,
+            "shed": self._shed_count,
+            "deadlineExceeded": self._deadline_count,
+            "orphaned": self._orphaned,
+            "deadlineMsDefault": self.query_deadline_ms,
+            "draining": self._draining,
+            "drainDeadlineMs": self.drain_deadline_ms,
+            "drainStragglers": self._drain_stragglers,
+            "reloadConflicts": self._reload_conflicts,
+        }
+
+    def _request_deadline(self, request: web.Request) \
+            -> Optional[deadline.Deadline]:
+        """Per-request budget: X-Pio-Deadline-Ms header, else the
+        server default (0 = unbounded). The header may tighten freely
+        and loosen only up to PIO_QUERY_DEADLINE_MAX_MS — a malformed,
+        non-positive or non-finite header falls back to the default, so
+        no client can grant itself an unbounded budget (only the
+        operator's default may disable the deadline)."""
+        budget_ms = self.query_deadline_ms
+        raw = request.headers.get("X-Pio-Deadline-Ms")
+        if raw:
+            try:
+                hdr = float(raw)
+            except ValueError:
+                hdr = float("nan")
+            if math.isfinite(hdr) and hdr > 0:
+                budget_ms = hdr
+                if self.query_deadline_max_ms > 0:
+                    budget_ms = min(budget_ms, self.query_deadline_max_ms)
+        if budget_ms <= 0:
+            return None
+        return deadline.Deadline(budget_ms)
+
+    def _admit(self) -> None:
+        """Take one admission slot or refuse. A slot covers the query
+        from acceptance until its compute FINISHES — including workers
+        that overran their deadline after the client got its 504
+        (threads can't be killed), so orphaned work keeps counting
+        against the cap and the executor stays bounded."""
+        with self._adm_lock:
+            if self._draining:
+                raise AdmissionShed(
+                    "server is draining for shutdown", 1.0, "draining")
+            cap = self.query_conc + self.query_max_pending
+            if self._adm_pending >= cap:
+                raise AdmissionShed(
+                    f"query admission queue full ({self._adm_pending}"
+                    f"/{cap})", 1.0, "full")
+            self._adm_pending += 1
+            if self._adm_pending > self._adm_peak:
+                self._adm_peak = self._adm_pending
+
+    def _release_slot(self, fut=None) -> None:
+        """Admission-slot release; done-callback on both asyncio and
+        concurrent futures (the latter runs on a worker thread). Also
+        retrieves the future's exception: an orphaned worker failing
+        AFTER its client got 504 must be accounted, not warned about
+        as a never-retrieved exception."""
+        if fut is not None and not fut.cancelled():
+            exc = fut.exception()
+            if exc is not None and not isinstance(
+                    exc, deadline.DeadlineExceeded):
+                log.debug("orphaned/abandoned query failed: %s", exc)
+        with self._adm_lock:
+            self._adm_pending -= 1
+
+    def _run_admitted_query(self, deployment, query):
+        """Executor-thread entry. Re-checks the budget first: a query
+        that spent its whole deadline WAITING in the executor queue
+        frees the worker immediately instead of computing an answer
+        nobody is waiting for."""
+        dl = deadline.current()
+        if dl is not None:
+            dl.check("executor pickup")
+        return deployment.query(query)
+
+    async def _dispatch_query(self, deployment, query, dl):
+        """The admission gate — the ONLY way a handler may hand a query
+        to compute (guard-tested; a direct ``asyncio.to_thread(
+        deployment.query, ...)`` would bypass the bounded executor,
+        the shed path and the deadline budget).
+
+        Raises :class:`AdmissionShed` (→ 503) or
+        :class:`deadline.DeadlineExceeded` (→ 504)."""
+        if dl is not None:
+            dl.check("admission")
+        self._admit()
+        slot_owned_by_future = False
+        try:
+            timeout = dl.remaining() if dl is not None else None
+            if self._batch_queue is not None:
+                fut = asyncio.get_running_loop().create_future()
+                fut.add_done_callback(self._release_slot)
+                slot_owned_by_future = True
+                await self._batch_queue.put((query, fut))
+                try:
+                    return await asyncio.wait_for(fut, timeout)
+                except asyncio.TimeoutError:
+                    # wait_for cancelled fut; the batch worker's
+                    # fut.done() check skips delivering to it
+                    raise deadline.DeadlineExceeded(
+                        dl.budget_ms, dl.overrun_ms(),
+                        "batch queue") from None
+            # deadline rides the copied context into the worker thread
+            # (same mechanism that carries the trace context)
+            with deadline.running(dl):
+                ctx = contextvars.copy_context()
+            cfut = self._query_executor.submit(
+                ctx.run, self._run_admitted_query, deployment, query)
+            cfut.add_done_callback(self._release_slot)
+            slot_owned_by_future = True
+            afut = asyncio.wrap_future(cfut)
+            # the shield below can leave afut unawaited (504 path):
+            # consume its result/exception so nothing warns
+            afut.add_done_callback(
+                lambda f: None if f.cancelled() else f.exception())
+            try:
+                return await asyncio.wait_for(asyncio.shield(afut),
+                                              timeout)
+            except asyncio.TimeoutError:
+                if not cfut.cancel():
+                    # already running: the thread can't be killed; it
+                    # frees itself at the next deadline spend-point
+                    # (stage boundary / storage egress) and releases
+                    # its admission slot then — clean overrun, the
+                    # executor stays bounded
+                    with self._adm_lock:
+                        self._orphaned += 1
+                raise deadline.DeadlineExceeded(
+                    dl.budget_ms, dl.overrun_ms(), "await") from None
+        finally:
+            if not slot_owned_by_future:
+                self._release_slot()
 
     def _storage_breakers(self) -> list[dict]:
         try:
@@ -295,6 +562,12 @@ class EngineServer:
         except Exception:  # noqa: BLE001 - readiness must never crash
             log.exception("breaker state collection failed")
             return []
+
+    async def _shutdown_executor(self, app) -> None:
+        """App cleanup: release the bounded executor's idle workers
+        (don't wait — orphaned threads free themselves at their next
+        deadline spend-point; finalize_shutdown owns the hard stop)."""
+        self._query_executor.shutdown(wait=False, cancel_futures=True)
 
     # -- micro-batching ---------------------------------------------------
     async def _start_batcher(self, app) -> None:
@@ -348,6 +621,14 @@ class EngineServer:
                         self._batch_queue.get(), timeout))
                 except asyncio.TimeoutError:
                     break
+            # Drop entries whose future already settled — a deadline
+            # timeout cancels the future but leaves the (query, fut)
+            # pair queued; computing it would burn a batch slot on an
+            # answer nobody is waiting for (in-place: the cancellation
+            # handler aliases this list as _inflight_batch).
+            batch[:] = [(q, f) for q, f in batch if not f.done()]
+            if not batch:
+                continue
             with self._lock:
                 deployment = self.deployment
             queries = [q for q, _ in batch]
@@ -389,16 +670,29 @@ class EngineServer:
         with self._lock:
             deployment = self.deployment
         if deployment is None:
-            return web.json_response({"message": "no model deployed"}, status=503)
+            # jittered Retry-After, like every other shed: a constant
+            # (or absent) value would synchronize every honouring SDK
+            # into one retry wave against the still-empty server
+            return web.json_response(
+                {"message": "no model deployed"}, status=503,
+                headers={"Retry-After": str(retry_after_jitter(2.0))})
+        dl = self._request_deadline(request)
         try:
             query = self.plugins.before_query(query)
-            if self._batch_queue is not None:
-                fut = asyncio.get_running_loop().create_future()
-                await self._batch_queue.put((query, fut))
-                result = await fut
-            else:
-                result = await asyncio.to_thread(deployment.query, query)
+            result = await self._dispatch_query(deployment, query, dl)
             result = self.plugins.after_query(query, result)
+        except AdmissionShed as e:
+            self._shed_count += 1
+            return web.json_response(
+                {"message": f"query shed: {e}"}, status=503,
+                headers={"Retry-After":
+                         str(retry_after_jitter(e.retry_after_base))})
+        except deadline.DeadlineExceeded as e:
+            # accepted but out of time: 504, NOT 503 — work started, a
+            # blind client retry may duplicate load, so the two cases
+            # stay distinguishable
+            self._deadline_count += 1
+            return web.json_response({"message": str(e)}, status=504)
         except KeyError as e:
             return web.json_response(
                 {"message": f"missing query field {e.args[0]!r}"}, status=400
@@ -619,28 +913,99 @@ class EngineServer:
         MasterActor ! ReloadServer). A failed reload NEVER takes down
         serving: the last-good model stays live and the server enters
         degraded mode (visible on /status and /readyz) until a reload
-        succeeds."""
-        try:
-            await asyncio.to_thread(self._load, None)
-        except Exception as e:  # noqa: BLE001
-            self._degraded_reason = (
-                f"reload failed at "
-                f"{_dt.datetime.now(_dt.timezone.utc).isoformat()}: {e}; "
-                "serving last-good model")
-            log.exception("reload failed; continuing on last-good model")
+        succeeds.
+
+        Serialized: two concurrent /reload calls race `_load` (two
+        warm-ups, interleaved compile-gauge swaps, last-writer-wins on
+        the deployment) — the loser gets 409 and retries once the
+        winner finishes."""
+        if self._reload_lock.locked():
+            self._reload_conflicts += 1
             return web.json_response(
-                {"message": str(e), "degraded": True,
+                {"message": "reload already in progress",
                  "engineInstanceId":
                      self.instance.id if self.instance else None},
-                status=500)
+                status=409)
+        async with self._reload_lock:
+            try:
+                await asyncio.to_thread(self._load, None)
+            except Exception as e:  # noqa: BLE001
+                self._degraded_reason = (
+                    f"reload failed at "
+                    f"{_dt.datetime.now(_dt.timezone.utc).isoformat()}: {e}; "
+                    "serving last-good model")
+                log.exception("reload failed; continuing on last-good model")
+                return web.json_response(
+                    {"message": str(e), "degraded": True,
+                     "engineInstanceId":
+                         self.instance.id if self.instance else None},
+                    status=500)
         self._degraded_reason = None
         return web.json_response(
             {"message": "Reloaded", "engineInstanceId": self.instance.id}
         )
 
+    # -- graceful drain ----------------------------------------------------
+    async def drain_then_stop(self, stopper=None) -> None:
+        """SIGTERM / /stop sequence: flip /readyz to 503 FIRST (load
+        balancers rotate this replica out and new arrivals shed 503 at
+        admission), wait for every ACCEPTED in-flight query up to
+        PIO_DRAIN_DEADLINE_MS, then stop — stragglers past the budget
+        are failed by shutdown (batch-queue cleanup + connection
+        close) rather than holding the process open."""
+        if self._draining:
+            return          # second SIGTERM / /stop: first drain owns it
+        self._draining = True
+        log.info("draining: readyz → 503, waiting for in-flight queries "
+                 "(budget %.0f ms)", self.drain_deadline_ms)
+        if stopper is None:
+            stopper = self.app.get("stopper")
+        await asyncio.sleep(0.05)   # let the triggering response flush
+        t_end = _time.monotonic() + self.drain_deadline_ms / 1000.0
+        while _time.monotonic() < t_end:
+            with self._adm_lock:
+                pending = self._adm_pending
+            if pending == 0:
+                break
+            await asyncio.sleep(0.02)
+        with self._adm_lock:
+            stragglers = self._adm_pending
+        if stragglers:
+            self._drain_stragglers = stragglers
+            log.warning("drain deadline (%.0f ms) expired with %d "
+                        "query(ies) unfinished; failing them",
+                        self.drain_deadline_ms, stragglers)
+        else:
+            log.info("drain complete: all accepted queries answered")
+        if stopper is not None:
+            stopper()
+
+    def finalize_shutdown(self, grace: float = 2.0) -> None:
+        """After the event loop exits. Worker threads can't be killed,
+        so: cancel everything still queued, give RUNNING orphans a
+        short grace, then hard-exit rather than letting a hung model
+        call block interpreter shutdown forever (the SIGKILL-after-
+        drain contract a supervisor would apply, applied to
+        ourselves)."""
+        self._query_executor.shutdown(wait=False, cancel_futures=True)
+        t_end = _time.monotonic() + grace
+        while _time.monotonic() < t_end:
+            with self._adm_lock:
+                if self._adm_pending <= 0:
+                    return
+            _time.sleep(0.02)
+        with self._adm_lock:
+            left = self._adm_pending
+        log.warning("%d query worker(s) still running after shutdown "
+                    "grace; exiting anyway", left)
+        os._exit(0)
+
     async def handle_stop(self, request: web.Request) -> web.Response:
         log.info("stop requested")
-        asyncio.get_running_loop().call_later(0.1, request.app["stopper"])
+        if self._draining:
+            return web.json_response({"message": "Already draining."})
+        asyncio.get_running_loop().create_task(
+            self.drain_then_stop(request.app["stopper"]))
         return web.json_response({"message": "Shutting down."})
 
     async def handle_plugins(self, request: web.Request) -> web.Response:
@@ -669,11 +1034,29 @@ def run_engine_server(server: EngineServer, host: str = "0.0.0.0",
         from ..common import ssl_context_from_env
 
         tls = ssl_context_from_env()
-        runner = web.AppRunner(server.app)
+        # short shutdown_timeout: stragglers already got the full drain
+        # window; aiohttp's default 60 s grace would triple-wait them
+        runner = web.AppRunner(server.app, shutdown_timeout=5.0)
         await runner.setup()
         site = web.TCPSite(runner, host, port, ssl_context=tls)
         await site.start()
         log.info("Engine Server listening on %s:%d", host, port)
+        # SIGTERM/SIGINT → graceful drain (readyz 503 first, in-flight
+        # queries answered, then exit) — what a rolling restart sends
+        import signal as _signal
+
+        rloop = asyncio.get_running_loop()
+
+        def _on_term(signame: str) -> None:
+            log.info("%s received: graceful drain", signame)
+            rloop.create_task(server.drain_then_stop(stop_event.set))
+
+        for signame in ("SIGTERM", "SIGINT"):
+            try:
+                rloop.add_signal_handler(
+                    getattr(_signal, signame), _on_term, signame)
+            except (NotImplementedError, RuntimeError, AttributeError):
+                pass    # platform without unix signal support
         if probe_latency:
             scheme = "https" if tls else "http"
             try:
@@ -685,3 +1068,4 @@ def run_engine_server(server: EngineServer, host: str = "0.0.0.0",
         await runner.cleanup()
 
     loop.run_until_complete(main())
+    server.finalize_shutdown()

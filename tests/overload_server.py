@@ -1,0 +1,46 @@
+"""Engine-server subprocess for the overload flood / SIGTERM harness
+(tests/test_query_overload.py).
+
+Runs the REAL engine server (`run_engine_server` — the production
+entry point with the SIGTERM graceful-drain handler installed) against
+the storage configured in the inherited environment. The TEST process
+trains the model first (SQLITE metadata + modeldata in the test's tmp
+dir) so this process only loads and serves; overload knobs
+(PIO_QUERY_*, PIO_DRAIN_DEADLINE_MS) and the injected slow model
+(PIO_FAULT_SPEC latency on query.predict) arrive through the
+environment.
+
+Usage: python overload_server.py <port>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import logging
+
+    # the harness asserts on the drain INFO lines; the per-request
+    # access log is silenced — at flood rates it fills the test's
+    # capture pipe and the blocked write would stall the event loop
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(name)s %(message)s")
+    logging.getLogger("aiohttp.access").setLevel(logging.WARNING)
+    port = int(sys.argv[1])
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.models.recommendation import (
+        RecommendationEngine)
+    from incubator_predictionio_tpu.workflow.create_server import (
+        EngineServer, run_engine_server)
+
+    engine = RecommendationEngine()()
+    server = EngineServer(engine, engine_factory_name="overload",
+                          storage=Storage.instance())
+    run_engine_server(server, "127.0.0.1", port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,6 +1,7 @@
 """Engine (deploy) server over real HTTP: /queries.json hot path, status
 page, /reload hot-swap (reference: SURVEY.md §3.2)."""
 
+import pytest
 import requests
 
 from incubator_predictionio_tpu.controller import EngineParams
@@ -112,7 +113,14 @@ def test_engine_server_micro_batching(memory_storage):
     for q, e, g in zip(queries, expected, got):
         assert g.status_code == e.status_code, (q, g.text)
         if e.status_code == 200:
-            assert g.json() == e.json(), q
+            ej, gj = e.json(), g.json()
+            # same items in the same order; scores ulp-tolerant — under
+            # CPU contention the burst can split across batch windows,
+            # and different batch shapes round differently in f32
+            assert [s["item"] for s in gj["itemScores"]] == \
+                   [s["item"] for s in ej["itemScores"]], q
+            assert [s["score"] for s in gj["itemScores"]] == pytest.approx(
+                [s["score"] for s in ej["itemScores"]], rel=1e-5), q
 
 
 def test_product_ranking_query_mode(memory_storage):

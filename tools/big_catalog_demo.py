@@ -116,6 +116,9 @@ def main() -> int:
     server._query_count = 0
     server.feedback = False
     server._batch_queue = None
+    # arm the admission gate (handle_query routes through it); huge
+    # sharded queries run seconds each, so no deadline budget here
+    server._init_overload_state(query_deadline_ms=0)
     server.app = web.Application()
     server.app.add_routes([web.post("/queries.json", server.handle_query)])
 
