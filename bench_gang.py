@@ -1,0 +1,190 @@
+"""Gang-restart recovery bench: how fast does supervised multi-worker
+training detect a dead/hung worker and resume from checkpoint?
+
+Runs a REAL 2-process sharded-ALS gang (tests/gang_als_worker.py) under
+parallel/supervisor.Supervisor and measures, with wall-clock brackets:
+
+- kill bracket: SIGKILL one worker mid-training →
+  ``detect_kill_ms`` (death → supervisor failure event),
+  ``relaunch_ms`` (failure → relaunched gang, incl. jittered backoff),
+  ``recover_to_done_ms`` (relaunch → training complete).
+- stall bracket (``PIO_GANG_BENCH_STALL=0`` skips): SIGSTOP one worker →
+  ``detect_stall_ms`` (stop → failure event; dominated by the
+  configured ``PIO_WORKER_STALL_MS``, reported alongside it so the
+  detector overhead is visible).
+
+Like every bench here: same-run brackets only — this host's CPU varies
+wildly run to run (BASELINE.md), so the numbers are for shape, not
+absolutes. Results print as one JSON line and persist under
+``BASELINE.json.published.measured_gang_recovery`` plus
+``MULTICHIP_gang.json`` (the multichip bracket the roadmap asks for).
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+
+from incubator_predictionio_tpu.parallel.supervisor import (  # noqa: E402
+    COMPLETED,
+    GangConfig,
+    Supervisor,
+)
+
+WORKER = os.path.join(HERE, "tests", "gang_als_worker.py")
+N_ITERS = 8
+STALL_MS = 6000.0
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _gang(tmp, tag, per_worker_env=None, max_restarts=3):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(tmp, "xla_cache"),
+    }
+    env.pop("PIO_FAULT_SPEC", None)
+    return Supervisor(
+        [sys.executable, WORKER, os.path.join(tmp, f"{tag}.npz"),
+         os.path.join(tmp, f"ckpt_{tag}"), str(N_ITERS)],
+        num_workers=2, env=env, per_worker_env=per_worker_env,
+        config=GangConfig(num_workers=2, heartbeat_ms=250.0,
+                          stall_ms=STALL_MS, init_grace_ms=300_000.0,
+                          max_restarts=max_restarts, poll_ms=50.0),
+        run_dir=os.path.join(tmp, f"run_{tag}"),
+    )
+
+
+def _run_async(sup):
+    box = {}
+    t = threading.Thread(
+        target=lambda: box.update(outcome=sup.run()), daemon=True)
+    t.start()
+    return t, box
+
+
+def _wait_first_beat(sup, box, worker=1, attempt=0, timeout=600):
+    """Block until `worker` of `attempt` starts beating (mid-training),
+    then return its pid."""
+    deadline = time.monotonic() + timeout
+    hb = os.path.join(sup.run_dir, f"worker_{worker}.hb")
+    while time.monotonic() < deadline and not box:
+        start = next((e for e in list(sup.events)
+                      if e["type"] == "gangStart"
+                      and e["attempt"] == attempt), None)
+        if start and os.path.exists(hb):
+            return start["pids"][worker]
+        time.sleep(0.02)
+    raise RuntimeError(f"worker {worker} never started beating: "
+                       f"{sup.events} {box}")
+
+
+def _event(sup, type_, **match):
+    return next((e for e in sup.events if e["type"] == type_
+                 and all(e.get(k) == v for k, v in match.items())), None)
+
+
+def bench_kill(tmp) -> dict:
+    # sweeps slowed to ~0.25s so the kill lands genuinely mid-run
+    sup = _gang(tmp, "kill", per_worker_env=lambda a, i: (
+        {"PIO_FAULT_SPEC": "train.sweep:latency:1000:0.25"}
+        if i == 0 and a == 0 else {}))
+    t, box = _run_async(sup)
+    pid = _wait_first_beat(sup, box, worker=1, attempt=0)
+    t_kill = time.time()
+    os.kill(pid, signal.SIGKILL)
+    log(f"[gang-bench] SIGKILLed worker 1 (pid {pid})")
+    t.join(timeout=900)
+    if t.is_alive() or box.get("outcome") != COMPLETED:
+        raise RuntimeError(f"kill bracket did not complete: {box} "
+                           f"{sup.events}")
+    fail = _event(sup, "failure", reason="exit")
+    relaunch = _event(sup, "gangStart", attempt=1)
+    done = _event(sup, "completed")
+    assert fail and relaunch and done, sup.events
+    return {
+        "detect_kill_ms": round((fail["t"] - t_kill) * 1000, 1),
+        "relaunch_ms": round((relaunch["t"] - fail["t"]) * 1000, 1),
+        "recover_to_done_ms": round((done["t"] - relaunch["t"]) * 1000, 1),
+        "restarts": sup.restarts,
+    }
+
+
+def bench_stall(tmp) -> dict:
+    sup = _gang(tmp, "stall", per_worker_env=lambda a, i: (
+        {"PIO_FAULT_SPEC": "train.sweep:latency:1000:0.25"}
+        if i == 0 and a <= 1 else {}))
+    t, box = _run_async(sup)
+    pid = _wait_first_beat(sup, box, worker=1, attempt=0)
+    t_stop = time.time()
+    os.kill(pid, signal.SIGSTOP)
+    log(f"[gang-bench] SIGSTOPped worker 1 (pid {pid})")
+    t.join(timeout=900)
+    if t.is_alive() or box.get("outcome") != COMPLETED:
+        raise RuntimeError(f"stall bracket did not complete: {box} "
+                           f"{sup.events}")
+    fail = _event(sup, "failure", reason="stall")
+    done = _event(sup, "completed")
+    assert fail and done, sup.events
+    # NOTE: stall age counts from the worker's last BEAT, which can
+    # predate the SIGSTOP by up to a sweep — detect_stall_ms may land
+    # slightly under the threshold. The bracket's point is that it is
+    # O(threshold), not O(forever).
+    detect = (fail["t"] - t_stop) * 1000
+    return {
+        "stall_threshold_ms": STALL_MS,
+        "detect_stall_ms": round(detect, 1),
+        "restarts": sup.restarts,
+    }
+
+
+def main() -> int:
+    import tempfile
+
+    results = {"num_workers": 2, "n_iters": N_ITERS}
+    with tempfile.TemporaryDirectory(prefix="pio_gang_bench_") as tmp:
+        t0 = time.time()
+        log("[gang-bench] kill bracket ...")
+        results["kill"] = bench_kill(tmp)
+        if os.environ.get("PIO_GANG_BENCH_STALL", "1") != "0":
+            log("[gang-bench] stall bracket ...")
+            results["stall"] = bench_stall(tmp)
+        results["bench_seconds"] = round(time.time() - t0, 1)
+
+    # persist: BASELINE.json published bracket + the MULTICHIP file
+    baseline_path = os.path.join(HERE, "BASELINE.json")
+    try:
+        with open(baseline_path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})["measured_gang_recovery"] = results
+        with open(baseline_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    except Exception as e:  # noqa: BLE001 - bench must still print
+        log(f"[gang-bench] could not persist to BASELINE.json: {e}")
+    with open(os.path.join(HERE, "MULTICHIP_gang.json"), "w") as f:
+        json.dump({"metric": "gang supervised recovery (2 workers, "
+                             "sharded ALS, CPU gloo)", **results}, f,
+                  indent=2)
+
+    print(json.dumps({
+        "metric": "gang kill detect/relaunch/recover ms",
+        "value": [results["kill"]["detect_kill_ms"],
+                  results["kill"]["relaunch_ms"],
+                  results["kill"]["recover_to_done_ms"]],
+        **({"stall_detect_ms": results["stall"]["detect_stall_ms"]}
+           if "stall" in results else {}),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,133 @@
+"""One tolerant parser for ``PIO_*`` environment knobs.
+
+Three subsystems grew three divergent copies of ``_env_int`` (the engine
+server's accepted ``"1e3"`` and degraded on overflow, the ingest buffer's
+rejected floats silently, the input pipeline's warned and clamped). They
+are consolidated here with the semantics spelled out as flags, so every
+caller states — and tests can assert — exactly what a malformed value
+does:
+
+- unset / empty         → ``default`` (always)
+- unparsable / overflow → ``default``; ``warn=True`` additionally emits a
+  ``UserWarning`` naming the variable and the value it fell back to
+  (an operator typo must never crash a deploy or a train)
+- ``float_ok=True``     → accept float spellings for integer knobs
+  (``"1e3"`` → 1000); off by default, so ``PIO_FOO=3.5`` falls back
+  rather than silently truncating
+- ``lo``/``hi``         → clamp the PARSED value into a sane range
+  (clamping is not an error: an operator asking for depth 10**9 gets the
+  ceiling, not the default)
+
+New knobs should come here instead of growing a fourth copy.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Optional
+
+__all__ = ["env_int", "env_float", "env_ms", "env_flag", "env_str"]
+
+
+def _warn(name: str, raw: str, default) -> None:
+    warnings.warn(
+        f"{name}={raw!r} is not a valid value; using {default}",
+        stacklevel=4)
+
+
+def env_int(name: str, default: int, *, lo: Optional[int] = None,
+            hi: Optional[int] = None, float_ok: bool = False,
+            warn: bool = False) -> int:
+    """Integer knob. See module docstring for the malformed/overflow
+    semantics each flag selects."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    raw = raw.strip()
+    try:
+        v = int(raw)
+    except ValueError:
+        if not float_ok:
+            if warn:
+                _warn(name, raw, default)
+            return default
+        try:
+            f = float(raw)
+            if f != f or f in (float("inf"), float("-inf")):
+                raise ValueError(raw)
+            v = int(f)
+        except (ValueError, OverflowError):
+            if warn:
+                _warn(name, raw, default)
+            return default
+    except OverflowError:  # pragma: no cover - int() doesn't overflow
+        if warn:
+            _warn(name, raw, default)
+        return default
+    if lo is not None:
+        v = max(lo, v)
+    if hi is not None:
+        v = min(hi, v)
+    return v
+
+
+def env_float(name: str, default: float, *, lo: Optional[float] = None,
+              hi: Optional[float] = None, finite: bool = True,
+              warn: bool = False) -> float:
+    """Float knob. ``finite=True`` (default) treats nan/inf spellings as
+    malformed — a budget of ``inf`` is nearly always an operator error."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    raw = raw.strip()
+    try:
+        v = float(raw)
+    except (ValueError, OverflowError):
+        if warn:
+            _warn(name, raw, default)
+        return default
+    if finite and (v != v or v in (float("inf"), float("-inf"))):
+        if warn:
+            _warn(name, raw, default)
+        return default
+    if lo is not None:
+        v = max(lo, v)
+    if hi is not None:
+        v = min(hi, v)
+    return v
+
+
+def env_ms(name: str, default_ms: float, *, lo_ms: float = 0.0) -> float:
+    """Millisecond knob returned in SECONDS (what time.monotonic math
+    wants); malformed/non-finite → default, clamped at ``lo_ms``."""
+    ms = env_float(name, default_ms, lo=lo_ms)
+    return ms / 1000.0
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean knob: 1/true/yes/on vs 0/false/no/off (case-insensitive);
+    anything else → default."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    v = raw.strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    return default
+
+
+def env_str(name: str, default: str, *, choices: Optional[tuple] = None,
+            lower: bool = True) -> str:
+    """String knob; with ``choices``, values outside the set → default."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    v = raw.strip()
+    if lower:
+        v = v.lower()
+    if choices is not None and v not in choices:
+        return default
+    return v

@@ -73,7 +73,7 @@ import os
 from collections import Counter
 from typing import Optional, Sequence
 
-from ...common import telemetry
+from ...common import envknobs, telemetry
 from ...common.faultinject import fault_point
 from ..storage.event import (Event, EventValidationError, _utcnow,
                              format_event_time, new_event_id)
@@ -151,18 +151,14 @@ def parse_single_event(raw: bytes, whitelist=()) -> tuple[Event, dict]:
     return event, body
 
 
+# Strict integer spellings only (``"3.5"`` falls back rather than
+# silently truncating); one shared implementation: common/envknobs.
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+    return envknobs.env_float(name, default)
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+    return envknobs.env_int(name, default)
 
 
 class IngestConfig:

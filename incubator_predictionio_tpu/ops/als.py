@@ -51,7 +51,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..common.faultinject import fault_point
 from ..common.jax_compat import shard_map
+from ..parallel import supervisor as gang
 
 from .pallas_kernels import batched_spd_solve
 from .rowblocks import (
@@ -931,32 +933,57 @@ def train_als(
             lambda a, b: jnp.isfinite(a).all() & jnp.isfinite(b).all())
         x, y = x0, y0
         for it in range(start_iter, params.num_iterations):
+            fault_point("train.sweep")
             x, y = run_fn(np.int32(1), x, y, *run_args)
+            # Beat AFTER the dispatch: the first sweep includes the XLA
+            # compile, and the supervisor's stall detector only arms at
+            # the first beat (init grace covers everything before it).
+            gang.beat()
             if not bool(jax.device_get(finite_probe(x, y))):
                 raise NaNGuardError(
                     f"stage: {nan_guard_stage}, iteration {it + 1}: "
                     "non-finite factors (check input ratings for NaN/Inf "
                     "or raise the regularization)")
             done = it + 1
+            saved = False
             if chunk and done % chunk == 0 and done < params.num_iterations:
                 checkpoint_hook.save(
                     done, {"user_factors": x, "item_factors": y,
                            "fingerprint": np.int64(fingerprint)}
                 )
+                saved = True
+                gang.beat()  # a save (manager init, fsync) can be slow too
+            # Per-iteration dispatch ⇒ drain can honor EVERY sweep
+            # boundary, not just the checkpoint cadence; an off-cadence
+            # drain writes its own snapshot (all processes agree:
+            # `saved` is deterministic and the flag is allgathered).
+            if done < params.num_iterations and gang.drain_requested_global():
+                if chunk and not saved:
+                    checkpoint_hook.save(
+                        done, {"user_factors": x, "item_factors": y,
+                               "fingerprint": np.int64(fingerprint)}
+                    )
+                raise gang.GangDrainRequested(done)
     elif chunk and params.num_iterations - start_iter > chunk:
         x, y = x0, y0
         it = start_iter
         while it < params.num_iterations:
+            fault_point("train.sweep")
             n = min(chunk, params.num_iterations - it)
             x, y = run_fn(n, x, y, *run_args)
+            gang.beat()  # after the dispatch: sweep 1 includes compile
             it += n
             if it < params.num_iterations:
                 checkpoint_hook.save(
                     it, {"user_factors": x, "item_factors": y,
                          "fingerprint": np.int64(fingerprint)}
                 )
+                gang.beat()  # a save (manager init, fsync) can be slow too
+                if gang.drain_requested_global():
+                    raise gang.GangDrainRequested(it)
     else:
         x, y = run_fn(params.num_iterations - start_iter, x0, y0, *run_args)
+        gang.beat()
     x, y = jax.device_get((x, y))
     return ALSFactors(
         user_factors=np.asarray(x)[plan_u.slot_of_row],
@@ -1200,8 +1227,10 @@ def train_als_process_sharded(
         x, y = gx0, gy0
         it = start_iter
         while it < params.num_iterations:
+            fault_point("train.sweep")
             n = min(chunk, params.num_iterations - it)
             x, y = fn(np.int32(n), x, y, *flat)
+            gang.beat()  # after the dispatch: sweep 1 includes compile
             it += n
             if it < params.num_iterations:
                 # EVERY process calls save: orbax's CheckpointManager is
@@ -1213,9 +1242,15 @@ def train_als_process_sharded(
                     it, {"user_factors": np.asarray(jax.device_get(x)),
                          "item_factors": np.asarray(jax.device_get(y)),
                          "fingerprint": np.int64(fingerprint)})
+                gang.beat()  # a save (manager init, barriers) can be slow
+                # Collective drain check (allgathered): every process
+                # takes this branch at the SAME boundary or none does.
+                if gang.drain_requested_global():
+                    raise gang.GangDrainRequested(it)
     else:
         x, y = fn(np.int32(params.num_iterations - start_iter), gx0, gy0,
                   *flat)
+        gang.beat()
     x, y = jax.device_get((x, y))
     return ALSFactors(
         user_factors=np.asarray(x)[plan_u.slot_of_row],

@@ -6,6 +6,15 @@ host to the coordination service over DCN; afterwards jax.devices() spans
 the pod and the same mesh/pjit code runs unchanged (single-controller SPMD
 per host — the workflow binary is simply launched once per host, the way
 the reference launches one executor JVM per node).
+
+Failure semantics (the gang supervisor depends on these): a worker that
+cannot REACH its coordinator must error within ``PIO_COORDINATOR_TIMEOUT_MS``
+instead of retrying forever, and a worker whose coordinator DIES mid-run
+must notice within ``PIO_DIST_HEARTBEAT_MS × PIO_DIST_MAX_MISSING_HEARTBEATS``
+(the coordination-service health check, which also tears down the
+remaining processes when any peer is declared dead) — so a dead gang
+member surfaces as a worker error the supervisor can act on rather than
+an infinite hang in the next collective.
 """
 
 from __future__ import annotations
@@ -15,6 +24,8 @@ import os
 from typing import Optional
 
 import jax
+
+from ..common import envknobs
 
 log = logging.getLogger("pio.distributed")
 
@@ -27,6 +38,32 @@ def is_multi_host() -> bool:
     return jax.process_count() > 1
 
 
+def resolve_distributed_timeouts() -> dict:
+    """Resolved connection/health-check knobs (seconds, jax's unit).
+
+    - ``PIO_COORDINATOR_TIMEOUT_MS`` — how long a process retries the
+      initial coordinator connection before erroring (jax
+      ``initialization_timeout``; default 300 s). Floored at 1 s —
+      jax takes whole seconds.
+    - ``PIO_DIST_HEARTBEAT_MS`` — coordination-service heartbeat
+      interval, client and service side (default 10 s, floor 1 s).
+    - ``PIO_DIST_MAX_MISSING_HEARTBEATS`` — missed beats before a
+      process is declared dead and the job torn down (default 10).
+
+    Malformed or absent values fall back to the jax defaults (a typo'd
+    knob must not take down a training job at init).
+    """
+    init_s = envknobs.env_ms("PIO_COORDINATOR_TIMEOUT_MS", 300_000.0,
+                             lo_ms=1000.0)
+    hb_s = envknobs.env_ms("PIO_DIST_HEARTBEAT_MS", 10_000.0, lo_ms=1000.0)
+    missing = envknobs.env_int("PIO_DIST_MAX_MISSING_HEARTBEATS", 10, lo=2)
+    return {
+        "initialization_timeout": max(1, int(round(init_s))),
+        "heartbeat_interval": max(1, int(round(hb_s))),
+        "max_missing_heartbeats": missing,
+    }
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -34,7 +71,8 @@ def initialize_distributed(
 ) -> None:
     """Initialize JAX multi-controller runtime from args or PIO_* env vars
     (PIO_COORDINATOR_ADDRESS, PIO_NUM_PROCESSES, PIO_PROCESS_ID). Safe to
-    call when unset → single-process mode."""
+    call when unset → single-process mode. Timeout/health-check knobs:
+    :func:`resolve_distributed_timeouts`."""
     coordinator_address = coordinator_address or os.environ.get("PIO_COORDINATOR_ADDRESS")
     if not coordinator_address:
         log.debug("single-process mode (no PIO_COORDINATOR_ADDRESS)")
@@ -51,11 +89,40 @@ def initialize_distributed(
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except (AttributeError, ValueError):  # older/newer jax: no flag
             log.debug("jax_cpu_collectives_implementation not supported")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    t = resolve_distributed_timeouts()
+    try:
+        # The public jax.distributed.initialize does not expose the
+        # coordination-service heartbeat knobs (jax 0.4.x); it is a thin
+        # wrapper over State.initialize plus this same guard, so call
+        # the state object directly and keep the guard.
+        from jax._src import distributed as _dist
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            raise RuntimeError(
+                "initialize_distributed() must be called before any JAX "
+                "computations are executed.")
+        _dist.global_state.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            initialization_timeout=t["initialization_timeout"],
+            service_heartbeat_interval_seconds=t["heartbeat_interval"],
+            service_max_missing_heartbeats=t["max_missing_heartbeats"],
+            client_heartbeat_interval_seconds=t["heartbeat_interval"],
+            client_max_missing_heartbeats=t["max_missing_heartbeats"],
+        )
+    except (ImportError, TypeError, AttributeError):
+        # Private surface moved (newer jax): the public API still honors
+        # the connection timeout; heartbeat cadence stays at defaults.
+        log.debug("falling back to public jax.distributed.initialize",
+                  exc_info=True)
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            initialization_timeout=t["initialization_timeout"],
+        )
     log.info(
         "jax.distributed initialized: process %d/%d, %d global devices",
         process_id, num_processes, len(jax.devices()),

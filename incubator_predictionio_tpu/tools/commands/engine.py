@@ -75,7 +75,34 @@ def train_cmd(args: list[str]) -> int:
                         "accelerator-vs-CPU per algorithm with measured "
                         "link/host rates and picks the faster; tpu/cpu "
                         "force one side (PIO_TRAIN_DEVICE sets the default)")
+    p.add_argument("--num-workers", type=int, default=None, metavar="N",
+                   help="train as a supervised gang of N worker processes "
+                        "(liveness + heartbeat monitoring, automatic "
+                        "checkpoint gang-restart; default $PIO_NUM_WORKERS, "
+                        "else 1 = in-process)")
     ns = p.parse_args(args)
+
+    from ...common import envknobs
+
+    num_workers = (ns.num_workers if ns.num_workers is not None
+                   else envknobs.env_int("PIO_NUM_WORKERS", 1, lo=1))
+    supervised_worker = os.environ.get("PIO_GANG_WORKER") == "1"
+    if num_workers > 1 and not supervised_worker:
+        return _train_supervised(args, ns, num_workers)
+    from ...parallel.distributed import initialize_distributed
+
+    initialize_distributed()  # no-op without PIO_COORDINATOR_ADDRESS
+    if supervised_worker:
+        # Gang worker: SIGTERM means "checkpoint at the next sweep
+        # boundary and exit". Installed AFTER distributed init — jax's
+        # coordination service registers XLA's preemption-sync SIGTERM
+        # handler during initialize, and the drain semantics must win
+        # the sigaction. (No heartbeat yet — the first beat comes from
+        # the training loop, after work completes; the supervisor's
+        # init grace covers init + compile.)
+        from ...parallel.supervisor import install_worker_signal_handlers
+
+        install_worker_signal_handlers()
     from ...workflow.core_workflow import run_train
 
     engine, params, factory, variant, engine_json = _load_engine(ns)
@@ -98,14 +125,101 @@ def train_cmd(args: list[str]) -> int:
     import time as _time
 
     t0 = _time.perf_counter()
-    instance_id = run_train(
-        engine, params, ctx, wp,
-        engine_factory_name=factory, engine_variant=variant,
-    )
+    try:
+        instance_id = run_train(
+            engine, params, ctx, wp,
+            engine_factory_name=factory, engine_variant=variant,
+        )
+    except Exception as e:  # noqa: BLE001 - drain is not a failure
+        from ...parallel.supervisor import (DRAIN_EXIT_CODE,
+                                            GangDrainRequested)
+
+        if isinstance(e, GangDrainRequested):
+            print(f"[info] Drained at step {e.step}; checkpoint kept — "
+                  "resume with `pio train --resume`.")
+            return DRAIN_EXIT_CODE  # the supervisor treats this as a
+            #                         drain outcome, never a failure
+        raise
     train_s = _time.perf_counter() - t0
     print(f"[info] Training completed in {train_s:.2f}s. "
           f"Engine instance ID: {instance_id}")
     return 0
+
+
+def _strip_num_workers(args: list[str]) -> list[str]:
+    """Worker argv = the train argv minus the gang flag (a worker that
+    re-spawned a gang would fork-bomb; belt to the PIO_GANG_WORKER
+    suspenders)."""
+    out, skip = [], False
+    for tok in args:
+        if skip:
+            skip = False
+            continue
+        if tok == "--num-workers":
+            skip = True
+            continue
+        if tok.startswith("--num-workers="):
+            continue
+        out.append(tok)
+    return out
+
+
+def _train_supervised(args: list[str], ns, num_workers: int) -> int:
+    """Run `pio train` as a supervised gang (parallel/supervisor.py):
+    N copies of this exact command, coordinator/process-id wiring from
+    the supervisor, automatic checkpoint gang-restart on worker death
+    or heartbeat stall, clean drain on SIGTERM."""
+    from ...data.storage.event import new_event_id
+    from ...parallel.supervisor import (COMPLETED, DRAINED, GangConfig,
+                                        Supervisor)
+
+    if ns.checkpoint_every <= 0:
+        print("[warn] gang training without --checkpoint-every: a "
+              "restart retrains from scratch instead of resuming "
+              "mid-run", file=sys.stderr)
+    gang_id = None
+    if ns.resume:
+        # A fresh supervisor invocation must pin the INTERRUPTED run's
+        # instance id, or the gang leader would look up a brand-new id
+        # and quietly train from scratch.
+        from ...workflow.checkpoint import find_resumable_instance
+
+        engine, params, factory, variant, _ = _load_engine(ns)
+        prior = find_resumable_instance(
+            Storage.instance(), factory or "engine", "1", variant,
+            data_source_params=json.dumps(dict(params.data_source_params)),
+            preparator_params=json.dumps(dict(params.preparator_params)),
+        )
+        if prior is not None:
+            gang_id = prior.id
+            print(f"[info] --resume: continuing interrupted instance "
+                  f"{gang_id}")
+        else:
+            print("[info] --resume requested but no resumable instance "
+                  "found; training from scratch")
+    gang_id = gang_id or new_event_id()
+    worker_argv = [sys.executable, "-m",
+                   "incubator_predictionio_tpu.tools.console", "train",
+                   *_strip_num_workers(args)]
+    sup = Supervisor(worker_argv, num_workers,
+                     config=GangConfig.from_env(num_workers),
+                     gang_instance_id=gang_id)
+    sup.install_signal_handlers()
+    print(f"[info] Gang training: {num_workers} workers, instance "
+          f"{gang_id}, run dir {sup.run_dir}")
+    outcome = sup.run()
+    if outcome == COMPLETED:
+        print(f"[info] Gang training completed "
+              f"({sup.restarts} restart(s)). Engine instance ID: {gang_id}")
+        return 0
+    if outcome == DRAINED:
+        print("[info] Gang drained cleanly; resume with "
+              "`pio train --num-workers "
+              f"{num_workers} --resume` (instance {gang_id}).")
+        return 0
+    print(f"[error] Gang training failed after {sup.restarts} restart(s); "
+          f"see worker logs under {sup.run_dir}", file=sys.stderr)
+    return 1
 
 
 @verb("deploy", "serve the trained engine over HTTP")

@@ -62,6 +62,77 @@ def deserialize_models(blob: bytes, algo_list, instance_id: str, ctx) -> list[An
     return out
 
 
+def _train_with_stale_checkpoint_fallback(engine, engine_params, ctx, wp,
+                                          cm=contextlib.nullcontext):
+    """engine.train with the --resume stale-snapshot fallback: a
+    CheckpointIncompatibleError (data/rank changed) discards the
+    checkpoints and retrains from scratch — otherwise every future
+    --resume re-selects the same instance and fails the same way. The
+    ONE implementation for the gang leader and followers: the
+    fingerprint check is deterministic across the gang, so every
+    process takes (or skips) this branch at the same point and the
+    collectives stay aligned. ``cm`` wraps each attempt (the leader's
+    profiler trace)."""
+    from .checkpoint import CheckpointHook, CheckpointIncompatibleError
+
+    try:
+        with cm():
+            return engine.train(ctx, engine_params, wp)
+    except CheckpointIncompatibleError as e:
+        if ctx.checkpoint_hook is None or not wp.resume:
+            raise
+        # Shared dir — rmtree tolerates gang peers racing the delete.
+        log.warning(
+            "--resume: %s; discarding stale checkpoints and training "
+            "from scratch", e,
+        )
+        root = ctx.checkpoint_hook
+        root.delete_all()
+        ctx.checkpoint_hook = CheckpointHook(
+            root.directory, every_n=root.every_n,
+            max_to_keep=root.max_to_keep,
+        )
+        ctx.workflow_params = dataclasses.replace(wp, resume=False)
+        try:
+            with cm():
+                return engine.train(ctx, engine_params, ctx.workflow_params)
+        finally:
+            ctx.workflow_params = wp
+
+
+def _run_train_follower(engine, engine_params, ctx, wp, gang_id: str) -> str:
+    """Gang processes 1..N-1: participate in every training collective
+    (and the checkpoint barriers) under the supervisor-pinned instance
+    id, but leave ALL metadata/model persistence to the leader — the
+    factors are replicated, so the leader's copy is the gang's copy."""
+    from .checkpoint import CheckpointHook, instance_checkpoint_dir
+
+    ctx.engine_instance_id = gang_id
+    if wp.resume:
+        prior = ctx.get_storage().get_meta_data_engine_instances().get(
+            gang_id)
+        if prior is not None and prior.status == "COMPLETED":
+            # Mirror of the leader's already-COMPLETED exit: on a
+            # relaunch that raced the finish line, every process must
+            # skip training or the ones that don't would wait forever
+            # in the first collective.
+            log.info("gang follower: EngineInstance %s already "
+                     "COMPLETED; nothing to do", gang_id)
+            return gang_id
+    if wp.checkpoint_every > 0 or wp.resume:
+        ctx.checkpoint_hook = CheckpointHook(
+            instance_checkpoint_dir(gang_id), every_n=wp.checkpoint_every)
+    try:
+        _train_with_stale_checkpoint_fallback(engine, engine_params, ctx, wp)
+    finally:
+        if ctx.checkpoint_hook is not None:
+            ctx.checkpoint_hook.close()
+            ctx.checkpoint_hook = None
+    log.info("gang follower %s: train stage complete",
+             os.environ.get("PIO_PROCESS_ID"))
+    return gang_id
+
+
 def run_train(
     engine: Engine,
     engine_params: EngineParams,
@@ -87,6 +158,18 @@ def run_train(
         "input pipeline: mode=%s chunk_rows=%d chunk_docs=%d depth=%d "
         "workers=%d", pl.mode, pl.chunk_rows, pl.chunk_docs, pl.depth,
         pl.workers)
+    from ..parallel import supervisor as gang
+
+    # Gang runs (parallel/supervisor.py): the supervisor pins ONE
+    # engine-instance id for the whole gang so every process agrees on
+    # the checkpoint directory and a relaunch resumes the same row.
+    # Only process 0 (the leader) touches metadata/model storage;
+    # followers train — every collective needs them — and discard.
+    gang_id = os.environ.get(gang.ENV_GANG_INSTANCE_ID) or None
+    follower = bool(
+        gang_id) and os.environ.get("PIO_PROCESS_ID", "0") != "0"
+    if follower:
+        return _run_train_follower(engine, engine_params, ctx, wp, gang_id)
     storage = ctx.get_storage()
     instances = storage.get_meta_data_engine_instances()
 
@@ -111,7 +194,52 @@ def run_train(
         ),
         serving_params=json.dumps(dict(engine_params.serving_params)),
     )
-    if wp.resume:
+    if gang_id:
+        # Supervisor-pinned id: the row and checkpoint dir are shared
+        # by every gang attempt, so resume discovery is a direct get —
+        # a relaunch must never pick up some OTHER interrupted run.
+        from .checkpoint import instance_checkpoint_dir
+
+        instance = EngineInstance(**{**instance.__dict__, "id": gang_id})
+        prior = instances.get(gang_id) if wp.resume else None
+        if prior is not None and prior.status == "COMPLETED":
+            # A relaunch can race the finish line: the leader persisted
+            # and stamped COMPLETED while a wedged follower got the gang
+            # killed. The job is DONE — retraining it would flip the row
+            # back to RUNNING and duplicate the Model insert. Every gang
+            # process takes this same exit (followers check the shared
+            # row), so nobody is left alone in a collective.
+            log.info("gang resume: EngineInstance %s is already "
+                     "COMPLETED; nothing to do", gang_id)
+            return gang_id
+        if (prior is not None
+                and prior.algorithms_params != instance.algorithms_params):
+            # Same guard as the discovery path below: resuming under
+            # changed hyperparameters would blend them — drop the stale
+            # snapshots and train this gang id from scratch.
+            from .checkpoint import CheckpointHook
+
+            log.warning(
+                "gang --resume: instance %s has different algorithm "
+                "params; discarding its checkpoints and training from "
+                "scratch", gang_id)
+            CheckpointHook(instance_checkpoint_dir(gang_id)).delete_all()
+            prior = None
+        if (prior is not None and prior.status != "COMPLETED"
+                and os.path.isdir(instance_checkpoint_dir(gang_id))):
+            instance = EngineInstance(
+                **{**instance.__dict__, "start_time": prior.start_time})
+            instances.update(instance)
+            log.info("gang resume: continuing EngineInstance %s", gang_id)
+        elif instances.get(gang_id) is not None:
+            # The row exists but isn't resumable (no snapshots landed
+            # before the relaunch): retake it fresh — an insert here
+            # would be a duplicate key on strict backends.
+            instances.update(instance)
+        else:
+            instances.insert(instance)
+        instance_id = gang_id
+    elif wp.resume:
         from .checkpoint import find_resumable_instance
 
         prior = find_resumable_instance(
@@ -171,37 +299,10 @@ def run_train(
             return jax.profiler.trace(wp.profile_dir)
         return contextlib.nullcontext()
 
-    def _train_models():
-        from .checkpoint import CheckpointHook, CheckpointIncompatibleError
-
-        try:
-            with _profile_cm():
-                return engine.train(ctx, engine_params, wp)
-        except CheckpointIncompatibleError as e:
-            if ctx.checkpoint_hook is None or not wp.resume:
-                raise
-            # Stale snapshots can't continue this run (data/rank changed).
-            # Discard them and train from scratch — otherwise every future
-            # --resume re-selects the same instance and fails the same way.
-            log.warning(
-                "--resume: %s; discarding stale checkpoints and training "
-                "from scratch", e,
-            )
-            root = ctx.checkpoint_hook
-            root.delete_all()
-            ctx.checkpoint_hook = CheckpointHook(
-                root.directory, every_n=root.every_n,
-                max_to_keep=root.max_to_keep,
-            )
-            ctx.workflow_params = dataclasses.replace(wp, resume=False)
-            try:
-                with _profile_cm():
-                    return engine.train(ctx, engine_params, ctx.workflow_params)
-            finally:
-                ctx.workflow_params = wp
-
     try:
-        models = _train_models()
+        models = _train_with_stale_checkpoint_fallback(
+            engine, engine_params, ctx, wp, cm=_profile_cm)
+        gang.beat()
         if wp.stop_after_read or wp.stop_after_prepare:
             instances.update(instance.with_status("ABORTED", _utcnow()))
             if ctx.checkpoint_hook is not None:
@@ -209,25 +310,30 @@ def run_train(
                 ctx.checkpoint_hook = None
             return instance_id
 
-        _, _, algo_list, _ = engine.make_components(engine_params)
-        persistent = 0
-        for (name, algo), model in zip(algo_list, models):
-            if isinstance(model, PersistentModel):
-                if model.save(instance_id, algo.params):
-                    persistent += 1
-        blob = serialize_models(algo_list, models)
-        storage.get_model_data_models().insert(Model(instance_id, blob))
-        log.info(
-            "models persisted: %d bytes pickled, %d self-persisted",
-            len(blob), persistent,
-        )
-        done = EngineInstance(
-            **{**instance.__dict__, "id": instance_id}
-        ).with_status("COMPLETED", _utcnow())
-        instances.update(done)
-        if ctx.checkpoint_hook is not None:
-            ctx.checkpoint_hook.delete_all()  # snapshots superseded by the model
-            ctx.checkpoint_hook = None
+        # Persistence has no natural beat points, and at scale the
+        # device_get + pickle + storage insert can outlast the stall
+        # threshold — a background beat keeps the supervisor from
+        # gang-killing a job whose training already succeeded.
+        with gang.beat_while():
+            _, _, algo_list, _ = engine.make_components(engine_params)
+            persistent = 0
+            for (name, algo), model in zip(algo_list, models):
+                if isinstance(model, PersistentModel):
+                    if model.save(instance_id, algo.params):
+                        persistent += 1
+            blob = serialize_models(algo_list, models)
+            storage.get_model_data_models().insert(Model(instance_id, blob))
+            log.info(
+                "models persisted: %d bytes pickled, %d self-persisted",
+                len(blob), persistent,
+            )
+            done = EngineInstance(
+                **{**instance.__dict__, "id": instance_id}
+            ).with_status("COMPLETED", _utcnow())
+            instances.update(done)
+            if ctx.checkpoint_hook is not None:
+                ctx.checkpoint_hook.delete_all()  # superseded by the model
+                ctx.checkpoint_hook = None
         log.info("EngineInstance %s COMPLETED", instance_id)
         return instance_id
     except Exception:

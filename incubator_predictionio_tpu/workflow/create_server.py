@@ -29,7 +29,7 @@ import time as _time
 
 from aiohttp import web
 
-from ..common import deadline, telemetry
+from ..common import deadline, envknobs, telemetry
 from ..common.resilience import retry_after_jitter
 from ..controller.engine import Engine
 from ..data.storage.datamap import DataMap
@@ -44,14 +44,9 @@ log = logging.getLogger("pio.engineserver")
 
 def _env_int(name: str, default: int) -> int:
     """Tolerant integer knob: unset/unparsable degrades to the default
-    (a typo'd env var must not crash a deploy)."""
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        return int(float(raw))
-    except (ValueError, OverflowError):   # "bananas", "inf", 1e999
-        return default
+    (a typo'd env var must not crash a deploy). Float spellings like
+    ``"1e3"`` are accepted. One shared implementation: common/envknobs."""
+    return envknobs.env_int(name, default, float_ok=True)
 
 
 class AdmissionShed(Exception):

@@ -47,7 +47,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
-from ..common import telemetry
+from ..common import envknobs, telemetry
 
 # Last-run pipeline stage gauges (training is episodic, so the natural
 # exposition is "the most recent run's decomposition", not a histogram
@@ -97,18 +97,8 @@ class PipelineWorkerError(RuntimeError):
 
 
 def _env_int(name: str, default: int, lo: int = 1, hi: int = 1 << 30) -> int:
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        v = int(raw)
-    except ValueError:
-        import warnings
-
-        warnings.warn(f"{name}={raw!r} is not an integer; using {default}",
-                      stacklevel=3)
-        return default
-    return max(lo, min(v, hi))
+    # Warn-and-clamp semantics; one shared implementation: common/envknobs.
+    return envknobs.env_int(name, default, lo=lo, hi=hi, warn=True)
 
 
 @dataclasses.dataclass(frozen=True)
