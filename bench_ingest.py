@@ -12,6 +12,12 @@ write — measuring:
   visible next to its throughput win
 - /batch/events.json at the wire cap (50 events/request), both modes
 - bulk import path (`pio import`-equivalent insert_batch) for contrast
+- multi-worker bracket (`PIO_INGEST_MULTIWORKER=0` skips): REAL
+  `pio eventserver --workers N` subprocess topologies at N=1/2/4,
+  same-run, WAL armed — the partitioned-event-log scale-out number
+  (ISSUE 8); persisted as `measured_ingest_multiworker`
+- compacted-scan timing: a cold columnar-snapshot load vs the JSON
+  re-parse of the same log (`measured_eventlog_scan`)
 
 against the JSONL event log (the training-fast-path store of record)
 by default; PIO_INGEST_BACKEND=SQLITE|MEMORY switches. Ack semantics
@@ -223,6 +229,329 @@ def run_batch50(st, n_batch):
     return n_reqs * 50 / dt
 
 
+def _mw_env(tmp: str) -> dict:
+    return {
+        **os.environ,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": os.path.join(tmp, "meta.sqlite"),
+        "PIO_STORAGE_SOURCES_EV_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_EV_PATH": os.path.join(tmp, "events"),
+        "PIO_WAL": "1",
+        "PIO_WAL_DIR": os.path.join(tmp, "wal"),
+        "PIO_FS_BASEDIR": os.path.join(tmp, "pio_store"),
+        "JAX_PLATFORMS": "cpu",
+    }
+
+
+def _mw_prepare(env) -> None:
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+
+    storage = Storage({k: v for k, v in env.items()
+                       if k.startswith("PIO_STORAGE")})
+    app_id = storage.get_meta_data_apps().insert(App(0, "mw"))
+    storage.get_meta_data_access_keys().insert(AccessKey("k1", app_id, ()))
+    storage.close()
+
+
+def _mw_drive(base_url: str, conc: int, n: int) -> float:
+    """events/sec of single-event POSTs over `conc` keep-alive
+    connections (the run_single_sweep discipline, one fixed point)."""
+    import concurrent.futures
+
+    base = "/events.json?accessKey=k1"
+    threads = max(t for t in range(1, min(8, conc) + 1) if conc % t == 0)
+    conns_per_worker = conc // threads
+    per_conn = max(1, n // conc)
+
+    def worker(w):
+        socks = [HttpClient(base_url) for _ in range(conns_per_worker)]
+        reqs = [[HttpClient.encode(
+            base, ev((w * conns_per_worker + i) * per_conn + j))
+            for j in range(per_conn)] for i in range(conns_per_worker)]
+        ok = 0
+        try:
+            for j in range(per_conn):
+                for i, c in enumerate(socks):
+                    c.send_raw(reqs[i][j])
+                for c in socks:
+                    ok += c.recv_response() == 201
+        finally:
+            for c in socks:
+                c.close()
+        return ok
+
+    t0 = time.perf_counter()
+    if threads == 1:
+        ok = worker(0)
+    else:
+        with concurrent.futures.ThreadPoolExecutor(threads) as pool:
+            ok = sum(pool.map(worker, range(threads)))
+    dt = time.perf_counter() - t0
+    sent = per_conn * conc
+    assert ok == sent, f"{sent - ok} POSTs failed in multiworker drive"
+    return ok / dt
+
+
+class _MwTopology:
+    """One live `pio eventserver --workers N` topology (front +
+    supervised worker subprocesses, SQLITE metadata + JSONL shards +
+    per-partition WAL in a private tmp dir)."""
+
+    def __init__(self, workers: int):
+        import subprocess
+
+        self.tmp = tempfile.mkdtemp(prefix=f"pio_mw{workers}_")
+        env = _mw_env(self.tmp)
+        _mw_prepare(env)
+        port = _free_port()
+        self.base = f"http://127.0.0.1:{port}"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "incubator_predictionio_tpu.tools.console", "eventserver",
+             "--workers", str(max(1, workers)), "--ip", "127.0.0.1",
+             "--port", str(port)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"multiworker front died rc={self.proc.returncode}")
+            try:
+                cli = HttpClient(self.base)
+                if cli.post("/events.json?accessKey=k1", ev(0)) == 201:
+                    cli.close()
+                    return
+                cli.close()
+            except OSError:
+                time.sleep(0.2)
+        raise RuntimeError("multiworker front not ready in time")
+
+    def close(self):
+        import shutil
+        import signal
+        import subprocess
+
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+
+def run_multiworker_bracket(brackets, conc: int, n: int,
+                            rounds: int = 3) -> dict:
+    """Same-run `pio eventserver --workers N` throughput bracket.
+
+    This host's CPU can swing severalfold WITHIN one bench run, so a
+    single sequential sweep booked as a bracket would mostly measure
+    the swing. All topologies are brought up FIRST, then the drive
+    interleaves them round-robin for `rounds` rounds (adjacent
+    measurements are close in time); each point reports the median
+    across rounds, and each speedup is the median of the WITHIN-round
+    ratios — drift that moves a whole round cancels out of the ratio."""
+    topos = {}
+    out = {}
+    try:
+        for w in brackets:
+            topos[w] = _MwTopology(w)
+        for w in brackets:  # warm-up every topology once
+            _mw_drive(topos[w].base, conc, max(200, n // 10))
+        per_round: dict = {w: [] for w in brackets}
+        for r in range(rounds):
+            for w in brackets:
+                rate = _mw_drive(topos[w].base, conc, n)
+                per_round[w].append(rate)
+                log(f"[ingest]   multiworker x{w} (round {r + 1}): "
+                    f"{rate:,.0f} ev/s (conc {conc})")
+        for w in brackets:
+            out[f"workers_{w}"] = round(
+                float(np.median(per_round[w])), 1)
+            out[f"workers_{w}_rounds"] = [round(v, 1)
+                                          for v in per_round[w]]
+        if 1 in brackets:
+            for w in brackets:
+                if w == 1:
+                    continue
+                ratios = [per_round[w][r] / per_round[1][r]
+                          for r in range(rounds)]
+                out[f"speedup_{w}"] = round(float(np.median(ratios)), 2)
+                log(f"[ingest]   multiworker speedup x{w}: "
+                    f"{out[f'speedup_{w}']}x (per-round "
+                    f"{[round(x, 2) for x in ratios]})")
+    finally:
+        for t in topos.values():
+            t.close()
+    out["conc"] = conc
+    out["rounds"] = rounds
+    out["host_scaleout_ceiling"] = _host_scaleout_ceiling(conc, n)
+    ceiling = out["host_scaleout_ceiling"].get("ceiling") or 0.0
+    if ceiling < 1.8:
+        out["note"] = (
+            "host-limited: the ceiling control (TWO fully independent "
+            "servers vs one, identical client shape — the best case of "
+            f"ANY scale-out) reached only {ceiling}x on this host "
+            f"({os.cpu_count()} cores; client+front+worker saturate "
+            "them), so the bracket measures host capacity, not the "
+            "partitioned log; a >=1.8x demonstration needs >=4 usable "
+            "cores")
+        log(f"[ingest]   NOTE: host scale-out ceiling {ceiling}x < 1.8x "
+            "— bracket is host-limited on this machine")
+    return out
+
+
+def _host_scaleout_ceiling(conc: int, n: int) -> dict:
+    """Same-run control: TWO fully independent event-server processes
+    (no front, no supervisor, separate stores — the theoretical best
+    case of ANY scale-out) vs ONE, under an identical client shape.
+    The ratio is what this HOST can express: on a box whose cores are
+    already saturated by client+kernel+server at 1 worker, no
+    architecture can beat it — a ceiling near 1.0 means the bracket
+    above measures the host, not the partitioned log."""
+    import shutil
+    import signal
+    import subprocess
+    import threading
+
+    half = max(2, conc // 2)
+    procs, tmps, bases = [], [], []
+    try:
+        for i in range(2):
+            tmp = tempfile.mkdtemp(prefix=f"pio_ceil{i}_")
+            tmps.append(tmp)
+            env = _mw_env(tmp)
+            _mw_prepare(env)
+            port = _free_port()
+            env["PIO_EVENT_WORKER_PORT"] = str(port)
+            env["PIO_EVENT_PARTITION"] = str(i)
+            env["PIO_WAL_DIR"] = os.path.join(env["PIO_WAL_DIR"], f"p{i}")
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "incubator_predictionio_tpu.tools.console",
+                 "eventserver", "--worker"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+            bases.append(f"http://127.0.0.1:{port}")
+        for base in bases:
+            deadline = time.monotonic() + 90
+            ready = False
+            while time.monotonic() < deadline:
+                try:
+                    cli = HttpClient(base)
+                    ok = cli.post("/events.json?accessKey=k1", ev(0)) == 201
+                    cli.close()
+                    if ok:
+                        ready = True
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.2)
+            if not ready:
+                raise RuntimeError(f"ceiling worker at {base} not ready")
+
+        def dual_drive(targets):
+            rates = [0.0, 0.0]
+
+            def go(i):
+                rates[i] = _mw_drive(targets[i], half, n // 2)
+
+            ts = [threading.Thread(target=go, args=(i,)) for i in (0, 1)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return rates[0] + rates[1]
+
+        # interleaved rounds + ratio-of-adjacent-measurements: the
+        # host's CPU swing must cancel out of the ceiling, or a swing
+        # reads as an impossible >2x "scale-out"
+        ones, twos, ratios = [], [], []
+        dual_drive([bases[0], bases[0]])  # warm-up
+        dual_drive(bases)
+        for _ in range(3):
+            one = dual_drive([bases[0], bases[0]])
+            two = dual_drive(bases)
+            ones.append(one)
+            twos.append(two)
+            ratios.append(two / one if one else 0.0)
+        out = {"one_server": round(float(np.median(ones)), 1),
+               "two_servers": round(float(np.median(twos)), 1),
+               "ceiling": round(float(np.median(ratios)), 2)}
+        log(f"[ingest]   host scale-out ceiling: 1-server "
+            f"{out['one_server']:,.0f} vs 2-independent-servers "
+            f"{out['two_servers']:,.0f} ev/s ({out['ceiling']}x, "
+            f"per-round {[round(r, 2) for r in ratios]})")
+        return out
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+                p.wait(timeout=20)
+            except Exception:  # noqa: BLE001 — bench teardown
+                p.kill()
+                p.wait()
+        for tmp in tmps:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_compacted_scan_bench(n_events: int = 60_000) -> dict:
+    """Cold scan of one JSONL log: columnar-snapshot load (the event-log
+    compactor's output) vs the native JSON re-parse of the same bytes.
+    Same-run, same data — the train-time read-path win of ISSUE 8."""
+    import shutil
+
+    from incubator_predictionio_tpu.data.api import event_log
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
+
+    tmp = tempfile.mkdtemp(prefix="pio_colseg_")
+    try:
+        le = JSONLEvents(tmp)
+        chunk = [Event.from_json(ev(i)) for i in range(5000)]
+        for _ in range(max(1, n_events // 5000)):
+            le.insert_batch(chunk, 1)
+        le.close()
+        path = os.path.join(tmp, "events_1.jsonl")
+        size = os.path.getsize(path)
+
+        def cold_scan_seconds() -> float:
+            t0 = time.perf_counter()
+            fresh = JSONLEvents(tmp)
+            cols, rows = fresh.scan_columnar(1)
+            assert len(rows) >= n_events - 1
+            return time.perf_counter() - t0
+
+        json_s = min(cold_scan_seconds() for _ in range(3))
+        manifest = event_log.compact_log(path)
+        assert manifest is not None
+        snap_s = min(cold_scan_seconds() for _ in range(3))
+        out = {
+            "events": manifest["events"],
+            "log_bytes": size,
+            "json_parse_s": round(json_s, 4),
+            "compacted_s": round(snap_s, 4),
+            "speedup": round(json_s / snap_s, 2) if snap_s > 0 else None,
+        }
+        log(f"[ingest] compacted scan: {out['events']} events, JSON "
+            f"parse {json_s * 1e3:.0f}ms vs snapshot {snap_s * 1e3:.0f}ms "
+            f"({out['speedup']}x)")
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tests"))
@@ -343,6 +672,23 @@ def main() -> int:
     results_wal = flat("wal")
     results_wal["host_loop_mops"] = round(mops, 1)
 
+    # multi-worker bracket (ISSUE 8): same-run 1/2/4-worker topologies
+    results_mw = None
+    if os.environ.get("PIO_INGEST_MULTIWORKER", "1") != "0":
+        mw_concs = [int(c) for c in os.environ.get(
+            "PIO_INGEST_MW_WORKERS", "1,2,4").split(",") if c.strip()]
+        log("[ingest] --- multi-worker bracket (front + supervised "
+            "workers, WAL on) ---")
+        results_mw = run_multiworker_bracket(
+            mw_concs,
+            conc=int(os.environ.get("PIO_INGEST_MW_CONC", "16")),
+            n=int(os.environ.get("PIO_INGEST_MW_N", "3000")))
+        results_mw["host_loop_mops"] = round(mops, 1)
+
+    # compacted-scan vs JSON-re-parse (ISSUE 8 satellite)
+    results_scan = run_compacted_scan_bench(
+        int(os.environ.get("PIO_INGEST_SCAN_N", "60000")))
+
     for conc in concs:
         on = by_mode["on"]["sweep"][conc]["events_per_sec"]
         off = by_mode["off"]["sweep"][conc]["events_per_sec"]
@@ -353,11 +699,16 @@ def main() -> int:
         log(f"[ingest] WAL cost x{conc}: {wal / on:.2f}x of group-on "
             f"({on:,.0f} -> {wal:,.0f} ev/s)")
 
-    for mode, res in (("group_on", results_on), ("group_off", results_off),
-                      ("wal_on", results_wal)):
+    modes = [("group_on", results_on), ("group_off", results_off),
+             ("wal_on", results_wal), ("eventlog_scan", results_scan)]
+    if results_mw is not None:
+        modes.append(("multiworker", results_mw))
+    for mode, res in modes:
         for k, v in res.items():
             unit = ("ms" if k.endswith("_ms") else
-                    "Mops" if k.endswith("_mops") else "events/sec")
+                    "Mops" if k.endswith("_mops") else
+                    "s" if k.endswith("_s") else
+                    "x" if k.startswith("speedup") else "events/sec")
             print(json.dumps({
                 "metric": f"event ingestion {mode} {k} ({backend.lower()})",
                 "value": v, "unit": unit,
@@ -372,6 +723,9 @@ def main() -> int:
         pub[f"measured_ingest_{backend.lower()}"] = results_on
         pub[f"measured_ingest_{backend.lower()}_nogroup"] = results_off
         pub[f"measured_ingest_{backend.lower()}_wal"] = results_wal
+        pub["measured_eventlog_scan"] = results_scan
+        if results_mw is not None:
+            pub["measured_ingest_multiworker"] = results_mw
         with open(base_path, "w") as f:
             json.dump(doc, f, indent=2)
     except Exception as e:  # noqa: BLE001

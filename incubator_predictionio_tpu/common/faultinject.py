@@ -26,6 +26,11 @@ identically in-process, across subprocesses, and in CI:
   Unlike ``fail``, the count selects WHICH call crashes (a process
   only crashes once): ``ingest.commit:crash:3`` survives two group
   commits and dies inside the third.
+- ``oserr:N:ERRNO`` — the first N matching calls raise a plain
+  ``OSError(ERRNO, ...)`` (NOT the retryable :class:`InjectedFault`
+  class): the deterministic disk fault (``oserr:1:28`` = ENOSPC) used
+  by the append-error shed tests, where the failure must classify as
+  resource exhaustion rather than a torn connection.
 
 Counts are per-rule and deterministic: "fail first 2 calls" means
 exactly the first two matching calls in this process fail, then the
@@ -75,7 +80,7 @@ def _parse(spec: str) -> list[_Rule]:
                 f"{ENV_VAR}: malformed rule {raw!r} "
                 "(want point:mode:count[:param])")
         pattern, mode, count = parts[0], parts[1].lower(), parts[2]
-        if mode not in ("fail", "latency", "drop", "crash"):
+        if mode not in ("fail", "latency", "drop", "crash", "oserr"):
             raise ValueError(f"{ENV_VAR}: unknown fault mode {mode!r}")
         try:
             n = int(count)
@@ -87,7 +92,7 @@ def _parse(spec: str) -> list[_Rule]:
                 param = float(parts[3])
             except ValueError as e:
                 raise ValueError(f"{ENV_VAR}: bad param in {raw!r}") from e
-        elif mode in ("latency", "drop"):
+        elif mode in ("latency", "drop", "oserr"):
             raise ValueError(f"{ENV_VAR}: mode {mode!r} needs a param "
                              f"({raw!r})")
         rules.append(_Rule(pattern, mode, n, param))
@@ -143,7 +148,7 @@ def fault_point(name: str) -> None:
     if not os.environ.get(ENV_VAR):
         return
     delay = 0.0
-    boom: Optional[InjectedFault] = None
+    boom: Optional[Exception] = None
     die = False
     with _lock:
         for rule in _active_rules():
@@ -162,6 +167,11 @@ def fault_point(name: str) -> None:
             if rule.mode == "fail":
                 boom = InjectedFault(
                     f"injected fault at {name!r} ({ENV_VAR})")
+                break
+            if rule.mode == "oserr":
+                boom = OSError(
+                    int(rule.param),
+                    f"injected disk fault at {name!r} ({ENV_VAR})")
                 break
             delay += rule.param
     if die:

@@ -1,0 +1,812 @@
+"""Partitioned event log: fenced ownership, crash-safe compaction,
+corruption scrubbing, multi-worker event serving.
+
+This module promotes the per-(app, channel) append-only event log (the
+JSONL store of record plus its ingest WAL) from a single-process design
+to a *partitioned primary event log* — the HBase WAL-first shape the
+reference platform leaned on:
+
+- **Fenced ownership.** Every partition (a worker's private shard of
+  the log: ``events_<app>[_<chan>].p<i>.jsonl`` plus the matching WAL
+  subdirectory) is claimed through a *lease file*: an exclusive
+  ``flock`` held for the owner's lifetime plus a monotonically bumped
+  **epoch** counter persisted in the file body. A rival claimant on a
+  held partition fails at claim time (:class:`PartitionHeldError`).
+  The epoch closes the residual split-brain window flock cannot
+  (lease stolen across a partition/NFS boundary, or force-taken from a
+  wedged-but-alive worker): the owner re-reads the epoch before every
+  group of writes and a stale epoch raises
+  :class:`PartitionFencedError` — the fenced worker structurally
+  cannot land another byte, it does not merely happen not to.
+
+- **Crash-safe compaction.** A compactor rewrites the fully-committed
+  prefix of a log into a columnar snapshot (the native codec's
+  interned columns, serialized) that every scan consumer —
+  ``find_batches``, ``scan_columnar``, the PR 2 input pipeline — loads
+  without re-parsing JSON. The commit protocol is shadow-file + fsync
+  + atomic rename + manifest commit record: SIGKILL at ANY instruction
+  leaves either the previous state or the complete new snapshot active
+  (the manifest names exactly one generation), never a half-written
+  one and never neither. The JSONL log itself is never truncated or
+  rewritten by compaction — the snapshot is a provably-equivalent
+  accelerated view, so no kill point can lose an acked event.
+
+- **Corruption scrubbing.** The scrubber CRC-verifies snapshots
+  against their manifests and (via the WAL decoder's resync mode)
+  detects mid-file corruption in WAL segments. Corrupt files are
+  *quarantined* — moved into a ``quarantine/`` subdir, never deleted —
+  counted in ``pio_eventlog_quarantined_segments_total`` and warned
+  about by ``pio status``; the partition keeps serving from the
+  surviving JSONL bytes.
+
+- **Resource-exhaustion degradation.** ENOSPC-class append failures
+  flip the partition into *shed mode* (503 + jittered Retry-After, the
+  breaker discipline of ``common/resilience.py``) instead of letting a
+  full disk corrupt the log tail; see
+  :class:`~.ingest_buffer.AppendShedError`.
+
+- **Multi-worker serving.** ``pio eventserver --workers N`` (or
+  ``PIO_EVENT_WORKERS``) runs N real event-server processes, each
+  owning a disjoint partition, behind a front listener that splices
+  client connections to workers round-robin (connection-level L4
+  routing: any worker can serve any request — reads are merged across
+  partitions, writes land in the handling worker's own shard — so no
+  per-request body parsing sits on the hot path). The workers are
+  supervised with the PR 7 liveness machinery
+  (``parallel/supervisor.py``) generalized to per-worker restart:
+  a dead or wedged worker is individually relaunched (its startup
+  replays its own WAL partition), the rest keep serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as _dt
+import io
+import json
+import logging
+import os
+import socket
+import sys
+import threading
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from ...common import telemetry
+from ...common.faultinject import fault_point
+from .ingest_buffer import IngestOverloadError
+from .ingest_wal import QUARANTINE_DIR, quarantine_path
+
+log = logging.getLogger("pio.eventlog")
+
+__all__ = [
+    "Lease", "PartitionFencedError", "PartitionHeldError",
+    "claim_partition", "compact_log", "lease_info", "load_snapshot",
+    "partition_health", "run_partitioned_event_server", "scrub_log_dir",
+]
+
+_M_SNAP_LOADS = telemetry.registry().counter(
+    "pio_eventlog_snapshot_loads_total",
+    "Compacted columnar snapshots loaded in place of a JSON "
+    "re-parse").labels()
+_M_COMPACTIONS = telemetry.registry().counter(
+    "pio_eventlog_compactions_total",
+    "Event-log compaction passes that committed a new snapshot").labels()
+
+SNAPSHOT_VERSION = 1
+MANIFEST_SUFFIX = ".manifest"
+TAIL_PROBE_LEN = 4096
+
+
+# ---------------------------------------------------------------------------
+# partition leases (fenced ownership)
+# ---------------------------------------------------------------------------
+
+class PartitionHeldError(RuntimeError):
+    """A live process holds this partition's lease (flock): a second
+    claimant must not come up — two writers on one shard would
+    interleave appends and race segment deletion."""
+
+
+class PartitionFencedError(IngestOverloadError):
+    """This worker's lease epoch is no longer the partition's current
+    epoch: another claimant took ownership. Every subsequent write is
+    structurally refused (verified BEFORE any WAL/store append) and the
+    event server converts it into a 503 so clients retry against the
+    new owner. Restarting the fenced worker re-claims with a fresh
+    epoch."""
+
+    def __init__(self, message: str):
+        super().__init__(message, retry_after=5.0)
+
+
+def _lease_path(dirpath: str, partition: int) -> str:
+    return os.path.join(dirpath, f".p{partition}.lease")
+
+
+class Lease:
+    """A held partition lease: an exclusive flock (kernel-released on
+    ANY process death, including SIGKILL) plus the epoch this holder
+    wrote. ``verify()`` re-reads the on-disk epoch; callers run it
+    before every write group."""
+
+    __slots__ = ("path", "partition", "epoch", "_fd", "forced")
+
+    def __init__(self, path: str, partition: int, epoch: int, fd: int,
+                 forced: bool = False):
+        self.path = path
+        self.partition = partition
+        self.epoch = epoch
+        self._fd = fd
+        self.forced = forced
+
+    def verify(self) -> None:
+        """Raise :class:`PartitionFencedError` unless the on-disk epoch
+        is still ours. An unreadable/garbled body also fences — the
+        safe direction is refusing the write."""
+        try:
+            body = os.pread(self._fd, 4096, 0)
+            current = json.loads(body.decode("utf-8"))["epoch"]
+        except (OSError, ValueError, KeyError, UnicodeDecodeError):
+            raise PartitionFencedError(
+                f"partition {self.partition} lease unreadable; refusing "
+                "writes (possible ownership change in progress)") from None
+        if current != self.epoch:
+            raise PartitionFencedError(
+                f"partition {self.partition} fenced: lease epoch "
+                f"{current} has overtaken ours ({self.epoch}); another "
+                "worker owns this partition now")
+
+    def release(self) -> None:
+        if self._fd is not None:
+            try:
+                os.close(self._fd)  # closing drops the flock
+            except OSError:  # pragma: no cover — already closed
+                pass
+            self._fd = None
+
+    def to_json(self) -> dict:
+        return {"partition": self.partition, "epoch": self.epoch,
+                "forced": self.forced}
+
+
+def _write_lease_body(fd: int, epoch: int) -> None:
+    body = json.dumps({
+        "epoch": epoch, "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "claimedAt": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+    }).encode("utf-8")
+    os.ftruncate(fd, 0)
+    os.pwrite(fd, body, 0)
+    os.fsync(fd)
+
+
+def _read_lease_body(fd: int) -> dict:
+    try:
+        return json.loads(os.pread(fd, 4096, 0).decode("utf-8"))
+    except (OSError, ValueError, UnicodeDecodeError):
+        return {}
+
+
+def claim_partition(dirpath: str, partition: int,
+                    force: bool = False) -> Lease:
+    """Claim a partition: exclusive flock on its lease file, then bump
+    and persist the epoch. A held lease raises
+    :class:`PartitionHeldError` unless ``force`` — the operator's
+    split-brain resolver (`pio eventlog fence`): it bumps the epoch
+    WITHOUT the flock, so a wedged-but-alive previous owner is fenced
+    out on its next write while the new claimant proceeds. ``force``
+    presumes the old owner is unreachable or wedged; with it, YOU are
+    asserting there is at most one live claimant."""
+    os.makedirs(dirpath, exist_ok=True)
+    path = _lease_path(dirpath, partition)
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+    forced = False
+    try:
+        try:
+            import fcntl
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except ImportError:  # pragma: no cover — non-POSIX
+            pass
+        except OSError:
+            if not force:
+                holder = _read_lease_body(fd)
+                raise PartitionHeldError(
+                    f"partition {partition} of {dirpath!r} is held by a "
+                    f"live process (pid {holder.get('pid')}, epoch "
+                    f"{holder.get('epoch')}); a second writer would "
+                    "corrupt the shard") from None
+            forced = True
+        epoch = int(_read_lease_body(fd).get("epoch", 0)) + 1
+        _write_lease_body(fd, epoch)
+    except Exception:
+        os.close(fd)
+        raise
+    lease = Lease(path, partition, epoch, fd, forced=forced)
+    log.info("claimed partition %d of %s (epoch %d%s)", partition,
+             dirpath, epoch, ", FORCED past a held flock" if forced else "")
+    return lease
+
+
+def lease_info(dirpath: str, partition: int) -> Optional[dict]:
+    """Operator view of one lease file: holder body plus whether the
+    flock is actually held (``held=False`` with a body present = a
+    stale lease left by a crashed worker — the next claimant recovers
+    it). Returns None when the lease file does not exist."""
+    path = _lease_path(dirpath, partition)
+    if not os.path.exists(path):
+        return None
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError:
+        # unreadable (permissions, or deleted since the exists check):
+        # a health surface must degrade, not traceback
+        return {"partition": partition, "held": None, "epoch": None,
+                "pid": None, "claimedAt": None, "stale": False}
+    try:
+        body = _read_lease_body(fd)
+        held = True
+        try:
+            import fcntl
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            held = False  # we got it: no live holder
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        except ImportError:  # pragma: no cover — non-POSIX
+            held = False
+        except OSError:
+            held = True
+        return {"partition": partition, "held": held,
+                "epoch": body.get("epoch"), "pid": body.get("pid"),
+                "claimedAt": body.get("claimedAt"),
+                "stale": bool(body) and not held}
+    finally:
+        os.close(fd)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe columnar compaction
+# ---------------------------------------------------------------------------
+
+def _manifest_path(log_path: str) -> str:
+    return log_path + MANIFEST_SUFFIX
+
+
+def _read_manifest(log_path: str) -> Optional[dict]:
+    try:
+        with open(_manifest_path(log_path)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fsync_dir(dirpath: str) -> None:
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:  # pragma: no cover — platform without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _serialize_cols(cols) -> bytes:
+    """ColumnarEvents → one npz blob (arrays + interned tables). The
+    snapshot stores the raw bytes too, so lazy per-record reparse
+    (``record_dict`` — what ``find()`` materializes Events from) works
+    off the snapshot exactly as off a fresh parse: bit-identical."""
+    buf = io.BytesIO()
+    tables = {f"table_{w}": np.frombuffer(
+        json.dumps(cols.table(w)).encode("utf-8"), np.uint8)
+        for w in range(6)}
+    np.savez(
+        buf,
+        version=np.asarray([SNAPSHOT_VERSION], np.int64),
+        raw=np.frombuffer(cols.raw, np.uint8),
+        event=cols.event, etype=cols.etype, eid=cols.eid,
+        tetype=cols.tetype, teid=cols.teid, event_id=cols.event_id,
+        time_us=cols.time_us, rating=cols.rating,
+        props=cols.props, span=cols.span,
+        tombstones=np.frombuffer(
+            json.dumps(cols.tombstones).encode("utf-8"), np.uint8),
+        tombstone_pos=cols.tombstone_pos,
+        **tables,
+    )
+    return buf.getvalue()
+
+
+def _deserialize_cols(blob: bytes):
+    from ...native import ColumnarEvents
+
+    with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+        if int(z["version"][0]) != SNAPSHOT_VERSION:
+            raise ValueError(f"snapshot version {z['version'][0]}")
+        tables = [json.loads(bytes(z[f"table_{w}"]).decode("utf-8"))
+                  for w in range(6)]
+        return ColumnarEvents(
+            raw=bytes(z["raw"]),
+            event=z["event"], etype=z["etype"], eid=z["eid"],
+            tetype=z["tetype"], teid=z["teid"], event_id=z["event_id"],
+            time_us=z["time_us"], rating=z["rating"],
+            props=z["props"], span=z["span"],
+            _tables=tables,
+            tombstones=json.loads(bytes(z["tombstones"]).decode("utf-8")),
+            tombstone_pos=z["tombstone_pos"],
+        )
+
+
+def _tail_probe(buf: bytes, covered: int) -> dict:
+    off = max(0, covered - TAIL_PROBE_LEN)
+    return {"off": off, "len": covered - off,
+            "crc32": zlib.crc32(buf[off:covered])}
+
+
+def compact_log(log_path: str, min_new_bytes: int = 0) -> Optional[dict]:
+    """Compact one JSONL event log into a columnar snapshot.
+
+    Additive and lock-free: the snapshot covers the first ``covered``
+    bytes (the complete-line prefix at read time); concurrent appends
+    only ever extend the file past ``covered`` and are picked up as the
+    normal incremental tail parse. Commit protocol (each step leaves a
+    recoverable state — SIGKILL anywhere yields either the old
+    snapshot or the new one, complete):
+
+    1. write ``<log>.g<N>.colseg.tmp`` (shadow file), fsync
+    2. atomic-rename to ``<log>.g<N>.colseg``, fsync dir
+    3. write + fsync + atomic-rename the manifest (the COMMIT record:
+       it names exactly one generation)
+    4. garbage-collect superseded generations and stray ``.tmp`` files
+
+    Returns the committed manifest, or None when the log has grown less
+    than ``min_new_bytes`` past the current snapshot."""
+    from ...native import parse_events
+
+    try:
+        with open(log_path, "rb") as f:
+            buf = f.read()
+    except OSError:
+        return None
+    covered = buf.rfind(b"\n") + 1  # complete lines only
+    prev = _read_manifest(log_path)
+    gen = 1
+    if prev is not None:
+        if covered < int(prev.get("covered", 0)) + max(1, min_new_bytes):
+            return None
+        gen = int(prev.get("generation", 0)) + 1
+    elif covered == 0:
+        return None
+    cols = parse_events(buf[:covered])
+    blob = _serialize_cols(cols)
+    dirpath = os.path.dirname(log_path) or "."
+    base = os.path.basename(log_path)
+    snap_name = f"{base}.g{gen}.colseg"
+    tmp = os.path.join(dirpath, snap_name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    fault_point("compact.write")
+    os.replace(tmp, os.path.join(dirpath, snap_name))
+    _fsync_dir(dirpath)
+    fault_point("compact.rename")
+    manifest = {
+        "version": SNAPSHOT_VERSION,
+        "generation": gen,
+        "file": snap_name,
+        "covered": covered,
+        "events": len(cols),
+        "crc32": zlib.crc32(blob),
+        "tailProbe": _tail_probe(buf, covered),
+        "compactedAt": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+    }
+    mtmp = _manifest_path(log_path) + ".tmp"
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    fault_point("compact.manifest")
+    os.replace(mtmp, _manifest_path(log_path))
+    _fsync_dir(dirpath)
+    _M_COMPACTIONS.inc()
+    _gc_generations(dirpath, base, keep=snap_name)
+    log.info("compacted %s: generation %d, %d event(s), %d byte(s) "
+             "covered", log_path, gen, len(cols), covered)
+    return manifest
+
+
+def _gc_generations(dirpath: str, base: str, keep: str) -> None:
+    """Remove superseded snapshot generations and stray shadow files
+    of one log (post-commit: nothing references them)."""
+    prefix = base + ".g"
+    for name in os.listdir(dirpath):
+        if not name.startswith(prefix):
+            continue
+        if name == keep:
+            continue
+        if name.endswith(".colseg") or name.endswith(".tmp"):
+            try:
+                os.remove(os.path.join(dirpath, name))
+            except OSError:  # pragma: no cover — racing gc is fine
+                pass
+
+
+def _discard_stale(log_path: str, manifest: Optional[dict]) -> None:
+    """Remove a snapshot that no longer matches its log (the log was
+    replaced or rewritten — e.g. tombstone compaction). NOT corruption:
+    nothing is quarantined, the next compaction pass rebuilds it.
+
+    Generation-guarded: a reader can race a concurrent compaction — it
+    read generation N, the compactor committed N+1 and gc'd N's file,
+    and the reader's failed load must NOT delete the freshly committed
+    N+1 manifest. Only the generation the caller actually failed on is
+    ever removed."""
+    current = _read_manifest(log_path)
+    if (current is not None and manifest is not None
+            and current.get("generation") != manifest.get("generation")):
+        return  # a newer commit raced in: it owns the manifest now
+    for p in ([_manifest_path(log_path)]
+              + ([os.path.join(os.path.dirname(log_path) or ".",
+                               manifest["file"])]
+                 if manifest and manifest.get("file") else [])):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    log.info("discarded stale snapshot of %s (log replaced/rewritten)",
+             log_path)
+
+
+def _remove_manifest_if(log_path: str, manifest: dict) -> None:
+    """Remove the manifest only while it still names the generation the
+    caller failed on (same race guard as :func:`_discard_stale`)."""
+    current = _read_manifest(log_path)
+    if (current is not None
+            and current.get("generation") != manifest.get("generation")):
+        return
+    try:
+        os.remove(_manifest_path(log_path))
+    except OSError:
+        pass
+
+
+def load_snapshot(log_path: str):
+    """Load the committed snapshot of one log, fully verified.
+
+    Returns ``(ColumnarEvents, covered_bytes)`` or None. A CORRUPT
+    snapshot (CRC mismatch against the manifest commit record, or a
+    blob that fails to decode) is quarantined — moved aside, counted,
+    warned — and the caller falls back to the JSON parse: corruption
+    degrades speed, never availability and never replay. A STALE
+    snapshot (the log shrank or its covered prefix changed — a rewrite,
+    not bit rot) is silently discarded and rebuilt by the next
+    compaction pass."""
+    manifest = _read_manifest(log_path)
+    if manifest is None:
+        return None
+    dirpath = os.path.dirname(log_path) or "."
+    snap_path = os.path.join(dirpath, manifest.get("file") or "")
+    try:
+        covered = int(manifest["covered"])
+        with open(snap_path, "rb") as f:
+            blob = f.read()
+    except (OSError, KeyError, TypeError, ValueError):
+        _discard_stale(log_path, manifest)
+        return None
+    if zlib.crc32(blob) != manifest.get("crc32"):
+        quarantine_path(snap_path, "colseg")
+        _remove_manifest_if(log_path, manifest)
+        log.warning("snapshot of %s failed CRC; quarantined — scans "
+                    "fall back to the JSON parse", log_path)
+        return None
+    # the snapshot must describe THIS log: size still covers it and the
+    # last bytes of the covered prefix match the recorded probe
+    try:
+        if os.path.getsize(log_path) < covered:
+            raise ValueError("log shrank")
+        probe = manifest["tailProbe"]
+        with open(log_path, "rb") as f:
+            f.seek(int(probe["off"]))
+            got = f.read(int(probe["len"]))
+        if zlib.crc32(got) != probe["crc32"]:
+            raise ValueError("tail probe mismatch")
+    except (OSError, KeyError, TypeError, ValueError):
+        _discard_stale(log_path, manifest)
+        return None
+    try:
+        cols = _deserialize_cols(blob)
+    except Exception:  # noqa: BLE001 — any decode failure = corrupt
+        quarantine_path(snap_path, "colseg")
+        _remove_manifest_if(log_path, manifest)
+        log.exception("snapshot of %s failed to decode; quarantined",
+                      log_path)
+        return None
+    _M_SNAP_LOADS.inc()
+    return cols, covered
+
+
+def remove_artifacts(log_path: str) -> None:
+    """Delete one log's compaction artifacts (manifest + snapshot
+    generations + stray shadow files). Called when the LOG ITSELF is
+    being deleted — the snapshot is a full columnar copy of the data,
+    and app-data deletion must not silently retain it on disk."""
+    dirpath = os.path.dirname(log_path) or "."
+    base = os.path.basename(log_path)
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return
+    for name in names:
+        if (name == base + MANIFEST_SUFFIX
+                or (name.startswith(base + ".g")
+                    and (name.endswith(".colseg")
+                         or name.endswith(".tmp")))):
+            try:
+                os.remove(os.path.join(dirpath, name))
+            except OSError:
+                pass
+
+
+def scrub_log_dir(dirpath: str) -> dict:
+    """Verify every committed snapshot in one JSONL log directory;
+    quarantine corrupt ones (:func:`load_snapshot` does the moving and
+    counting). Returns ``{checked, ok, quarantined, stale}``."""
+    report = {"checked": 0, "ok": 0, "quarantined": 0, "stale": 0}
+    if not os.path.isdir(dirpath):
+        return report
+    qdir = os.path.join(dirpath, QUARANTINE_DIR)
+
+    def qcount() -> int:
+        return len(os.listdir(qdir)) if os.path.isdir(qdir) else 0
+
+    for name in sorted(os.listdir(dirpath)):
+        if not name.endswith(".jsonl" + MANIFEST_SUFFIX):
+            continue
+        log_path = os.path.join(dirpath, name[:-len(MANIFEST_SUFFIX)])
+        report["checked"] += 1
+        before = qcount()
+        if load_snapshot(log_path) is not None:
+            report["ok"] += 1
+        elif qcount() > before:
+            report["quarantined"] += 1
+        else:
+            report["stale"] += 1
+    return report
+
+
+# ---------------------------------------------------------------------------
+# partition health (pio status / pio wal inspect)
+# ---------------------------------------------------------------------------
+
+def partition_health(events_dir: str) -> dict:
+    """Health of one JSONL namespace dir for ``pio status`` /
+    ``pio wal inspect``: per-log rows (file size, lease holder/epoch
+    with staleness, last compaction) plus the dir-level quarantine
+    count. WAL state rides separately (``ingest_wal.inspect``)."""
+    out = {"logs": [], "quarantinedFiles": 0}
+    if not os.path.isdir(events_dir):
+        return out
+    qdir = os.path.join(events_dir, QUARANTINE_DIR)
+    out["quarantinedFiles"] = (
+        len(os.listdir(qdir)) if os.path.isdir(qdir) else 0)
+    for name in sorted(os.listdir(events_dir)):
+        if not name.endswith(".jsonl"):
+            continue
+        path = os.path.join(events_dir, name)
+        stem = name[:-6]
+        partition = None
+        if ".p" in stem:
+            _stem_base, _, suffix = stem.rpartition(".p")
+            if suffix.isdigit():
+                partition = int(suffix)
+        manifest = _read_manifest(path)
+        lease = (lease_info(events_dir, partition)
+                 if partition is not None else None)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        out["logs"].append({
+            "log": name,
+            "partition": partition,
+            "bytes": size,
+            "lease": lease,
+            "lastCompaction": (manifest or {}).get("compactedAt"),
+            "compactedEvents": (manifest or {}).get("events"),
+            "compactedBytes": (manifest or {}).get("covered"),
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# multi-worker event serving (front listener + supervised workers)
+# ---------------------------------------------------------------------------
+
+async def _pipe(reader: asyncio.StreamReader,
+                writer: asyncio.StreamWriter) -> None:
+    """One splice direction. EOF half-closes the peer (write_eof) —
+    a client that shuts down its write side after the request must
+    still receive the response on the other direction; the full close
+    happens in _handle once BOTH directions are done."""
+    try:
+        while True:
+            chunk = await reader.read(65536)
+            if not chunk:
+                break
+            writer.write(chunk)
+            await writer.drain()
+        if writer.can_write_eof():
+            writer.write_eof()
+    except (ConnectionError, asyncio.IncompleteReadError, OSError):
+        try:
+            writer.close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+
+class FrontProxy:
+    """Connection-level (L4) front listener: each accepted client
+    connection is spliced to one worker, chosen round-robin among the
+    backends that accept a connect. No HTTP parsing on the hot path —
+    keep-alive clients naturally spread across workers, and a worker
+    mid-restart is skipped (its connections land on the survivors)."""
+
+    def __init__(self, worker_ports: list[int],
+                 host: str = "127.0.0.1"):
+        self.worker_ports = worker_ports
+        self.worker_host = host
+        self._rr = 0
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def _connect_backend(self):
+        n = len(self.worker_ports)
+        for i in range(n):
+            port = self.worker_ports[(self._rr + i) % n]
+            try:
+                r, w = await asyncio.open_connection(self.worker_host, port)
+            except OSError:
+                continue
+            self._rr = (self._rr + i + 1) % n
+            return r, w
+        return None
+
+    async def _handle(self, creader, cwriter) -> None:
+        backend = await self._connect_backend()
+        if backend is None:
+            cwriter.close()
+            return
+        breader, bwriter = backend
+        await asyncio.gather(_pipe(creader, bwriter),
+                             _pipe(breader, cwriter))
+        for w in (bwriter, cwriter):
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    async def start(self, host: str, port: int) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, host, port, reuse_address=True)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+def worker_env(idx: int, port: int, wal_dir: Optional[str]) -> dict:
+    """Env overrides one event worker runs under: its partition
+    identity, its private listen port, and (when the WAL is armed) its
+    OWN WAL subdirectory — per-partition WAL dirs keep the dir flock,
+    replay, and segment lifecycle single-owner. (The worker COUNT
+    arrives as ``PIO_NUM_PROCESSES`` from the supervisor.)"""
+    env = {
+        "PIO_EVENT_PARTITION": str(idx),
+        "PIO_EVENT_WORKER_PORT": str(port),
+    }
+    if wal_dir:
+        env["PIO_WAL_DIR"] = os.path.join(wal_dir, f"p{idx}")
+    return env
+
+
+def run_partitioned_event_server(host: str, port: int, workers: int,
+                                 enable_stats: bool = False) -> int:
+    """Blocking entry for ``pio eventserver --workers N``: spawn N
+    supervised worker processes (disjoint partitions, per-worker
+    restart) and splice client connections to them.
+
+    Chaos hook: ``PIO_EVENT_WORKER_FAULT_SPEC`` is applied as each
+    worker's ``PIO_FAULT_SPEC`` on the FIRST launch only — a restarted
+    worker comes up clean, so an injected crash can't relaunch-loop."""
+    from . import ingest_wal
+    from ...parallel.supervisor import Supervisor
+
+    wal_cfg = ingest_wal.WalConfig.from_env()
+    if wal_cfg.enabled and os.path.isdir(wal_cfg.dir):
+        # a previous SINGLE-process deployment (or `pio import`-era
+        # crash) may have left segments at the WAL root; workers only
+        # ever replay their own p<i> subdirs, so the front replays the
+        # root once before they start — same storage-down semantics as
+        # the event server's startup recovery (log, serve, operator
+        # runs `pio wal replay` later).
+        try:
+            from ..storage.registry import Storage
+
+            recovered = ingest_wal.recover(Storage.instance(), wal_cfg)
+            if recovered["replayed"] or recovered["deduped"]:
+                log.info("front replayed %d pre-partitioning WAL "
+                         "event(s) (%d deduped)", recovered["replayed"],
+                         recovered["deduped"])
+        except Exception:  # noqa: BLE001 — serve; operator replays
+            log.exception("root WAL recovery failed; run `pio wal "
+                          "replay` once storage is healthy")
+    ports = [Supervisor._free_port() for _ in range(workers)]
+    base_env = dict(os.environ)
+    chaos = base_env.pop("PIO_EVENT_WORKER_FAULT_SPEC", None)
+    base_env.pop("PIO_EVENT_WORKERS", None)
+
+    def env_for(attempt: int, idx: int) -> dict:
+        if attempt > 0:
+            # the original port pick is a TOCTOU (probe socket closed
+            # before the worker binds): a stolen port must not turn
+            # into a crash-loop that burns the restart budget — each
+            # respawn re-picks, and the front routes off the live list
+            ports[idx] = Supervisor._free_port()
+        env = worker_env(idx, ports[idx],
+                         wal_cfg.dir if wal_cfg.enabled else None)
+        if chaos and attempt == 0:
+            env["PIO_FAULT_SPEC"] = chaos
+        return env
+
+    argv = [sys.executable, "-m",
+            "incubator_predictionio_tpu.tools.console", "eventserver",
+            "--worker"]
+    if enable_stats:
+        argv.append("--stats")
+    sup = Supervisor(argv, workers, env=base_env, per_worker_env=env_for,
+                     wire_coordinator=False, restart_scope="worker",
+                     resume_argv=())
+    sup_done = threading.Event()
+    outcome = {}
+
+    def run_sup():
+        try:
+            outcome["state"] = sup.run()
+        finally:
+            sup_done.set()
+
+    t = threading.Thread(target=run_sup, daemon=True)
+    t.start()
+    log.info("partitioned event server: front on %s:%d, %d worker(s) "
+             "on ports %s (run dir %s)", host, port, workers, ports,
+             sup.run_dir)
+
+    async def front_main() -> None:
+        proxy = FrontProxy(ports)
+        await proxy.start(host, port)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        import signal as _signal
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except (NotImplementedError, RuntimeError):  # pragma: no cover
+                pass
+        # the front lives exactly as long as its workers: a supervisor
+        # that gave up (restart budget exhausted) must take the front
+        # down rather than keep accepting connections nothing can serve
+        while not stop.is_set() and not sup_done.is_set():
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=0.25)
+            except asyncio.TimeoutError:
+                pass
+        await proxy.stop()
+        sup.request_stop()
+
+    asyncio.run(front_main())
+    sup_done.wait(timeout=60)
+    t.join(timeout=5)
+    state = outcome.get("state", "drained")
+    log.info("partitioned event server stopped (%s)", state)
+    return 0 if state in ("drained", "completed") else 1

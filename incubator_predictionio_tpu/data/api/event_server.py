@@ -34,7 +34,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from ...common import ssl_context_from_env, telemetry
+from ...common import envknobs, ssl_context_from_env, telemetry
 from ...common.resilience import CircuitOpenError, retry_after_jitter
 from ...workflow.plugins import EventServerPluginContext
 from ..storage.base import AccessKey
@@ -79,6 +79,26 @@ class EventServer:
         # backend's circuit breaker is open or the ingest buffer is full
         # (reported on GET /)
         self._shed_count = 0
+        # partitioned event log (data/api/event_log.py): a multi-worker
+        # deployment gives each worker PIO_EVENT_PARTITION=i. Claim the
+        # partition lease FIRST — before WAL replay, before serving —
+        # so everything this process ever writes (replay included) runs
+        # under fenced ownership. A held lease raises and the worker
+        # exits: the supervisor's backoff retries until the previous
+        # owner is gone.
+        self.lease = None
+        part = os.environ.get("PIO_EVENT_PARTITION", "").strip()
+        if part.isdigit():
+            from . import event_log
+
+            le = self.storage.get_l_events()
+            log_dir = getattr(le, "_dir", None)
+            if log_dir is not None:
+                self.lease = event_log.claim_partition(log_dir, int(part))
+            else:
+                log.warning(
+                    "PIO_EVENT_PARTITION=%s but the event store is not a "
+                    "JSONL log; partition fencing disabled", part)
         # crash durability (PIO_WAL=1): BEFORE serving, replay any
         # uncommitted write-ahead-log records a previous process left
         # behind (kill -9 mid-group), deduped by event_id against what
@@ -102,9 +122,20 @@ class EventServer:
             wal = ingest_wal.IngestWal(wal_config)
         # write-behind group commit: every write handler feeds this
         # buffer; the flusher coalesces concurrent requests into one
-        # insert_batch/append per (app, channel) group
+        # insert_batch/append per (app, channel) group. The partition
+        # lease rides along: its epoch is verified before every write
+        # group, so a fenced worker cannot land a byte.
         self.ingest = IngestBuffer(self.storage, self.stats, self.plugins,
-                                   IngestConfig.from_env(), wal=wal)
+                                   IngestConfig.from_env(), wal=wal,
+                                   lease=self.lease)
+        # background compaction (PIO_COMPACT_INTERVAL_MS > 0): rewrite
+        # this worker's own log shards into columnar snapshots so train
+        # scans skip the JSON re-parse; scrub once at startup.
+        self._compact_interval = envknobs.env_float(
+            "PIO_COMPACT_INTERVAL_MS", 0.0, lo=0.0) / 1000.0
+        self._compact_min_bytes = envknobs.env_int(
+            "PIO_COMPACT_MIN_BYTES", 1 << 20, lo=0)
+        self._bg_tasks: list = []
         # telemetry: per-instance stats counters join the process-wide
         # registry exposition via a collector (replaced per instance —
         # the LIVE server's counters are what /metrics shows)
@@ -114,6 +145,7 @@ class EventServer:
             client_max_size=16 * 1024 * 1024,
             middlewares=[self._shed_middleware,
                          telemetry.trace_middleware()])
+        self.app.on_startup.append(self._start_background)
         self.app.on_shutdown.append(self._drain_ingest)
         self.app.add_routes(
             [
@@ -163,11 +195,65 @@ class EventServer:
                          str(retry_after_jitter(e.retry_after))},
             )
 
+    # -- background tasks (worker heartbeat, compaction) -------------------
+    async def _start_background(self, app) -> None:
+        if os.environ.get("PIO_WORKER_HEARTBEAT_FILE"):
+            self._bg_tasks.append(
+                asyncio.get_running_loop().create_task(
+                    self._heartbeat_loop()))
+        if self._compact_interval > 0:
+            self._bg_tasks.append(
+                asyncio.get_running_loop().create_task(
+                    self._compact_loop()))
+
+    async def _heartbeat_loop(self) -> None:
+        """Supervised worker liveness: touch the heartbeat file so a
+        wedged event loop (not just a dead process) is detected and the
+        worker relaunched (parallel/supervisor.py, worker scope)."""
+        from ...parallel import supervisor
+
+        # env_ms returns SECONDS; beat at half the configured period
+        interval = max(0.05, envknobs.env_ms(
+            "PIO_WORKER_HEARTBEAT_MS", 1000.0, lo_ms=20.0) / 2.0)
+        while True:
+            supervisor.beat()
+            await asyncio.sleep(interval)
+
+    async def _compact_loop(self) -> None:
+        from . import event_log
+
+        le = self.storage.get_l_events()
+        log_dir = getattr(le, "_dir", None)
+        if log_dir is None:
+            return
+        # startup scrub: corrupt snapshots are quarantined NOW, not on
+        # the first unlucky scan
+        report = await asyncio.to_thread(event_log.scrub_log_dir, log_dir)
+        if report["quarantined"]:
+            log.warning("event-log scrub quarantined %d snapshot(s)",
+                        report["quarantined"])
+        part = self.lease.partition if self.lease is not None else None
+        own_suffix = f".p{part}.jsonl" if part is not None else ".jsonl"
+        while True:
+            await asyncio.sleep(self._compact_interval)
+            try:
+                for name in sorted(os.listdir(log_dir)):
+                    if not name.endswith(own_suffix):
+                        continue
+                    await asyncio.to_thread(
+                        event_log.compact_log,
+                        os.path.join(log_dir, name),
+                        self._compact_min_bytes)
+            except Exception:  # noqa: BLE001 — compaction must not die
+                log.exception("background compaction pass failed")
+
     async def _drain_ingest(self, app) -> None:
         """Shutdown: drain the buffer, then ALWAYS release the cached
         file handles (JSONL append handles, WAL segments) — a drain
         that raises must not leak open fds or keep a WAL segment from
         a clean last fsync."""
+        for t in self._bg_tasks:
+            t.cancel()
         try:
             await self.ingest.drain()
         finally:
@@ -182,6 +268,8 @@ class EventServer:
                     self.ingest.wal.close()
                 except Exception:  # noqa: BLE001 — best-effort on shutdown
                     log.exception("WAL close failed on shutdown")
+            if self.lease is not None:
+                self.lease.release()
 
     # -- auth -------------------------------------------------------------
     def _access_key_str(self, request: web.Request) -> Optional[str]:
@@ -270,6 +358,8 @@ class EventServer:
     # -- handlers ---------------------------------------------------------
     async def handle_root(self, request: web.Request) -> web.Response:
         out = {"status": "alive"}
+        if self.lease is not None:
+            out["partition"] = self.lease.partition
         if self._shed_count:
             out["shedRequests"] = self._shed_count
         snap = self.ingest.snapshot()

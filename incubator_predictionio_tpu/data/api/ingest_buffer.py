@@ -67,9 +67,11 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import errno
 import json
 import logging
 import os
+import time
 from collections import Counter
 from typing import Optional, Sequence
 
@@ -103,6 +105,11 @@ _M_DEFERRED = telemetry.registry().counter(
     "pio_wal_deferred_events_total",
     "Enqueue-acked events whose group commit failed but which remain "
     "in the WAL for the next recovery pass (not lost)").labels()
+_M_APPEND_ERRORS = telemetry.registry().counter(
+    "pio_ingest_append_errors_total",
+    "OSErrors raised by a WAL/event-log append, by errno class; "
+    "resource-exhaustion kinds flip the partition to shed mode",
+    ("kind",))
 
 Key = tuple[int, Optional[int]]
 
@@ -114,6 +121,46 @@ class IngestOverloadError(RuntimeError):
     def __init__(self, message: str, retry_after: float = 1.0):
         super().__init__(message)
         self.retry_after = retry_after
+
+
+class AppendShedError(IngestOverloadError):
+    """A WAL/event-log append failed with a resource-exhaustion
+    OSError (disk full, quota, read-only remount, I/O error). The
+    partition flips to *shed mode*: clients get 503 + jittered
+    Retry-After (they own the retry — same contract as a full buffer)
+    instead of a generic 500, and further appends are refused for a
+    doubling backoff window so a full disk isn't hammered into a
+    corrupt log tail."""
+
+    def __init__(self, message: str, kind: str, retry_after: float):
+        super().__init__(message, retry_after=retry_after)
+        self.kind = kind
+
+
+#: errno → counter label; membership also defines which append
+#: failures flip the partition into shed mode (``AppendShedError``).
+#: Everything here is "the disk/filesystem said no", where retrying
+#: immediately cannot succeed and blind retries risk a corrupt tail.
+_SHED_ERRNOS = {
+    errno.ENOSPC: "enospc",
+    errno.EDQUOT: "edquot",
+    errno.EROFS: "erofs",
+    errno.EIO: "eio",
+    errno.EMFILE: "emfile",
+    errno.ENFILE: "enfile",
+}
+
+
+def classify_append_error(e: BaseException) -> Optional[str]:
+    """Kind label for an append-path OSError, or None for non-disk
+    failures. ConnectionErrors are excluded even though they subclass
+    OSError — a torn socket to a remote backend is the retry/breaker
+    layer's business, not a local disk fault."""
+    if not isinstance(e, OSError) or isinstance(e, ConnectionError):
+        return None
+    if e.errno is None:  # URLError/timeout wrappers: not a disk fault
+        return None
+    return _SHED_ERRNOS.get(e.errno, "oserr")
 
 
 class ForbiddenEventError(PermissionError):
@@ -236,22 +283,35 @@ class IngestBuffer:
     """Per-key write-behind queues + flusher tasks over one storage."""
 
     def __init__(self, storage, stats, plugins,
-                 config: Optional[IngestConfig] = None, wal=None):
+                 config: Optional[IngestConfig] = None, wal=None,
+                 lease=None):
         self.storage = storage
         self.stats = stats
         self.plugins = plugins
         self.config = config or IngestConfig.from_env()
         self.wal = wal            # IngestWal or None (PIO_WAL off)
+        # partition lease (event_log.Lease) in multi-worker mode: its
+        # epoch is re-verified before EVERY write group and every
+        # pre-ack WAL append, so a fenced worker structurally cannot
+        # land a byte after losing ownership
+        self.lease = lease
         self._keys: dict[Key, _KeyState] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._pending = 0
         self._draining = False
+        # disk-fault shed mode: key -> (monotonic shed-until, streak);
+        # written from commit threads, read from the loop — values are
+        # immutable tuples so torn reads are impossible under the GIL
+        self._shed: dict[Key, tuple[float, int]] = {}
+        self._shed_window = envknobs.env_float(
+            "PIO_INGEST_SHED_MS", 5000.0, lo=100.0) / 1000.0
         # observability (GET / and tests)
         self.groups_committed = 0
         self.events_committed = 0
         self.max_group = 0
         self.dropped = 0
         self.deferred = 0         # enqueue-acked, commit failed, in WAL
+        self.shed_appends = 0     # requests refused while in shed mode
 
     @property
     def ack_on_enqueue(self) -> bool:
@@ -283,6 +343,14 @@ class IngestBuffer:
             "maxGroup": self.max_group,
             "droppedEvents": self.dropped,
         }
+        if self.shed_appends or self._shed:
+            now = time.monotonic()
+            out["shedAppends"] = self.shed_appends
+            # list() first: commit threads insert/pop keys concurrently
+            out["shedding"] = sum(
+                1 for until, _ in list(self._shed.values()) if until > now)
+        if self.lease is not None:
+            out["lease"] = self.lease.to_json()
         if self.wal is not None:
             out["deferredEvents"] = self.deferred
             out["wal"] = self.wal.snapshot()
@@ -299,19 +367,47 @@ class IngestBuffer:
             self._pending = 0
             self._draining = False
 
-    def _admit(self, n: int) -> None:
+    def _admit(self, n: int, key: Optional[Key] = None) -> None:
         if self._draining:
             raise IngestOverloadError("event server is shutting down")
+        if key is not None:
+            shed = self._shed.get(key)
+            if shed is not None:
+                remaining = shed[0] - time.monotonic()
+                if remaining > 0:
+                    self.shed_appends += 1
+                    raise AppendShedError(
+                        "event log partition is shedding writes after a "
+                        "disk error; retry later", kind="shed",
+                        retry_after=max(1.0, remaining))
         if self._pending + n > self.config.max_pending:
             raise IngestOverloadError(
                 f"ingest buffer full ({self._pending} events pending); "
                 "retry later",
                 retry_after=max(1.0, self.config.group_ms / 1000.0))
 
+    def _note_append_error(self, key: Key, kind: str) -> float:
+        """Flip (or extend) shed mode for this key after a disk-class
+        append failure; returns the window length. Doubling backoff,
+        capped at 60s — a recovered disk is probed by the first request
+        after the window (half-open, breaker style)."""
+        prev = self._shed.get(key)
+        streak = (prev[1] + 1) if prev is not None else 0
+        window = min(60.0, self._shed_window * (2.0 ** streak))
+        self._shed[key] = (time.monotonic() + window, streak)
+        _M_APPEND_ERRORS.labels(kind).inc()
+        log.error("append failed (%s) for %s: shedding writes for "
+                  "%.1fs", kind, key, window)
+        return window
+
+    def _note_append_ok(self, key: Key) -> None:
+        if self._shed:
+            self._shed.pop(key, None)
+
     def _enqueue(self, key: Key, entry: _Pending, admit: bool = True) -> None:
         self._bind_loop()
         if admit:
-            self._admit(entry.n)
+            self._admit(entry.n, key)
         st = self._keys.get(key)
         if st is None:
             st = self._keys[key] = _KeyState()
@@ -375,7 +471,7 @@ class IngestBuffer:
         eid = event.event_id or new_event_id()
         entry = _Pending(_EVENT, event, body=body, ids=[eid])
         self._bind_loop()
-        self._admit(1)
+        self._admit(1, key)
         if self.wal is None or not self.wal.fsyncs_on_commit:
             self._wal_append_entry(key, entry)
         else:
@@ -408,13 +504,26 @@ class IngestBuffer:
     def _wal_append_entry(self, key: Key, entry: _Pending) -> None:
         """WAL-append one pre-validated entry ahead of its ack. Stashes
         the canonical line on the entry so the later storage commit
-        appends the byte-identical record the WAL holds."""
+        appends the byte-identical record the WAL holds. Fenced-lease
+        and disk-fault failures surface as the 503 shed contract (the
+        ack was never sent — the client owns the retry)."""
         if self.wal is None:
             return
+        if self.lease is not None:
+            self.lease.verify()
         d = entry.payload.to_json()
         d["eventId"] = entry.ids[0]
         entry.wal_line = json.dumps(d).encode("utf-8") + b"\n"
-        entry.lsns = [self.wal.append_events(key, entry.wal_line, 1)]
+        try:
+            entry.lsns = [self.wal.append_events(key, entry.wal_line, 1)]
+        except Exception as e:  # noqa: BLE001 — classify disk faults
+            kind = classify_append_error(e)
+            if kind is None:
+                raise
+            window = self._note_append_error(key, kind)
+            raise AppendShedError(
+                f"WAL append failed ({kind}): {e}", kind=kind,
+                retry_after=window) from e
 
     async def ingest_events(self, events_bodies: Sequence[tuple],
                             access_key, channel_id) -> list[str]:
@@ -586,6 +695,13 @@ class IngestBuffer:
         not block (raises :class:`_WouldBlock` — nothing persisted, no
         stats recorded — and the caller retries off-loop)."""
         app_id, channel_id = key
+        if self.lease is not None:
+            # fenced ownership: verify the partition lease epoch BEFORE
+            # any WAL or store byte can land. A stale epoch raises
+            # PartitionFencedError for the whole group — the 503 shed
+            # contract — making split-brain writes structurally
+            # impossible rather than merely unlikely.
+            self.lease.verify()
         le = self.storage.get_l_events()
         supports_lines = hasattr(le, "insert_canonical_lines")
         wal_on = self.wal is not None
@@ -758,6 +874,17 @@ class IngestBuffer:
                 raise  # nothing persisted, no stats: safe to retry
             except Exception as e:  # noqa: BLE001 — surfaced per request
                 storage_error = e
+                kind = classify_append_error(e)
+                if kind is not None:
+                    # disk-class fault (ENOSPC/EIO/...): flip the key to
+                    # shed mode and report 503 + Retry-After instead of
+                    # a generic 500 — the client owns the retry, and
+                    # hammering a full disk risks a corrupt tail
+                    window = self._note_append_error(key, kind)
+                    storage_error = AppendShedError(
+                        f"event log append failed ({kind}): {e}",
+                        kind=kind, retry_after=window)
+                    storage_error.__cause__ = e
             if storage_error is not None:
                 if wal_on and group_lsn is not None:
                     # every event in the group frame belongs to a request
@@ -773,6 +900,7 @@ class IngestBuffer:
                 for pos in committed:
                     results[pos] = storage_error
             else:
+                self._note_append_ok(key)
                 if wal_on:
                     try:
                         fault_point("wal.mark")

@@ -19,7 +19,11 @@ restart.
 Frame format (one segment file = a sequence of frames, no header; the
 file name carries the sequence number):
 
-    <kind:u8> <payload_len:u32> <lsn:u64> <crc32(payload):u32> <payload>
+    <kind:u8> <payload_len:u32> <lsn:u64> <crc32:u32> <payload>
+
+(the CRC covers the header prefix AND the payload, so a flipped kind
+or LSN byte reads as corruption rather than a valid frame with the
+wrong identity)
 
 - kind ``E`` — payload is one or more newline-terminated canonical
   event lines (the exact bytes the JSONL store appends); ``lsn`` is the
@@ -62,7 +66,8 @@ log = logging.getLogger("pio.wal")
 
 Key = tuple[int, Optional[int]]
 
-_FRAME = struct.Struct("<BIQI")  # kind, payload_len, lsn, crc32(payload)
+_FRAME = struct.Struct("<BIQI")  # kind, payload_len, lsn, crc32
+_HEAD = struct.Struct("<BIQ")    # the CRC-covered header prefix
 K_EVENTS, K_COMMIT, K_ABORT = 0x45, 0x43, 0x58  # 'E', 'C', 'X'
 _KINDS = (K_EVENTS, K_COMMIT, K_ABORT)
 
@@ -83,6 +88,36 @@ _M_DISCARDED = telemetry.registry().counter(
     "pio_wal_discarded_bytes_total",
     "Torn-tail bytes discarded from WAL segments at recovery "
     "(CRC-checked suffix)").labels()
+_M_QUARANTINED = telemetry.registry().counter(
+    "pio_eventlog_quarantined_segments_total",
+    "Corrupt event-log segments quarantined (moved aside, never "
+    "deleted) by recovery or the scrubber", ("kind",))
+
+#: subdirectory (of a WAL key dir or a JSONL log dir) where corrupt
+#: segments are MOVED — never deleted — for operator forensics
+QUARANTINE_DIR = "quarantine"
+
+
+def quarantine_path(path: str, kind: str) -> Optional[str]:
+    """Move a corrupt segment/snapshot into its directory's quarantine
+    subdir (never delete — the bytes are the only forensic record of
+    what the corruption ate). Returns the new path, or None when the
+    move itself failed (the file is left in place and the caller must
+    keep treating it as corrupt)."""
+    qdir = os.path.join(os.path.dirname(path), QUARANTINE_DIR)
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, os.path.basename(path))
+        if os.path.exists(dest):  # re-quarantine after a crashed pass
+            dest = f"{dest}.{os.getpid()}"
+        os.replace(path, dest)
+    except OSError:
+        log.exception("could not quarantine corrupt segment %s", path)
+        return None
+    _M_QUARANTINED.labels(kind).inc()
+    log.warning("quarantined corrupt %s segment: %s -> %s",
+                kind, path, dest)
+    return dest
 
 
 def _env_flag(name: str) -> bool:
@@ -180,41 +215,140 @@ def parse_key_dirname(name: str) -> Optional[Key]:
     return None
 
 
+class SegmentDecode:
+    """Result of decoding one segment: events ``[(lsn, payload)]`` in
+    append order, committed/aborted LSN sets, bytes discarded as
+    corrupt/torn, and whether any VALID frame was found after a corrupt
+    region (``resynced`` — evidence of mid-file corruption rather than
+    the ordinary torn tail a crash leaves)."""
+
+    __slots__ = ("events", "committed", "aborted", "discarded", "resynced")
+
+    def __init__(self):
+        self.events: list[tuple[int, bytes]] = []
+        self.committed: set[int] = set()
+        self.aborted: set[int] = set()
+        self.discarded = 0
+        self.resynced = False
+
+
+def _frame_at(buf: bytes, off: int, legacy: bool = False):
+    """Try to decode one frame at ``off``; returns
+    ``(kind, lsn, payload, next_off)`` or None. Validates kind, length
+    bounds, marker-length alignment (a flipped kind byte must not turn
+    an E payload into a short-read struct error), and the CRC — never
+    raises. ``legacy=True`` checks the pre-ISSUE-8 payload-only CRC
+    (segments written by an older build; see :func:`decode_buffer`)."""
+    n = len(buf)
+    if off + _FRAME.size > n:
+        return None
+    kind, plen, lsn, crc = _FRAME.unpack_from(buf, off)
+    start = off + _FRAME.size
+    if kind not in _KINDS or start + plen > n:
+        return None
+    if kind != K_EVENTS and plen % 8 != 0:
+        return None  # marker payloads are packed u64 arrays
+    payload = buf[start:start + plen]
+    want = zlib.crc32(payload) if legacy \
+        else _frame_crc(kind, plen, lsn, payload)
+    if want != crc:
+        return None
+    return kind, lsn, payload, start + plen
+
+
+def decode_buffer(buf: bytes, resync: bool = False) -> SegmentDecode:
+    """Decode a segment buffer. Contract (fuzz-tested): NEVER raises,
+    and never yields a record that fails its CRC — any truncation, bit
+    flip, or garbage between frames is counted in ``discarded``.
+
+    ``resync=False`` (the active-writer view): decoding stops at the
+    first bad frame — appends are sequential, so on a healthy disk
+    corruption can only be a torn suffix. ``resync=True`` (the recovery
+    / scrubber view): after a bad frame the decoder scans forward for
+    the next offset that holds a complete CRC-valid frame and resumes,
+    salvaging records past a bit-flipped region; ``resynced`` is set so
+    the caller can quarantine the segment instead of deleting it.
+
+    Format compatibility: ISSUE 8 extended the frame CRC to cover the
+    header (a flipped kind/LSN byte must read as corruption, not as a
+    valid frame with the wrong identity). Segments left behind by an
+    OLDER build carry payload-only CRCs — a crashed server upgraded
+    in place must still replay them, or every pre-upgrade acked event
+    silently vanishes. A segment is written by exactly one build, so
+    the format is locked in by the FIRST frame that validates under
+    either CRC (not just the frame at offset 0 — a corrupt first frame
+    in a legacy segment must not condemn the intact rest)."""
+    out = SegmentDecode()
+    off, n = 0, len(buf)
+    legacy: Optional[bool] = None  # unknown until a frame validates
+
+    def frame_at(o: int):
+        nonlocal legacy
+        if legacy is not None:
+            return _frame_at(buf, o, legacy)
+        got = _frame_at(buf, o)
+        if got is not None:
+            legacy = False
+            return got
+        got = _frame_at(buf, o, legacy=True)
+        if got is not None:
+            legacy = True
+        return got
+
+    while off < n:
+        got = frame_at(off)
+        if got is None:
+            if not resync:
+                break
+            nxt = off + 1
+            while nxt < n:
+                if buf[nxt] in _KINDS and frame_at(nxt) is not None:
+                    break
+                nxt += 1
+            if nxt >= n:
+                break
+            out.discarded += nxt - off
+            out.resynced = True
+            off = nxt
+            continue
+        kind, lsn, payload, off = got
+        if kind == K_EVENTS:
+            out.events.append((lsn, payload))
+        else:
+            dest = out.committed if kind == K_COMMIT else out.aborted
+            dest.update(struct.unpack(f"<{len(payload) // 8}Q", payload))
+    out.discarded += n - off
+    return out
+
+
+def decode_segment(path: str, resync: bool = False) -> SegmentDecode:
+    with open(path, "rb") as f:
+        return decode_buffer(f.read(), resync=resync)
+
+
 def read_segment(path: str):
-    """Decode one segment file.
+    """Decode one segment file (compat 4-tuple view of
+    :func:`decode_segment`, no resync).
 
     Returns ``(events, committed, aborted, discarded_bytes)`` where
     ``events`` is ``[(lsn, payload_bytes)]`` in append order and
     ``committed``/``aborted`` are LSN sets from the markers. Any torn
     tail (short header, short/garbled payload) is counted in
     ``discarded_bytes`` and ignored — never raised."""
-    with open(path, "rb") as f:
-        buf = f.read()
-    events: list[tuple[int, bytes]] = []
-    committed: set[int] = set()
-    aborted: set[int] = set()
-    off, n = 0, len(buf)
-    while True:
-        if off + _FRAME.size > n:
-            break
-        kind, plen, lsn, crc = _FRAME.unpack_from(buf, off)
-        start = off + _FRAME.size
-        if kind not in _KINDS or start + plen > n:
-            break
-        payload = buf[start:start + plen]
-        if zlib.crc32(payload) != crc:
-            break
-        if kind == K_EVENTS:
-            events.append((lsn, payload))
-        else:
-            dest = committed if kind == K_COMMIT else aborted
-            dest.update(struct.unpack(f"<{plen // 8}Q", payload))
-        off = start + plen
-    return events, committed, aborted, n - off
+    d = decode_segment(path)
+    return d.events, d.committed, d.aborted, d.discarded
+
+
+def _frame_crc(kind: int, plen: int, lsn: int, payload: bytes) -> int:
+    """CRC over header AND payload: a bit flip in the kind or LSN
+    fields must read as corruption, not as a differently-numbered valid
+    record (replay accounting is keyed on LSNs — fuzz-tested)."""
+    return zlib.crc32(payload, zlib.crc32(_HEAD.pack(kind, plen, lsn)))
 
 
 def _frame(kind: int, lsn: int, payload: bytes) -> bytes:
-    return _FRAME.pack(kind, len(payload), lsn, zlib.crc32(payload)) + payload
+    return _FRAME.pack(kind, len(payload), lsn,
+                       _frame_crc(kind, len(payload), lsn, payload)) + payload
 
 
 class _Segment:
@@ -302,14 +436,17 @@ class IngestWal:
             kw.segments[seq] = _Segment(path, frozen=True)
             kw.next_seq = max(kw.next_seq, seq + 1)
             try:
-                events, com, ab, _d = read_segment(path)
+                # resync=True: even records past a corrupt region count
+                # toward the LSN floor — reusing one of their LSNs would
+                # make replay silently skip the new record
+                d = decode_segment(path, resync=True)
                 # bootstrap past marker LSN sets too, not just surviving
                 # E-frames: a committed segment may be deleted while its
                 # marker lives on in a later one — reusing an LSN a stale
                 # marker covers would make replay silently skip the new
                 # record (acked-event loss)
-                top = max(lsn for lsn, _ in events) if events else 0
-                for marked in (com, ab):
+                top = max(lsn for lsn, _ in d.events) if d.events else 0
+                for marked in (d.committed, d.aborted):
                     if marked:
                         top = max(top, max(marked))
                 kw.next_lsn = max(kw.next_lsn, top + 1)
@@ -479,14 +616,16 @@ class IngestWal:
 # recovery / inspection
 # ---------------------------------------------------------------------------
 
-def _scan_key_dir(dirpath: str):
+def _scan_key_dir(dirpath: str, resync: bool = True):
     """Aggregate every segment of one key directory (seq order).
 
-    Returns ``(uncommitted, n_committed, n_aborted, discarded, paths)``
-    — ``uncommitted`` is ``[(lsn, payload)]`` in LSN order: E-records
-    covered by neither a commit nor an abort marker anywhere in the
-    key's WAL (markers may land in a later segment than their
-    records)."""
+    Returns ``(uncommitted, n_committed, n_aborted, discarded, paths,
+    corrupt)`` — ``uncommitted`` is ``[(lsn, payload)]`` in LSN order:
+    E-records covered by neither a commit nor an abort marker anywhere
+    in the key's WAL (markers may land in a later segment than their
+    records). ``corrupt`` lists segment paths with MID-FILE corruption
+    (valid frames found past a bad region — bit rot, not the ordinary
+    crash-torn tail): recovery quarantines those instead of deleting."""
     seqs = []
     for name in os.listdir(dirpath):
         if name.endswith(".wal"):
@@ -500,59 +639,105 @@ def _scan_key_dir(dirpath: str):
     aborted: set[int] = set()
     discarded = 0
     paths = []
+    corrupt = []
     for _seq, name in seqs:
         path = os.path.join(dirpath, name)
         paths.append(path)
-        ev, com, ab, disc = read_segment(path)
-        events.extend(ev)
-        committed |= com
-        aborted |= ab
-        discarded += disc
+        d = decode_segment(path, resync=resync)
+        events.extend(d.events)
+        committed |= d.committed
+        aborted |= d.aborted
+        discarded += d.discarded
+        if d.resynced or (not d.events and not d.committed
+                          and not d.aborted and d.discarded > 0):
+            # mid-file corruption, OR a segment that decoded to NOTHING
+            # despite holding bytes (could be a benign partial-frame
+            # tail, could be wholesale corruption of an old-format
+            # segment — indistinguishable, so keep the forensic bytes)
+            corrupt.append(path)
     events.sort(key=lambda t: t[0])
     uncommitted = [(lsn, p) for lsn, p in events
                    if lsn not in committed and lsn not in aborted]
-    return uncommitted, len(committed), len(aborted), discarded, paths
+    return uncommitted, len(committed), len(aborted), discarded, paths, \
+        corrupt
+
+
+def _partition_subdirs(dirpath: str) -> list[tuple[int, str]]:
+    """(index, path) of multi-worker partition WAL subdirs (``p<i>``)
+    under a root WAL dir — each is flocked by its OWN worker."""
+    out = []
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return out
+    for name in names:
+        if (name.startswith("p") and name[1:].isdigit()
+                and os.path.isdir(os.path.join(dirpath, name))):
+            out.append((int(name[1:]), os.path.join(dirpath, name)))
+    out.sort()
+    return out
+
+
+def _sub_config(config: WalConfig, subdir: str) -> WalConfig:
+    return WalConfig(enabled=True, fsync=config.fsync, dir=subdir,
+                     segment_bytes=config.segment_bytes)
 
 
 def dir_is_live(config: Optional[WalConfig] = None) -> bool:
     """True when a live process (an event server) holds the WAL dir
-    flock. Its active segment is mid-write: `inspect` counts taken now
-    include in-flight records and can even show a transient "torn tail"
-    (a frame between header and payload flush) — expected on a healthy
-    server, not corruption, and `replay` would refuse anyway."""
+    flock — the root dir's, or any multi-worker partition subdir's
+    (``p<i>``, each locked by its own worker). A live dir's active
+    segment is mid-write: `inspect` counts taken now include in-flight
+    records and can even show a transient "torn tail" (a frame between
+    header and payload flush) — expected on a healthy server, not
+    corruption, and `replay` would refuse anyway."""
     config = config or WalConfig.from_env()
     if not os.path.isdir(config.dir):
         return False
-    try:
-        fd = _acquire_dir_lock(config.dir)
-    except WalLockedError:
-        return True
-    _release_dir_lock(fd)
+    for dirpath in ([config.dir]
+                    + [p for _i, p in _partition_subdirs(config.dir)]):
+        try:
+            fd = _acquire_dir_lock(dirpath)
+        except WalLockedError:
+            return True
+        _release_dir_lock(fd)
     return False
 
 
-def inspect(config: Optional[WalConfig] = None) -> list[dict]:
+def inspect(config: Optional[WalConfig] = None,
+            partition: Optional[int] = None) -> list[dict]:
     """Per-key WAL state for `pio wal inspect` / `pio status`: segment
-    count and bytes, record/uncommitted counts, torn-tail bytes."""
+    count and bytes, record/uncommitted counts, torn-tail bytes,
+    corrupt/quarantined segment counts. Recurses into multi-worker
+    partition subdirs (``p<i>``), tagging their rows."""
     config = config or WalConfig.from_env()
     out = []
     if not os.path.isdir(config.dir):
         return out
+    if partition is None:
+        for idx, sub in _partition_subdirs(config.dir):
+            out.extend(inspect(_sub_config(config, sub), partition=idx))
     for name in sorted(os.listdir(config.dir)):
         key = parse_key_dirname(name)
         dirpath = os.path.join(config.dir, name)
         if key is None or not os.path.isdir(dirpath):
             continue
-        uncommitted, n_com, n_ab, discarded, paths = _scan_key_dir(dirpath)
+        uncommitted, n_com, n_ab, discarded, paths, corrupt = \
+            _scan_key_dir(dirpath)
         n_events = sum(p.count(b"\n") for _lsn, p in uncommitted)
+        qdir = os.path.join(dirpath, QUARANTINE_DIR)
+        quarantined = (len(os.listdir(qdir)) if os.path.isdir(qdir) else 0)
         out.append({
             "appId": key[0], "channelId": key[1],
+            "partition": partition,
             "segments": len(paths),
             "bytes": sum(os.path.getsize(p) for p in paths),
             "uncommittedRecords": len(uncommitted),
             "uncommittedEvents": n_events,
             "committedRecords": n_com, "abortedRecords": n_ab,
             "tornTailBytes": discarded,
+            "corruptSegments": len(corrupt),
+            "quarantinedSegments": quarantined,
         })
     return out
 
@@ -571,7 +756,7 @@ def recover(storage, config: Optional[WalConfig] = None, stats=None,
 
     config = config or WalConfig.from_env()
     summary = {"keys": 0, "replayed": 0, "deduped": 0, "aborted": 0,
-               "discardedBytes": 0, "segmentsRemoved": 0}
+               "discardedBytes": 0, "segmentsRemoved": 0, "quarantined": 0}
     if not os.path.isdir(config.dir):
         return summary
     # a live writer (an event server holding the dir flock) makes
@@ -597,7 +782,8 @@ def _recover_locked(storage, config, summary, stats, plugins) -> dict:
         dirpath = os.path.join(config.dir, name)
         if key is None or not os.path.isdir(dirpath):
             continue
-        uncommitted, _n_com, n_ab, discarded, paths = _scan_key_dir(dirpath)
+        uncommitted, _n_com, n_ab, discarded, paths, corrupt = \
+            _scan_key_dir(dirpath)
         summary["keys"] += 1
         summary["aborted"] += n_ab
         summary["discardedBytes"] += discarded
@@ -629,6 +815,14 @@ def _recover_locked(storage, config, summary, stats, plugins) -> dict:
         _M_REPLAYED.inc(replayed)
         _M_DEDUPED.inc(deduped)
         for path in paths:
+            if path in corrupt:
+                # mid-file corruption: the salvageable records were just
+                # replayed, but the bad region may hide records we could
+                # not read — keep the raw bytes for forensics instead of
+                # deleting the evidence
+                if quarantine_path(path, "wal") is not None:
+                    summary["quarantined"] += 1
+                continue
             try:
                 os.remove(path)
                 summary["segmentsRemoved"] += 1
@@ -638,6 +832,20 @@ def _recover_locked(storage, config, summary, stats, plugins) -> dict:
             os.rmdir(dirpath)
         except OSError:
             pass
+    # multi-worker layout: each partition subdir is its own WAL (its
+    # worker's flock, its worker's replay at startup). `pio wal replay`
+    # on the ROOT replays dead partitions and skips live ones — a live
+    # worker's in-flight records are not stranded, merely not ours.
+    for idx, sub in _partition_subdirs(config.dir):
+        try:
+            sub_summary = recover(storage, _sub_config(config, sub),
+                                  stats=stats, plugins=plugins)
+        except WalLockedError:
+            log.info("WAL partition p%d is owned by a live worker; "
+                     "skipping (its startup replay owns it)", idx)
+            continue
+        for k, v in sub_summary.items():
+            summary[k] = summary.get(k, 0) + v
     if summary["replayed"] or summary["deduped"] or summary["discardedBytes"]:
         log.info("WAL recovery: %s", summary)
     return summary

@@ -28,6 +28,7 @@ from typing import Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 
+from ...common.faultinject import fault_point
 from ...native import ColumnarEvents, parse_events
 from . import base
 from .datamap import PropertyMap
@@ -102,6 +103,28 @@ class _LogScan:
             self._extend(new)
             self.size = size
             return
+        # cold (or replaced) load: a committed columnar snapshot — the
+        # event-log compactor's crash-safe rewrite of the log prefix
+        # (data/api/event_log.py) — replaces the JSON re-parse of
+        # everything it covers; only the tail appended since compaction
+        # is parsed. Verified (CRC + manifest) inside load_snapshot;
+        # any corruption quarantines the snapshot and falls back to the
+        # full parse below — slower, never wrong.
+        snap = self._try_snapshot(path)
+        if snap is not None:
+            cols, covered = snap
+            self.cols = cols
+            self.tombstones = {}
+            self._merge_tombstones(self.tombstones, cols)
+            self._reset_indexes()
+            self.size = covered
+            if size > covered:
+                with open(path, "rb") as f:
+                    f.seek(covered)
+                    tail = f.read()
+                self._extend(parse_events(tail))
+                self.size = size
+            return
         with open(path, "rb") as f:
             buf = f.read()
         self.cols = parse_events(buf)
@@ -109,6 +132,17 @@ class _LogScan:
         self._merge_tombstones(self.tombstones, self.cols)
         self._reset_indexes()
         self.size = size
+
+    @staticmethod
+    def _try_snapshot(path: str):
+        """(cols, covered_bytes) from the compacted snapshot, or None.
+        The snapshot layer must never be able to break a scan."""
+        try:
+            from ..api import event_log
+
+            return event_log.load_snapshot(path)
+        except Exception:  # noqa: BLE001 — cache layer, fall back
+            return None
 
     def _extend(self, new: ColumnarEvents) -> None:
         old = self.cols
@@ -260,6 +294,7 @@ class _TableState:
 
     def append(self, path: str, data: bytes) -> None:
         """Caller holds ``lock``."""
+        fault_point("jsonl.append")
         if self._handle is None or self._handle.path != path:
             self._handle = AppendHandle(path)
         self._handle.append(data, fsync=_fsync_enabled())
@@ -282,11 +317,49 @@ class JSONLEvents(base.LEvents):
         self._meta = threading.Lock()
         self._tables: dict[str, _TableState] = {}
         self._scans: dict[str, _LogScan] = {}
+        # partitioned event log (data/api/event_log.py): a multi-worker
+        # event server gives each worker PIO_EVENT_PARTITION=i — its
+        # appends land in its OWN shard (events_<app>[_<chan>].p<i>)
+        # while reads merge every shard, so any worker answers any
+        # query. Without the env var, behavior is byte-identical to the
+        # single-log layout.
+        part = os.environ.get("PIO_EVENT_PARTITION", "").strip()
+        self._partition = int(part) if part.isdigit() else None
+        # merged-view cache: (app, chan) -> ((paths, sizes), _LogScan)
+        self._merged: dict = {}
 
     # -- paths ------------------------------------------------------------
-    def _path(self, app_id: int, channel_id: Optional[int]) -> str:
+    def _base_path(self, app_id: int, channel_id: Optional[int]) -> str:
         suffix = f"_{channel_id}" if channel_id is not None else ""
         return os.path.join(self._dir, f"events_{app_id}{suffix}.jsonl")
+
+    def _path(self, app_id: int, channel_id: Optional[int]) -> str:
+        """The WRITE path: this process's own shard."""
+        base = self._base_path(app_id, channel_id)
+        if self._partition is None:
+            return base
+        return f"{base[:-6]}.p{self._partition}.jsonl"
+
+    def _read_paths(self, app_id: int, channel_id: Optional[int]) -> list:
+        """Every shard of this (app, channel) log on disk, base first
+        then partitions in index order — the merge order of the
+        partitioned read view."""
+        base = self._base_path(app_id, channel_id)
+        paths = [base] if os.path.exists(base) else []
+        prefix = os.path.basename(base)[:-6] + ".p"
+        parts = []
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            names = []
+        for name in names:
+            if name.startswith(prefix) and name.endswith(".jsonl"):
+                mid = name[len(prefix):-6]
+                if mid.isdigit():
+                    parts.append((int(mid), name))
+        paths.extend(os.path.join(self._dir, name)
+                     for _i, name in sorted(parts))
+        return paths
 
     def _state(self, path: str) -> _TableState:
         with self._meta:
@@ -297,11 +370,115 @@ class JSONLEvents(base.LEvents):
 
     def _scan(self, app_id: int, channel_id: Optional[int]) -> _LogScan:
         path = self._path(app_id, channel_id)
+        read_paths = self._read_paths(app_id, channel_id)
+        if read_paths and read_paths != [path]:
+            # other shards exist (multi-worker layout, or an operator
+            # reading a partitioned dir): serve the merged view
+            return self._merged_scan(app_id, channel_id, read_paths)
         state = self._state(path)
         with self._meta:
             scan = self._scans.setdefault(path, _LogScan())
         with state.lock:
             scan.refresh(path)
+            return scan
+
+    def _merged_scan(self, app_id: int, channel_id: Optional[int],
+                     paths: list) -> _LogScan:
+        """Merged view over every shard of one log, extended
+        incrementally.
+
+        Foreign shards are appended by OTHER live processes, so each is
+        consumed up to its last complete line. The cache probe is
+        stat-only; when shards grew, only their NEW bytes are parsed
+        and merged in via ``_extend`` (same remap machinery as the
+        single-log incremental refresh) — a read costs O(new bytes),
+        not O(total log). A shard that shrank (rewrite/removal) or a
+        changed shard set rebuilds from scratch.
+
+        Delete semantics in the merged view are **id-global**: a
+        tombstone kills every record of that event id, across all
+        shards and regardless of order. Positional ordering between
+        independently-appended shards is not meaningful (and deletes
+        route to an arbitrary worker), so re-inserting a previously
+        deleted explicit eventId is NOT supported here — the delete
+        wins. Single-log deployments keep exact positional semantics."""
+        key = (app_id, channel_id)
+        with self._meta:
+            entry = self._merged.get(key)
+            if entry is not None and entry["paths"] != tuple(paths):
+                entry = None  # shard set changed: rebuild
+            if entry is None:
+                entry = self._merged[key] = {
+                    "paths": tuple(paths), "parsed": None,
+                    "scan": None, "lock": threading.Lock(),
+                }
+        with entry["lock"]:
+            sizes = []
+            for p in paths:  # cache probe is stat-only
+                try:
+                    sizes.append(os.path.getsize(p))
+                except OSError:
+                    sizes.append(0)
+            parsed = entry["parsed"]
+            if parsed is not None and any(
+                    s < done for s, done in zip(sizes, parsed)):
+                parsed = None  # a shard shrank: rebuild below
+            if parsed is not None:
+                scan = entry["scan"]
+                for i, p in enumerate(paths):
+                    if sizes[i] <= parsed[i]:
+                        continue
+                    try:
+                        with open(p, "rb") as f:
+                            f.seek(parsed[i])
+                            tail = f.read()
+                    except OSError:
+                        continue
+                    cut = tail.rfind(b"\n") + 1
+                    if cut:
+                        scan._extend(parse_events(tail[:cut]))
+                        parsed[i] += cut
+            else:
+                # cold (re)build: each shard seeds from its committed
+                # columnar snapshot where one exists (same verified
+                # load the single-log refresh uses — the compactor's
+                # work is not wasted in partitioned mode), then only
+                # the uncovered tail is JSON-parsed.
+                parsed = []
+                scan = _LogScan()
+
+                def merge_piece(cols) -> None:
+                    if scan.cols is None:
+                        scan.cols = cols
+                    else:
+                        scan._extend(cols)
+
+                for p in paths:
+                    start = 0
+                    snap = _LogScan._try_snapshot(p)
+                    if snap is not None:
+                        snap_cols, start = snap[0], snap[1]
+                        merge_piece(snap_cols)
+                    try:
+                        with open(p, "rb") as f:
+                            f.seek(start)
+                            buf = f.read()
+                    except OSError:
+                        buf = b""
+                    cut = buf.rfind(b"\n") + 1
+                    if cut:
+                        merge_piece(parse_events(buf[:cut]))
+                    parsed.append(start + cut)
+                if scan.cols is None:
+                    scan.cols = parse_events(b"")
+                entry["scan"] = scan
+                entry["parsed"] = parsed
+            scan.size = sum(parsed)
+            # id-global deletes: every tombstone pins to the current
+            # end, killing all of its id's records in this view
+            n = len(scan.cols)
+            for tid in scan.cols.tombstones:
+                scan.tombstones[tid] = n
             return scan
 
     def _append(self, path: str, lines: list[str]) -> None:
@@ -350,6 +527,19 @@ class JSONLEvents(base.LEvents):
                 open(path, "a").close()
         return True
 
+    @staticmethod
+    def _remove_log_artifacts(path: str) -> None:
+        """Compaction artifacts follow their log to the grave: the
+        snapshot is a full columnar COPY of the data — leaving it
+        behind after an app-data delete would silently retain deleted
+        events on disk."""
+        try:
+            from ..api import event_log
+
+            event_log.remove_artifacts(path)
+        except Exception:  # noqa: BLE001 — deletion stays best-effort
+            pass
+
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         path = self._path(app_id, channel_id)
         state = self._state(path)
@@ -357,10 +547,46 @@ class JSONLEvents(base.LEvents):
             state.close()
             with self._meta:
                 self._scans.pop(path, None)
+                self._merged.pop((app_id, channel_id), None)
+            # foreign shards of this log go too (app deletion must not
+            # leave orphan partitions for a later app to merge in) —
+            # but NEVER a shard whose partition lease is held: its live
+            # owner has an open append handle, and unlinking under it
+            # would silently ack events into a ghost inode
+            for extra in self._read_paths(app_id, channel_id):
+                if extra == path:
+                    continue
+                stem = os.path.basename(extra)[:-6]
+                _b, _, suffix = stem.rpartition(".p")
+                if suffix.isdigit():
+                    try:
+                        from ..api import event_log
+
+                        info = event_log.lease_info(self._dir,
+                                                    int(suffix))
+                        # err to keeping: held=None means the lease
+                        # state could not be read — assume live
+                        if info is not None and info["held"] is not False:
+                            import logging
+
+                            logging.getLogger("pio.jsonl").warning(
+                                "remove(%s): shard %s is owned by a "
+                                "live worker (lease held); not "
+                                "unlinking under it", app_id, extra)
+                            continue
+                    except Exception:  # noqa: BLE001 — err to keeping
+                        continue
+                try:
+                    os.remove(extra)
+                except OSError:
+                    pass
+                self._remove_log_artifacts(extra)
             try:
                 os.remove(path)
             except OSError:
                 return False
+            finally:
+                self._remove_log_artifacts(path)
         return True
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:

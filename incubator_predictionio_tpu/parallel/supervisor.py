@@ -361,11 +361,31 @@ class Supervisor:
                  run_dir: Optional[str] = None,
                  gang_instance_id: Optional[str] = None,
                  resume_argv: Sequence[str] = ("--resume",),
-                 coordinator_host: str = "127.0.0.1"):
+                 coordinator_host: str = "127.0.0.1",
+                 wire_coordinator: bool = True,
+                 restart_scope: str = "gang"):
+        """``wire_coordinator=False`` skips the jax.distributed env
+        (``PIO_COORDINATOR_ADDRESS`` + the per-attempt port): the
+        workers are independent servers, not an SPMD gang.
+
+        ``restart_scope`` selects the recovery model. ``"gang"`` (the
+        training default): every process participates in every
+        collective, so ONE failure kills and relaunches ALL of them
+        from the checkpoint. ``"worker"`` (services — the partitioned
+        event server): workers share nothing at runtime, so a dead or
+        wedged worker is killed and relaunched INDIVIDUALLY (its
+        startup replays its own WAL partition) while the rest keep
+        serving; ``max_restarts`` is a per-worker budget, and ANY exit
+        — including rc 0 — is a failure, because a service worker has
+        no legitimate reason to stop while supervised."""
+        if restart_scope not in ("gang", "worker"):
+            raise ValueError(f"restart_scope {restart_scope!r}")
         self.worker_argv = list(worker_argv)
         self.config = config or GangConfig.from_env(num_workers)
         if num_workers is not None:
             self.config.num_workers = max(1, int(num_workers))
+        self.wire_coordinator = wire_coordinator
+        self.restart_scope = restart_scope
         self.base_env = dict(os.environ if env is None else env)
         if callable(per_worker_env):
             self._env_for = per_worker_env
@@ -418,47 +438,54 @@ class Supervisor:
 
     # -- gang lifecycle ----------------------------------------------------
 
-    def _spawn_gang(self, resume: bool) -> None:
+    def _spawn_worker(self, i: int, port: Optional[int],
+                      resume: bool, attempt: int) -> _Worker:
         cfg = self.config
-        port = self._free_port()
         argv = list(self.worker_argv)
         if resume:
             for tok in self.resume_argv:
                 if tok not in argv:
                     argv.append(tok)
-        self._workers = []
-        for i in range(cfg.num_workers):
-            hb = os.path.join(self.run_dir, f"worker_{i}.hb")
-            try:  # stall ages are measured against THIS attempt only
-                os.unlink(hb)
-            except OSError:
-                pass
-            env = {
-                **self.base_env,
-                "PIO_COORDINATOR_ADDRESS": f"{self.coordinator_host}:{port}",
-                "PIO_NUM_PROCESSES": str(cfg.num_workers),
-                "PIO_PROCESS_ID": str(i),
-                ENV_GANG_WORKER: "1",
-                ENV_HEARTBEAT_FILE: hb,
-                "PIO_WORKER_HEARTBEAT_MS": str(cfg.heartbeat_ms),
-                **self._env_for(self._attempt, i),
-            }
-            if self.gang_instance_id:
-                env[ENV_GANG_INSTANCE_ID] = self.gang_instance_id
-            log_path = os.path.join(self.run_dir, f"worker_{i}.log")
-            logf = open(log_path, "ab")
-            try:
-                proc = subprocess.Popen(
-                    argv, env=env, stdout=logf, stderr=subprocess.STDOUT)
-            finally:
-                logf.close()  # the child holds its own fd now
-            self._workers.append(
-                _Worker(i, proc, hb, log_path, time.monotonic()))
+        hb = os.path.join(self.run_dir, f"worker_{i}.hb")
+        try:  # stall ages are measured against THIS attempt only
+            os.unlink(hb)
+        except OSError:
+            pass
+        env = {
+            **self.base_env,
+            "PIO_NUM_PROCESSES": str(cfg.num_workers),
+            "PIO_PROCESS_ID": str(i),
+            ENV_GANG_WORKER: "1",
+            ENV_HEARTBEAT_FILE: hb,
+            "PIO_WORKER_HEARTBEAT_MS": str(cfg.heartbeat_ms),
+            **self._env_for(attempt, i),
+        }
+        if self.wire_coordinator and port is not None:
+            env["PIO_COORDINATOR_ADDRESS"] = \
+                f"{self.coordinator_host}:{port}"
+        if self.gang_instance_id:
+            env[ENV_GANG_INSTANCE_ID] = self.gang_instance_id
+        log_path = os.path.join(self.run_dir, f"worker_{i}.log")
+        logf = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                argv, env=env, stdout=logf, stderr=subprocess.STDOUT)
+        finally:
+            logf.close()  # the child holds its own fd now
+        return _Worker(i, proc, hb, log_path, time.monotonic())
+
+    def _spawn_gang(self, resume: bool) -> None:
+        cfg = self.config
+        port = self._free_port() if self.wire_coordinator else None
+        self._workers = [
+            self._spawn_worker(i, port, resume, self._attempt)
+            for i in range(cfg.num_workers)
+        ]
         self._event("gangStart", attempt=self._attempt, resume=resume,
                     port=port,
                     pids=[w.proc.pid for w in self._workers])
         log.info("gang attempt %d: %d worker(s) up (resume=%s, "
-                 "coordinator port %d)", self._attempt, cfg.num_workers,
+                 "coordinator port %s)", self._attempt, cfg.num_workers,
                  resume, port)
 
     def _kill_gang(self, sig: int = signal.SIGKILL) -> None:
@@ -565,10 +592,107 @@ class Supervisor:
         except OSError:
             return "<no log>"
 
+    def _check_service_failure(self) -> Optional[dict]:
+        """Per-worker failure sweep for ``restart_scope='worker'``: ANY
+        exit is a failure (a supervised service worker has no reason to
+        stop), plus the same no-first-beat / heartbeat-stall detection
+        the gang path uses."""
+        cfg = self.config
+        now = time.monotonic()
+        for w in self._workers:
+            rc = w.proc.poll()
+            if rc is not None:
+                return {"reason": "exit", "worker": w.idx, "rc": rc}
+            age = w.heartbeat_age_ms()
+            if age is None:
+                if (now - w.spawned_at) * 1000.0 > cfg.init_grace_ms:
+                    return {"reason": "no_heartbeat", "worker": w.idx}
+            elif age > cfg.stall_ms:
+                return {"reason": "stall", "worker": w.idx,
+                        "age_ms": round(age, 1)}
+        return None
+
+    def _run_service(self) -> str:
+        """Per-worker supervision: a failed worker is killed and
+        relaunched alone (no checkpoint, no resume argv — a fresh
+        server whose startup replays its own WAL partition) while its
+        peers keep serving. Terminal states: ``drained`` (stop
+        requested) or ``failed`` (one worker exhausted its per-worker
+        restart budget)."""
+        from ..common.resilience import RetryPolicy
+
+        cfg = self.config
+        backoff = RetryPolicy(max_attempts=cfg.max_restarts + 1,
+                              base_delay=0.5, max_delay=15.0)
+        per_worker_restarts = [0] * cfg.num_workers
+        self._attempt = 0
+        self.state = "running"
+        self._spawn_gang(resume=False)
+        self._publish(1.0)
+        last_publish = 0.0
+        while True:
+            if self._stop.is_set():
+                self._drain()
+                self.state = DRAINED
+                self._publish(0.0)
+                log.info("service drained cleanly (%d worker(s))",
+                         cfg.num_workers)
+                return DRAINED
+            failure = self._check_service_failure()
+            if failure is not None:
+                idx = failure["worker"]
+                bad = self._workers[idx]
+                log.warning("service worker %d failed (%s); relaunching "
+                            "it. log tail:\n%s", idx, failure,
+                            self._tail(bad))
+                self._event("workerFailure", **failure)
+                if bad.proc.poll() is None:
+                    try:
+                        bad.proc.send_signal(signal.SIGKILL)
+                    except OSError:
+                        pass
+                    bad.proc.wait()
+                restarts_c, *_ = _metrics()
+                restarts_c.labels(failure["reason"]).inc()
+                per_worker_restarts[idx] += 1
+                self.restarts += 1
+                if per_worker_restarts[idx] > cfg.max_restarts:
+                    self.state = FAILED
+                    self._event("gaveUp", worker=idx,
+                                restarts=per_worker_restarts[idx])
+                    self._publish(3.0)
+                    self._kill_gang()
+                    log.error("worker %d exhausted its restart budget "
+                              "(%d); stopping the service", idx,
+                              cfg.max_restarts)
+                    return FAILED
+                delay = backoff.backoff(per_worker_restarts[idx] - 1)
+                self._event("workerRestart", worker=idx,
+                            n=per_worker_restarts[idx],
+                            backoff_s=round(delay, 3))
+                # bounded wait that still honours a stop request — a
+                # drain must not be stuck behind a restart backoff,
+                # and a stop that lands DURING the backoff must not
+                # spawn (and immediately kill) a fresh worker
+                if self._stop.wait(delay):
+                    continue
+                self._attempt = per_worker_restarts[idx]
+                self._workers[idx] = self._spawn_worker(
+                    idx, None, resume=False,
+                    attempt=per_worker_restarts[idx])
+                self._publish(1.0)
+            now = time.monotonic()
+            if now - last_publish >= 1.0:
+                self._publish(1.0)
+                last_publish = now
+            time.sleep(cfg.poll_ms / 1000.0)
+
     def run(self) -> str:
         """Supervise to a terminal state: ``completed`` (every worker
         exited 0), ``drained`` (stop requested; checkpoint preserved),
         or ``failed`` (restart budget exhausted)."""
+        if self.restart_scope == "worker":
+            return self._run_service()
         cfg = self.config
         restart_backoff = None
         resume = False

@@ -89,6 +89,17 @@ def status_cmd(args: list[str]) -> int:
     else:
         print("[info] Ingest WAL: disabled (PIO_WAL=1 to arm crash-"
               "durable ingestion)")
+    # Partitioned event log health: per-shard sizes, lease holders
+    # (stale-lease warnings), compaction recency, quarantine counts.
+    log_dir = getattr(s.get_l_events(), "_dir", None)
+    if log_dir is not None and os.path.isdir(log_dir):
+        from ...data.api import event_log
+
+        health = event_log.partition_health(log_dir)
+        if health["logs"]:
+            print(f"[info] Event log: {len(health['logs'])} log file(s) "
+                  f"in {log_dir}")
+            _print_partition_health(health, log_dir)
     if ns.engine_url:
         _print_engine_overload(ns.engine_url)
     if ns.metrics:
@@ -162,6 +173,13 @@ def wal_cmd(args: list[str]) -> int:
         print(f"[info] WAL dir: {cfg.dir} (fsync={cfg.fsync})")
         if not rows:
             print("[info] No WAL segments on disk — nothing to replay.")
+            s = Storage.instance()
+            log_dir = getattr(s.get_l_events(), "_dir", None)
+            if log_dir is not None and os.path.isdir(log_dir):
+                from ...data.api import event_log
+
+                _print_partition_health(
+                    event_log.partition_health(log_dir), log_dir)
             return 0
         live = ingest_wal.dir_is_live(cfg)
         if live:
@@ -171,15 +189,33 @@ def wal_cmd(args: list[str]) -> int:
                   "corruption).")
         for r in rows:
             chan = "" if r["channelId"] is None else f" channel {r['channelId']}"
-            marker = "[warn]" if (not live and (r["uncommittedEvents"]
-                                                or r["tornTailBytes"])) \
+            marker = "[warn]" if (r["corruptSegments"]
+                                  or r["quarantinedSegments"]
+                                  or (not live and (r["uncommittedEvents"]
+                                                    or r["tornTailBytes"]))) \
                 else "[info]"
+            extra = ""
+            if r["corruptSegments"]:
+                extra += (f", {r['corruptSegments']} CORRUPT segment(s) "
+                          "(mid-file; quarantined at next replay)")
+            if r["quarantinedSegments"]:
+                extra += (f", {r['quarantinedSegments']} quarantined "
+                          "segment(s)")
             print(f"{marker}   app {r['appId']}{chan}: "
                   f"{r['segments']} segment(s), {r['bytes']} bytes, "
                   f"{r['uncommittedEvents']} uncommitted event(s), "
                   f"{r['committedRecords']} committed / "
                   f"{r['abortedRecords']} aborted record(s), "
-                  f"{r['tornTailBytes']} torn-tail byte(s)")
+                  f"{r['tornTailBytes']} torn-tail byte(s){extra}")
+        # the partitioned event log rides the same operator surface:
+        # shard sizes, lease holders + epochs, compaction recency
+        s = Storage.instance()
+        log_dir = getattr(s.get_l_events(), "_dir", None)
+        if log_dir is not None and os.path.isdir(log_dir):
+            from ...data.api import event_log
+
+            _print_partition_health(
+                event_log.partition_health(log_dir), log_dir)
         return 0
     # replay
     s = Storage.instance()
@@ -201,15 +237,138 @@ def wal_cmd(args: list[str]) -> int:
 
 @verb("eventserver", "start the Event Server (REST ingestion, :7070)")
 def eventserver_cmd(args: list[str]) -> int:
+    from ...common import envknobs
+
     p = argparse.ArgumentParser(prog="pio eventserver")
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=7070)
     p.add_argument("--stats", action="store_true")
+    p.add_argument("--workers", type=int,
+                   default=envknobs.env_int("PIO_EVENT_WORKERS", 0, lo=0),
+                   help="run N supervised worker processes owning "
+                        "disjoint event-log partitions behind a front "
+                        "listener (defaults to $PIO_EVENT_WORKERS; "
+                        "N=1 is still supervised + lease-fenced; 0 = "
+                        "plain single process, no partitioning)")
+    p.add_argument("--worker", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: supervised worker
     ns = p.parse_args(args)
+    if ns.worker:
+        # spawned by the partitioned front (event_log.py): partition
+        # identity, port, and WAL subdir all arrive via environment
+        port = envknobs.env_int("PIO_EVENT_WORKER_PORT", 0, lo=0)
+        if port <= 0:
+            print("[error] --worker requires PIO_EVENT_WORKER_PORT "
+                  "(set by the supervisor — this flag is internal)",
+                  file=sys.stderr)
+            return 1
+        from ...data.api.event_server import run_event_server
+
+        run_event_server("127.0.0.1", port, enable_stats=ns.stats)
+        return 0
+    if ns.workers >= 1:
+        from ...data.api.event_log import run_partitioned_event_server
+
+        return run_partitioned_event_server(
+            ns.ip, ns.port, ns.workers, enable_stats=ns.stats)
     from ...data.api.event_server import run_event_server
 
     run_event_server(ns.ip, ns.port, enable_stats=ns.stats)
     return 0
+
+
+@verb("eventlog", "compact, scrub, or fence the partitioned event log")
+def eventlog_cmd(args: list[str]) -> int:
+    """Operator surface for the partitioned event log
+    (data/api/event_log.py): `compact` rewrites JSONL logs into
+    columnar snapshots (crash-safe: shadow file + atomic rename +
+    manifest commit), `scrub` CRC-verifies committed snapshots and
+    quarantines corrupt ones, `status` prints per-partition health, and
+    `fence` force-claims a partition lease (split-brain last resort:
+    bumps the epoch so a wedged previous owner is refused on its next
+    write)."""
+    p = argparse.ArgumentParser(prog="pio eventlog")
+    sub = p.add_subparsers(dest="sub", required=True)
+    p_compact = sub.add_parser(
+        "compact", help="compact JSONL event logs into columnar "
+                        "snapshots (additive + crash-safe; scans load "
+                        "them instead of re-parsing JSON)")
+    p_compact.add_argument("--min-new-bytes", type=int, default=0,
+                           help="skip logs that grew less than this "
+                                "since the last snapshot")
+    sub.add_parser("scrub", help="verify snapshot CRCs; quarantine "
+                                 "corrupt ones (never deletes)")
+    sub.add_parser("status", help="per-partition log health: sizes, "
+                                  "leases, compaction, quarantine")
+    p_fence = sub.add_parser(
+        "fence", help="force-claim a partition lease past a held flock "
+                      "(ONLY when the owner is wedged/unreachable)")
+    p_fence.add_argument("--partition", type=int, required=True)
+    ns = p.parse_args(args)
+    from ...data.api import event_log
+
+    s = Storage.instance()
+    le = s.get_l_events()
+    log_dir = getattr(le, "_dir", None)
+    if log_dir is None:
+        print("[error] the configured event store is not a JSONL event "
+              "log; `pio eventlog` applies to TYPE=JSONL", file=sys.stderr)
+        return 1
+    if ns.sub == "compact":
+        n = 0
+        for name in sorted(os.listdir(log_dir)):
+            if name.endswith(".jsonl"):
+                m = event_log.compact_log(
+                    os.path.join(log_dir, name), ns.min_new_bytes)
+                if m is not None:
+                    print(f"[info] {name}: generation {m['generation']}, "
+                          f"{m['events']} event(s), {m['covered']} "
+                          "byte(s) covered")
+                    n += 1
+        print(f"[info] Compacted {n} log(s) in {log_dir}")
+        return 0
+    if ns.sub == "scrub":
+        report = event_log.scrub_log_dir(log_dir)
+        marker = "[warn]" if report["quarantined"] else "[info]"
+        print(f"{marker} Scrub: {report['checked']} snapshot(s) checked, "
+              f"{report['ok']} ok, {report['quarantined']} quarantined, "
+              f"{report['stale']} stale (discarded)")
+        return 1 if report["quarantined"] else 0
+    if ns.sub == "fence":
+        lease = event_log.claim_partition(
+            log_dir, ns.partition, force=True)
+        print(f"[info] Partition {ns.partition} fenced: new epoch "
+              f"{lease.epoch}"
+              + (" (FORCED past a held flock — the previous owner will "
+                 "be refused on its next write)" if lease.forced else ""))
+        lease.release()
+        return 0
+    # status
+    _print_partition_health(event_log.partition_health(log_dir), log_dir)
+    return 0
+
+
+def _print_partition_health(health: dict, log_dir: str) -> None:
+    if not health["logs"]:
+        print(f"[info] No event logs in {log_dir}")
+    for row in health["logs"]:
+        lease = row["lease"]
+        lease_s = ""
+        if lease is not None:
+            state = ("held" if lease["held"]
+                     else "STALE" if lease["stale"] else "free")
+            lease_s = (f", lease {state} (epoch {lease['epoch']}, "
+                       f"pid {lease['pid']})")
+        compact_s = (f", compacted {row['compactedEvents']} event(s) at "
+                     f"{row['lastCompaction']}"
+                     if row["lastCompaction"] else ", never compacted")
+        marker = "[warn]" if (lease and lease["stale"]) else "[info]"
+        print(f"{marker}   {row['log']}: {row['bytes']} bytes"
+              f"{lease_s}{compact_s}")
+    if health["quarantinedFiles"]:
+        print(f"[warn]   {health['quarantinedFiles']} quarantined "
+              f"file(s) in {os.path.join(log_dir, 'quarantine')} — "
+              "corrupt segments kept for forensics")
 
 
 @verb("storageserver", "host this node's storage over HTTP (:7072)")

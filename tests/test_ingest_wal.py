@@ -169,7 +169,9 @@ def test_group_fsync_failure_aborts_instead_of_resurrecting(
         wal_env, monkeypatch):
     """An fsync error AFTER the group frame landed must take the abort
     path: the client is told the commit failed (it owns the retry), so
-    replay resurrecting the frame would land every event twice."""
+    replay resurrecting the frame would land every event twice. Since
+    ISSUE 8 the EIO surfaces as 503 + Retry-After (disk-class append
+    errors shed instead of 500ing) — the client still owns the retry."""
     tmp_path = wal_env
     storage, app_id, key = _storage(tmp_path)
 
@@ -182,7 +184,8 @@ def test_group_fsync_failure_aborts_instead_of_resurrecting(
         with ServerThread(server.app) as st:
             r = requests.post(f"{st.base}/events.json?accessKey={key}",
                               json=_ev(1))
-            assert r.status_code == 500  # client owns the retry
+            assert r.status_code == 503  # shed: client owns the retry
+            assert int(r.headers["Retry-After"]) >= 1
     summary = ingest_wal.recover(storage)
     assert summary["replayed"] == 0, \
         "client-reported fsync failure was resurrected by replay"
@@ -438,6 +441,194 @@ def test_recovery_runs_at_server_startup(wal_env, monkeypatch):
     got = storage.get_l_events().get("ee" * 16, app_id)
     assert got is not None and got.entity_id == "u9"
     assert ingest_wal.inspect() == []  # truncated after replay
+
+
+# ---------------------------------------------------------------------------
+# frame decoder property/fuzz tests (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+def _random_segment(rng):
+    """A well-formed segment plus ground truth: frames of all three
+    kinds with random payloads, as the writer would produce."""
+    frames = []
+    events = {}      # lsn -> payload
+    committed, aborted = set(), set()
+    lsn = 1
+    for _ in range(rng.randrange(1, 12)):
+        kind = rng.choice(["E", "E", "E", "C", "X"])
+        if kind == "E":
+            payload = bytes(rng.randrange(256)
+                            for _ in range(rng.randrange(1, 120)))
+            frames.append(ingest_wal._frame(
+                ingest_wal.K_EVENTS, lsn, payload))
+            events[lsn] = payload
+            lsn += 1
+        else:
+            lsns = [rng.randrange(1, max(2, lsn))
+                    for _ in range(rng.randrange(1, 5))]
+            payload = struct.pack(f"<{len(lsns)}Q", *lsns)
+            k = ingest_wal.K_COMMIT if kind == "C" else ingest_wal.K_ABORT
+            frames.append(ingest_wal._frame(k, 0, payload))
+            (committed if kind == "C" else aborted).update(lsns)
+    return b"".join(frames), events, committed, aborted
+
+
+def test_frame_decoder_fuzz_never_raises_never_lies():
+    """Decoder contract (satellite): random truncation, bit flips, and
+    garbage interleaved between frames must never raise out of the
+    decoder and never yield a record that fails CRC — every yielded
+    (lsn, payload) is byte-identical to a frame the writer actually
+    appended, and marker sets only ever shrink toward the originals.
+    Both modes (truncate-at-first-bad and forward-resync) are held to
+    the same contract."""
+    import random
+
+    rng = random.Random(0xC0FFEE)
+    for trial in range(300):
+        buf, events, committed, aborted = _random_segment(rng)
+        corrupted = bytearray(buf)
+        mode = rng.choice(["truncate", "bitflip", "garbage", "both"])
+        if mode in ("truncate",) and len(corrupted) > 1:
+            corrupted = corrupted[:rng.randrange(len(corrupted))]
+        if mode in ("bitflip", "both"):
+            for _ in range(rng.randrange(1, 4)):
+                if corrupted:
+                    i = rng.randrange(len(corrupted))
+                    corrupted[i] ^= 1 << rng.randrange(8)
+        if mode in ("garbage", "both"):
+            junk = bytes(rng.randrange(256)
+                         for _ in range(rng.randrange(1, 40)))
+            at = rng.randrange(len(corrupted) + 1)
+            corrupted = corrupted[:at] + junk + corrupted[at:]
+        for resync in (False, True):
+            d = ingest_wal.decode_buffer(bytes(corrupted), resync=resync)
+            for lsn, payload in d.events:
+                assert events.get(lsn) == payload, (
+                    f"trial {trial} ({mode}, resync={resync}): decoder "
+                    f"yielded an altered record for lsn {lsn}")
+            # markers: decoded sets must be subsets of what was written
+            # (corruption can eat markers, never mint new LSNs)
+            assert d.committed <= committed, (trial, mode, resync)
+            assert d.aborted <= aborted, (trial, mode, resync)
+
+
+def test_frame_decoder_kind_flip_is_not_an_error():
+    """A flipped KIND byte turns an E frame into a 'marker' whose
+    length is not a multiple of 8 — the decoder must treat it as
+    corruption (the header-covering CRC fails first, and even a
+    colliding CRC must hit the plen%8 validation), never raise
+    struct.error."""
+    payload = b'{"eventId":"x"}\n'  # 16 bytes... use 15 to be odd
+    payload = payload[:15]
+    frame = ingest_wal._frame(ingest_wal.K_EVENTS, 7, payload)
+    flipped = bytes([ingest_wal.K_COMMIT]) + frame[1:]
+    d = ingest_wal.decode_buffer(flipped)
+    assert d.events == [] and d.committed == set()
+    assert d.discarded == len(flipped)
+
+
+def _legacy_frame(kind, lsn, payload):
+    """Pre-ISSUE-8 frame: CRC over the payload only."""
+    return ingest_wal._FRAME.pack(
+        kind, len(payload), lsn, zlib.crc32(payload)) + payload
+
+
+def test_legacy_payload_crc_segments_still_replay(tmp_path, monkeypatch):
+    """Upgrade compatibility: a segment written by a pre-ISSUE-8 build
+    (payload-only frame CRC) left behind by a crash must still decode
+    and replay after the upgrade — silently discarding it would lose
+    every pre-upgrade acked event, the exact loss the WAL exists to
+    prevent."""
+    monkeypatch.setenv("PIO_WAL", "1")
+    monkeypatch.setenv("PIO_WAL_DIR", str(tmp_path / "wal"))
+    storage, app_id, key = _storage(tmp_path)
+    keydir = os.path.join(str(tmp_path / "wal"), "1")
+    os.makedirs(keydir)
+    lines = [json.dumps(dict(_ev(i), eventId=f"{i:032x}",
+                             creationTime=T)).encode() + b"\n"
+             for i in range(3)]
+    with open(os.path.join(keydir, "0000000001.wal"), "wb") as f:
+        for lsn, ln in enumerate(lines, start=1):
+            f.write(_legacy_frame(ingest_wal.K_EVENTS, lsn, ln))
+        # lsn 1 was committed pre-crash; 2 and 3 were not
+        f.write(_legacy_frame(ingest_wal.K_COMMIT, 0,
+                              struct.pack("<Q", 1)))
+    d = ingest_wal.decode_segment(
+        os.path.join(keydir, "0000000001.wal"))
+    assert [lsn for lsn, _ in d.events] == [1, 2, 3]
+    assert d.committed == {1}
+    summary = ingest_wal.recover(storage, ingest_wal.WalConfig.from_env())
+    assert summary["replayed"] == 2
+    le = storage.get_l_events()
+    for i in (1, 2):
+        assert le.get(f"{i:032x}", app_id) is not None, i
+
+
+def test_decoder_resync_salvages_past_midfile_corruption(tmp_path):
+    """Bit rot in the MIDDLE of a segment: resync recovers the frames
+    after the corrupt region (recovery replays them) and flags the
+    segment (`resynced`) so it is quarantined, not deleted."""
+    cfg = WalConfig(enabled=True, dir=str(tmp_path / "wal"), fsync="off")
+    wal = IngestWal(cfg)
+    key = (1, None)
+    lines = [json.dumps(dict(_ev(i), eventId=f"{i:032x}",
+                             creationTime=T)).encode() + b"\n"
+             for i in range(5)]
+    for ln in lines:
+        wal.append_events(key, ln, 1)
+    wal.close()
+    seg = os.path.join(cfg.dir, "1", "0000000001.wal")
+    buf = bytearray(open(seg, "rb").read())
+    # flip a byte inside the SECOND frame's payload
+    first_len = ingest_wal._FRAME.size + len(lines[0])
+    buf[first_len + ingest_wal._FRAME.size + 3] ^= 0xFF
+    open(seg, "wb").write(bytes(buf))
+
+    plain = ingest_wal.decode_segment(seg)
+    assert [lsn for lsn, _ in plain.events] == [1]  # truncating view
+    d = ingest_wal.decode_segment(seg, resync=True)
+    assert [lsn for lsn, _ in d.events] == [1, 3, 4, 5]
+    assert d.resynced and d.discarded > 0
+
+
+def test_recovery_quarantines_corrupt_segment_and_replays_salvage(
+        tmp_path, monkeypatch):
+    """End-to-end over recover(): a bit-flipped segment is quarantined
+    (moved, never deleted, counted in
+    pio_eventlog_quarantined_segments_total) while every salvageable
+    record around the corruption is still replayed exactly once."""
+    monkeypatch.setenv("PIO_WAL", "1")
+    monkeypatch.setenv("PIO_WAL_DIR", str(tmp_path / "wal"))
+    storage, app_id, key = _storage(tmp_path)
+    cfg = WalConfig.from_env()
+    wal = IngestWal(cfg)
+    lines = [json.dumps(dict(_ev(i), eventId=f"{i:032x}",
+                             creationTime=T)).encode() + b"\n"
+             for i in range(5)]
+    for ln in lines:
+        wal.append_events((app_id, None), ln, 1)
+    wal.close()
+    seg = os.path.join(cfg.dir, "1", "0000000001.wal")
+    buf = bytearray(open(seg, "rb").read())
+    first_len = ingest_wal._FRAME.size + len(lines[0])
+    buf[first_len + ingest_wal._FRAME.size + 3] ^= 0xFF
+    open(seg, "wb").write(bytes(buf))
+
+    qcounter = ingest_wal._M_QUARANTINED
+    before = qcounter.labels("wal").value()
+    summary = ingest_wal.recover(storage, cfg)
+    assert summary["replayed"] == 4          # all but the corrupt record
+    assert summary["quarantined"] == 1
+    qdir = os.path.join(cfg.dir, "1", ingest_wal.QUARANTINE_DIR)
+    assert os.path.isdir(qdir) and len(os.listdir(qdir)) == 1
+    le = storage.get_l_events()
+    for i in (0, 2, 3, 4):
+        assert le.get(f"{i:032x}", app_id) is not None, i
+    assert le.get(f"{1:032x}", app_id) is None  # eaten by the bit flip
+    assert qcounter.labels("wal").value() == before + 1
+    # idempotent: a second recovery pass finds a clean (empty) WAL
+    summary2 = ingest_wal.recover(storage, cfg)
+    assert summary2["replayed"] == 0 and summary2["quarantined"] == 0
 
 
 # ---------------------------------------------------------------------------
