@@ -213,6 +213,13 @@ class Models(abc.ABC):
     @abc.abstractmethod
     def get(self, model_id: str) -> Optional[Model]: ...
 
+    def exists(self, model_id: str) -> bool:
+        """Row-existence probe. The default round-trips the whole blob;
+        backends with a cheap metadata check override it (GC over a
+        store of multi-GB artifacts must not read every one to decide
+        which few to delete)."""
+        return self.get(model_id) is not None
+
     @abc.abstractmethod
     def delete(self, model_id: str) -> None: ...
 

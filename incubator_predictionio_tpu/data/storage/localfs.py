@@ -36,6 +36,9 @@ class LocalFSModels(base.Models):
         with open(p, "rb") as f:
             return base.Model(model_id, f.read())
 
+    def exists(self, model_id: str) -> bool:
+        return os.path.exists(self._path(model_id))
+
     def delete(self, model_id: str) -> None:
         p = self._path(model_id)
         if os.path.exists(p):

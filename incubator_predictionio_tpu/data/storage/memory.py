@@ -394,6 +394,10 @@ class MemoryModels(base.Models):
         with self._lock:
             return self._by_id.get(model_id)
 
+    def exists(self, model_id: str) -> bool:
+        with self._lock:
+            return model_id in self._by_id
+
     def delete(self, model_id: str) -> None:
         with self._lock:
             self._by_id.pop(model_id, None)

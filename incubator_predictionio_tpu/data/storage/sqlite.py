@@ -482,6 +482,13 @@ class SQLiteModels(base.Models, _Dao):
             ).fetchone()
         return base.Model(row[0], row[1]) if row else None
 
+    def exists(self, model_id: str) -> bool:
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT 1 FROM {self._t} WHERE id=?", (model_id,)
+            ).fetchone()
+        return row is not None
+
     def delete(self, model_id: str) -> None:
         with self._lock, self._conn:
             self._conn.execute(f"DELETE FROM {self._t} WHERE id=?", (model_id,))

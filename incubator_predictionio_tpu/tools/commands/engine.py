@@ -256,7 +256,25 @@ def deploy_cmd(args: list[str]) -> int:
     p.add_argument("--drain-deadline-ms", type=float, default=None,
                    help="graceful-drain budget on SIGTERM or /stop "
                         "(default $PIO_DRAIN_DEADLINE_MS, else 10000)")
+    p.add_argument("--model-refresh-ms", type=float, default=None,
+                   help="poll for newer COMPLETED instances and hot-swap "
+                        "them through the validated gate every N ms "
+                        "(default $PIO_MODEL_REFRESH_MS, else 0 = off)")
+    p.add_argument("--rollback", action="store_true",
+                   help="don't deploy: tell the engine server already "
+                        "running at --ip/--port to roll back to its "
+                        "previous deployment, then exit")
     ns = p.parse_args(args)
+    if ns.rollback:
+        from ...common import ssl_context_from_env
+        from .models import rollback_via_url
+
+        # same TLS detection the server itself deploys with; loopback
+        # https skips verification (self-signed / hostname-scoped cert)
+        scheme = "https" if ssl_context_from_env() else "http"
+        host = "127.0.0.1" if ns.ip in ("0.0.0.0", "::") else ns.ip
+        return rollback_via_url(f"{scheme}://{host}:{ns.port}",
+                                insecure=True)
     from ...workflow.create_server import EngineServer, run_engine_server
 
     engine, params, factory, variant, _ = _load_engine(ns)
@@ -276,6 +294,7 @@ def deploy_cmd(args: list[str]) -> int:
         query_max_pending=ns.query_max_pending,
         query_deadline_ms=ns.query_deadline_ms,
         drain_deadline_ms=ns.drain_deadline_ms,
+        model_refresh_ms=ns.model_refresh_ms,
     )
     print(f"[info] Engine is deployed and running. Listening on {ns.ip}:{ns.port}")
     run_engine_server(server, ns.ip, ns.port,

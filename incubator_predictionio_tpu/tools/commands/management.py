@@ -119,15 +119,12 @@ def _print_engine_overload(url: str) -> None:
     """Operator view of a live engine server's admission gate: the
     /status overload counters, without scraping /metrics (ISSUE 6 —
     `pio status` must show overload at a glance)."""
-    import urllib.error
-    import urllib.request
-
     base = url if "://" in url else f"http://{url}"
     try:
-        with urllib.request.urlopen(
-                base.rstrip("/") + "/status", timeout=5) as resp:
-            doc = json.load(resp)
-    except (urllib.error.URLError, OSError, ValueError) as e:
+        from .models import engine_status
+
+        doc = engine_status(url)
+    except Exception as e:  # noqa: BLE001 - diagnostics, not a failure
         print(f"[warn] engine server at {base} unreachable: {e}")
         return
     ov = doc.get("overload")
@@ -149,6 +146,25 @@ def _print_engine_overload(url: str) -> None:
           f"orphaned={ov.get('orphaned')}, "
           f"draining={ov.get('draining')}, "
           f"drainStragglers={ov.get('drainStragglers')}")
+    lc = doc.get("lifecycle")
+    if lc:
+        rollbacks = sum((lc.get("rollbacks") or {}).values())
+        pinned = lc.get("pinned") or {}
+        integ = {k: v for k, v in
+                 (lc.get("integrityFailures") or {}).items() if v}
+        marker = "[warn]" if (rollbacks or pinned or integ
+                              or lc.get("validateFailures")) else "[info]"
+        pins = (", ".join(f"{i} ({r})" for i, r in sorted(pinned.items()))
+                or "none")
+        refresh = (f"every {lc.get('refreshMs'):.0f}ms "
+                   f"({lc.get('refreshSwaps')} swap(s))"
+                   if lc.get("refreshMs") else "off")
+        print(f"{marker}   lifecycle: previous {lc.get('previous')}, "
+              f"swaps={lc.get('swaps')}, rollbacks={rollbacks} "
+              f"{lc.get('rollbacks')}, "
+              f"validateFailures={lc.get('validateFailures')}, "
+              f"integrityFailures={integ or 0}, "
+              f"refresh {refresh}, pinned: {pins}")
 
 
 @verb("wal", "inspect or replay the ingest write-ahead log")

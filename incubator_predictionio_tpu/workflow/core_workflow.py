@@ -21,8 +21,9 @@ from typing import Any, Optional
 
 from ..controller.engine import Engine, EngineParams
 from ..controller.persistent_model import PersistentModel
-from ..data.storage.base import EngineInstance, Model
+from ..data.storage.base import EngineInstance
 from ..data.storage.event import new_event_id
+from . import model_artifact
 from .context import WorkflowContext
 from .workflow_params import WorkflowParams
 
@@ -322,10 +323,17 @@ def run_train(
                     if model.save(instance_id, algo.params):
                         persistent += 1
             blob = serialize_models(algo_list, models)
-            storage.get_model_data_models().insert(Model(instance_id, blob))
+            # Checksummed artifact via the single verifying-writer path
+            # (workflow/model_artifact.py). The Model row MUST land
+            # before the COMPLETED stamp below: a crash in between
+            # leaves a RUNNING row (never deployed) instead of a
+            # COMPLETED row without a model — and the verifying loader
+            # skips the latter anyway, for rows written by older code.
+            sha = model_artifact.write_model(storage, instance_id, blob)
             log.info(
-                "models persisted: %d bytes pickled, %d self-persisted",
-                len(blob), persistent,
+                "models persisted: %d bytes pickled (sha256 %s), "
+                "%d self-persisted",
+                len(blob), sha[:12], persistent,
             )
             done = EngineInstance(
                 **{**instance.__dict__, "id": instance_id}
@@ -364,41 +372,98 @@ def load_deployment(
     ctx: Optional[WorkflowContext] = None,
     engine_factory_name: str = "",
     engine_variant: str = "default",
+    exclude_ids=(),
+    on_reject=None,
 ):
     """Load a trained instance for serving (reference: CreateServer /
-    MasterActor prepareDeployment). instance_id None → latest COMPLETED."""
+    MasterActor prepareDeployment). instance_id None → latest
+    *deployable* COMPLETED: every candidate's stored model is verified
+    (checksum/size/format via workflow/model_artifact.py) and a corrupt,
+    missing or unpicklable artifact makes the loader WALK BACK to the
+    next-older COMPLETED instance instead of crashing — the bad blob is
+    counted (`pio_model_integrity_failures_total{kind}`) and kept on
+    disk for forensics, never deleted. ``exclude_ids`` skips instances
+    the caller has pinned (a rolled-back deployment must not be
+    re-picked); ``on_reject(instance_id, kind)`` is called per skipped
+    instance so callers (the refresh loop) can pin them instead of
+    re-walking the same corpse every poll. An EXPLICIT instance_id
+    never walks back: the operator asked for that version, so a
+    failure surfaces as an error."""
     ctx = ctx or WorkflowContext()
     storage = ctx.get_storage()
     instances = storage.get_meta_data_engine_instances()
+    excluded = set(exclude_ids or ())
     if instance_id is None:
-        latest = instances.get_latest_completed(
+        candidates = instances.get_completed(
             engine_factory_name or "engine", "1", engine_variant
         )
-        if latest is None:
+        if not candidates:
             raise RuntimeError(
                 "No COMPLETED engine instance found; run `pio train` first"
             )
-        instance = latest
+        candidates = [c for c in candidates if c.id not in excluded]
+        if not candidates:
+            raise RuntimeError(
+                "Every COMPLETED engine instance is pinned (rolled back "
+                "or failed validation); train a fresh instance or reload "
+                "one explicitly")
     else:
         instance = instances.get(instance_id)
         if instance is None:
             raise RuntimeError(f"Engine instance {instance_id} not found")
+        candidates = [instance]
 
-    engine_params = EngineParams(
-        data_source_params=json.loads(instance.data_source_params),
-        preparator_params=json.loads(instance.preparator_params),
-        algorithm_params_list=[
-            (a["name"], a["params"]) for a in json.loads(instance.algorithms_params)
-        ],
-        serving_params=json.loads(instance.serving_params),
-    )
-    ctx.engine_instance_id = instance.id
-    if not ctx.app_name:
-        ctx.app_name = instance.env.get("appName", "")
-    model_row = storage.get_model_data_models().get(instance.id)
-    if model_row is None:
-        raise RuntimeError(f"No model blob for engine instance {instance.id}")
-    _, _, algo_list, _ = engine.make_components(engine_params)
-    models = deserialize_models(model_row.models, algo_list, instance.id, ctx)
-    deployment = engine.prepare_deployment(ctx, engine_params, models)
-    return deployment, instance, engine_params
+    rejected: list[str] = []
+    caller_app_name = ctx.app_name
+    for instance in candidates:
+        try:
+            payload = model_artifact.read_model(storage, instance.id)
+        except model_artifact.ModelIntegrityError as e:
+            if instance_id is not None:
+                raise
+            rejected.append(f"{instance.id} ({e.kind})")
+            if on_reject is not None:
+                on_reject(instance.id, e.kind)
+            log.warning("%s; walking back to an older COMPLETED instance",
+                        e)
+            continue
+        engine_params = EngineParams(
+            data_source_params=json.loads(instance.data_source_params),
+            preparator_params=json.loads(instance.preparator_params),
+            algorithm_params_list=[
+                (a["name"], a["params"])
+                for a in json.loads(instance.algorithms_params)
+            ],
+            serving_params=json.loads(instance.serving_params),
+        )
+        ctx.engine_instance_id = instance.id
+        # derive from THIS candidate, not whatever a previously rejected
+        # candidate left behind — each walk iteration binds its own app
+        if not caller_app_name:
+            ctx.app_name = instance.env.get("appName", "")
+        _, _, algo_list, _ = engine.make_components(engine_params)
+        try:
+            models = deserialize_models(payload, algo_list, instance.id, ctx)
+        except Exception as e:  # noqa: BLE001 - checksummed yet unloadable
+            if instance_id is not None:
+                raise
+            ctx.app_name = caller_app_name
+            model_artifact.count_integrity_failure("deserialize")
+            rejected.append(f"{instance.id} (deserialize)")
+            if on_reject is not None:
+                on_reject(instance.id, "deserialize")
+            log.warning(
+                "model for engine instance %s verified but failed to "
+                "deserialize (%s); walking back to an older COMPLETED "
+                "instance", instance.id, e)
+            continue
+        deployment = engine.prepare_deployment(ctx, engine_params, models)
+        if rejected:
+            log.warning(
+                "deployed %s after skipping %d undeployable instance(s): "
+                "%s", instance.id, len(rejected), ", ".join(rejected))
+        return deployment, instance, engine_params
+    raise RuntimeError(
+        "No deployable COMPLETED engine instance: all candidates "
+        f"rejected ({', '.join(rejected)}); blobs kept for forensics — "
+        "`pio models verify` to inspect, `pio train` to replace")

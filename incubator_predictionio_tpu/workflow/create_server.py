@@ -29,7 +29,7 @@ import time as _time
 
 from aiohttp import web
 
-from ..common import deadline, envknobs, telemetry
+from ..common import deadline, envknobs, faultinject, telemetry
 from ..common.resilience import retry_after_jitter
 from ..controller.engine import Engine
 from ..data.storage.datamap import DataMap
@@ -60,6 +60,20 @@ class AdmissionShed(Exception):
         self.reason = reason
 
 
+class SwapValidationError(RuntimeError):
+    """The validation gate refused to put a (re)loaded model live
+    (nan_guard hit, warm-up failed, or the golden-query smoke predict
+    raised). The last-good deployment keeps serving; the reload/refresh
+    caller decides whether to pin the refused instance."""
+
+    def __init__(self, instance_id: str, reason: str):
+        super().__init__(
+            f"engine instance {instance_id} failed swap validation: "
+            f"{reason}")
+        self.instance_id = instance_id
+        self.reason = reason
+
+
 class EngineServer:
     def __init__(
         self,
@@ -77,6 +91,10 @@ class EngineServer:
         query_max_pending: Optional[int] = None,
         query_deadline_ms: Optional[float] = None,
         drain_deadline_ms: Optional[float] = None,
+        swap_validate: Optional[bool] = None,
+        swap_watch_ms: Optional[float] = None,
+        swap_max_error_rate: Optional[float] = None,
+        model_refresh_ms: Optional[float] = None,
     ):
         self.engine = engine
         self.engine_factory_name = engine_factory_name
@@ -104,7 +122,9 @@ class EngineServer:
         self._lock = threading.Lock()
         self._query_count = 0
         self._init_overload_state(query_conc, query_max_pending,
-                                  query_deadline_ms, drain_deadline_ms)
+                                  query_deadline_ms, drain_deadline_ms,
+                                  swap_validate, swap_watch_ms,
+                                  swap_max_error_rate, model_refresh_ms)
         # Probe marker secret: synthetic startup-probe traffic is
         # excluded from queryCount/feedback, so the marker must not be
         # spoofable — an external client sending a bare "X-Pio-Probe: 1"
@@ -143,6 +163,8 @@ class EngineServer:
                 web.post("/queries.json", self.handle_query),
                 web.get("/reload", self.handle_reload),
                 web.post("/reload", self.handle_reload),
+                web.get("/rollback", self.handle_rollback),
+                web.post("/rollback", self.handle_rollback),
                 web.get("/stop", self.handle_stop),
                 web.post("/stop", self.handle_stop),
                 web.get("/plugins.json", self.handle_plugins),
@@ -151,11 +173,15 @@ class EngineServer:
         if self.batch_window_ms > 0:
             self.app.on_startup.append(self._start_batcher)
             self.app.on_cleanup.append(self._stop_batcher)
+        self.app.on_startup.append(self._start_refresher)
+        self.app.on_cleanup.append(self._stop_refresher)
         self.app.on_cleanup.append(self._shutdown_executor)
 
     def _init_overload_state(self, query_conc=None, query_max_pending=None,
                              query_deadline_ms=None,
-                             drain_deadline_ms=None) -> None:
+                             drain_deadline_ms=None, swap_validate=None,
+                             swap_watch_ms=None, swap_max_error_rate=None,
+                             model_refresh_ms=None) -> None:
         """Admission control: the query path gets a DEDICATED bounded
         executor (query_conc workers) plus a bounded waiting budget
         (query_max_pending); offered load beyond conc+pending is shed
@@ -199,6 +225,38 @@ class EngineServer:
         self._drain_stragglers = 0
         self._reload_lock = asyncio.Lock()
         self._reload_conflicts = 0
+        # -- model lifecycle (docs/operations.md "Model lifecycle") ----
+        # Validation gate: before any (re)loaded model goes live, run
+        # nan_guard over its arrays, require warm-up success, and smoke-
+        # predict the golden query. Gate failure → stay on last-good.
+        self.swap_validate = (
+            bool(swap_validate) if swap_validate is not None
+            else envknobs.env_flag("PIO_SWAP_VALIDATE", True))
+        # Post-swap watch: for this long after a hot swap, query
+        # failures are counted against the NEW model (and hedged onto
+        # the retained previous one); past the error-rate threshold the
+        # swap is rolled back automatically and the bad instance pinned.
+        self.swap_watch_ms = max(0.0, float(
+            swap_watch_ms if swap_watch_ms is not None
+            else _env_int("PIO_SWAP_WATCH_MS", 60_000)))
+        self.swap_max_error_rate = float(
+            swap_max_error_rate if swap_max_error_rate is not None
+            else envknobs.env_float("PIO_SWAP_MAX_ERROR_RATE", 0.5,
+                                    lo=0.0, hi=1.0))
+        # Continuous refresh (ROADMAP item 4): poll for newer COMPLETED
+        # instances and hot-swap them through the validated gate.
+        # 0 = off (the default: reloads stay operator-driven).
+        self.model_refresh_ms = max(0.0, float(
+            model_refresh_ms if model_refresh_ms is not None
+            else _env_int("PIO_MODEL_REFRESH_MS", 0)))
+        self._previous = None            # (deployment, instance) resident
+        self._pinned: dict[str, str] = {}  # instance id → pin reason
+        self._watch = None               # active post-swap watch window
+        self._rollbacks: dict[str, int] = {}   # reason → count
+        self._swap_count = 0
+        self._validate_failures = 0
+        self._refresh_swaps = 0
+        self._refresh_task = None
 
     @staticmethod
     def _new_compile_families():
@@ -212,19 +270,68 @@ class EngineServer:
                     "instance, per algorithm", ("algorithm",)))
 
     # -- lifecycle --------------------------------------------------------
-    def _load(self, instance_id: Optional[str]) -> None:
+    def _load(self, instance_id: Optional[str],
+              skip_if_current: bool = False, on_reject=None) -> bool:
+        """(Re)load a deployment; returns True when a deployment was
+        published, False when skip_if_current short-circuited.
+
+        At INITIAL deploy (nothing serving yet) a validation-refused
+        newest instance is pinned and the walk retries older COMPLETED
+        instances — the same recovery the integrity walk-back gives a
+        corrupt blob, because there is no last-good model to stay on.
+        Once something IS serving, a validation failure raises so the
+        caller keeps the last-good deployment (and decides about
+        pinning)."""
+        while True:
+            try:
+                return self._load_once(instance_id, skip_if_current,
+                                       on_reject)
+            except SwapValidationError as e:
+                with self._lock:
+                    has_current = self.deployment is not None
+                if instance_id is not None or has_current:
+                    raise
+                self._validate_failures += 1
+                with self._lock:
+                    self._pinned[e.instance_id] = "validate"
+                log.warning(
+                    "initial deploy: %s; pinning it and walking back to "
+                    "an older COMPLETED instance", e)
+
+    def _load_once(self, instance_id: Optional[str],
+                   skip_if_current: bool = False, on_reject=None) -> bool:
         ctx = WorkflowContext(storage=self.storage)
+        # snapshot under the lock: this runs on a worker thread while
+        # the event loop may be pinning concurrently (error-rate
+        # rollback is not serialized by the reload lock)
+        with self._lock:
+            pinned = tuple(self._pinned) if instance_id is None else ()
         deployment, instance, _ = load_deployment(
             self.engine,
             instance_id,
             ctx,
             engine_factory_name=self.engine_factory_name,
             engine_variant=self.engine_variant,
+            # latest-completed mode never re-picks a pinned (rolled
+            # back / validation-refused) instance; an explicit id is
+            # the operator overriding the pin on purpose
+            exclude_ids=pinned,
+            on_reject=on_reject,
         )
+        with self._lock:
+            current = self.instance
+        if (skip_if_current and current is not None
+                and instance.id == current.id):
+            # refresh poll raced a walk-back onto the live instance:
+            # nothing newer is deployable, keep serving as-is
+            log.info("refresh: no newer deployable instance than %s",
+                     current.id)
+            return False
         # Fresh compile families for this instance: the collector reads
         # the attributes live, so swapping them drops labels that only
         # existed on the previous variant (nothing merges stale rows)
         m_count, m_seconds = self._new_compile_families()
+        warmup_errors: list[str] = []
         # Warm up every model that supports it (compile + device
         # placement); wall time per algorithm feeds the compile gauges —
         # on a cold deploy this is almost entirely XLA compilation, the
@@ -237,13 +344,13 @@ class EngineServer:
                 t0 = _time.perf_counter()
                 try:
                     warm()
-                except Exception:  # pragma: no cover - warmup best-effort
+                except Exception as e:  # noqa: BLE001 - gate decides below
                     log.exception("model warm-up failed")
+                    warmup_errors.append(f"{label}: {e}")
                 else:
                     m_count.labels(label).set(1)
                     m_seconds.labels(label).set(
                         _time.perf_counter() - t0)
-        self._m_compile_count, self._m_compile_seconds = m_count, m_seconds
         if self.batch_window_ms > 0:
             # Pre-compile every power-of-two batch shape the micro-batch
             # path can produce — a cold shape showed ~1.5s p99 through a
@@ -261,18 +368,98 @@ class EngineServer:
                 while b <= top:
                     try:
                         deployment.batch_query([dict(example)] * b)
-                    except Exception:  # noqa: BLE001 - warmup best-effort
+                    except Exception as e:  # noqa: BLE001 - gate below
                         log.exception("batch warm-up failed at size %d", b)
+                        warmup_errors.append(f"batch[{b}]: {e}")
                         break
                     n_shapes += 1
                     b *= 2
-                self._m_compile_count.labels("batch").set(n_shapes)
-                self._m_compile_seconds.labels("batch").set(
+                m_count.labels("batch").set(n_shapes)
+                m_seconds.labels("batch").set(
                     _time.perf_counter() - t0)
+        # Validation gate — this deployment goes live only past it. A
+        # failure leaves the compile gauges and the served deployment
+        # exactly as they were (the caller keeps the last-good model).
+        if self.swap_validate and warmup_errors:
+            raise SwapValidationError(
+                instance.id, "warm-up failed: " + "; ".join(warmup_errors))
+        self._validate_swap(deployment, instance)
+        self._m_compile_count, self._m_compile_seconds = m_count, m_seconds
         with self._lock:
+            prev_dep, prev_inst = self.deployment, self.instance
+            swapped = (prev_inst is not None
+                       and prev_inst.id != instance.id)
+            if swapped:
+                # Keep exactly ONE previous deployment resident (warm,
+                # device buffers intact): /rollback and the post-swap
+                # error-rate watch swap back to it instantly, with no
+                # storage round trip and no recompile.
+                self._previous = (prev_dep, prev_inst)
+                self._swap_count += 1
             self.deployment = deployment
             self.instance = instance
+            if swapped and self.swap_watch_ms > 0:
+                self._watch = {
+                    "until": _time.monotonic() + self.swap_watch_ms / 1e3,
+                    "total": 0, "errors": 0, "instance": instance.id,
+                }
         log.info("deployed engine instance %s", instance.id)
+        return True
+
+    def _validate_swap(self, deployment, instance) -> None:
+        """Swap gate (PIO_SWAP_VALIDATE, default on): nan_guard over
+        every model's arrays plus a smoke predict on the golden query
+        (instance runtime_conf["golden_query"] → $PIO_GOLDEN_QUERY →
+        the models' example_query protocol). The ``swap.validate``
+        fault point lets the chaos harness fail the gate
+        deterministically. Any failure raises
+        :class:`SwapValidationError` — the model never goes live."""
+        if not self.swap_validate:
+            return
+        from ..common.nan_guard import check_finite
+
+        try:
+            faultinject.fault_point("swap.validate")
+            for (algo_name, _algo), model in zip(deployment.algo_list,
+                                                 deployment.models):
+                check_finite(
+                    model, f"swap.validate[{algo_name or 'default'}]")
+            golden = self._golden_query(instance, deployment)
+            if golden is not None:
+                # Drive the DASE stages directly instead of
+                # Deployment.query: synthetic gate traffic must not
+                # consume chaos fault-point budgets (query.*) nor
+                # pollute the per-query stage histograms.
+                q = deployment.serving.supplement(dict(golden))
+                predictions = [
+                    algo.predict(model, q)
+                    for (_n, algo), model in zip(deployment.algo_list,
+                                                 deployment.models)
+                ]
+                deployment.serving.serve(q, predictions)
+            else:
+                log.debug("swap validation: no golden query available; "
+                          "skipping smoke predict")
+        except Exception as e:  # noqa: BLE001 - any failure refuses the swap
+            raise SwapValidationError(instance.id, str(e)) from e
+
+    def _golden_query(self, instance, deployment) -> Optional[dict]:
+        """The smoke-predict query: a known-good query stored on the
+        instance row (runtime_conf["golden_query"]), the operator's
+        $PIO_GOLDEN_QUERY, or the models' example_query() opt-in."""
+        raw = ((instance.runtime_conf or {}).get("golden_query")
+               or os.environ.get("PIO_GOLDEN_QUERY"))
+        if raw:
+            try:
+                doc = json.loads(raw)
+                if isinstance(doc, dict):
+                    return doc
+                log.warning("golden_query is not a JSON object; "
+                            "falling back to example_query")
+            except json.JSONDecodeError:
+                log.warning("golden_query is not valid JSON; falling "
+                            "back to example_query")
+        return self._find_example_query(deployment)
 
     @staticmethod
     def _find_example_query(deployment) -> Optional[dict]:
@@ -308,6 +495,9 @@ class EngineServer:
             # overload surface: the operator's no-scrape view of the
             # admission gate (`pio status --engine-url` prints this)
             "overload": self.overload_snapshot(),
+            # model-lifecycle surface: previous/pinned instances,
+            # rollback + swap-validation counters, refresh config
+            "lifecycle": self.lifecycle_snapshot(),
         }
         # measured serving-latency decomposition, when a probe ran
         # (pio deploy --probe-latency persists it to the instance row)
@@ -360,6 +550,35 @@ class EngineServer:
             ("pio_engine_drain_stragglers",
              "Accepted queries still unfinished when the drain "
              "deadline expired", ov["drainStragglers"]),
+        ):
+            fam = telemetry.GaugeFamily(name, help_)
+            fam.labels().set(value)
+            fams.append(fam)
+        lc = self.lifecycle_snapshot()
+        rb = telemetry.GaugeFamily(
+            "pio_engine_rollbacks_total",
+            "Deployment rollbacks to the retained previous model, by "
+            "reason (error-rate = automatic post-swap watch, manual = "
+            "/rollback)", ("reason",))
+        # always expose the automatic-rollback row so dashboards can
+        # alert on its first increment, plus any reasons already seen
+        for reason in sorted({"error-rate", *lc["rollbacks"]}):
+            rb.labels(reason).set(lc["rollbacks"].get(reason, 0))
+        fams.append(rb)
+        for name, help_, value in (
+            ("pio_engine_model_swaps_total",
+             "Hot swaps to a different engine instance since start "
+             "(reload, explicit target, or refresh)", lc["swaps"]),
+            ("pio_engine_swap_validate_failures_total",
+             "Reload/refresh attempts refused by the swap validation "
+             "gate (nan_guard, warm-up, golden-query smoke predict)",
+             lc["validateFailures"]),
+            ("pio_engine_pinned_instances",
+             "Engine instances pinned against redeployment (rolled "
+             "back or validation-refused)", len(lc["pinned"])),
+            ("pio_engine_model_refresh_swaps_total",
+             "Hot swaps performed by the continuous-refresh loop",
+             lc["refreshSwaps"]),
         ):
             fam = telemetry.GaugeFamily(name, help_)
             fam.labels().set(value)
@@ -492,11 +711,17 @@ class EngineServer:
             dl.check("executor pickup")
         return deployment.query(query)
 
-    async def _dispatch_query(self, deployment, query, dl):
+    async def _dispatch_query(self, deployment, query, dl,
+                              direct: bool = False):
         """The admission gate — the ONLY way a handler may hand a query
         to compute (guard-tested; a direct ``asyncio.to_thread(
         deployment.query, ...)`` would bypass the bounded executor,
         the shed path and the deadline budget).
+
+        ``direct=True`` skips the micro-batch queue: the batch worker
+        always dispatches against the LIVE deployment, so callers that
+        must run on a SPECIFIC one (the watch window's hedge onto the
+        retained previous model) go straight to the executor.
 
         Raises :class:`AdmissionShed` (→ 503) or
         :class:`deadline.DeadlineExceeded` (→ 504)."""
@@ -506,7 +731,7 @@ class EngineServer:
         slot_owned_by_future = False
         try:
             timeout = dl.remaining() if dl is not None else None
-            if self._batch_queue is not None:
+            if self._batch_queue is not None and not direct:
                 fut = asyncio.get_running_loop().create_future()
                 fut.add_done_callback(self._release_slot)
                 slot_owned_by_future = True
@@ -536,7 +761,12 @@ class EngineServer:
                 return await asyncio.wait_for(asyncio.shield(afut),
                                               timeout)
             except asyncio.TimeoutError:
-                if not cfut.cancel():
+                if cfut.cancel():
+                    # still queued: the model never saw this query —
+                    # the stage matters to the post-swap watch, which
+                    # must not blame the canary for queue starvation
+                    stage = "queued"
+                else:
                     # already running: the thread can't be killed; it
                     # frees itself at the next deadline spend-point
                     # (stage boundary / storage egress) and releases
@@ -544,8 +774,9 @@ class EngineServer:
                     # executor stays bounded
                     with self._adm_lock:
                         self._orphaned += 1
+                    stage = "await"
                 raise deadline.DeadlineExceeded(
-                    dl.budget_ms, dl.overrun_ms(), "await") from None
+                    dl.budget_ms, dl.overrun_ms(), stage) from None
         finally:
             if not slot_owned_by_future:
                 self._release_slot()
@@ -672,10 +903,23 @@ class EngineServer:
                 {"message": "no model deployed"}, status=503,
                 headers={"Retry-After": str(retry_after_jitter(2.0))})
         dl = self._request_deadline(request)
+        # Plugin hooks run OUTSIDE the watch-window accounting below: a
+        # plugin raising on particular client input is not evidence
+        # against a freshly-swapped model, and hedging past a failed
+        # before_query would serve the untransformed query.
         try:
             query = self.plugins.before_query(query)
+        except KeyError as e:
+            return web.json_response(
+                {"message": f"missing query field {e.args[0]!r}"}, status=400
+            )
+        except Exception as e:  # noqa: BLE001
+            log.exception("before_query plugin failed")
+            return web.json_response({"message": str(e)}, status=500)
+        try:
             result = await self._dispatch_query(deployment, query, dl)
-            result = self.plugins.after_query(query, result)
+            if self._watch is not None and self._is_live(deployment):
+                self._note_watch(ok=True)
         except AdmissionShed as e:
             self._shed_count += 1
             return web.json_response(
@@ -687,6 +931,18 @@ class EngineServer:
             # blind client retry may duplicate load, so the two cases
             # stay distinguishable
             self._deadline_count += 1
+            # A pathologically SLOW new model is a rollback trigger
+            # too: overruns whose stage shows compute was running count
+            # against the watch window (no hedge — the budget is
+            # spent). Queue-side stages are overload, not the model,
+            # and an overrun on a PRE-swap deployment still in flight
+            # is not evidence against the model that replaced it.
+            if (self._watch is not None
+                    and e.stage not in ("admission", "executor pickup",
+                                        "batch queue", "queued")
+                    and self._is_live(deployment)
+                    and self._note_watch(ok=False)):
+                self._rollback_to_previous("error-rate")
             return web.json_response({"message": str(e)}, status=504)
         except KeyError as e:
             return web.json_response(
@@ -694,6 +950,22 @@ class EngineServer:
             )
         except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500 w/ message
             log.exception("query failed")
+            # Inside a post-swap watch window: count the failure against
+            # the NEW model (rolling back past the error-rate threshold)
+            # and hedge this query onto the retained last-good model so
+            # the client still gets its answer.
+            hedged = await self._watched_failure(deployment, query, dl)
+            if hedged is None:
+                return web.json_response({"message": str(e)}, status=500)
+            result = hedged
+        try:
+            result = self.plugins.after_query(query, result)
+        except KeyError as e:
+            return web.json_response(
+                {"message": f"missing query field {e.args[0]!r}"}, status=400
+            )
+        except Exception as e:  # noqa: BLE001
+            log.exception("after_query plugin failed")
             return web.json_response({"message": str(e)}, status=500)
         probe = request.headers.get("X-Pio-Probe")
         # bytes comparison: compare_digest raises TypeError on non-ASCII
@@ -903,17 +1175,289 @@ class EngineServer:
             log.exception("probe-latency: persisting to instance row failed")
         return result
 
+    # -- post-swap watch + rollback ---------------------------------------
+    def lifecycle_snapshot(self) -> dict:
+        """Model-lifecycle state for /status and `pio status
+        --engine-url`: current/previous instance, pins, rollback and
+        validation counters, refresh/watch config."""
+        from . import model_artifact
+
+        with self._lock:
+            cur, prev = self.instance, self._previous
+            pinned = dict(self._pinned)
+        w = self._watch
+        return {
+            "instance": cur.id if cur else None,
+            "previous": prev[1].id if prev else None,
+            # process-wide: every model blob the verifying loader
+            # refused in this process, by failure kind
+            "integrityFailures": model_artifact.integrity_failure_counts(),
+            "pinned": pinned,
+            "rollbacks": dict(self._rollbacks),
+            "swaps": self._swap_count,
+            "validateFailures": self._validate_failures,
+            "validate": self.swap_validate,
+            "refreshMs": self.model_refresh_ms,
+            "refreshSwaps": self._refresh_swaps,
+            "watchMs": self.swap_watch_ms,
+            "maxErrorRate": self.swap_max_error_rate,
+            "watch": ({"total": w["total"], "errors": w["errors"]}
+                      if w is not None else None),
+        }
+
+    def _is_live(self, deployment) -> bool:
+        """Whether ``deployment`` is the one currently published — watch
+        accounting must ignore outcomes of queries dispatched to a
+        PRE-swap deployment that were still in flight when the swap
+        landed."""
+        with self._lock:
+            return self.deployment is deployment
+
+    def _note_watch(self, ok: bool) -> bool:
+        """Record one query outcome against the post-swap watch window
+        (loop context only). Returns True when the error rate tripped
+        the rollback threshold — at least 2 failures AND a failure
+        fraction above PIO_SWAP_MAX_ERROR_RATE, so one flaky query
+        can't roll back a healthy model."""
+        w = self._watch
+        if w is None:
+            return False
+        with self._lock:
+            cur = self.instance
+        if cur is None or w["instance"] != cur.id:
+            # a newer swap/rollback superseded this window — but only
+            # clear OUR snapshot: a concurrent _load (worker thread) may
+            # have already installed the NEW swap's watch, which must
+            # not be disarmed by a query that raced the swap
+            if self._watch is w:
+                self._watch = None
+            return False
+        if _time.monotonic() > w["until"]:
+            log.info("post-swap watch for %s closed clean (%d queries, "
+                     "%d errors)", w["instance"], w["total"], w["errors"])
+            if self._watch is w:
+                self._watch = None
+            return False
+        w["total"] += 1
+        if not ok:
+            w["errors"] += 1
+            if (w["errors"] >= 2
+                    and w["errors"] / w["total"] > self.swap_max_error_rate):
+                return True
+        return False
+
+    def _rollback_to_previous(self, reason: str) -> Optional[str]:
+        """Instant swap back to the resident previous deployment (no
+        storage round trip, no recompile — it stayed warm). The bad
+        instance is PINNED so neither the latest-completed walk nor the
+        refresh loop re-picks it; its blob is never deleted. Returns
+        the restored instance id, or None when no previous deployment
+        is resident."""
+        with self._lock:
+            if self._previous is None:
+                return None
+            bad_inst = self.instance
+            self.deployment, self.instance = self._previous
+            self._previous = None
+            restored = self.instance
+        self._watch = None
+        with self._lock:
+            self._pinned[bad_inst.id] = reason
+        self._rollbacks[reason] = self._rollbacks.get(reason, 0) + 1
+        self._degraded_reason = (
+            f"rolled back from {bad_inst.id} to {restored.id} ({reason}) "
+            f"at {_dt.datetime.now(_dt.timezone.utc).isoformat()}; "
+            f"{bad_inst.id} pinned until an operator reloads it "
+            "explicitly")
+        log.warning("automatic rollback (%s): %s → %s; %s pinned",
+                    reason, bad_inst.id, restored.id, bad_inst.id)
+        return restored.id
+
+    async def _watched_failure(self, deployment, query, dl):
+        """A query failed on a deployment inside its post-swap watch
+        window: hedge it onto the last-good deployment, and — only when
+        last-good SUCCEEDS on the same query (differential diagnosis:
+        a query that fails on both models is the query's problem, not
+        the canary's) — count the failure against the new model,
+        rolling back past the error-rate threshold. Either way the
+        client gets the hedged answer instead of the canary's 500.
+        Returns the hedged result, or None (caller answers the
+        original error)."""
+        w = self._watch
+        if w is None:
+            return None
+        with self._lock:
+            live_dep = self.deployment
+            prev = self._previous
+            cur = self.instance
+        # prune an expired or superseded window BEFORE hedging: outside
+        # the watch the client must get the live model's real error,
+        # not a silent answer from a long-superseded previous model
+        if cur is None or w["instance"] != cur.id:
+            if self._watch is w:     # superseded by a newer swap
+                self._watch = None
+            return None
+        if _time.monotonic() > w["until"]:
+            log.info("post-swap watch for %s closed clean (%d queries, "
+                     "%d errors)", w["instance"], w["total"], w["errors"])
+            if self._watch is w:
+                self._watch = None
+            return None
+        if live_dep is not deployment:
+            # a concurrent query already rolled back: serve the restored
+            try:
+                return await self._dispatch_query(live_dep, query, dl,
+                                                  direct=True)
+            except Exception:  # noqa: BLE001 - original error stands
+                log.exception("retry on restored model failed")
+                return None
+        if prev is None:
+            return None
+        try:
+            # direct=True: the micro-batch queue would dispatch against
+            # the LIVE (canary) deployment, defeating the hedge
+            result = await self._dispatch_query(prev[0], query, dl,
+                                                direct=True)
+        except Exception:  # noqa: BLE001 - query fails on BOTH models
+            log.exception("hedged retry on last-good model failed too; "
+                          "not counting against the new model")
+            return None
+        if self._note_watch(ok=False):
+            self._rollback_to_previous("error-rate")
+        return result
+
+    async def handle_rollback(self, request: web.Request) -> web.Response:
+        """Operator rollback to the retained previous deployment
+        (`pio models rollback --engine-url` / `pio deploy --rollback`).
+        Instant — the previous model stayed resident — and pins the
+        rolled-back instance so refresh/reload-latest won't re-pick
+        it."""
+        if self._reload_lock.locked():
+            self._reload_conflicts += 1
+            return web.json_response(
+                {"message": "reload in progress; retry shortly"},
+                status=409)
+        async with self._reload_lock:
+            restored = self._rollback_to_previous("manual")
+        if restored is None:
+            return web.json_response(
+                {"message": "no previous deployment resident to roll "
+                            "back to"}, status=409)
+        return web.json_response(
+            {"message": "Rolled back", "engineInstanceId": restored})
+
+    # -- continuous refresh ------------------------------------------------
+    async def _start_refresher(self, app) -> None:
+        if self.model_refresh_ms > 0:
+            self._refresh_task = asyncio.get_running_loop().create_task(
+                self._refresh_loop())
+
+    async def _stop_refresher(self, app) -> None:
+        task, self._refresh_task = self._refresh_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    async def _refresh_loop(self) -> None:
+        """Continuous model refresh (PIO_MODEL_REFRESH_MS > 0): poll
+        for a newer COMPLETED instance and hot-swap it through the SAME
+        validated gate as /reload. A validation failure pins the
+        candidate (it will fail again — NaN models don't heal) and
+        stays on last-good; a poll/storage error is logged and retried
+        next tick. The loop must never die."""
+        log.info("model refresh loop armed (every %.0f ms)",
+                 self.model_refresh_ms)
+        while True:
+            await asyncio.sleep(self.model_refresh_ms / 1000.0)
+            try:
+                await self._refresh_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - poll errors never kill it
+                log.exception("model refresh poll failed; retrying next "
+                              "tick")
+
+    async def _refresh_once(self) -> None:
+        candidate = await asyncio.to_thread(self._newer_candidate)
+        if candidate is None or self._reload_lock.locked():
+            return
+        async with self._reload_lock:
+            log.info("refresh: newer COMPLETED instance %s; validating "
+                     "hot swap", candidate.id)
+            rejected: list[tuple[str, str]] = []
+            try:
+                swapped = await asyncio.to_thread(
+                    self._load, None, True,
+                    lambda iid, kind: rejected.append((iid, kind)))
+            except SwapValidationError as e:
+                self._validate_failures += 1
+                with self._lock:
+                    self._pinned[e.instance_id] = "validate"
+                self._degraded_reason = (
+                    f"refresh: {e}; serving last-good model "
+                    f"({e.instance_id} pinned)")
+                log.warning("refresh swap refused: %s", e)
+            except Exception as e:  # noqa: BLE001 - stay on last-good
+                self._degraded_reason = (
+                    f"refresh reload failed at "
+                    f"{_dt.datetime.now(_dt.timezone.utc).isoformat()}: "
+                    f"{e}; serving last-good model")
+                log.exception("refresh reload failed; continuing on "
+                              "last-good model")
+            else:
+                if swapped:
+                    self._refresh_swaps += 1
+                # the load SUCCEEDED — whether it swapped or confirmed
+                # the live instance is still the newest deployable, a
+                # degraded reason from an earlier transient refresh
+                # failure no longer describes reality
+                self._degraded_reason = None
+            # pin integrity-rejected candidates: a corrupt blob won't
+            # heal, and without the pin every poll would re-walk (and
+            # re-count) the same corpse
+            for iid, kind in rejected:
+                with self._lock:
+                    self._pinned.setdefault(iid, f"integrity:{kind}")
+                log.warning("refresh: pinned undeployable instance %s "
+                            "(%s)", iid, kind)
+
+    def _newer_candidate(self):
+        """Worker-thread poll: the newest non-pinned COMPLETED instance
+        strictly newer than the live one, or None when up to date."""
+        instances = self.storage.get_meta_data_engine_instances()
+        with self._lock:
+            cur = self.instance
+        done = instances.get_completed(
+            self.engine_factory_name or "engine", "1", self.engine_variant)
+        with self._lock:
+            pinned = set(self._pinned)
+        for c in done:
+            if c.id in pinned:
+                continue
+            if cur is not None and (c.id == cur.id
+                                    or c.start_time <= cur.start_time):
+                return None
+            return c
+        return None
+
     async def handle_reload(self, request: web.Request) -> web.Response:
         """Hot-swap to the latest completed instance (reference: /reload →
-        MasterActor ! ReloadServer). A failed reload NEVER takes down
-        serving: the last-good model stays live and the server enters
-        degraded mode (visible on /status and /readyz) until a reload
-        succeeds.
+        MasterActor ! ReloadServer), or — with ``?instance=<id>`` — to an
+        EXPLICIT engine instance (operator rollback/pin-override to a
+        known-good version; the target is verified and validated like
+        any other swap, and un-pinned on success). A failed reload NEVER
+        takes down serving: the last-good model stays live and the
+        server enters degraded mode (visible on /status and /readyz)
+        until a reload succeeds.
 
         Serialized: two concurrent /reload calls race `_load` (two
         warm-ups, interleaved compile-gauge swaps, last-writer-wins on
         the deployment) — the loser gets 409 and retries once the
         winner finishes."""
+        target = request.query.get("instance") or None
         if self._reload_lock.locked():
             self._reload_conflicts += 1
             return web.json_response(
@@ -923,8 +1467,10 @@ class EngineServer:
                 status=409)
         async with self._reload_lock:
             try:
-                await asyncio.to_thread(self._load, None)
+                await asyncio.to_thread(self._load, target)
             except Exception as e:  # noqa: BLE001
+                if isinstance(e, SwapValidationError):
+                    self._validate_failures += 1
                 self._degraded_reason = (
                     f"reload failed at "
                     f"{_dt.datetime.now(_dt.timezone.utc).isoformat()}: {e}; "
@@ -935,6 +1481,11 @@ class EngineServer:
                      "engineInstanceId":
                          self.instance.id if self.instance else None},
                     status=500)
+            if target:
+                # the operator explicitly chose (and the gate passed)
+                # this version — a standing pin no longer applies
+                with self._lock:
+                    self._pinned.pop(target, None)
         self._degraded_reason = None
         return web.json_response(
             {"message": "Reloaded", "engineInstanceId": self.instance.id}

@@ -1,0 +1,255 @@
+"""Verified model artifacts — the ONE path between workflow code and the
+Models DAO.
+
+Every model blob written by `run_train` is wrapped in a self-describing
+envelope (magic + JSON header carrying sha256, payload size and a format
+version) and every read re-verifies it, so a truncated, bit-flipped or
+half-written artifact is detected at LOAD time instead of surfacing as a
+garbage model in production serving. The envelope lives inside the
+``Model.models`` bytes, so it round-trips identically through every
+Models backend (sqlite blob column, memory dict, localfs file, the HTTP
+blob routes, S3/HDFS objects) with no schema migration.
+
+Rules of the house (guard-tested in tests/test_model_lifecycle.py):
+
+- Nothing under ``workflow/`` may call ``get_model_data_models`` except
+  this module — all reads go through :func:`read_model` so the
+  verification cannot be bypassed (the PR 3/6/8 single-path pattern).
+- A blob that fails verification is NEVER deleted (PR 8 quarantine
+  discipline: keep the evidence); callers walk back to an older
+  COMPLETED instance instead.
+- Pre-upgrade blobs (bare pickle, no envelope) are accepted with a
+  warning counter — an in-place upgrade must not brick existing
+  deployments — but anything that is neither a valid envelope nor a
+  pickle is an integrity failure, so a bit-flip inside the envelope
+  header can not demote a checksummed artifact to "legacy".
+
+Failure kinds (``pio_model_integrity_failures_total{kind}``):
+``missing`` (COMPLETED row without a model — the crash-mid-persist
+window), ``header`` (envelope magic/structure damaged), ``version``
+(written by a newer format), ``size`` (payload length mismatch —
+truncation), ``checksum`` (sha256 mismatch — corruption), and
+``deserialize`` (payload verified but unpicklable; counted by the
+caller via :func:`count_integrity_failure`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import struct
+from typing import Optional
+
+from ..common import faultinject, telemetry
+from ..data.storage.base import Model
+
+log = logging.getLogger("pio.model_artifact")
+
+#: Envelope magic. Pickled payloads (protocol 2+) always start with
+#: b"\x80", so a stored blob is unambiguously an envelope, a legacy
+#: pickle, or damaged.
+MAGIC = b"PIOM"
+FORMAT_VERSION = 1
+_LEN = struct.Struct(">I")
+
+_INTEGRITY_FAILURES = telemetry.registry().counter(
+    "pio_model_integrity_failures_total",
+    "Model blobs refused by the verifying loader, by failure kind "
+    "(missing/header/version/size/checksum/deserialize)",
+    ("kind",))
+_LEGACY_LOADS = telemetry.registry().counter(
+    "pio_model_legacy_loads_total",
+    "Pre-checksum model blobs accepted without verification (written "
+    "before the envelope format; re-train to upgrade)")
+
+
+class ModelIntegrityError(RuntimeError):
+    """This instance's stored model is not deployable (and why)."""
+
+    def __init__(self, instance_id: str, kind: str, detail: str):
+        super().__init__(
+            f"model for engine instance {instance_id} is not deployable "
+            f"({kind}): {detail}")
+        self.instance_id = instance_id
+        self.kind = kind
+
+
+def count_integrity_failure(kind: str) -> None:
+    _INTEGRITY_FAILURES.labels(kind).inc()
+
+
+def integrity_failure_counts() -> dict[str, int]:
+    """Process-wide loader refusals by kind (the /status lifecycle
+    surface; `pio status --engine-url` prints it without scraping)."""
+    return {labels[0]: child.value()
+            for labels, child in _INTEGRITY_FAILURES.samples()}
+
+
+def _fail(instance_id: str, kind: str, detail: str) -> ModelIntegrityError:
+    count_integrity_failure(kind)
+    return ModelIntegrityError(instance_id, kind, detail)
+
+
+def compute_sha256(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+def wrap(payload: bytes, sha256: Optional[str] = None) -> bytes:
+    """Serialized-models payload → checksummed envelope bytes.
+    ``sha256`` may be passed when the caller already computed it (big
+    blobs: hashing a multi-GB factor matrix twice doubles the
+    persistence window's checksum cost)."""
+    header = json.dumps({
+        "v": FORMAT_VERSION,
+        "sha256": sha256 or compute_sha256(payload),
+        "size": len(payload),
+    }, sort_keys=True).encode()
+    return MAGIC + _LEN.pack(len(header)) + header + payload
+
+
+def describe(blob: Optional[bytes]) -> dict:
+    """Non-raising inspection for the `pio models` CLI: classify a
+    stored blob without loading it. Returns ``format`` ("v<N>" /
+    "legacy" / "invalid"), declared + actual metadata, ``ok`` and the
+    failure ``kind`` (None when verified or legacy)."""
+    if blob is None:
+        return {"format": "missing", "ok": False, "kind": "missing",
+                "size": 0, "sha256": None}
+    blob = bytes(blob)
+    if not blob.startswith(MAGIC):
+        if blob[:1] == b"\x80":
+            return {"format": "legacy", "ok": True, "kind": None,
+                    "size": len(blob), "sha256": None}
+        return {"format": "invalid", "ok": False, "kind": "header",
+                "size": len(blob), "sha256": None}
+    try:
+        header, payload = _split(blob)
+    except ValueError as e:
+        return {"format": "invalid", "ok": False, "kind": "header",
+                "size": len(blob), "sha256": None, "detail": str(e)}
+    v = header.get("v")
+    out = {"format": f"v{v}", "size": header.get("size"),
+           "sha256": header.get("sha256"), "ok": True, "kind": None}
+    # same classification as unwrap_verified, so `pio models` verdicts,
+    # pin reasons, and the per-kind counter all name one kind per blob
+    if not isinstance(v, int) or v < 1:
+        out.update(ok=False, kind="header")
+    elif v > FORMAT_VERSION:
+        out.update(ok=False, kind="version")
+    elif len(payload) != header.get("size"):
+        out.update(ok=False, kind="size", actual_size=len(payload))
+    elif compute_sha256(payload) != header.get("sha256"):
+        out.update(ok=False, kind="checksum")
+    return out
+
+
+def _split(blob: bytes) -> tuple[dict, bytes]:
+    """Envelope bytes → (header dict, payload). Raises ValueError on any
+    structural damage."""
+    if len(blob) < len(MAGIC) + _LEN.size:
+        raise ValueError("envelope shorter than its fixed header")
+    (hlen,) = _LEN.unpack_from(blob, len(MAGIC))
+    start = len(MAGIC) + _LEN.size
+    if hlen <= 0 or start + hlen > len(blob):
+        raise ValueError(f"envelope header length {hlen} out of range")
+    try:
+        header = json.loads(blob[start:start + hlen])
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"envelope header is not JSON: {e}") from None
+    if not isinstance(header, dict):
+        raise ValueError("envelope header is not an object")
+    return header, blob[start + hlen:]
+
+
+def unwrap_verified(blob: bytes, instance_id: str) -> bytes:
+    """Envelope bytes → verified payload. Legacy (pre-envelope) pickles
+    are accepted with a warning counter; everything else must verify.
+    Raises :class:`ModelIntegrityError` (and counts the kind) on any
+    mismatch. Never mutates or deletes the stored blob."""
+    blob = bytes(blob)
+    if not blob.startswith(MAGIC):
+        if blob[:1] == b"\x80":
+            # Pre-upgrade artifact: no metadata to verify. Accepted —
+            # refusing would brick every deployment on upgrade day —
+            # but counted, so operators can see unverifiable models.
+            _LEGACY_LOADS.labels().inc()
+            log.warning(
+                "model for engine instance %s predates checksummed "
+                "artifacts; loading unverified (re-train to upgrade)",
+                instance_id)
+            return blob
+        raise _fail(instance_id, "header",
+                    f"blob is neither an envelope nor a pickle "
+                    f"(first bytes {blob[:8]!r})")
+    try:
+        header, payload = _split(blob)
+    except ValueError as e:
+        raise _fail(instance_id, "header", str(e)) from None
+    v = header.get("v")
+    if not isinstance(v, int) or v < 1:
+        raise _fail(instance_id, "header", f"bad format version {v!r}")
+    if v > FORMAT_VERSION:
+        raise _fail(instance_id, "version",
+                    f"written by format v{v}, this build reads up to "
+                    f"v{FORMAT_VERSION}")
+    if len(payload) != header.get("size"):
+        raise _fail(instance_id, "size",
+                    f"payload is {len(payload)} bytes, header declares "
+                    f"{header.get('size')} (truncated or overwritten)")
+    actual = compute_sha256(payload)
+    if actual != header.get("sha256"):
+        raise _fail(instance_id, "checksum",
+                    f"sha256 {actual[:12]}… does not match declared "
+                    f"{str(header.get('sha256'))[:12]}… (corruption)")
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# The DAO chokepoints (guard: the only Models access under workflow/)
+# ---------------------------------------------------------------------------
+
+
+def write_model(storage, instance_id: str, payload: bytes) -> str:
+    """Persist a trained payload as a checksummed artifact; returns the
+    payload's sha256 hex (computed exactly once) so callers can log it.
+    The ``model.insert`` fault point sits in front of the DAO write —
+    the crash harness uses it to SIGKILL a train inside the persistence
+    window."""
+    sha = compute_sha256(payload)
+    faultinject.fault_point("model.insert")
+    storage.get_model_data_models().insert(
+        Model(instance_id, wrap(payload, sha)))
+    return sha
+
+
+def read_model(storage, instance_id: str) -> bytes:
+    """Fetch + verify the stored model payload for an instance.
+    Raises :class:`ModelIntegrityError` (kind="missing") when the row
+    does not exist — a COMPLETED instance without a model is exactly
+    the crash-mid-persist state the loader must skip, not serve."""
+    row = storage.get_model_data_models().get(instance_id)
+    if row is None:
+        raise _fail(instance_id, "missing",
+                    "no model row (crash between train and persistence, "
+                    "or GC'd)")
+    return unwrap_verified(row.models, instance_id)
+
+
+def get_model_row(storage, instance_id: str) -> Optional[Model]:
+    """Raw row fetch for inspection tooling (`pio models`): no
+    verification, no counters."""
+    return storage.get_model_data_models().get(instance_id)
+
+
+def model_exists(storage, instance_id: str) -> bool:
+    """Row-existence probe (no blob transfer on backends that can
+    check metadata) — `pio models gc` ranks with this instead of
+    reading every artifact."""
+    return storage.get_model_data_models().exists(instance_id)
+
+
+def delete_model(storage, instance_id: str) -> None:
+    """GC chokepoint (`pio models gc`). Deliberately NOT called by any
+    failure path — corrupt blobs are kept for forensics."""
+    storage.get_model_data_models().delete(instance_id)

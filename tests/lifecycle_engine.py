@@ -1,0 +1,78 @@
+"""A tiny jax-free DASE engine for the model-lifecycle chaos harness
+(tests/test_model_lifecycle.py + tests/lifecycle_server.py).
+
+The algorithm's params select what kind of model a train produces:
+
+- ``mode=good``    — answers every query
+- ``mode=poison``  — passes the swap validation gate (the golden query
+  "golden" still works, arrays are finite) but raises on every OTHER
+  user: the canary regime the post-swap error-rate watch must catch
+- ``mode=nan``     — carries a NaN weight array: the nan_guard leg of
+  the validation gate must refuse it before it ever serves
+
+Both the test process and the subprocess server import this module by
+name, so pickled models round-trip across processes."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from incubator_predictionio_tpu.controller.algorithm import Algorithm
+from incubator_predictionio_tpu.controller.datasource import DataSource
+from incubator_predictionio_tpu.controller.engine import Engine
+
+
+@dataclasses.dataclass
+class LifecycleModel:
+    tag: str
+    mode: str
+    weights: np.ndarray
+
+    def example_query(self):
+        # the warm-up / probe / swap-gate golden query protocol
+        return {"user": "golden"}
+
+
+class LifecycleDataSource(DataSource):
+    def read_training(self, ctx):
+        return None
+
+
+class LifecycleAlgorithm(Algorithm):
+    def _params(self) -> dict:
+        return self.params if isinstance(self.params, dict) else {}
+
+    def train(self, ctx, prepared_data):
+        p = self._params()
+        mode = str(p.get("mode", "good"))
+        weights = (np.array([1.0, float("nan")]) if mode == "nan"
+                   else np.ones(3))
+        return LifecycleModel(tag=str(p.get("tag", "")), mode=mode,
+                              weights=weights)
+
+    def predict(self, model, query):
+        user = query["user"]
+        if model.mode == "poison" and user != "golden":
+            raise RuntimeError("poisoned model: predict exploded")
+        return {"user": user, "tag": model.tag,
+                "score": float(model.weights[0])}
+
+    # no jax: the pickled payload is the model itself
+    def prepare_model_for_persistence(self, model):
+        return model
+
+    def restore_model(self, stored, ctx):
+        return stored
+
+
+def engine_factory() -> Engine:
+    return Engine(LifecycleDataSource, None, {"": LifecycleAlgorithm}, None)
+
+
+def engine_params(tag: str, mode: str = "good"):
+    from incubator_predictionio_tpu.controller.engine import EngineParams
+
+    return EngineParams(algorithm_params_list=[
+        ("", {"tag": tag, "mode": mode})])

@@ -1,0 +1,43 @@
+"""Engine-server subprocess for the model-lifecycle e2e harness
+(tests/test_model_lifecycle.py).
+
+Runs the REAL `run_engine_server` against the storage configured in the
+inherited environment, serving the jax-free lifecycle engine
+(tests/lifecycle_engine.py). Lifecycle knobs (PIO_MODEL_REFRESH_MS,
+PIO_SWAP_WATCH_MS, PIO_SWAP_MAX_ERROR_RATE, PIO_SWAP_VALIDATE) arrive
+through the environment; the TEST process trains good/poisoned
+instances into the shared SQLITE store while this process serves and
+refreshes.
+
+Usage: python lifecycle_server.py <port>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    import logging
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(name)s %(message)s")
+    logging.getLogger("aiohttp.access").setLevel(logging.WARNING)
+    port = int(sys.argv[1])
+    import lifecycle_engine
+
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.workflow.create_server import (
+        EngineServer, run_engine_server)
+
+    server = EngineServer(lifecycle_engine.engine_factory(),
+                          engine_factory_name="lifecycle",
+                          storage=Storage.instance())
+    run_engine_server(server, "127.0.0.1", port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

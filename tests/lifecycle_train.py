@@ -1,0 +1,37 @@
+"""One `run_train` of the lifecycle engine in a subprocess — the
+model-persistence crash harness (tests/test_model_lifecycle.py).
+
+The storage config arrives via the inherited environment; PIO_FAULT_SPEC
+(e.g. ``model.insert:crash:1``) SIGKILLs the process at the armed fault
+point, leaving whatever state reached storage for the test to assert
+on.
+
+Usage: python lifecycle_train.py <tag> [mode]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    tag = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "good"
+    import lifecycle_engine
+
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import run_train
+
+    ctx = WorkflowContext(storage=Storage.instance())
+    iid = run_train(lifecycle_engine.engine_factory(),
+                    lifecycle_engine.engine_params(tag, mode), ctx,
+                    engine_factory_name="lifecycle")
+    print(f"TRAINED {iid}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
