@@ -50,6 +50,8 @@ import time
 import uuid
 from typing import Callable, Iterable, Optional
 
+from . import envknobs
+
 __all__ = [
     "CounterFamily", "GaugeFamily", "HistogramFamily", "Registry",
     "Trace", "TraceRecorder", "TRACE_HEADER",
@@ -67,10 +69,7 @@ TRACE_HEADER = "X-Pio-Trace-Id"
 # ---------------------------------------------------------------------------
 
 def _env_flag(name: str, default: bool) -> bool:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    return raw.strip().lower() not in ("0", "off", "false", "no")
+    return envknobs.env_flag(name, default)
 
 
 class _State:
@@ -520,18 +519,17 @@ class TraceRecorder:
     def __init__(self, rate: Optional[float] = None,
                  sink: Optional[str] = None):
         if rate is None:
-            raw = (os.environ.get("PIO_TRACE") or "").strip().lower()
+            raw = envknobs.env_str("PIO_TRACE", "")
             if raw in ("", "0", "off", "false", "no"):
                 rate = 0.0
             elif raw in ("1", "on", "true", "yes"):
                 rate = 1.0
             else:
-                try:
-                    rate = float(raw)
-                except ValueError:
-                    rate = 0.0
+                rate = envknobs.env_float("PIO_TRACE", 0.0)
         self.rate = max(0.0, min(1.0, float(rate)))
-        self.sink = sink or os.environ.get("PIO_TRACE_SINK") or "stderr"
+        self.sink = (sink
+                     or envknobs.env_str("PIO_TRACE_SINK", "", lower=False)
+                     or "stderr")
         self._lock = threading.Lock()
 
     @property

@@ -132,7 +132,7 @@ class Lease:
     wrote. ``verify()`` re-reads the on-disk epoch; callers run it
     before every write group."""
 
-    __slots__ = ("path", "partition", "epoch", "_fd", "forced")
+    __slots__ = ("path", "partition", "epoch", "_fd", "_fd_lock", "forced")
 
     def __init__(self, path: str, partition: int, epoch: int, fd: int,
                  forced: bool = False):
@@ -140,14 +140,24 @@ class Lease:
         self.partition = partition
         self.epoch = epoch
         self._fd = fd
+        # verify() runs on commit worker threads while shutdown-side
+        # release() closes the fd: without the lock a straggler verify
+        # could pread a closed (or, worse, kernel-reused) descriptor —
+        # or trip a bare TypeError on the None it raced. Guarded
+        # accesses are enforced by the lint lock-discipline rule.
+        self._fd_lock = threading.Lock()
         self.forced = forced
 
     def verify(self) -> None:
         """Raise :class:`PartitionFencedError` unless the on-disk epoch
-        is still ours. An unreadable/garbled body also fences — the
-        safe direction is refusing the write."""
+        is still ours. An unreadable/garbled body — or a lease this
+        process already released — also fences: the safe direction is
+        refusing the write."""
         try:
-            body = os.pread(self._fd, 4096, 0)
+            with self._fd_lock:
+                if self._fd is None:
+                    raise OSError("lease released")
+                body = os.pread(self._fd, 4096, 0)
             current = json.loads(body.decode("utf-8"))["epoch"]
         except (OSError, ValueError, KeyError, UnicodeDecodeError):
             raise PartitionFencedError(
@@ -160,12 +170,13 @@ class Lease:
                 "worker owns this partition now")
 
     def release(self) -> None:
-        if self._fd is not None:
-            try:
-                os.close(self._fd)  # closing drops the flock
-            except OSError:  # pragma: no cover — already closed
-                pass
-            self._fd = None
+        with self._fd_lock:
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)  # closing drops the flock
+                except OSError:  # pragma: no cover — already closed
+                    pass
+                self._fd = None
 
     def to_json(self) -> dict:
         return {"partition": self.partition, "epoch": self.epoch,

@@ -69,11 +69,8 @@ class EventServer:
         # one metadata lookup PER ingested event — the single-POST hot
         # path. Key revocation/whitelist edits take effect within the
         # TTL; PIO_ACCESSKEY_CACHE_SECS=0 restores per-request lookups.
-        try:
-            self._key_ttl = float(
-                os.environ.get("PIO_ACCESSKEY_CACHE_SECS", "5"))
-        except ValueError:
-            self._key_ttl = 5.0
+        self._key_ttl = envknobs.env_float(
+            "PIO_ACCESSKEY_CACHE_SECS", 5.0, lo=0.0)
         self._key_cache: dict = {}  # key -> (expires_monotonic, AccessKey)
         # load-shed accounting: requests refused because the storage
         # backend's circuit breaker is open or the ingest buffer is full
@@ -87,7 +84,7 @@ class EventServer:
         # exits: the supervisor's backoff retries until the previous
         # owner is gone.
         self.lease = None
-        part = os.environ.get("PIO_EVENT_PARTITION", "").strip()
+        part = envknobs.env_str("PIO_EVENT_PARTITION", "")
         if part.isdigit():
             from . import event_log
 
@@ -197,7 +194,7 @@ class EventServer:
 
     # -- background tasks (worker heartbeat, compaction) -------------------
     async def _start_background(self, app) -> None:
-        if os.environ.get("PIO_WORKER_HEARTBEAT_FILE"):
+        if envknobs.env_str("PIO_WORKER_HEARTBEAT_FILE", "", lower=False):
             self._bg_tasks.append(
                 asyncio.get_running_loop().create_task(
                     self._heartbeat_loop()))
@@ -237,7 +234,11 @@ class EventServer:
         while True:
             await asyncio.sleep(self._compact_interval)
             try:
-                for name in sorted(os.listdir(log_dir)):
+                # the directory listing is disk I/O too — a cold or
+                # contended volume must stall a worker thread, not the
+                # accept loop
+                names = await asyncio.to_thread(os.listdir, log_dir)
+                for name in sorted(names):
                     if not name.endswith(own_suffix):
                         continue
                     await asyncio.to_thread(

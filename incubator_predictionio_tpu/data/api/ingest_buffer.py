@@ -71,6 +71,7 @@ import errno
 import json
 import logging
 import os
+import threading
 import time
 from collections import Counter
 from typing import Optional, Sequence
@@ -224,12 +225,12 @@ class IngestConfig:
 
     @classmethod
     def from_env(cls) -> "IngestConfig":
-        mode = os.environ.get("PIO_INGEST_GROUP", "auto").strip().lower()
+        mode = envknobs.env_str("PIO_INGEST_GROUP", "auto")
         return cls(
             enabled=mode not in ("off", "0", "false", "no"),
             group_max=_env_int("PIO_INGEST_GROUP_MAX", 256),
             group_ms=_env_float("PIO_INGEST_GROUP_MS", 0.0),
-            ack=os.environ.get("PIO_INGEST_ACK", "commit").strip().lower(),
+            ack=envknobs.env_str("PIO_INGEST_ACK", "commit"),
             max_pending=_env_int("PIO_INGEST_MAX_PENDING", 10_000),
         )
 
@@ -300,9 +301,10 @@ class IngestBuffer:
         self._pending = 0
         self._draining = False
         # disk-fault shed mode: key -> (monotonic shed-until, streak);
-        # written from commit threads, read from the loop — values are
-        # immutable tuples so torn reads are impossible under the GIL
+        # written from commit threads, read from the loop — every
+        # access holds _shed_lock (the lint lock-discipline contract)
         self._shed: dict[Key, tuple[float, int]] = {}
+        self._shed_lock = threading.Lock()
         self._shed_window = envknobs.env_float(
             "PIO_INGEST_SHED_MS", 5000.0, lo=100.0) / 1000.0
         # observability (GET / and tests)
@@ -343,12 +345,13 @@ class IngestBuffer:
             "maxGroup": self.max_group,
             "droppedEvents": self.dropped,
         }
-        if self.shed_appends or self._shed:
+        with self._shed_lock:
+            shed_values = list(self._shed.values())
+        if self.shed_appends or shed_values:
             now = time.monotonic()
             out["shedAppends"] = self.shed_appends
-            # list() first: commit threads insert/pop keys concurrently
             out["shedding"] = sum(
-                1 for until, _ in list(self._shed.values()) if until > now)
+                1 for until, _ in shed_values if until > now)
         if self.lease is not None:
             out["lease"] = self.lease.to_json()
         if self.wal is not None:
@@ -371,7 +374,8 @@ class IngestBuffer:
         if self._draining:
             raise IngestOverloadError("event server is shutting down")
         if key is not None:
-            shed = self._shed.get(key)
+            with self._shed_lock:
+                shed = self._shed.get(key)
             if shed is not None:
                 remaining = shed[0] - time.monotonic()
                 if remaining > 0:
@@ -391,18 +395,20 @@ class IngestBuffer:
         append failure; returns the window length. Doubling backoff,
         capped at 60s — a recovered disk is probed by the first request
         after the window (half-open, breaker style)."""
-        prev = self._shed.get(key)
-        streak = (prev[1] + 1) if prev is not None else 0
-        window = min(60.0, self._shed_window * (2.0 ** streak))
-        self._shed[key] = (time.monotonic() + window, streak)
+        with self._shed_lock:
+            prev = self._shed.get(key)
+            streak = (prev[1] + 1) if prev is not None else 0
+            window = min(60.0, self._shed_window * (2.0 ** streak))
+            self._shed[key] = (time.monotonic() + window, streak)
         _M_APPEND_ERRORS.labels(kind).inc()
         log.error("append failed (%s) for %s: shedding writes for "
                   "%.1fs", kind, key, window)
         return window
 
     def _note_append_ok(self, key: Key) -> None:
-        if self._shed:
-            self._shed.pop(key, None)
+        with self._shed_lock:
+            if self._shed:
+                self._shed.pop(key, None)
 
     def _enqueue(self, key: Key, entry: _Pending, admit: bool = True) -> None:
         self._bind_loop()

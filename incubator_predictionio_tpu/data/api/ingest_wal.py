@@ -58,7 +58,7 @@ import threading
 import zlib
 from typing import Optional
 
-from ...common import telemetry
+from ...common import envknobs, telemetry
 from ...common.faultinject import fault_point
 from ..storage.jsonl import AppendHandle
 
@@ -121,8 +121,7 @@ def quarantine_path(path: str, kind: str) -> Optional[str]:
 
 
 def _env_flag(name: str) -> bool:
-    return os.environ.get(name, "").strip().lower() in (
-        "1", "true", "yes", "on")
+    return envknobs.env_flag(name, False)
 
 
 class WalConfig:
@@ -143,16 +142,12 @@ class WalConfig:
 
     @classmethod
     def from_env(cls) -> "WalConfig":
-        try:
-            seg = int(os.environ.get("PIO_WAL_SEGMENT_BYTES", "")
-                      or 16 * 1024 * 1024)
-        except ValueError:
-            seg = 16 * 1024 * 1024
         return cls(
             enabled=_env_flag("PIO_WAL"),
-            fsync=os.environ.get("PIO_WAL_FSYNC", "group").strip().lower(),
-            dir=os.environ.get("PIO_WAL_DIR") or None,
-            segment_bytes=seg,
+            fsync=envknobs.env_str("PIO_WAL_FSYNC", "group"),
+            dir=envknobs.env_str("PIO_WAL_DIR", "", lower=False) or None,
+            segment_bytes=envknobs.env_int(
+                "PIO_WAL_SEGMENT_BYTES", 16 * 1024 * 1024),
         )
 
     def to_json(self) -> dict:

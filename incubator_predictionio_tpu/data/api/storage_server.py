@@ -26,6 +26,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ...common import envknobs
 from ..storage import http_backend as codec
 from ..storage.base import Model
 from ..storage.event import Event, EventValidationError
@@ -284,7 +285,10 @@ def run_storage_server(ip: str = "127.0.0.1", port: int = 7072,
     (common/ssl_config.py), mirroring the reference's SSLConfiguration."""
     from ...common.ssl_config import ssl_context_from_env
 
-    secret = secret or os.environ.get("PIO_STORAGESERVER_SECRET") or None
+    secret = (secret
+              or envknobs.env_str("PIO_STORAGESERVER_SECRET", "",
+                                  lower=False)
+              or None)
     if not secret and ip not in ("127.0.0.1", "localhost", "::1"):
         raise SystemExit(
             f"refusing to bind the storage server on {ip} without a "

@@ -576,10 +576,9 @@ class ESPEvents(base.PEvents):
         # bulk read feeding training: sliced-parallel PIT scan overlaps
         # the page round trips that serialize search_after at
         # store-of-record scale (PIO_ES_SLICES=1 restores serial)
-        try:
-            slices = max(int(os.environ.get("PIO_ES_SLICES", "4")), 1)
-        except ValueError:
-            slices = 4
+        from ...common import envknobs
+
+        slices = envknobs.env_int("PIO_ES_SLICES", 4, lo=1)
         if event_names is not None:
             event_names = list(event_names)  # materialize once: the
             # guard below + _build_query both consume it

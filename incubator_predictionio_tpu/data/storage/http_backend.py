@@ -729,10 +729,12 @@ class HTTPStorageClient(base.BaseStorageClient):
         stream_timeout = resilience.prop_float(props, "STREAM_TIMEOUT", 600.0)
         # Shared-secret auth: PIO_STORAGE_SOURCES_<N>_SECRET, falling back
         # to the server-side var so one-box setups configure it once.
-        import os as _os
+        from ...common import envknobs
 
         secret = (props.get("SECRET")
-                  or _os.environ.get("PIO_STORAGESERVER_SECRET") or None)
+                  or envknobs.env_str("PIO_STORAGESERVER_SECRET", "",
+                                      lower=False)
+                  or None)
         url = f"{scheme}://{host}:{port}"
         self._t = _Transport(
             url, timeout=timeout, stream_timeout=stream_timeout,

@@ -228,8 +228,9 @@ class _LogScan:
 
 
 def _fsync_enabled() -> bool:
-    return os.environ.get("PIO_INGEST_FSYNC", "").strip().lower() in (
-        "1", "true", "yes", "on")
+    from ...common import envknobs
+
+    return envknobs.env_flag("PIO_INGEST_FSYNC", False)
 
 
 class AppendHandle:
@@ -323,7 +324,9 @@ class JSONLEvents(base.LEvents):
         # while reads merge every shard, so any worker answers any
         # query. Without the env var, behavior is byte-identical to the
         # single-log layout.
-        part = os.environ.get("PIO_EVENT_PARTITION", "").strip()
+        from ...common import envknobs
+
+        part = envknobs.env_str("PIO_EVENT_PARTITION", "")
         self._partition = int(part) if part.isdigit() else None
         # merged-view cache: (app, chan) -> ((paths, sizes), _LogScan)
         self._merged: dict = {}

@@ -152,11 +152,10 @@ class MySQLLEvents(PGLEvents):
                      entity_type, entity_id, event_names,
                      target_entity_type, target_entity_id):
         import json as _json
-        import os as _os
-
+        from ...common import envknobs
         from .event import event_time_us as _us
 
-        page = max(int(_os.environ.get("PIO_SQL_PAGE_SIZE", "5000")), 1)
+        page = envknobs.env_int("PIO_SQL_PAGE_SIZE", 5000, lo=1)
         cursor = None  # (eventtimeus, seq) of the last yielded row
         while True:
             where = ["appid=$1", "channelid=$2"]

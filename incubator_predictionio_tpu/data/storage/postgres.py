@@ -42,14 +42,9 @@ from .sqlite import _safe_ident
 def _stream_fetch_size() -> int:
     """PIO_PG_FETCH_SIZE (rows per portal chunk of the streaming
     training feed), parsed once; malformed values warn and fall back."""
-    raw = os.environ.get("PIO_PG_FETCH_SIZE", "5000")
-    try:
-        return max(int(raw), 1)
-    except ValueError:
-        warnings.warn(
-            f"PIO_PG_FETCH_SIZE={raw!r} is not an integer; using 5000",
-            stacklevel=2)
-        return 5000
+    from ...common import envknobs
+
+    return envknobs.env_int("PIO_PG_FETCH_SIZE", 5000, lo=1, warn=True)
 
 
 def _from_us(us) -> Optional[_dt.datetime]:

@@ -92,7 +92,10 @@ def register_backend(
 
 
 def base_dir() -> str:
-    d = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+    from ...common import envknobs
+
+    d = (envknobs.env_str("PIO_FS_BASEDIR", "", lower=False)
+         or os.path.expanduser("~/.pio_store"))
     os.makedirs(d, exist_ok=True)
     return d
 

@@ -207,7 +207,9 @@ def _build() -> str:
 
 def _load() -> ctypes.CDLL:
     global _lib, _lib_error
-    if os.environ.get("PIO_DISABLE_NATIVE") == "1":
+    from ..common import envknobs
+
+    if envknobs.env_flag("PIO_DISABLE_NATIVE", False):
         # operational kill-switch: force every caller onto the pure-
         # Python fallbacks (e.g. a miscompiling toolchain in the field)
         raise NativeUnavailable("disabled by PIO_DISABLE_NATIVE=1")

@@ -644,9 +644,9 @@ _DEV_BUF_CACHE_BYTES = 256 * 1024 * 1024
 
 
 def _cached_dev_put(buf: np.ndarray, dev) -> "jax.Array":
-    import os as _os
+    from ..common import envknobs
 
-    if _os.environ.get("PIO_ALS_DEVICE_CACHE", "1") == "0":
+    if not envknobs.env_flag("PIO_ALS_DEVICE_CACHE", True):
         return jax.device_put(buf, dev)
     import hashlib
 

@@ -434,16 +434,19 @@ def _full_matrix_elem_cap() -> int:
     (no double buffer), and the remaining 3/4 leaves head-room for the
     bf16 slabs and LLR/top-k intermediates. TPUs whose tunnel reports
     no memory stats assume the fleet-minimum 8 GiB."""
-    raw = os.environ.get("PIO_UR_FULL_MATRIX_ELEMS")
-    if raw:
-        try:
-            return int(float(raw))
-        except ValueError:
-            import warnings
+    from ..common import envknobs
 
-            warnings.warn(
-                f"PIO_UR_FULL_MATRIX_ELEMS={raw!r} is not a number; "
-                "using the device-derived default", stacklevel=2)
+    raw = envknobs.env_str("PIO_UR_FULL_MATRIX_ELEMS", "")
+    if raw:
+        explicit = envknobs.env_int("PIO_UR_FULL_MATRIX_ELEMS", 0,
+                                    float_ok=True)
+        if explicit > 0:
+            return explicit
+        import warnings
+
+        warnings.warn(
+            f"PIO_UR_FULL_MATRIX_ELEMS={raw!r} is not a positive "
+            "number; using the device-derived default", stacklevel=2)
     limit = 0
     try:
         dev = jax.devices()[0]

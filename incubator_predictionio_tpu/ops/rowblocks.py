@@ -67,18 +67,13 @@ def ladder_growth() -> float:
     ops/als.py) — a cross-host mismatch fails fast instead of hanging in
     shape-mismatched collectives.
     """
-    import os
     import warnings
 
-    raw = os.environ.get("PIO_ALS_LADDER_GROWTH")
-    if raw is None:
-        return DEFAULT_LADDER_GROWTH
-    try:
-        g = float(raw)
-    except ValueError:
-        warnings.warn(
-            f"PIO_ALS_LADDER_GROWTH={raw!r} is not a number; using "
-            f"{DEFAULT_LADDER_GROWTH}", stacklevel=2)
+    from ..common import envknobs
+
+    g = envknobs.env_float("PIO_ALS_LADDER_GROWTH", DEFAULT_LADDER_GROWTH,
+                           warn=True)
+    if g == DEFAULT_LADDER_GROWTH:
         return DEFAULT_LADDER_GROWTH
     if not 1.0 < g <= 4.0:
         warnings.warn(

@@ -91,19 +91,19 @@ def _serving_shard_threshold_bytes() -> int:
     otherwise 1/4 of the device's reported memory — factors compete with
     the training slabs and per-query intermediates for HBM. Tunnels that
     report no memory stats assume the fleet-minimum 8 GiB TPU."""
-    raw = os.environ.get("PIO_SHARDED_SERVING_BYTES")
-    if raw:
-        try:
-            val = int(float(raw))
-            if val <= 0:
-                raise ValueError("threshold must be positive")
-            return val
-        except (ValueError, OverflowError):  # not a number, "inf", or <= 0
-            import warnings
+    from ..common import envknobs
 
-            warnings.warn(
-                f"PIO_SHARDED_SERVING_BYTES={raw!r} is not a positive "
-                "number; using the device-derived default", stacklevel=2)
+    raw = envknobs.env_str("PIO_SHARDED_SERVING_BYTES", "")
+    if raw:
+        explicit = envknobs.env_int("PIO_SHARDED_SERVING_BYTES", 0,
+                                    float_ok=True)
+        if explicit > 0:
+            return explicit
+        import warnings
+
+        warnings.warn(
+            f"PIO_SHARDED_SERVING_BYTES={raw!r} is not a positive "
+            "number; using the device-derived default", stacklevel=2)
     limit = 0
     try:
         dev = jax.devices()[0]

@@ -73,12 +73,21 @@ def initialize_distributed(
     (PIO_COORDINATOR_ADDRESS, PIO_NUM_PROCESSES, PIO_PROCESS_ID). Safe to
     call when unset → single-process mode. Timeout/health-check knobs:
     :func:`resolve_distributed_timeouts`."""
-    coordinator_address = coordinator_address or os.environ.get("PIO_COORDINATOR_ADDRESS")
+    coordinator_address = (
+        coordinator_address
+        or envknobs.env_str("PIO_COORDINATOR_ADDRESS", "", lower=False))
     if not coordinator_address:
         log.debug("single-process mode (no PIO_COORDINATOR_ADDRESS)")
         return
-    num_processes = num_processes or int(os.environ.get("PIO_NUM_PROCESSES", "1"))
-    process_id = process_id if process_id is not None else int(os.environ.get("PIO_PROCESS_ID", "0"))
+    # identity knobs parse STRICTLY (int() raises on garbage AND on a
+    # set-but-empty value): a gang worker whose rank/world-size env is
+    # garbled must crash loudly at startup — any tolerant fallback to
+    # rank 0 / world 1 would collide with the real leader or hang its
+    # peers' collectives instead
+    num_processes = num_processes or int(
+        os.environ.get("PIO_NUM_PROCESSES", "1"))  # pio-lint: disable=knob-envknobs -- identity knob: strict crash beats tolerant world=1
+    process_id = (process_id if process_id is not None
+                  else int(os.environ.get("PIO_PROCESS_ID", "0")))  # pio-lint: disable=knob-envknobs -- identity knob: strict crash beats tolerant rank=0
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         # The CPU PJRT client ships WITHOUT cross-process collectives by
         # default ("Multiprocess computations aren't implemented on the

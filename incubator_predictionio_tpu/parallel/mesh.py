@@ -56,7 +56,9 @@ def _mesh_shape_from_env() -> Optional[tuple[int, ...]]:
     """PIO_MESH_SHAPE: "8" → 1-D data mesh over 8 devices; "4x2" →
     2-D (d, m)=(4, 2) ALX mesh. Set directly or via the CLI passthrough
     tier (`pio train -- --mesh=4x2`, SURVEY.md §5.6c)."""
-    spec = (os.environ.get("PIO_MESH_SHAPE") or "").strip()
+    from ..common import envknobs
+
+    spec = envknobs.env_str("PIO_MESH_SHAPE", "")
     if not spec:
         return None
     try:

@@ -86,7 +86,7 @@ def train_cmd(args: list[str]) -> int:
 
     num_workers = (ns.num_workers if ns.num_workers is not None
                    else envknobs.env_int("PIO_NUM_WORKERS", 1, lo=1))
-    supervised_worker = os.environ.get("PIO_GANG_WORKER") == "1"
+    supervised_worker = envknobs.env_flag("PIO_GANG_WORKER", False)
     if num_workers > 1 and not supervised_worker:
         return _train_supervised(args, ns, num_workers)
     from ...parallel.distributed import initialize_distributed

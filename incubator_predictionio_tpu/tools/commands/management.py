@@ -9,6 +9,7 @@ import json
 import os
 import sys
 
+from ...common import envknobs
 from ...data.storage.event import Event
 from ...data.storage.registry import Storage, base_dir
 from . import verb
@@ -21,7 +22,8 @@ def status_cmd(args: list[str]) -> int:
                    help="print a Prometheus-format snapshot of this "
                         "process's telemetry registry after the checks")
     p.add_argument("--engine-url",
-                   default=os.environ.get("PIO_ENGINE_URL"),
+                   default=envknobs.env_str(
+                       "PIO_ENGINE_URL", "", lower=False) or None,
                    help="also query a running engine server's GET "
                         "/status and report its serving overload "
                         "counters (shed / deadline / drain) — defaults "

@@ -89,7 +89,8 @@ def models_cmd(args: list[str]) -> int:
         "rollback", help="swap a live engine server back to its retained "
                          "previous deployment (pins the bad instance)")
     p_rb.add_argument("--engine-url",
-                      default=os.environ.get("PIO_ENGINE_URL"),
+                      default=envknobs.env_str(
+                          "PIO_ENGINE_URL", "", lower=False) or None,
                       help="engine server base URL (defaults to "
                            "$PIO_ENGINE_URL)")
     p_gc = sub.add_parser(
@@ -101,7 +102,8 @@ def models_cmd(args: list[str]) -> int:
                            "(engine, version, variant); default "
                            "$PIO_MODEL_KEEP, else 5")
     p_gc.add_argument("--engine-url",
-                      default=os.environ.get("PIO_ENGINE_URL"),
+                      default=envknobs.env_str(
+                          "PIO_ENGINE_URL", "", lower=False) or None,
                       help="also protect the live server's deployed, "
                            "previous, and pinned instances (defaults to "
                            "$PIO_ENGINE_URL)")

@@ -80,10 +80,19 @@ def main(argv=None) -> int:
 
         print(__version__)
         return 0
+    if argv[0] == "lint":
+        # static analysis never touches jax/storage — dispatch before
+        # the force-cpu block below so linting a broken runtime (or a
+        # CI env with PIO_TEST_FORCE_CPU set) stays a pure parse pass
+        from .lint.cli import main as lint_main
+
+        return lint_main(argv[1:])
     # (the persistent XLA compilation cache is enabled lazily by
     # WorkflowContext — the chokepoint every compiling verb passes —
     # so metadata-only verbs never import jax for it)
-    if os.environ.get("PIO_TEST_FORCE_CPU") == "1":
+    from ..common import envknobs
+
+    if envknobs.env_flag("PIO_TEST_FORCE_CPU", False):
         # Hermetic CI: run workflows on host CPU devices (the sandbox's
         # PJRT plugin ignores JAX_PLATFORMS — see tests/conftest.py).
         try:

@@ -1,0 +1,62 @@
+"""``pio lint`` CLI: exit 1 on findings, ``--json`` for machines.
+
+Kept jax-free and imported lazily by the console so linting a broken
+tree costs a parse pass, not a backend initialization."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import ALL_RULES, Project, report_json, run_lint
+
+
+def main(args: list[str]) -> int:
+    p = argparse.ArgumentParser(
+        prog="pio lint",
+        description="repo-wide static analysis: concurrency/convention "
+                    "rules over one AST parse pass "
+                    "(docs/operations.md 'Static analysis')")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="NAME[,NAME...]",
+                   help="run only these rules (repeatable, comma-ok); "
+                        "skips the unused-suppression check")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: this checkout)")
+    ns = p.parse_args(args)
+
+    if ns.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.name:<24} {r.rationale}")
+        return 0
+
+    only = None
+    if ns.rule:
+        only = [n.strip() for chunk in ns.rule for n in chunk.split(",")
+                if n.strip()]
+        if not only:
+            # `--rule ""` selecting nothing must not report "clean"
+            print("pio lint: --rule selected no rules", file=sys.stderr)
+            return 2
+    try:
+        result = run_lint(Project.from_repo(ns.root), ALL_RULES, only=only)
+    except ValueError as e:  # unknown --rule name
+        print(f"pio lint: {e}", file=sys.stderr)
+        return 2
+
+    if ns.json:
+        print(report_json(result))
+    else:
+        for f in result["findings"]:
+            print(f.render())
+        n = len(result["findings"])
+        status = "clean" if n == 0 else f"{n} finding(s)"
+        print(f"pio lint: {status} — {len(result['rules'])} rule(s) over "
+              f"{result['modules']} module(s), "
+              f"{result['suppressed']} suppression(s) honoured",
+              file=sys.stderr)
+    return 1 if result["findings"] else 0

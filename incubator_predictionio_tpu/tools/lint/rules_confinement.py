@@ -1,0 +1,272 @@
+"""Single-path confinement rules — the six PR 3–9 AST guards, re-
+expressed over the shared engine (tests/test_*.py used to carry one
+hand-rolled ``ast.walk`` copy each; they now assert these rules).
+
+Each rule pins an architectural chokepoint: ALL traffic of some kind
+must flow through ONE module/class, because the chokepoint is where
+the system's guarantees live (group commit, admission control, retry/
+breaker policy, lease fencing, checksum verification, supervised
+spawning, the metrics registry)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .engine import Finding, Project, rule
+
+__all__ = ["RULES"]
+
+
+def _class(module, name: str) -> Optional[ast.ClassDef]:
+    for n in module.walk():
+        if isinstance(n, ast.ClassDef) and n.name == name:
+            return n
+    return None
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+@rule("ingest-hot-path",
+      "event-server write handlers must feed the ingest buffer — a "
+      "direct per-event DAO insert bypasses group commit, drain and "
+      "overload shedding")
+def ingest_hot_path(project: Project) -> Iterable[Finding]:
+    m = project.module("data/api/event_server.py")
+    if m is None or m.tree is None:
+        return
+    disp = project.display_path(m)
+    cls = _class(m, "EventServer")
+    if cls is None:
+        yield Finding("ingest-hot-path", disp, 1,
+                      "class EventServer not found — the hot-path guard "
+                      "has nothing to check (was it renamed?)")
+        return
+    hot = {"handle_create", "handle_batch", "handle_webhook"}
+    seen = set()
+    for fn in ast.walk(cls):
+        if not isinstance(fn, ast.AsyncFunctionDef) or fn.name not in hot:
+            continue
+        seen.add(fn.name)
+        uses_buffer = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                if n.func.attr in ("insert", "insert_batch",
+                                   "insert_canonical_lines"):
+                    yield Finding(
+                        "ingest-hot-path", disp, n.lineno,
+                        f"{fn.name} calls the per-event DAO "
+                        f"`.{n.func.attr}(` directly; route writes "
+                        "through EventServer.ingest (the group-commit "
+                        "buffer)")
+            if isinstance(n, ast.Attribute) and n.attr == "ingest":
+                uses_buffer = True
+        if not uses_buffer:
+            yield Finding("ingest-hot-path", disp, fn.lineno,
+                          f"{fn.name} does not feed the ingest buffer")
+    for missing in sorted(hot - seen):
+        yield Finding("ingest-hot-path", disp, cls.lineno,
+                      f"hot handler {missing} not found on EventServer — "
+                      "renaming it silently drops the guard")
+
+
+_BANNED_SUB = ("Popen", "run", "call", "check_call", "check_output")
+_BANNED_OS = ("fork", "forkpty", "spawnv", "spawnve", "spawnl", "spawnlp",
+              "spawnvp", "posix_spawn", "execv", "execve")
+
+
+@rule("spawn-confinement",
+      "parallel/ and workflow/ spawn processes only through "
+      "parallel/supervisor.py — a side-channel launch escapes liveness "
+      "monitoring, restart accounting and drain")
+def spawn_confinement(project: Project) -> Iterable[Finding]:
+    for sub in ("parallel/", "workflow/"):
+        for m in project.modules(sub):
+            if m.relpath == "parallel/supervisor.py" or m.tree is None:
+                continue
+            disp = project.display_path(m)
+            for node in m.walk():
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not (isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)):
+                    continue
+                if (f.value.id == "subprocess" and f.attr in _BANNED_SUB) \
+                        or (f.value.id == "os" and f.attr in _BANNED_OS):
+                    yield Finding(
+                        "spawn-confinement", disp, node.lineno,
+                        f"{f.value.id}.{f.attr}() outside "
+                        "parallel/supervisor.py — route worker spawning "
+                        "through the supervisor")
+
+
+@rule("resilient-urlopen",
+      "storage backends reach HTTP only through the resilience layer "
+      "(retries, breakers, fault injection) — raw urlopen bypasses all "
+      "three")
+def resilient_urlopen(project: Project) -> Iterable[Finding]:
+    def urlopen_lines(tree) -> list[int]:
+        return [n.lineno for n in ast.walk(tree)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "urlopen"]
+
+    for m in project.modules("data/storage/"):
+        if m.tree is None:
+            continue
+        calls = urlopen_lines(m.tree)
+        if not calls:
+            continue
+        allowed: set[int] = set()
+        if m.relpath == "data/storage/http_backend.py":
+            # urlopen is legal ONLY inside the resilient _Transport
+            # (whose every path applies policy/breaker/faults)
+            transport = _class(m, "_Transport")
+            if transport is not None:
+                allowed = set(urlopen_lines(transport))
+        disp = project.display_path(m)
+        for ln in calls:
+            if ln not in allowed:
+                yield Finding(
+                    "resilient-urlopen", disp, ln,
+                    "urlopen() outside the resilient transport — use "
+                    "common.resilience.resilient_urlopen")
+
+
+_WAL_SUFFIXES = (".wal", ".colseg", ".manifest")
+_WAL_ALLOWED = ("data/api/event_log.py", "data/api/ingest_wal.py")
+
+
+@rule("wal-suffix-confinement",
+      "only event_log.py/ingest_wal.py may open .wal/.colseg/.manifest "
+      "artifacts — touching them elsewhere forks segment lifecycle "
+      "(leases, quarantine, manifest commits)")
+def wal_suffix_confinement(project: Project) -> Iterable[Finding]:
+    for sub in ("data/", "workflow/"):
+        for m in project.modules(sub):
+            if m.relpath in _WAL_ALLOWED or m.tree is None:
+                continue
+            disp = project.display_path(m)
+            for node in m.walk():
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value.endswith(_WAL_SUFFIXES)):
+                    yield Finding(
+                        "wal-suffix-confinement", disp, node.lineno,
+                        f"segment/manifest suffix {node.value!r} "
+                        "referenced outside event_log.py/ingest_wal.py")
+
+
+_COUNTERISH = ("count", "counter", "stat", "stats", "metric")
+_BANNED_CTOR = ("Counter", "defaultdict", "dict", "OrderedDict")
+
+
+@rule("no-adhoc-counters",
+      "no module-level counter dicts under data/api/ and workflow/ — "
+      "ad-hoc counting state belongs to the telemetry registry")
+def no_adhoc_counters(project: Project) -> Iterable[Finding]:
+    for sub in ("data/api/", "workflow/"):
+        for m in project.modules(sub):
+            if m.tree is None or "/" in m.relpath[len(sub):]:
+                continue  # top level of each dir, like the legacy guard
+            disp = project.display_path(m)
+            for node in m.tree.body:
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                else:
+                    continue
+                value = node.value
+                banned = isinstance(value, (ast.Dict, ast.Set)) or (
+                    isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Name)
+                    and value.func.id in _BANNED_CTOR)
+                if not banned:
+                    continue
+                for t in targets:
+                    if (isinstance(t, ast.Name) and any(
+                            s in t.id.lower() for s in _COUNTERISH)):
+                        yield Finding(
+                            "no-adhoc-counters", disp, node.lineno,
+                            f"module-level counter dict {t.id!r} — use a "
+                            "common/telemetry.py registry family")
+
+
+@rule("models-dao-confinement",
+      "workflow/ reads model blobs only through model_artifact.py — any "
+      "other Models-DAO touch bypasses checksum verification and reopens "
+      "the corrupt-model-serves-production hole")
+def models_dao_confinement(project: Project) -> Iterable[Finding]:
+    for m in project.modules("workflow/"):
+        if m.relpath == "workflow/model_artifact.py" or m.tree is None:
+            continue
+        disp = project.display_path(m)
+        for node in m.walk():
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Name):
+                name = node.id
+            if name == "get_model_data_models":
+                yield Finding(
+                    "models-dao-confinement", disp, node.lineno,
+                    "get_model_data_models outside model_artifact.py — "
+                    "read models via model_artifact.read_model")
+
+
+@rule("query-dispatch-gate",
+      "engine-server handlers route query compute only through the "
+      "admission gate (_dispatch_query) — direct executor dispatch "
+      "bypasses the bounded executor, shedding and deadline budget")
+def query_dispatch_gate(project: Project) -> Iterable[Finding]:
+    m = project.module("workflow/create_server.py")
+    if m is None or m.tree is None:
+        return
+    disp = project.display_path(m)
+    cls = _class(m, "EngineServer")
+    if cls is None:
+        yield Finding("query-dispatch-gate", disp, 1,
+                      "class EngineServer not found — the dispatch guard "
+                      "has nothing to check (was it renamed?)")
+        return
+
+    def mentions_query_compute(node) -> bool:
+        return any(isinstance(sub, ast.Attribute)
+                   and sub.attr in ("query", "batch_query")
+                   for sub in ast.walk(node))
+
+    gated = False
+    for fn in ast.walk(cls):
+        if not isinstance(fn, ast.AsyncFunctionDef) \
+                or not fn.name.startswith("handle_"):
+            continue
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n)
+            if name in ("to_thread", "run_in_executor", "submit") and \
+                    any(mentions_query_compute(a) for a in n.args):
+                yield Finding(
+                    "query-dispatch-gate", disp, n.lineno,
+                    f"{fn.name} ships query compute to {name}() directly; "
+                    "route it through EngineServer._dispatch_query")
+            if fn.name == "handle_query" and name == "_dispatch_query":
+                gated = True
+    if not gated:
+        yield Finding("query-dispatch-gate", disp, cls.lineno,
+                      "handle_query no longer routes through "
+                      "_dispatch_query")
+
+
+RULES = [ingest_hot_path, spawn_confinement, resilient_urlopen,
+         wal_suffix_confinement, no_adhoc_counters, models_dao_confinement,
+         query_dispatch_gate]

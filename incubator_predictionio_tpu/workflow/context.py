@@ -34,9 +34,9 @@ def _enable_compilation_cache() -> None:
     if _cache_enabled:
         return
     _cache_enabled = True
-    import os
+    from ..common import envknobs
 
-    if os.environ.get("PIO_COMPILATION_CACHE", "1") == "0":
+    if not envknobs.env_flag("PIO_COMPILATION_CACHE", True):
         return
     try:
         import jax

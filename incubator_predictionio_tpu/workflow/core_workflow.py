@@ -19,6 +19,7 @@ import pickle
 import socket
 from typing import Any, Optional
 
+from ..common import envknobs
 from ..controller.engine import Engine, EngineParams
 from ..controller.persistent_model import PersistentModel
 from ..data.storage.base import EngineInstance
@@ -130,7 +131,7 @@ def _run_train_follower(engine, engine_params, ctx, wp, gang_id: str) -> str:
             ctx.checkpoint_hook.close()
             ctx.checkpoint_hook = None
     log.info("gang follower %s: train stage complete",
-             os.environ.get("PIO_PROCESS_ID"))
+             envknobs.env_str("PIO_PROCESS_ID", "?"))
     return gang_id
 
 
@@ -168,7 +169,7 @@ def run_train(
     # followers train — every collective needs them — and discard.
     gang_id = os.environ.get(gang.ENV_GANG_INSTANCE_ID) or None
     follower = bool(
-        gang_id) and os.environ.get("PIO_PROCESS_ID", "0") != "0"
+        gang_id) and envknobs.env_str("PIO_PROCESS_ID", "0") != "0"
     if follower:
         return _run_train_follower(engine, engine_params, ctx, wp, gang_id)
     storage = ctx.get_storage()

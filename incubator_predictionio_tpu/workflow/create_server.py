@@ -291,8 +291,8 @@ class EngineServer:
                     has_current = self.deployment is not None
                 if instance_id is not None or has_current:
                     raise
-                self._validate_failures += 1
                 with self._lock:
+                    self._validate_failures += 1
                     self._pinned[e.instance_id] = "validate"
                 log.warning(
                     "initial deploy: %s; pinning it and walking back to "
@@ -448,7 +448,7 @@ class EngineServer:
         instance row (runtime_conf["golden_query"]), the operator's
         $PIO_GOLDEN_QUERY, or the models' example_query() opt-in."""
         raw = ((instance.runtime_conf or {}).get("golden_query")
-               or os.environ.get("PIO_GOLDEN_QUERY"))
+               or envknobs.env_str("PIO_GOLDEN_QUERY", "", lower=False))
         if raw:
             try:
                 doc = json.loads(raw)
@@ -615,12 +615,14 @@ class EngineServer:
             b["name"] for b in self._storage_breakers()
             if b.get("state") == "open"
         ]
-        ready = loaded and not open_breakers and not self._draining
+        with self._adm_lock:
+            draining = self._draining
+        ready = loaded and not open_breakers and not draining
         out = {
             "ready": ready,
             "modelLoaded": loaded,
             "degraded": self._degraded_reason is not None,
-            "draining": self._draining,
+            "draining": draining,
             "openBreakers": open_breakers,
         }
         return web.json_response(out, status=200 if ready else 503)
@@ -630,18 +632,21 @@ class EngineServer:
         """Shed/deadline/drain counters for /status and `pio status`."""
         with self._adm_lock:
             pending, peak = self._adm_pending, self._adm_peak
+            shed, deadline_exceeded = self._shed_count, self._deadline_count
+            orphaned, draining = self._orphaned, self._draining
+            stragglers = self._drain_stragglers
         return {
             "conc": self.query_conc,
             "pending": pending,
             "pendingLimit": self.query_conc + self.query_max_pending,
             "peakPending": peak,
-            "shed": self._shed_count,
-            "deadlineExceeded": self._deadline_count,
-            "orphaned": self._orphaned,
+            "shed": shed,
+            "deadlineExceeded": deadline_exceeded,
+            "orphaned": orphaned,
             "deadlineMsDefault": self.query_deadline_ms,
-            "draining": self._draining,
+            "draining": draining,
             "drainDeadlineMs": self.drain_deadline_ms,
-            "drainStragglers": self._drain_stragglers,
+            "drainStragglers": stragglers,
             "reloadConflicts": self._reload_conflicts,
         }
 
@@ -921,7 +926,8 @@ class EngineServer:
             if self._watch is not None and self._is_live(deployment):
                 self._note_watch(ok=True)
         except AdmissionShed as e:
-            self._shed_count += 1
+            with self._adm_lock:
+                self._shed_count += 1
             return web.json_response(
                 {"message": f"query shed: {e}"}, status=503,
                 headers={"Retry-After":
@@ -930,7 +936,8 @@ class EngineServer:
             # accepted but out of time: 504, NOT 503 — work started, a
             # blind client retry may duplicate load, so the two cases
             # stay distinguishable
-            self._deadline_count += 1
+            with self._adm_lock:
+                self._deadline_count += 1
             # A pathologically SLOW new model is a rollback trigger
             # too: overruns whose stage shows compute was running count
             # against the watch window (no hedge — the budget is
@@ -1185,6 +1192,10 @@ class EngineServer:
         with self._lock:
             cur, prev = self.instance, self._previous
             pinned = dict(self._pinned)
+            rollbacks = dict(self._rollbacks)
+            swaps = self._swap_count
+            validate_failures = self._validate_failures
+            refresh_swaps = self._refresh_swaps
         w = self._watch
         return {
             "instance": cur.id if cur else None,
@@ -1193,12 +1204,12 @@ class EngineServer:
             # refused in this process, by failure kind
             "integrityFailures": model_artifact.integrity_failure_counts(),
             "pinned": pinned,
-            "rollbacks": dict(self._rollbacks),
-            "swaps": self._swap_count,
-            "validateFailures": self._validate_failures,
+            "rollbacks": rollbacks,
+            "swaps": swaps,
+            "validateFailures": validate_failures,
             "validate": self.swap_validate,
             "refreshMs": self.model_refresh_ms,
-            "refreshSwaps": self._refresh_swaps,
+            "refreshSwaps": refresh_swaps,
             "watchMs": self.swap_watch_ms,
             "maxErrorRate": self.swap_max_error_rate,
             "watch": ({"total": w["total"], "errors": w["errors"]}
@@ -1263,7 +1274,7 @@ class EngineServer:
         self._watch = None
         with self._lock:
             self._pinned[bad_inst.id] = reason
-        self._rollbacks[reason] = self._rollbacks.get(reason, 0) + 1
+            self._rollbacks[reason] = self._rollbacks.get(reason, 0) + 1
         self._degraded_reason = (
             f"rolled back from {bad_inst.id} to {restored.id} ({reason}) "
             f"at {_dt.datetime.now(_dt.timezone.utc).isoformat()}; "
@@ -1393,8 +1404,8 @@ class EngineServer:
                     self._load, None, True,
                     lambda iid, kind: rejected.append((iid, kind)))
             except SwapValidationError as e:
-                self._validate_failures += 1
                 with self._lock:
+                    self._validate_failures += 1
                     self._pinned[e.instance_id] = "validate"
                 self._degraded_reason = (
                     f"refresh: {e}; serving last-good model "
@@ -1409,7 +1420,8 @@ class EngineServer:
                               "last-good model")
             else:
                 if swapped:
-                    self._refresh_swaps += 1
+                    with self._lock:
+                        self._refresh_swaps += 1
                 # the load SUCCEEDED — whether it swapped or confirmed
                 # the live instance is still the newest deployable, a
                 # degraded reason from an earlier transient refresh
@@ -1470,7 +1482,8 @@ class EngineServer:
                 await asyncio.to_thread(self._load, target)
             except Exception as e:  # noqa: BLE001
                 if isinstance(e, SwapValidationError):
-                    self._validate_failures += 1
+                    with self._lock:
+                        self._validate_failures += 1
                 self._degraded_reason = (
                     f"reload failed at "
                     f"{_dt.datetime.now(_dt.timezone.utc).isoformat()}: {e}; "
@@ -1499,9 +1512,10 @@ class EngineServer:
         PIO_DRAIN_DEADLINE_MS, then stop — stragglers past the budget
         are failed by shutdown (batch-queue cleanup + connection
         close) rather than holding the process open."""
-        if self._draining:
-            return          # second SIGTERM / /stop: first drain owns it
-        self._draining = True
+        with self._adm_lock:
+            if self._draining:
+                return      # second SIGTERM / /stop: first drain owns it
+            self._draining = True
         log.info("draining: readyz → 503, waiting for in-flight queries "
                  "(budget %.0f ms)", self.drain_deadline_ms)
         if stopper is None:
@@ -1517,7 +1531,8 @@ class EngineServer:
         with self._adm_lock:
             stragglers = self._adm_pending
         if stragglers:
-            self._drain_stragglers = stragglers
+            with self._adm_lock:
+                self._drain_stragglers = stragglers
             log.warning("drain deadline (%.0f ms) expired with %d "
                         "query(ies) unfinished; failing them",
                         self.drain_deadline_ms, stragglers)
@@ -1548,7 +1563,9 @@ class EngineServer:
 
     async def handle_stop(self, request: web.Request) -> web.Response:
         log.info("stop requested")
-        if self._draining:
+        with self._adm_lock:
+            draining = self._draining
+        if draining:
             return web.json_response({"message": "Already draining."})
         asyncio.get_running_loop().create_task(
             self.drain_then_stop(request.app["stopper"]))

@@ -116,7 +116,7 @@ class PipelineConfig:
 
     @classmethod
     def from_env(cls, mode: Optional[str] = None) -> "PipelineConfig":
-        raw = (mode or os.environ.get("PIO_PIPELINE") or "auto").strip().lower()
+        raw = (mode or envknobs.env_str("PIO_PIPELINE", "auto")).strip().lower()
         if raw in ("1", "on", "true", "yes"):
             raw = "on"
         elif raw in ("0", "off", "false", "no"):

@@ -22,9 +22,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import os
 import time
 from typing import Optional
+
+from ..common import envknobs
 
 log = logging.getLogger("pio.placement")
 
@@ -183,7 +184,7 @@ def device_mode_from_env(default: str = "auto") -> str:
     """PIO_TRAIN_DEVICE env tier (engine.json/CLI win over it). An
     invalid env value warns and falls back — a typo must not surface as
     a mid-training crash minutes later."""
-    v = (os.environ.get("PIO_TRAIN_DEVICE") or default).strip().lower() or default
+    v = envknobs.env_str("PIO_TRAIN_DEVICE", default) or default
     try:
         return validate_device_mode(v)
     except ValueError:

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import importlib
 import logging
-import os
 from typing import Any, Optional
+
+from ..common import envknobs
 
 log = logging.getLogger("pio.plugins")
 
@@ -47,7 +48,8 @@ class EventServerPluginContext:
 
     def __init__(self, plugins: Optional[list[EventServerPlugin]] = None):
         self.plugins = list(plugins or [])
-        for dotted in filter(None, os.environ.get("PIO_EVENT_SERVER_PLUGINS", "").split(",")):
+        for dotted in filter(None, envknobs.env_str(
+                "PIO_EVENT_SERVER_PLUGINS", "", lower=False).split(",")):
             try:
                 module, _, cls = dotted.strip().rpartition(".")
                 self.plugins.append(getattr(importlib.import_module(module), cls)())
@@ -68,7 +70,8 @@ class EventServerPluginContext:
 class EngineServerPluginContext:
     def __init__(self, plugins: Optional[list[EngineServerPlugin]] = None):
         self.plugins = list(plugins or [])
-        for dotted in filter(None, os.environ.get("PIO_ENGINE_SERVER_PLUGINS", "").split(",")):
+        for dotted in filter(None, envknobs.env_str(
+                "PIO_ENGINE_SERVER_PLUGINS", "", lower=False).split(",")):
             try:
                 module, _, cls = dotted.strip().rpartition(".")
                 plugin = getattr(importlib.import_module(module), cls)()

@@ -692,28 +692,8 @@ def test_guard_only_event_log_modules_open_log_artifacts():
     data/api/ingest_wal.py may open ``.wal`` / ``.colseg`` /
     ``.manifest`` files — every other module under data/ and workflow/
     must go through them, or segment lifecycle (leases, quarantine,
-    manifest commits) silently forks."""
-    import ast
-    import pathlib
+    manifest commits) silently forks. Enforced by the shared
+    `pio lint` engine."""
+    from incubator_predictionio_tpu.tools.lint import assert_rule_clean
 
-    import incubator_predictionio_tpu
-
-    root = pathlib.Path(incubator_predictionio_tpu.__file__).parent
-    allowed = {root / "data" / "api" / "event_log.py",
-               root / "data" / "api" / "ingest_wal.py"}
-    suspects = (".wal", ".colseg", ".manifest")
-    offenders = []
-    for sub in ("data", "workflow"):
-        for path in (root / sub).rglob("*.py"):
-            if path in allowed:
-                continue
-            tree = ast.parse(path.read_text(), filename=str(path))
-            for node in ast.walk(tree):
-                if (isinstance(node, ast.Constant)
-                        and isinstance(node.value, str)
-                        and node.value.endswith(suspects)):
-                    offenders.append(f"{path}:{node.lineno} "
-                                     f"{node.value!r}")
-    assert not offenders, (
-        "segment/manifest file suffixes referenced outside "
-        "event_log.py/ingest_wal.py:\n" + "\n".join(offenders))
+    assert_rule_clean("wal-suffix-confinement")

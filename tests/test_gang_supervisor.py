@@ -13,7 +13,6 @@ coordinator must error within the bound, not hang), envknobs semantics,
 and the single-spawn-path AST guard.
 """
 
-import ast
 import json
 import os
 import signal
@@ -29,7 +28,6 @@ import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-PKG = os.path.join(REPO, "incubator_predictionio_tpu")
 WORKER = os.path.join(HERE, "gang_als_worker.py")
 
 N_ITERS = 6
@@ -245,35 +243,11 @@ def test_no_subprocess_spawns_outside_supervisor():
     """Everything under parallel/ and workflow/ must route process
     spawning through parallel/supervisor.py (the PR 3/6
     single-dispatch-path pattern): a side-channel worker launch would
-    escape liveness monitoring, restart accounting, and drain."""
-    allowed = {os.path.join(PKG, "parallel", "supervisor.py")}
-    banned_sub = {"Popen", "run", "call", "check_call", "check_output"}
-    banned_os = {"fork", "forkpty", "spawnv", "spawnve", "spawnl",
-                 "spawnlp", "spawnvp", "posix_spawn", "execv", "execve"}
-    offenders = []
-    for sub in ("parallel", "workflow"):
-        for root, _, files in os.walk(os.path.join(PKG, sub)):
-            for fn in files:
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(root, fn)
-                if path in allowed:
-                    continue
-                tree = ast.parse(open(path).read(), filename=path)
-                for node in ast.walk(tree):
-                    if not isinstance(node, ast.Call):
-                        continue
-                    f = node.func
-                    if (isinstance(f, ast.Attribute)
-                            and isinstance(f.value, ast.Name)):
-                        if (f.value.id == "subprocess"
-                                and f.attr in banned_sub) or \
-                           (f.value.id == "os" and f.attr in banned_os):
-                            offenders.append(
-                                f"{path}:{node.lineno} {f.value.id}.{f.attr}")
-    assert not offenders, (
-        "process spawn outside parallel/supervisor.py:\n"
-        + "\n".join(offenders))
+    escape liveness monitoring, restart accounting, and drain.
+    Enforced by the shared `pio lint` engine."""
+    from incubator_predictionio_tpu.tools.lint import assert_rule_clean
+
+    assert_rule_clean("spawn-confinement")
 
 
 # ---------------------------------------------------------------------------

@@ -547,37 +547,11 @@ def test_guard_no_per_event_insert_in_hot_handlers():
     """Guard (pattern of PR 1's raw-urlopen ban): the event server's
     write handlers must feed the ingest buffer — a future edit calling
     the per-event `insert(` DAO directly would silently bypass group
-    commit, drain and overload shedding."""
-    import ast
-    import pathlib
+    commit, drain and overload shedding. Enforced by the shared
+    `pio lint` engine (rule also covers handler renames)."""
+    from incubator_predictionio_tpu.tools.lint import assert_rule_clean
 
-    import incubator_predictionio_tpu
-
-    src = (pathlib.Path(incubator_predictionio_tpu.__file__).parent
-           / "data" / "api" / "event_server.py").read_text()
-    tree = ast.parse(src)
-    cls = next(n for n in ast.walk(tree)
-               if isinstance(n, ast.ClassDef) and n.name == "EventServer")
-    hot = {"handle_create", "handle_batch", "handle_webhook"}
-    seen = set()
-    offenders = []
-    for fn in ast.walk(cls):
-        if not isinstance(fn, ast.AsyncFunctionDef) or fn.name not in hot:
-            continue
-        seen.add(fn.name)
-        uses_buffer = False
-        for n in ast.walk(fn):
-            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
-                if n.func.attr in ("insert", "insert_batch",
-                                   "insert_canonical_lines"):
-                    offenders.append((fn.name, n.lineno, n.func.attr))
-            if isinstance(n, ast.Attribute) and n.attr == "ingest":
-                uses_buffer = True
-        assert uses_buffer, f"{fn.name} does not feed the ingest buffer"
-    assert seen == hot
-    assert not offenders, (
-        f"per-event storage writes in hot handlers: {offenders}; "
-        "route writes through EventServer.ingest (the group-commit buffer)")
+    assert_rule_clean("ingest-hot-path")
 
 
 def test_ingest_marker_registered():

@@ -757,30 +757,11 @@ def test_guard_workflow_reads_models_only_via_artifact_loader():
     verifying loader (model_artifact.py) — a future `storage.
     get_model_data_models().get(...)` elsewhere would bypass checksum
     verification and reopen the corrupt-model-serves-production hole
-    (the PR 3/6/8 single-path-guard pattern)."""
-    import ast
-    import pathlib
+    (the PR 3/6/8 single-path-guard pattern). Enforced by the shared
+    `pio lint` engine."""
+    from incubator_predictionio_tpu.tools.lint import assert_rule_clean
 
-    import incubator_predictionio_tpu
-
-    wf = pathlib.Path(incubator_predictionio_tpu.__file__).parent \
-        / "workflow"
-    offenders = []
-    for path in sorted(wf.glob("*.py")):
-        if path.name == "model_artifact.py":
-            continue
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            name = None
-            if isinstance(node, ast.Attribute):
-                name = node.attr
-            elif isinstance(node, ast.Name):
-                name = node.id
-            if name == "get_model_data_models":
-                offenders.append(f"{path.name}:{node.lineno}")
-    assert not offenders, (
-        "workflow/ must read models only through "
-        f"model_artifact.read_model: {offenders}")
+    assert_rule_clean("models-dao-confinement")
 
 
 def test_lifecycle_marker_registered():

@@ -475,45 +475,10 @@ def test_guard_handlers_dispatch_only_through_admission_gate():
     calling `asyncio.to_thread(deployment.query, ...)` (or shipping
     `.query`/`.batch_query` to any executor) directly from a handler
     would silently bypass the bounded executor, the shed path and the
-    deadline budget."""
-    import ast
-    import pathlib
+    deadline budget. Enforced by the shared `pio lint` engine."""
+    from incubator_predictionio_tpu.tools.lint import assert_rule_clean
 
-    import incubator_predictionio_tpu
-
-    src = (pathlib.Path(incubator_predictionio_tpu.__file__).parent
-           / "workflow" / "create_server.py").read_text()
-    cls = next(n for n in ast.walk(ast.parse(src))
-               if isinstance(n, ast.ClassDef) and n.name == "EngineServer")
-
-    def mentions_query_compute(node):
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Attribute) and sub.attr in (
-                    "query", "batch_query"):
-                return True
-        return False
-
-    offenders = []
-    gated = False
-    for fn in ast.walk(cls):
-        if not isinstance(fn, ast.AsyncFunctionDef) \
-                or not fn.name.startswith("handle_"):
-            continue
-        for n in ast.walk(fn):
-            if not isinstance(n, ast.Call):
-                continue
-            callee = n.func
-            name = callee.attr if isinstance(callee, ast.Attribute) else \
-                getattr(callee, "id", "")
-            if name in ("to_thread", "run_in_executor", "submit") and \
-                    any(mentions_query_compute(a) for a in n.args):
-                offenders.append((fn.name, n.lineno, name))
-            if fn.name == "handle_query" and name == "_dispatch_query":
-                gated = True
-    assert gated, "handle_query no longer routes through _dispatch_query"
-    assert not offenders, (
-        f"query compute dispatched outside the admission gate: "
-        f"{offenders}; route it through EngineServer._dispatch_query")
+    assert_rule_clean("query-dispatch-gate")
 
 
 def test_pio_status_engine_url_reports_overload(memory_storage, capsys):

@@ -495,38 +495,8 @@ def test_no_raw_urlopen_outside_resilient_transport():
     resilience layer (common.resilience.resilient_urlopen) or the
     resilient _Transport — a future backend calling
     urllib.request.urlopen directly would silently bypass retries,
-    breakers AND fault injection."""
-    import ast
-    import pathlib
+    breakers AND fault injection. Enforced by the shared `pio lint`
+    engine."""
+    from incubator_predictionio_tpu.tools.lint import assert_rule_clean
 
-    import incubator_predictionio_tpu
-
-    storage_dir = (pathlib.Path(incubator_predictionio_tpu.__file__).parent
-                   / "data" / "storage")
-
-    def urlopen_calls(tree):
-        return [n.lineno for n in ast.walk(tree)
-                if isinstance(n, ast.Call)
-                and isinstance(n.func, ast.Attribute)
-                and n.func.attr == "urlopen"]
-
-    offenders = []
-    for path in sorted(storage_dir.glob("*.py")):
-        tree = ast.parse(path.read_text())
-        calls = urlopen_calls(tree)
-        if not calls:
-            continue
-        if path.name != "http_backend.py":
-            offenders.extend((path.name, ln) for ln in calls)
-            continue
-        # http_backend.py: urlopen is legal ONLY inside the resilient
-        # _Transport (whose every path applies policy/breaker/faults)
-        transport = next(
-            n for n in ast.walk(tree)
-            if isinstance(n, ast.ClassDef) and n.name == "_Transport")
-        allowed = set(urlopen_calls(transport))
-        offenders.extend(
-            (path.name, ln) for ln in calls if ln not in allowed)
-    assert not offenders, (
-        f"urllib.request.urlopen outside the resilience layer: {offenders}; "
-        "use incubator_predictionio_tpu.common.resilience.resilient_urlopen")
+    assert_rule_clean("resilient-urlopen")

@@ -16,7 +16,6 @@ disabled-path cost guarantee.
   workflow/ — metrics go through the registry
 """
 
-import ast
 import gc
 import json
 import os
@@ -413,44 +412,12 @@ def test_disabled_metrics_skip_recording():
 # AST guard: metrics go through the registry
 # ---------------------------------------------------------------------------
 
-_COUNTERISH_NAME = re.compile(r"(count|counter|stats?|metric)", re.I)
-_BANNED_CALLS = {"Counter", "defaultdict", "dict", "OrderedDict"}
-
-
 def test_no_adhoc_module_counter_dicts():
     """No NEW module-level counter dicts under data/api/ and workflow/:
     a counter-ish name assigned a dict/Counter literal at module scope
     is ad-hoc state the registry should own (this is exactly what
-    stats.py and the ingest counters migrated away from)."""
-    pkg_root = os.path.dirname(
-        os.path.abspath(incubator_predictionio_tpu.__file__))
-    offenders = []
-    for sub in ("data/api", "workflow"):
-        d = os.path.join(pkg_root, sub)
-        for fname in sorted(os.listdir(d)):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(d, fname)
-            with open(path, encoding="utf-8") as f:
-                tree = ast.parse(f.read())
-            for node in tree.body:
-                if isinstance(node, ast.Assign):
-                    targets = node.targets
-                elif isinstance(node, ast.AnnAssign):
-                    targets = [node.target]
-                else:
-                    continue
-                value = node.value
-                banned = isinstance(value, (ast.Dict, ast.Set)) or (
-                    isinstance(value, ast.Call)
-                    and isinstance(value.func, ast.Name)
-                    and value.func.id in _BANNED_CALLS)
-                if not banned:
-                    continue
-                for t in targets:
-                    if (isinstance(t, ast.Name)
-                            and _COUNTERISH_NAME.search(t.id)):
-                        offenders.append(f"{sub}/{fname}: {t.id}")
-    assert not offenders, (
-        "module-level counter dicts found (use common/telemetry.py "
-        f"registry families instead): {offenders}")
+    stats.py and the ingest counters migrated away from). Enforced by
+    the shared `pio lint` engine."""
+    from incubator_predictionio_tpu.tools.lint import assert_rule_clean
+
+    assert_rule_clean("no-adhoc-counters")
