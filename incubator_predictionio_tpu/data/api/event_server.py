@@ -213,7 +213,11 @@ class EventServer:
         interval = max(0.05, envknobs.env_ms(
             "PIO_WORKER_HEARTBEAT_MS", 1000.0, lo_ms=20.0) / 2.0)
         while True:
-            supervisor.beat()
+            # beat() touches the heartbeat file — disk I/O that must
+            # stall a worker thread, not the accept loop (a cold or
+            # contended volume turning a liveness beat into a server
+            # freeze would be the detector CAUSING the disease)
+            await asyncio.to_thread(supervisor.beat)
             await asyncio.sleep(interval)
 
     async def _compact_loop(self) -> None:

@@ -314,6 +314,20 @@ class IngestBuffer:
         self.dropped = 0
         self.deferred = 0         # enqueue-acked, commit failed, in WAL
         self.shed_appends = 0     # requests refused while in shed mode
+        # Warm the native codec NOW, in sync construction context: the
+        # batch fast paths (ingest_batch) refuse to lazy-build because
+        # they can run on the event loop, where a cold-cache g++ build
+        # would stall every connection — so the build (or the cached
+        # dlopen) happens here, before serving starts. Only worth it
+        # when the store can take canonical lines at all.
+        try:
+            if self.storage is not None and hasattr(
+                    self.storage.get_l_events(), "insert_canonical_lines"):
+                from ...native import available
+
+                available()
+        except Exception:  # noqa: BLE001 — no codec just means no fast path
+            pass
 
     @property
     def ack_on_enqueue(self) -> bool:

@@ -244,6 +244,21 @@ def _load() -> ctypes.CDLL:
             raise NativeUnavailable(_lib_error) from e
 
 
+def loaded() -> Optional[ctypes.CDLL]:
+    """The already-loaded library, or None — NEVER loads or builds.
+    Hot paths that may run ON an event loop (the ingest fast paths) use
+    this so a cold cache can't turn into a g++ build stalling every
+    connection; a sync context (server construction) pays the build via
+    :func:`available`. Honours the PIO_DISABLE_NATIVE kill-switch
+    per-call exactly like :func:`_load` — the operational escape hatch
+    must cover the hot path too, resident library or not."""
+    from ..common import envknobs
+
+    if envknobs.env_flag("PIO_DISABLE_NATIVE", False):
+        return None
+    return _lib
+
+
 def available() -> bool:
     try:
         _load()
@@ -682,10 +697,20 @@ def ingest_batch(raw: bytes, max_items: int, creation_iso: str):
     the uniform happy case, or None when ANY item needs the Python path
     (validation failure, client-supplied eventId, over-cap count, syntax
     error) — the caller then re-parses in Python for exact error
-    semantics. Raises NativeUnavailable when the codec cannot load."""
+    semantics. Raises NativeUnavailable when the codec is not RESIDENT:
+    unlike every other entry point this one never triggers the lazy
+    build — its callers (/batch handler, inline group commit) can run
+    on the event loop, where a first-use g++ build would stall every
+    connection for seconds. IngestBuffer warms the codec at
+    construction; until someone does, callers fall back to the Python
+    path exactly as if no toolchain existed."""
     import os as _os2
 
-    lib = _load()
+    lib = loaded()
+    if lib is None:
+        raise NativeUnavailable(
+            "native codec not resident — warm it off the hot path "
+            "(native.available() in a sync context) before first use")
     try:
         # Python json.loads decodes the body as strict UTF-8 before any
         # grammar check; the C scanner is byte-oriented, so invalid UTF-8

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from .engine import (Finding, Module, Project, Rule, report_json, rule,
                      run_lint)
-from . import rules_concurrency, rules_confinement, rules_registry
+from . import (rules_concurrency, rules_confinement, rules_flow,
+               rules_registry)
 
 __all__ = ["ALL_RULES", "Finding", "Module", "Project", "Rule",
            "lint_repo", "report_json", "rule", "run_lint",
@@ -19,7 +20,8 @@ __all__ = ["ALL_RULES", "Finding", "Module", "Project", "Rule",
 
 ALL_RULES: list[Rule] = (rules_confinement.RULES
                          + rules_concurrency.RULES
-                         + rules_registry.RULES)
+                         + rules_registry.RULES
+                         + rules_flow.RULES)
 
 
 def rule_names() -> list[str]:
@@ -27,6 +29,7 @@ def rule_names() -> list[str]:
 
 
 _project_cache: dict = {}
+_full_result_cache: dict = {}
 
 
 def lint_repo(repo_root=None, only=None) -> dict:
@@ -34,10 +37,21 @@ def lint_repo(repo_root=None, only=None) -> dict:
 
     The parsed Project is memoized per root: the tier-1 repo-clean test
     plus the seven migrated guard tests would otherwise each re-parse
-    all ~116 modules — one parse pass total is the budget contract."""
+    all ~116 modules — one parse pass total is the budget contract.
+    FULL runs (``only=None``) memoize their whole result too: they are
+    deterministic per process, and the repo-clean gate, the suppression
+    inventory and the runtime-budget tests all want the same run — its
+    ``timings`` carry the true cost (parse, call graph and tests/ scan
+    are paid lazily inside the first rules that need them)."""
     project = _project_cache.get(repo_root)
     if project is None:
         project = _project_cache[repo_root] = Project.from_repo(repo_root)
+    if only is None:
+        result = _full_result_cache.get(repo_root)
+        if result is None:
+            result = _full_result_cache[repo_root] = run_lint(
+                project, ALL_RULES)
+        return result
     return run_lint(project, ALL_RULES, only=only)
 
 

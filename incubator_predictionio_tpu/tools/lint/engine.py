@@ -30,6 +30,7 @@ import dataclasses
 import json
 import pathlib
 import re
+import time
 from typing import Callable, Iterable, Optional
 
 __all__ = [
@@ -239,6 +240,7 @@ def run_lint(project: Project, rules: list[Rule],
          "suppressed": int,
          "suppressions": [Suppression...],
          "rules": [names run],
+         "timings": [(rule name, seconds)],   # per-rule wall time
          "modules": int}
 
     Per-line ``# pio-lint: disable=<rule>`` comments swallow findings
@@ -263,8 +265,11 @@ def run_lint(project: Project, rules: list[Rule],
         selected = list(rules)
 
     raw: list[Finding] = []
+    timings: list[tuple[str, float]] = []
     for r in selected:
+        t0 = time.perf_counter()
         raw.extend(r.check(project))
+        timings.append((r.name, time.perf_counter() - t0))
     # modules the compiler can't parse are findings, not crashes
     for m in project.modules():
         if m.parse_error is not None:
@@ -300,6 +305,7 @@ def run_lint(project: Project, rules: list[Rule],
         "suppressed": suppressed,
         "suppressions": sorted(sups.values(), key=lambda s: (s.path, s.line)),
         "rules": [r.name for r in selected],
+        "timings": timings,
         "modules": len(project.modules()),
     }
 

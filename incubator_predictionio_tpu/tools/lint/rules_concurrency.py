@@ -22,7 +22,11 @@ from typing import Iterable, Optional
 
 from .engine import Finding, Project, rule
 
-__all__ = ["RULES", "LOCK_GUARDED"]
+# _LOOP_SCOPES and the _BLOCKING_* tables are shared with the
+# whole-program rules_flow family: ONE definition of "serving module"
+# and "known-blocking call" or the lexical and flow rules drift apart.
+__all__ = ["RULES", "LOCK_GUARDED", "_LOOP_SCOPES",
+           "_BLOCKING_QUALIFIED", "_BLOCKING_BARE"]
 
 # -- lock-discipline registry ----------------------------------------------
 # module relpath -> list of (class name or None for module scope,

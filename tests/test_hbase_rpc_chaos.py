@@ -1,0 +1,61 @@
+"""Chaos coverage for the native HBase RPC fault points.
+
+``hbase.rpc`` and ``hbase.ping`` are instrumented in
+data/storage/hbase_rpc.py but no test armed them before ISSUE 11's
+``fault-point-coverage`` rule (code ↔ tests registry sync) — an
+unarmed fault point is chaos tooling that proves nothing. These tests
+arm both through PIO_FAULT_SPEC against the in-process mock region
+server and assert the injected faults ride the SAME retry/breaker
+plumbing a real torn socket would.
+"""
+
+import pytest
+
+from hbase_rpc_mock import MockHBaseRpcServer
+from incubator_predictionio_tpu.common import faultinject
+from incubator_predictionio_tpu.data.storage import hbase_rpc
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture()
+def chaos(monkeypatch):
+    def arm(spec):
+        monkeypatch.setenv("PIO_FAULT_SPEC", spec)
+        faultinject.reset()
+
+    yield arm
+    monkeypatch.delenv("PIO_FAULT_SPEC", raising=False)
+    faultinject.reset()
+
+
+def test_rpc_fault_retries_like_torn_socket(chaos):
+    """hbase.rpc fail = InjectedFault(ConnectionError) inside _call: it
+    must classify as connection_lost and be absorbed by the
+    relocate-and-retry loop exactly like a dead region server — the
+    caller still gets its row."""
+    with MockHBaseRpcServer() as srv:
+        t = hbase_rpc.HBaseRpcTransport("127.0.0.1", srv.port)
+        try:
+            t.create_table("chaos_tbl")
+            t.put_rows("chaos_tbl", [(b"r1", {"v": b"x"})])
+            chaos("hbase.rpc:fail:1")
+            assert t.get_row("chaos_tbl", b"r1") == {"v": b"x"}
+        finally:
+            t.close()
+
+
+def test_ping_fault_retried_then_exhausts_policy(chaos):
+    """hbase.ping rides the shared RetryPolicy: one injected failure is
+    retried away; more failures than the policy's attempts surface as
+    the injected ConnectionError."""
+    with MockHBaseRpcServer() as srv:
+        t = hbase_rpc.HBaseRpcTransport("127.0.0.1", srv.port)
+        try:
+            chaos("hbase.ping:fail:1")
+            t.ping()                        # retried within the policy
+            chaos("hbase.ping:fail:99")
+            with pytest.raises(ConnectionError):
+                t.ping()                    # policy exhausted: surfaces
+        finally:
+            t.close()

@@ -342,6 +342,37 @@ def test_replay_dedupes_when_marker_lost(wal_env, monkeypatch):
     assert [e.event_id for e in storage.get_l_events().find(app_id)] == [eid]
 
 
+def test_append_fault_fails_request_and_replay_stays_clean(wal_env,
+                                                           monkeypatch):
+    """wal.append fault = the durability append itself failed (disk
+    gone mid-write): the request must FAIL — an event the WAL never
+    held may not be acked — nothing lands in the store, and recovery
+    must not resurrect anything from the aborted attempt. The next
+    request (rule spent) commits normally."""
+    tmp_path = wal_env
+    monkeypatch.setenv("PIO_FAULT_SPEC", "wal.append:fail:1")
+    faultinject.reset()
+    try:
+        storage, app_id, key = _storage(tmp_path)
+        server = EventServer(storage)
+        with ServerThread(server.app) as st:
+            u = f"{st.base}/events.json?accessKey={key}"
+            r = requests.post(u, json=_ev(1))
+            assert r.status_code == 500, r.text
+            r2 = requests.post(u, json=_ev(2))
+            assert r2.status_code == 201, r2.text
+            eid2 = r2.json()["eventId"]
+    finally:
+        monkeypatch.delenv("PIO_FAULT_SPEC")
+        faultinject.reset()
+    assert [e.event_id for e in storage.get_l_events().find(app_id)] \
+        == [eid2]
+    summary = ingest_wal.recover(storage)
+    assert summary["replayed"] == 0 and summary["deduped"] == 0
+    assert [e.event_id for e in storage.get_l_events().find(app_id)] \
+        == [eid2]
+
+
 @pytest.mark.chaos
 @pytest.mark.ingest
 def test_drain_under_fault_settles_futures_and_wal_replayable(

@@ -623,14 +623,22 @@ def test_cli_clean_rc0_and_filters(tmp_path, capsys):
 def test_console_lint_verb_never_imports_jax():
     """`pio lint` must stay a pure parse pass: the console dispatches
     it before any jax-touching setup (PIO_TEST_FORCE_CPU included), so
-    a full run fits tier-1 in seconds. Subprocess-proved."""
+    a full run fits tier-1 in seconds. Subprocess-proved — including
+    the ISSUE 11 whole-program flow rules (call graph + tests/ scan)
+    and the --profile path, which must stay equally import-light."""
     r = subprocess.run(
         [sys.executable, "-c",
          "import sys\n"
          "from incubator_predictionio_tpu.tools.console import main\n"
-         "rc = main(['lint'])\n"
+         # ONE full run covers all 17 rules — the ISSUE 11 flow family
+         # included (call-graph build + tests/ fault-spec scan), and
+         # --profile proves the timing path is equally import-light
+         "rc = main(['lint', '--profile'])\n"
          "assert rc == 0, rc\n"
          "assert 'jax' not in sys.modules, 'pio lint imported jax'\n"
          "assert 'aiohttp' not in sys.modules, 'pio lint imported aiohttp'\n"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
+    # the profile table names the flow rules: they RAN in that process
+    assert "transitive-blocking-on-loop" in r.stderr
+    assert "fault-point-coverage" in r.stderr

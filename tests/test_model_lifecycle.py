@@ -292,6 +292,26 @@ def test_slow_canary_times_out_into_rollback(memory_storage, chaos):
         assert lc["pinned"] == {iid2: "error-rate"}
 
 
+def test_query_stage_faults_surface_as_500(memory_storage, chaos):
+    """The featurize and serve stage fault points fire through the
+    REAL query path: a fail-injected stage answers 500 (no watch
+    window, so no hedge), and once the rule is spent the next query
+    serves normally. The overload/watch harnesses lean on
+    query.predict; these two close fault-point-coverage for the
+    remaining DASE stages."""
+    _train(memory_storage, "one")
+    server = EngineServer(lifecycle_engine.engine_factory(),
+                          engine_factory_name="lifecycle",
+                          storage=memory_storage)
+    with ServerThread(server.app) as st:
+        chaos("query.featurize:fail:1")
+        assert _post(st.base, "u1").status_code == 500
+        assert _post(st.base, "u1").status_code == 200
+        chaos("query.serve:fail:1")
+        assert _post(st.base, "u2").status_code == 500
+        assert _post(st.base, "u2").status_code == 200
+
+
 def test_completed_row_without_model_skipped(memory_storage):
     """The crash-mid-persist state: a COMPLETED row whose model never
     landed must be skipped by the latest walk — and an engine server

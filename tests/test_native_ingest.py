@@ -47,6 +47,26 @@ BATCH = [
 ]
 
 
+def test_kill_switch_covers_resident_hot_path(monkeypatch):
+    """PIO_DISABLE_NATIVE must disable the batch fast path PER CALL
+    even after the codec is resident: ingest_batch reads the cached
+    library through loaded(), and loaded() re-checks the flag exactly
+    like the lazy loader — flipping the switch with a warm library
+    must not leave /batch running the supposedly-disabled codec."""
+    from incubator_predictionio_tpu import native
+
+    monkeypatch.delenv("PIO_DISABLE_NATIVE", raising=False)
+    if not native.available():
+        pytest.skip("no native toolchain in this environment")
+    assert native.loaded() is not None
+    monkeypatch.setenv("PIO_DISABLE_NATIVE", "1")
+    assert native.loaded() is None
+    with pytest.raises(native.NativeUnavailable):
+        native.ingest_batch(b"[]", 50, "2026-01-01T00:00:00.000Z")
+    monkeypatch.delenv("PIO_DISABLE_NATIVE")
+    assert native.loaded() is not None
+
+
 def _ingest(storage, body, monkeypatch=None, disable_native=False):
     if monkeypatch is not None:
         if disable_native:

@@ -686,12 +686,24 @@ def test_flood_caps_queue_and_sigterm_drains_clean(tmp_path):
     assert 500 not in statuses and 504 not in statuses, statuses
     # before SIGTERM the server answers EVERYTHING at the HTTP layer —
     # accepted (200) or cleanly shed (503); no dropped connections. A
-    # small margin excludes the boundary instant: a request whose send
-    # timestamp landed just before the signal can still lose the
-    # connection-level race against the post-drain listener close.
+    # request whose SEND stamp landed pre-signal can still lose the
+    # connection-level race against the post-drain listener close when
+    # the CLIENT loop itself is starved (the documented PR 6 full-suite
+    # CPU-contention flake: the coroutine stamps its send time, then
+    # waits severalfold longer than planned for its actual connect), so
+    # connection failures are classified by when they MATERIALIZED:
+    # observed after the signal instant = the close race (excused);
+    # observed before it = the server really dropped a live connection
+    # (still fails).
     pre = [r for r in records if r[0] < sigterm_at - 0.5]
-    assert pre and all(s in (200, 503) for (_, s, _, _, _) in pre), \
-        sorted({str(s) for (_, s, _, _, _) in pre})
+    assert pre, "no pre-SIGTERM samples"
+    answered = [s for (_, s, _, _, _) in pre if s is not None]
+    assert answered and all(s in (200, 503) for s in answered), \
+        sorted({str(s) for s in answered})
+    dropped_live = [(t, lat) for (t, s, _, lat, _) in pre
+                    if s is None and t + lat < sigterm_at]
+    assert not dropped_live, \
+        f"connection(s) dropped before SIGTERM: {dropped_live}"
     # every accepted query returned a real result
     assert all(ok for (_, s, _, _, ok) in records if s == 200)
     # accepted p99 bounded: far below the 6s request deadline — the
