@@ -76,6 +76,7 @@ import numpy as np
 
 from ...common import telemetry
 from ...common.faultinject import fault_point
+from ...common.splice import FrontProxy
 from .ingest_buffer import IngestOverloadError
 from .ingest_wal import QUARANTINE_DIR, quarantine_path
 
@@ -634,76 +635,10 @@ def partition_health(events_dir: str) -> dict:
 # multi-worker event serving (front listener + supervised workers)
 # ---------------------------------------------------------------------------
 
-async def _pipe(reader: asyncio.StreamReader,
-                writer: asyncio.StreamWriter) -> None:
-    """One splice direction. EOF half-closes the peer (write_eof) —
-    a client that shuts down its write side after the request must
-    still receive the response on the other direction; the full close
-    happens in _handle once BOTH directions are done."""
-    try:
-        while True:
-            chunk = await reader.read(65536)
-            if not chunk:
-                break
-            writer.write(chunk)
-            await writer.drain()
-        if writer.can_write_eof():
-            writer.write_eof()
-    except (ConnectionError, asyncio.IncompleteReadError, OSError):
-        try:
-            writer.close()
-        except Exception:  # noqa: BLE001 — teardown best-effort
-            pass
-
-
-class FrontProxy:
-    """Connection-level (L4) front listener: each accepted client
-    connection is spliced to one worker, chosen round-robin among the
-    backends that accept a connect. No HTTP parsing on the hot path —
-    keep-alive clients naturally spread across workers, and a worker
-    mid-restart is skipped (its connections land on the survivors)."""
-
-    def __init__(self, worker_ports: list[int],
-                 host: str = "127.0.0.1"):
-        self.worker_ports = worker_ports
-        self.worker_host = host
-        self._rr = 0
-        self._server: Optional[asyncio.AbstractServer] = None
-
-    async def _connect_backend(self):
-        n = len(self.worker_ports)
-        for i in range(n):
-            port = self.worker_ports[(self._rr + i) % n]
-            try:
-                r, w = await asyncio.open_connection(self.worker_host, port)
-            except OSError:
-                continue
-            self._rr = (self._rr + i + 1) % n
-            return r, w
-        return None
-
-    async def _handle(self, creader, cwriter) -> None:
-        backend = await self._connect_backend()
-        if backend is None:
-            cwriter.close()
-            return
-        breader, bwriter = backend
-        await asyncio.gather(_pipe(creader, bwriter),
-                             _pipe(breader, cwriter))
-        for w in (bwriter, cwriter):
-            try:
-                w.close()
-            except Exception:  # noqa: BLE001 — teardown best-effort
-                pass
-
-    async def start(self, host: str, port: int) -> None:
-        self._server = await asyncio.start_server(
-            self._handle, host, port, reuse_address=True)
-
-    async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+# The L4 splice front itself now lives in common/splice.py (shared with
+# the engine replica fleet, workflow/fleet.py); the event server keeps
+# its original behavior — no readiness probing, no /healthz
+# interception (FrontProxy is re-imported above).
 
 
 def worker_env(idx: int, port: int, wal_dir: Optional[str]) -> dict:

@@ -399,6 +399,10 @@ class Supervisor:
         self.coordinator_host = coordinator_host
 
         self.restarts = 0
+        # per-worker relaunch counts (restart_scope="worker"): read by
+        # the fleet front's /healthz aggregation and mirrored into
+        # supervisor.json; gang-scope restarts stay in self.restarts
+        self.worker_restarts = [0] * self.config.num_workers
         self.state = "idle"
         self.events: list[dict] = []
         self._workers: list[_Worker] = []
@@ -538,6 +542,8 @@ class Supervisor:
                 "alive": alive,
                 "returncode": w.proc.poll(),
                 "heartbeatAgeMs": age,
+                "restarts": (self.worker_restarts[w.idx]
+                             if w.idx < len(self.worker_restarts) else 0),
                 "log": w.log_path,
             })
         state_g.set(state_code)
@@ -624,7 +630,7 @@ class Supervisor:
         cfg = self.config
         backoff = RetryPolicy(max_attempts=cfg.max_restarts + 1,
                               base_delay=0.5, max_delay=15.0)
-        per_worker_restarts = [0] * cfg.num_workers
+        per_worker_restarts = self.worker_restarts
         self._attempt = 0
         self.state = "running"
         self._spawn_gang(resume=False)

@@ -263,7 +263,16 @@ def deploy_cmd(args: list[str]) -> int:
     p.add_argument("--rollback", action="store_true",
                    help="don't deploy: tell the engine server already "
                         "running at --ip/--port to roll back to its "
-                        "previous deployment, then exit")
+                        "previous deployment, then exit (against a "
+                        "fleet front this is a FLEET rollback — the "
+                        "pin propagates to every replica)")
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="serve as a fleet of N supervised engine-server "
+                        "processes behind an L4 splice front with a "
+                        "staged canary rollout (default "
+                        "$PIO_QUERY_REPLICAS, else 0 = single process)")
+    p.add_argument("--replica-worker", action="store_true",
+                   help=argparse.SUPPRESS)  # internal: fleet replica
     ns = p.parse_args(args)
     if ns.rollback:
         from ...common import ssl_context_from_env
@@ -275,13 +284,36 @@ def deploy_cmd(args: list[str]) -> int:
         host = "127.0.0.1" if ns.ip in ("0.0.0.0", "::") else ns.ip
         return rollback_via_url(f"{scheme}://{host}:{ns.port}",
                                 insecure=True)
-    from ...workflow.create_server import EngineServer, run_engine_server
+    from ...common import envknobs
+
+    if ns.replica_worker:
+        return _deploy_replica_worker(ns)
+    replicas = (ns.replicas if ns.replicas is not None
+                else envknobs.env_int("PIO_QUERY_REPLICAS", 0, lo=0))
+    if replicas >= 1:
+        return _deploy_fleet(args, ns, replicas)
+    from ...workflow.create_server import run_engine_server
+
+    server = _build_engine_server(ns)
+    print(f"[info] Engine is deployed and running. Listening on {ns.ip}:{ns.port}")
+    run_engine_server(server, ns.ip, ns.port,
+                      probe_latency=ns.probe_latency)
+    return 0
+
+
+def _build_engine_server(ns):
+    """ONE EngineServer construction for the single-process deploy and
+    the fleet replica worker: a serving knob added here reaches both
+    paths (two hand-synced kwarg blocks had already drifted once).
+    `model_refresh_ms` is safe to pass in fleet mode — the replica
+    zeroes it itself (the coordinator owns refresh)."""
+    from ...workflow.create_server import EngineServer
 
     engine, params, factory, variant, _ = _load_engine(ns)
     app_name = dict(params.data_source_params).get("app_name") or dict(
         params.data_source_params
     ).get("appName", "")
-    server = EngineServer(
+    return EngineServer(
         engine,
         engine_factory_name=factory,
         engine_variant=variant,
@@ -296,9 +328,90 @@ def deploy_cmd(args: list[str]) -> int:
         drain_deadline_ms=ns.drain_deadline_ms,
         model_refresh_ms=ns.model_refresh_ms,
     )
-    print(f"[info] Engine is deployed and running. Listening on {ns.ip}:{ns.port}")
-    run_engine_server(server, ns.ip, ns.port,
-                      probe_latency=ns.probe_latency)
+
+
+def _strip_replicas(args: list[str]) -> list[str]:
+    """Replica worker argv = the deploy argv minus the fleet flag (a
+    replica that re-spawned a fleet would fork-bomb; belt to the
+    --replica-worker suspenders — the PR 7 --num-workers pattern)."""
+    out, skip = [], False
+    for tok in args:
+        if skip:
+            skip = False
+            continue
+        if tok == "--replicas":
+            skip = True
+            continue
+        if tok.startswith("--replicas="):
+            continue
+        out.append(tok)
+    return out
+
+
+def _deploy_fleet(args: list[str], ns, replicas: int) -> int:
+    """`pio deploy --replicas N` front: the fleet coordinator + splice
+    front (workflow/fleet.py) supervising N `--replica-worker` copies
+    of this exact command. The front never imports the engine module
+    (factory/variant names come straight from engine.json), so it stays
+    light while the replicas carry the models."""
+    from ...common import ssl_context_from_env
+    from ...workflow.fleet import run_fleet
+
+    if ssl_context_from_env() is not None:
+        # the splice front is plaintext L4: TLS-serving replicas would
+        # fail every plaintext /readyz probe (readiness routing never
+        # engages) and the front's /healthz first-bytes peek cannot see
+        # inside a TLS ClientHello — a silently ops-blind fleet. Refuse
+        # with the deployment that works instead.
+        print("[error] --replicas does not support PIO_SSL_CERTFILE/"
+              "PIO_SSL_KEYFILE: the splice front and its readiness "
+              "probes are plaintext L4. Terminate TLS at a proxy in "
+              "front of the fleet and unset the PIO_SSL_* knobs here.",
+              file=sys.stderr)
+        return 1
+    engine_json_path = os.path.join(ns.engine_dir, "engine.json")
+    engine_json = load_engine_json(engine_json_path,
+                                   getattr(ns, "variant", None))
+    factory = engine_json.get("engineFactory", "engine")
+    variant = engine_json.get("id", "default")
+    worker_argv = [sys.executable, "-m",
+                   "incubator_predictionio_tpu.tools.console", "deploy",
+                   "--replica-worker", *_strip_replicas(args)]
+    if ns.probe_latency:
+        print("[warn] --probe-latency is ignored with --replicas: the "
+              "probe measures ONE process's hot path and would race "
+              "N replicas writing the same instance row; probe a "
+              "single-process deploy instead", file=sys.stderr)
+    if ns.engine_instance_id:
+        print("[warn] --engine-instance-id only seeds the replicas' "
+              "FIRST load with --replicas: the fleet coordinator owns "
+              "rollout and will stage (and, if healthy, promote) the "
+              "newest COMPLETED instance on its next tick. To hold the "
+              "fleet on an older version, roll back to it (`pio models "
+              "rollback --engine-url <front>`) so the newer instance "
+              "is pinned", file=sys.stderr)
+    print(f"[info] Engine fleet: {replicas} replica(s) behind "
+          f"{ns.ip}:{ns.port} (staged canary rollout; front /healthz "
+          "aggregates liveness)")
+    return run_fleet(worker_argv, replicas, ns.ip, ns.port,
+                     engine_factory_name=factory,
+                     engine_variant=variant)
+
+
+def _deploy_replica_worker(ns) -> int:
+    """One supervised fleet replica: identity/port arrive via the
+    supervisor environment; the front owns --ip/--port. The
+    ``fleet.spawn`` fault point fires BEFORE the engine loads, so
+    spawn-window chaos (PIO_FLEET_WORKER_FAULT_SPEC) kills the replica
+    where the supervisor's relaunch machinery must catch it."""
+    from ...workflow.create_server import run_engine_server
+    from ...workflow.fleet import replica_worker_entry
+
+    port = replica_worker_entry()
+    if port <= 0:
+        return 1
+    server = _build_engine_server(ns)
+    run_engine_server(server, "127.0.0.1", port)
     return 0
 
 
@@ -312,7 +425,12 @@ def undeploy_cmd(args: list[str]) -> int:
 
     try:
         r = requests.post(f"http://{ns.ip}:{ns.port}/stop", timeout=10)
-        print(f"[info] {r.json().get('message', r.status_code)}")
+        msg = r.json().get("message", r.status_code)
+        if r.status_code >= 400:
+            # e.g. a fleet replica refusing a single-replica stop
+            print(f"[error] {msg}", file=sys.stderr)
+            return 1
+        print(f"[info] {msg}")
         return 0
     except Exception as e:  # noqa: BLE001
         print(f"[error] {e}", file=sys.stderr)

@@ -167,6 +167,58 @@ def _print_engine_overload(url: str) -> None:
               f"validateFailures={lc.get('validateFailures')}, "
               f"integrityFailures={integ or 0}, "
               f"refresh {refresh}, pinned: {pins}")
+    fleet = doc.get("fleet")
+    if fleet:
+        _print_fleet(fleet)
+
+
+def _print_fleet(fleet: dict) -> None:
+    """Per-replica fleet view (the answering replica's store-fed
+    aggregation): rollout state, every peer's instance/pins/watch, and
+    a warn-marker on divergence — a wedged or stuck-canary replica is
+    visible from one `pio status --engine-url` against the front."""
+    d = fleet.get("directive") or {}
+    peers = fleet.get("peers") or []
+    diverged = bool(fleet.get("divergence"))
+    marker = "[warn]" if diverged else "[info]"
+    canary = (f", canary replica {d.get('canaryReplica')} -> "
+              f"{d.get('target')}" if d.get("state") == "canary" else "")
+    print(f"{marker}   fleet {fleet.get('group')}: "
+          f"{len(peers)}/{fleet.get('replicas')} replica(s) reporting, "
+          f"state {d.get('state') or 'bootstrapping'}, instance "
+          f"{d.get('instance')}{canary} (epoch {d.get('epoch')}, "
+          f"answered by replica {fleet.get('replica')})"
+          + (" — REPLICAS DIVERGE" if diverged else ""))
+    import time as _time
+
+    from ...workflow import model_artifact
+
+    now = _time.time()
+    # staleness tracks the fleet's own sync cadence (the coordinator's
+    # freshness rule — literally the same helper), not a wall-clock
+    # constant: a 30 s PIO_FLEET_SYNC_MS fleet must not warn on every
+    # healthy replica
+    stale_after = model_artifact.fleet_fresh_s(
+        float(fleet.get("syncMs") or 1000))
+    for p in sorted(peers, key=lambda x: x.get("replica", -1)):
+        age = now - float(p.get("updatedAt") or now)
+        flags = []
+        if d.get("state") == "canary" \
+                and p.get("replica") == d.get("canaryReplica"):
+            flags.append("canary" + ("" if p.get("watchDone")
+                                     else " (watching)"))
+        if p.get("pinned"):
+            flags.append(f"pinned={p['pinned']}")
+        if p.get("draining"):
+            flags.append("draining")
+        stale = age > stale_after
+        pmarker = "[warn]" if (stale or p.get("pinned")
+                               or p.get("draining")) else "[info]"
+        print(f"{pmarker}     r{p.get('replica')}: instance "
+              f"{p.get('instance')}"
+              + (f" [{', '.join(flags)}]" if flags else "")
+              + f", updated {age:.1f}s ago"
+              + (" — STALE (wedged or dead?)" if stale else ""))
 
 
 @verb("wal", "inspect or replay the ingest write-ahead log")

@@ -197,6 +197,18 @@ def _gc(storage, ns) -> int:
                                       lc.get("instance"),
                                       lc.get("previous")) if i}
             protected |= set((lc.get("pinned") or {}))
+            # a fleet front splices /status to ONE replica; its cached
+            # peer rows carry what EVERY replica serves — protect all
+            # of it, or GC could delete a model a peer still holds
+            fleet = doc.get("fleet") or {}
+            for peer in fleet.get("peers") or []:
+                protected |= {i for i in (peer.get("instance"),
+                                          peer.get("previous")) if i}
+                protected |= set((peer.get("pinned") or {}))
+            d = fleet.get("directive") or {}
+            protected |= {i for i in (d.get("instance"), d.get("target"),
+                                      d.get("lastGood")) if i}
+            protected |= set((d.get("pinned") or {}))
         except Exception as e:  # noqa: BLE001 - refuse to guess
             print(f"[error] engine server at {ns.engine_url} unreachable "
                   f"({e}); refusing to GC without knowing what it serves "

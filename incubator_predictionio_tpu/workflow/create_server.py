@@ -95,6 +95,9 @@ class EngineServer:
         swap_watch_ms: Optional[float] = None,
         swap_max_error_rate: Optional[float] = None,
         model_refresh_ms: Optional[float] = None,
+        fleet_replica: Optional[int] = None,
+        fleet_replicas: Optional[int] = None,
+        fleet_sync_ms: Optional[float] = None,
     ):
         self.engine = engine
         self.engine_factory_name = engine_factory_name
@@ -124,7 +127,9 @@ class EngineServer:
         self._init_overload_state(query_conc, query_max_pending,
                                   query_deadline_ms, drain_deadline_ms,
                                   swap_validate, swap_watch_ms,
-                                  swap_max_error_rate, model_refresh_ms)
+                                  swap_max_error_rate, model_refresh_ms,
+                                  fleet_replica, fleet_replicas,
+                                  fleet_sync_ms)
         # Probe marker secret: synthetic startup-probe traffic is
         # excluded from queryCount/feedback, so the marker must not be
         # spoofable — an external client sending a bare "X-Pio-Probe: 1"
@@ -149,7 +154,10 @@ class EngineServer:
             "engineserver", self._collect_metrics)
         self.deployment = None
         self.instance = None
-        self._load(instance_id)
+        if self.fleet_mode and instance_id is None:
+            self._fleet_bootstrap_load()
+        else:
+            self._load(instance_id)
 
         self.app = web.Application(
             middlewares=[telemetry.trace_middleware()])
@@ -175,13 +183,19 @@ class EngineServer:
             self.app.on_cleanup.append(self._stop_batcher)
         self.app.on_startup.append(self._start_refresher)
         self.app.on_cleanup.append(self._stop_refresher)
+        self.app.on_startup.append(self._start_fleet)
+        self.app.on_cleanup.append(self._stop_fleet)
+        self.app.on_startup.append(self._start_heartbeat)
+        self.app.on_cleanup.append(self._stop_heartbeat)
         self.app.on_cleanup.append(self._shutdown_executor)
 
     def _init_overload_state(self, query_conc=None, query_max_pending=None,
                              query_deadline_ms=None,
                              drain_deadline_ms=None, swap_validate=None,
                              swap_watch_ms=None, swap_max_error_rate=None,
-                             model_refresh_ms=None) -> None:
+                             model_refresh_ms=None, fleet_replica=None,
+                             fleet_replicas=None,
+                             fleet_sync_ms=None) -> None:
         """Admission control: the query path gets a DEDICATED bounded
         executor (query_conc workers) plus a bounded waiting budget
         (query_max_pending); offered load beyond conc+pending is shed
@@ -251,12 +265,59 @@ class EngineServer:
             else _env_int("PIO_MODEL_REFRESH_MS", 0)))
         self._previous = None            # (deployment, instance) resident
         self._pinned: dict[str, str] = {}  # instance id → pin reason
+        # pins mid-application (store-walk rollback in flight): honored
+        # by this replica's own walks but NOT published to the fleet —
+        # the coordinator merges pins irreversibly, so a provisional
+        # pin that fails to apply must never leak into the directive
+        self._pins_provisional: set = set()
         self._watch = None               # active post-swap watch window
         self._rollbacks: dict[str, int] = {}   # reason → count
         self._swap_count = 0
         self._validate_failures = 0
         self._refresh_swaps = 0
         self._refresh_task = None
+        # fleet wiring rides along so __new__-built harness skeletons
+        # (tools/big_catalog_demo.py) arm everything with ONE call
+        self._init_fleet_state(fleet_replica, fleet_replicas,
+                               fleet_sync_ms)
+
+    def _init_fleet_state(self, fleet_replica=None, fleet_replicas=None,
+                          fleet_sync_ms=None) -> None:
+        """Replica-fleet wiring (docs/operations.md "Serving fleet").
+
+        A fleet replica (``PIO_FLEET_REPLICA`` >= 0, set by the fleet
+        supervisor) does not chase the newest COMPLETED instance on its
+        own: the fleet coordinator (workflow/fleet.py) stages rollouts
+        through a store-mediated directive record, and this replica's
+        sync loop applies directives — each swap still passing this
+        replica's OWN validation gate — and publishes a status row the
+        coordinator (and `pio status --engine-url`) aggregates."""
+        self.fleet_replica = int(
+            fleet_replica if fleet_replica is not None
+            else envknobs.env_int("PIO_FLEET_REPLICA", -1))
+        self.fleet_replicas = max(0, int(
+            fleet_replicas if fleet_replicas is not None
+            else envknobs.env_int("PIO_FLEET_REPLICAS", 0, lo=0)))
+        self.fleet_sync_ms = max(50.0, float(
+            fleet_sync_ms if fleet_sync_ms is not None
+            else _env_int("PIO_FLEET_SYNC_MS", 1000)))
+        self.fleet_mode = self.fleet_replica >= 0
+        # loop-confined cache of the last directive + peer rows (the
+        # _watch idiom): /status and the divergence gauge read the
+        # reference atomically, never the store
+        self._fleet_view: Optional[dict] = None
+        self._fleet_task = None
+        self._hb_task = None
+        if self.fleet_mode and self.model_refresh_ms > 0:
+            log.info("fleet mode: PIO_MODEL_REFRESH_MS ignored — the "
+                     "fleet coordinator owns refresh (staged canary)")
+            self.model_refresh_ms = 0.0
+
+    def _fleet_group(self) -> str:
+        from . import model_artifact
+
+        return model_artifact.fleet_group(self.engine_factory_name,
+                                          self.engine_variant)
 
     @staticmethod
     def _new_compile_families():
@@ -499,6 +560,18 @@ class EngineServer:
             # rollback + swap-validation counters, refresh config
             "lifecycle": self.lifecycle_snapshot(),
         }
+        if self.fleet_mode:
+            # store-fed fleet aggregation, cached by the sync loop (no
+            # storage I/O on the status path): directive state, every
+            # peer's status row, and a divergence flag — `pio status
+            # --engine-url` against the front lands on ANY replica and
+            # still sees the whole fleet
+            out["fleet"] = self._fleet_view or {
+                "group": self._fleet_group(),
+                "replica": self.fleet_replica,
+                "replicas": self.fleet_replicas,
+                "directive": None, "peers": [], "divergence": False,
+            }
         # measured serving-latency decomposition, when a probe ran
         # (pio deploy --probe-latency persists it to the instance row)
         probe = (instance.runtime_conf.get("probe_latency")
@@ -583,6 +656,16 @@ class EngineServer:
             fam = telemetry.GaugeFamily(name, help_)
             fam.labels().set(value)
             fams.append(fam)
+        if self.fleet_mode:
+            view = self._fleet_view
+            div = telemetry.GaugeFamily(
+                "pio_fleet_divergence",
+                "1 while this replica's cached peer view shows the "
+                "fleet serving more than one engine instance (mixed "
+                "brain; converges within PIO_FLEET_SYNC_MS)")
+            div.labels().set(
+                1 if (view and view.get("divergence")) else 0)
+            fams.append(div)
         return fams
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
@@ -1273,7 +1356,10 @@ class EngineServer:
             restored = self.instance
         self._watch = None
         with self._lock:
-            self._pinned[bad_inst.id] = reason
+            # setdefault: a fleet-directed rollback arrives AFTER the
+            # coordinator already recorded the real pin reason (e.g.
+            # error-rate from the canary) — "fleet" must not clobber it
+            self._pinned.setdefault(bad_inst.id, reason)
             self._rollbacks[reason] = self._rollbacks.get(reason, 0) + 1
         self._degraded_reason = (
             f"rolled back from {bad_inst.id} to {restored.id} ({reason}) "
@@ -1350,12 +1436,23 @@ class EngineServer:
                 status=409)
         async with self._reload_lock:
             restored = self._rollback_to_previous("manual")
+            if restored is None and self.fleet_mode:
+                restored = await self._fleet_rollback_via_store()
         if restored is None:
             return web.json_response(
                 {"message": "no previous deployment resident to roll "
                             "back to"}, status=409)
+        if self.fleet_mode:
+            # propagate NOW instead of waiting for the next tick: the
+            # pin lands in this replica's status row, the coordinator
+            # picks it up on its next poll, and the whole fleet
+            # converges on last-good within the sync bound
+            t = asyncio.get_running_loop().create_task(self._fleet_sync())
+            t.add_done_callback(
+                lambda f: None if f.cancelled() else f.exception())
         return web.json_response(
-            {"message": "Rolled back", "engineInstanceId": restored})
+            {"message": "Rolled back", "engineInstanceId": restored,
+             **({"fleet": True} if self.fleet_mode else {})})
 
     # -- continuous refresh ------------------------------------------------
     async def _start_refresher(self, app) -> None:
@@ -1438,22 +1535,314 @@ class EngineServer:
 
     def _newer_candidate(self):
         """Worker-thread poll: the newest non-pinned COMPLETED instance
-        strictly newer than the live one, or None when up to date."""
-        instances = self.storage.get_meta_data_engine_instances()
+        strictly newer than the live one, or None when up to date (the
+        shared definition in model_artifact — the fleet coordinator's
+        rollout staging must agree with this poll about "newer")."""
+        from . import model_artifact
+
         with self._lock:
             cur = self.instance
-        done = instances.get_completed(
-            self.engine_factory_name or "engine", "1", self.engine_variant)
-        with self._lock:
             pinned = set(self._pinned)
-        for c in done:
-            if c.id in pinned:
-                continue
-            if cur is not None and (c.id == cur.id
-                                    or c.start_time <= cur.start_time):
-                return None
-            return c
-        return None
+        return model_artifact.newer_completed_instance(
+            self.storage.get_meta_data_engine_instances(),
+            self.engine_factory_name, self.engine_variant, cur,
+            exclude=pinned)
+
+    # -- replica fleet (store-mediated staged rollout) ---------------------
+    def _fleet_bootstrap_load(self) -> None:
+        """Initial load of a fleet replica: honor the fleet record
+        BEFORE touching the instance walk — a replica relaunched after
+        a fleet rollback must come up on the directed last-good
+        instance with the fleet's pins applied, not on the newest
+        COMPLETED row (which may be exactly the poisoned artifact the
+        fleet just rolled back)."""
+        from . import model_artifact
+
+        row_id = model_artifact.fleet_row_id(self._fleet_group())
+        directive = model_artifact.read_fleet_doc(self.storage, row_id)
+        if directive is None:
+            # the coordinator re-commits the directive every sync tick,
+            # and on backends whose Models.insert is DELETE-then-INSERT
+            # (pg/mysql) a read can land in the gap and see the row
+            # absent — one short retry separates "no directive yet"
+            # from that window, because booting onto the newest
+            # COMPLETED row here may be exactly the poisoned artifact
+            # the fleet just rolled back
+            _time.sleep(0.05)
+            directive = model_artifact.read_fleet_doc(
+                self.storage, row_id)
+        directive = directive or {}
+        with self._lock:
+            for iid, reason in (directive.get("pinned") or {}).items():
+                self._pinned.setdefault(iid, reason)
+            pinned = set(self._pinned)
+        want = directive.get("instance")
+        if want and want not in pinned:
+            try:
+                self._load(want)
+                return
+            except Exception:  # noqa: BLE001 - degrade to the walk
+                log.warning(
+                    "fleet directive instance %s not deployable at "
+                    "startup; walking back to latest", want,
+                    exc_info=True)
+        self._load(None)
+
+    async def _start_fleet(self, app) -> None:
+        if self.fleet_mode:
+            self._fleet_task = asyncio.get_running_loop().create_task(
+                self._fleet_loop())
+
+    async def _stop_fleet(self, app) -> None:
+        task, self._fleet_task = self._fleet_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    async def _fleet_loop(self) -> None:
+        """Fleet sync (PIO_FLEET_SYNC_MS): apply coordinator directives
+        and publish this replica's status row. Never dies — a storage
+        flake is logged and retried next tick."""
+        log.info("fleet sync loop armed (replica %d, every %.0f ms)",
+                 self.fleet_replica, self.fleet_sync_ms)
+        while True:
+            try:
+                await self._fleet_sync()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - poll errors never kill it
+                log.exception("fleet sync failed; retrying next tick")
+            await asyncio.sleep(self.fleet_sync_ms / 1000.0)
+
+    async def _fleet_sync(self) -> None:
+        from . import model_artifact
+
+        directive = await asyncio.to_thread(
+            model_artifact.read_fleet_doc, self.storage,
+            model_artifact.fleet_row_id(self._fleet_group())) or {}
+        with self._lock:
+            # fleet pins propagate to every replica: a restarting
+            # refresh/reload on this replica must never re-pick an
+            # instance any peer rolled back (mixed-brain prevention)
+            for iid, reason in (directive.get("pinned") or {}).items():
+                self._pinned.setdefault(iid, reason)
+            pinned = set(self._pinned)
+            cur = self.instance
+        want = directive.get("instance")
+        if (directive.get("state") == "canary"
+                and directive.get("canaryReplica") == self.fleet_replica
+                and directive.get("target")):
+            # staged rollout: ONLY the canary replica swaps to the
+            # target; everyone else holds the directed instance until
+            # the coordinator promotes a clean watch window
+            want = directive.get("target")
+        if (want and want not in pinned
+                and (cur is None or want != cur.id)
+                and not self._reload_lock.locked()):
+            async with self._reload_lock:
+                # recheck under the lock: a concurrent sync (manual-
+                # rollback fast path) may have applied this directive
+                # while we queued — re-applying would pay a full
+                # storage reload for nothing
+                with self._lock:
+                    cur = self.instance
+                if cur is None or want != cur.id:
+                    await self._fleet_apply(want)
+        await asyncio.to_thread(self._fleet_publish, directive)
+
+    async def _fleet_apply(self, want: str) -> None:
+        """Apply one directive target through this replica's own gate.
+        A directed rollback whose target is the still-resident previous
+        deployment swaps back instantly (no storage round trip); other
+        targets take the full verified + validated load. Failures pin
+        (validate/integrity) or degrade (transient) — the coordinator
+        sees the pin in the next status row and propagates."""
+        from . import model_artifact
+
+        with self._lock:
+            prev = self._previous
+        if prev is not None and prev[1].id == want:
+            self._rollback_to_previous("fleet")
+            return
+        try:
+            await asyncio.to_thread(self._load, want)
+        except SwapValidationError as e:
+            with self._lock:
+                self._validate_failures += 1
+                self._pinned.setdefault(e.instance_id, "validate")
+            self._degraded_reason = (
+                f"fleet: {e}; serving last-good model "
+                f"({e.instance_id} pinned)")
+            log.warning("fleet swap refused by gate: %s", e)
+        except model_artifact.ModelIntegrityError as e:
+            with self._lock:
+                self._pinned.setdefault(e.instance_id,
+                                        f"integrity:{e.kind}")
+            self._degraded_reason = (
+                f"fleet: directed instance {e.instance_id} failed "
+                f"integrity ({e.kind}); serving last-good model")
+            log.warning("fleet swap refused by integrity: %s", e)
+        except Exception as e:  # noqa: BLE001 - transient: retry next tick
+            self._degraded_reason = (
+                f"fleet reload failed at "
+                f"{_dt.datetime.now(_dt.timezone.utc).isoformat()}: {e}; "
+                "serving last-good model")
+            log.exception("fleet swap failed; continuing on last-good")
+        else:
+            self._degraded_reason = None
+
+    def _fleet_publish(self, directive: dict) -> None:
+        """Worker-thread half of the sync: write this replica's status
+        row (single writer: us) and refresh the cached peer view that
+        /status and the divergence gauge read."""
+        from . import model_artifact
+
+        with self._lock:
+            cur, prev = self.instance, self._previous
+            pinned = {i: r for i, r in self._pinned.items()
+                      if i not in self._pins_provisional}
+            rollbacks = dict(self._rollbacks)
+        with self._adm_lock:
+            draining = self._draining
+        w = self._watch
+        watch_done = (w is None or cur is None
+                      or w.get("instance") != cur.id
+                      or _time.monotonic() > w["until"])
+        group = self._fleet_group()
+        status = {
+            "replica": self.fleet_replica,
+            "pid": os.getpid(),
+            "instance": cur.id if cur else None,
+            "previous": prev[1].id if prev else None,
+            "pinned": pinned,
+            "rollbacks": rollbacks,
+            "draining": draining,
+            "watchDone": watch_done,
+            "epochSeen": directive.get("epoch", 0),
+            "updatedAt": _time.time(),
+        }
+        model_artifact.write_fleet_doc(
+            self.storage, model_artifact.fleet_row_id(
+                group, self.fleet_replica), status)
+        peers = directive.get("peers")
+        if peers is None:
+            # no coordinator peer snapshot yet (coordinator not started,
+            # or a pre-snapshot directive): fall back to reading each
+            # peer row directly
+            peers = []
+            for i in range(max(self.fleet_replicas,
+                               self.fleet_replica + 1)):
+                doc = model_artifact.read_fleet_doc(
+                    self.storage, model_artifact.fleet_row_id(group, i))
+                if doc is not None:
+                    peers.append(doc)
+        else:
+            # the coordinator aggregates every status row each tick and
+            # ships the snapshot inside the directive — consuming it
+            # costs each replica ONE store read per tick instead of N
+            # (O(N) fleet-wide, not O(N^2)); substitute our own
+            # just-written row so this replica's /status never lags
+            # itself by a coordinator tick
+            peers = [p for p in peers
+                     if p.get("replica") != self.fleet_replica]
+            peers.append(status)
+            peers.sort(key=lambda p: p.get("replica") or 0)
+        serving = {p.get("instance") for p in peers if p.get("instance")}
+        self._fleet_view = {
+            "group": group,
+            "replica": self.fleet_replica,
+            "replicas": self.fleet_replicas,
+            "syncMs": self.fleet_sync_ms,
+            "directive": {k: directive.get(k) for k in
+                          ("state", "instance", "target",
+                           "canaryReplica", "lastGood", "epoch",
+                           "pinned")},
+            "peers": peers,
+            "divergence": len(serving) > 1,
+        }
+
+    async def _fleet_rollback_via_store(self) -> Optional[str]:
+        """Fleet rollback on a replica with NO resident previous
+        deployment (it was relaunched and booted straight onto the
+        current instance): the front's round-robin must not make
+        `pio models rollback --engine-url <front>` nondeterministic, so
+        pin the current instance and walk back through the store
+        instead. Caller holds the reload lock. Returns the restored
+        instance id, or None (pin reverted) when nothing older is
+        deployable."""
+        with self._lock:
+            cur = self.instance
+        if cur is None:
+            return None
+        with self._lock:
+            # provisional until the walk-back lands: a concurrent
+            # _fleet_publish tick during the (slow) storage walk must
+            # not ship this pin to the coordinator — pins merge into
+            # the directive irreversibly, and if no older instance is
+            # deployable we pop the pin and keep serving cur. Only a
+            # pin WE insert is provisional/poppable: a pre-existing pin
+            # (e.g. merged from the directive while this replica still
+            # serves it) is real and must neither vanish from published
+            # status rows during the walk nor be deleted on failure
+            inserted = cur.id not in self._pinned
+            if inserted:
+                self._pinned[cur.id] = "manual"
+                self._pins_provisional.add(cur.id)
+        try:
+            await asyncio.to_thread(self._load, None)
+        except Exception:  # noqa: BLE001 - nothing older deployable
+            if inserted:
+                with self._lock:
+                    self._pinned.pop(cur.id, None)
+                    self._pins_provisional.discard(cur.id)
+            log.exception("fleet rollback: no older deployable "
+                          "instance; keeping %s live", cur.id)
+            return None
+        # the reload retained the PINNED instance as "previous" and
+        # opened a watch on the restored one — both wrong for a
+        # rollback (the hedge/swap-back target must never be the model
+        # we just pinned); drop them
+        with self._lock:
+            self._pins_provisional.discard(cur.id)
+            self._previous = None
+            self._rollbacks["manual"] = \
+                self._rollbacks.get("manual", 0) + 1
+            restored = self.instance
+        self._watch = None
+        log.warning("fleet rollback via store: %s pinned, restored %s",
+                    cur.id, restored.id)
+        return restored.id
+
+    async def _start_heartbeat(self, app) -> None:
+        if envknobs.env_str("PIO_WORKER_HEARTBEAT_FILE", "",
+                            lower=False):
+            self._hb_task = asyncio.get_running_loop().create_task(
+                self._heartbeat_loop())
+
+    async def _stop_heartbeat(self, app) -> None:
+        task, self._hb_task = self._hb_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    async def _heartbeat_loop(self) -> None:
+        """Supervised-replica liveness (the event-server pattern):
+        touch the heartbeat file so a wedged event loop — not just a
+        dead process — is detected and this replica relaunched. The
+        touch is disk I/O, shipped off-loop."""
+        from ..parallel import supervisor
+
+        interval = max(0.05, envknobs.env_ms(
+            "PIO_WORKER_HEARTBEAT_MS", 1000.0, lo_ms=20.0) / 2.0)
+        while True:
+            await asyncio.to_thread(supervisor.beat)
+            await asyncio.sleep(interval)
 
     async def handle_reload(self, request: web.Request) -> web.Response:
         """Hot-swap to the latest completed instance (reference: /reload →
@@ -1470,6 +1859,19 @@ class EngineServer:
         the deployment) — the loser gets 409 and retries once the
         winner finishes."""
         target = request.query.get("instance") or None
+        if self.fleet_mode:
+            # a reload through the front would land on ONE replica and
+            # be silently reverted by the next directive sync — refuse
+            # loudly instead of pretending: rollouts are staged by the
+            # coordinator (retrain → canary → promote), rollbacks via
+            # POST /rollback (fleet-wide)
+            return web.json_response(
+                {"message": "fleet mode: model rollout is coordinator-"
+                            "driven — retrain to stage a canary, POST "
+                            "/rollback for a fleet rollback",
+                 "engineInstanceId":
+                     self.instance.id if self.instance else None},
+                status=409)
         if self._reload_lock.locked():
             self._reload_conflicts += 1
             return web.json_response(
@@ -1562,6 +1964,21 @@ class EngineServer:
         os._exit(0)
 
     async def handle_stop(self, request: web.Request) -> web.Response:
+        if self.fleet_mode:
+            # through the front this lands on ONE replica, which would
+            # drain and exit cleanly — and a clean exit is NOT
+            # relaunched by the supervisor, so `pio undeploy` against a
+            # fleet would silently shrink it by one replica while
+            # reporting success. Refuse loudly: the fleet stops as a
+            # unit (SIGTERM to the `pio deploy --replicas` front
+            # process drains every replica)
+            return web.json_response(
+                {"message": "fleet mode: a single-replica stop would "
+                            "silently shrink the fleet — stop the "
+                            "whole fleet by terminating the `pio "
+                            "deploy --replicas` front process "
+                            "(SIGTERM)"},
+                status=409)
         log.info("stop requested")
         with self._adm_lock:
             draining = self._draining

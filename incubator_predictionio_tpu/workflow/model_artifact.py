@@ -253,3 +253,103 @@ def delete_model(storage, instance_id: str) -> None:
     """GC chokepoint (`pio models gc`). Deliberately NOT called by any
     failure path — corrupt blobs are kept for forensics."""
     storage.get_model_data_models().delete(instance_id)
+
+
+# ---------------------------------------------------------------------------
+# fleet coordination records (workflow/fleet.py + the fleet-aware
+# engine server). The replica fleet coordinates its staged rollout
+# through the SAME artifact store the models live in — no new
+# coordination service — as small JSON rows in the Models DAO under
+# reserved ids that can never collide with engine-instance ids. Every
+# row has exactly ONE writer (the front owns the directive record, each
+# replica owns its own status row — the single-writer half of the
+# event-log lease idiom), and the directive carries a monotonically
+# bumped epoch so readers can order observations and a superseded
+# coordinator can detect it has been overtaken.
+# ---------------------------------------------------------------------------
+
+#: Reserved id prefix. Engine-instance ids are event-id hex strings, so
+#: a dunder prefix cannot collide; `pio models list|verify|gc` iterate
+#: ENGINE INSTANCES and never see these rows.
+FLEET_ROW_PREFIX = "__pio_fleet__"
+
+
+def newer_completed_instance(instances, engine_factory_name: str,
+                             engine_variant: str, current,
+                             exclude=()):
+    """Newest COMPLETED instance not in ``exclude`` and strictly newer
+    than ``current`` (an instance row, an instance id, or None), else
+    None. The ONE definition of "a newer deployable candidate" — the
+    fleet coordinator's rollout staging and the engine server's refresh
+    poll must never disagree about what "newer" means (an instances-DAO
+    helper, but it lives here with the other fleet/lifecycle protocol
+    pieces both sides already import)."""
+    done = instances.get_completed(
+        engine_factory_name or "engine", "1", engine_variant)
+    cur_row = (instances.get(current) if isinstance(current, str)
+               else current)
+    for c in done:
+        if c.id in exclude:
+            continue
+        if cur_row is not None and (
+                c.id == cur_row.id
+                or c.start_time <= cur_row.start_time):
+            return None
+        return c
+    return None
+
+
+def fleet_fresh_s(sync_ms: float) -> float:
+    """Staleness horizon for a replica status row: rows older than this
+    are a dead/wedged replica's. The ONE definition — the coordinator's
+    promote/adoption votes and `pio status`'s STALE warn-marker must
+    agree on what "fresh" means (5 sync ticks, floored at 10 s)."""
+    return max(10.0, float(sync_ms) / 1000.0 * 5)
+
+
+def fleet_group(engine_factory_name: str, engine_variant: str) -> str:
+    """Canonical fleet group id — the ONE definition both sides of the
+    store protocol derive row keys from. A coordinator and its replicas
+    computing this independently (and drifting) would silently split
+    the fleet: directives written under one key, polled under another,
+    with no error anywhere (missing rows read as None)."""
+    return f"{engine_factory_name or 'engine'}::{engine_variant}"
+
+
+def fleet_row_id(group: str, replica: Optional[int] = None) -> str:
+    """Storage row id of a fleet record: the group's directive record
+    (``replica=None``, written only by the coordinator) or one
+    replica's status row (written only by that replica)."""
+    base = f"{FLEET_ROW_PREFIX}{group}"
+    return base if replica is None else f"{base}__r{int(replica)}"
+
+
+def read_fleet_doc(storage, row_id: str) -> Optional[dict]:
+    """Fetch one fleet record. Any damage (unreadable row, non-JSON
+    bytes) degrades to None — fleet coordination must converge through
+    the next write, never crash serving on a torn record."""
+    try:
+        row = storage.get_model_data_models().get(row_id)
+        if row is None:
+            return None
+        doc = json.loads(bytes(row.models).decode("utf-8"))
+        return doc if isinstance(doc, dict) else None
+    except Exception:  # noqa: BLE001 — degraded read, next write heals
+        log.warning("fleet record %s unreadable; treating as absent",
+                    row_id, exc_info=True)
+        return None
+
+
+def write_fleet_doc(storage, row_id: str, doc: dict,
+                    fault: bool = False) -> None:
+    """Persist one fleet record (plain JSON bytes — these rows are
+    coordination state, not model artifacts, so they skip the envelope
+    and its integrity counters). ``fault=True`` (the coordinator's
+    DIRECTIVE writes) arms the ``fleet.record`` fault point so the
+    chaos harness can fail a directive commit and prove the state
+    machine retries; replica status writes skip it so an injected
+    coordinator fault cannot leak onto replica processes."""
+    if fault:
+        faultinject.fault_point("fleet.record")
+    storage.get_model_data_models().insert(
+        Model(row_id, json.dumps(doc, sort_keys=True).encode("utf-8")))

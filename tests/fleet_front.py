@@ -1,0 +1,33 @@
+"""Fleet front subprocess for the fleet chaos harness
+(tests/test_fleet.py): the REAL `run_fleet` — supervisor, splice front,
+readiness poller, staged-rollout coordinator — over jax-free
+tests/fleet_server.py replicas.
+
+Usage: python fleet_front.py <port> <replicas>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> int:
+    import logging
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(name)s %(message)s")
+    port = int(sys.argv[1])
+    replicas = int(sys.argv[2])
+    from incubator_predictionio_tpu.workflow.fleet import run_fleet
+
+    worker_argv = [sys.executable, os.path.join(HERE, "fleet_server.py")]
+    return run_fleet(worker_argv, replicas, "127.0.0.1", port,
+                     engine_factory_name="lifecycle",
+                     engine_variant="default")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,47 @@
+"""One jax-free fleet replica for the fleet chaos harness
+(tests/test_fleet.py).
+
+Spawned by the fleet supervisor (via tests/fleet_front.py): identity,
+listen port, heartbeat file and fleet knobs all arrive through the
+environment, exactly as `pio deploy --replica-worker` receives them.
+Serves the lifecycle engine (tests/lifecycle_engine.py) against the
+storage configured in the inherited environment. The ``fleet.spawn``
+fault point fires before the engine loads — first-launch chaos
+(PIO_FLEET_WORKER_FAULT_SPEC=fleet.spawn:crash:1) SIGKILLs the replica
+in the spawn window the supervisor must recover from.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    import logging
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(name)s %(message)s")
+    logging.getLogger("aiohttp.access").setLevel(logging.WARNING)
+    from incubator_predictionio_tpu.workflow.fleet import (
+        replica_worker_entry)
+
+    port = replica_worker_entry()
+    if port <= 0:
+        return 1
+    import lifecycle_engine
+
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.workflow.create_server import (
+        EngineServer, run_engine_server)
+
+    server = EngineServer(lifecycle_engine.engine_factory(),
+                          engine_factory_name="lifecycle",
+                          storage=Storage.instance())
+    run_engine_server(server, "127.0.0.1", port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,911 @@
+"""Engine replica fleet chaos harness (ISSUE 12).
+
+N real engine-server replicas behind the splice front must act as ONE
+deployment:
+
+- a staged rollout swaps exactly ONE canary replica first, promotes the
+  rest only after a clean watch window, and a poisoned (gate-passing,
+  traffic-failing) retrain rolls back + pins FLEET-WIDE with every
+  client query answered 200 via the watch hedge
+- `pio models rollback --engine-url <front>` performs a FLEET rollback:
+  the mixed-brain window closes within a small multiple of
+  PIO_FLEET_SYNC_MS
+- a replica SIGKILLed mid-flood is relaunched by the supervisor while
+  the front keeps answering (zero non-{200,503,504} responses)
+- spawn-window chaos (`fleet.spawn` crash on first launch) is recovered
+  by per-replica restart; coordinator promote/record commits survive
+  injected faults (`fleet.promote`, `fleet.record`) by retrying
+- the hardened front skips not-ready backends for new connections,
+  retries a connect-refused backend, and serves /healthz itself
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+import lifecycle_engine
+from incubator_predictionio_tpu.common import faultinject
+from incubator_predictionio_tpu.common.faultinject import InjectedFault
+from incubator_predictionio_tpu.workflow import model_artifact
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import run_train
+from incubator_predictionio_tpu.workflow.fleet import FleetCoordinator
+
+from server_utils import free_port
+
+pytestmark = [pytest.mark.fleet, pytest.mark.chaos]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GROUP = "lifecycle::default"
+
+
+@pytest.fixture()
+def chaos(monkeypatch):
+    def arm(spec):
+        monkeypatch.setenv("PIO_FAULT_SPEC", spec)
+        faultinject.reset()
+    yield arm
+    monkeypatch.delenv("PIO_FAULT_SPEC", raising=False)
+    faultinject.reset()
+
+
+def _train(storage, tag, mode="good"):
+    ctx = WorkflowContext(app_name="fleetapp", storage=storage)
+    iid = run_train(lifecycle_engine.engine_factory(),
+                    lifecycle_engine.engine_params(tag, mode), ctx,
+                    engine_factory_name="lifecycle")
+    time.sleep(0.002)  # strictly ordered start_times
+    return iid
+
+
+def _sqlite_env(tmp_path, **extra):
+    env = {
+        **os.environ,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "meta.sqlite"),
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+        "PIO_COMPILATION_CACHE": "0",   # keep subprocesses jax-free
+        "JAX_PLATFORMS": "cpu",
+        "PIO_FLEET_SYNC_MS": "200",
+        "PIO_FLEET_READY_MS": "150",
+        # this 2-core box can starve ALL replicas' accept loops for
+        # seconds at once (GIL-held model loads + client churn): give
+        # the front's connect budget real slack so the harness measures
+        # the fleet contract, not host scheduling
+        "PIO_FLEET_CONNECT_RETRY_MS": "8000",
+    }
+    for k in ("PIO_FAULT_SPEC", "PIO_FLEET_WORKER_FAULT_SPEC"):
+        env.pop(k, None)
+    env.update(extra)
+    return env
+
+
+def _storage_for(env):
+    from incubator_predictionio_tpu.data.storage import Storage
+
+    return Storage({k: v for k, v in env.items()
+                    if k.startswith("PIO_STORAGE")})
+
+
+class _Fleet:
+    """A REAL fleet subprocess (tests/fleet_front.py): front +
+    supervisor + coordinator over jax-free replica servers."""
+
+    def __init__(self, env, replicas):
+        import tempfile
+
+        self.replicas = replicas
+        self.port = free_port()
+        self.base = f"http://127.0.0.1:{self.port}"
+        # front output goes to a FILE, not a pipe: a flood fills a pipe
+        # and stalls the front's loop (the PR 6 access-log lesson), and
+        # a file survives the process for post-mortem on failure
+        self._log = tempfile.NamedTemporaryFile(
+            prefix=f"pio_fleet_front_{self.port}_", suffix=".log",
+            delete=False)
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "fleet_front.py"),
+             str(self.port), str(replicas)],
+            env=env, stdout=self._log, stderr=subprocess.STDOUT)
+
+    def healthz(self, timeout=5):
+        r = self._get("/healthz", timeout)
+        assert r.status_code == 200
+        return r.json()
+
+    def status(self, timeout=5):
+        return self._get("/status", timeout).json()
+
+    def _get(self, path, timeout):
+        """Control-plane poll, NOT the client SLA under test: on a
+        starved 2-core host a poll can lose a TCP race (e.g. land on a
+        replica the kernel is mid-teardown on) — one bounded retry
+        keeps the harness measuring the contract instead of the
+        host."""
+        last = None
+        for _ in range(4):
+            try:
+                return requests.get(self.base + path, timeout=timeout)
+            except requests.RequestException as e:
+                last = e
+                time.sleep(0.5)
+        raise last
+
+    def wait_ready(self, deadline_s=120):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise AssertionError("fleet front died: " + self.tail())
+            try:
+                doc = self.healthz(timeout=2)
+                if (doc.get("readyReplicas") == self.replicas
+                        and all(b["alive"] for b in doc["backends"])):
+                    return doc
+            except requests.RequestException:
+                pass
+            time.sleep(0.2)
+        raise AssertionError("fleet not ready in time")
+
+    def _reap(self):
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        try:
+            self._log.close()
+        except Exception:  # noqa: BLE001 - already closed
+            pass
+
+    def stop(self, expect_rc=0):
+        if self.proc.poll() is None:
+            self.proc.send_signal(__import__("signal").SIGTERM)
+            try:
+                rc = self.proc.wait(timeout=60)
+                if expect_rc is not None:
+                    assert rc == expect_rc, self.tail()
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                raise
+        self._reap()
+
+    def tail(self):
+        try:
+            with open(self._log.name, "rb") as f:
+                return f.read().decode(errors="replace")[-4000:]
+        except Exception:  # noqa: BLE001
+            return "<no output>"
+
+    def kill(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self._reap()
+
+
+class _Clients:
+    """Background query fire against the front: fresh connection per
+    request (round-robins across replicas), every status code and 200
+    tag recorded."""
+
+    def __init__(self, base, threads=2, pause=0.025):
+        self.base = base
+        self.codes: list[int] = []
+        self.conn_errors = 0
+        self.tags: set = set()
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._fire, args=(i,))
+                         for i in range(threads)]
+        self._pause = pause
+
+    def _fire(self, idx):
+        n = 0
+        while not self._stop.is_set():
+            n += 1
+            try:
+                r = requests.post(self.base + "/queries.json",
+                                  json={"user": f"u{idx}-{n}"},
+                                  timeout=15)
+                self.codes.append(r.status_code)
+                if r.status_code == 200:
+                    self.tags.add(r.json().get("tag"))
+            except requests.RequestException:
+                if not self._stop.is_set():
+                    self.conn_errors += 1
+            time.sleep(self._pause)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(30)
+
+
+def _post_retrying(url, json=None, timeout=10, window_s=1.0):
+    """Bounded retry for the kill-window TCP race: connections the
+    dying listener accepted are RST until the kernel finishes tearing
+    the process down — on a starved host that window spans several
+    connect attempts, not one. The last failure propagates."""
+    deadline = time.monotonic() + window_s
+    while True:
+        try:
+            return requests.post(url, json=json, timeout=timeout)
+        except requests.RequestException:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
+def _poll(fn, deadline_s, every=0.1, msg="condition"):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(every)
+    raise AssertionError(f"timed out waiting for {msg}; last={last!r}")
+
+
+# ---------------------------------------------------------------------------
+# the headline: staged canary, fleet promote, CLI fleet rollback,
+# poisoned retrain pinned fleet-wide — every client query 200 throughout
+# ---------------------------------------------------------------------------
+
+def test_staged_canary_promote_cli_rollback_and_poison(tmp_path):
+    env = _sqlite_env(tmp_path,
+                      # wide enough that the canary sees several client
+                      # queries inside the window even through this
+                      # box's scheduling droughts — a quiet window
+                      # closes CLEAN by design (PR 9), which for the
+                      # poisoned phase would promote the poison
+                      PIO_SWAP_WATCH_MS="2500",
+                      PIO_SWAP_MAX_ERROR_RATE="0.3")
+    storage = _storage_for(env)
+    iid_a = _train(storage, "one")
+    fleet = _Fleet(env, replicas=3)
+    try:
+        fleet.wait_ready()
+
+        def fleet_view():
+            doc = fleet.status()
+            return doc.get("fleet") or {}
+
+        # bootstrap: the coordinator adopts the converged instance
+        _poll(lambda: ((fleet_view().get("directive") or {})
+                       .get("instance") == iid_a),
+              30, msg="bootstrap adoption")
+
+        with _Clients(fleet.base) as clients:
+            time.sleep(0.5)                 # steady-state 200s first
+            # -- staged rollout of a GOOD retrain -----------------------
+            iid_b = _train(storage, "two")
+            saw_canary = _poll(
+                lambda: (lambda v: v if (v.get("directive") or {})
+                         .get("state") == "canary" else None)(
+                             fleet_view()),
+                20, msg="canary staged")
+            d = saw_canary["directive"]
+            assert d["target"] == iid_b
+            on_b = [p for p in saw_canary["peers"]
+                    if p.get("instance") == iid_b]
+            # exactly ONE replica swaps first (the canary); the rest
+            # hold the old instance until the window closes clean
+            assert len(on_b) <= 1, saw_canary
+            held = [p for p in saw_canary["peers"]
+                    if p.get("instance") == iid_a]
+            assert len(held) >= len(saw_canary["peers"]) - 1
+
+            def promoted():
+                v = fleet_view()
+                dd = v.get("directive") or {}
+                peers = v.get("peers") or []
+                return (dd.get("state") == "steady"
+                        and dd.get("instance") == iid_b
+                        and len(peers) == 3
+                        and all(p.get("instance") == iid_b
+                                for p in peers)) and v
+            _poll(promoted, 30, msg="fleet promoted to the retrain")
+
+            # -- FLEET rollback through the front (satellite 3) ---------
+            from incubator_predictionio_tpu.tools.console import main as pio
+
+            t0 = time.monotonic()
+            assert pio(["models", "rollback", "--engine-url",
+                        fleet.base]) == 0
+
+            def converged_back():
+                v = fleet_view()
+                dd = v.get("directive") or {}
+                peers = v.get("peers") or []
+                return (dd.get("instance") == iid_a
+                        and dd.get("pinned", {}).get(iid_b) == "manual"
+                        and len(peers) == 3
+                        and all(p.get("instance") == iid_a
+                                for p in peers)
+                        and not v.get("divergence")) and v
+            _poll(converged_back, 15,
+                  msg="fleet rollback converged on last-good")
+            # mixed-brain window: bounded by a few PIO_FLEET_SYNC_MS
+            # polls (250 ms here), not by operator intervention
+            assert time.monotonic() - t0 < 10.0
+
+            # -- poisoned retrain: gate-passing, traffic-failing --------
+            iid_c = _train(storage, "poisoned", mode="poison")
+
+            def poisoned_pinned():
+                v = fleet_view()
+                dd = v.get("directive") or {}
+                return (dd.get("state") == "steady"
+                        and dd.get("pinned", {}).get(iid_c)
+                        == "error-rate"
+                        and dd.get("instance") == iid_a
+                        and all(p.get("instance") == iid_a
+                                for p in (v.get("peers") or []))) and v
+            _poll(poisoned_pinned, 30,
+                  msg="poisoned canary rolled back + pinned fleet-wide")
+            time.sleep(0.5)     # two more sync ticks: the pin holds
+            assert poisoned_pinned()
+
+        # EVERY client query answered 200 — through canary, promote,
+        # fleet rollback and the poisoned swap (hedged on the canary)
+        assert clients.codes and set(clients.codes) == {200}, \
+            sorted(set(clients.codes))
+        assert clients.conn_errors == 0
+        assert clients.tags <= {"one", "two"}, clients.tags
+
+        # `pio status --engine-url` shows the converged fleet
+        import io
+        from contextlib import redirect_stdout
+
+        from incubator_predictionio_tpu.tools.commands.management import (
+            _print_engine_overload)
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            _print_engine_overload(fleet.base)
+        out = buf.getvalue()
+        assert "fleet lifecycle::default" in out
+        assert "3/3 replica(s) reporting" in out
+        assert "DIVERGE" not in out
+        assert out.count(f"instance {iid_a}") >= 3
+
+        fleet.stop()
+    finally:
+        storage.close()
+        fleet.kill()
+
+
+# ---------------------------------------------------------------------------
+# replica SIGKILL under flood: supervisor relaunch, front keeps serving
+# ---------------------------------------------------------------------------
+
+def test_replica_sigkill_mid_flood(tmp_path):
+    env = _sqlite_env(tmp_path)
+    storage = _storage_for(env)
+    _train(storage, "one")
+    fleet = _Fleet(env, replicas=2)
+    try:
+        doc = fleet.wait_ready()
+        victim = doc["backends"][0]["pid"]
+        assert victim
+        # ~40 conn/s offered: a real flood for this 1-2 core sandbox
+        # (4 python processes share it) without drowning the host —
+        # at 300/s the harness ITSELF manufactures multi-second accept
+        # droughts and measures scheduling, not the fleet
+        with _Clients(fleet.base, threads=2, pause=0.05) as clients:
+            time.sleep(0.5)
+            os.kill(victim, __import__("signal").SIGKILL)
+            # the front must keep answering THROUGHOUT: new connections
+            # skip the dead backend (connect-refused retry + readiness).
+            # One TCP reality is tolerated: connections the dying
+            # listener accepted in the kill window are RST — and on a
+            # starved host the kernel teardown window spans several
+            # connects, so the retry is a short bounded loop, not a
+            # single shot; it must land on the survivor and get 200.
+            t_kill = time.monotonic()
+            probe_drops = 0
+            while time.monotonic() - t_kill < 1.0:
+                try:
+                    r = requests.post(fleet.base + "/queries.json",
+                                      json={"user": "probe"}, timeout=10)
+                except requests.RequestException:
+                    probe_drops += 1
+                    r = _post_retrying(fleet.base + "/queries.json",
+                                      json={"user": "probe"}, timeout=10)
+                assert r.status_code == 200
+            assert probe_drops <= 10, probe_drops
+            # supervisor relaunches the replica within its budget
+            def relaunched():
+                h = fleet.healthz()
+                return (all(b["alive"] for b in h["backends"])
+                        and any(b["restarts"] >= 1
+                                for b in h["backends"])
+                        and h["readyReplicas"] == 2) and h
+            _poll(relaunched, 60, msg="replica relaunched")
+            time.sleep(0.5)
+        # zero non-{200,503,504} HTTP responses across the whole flood;
+        # the only tolerated casualties are connection-level drops of
+        # requests in flight ON the killed replica at the kill instant
+        assert set(clients.codes) <= {200, 503, 504}, \
+            sorted(set(clients.codes))
+        assert clients.codes.count(200) > 50
+        # in-flight casualties are confined to the kill window; the
+        # bound scales with how long a starved kernel keeps RSTing
+        assert clients.conn_errors <= 12, clients.conn_errors
+        fleet.stop()
+    finally:
+        storage.close()
+        fleet.kill()
+
+
+# ---------------------------------------------------------------------------
+# spawn-window chaos: fleet.spawn crash on first launch, per-replica
+# relaunch recovers (arms the fleet.spawn fault point)
+# ---------------------------------------------------------------------------
+
+def test_sigkilled_front_does_not_orphan_replicas(tmp_path):
+    """A front that dies WITHOUT draining (SIGKILL — supervisor never
+    runs its stop path) must not orphan replicas serving forever on
+    ports nothing routes to: PR_SET_PDEATHSIG in the replica entry
+    delivers SIGTERM (the normal drain) when the supervising parent
+    goes."""
+    env = _sqlite_env(tmp_path)
+    storage = _storage_for(env)
+    _train(storage, "one")
+    fleet = _Fleet(env, replicas=2)
+    pids = []
+
+    def alive(pid):
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    try:
+        doc = fleet.wait_ready()
+        pids = [b["pid"] for b in doc["backends"]]
+        assert all(pids)
+        fleet.proc.kill()               # SIGKILL: no drain possible
+        fleet._reap()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and any(
+                alive(p) for p in pids):
+            time.sleep(0.2)
+        assert not any(alive(p) for p in pids), (
+            f"replicas {[p for p in pids if alive(p)]} orphaned by a "
+            "SIGKILLed front")
+    finally:
+        storage.close()
+        fleet.kill()
+        for p in pids:                  # never leak into later tests
+            if alive(p):
+                os.kill(p, __import__("signal").SIGKILL)
+
+
+def test_fleet_spawn_crash_recovered_by_supervisor(tmp_path):
+    env = _sqlite_env(
+        tmp_path,
+        PIO_FLEET_WORKER_FAULT_SPEC="fleet.spawn:crash:1")
+    storage = _storage_for(env)
+    _train(storage, "one")
+    fleet = _Fleet(env, replicas=2)
+    try:
+        # every first-launch replica SIGKILLs itself at the fleet.spawn
+        # fault point; the supervisor relaunches each one CLEAN (chaos
+        # is first-launch-only) and the fleet still comes up serving
+        doc = fleet.wait_ready(deadline_s=120)
+        assert all(b["restarts"] >= 1 for b in doc["backends"]), doc
+        r = requests.post(fleet.base + "/queries.json",
+                          json={"user": "u"}, timeout=10)
+        assert r.status_code == 200 and r.json()["tag"] == "one"
+        fleet.stop()
+    finally:
+        storage.close()
+        fleet.kill()
+
+
+# ---------------------------------------------------------------------------
+# coordinator unit: promote/record fault points retry, epoch fencing
+# ---------------------------------------------------------------------------
+
+def _write_row(storage, replica, **kw):
+    doc = {"replica": replica, "pid": 1, "instance": None,
+           "previous": None, "pinned": {}, "rollbacks": {},
+           "draining": False, "watchDone": True, "epochSeen": 0,
+           "updatedAt": time.time()}
+    doc.update(kw)
+    model_artifact.write_fleet_doc(
+        storage, model_artifact.fleet_row_id(GROUP, replica), doc)
+
+
+def test_coordinator_state_machine_with_fault_points(
+        memory_storage, chaos):
+    iid_a = _train(memory_storage, "one")
+    coord = FleetCoordinator(memory_storage, 2, "lifecycle",
+                             sync_ms=250.0)
+    # bootstrap adoption: converged replicas -> directive instance
+    _write_row(memory_storage, 0, instance=iid_a)
+    _write_row(memory_storage, 1, instance=iid_a)
+    rec = coord.step()
+    assert rec["state"] == "steady" and rec["instance"] == iid_a
+
+    # a newer COMPLETED instance stages a canary on the lowest replica
+    iid_b = _train(memory_storage, "two")
+    rec = coord.step()
+    assert rec["state"] == "canary"
+    assert rec["target"] == iid_b and rec["canaryReplica"] == 0
+
+    # canary swapped but still inside its watch window: no promote
+    _write_row(memory_storage, 0, instance=iid_b, previous=iid_a,
+               watchDone=False)
+    rec = coord.step()
+    assert rec["state"] == "canary" and rec["instance"] == iid_a
+
+    # watch clean -> promote, but the FIRST promote attempt is
+    # fault-injected: the step raises, the state machine must not
+    # advance, and the NEXT tick promotes (arms fleet.promote)
+    _write_row(memory_storage, 0, instance=iid_b, previous=iid_a,
+               watchDone=True)
+    chaos("fleet.promote:fail:1")
+    with pytest.raises(InjectedFault):
+        coord.step()
+    assert coord.rec["state"] == "canary"      # nothing advanced
+    rec = coord.step()
+    assert rec["state"] == "steady" and rec["instance"] == iid_b
+    assert rec["lastGood"] == iid_a
+    on_disk = model_artifact.read_fleet_doc(
+        memory_storage, model_artifact.fleet_row_id(GROUP))
+    assert on_disk["instance"] == iid_b
+
+    # replica 1 pins the promoted instance (manual rollback): the
+    # fleet rolls back to last-good — and the FIRST directive write is
+    # fault-injected, so the record stays dirty and the next tick
+    # commits it (arms fleet.record)
+    _write_row(memory_storage, 1, instance=iid_a, previous=None,
+               pinned={iid_b: "manual"})
+    chaos("fleet.record:fail:1")
+    with pytest.raises(InjectedFault):
+        coord.step()
+    rec = coord.step()          # retry commits the same transition
+    assert rec["instance"] == iid_a
+    assert rec["pinned"] == {iid_b: "manual"}
+    on_disk = model_artifact.read_fleet_doc(
+        memory_storage, model_artifact.fleet_row_id(GROUP))
+    assert on_disk["instance"] == iid_a
+    assert on_disk["pinned"] == {iid_b: "manual"}
+    # no double-counting: exactly one fleet rollback was recorded
+    from incubator_predictionio_tpu.common import telemetry
+
+    fam = telemetry.registry().counter(
+        "pio_fleet_rollbacks_total",
+        "Fleet-wide rollbacks propagated by the "
+        "coordinator, by the originating pin reason", ("reason",))
+    assert fam.labels("manual").value() == 1
+
+
+def test_fleet_mode_reload_refused_and_rollback_without_previous(
+        memory_storage):
+    """Two fleet-mode replica contracts: (a) /reload answers 409 — a
+    reload through the front would land on one replica and be reverted
+    by the next directive sync; (b) /rollback on a replica with NO
+    resident previous deployment (relaunched mid-rollout) still
+    performs the rollback by pinning the current instance and walking
+    back through the store — the front's round-robin must not make
+    `pio models rollback` nondeterministic."""
+    from incubator_predictionio_tpu.workflow.create_server import (
+        EngineServer)
+    from server_utils import ServerThread
+
+    iid_a = _train(memory_storage, "one")
+    iid_b = _train(memory_storage, "two")
+    server = EngineServer(lifecycle_engine.engine_factory(),
+                          engine_factory_name="lifecycle",
+                          storage=memory_storage,
+                          fleet_replica=0, fleet_replicas=1,
+                          fleet_sync_ms=200)
+    assert server.instance.id == iid_b      # fresh boot: no previous
+    with ServerThread(server.app) as st:
+        r = requests.get(st.base + "/reload")
+        assert r.status_code == 409
+        assert "coordinator-driven" in r.json()["message"]
+
+        # (c) /stop answers 409 too: through the front it would drain
+        # ONE replica into a clean exit the supervisor does not
+        # relaunch — `pio undeploy` must fail loudly instead of
+        # silently shrinking the fleet by one
+        r = requests.post(st.base + "/stop")
+        assert r.status_code == 409
+        assert "shrink the fleet" in r.json()["message"]
+        from incubator_predictionio_tpu.tools.commands.engine import (
+            undeploy_cmd)
+
+        port = st.base.rsplit(":", 1)[1]
+        assert undeploy_cmd(["--port", port]) == 1
+
+        r = requests.post(st.base + "/rollback")
+        assert r.status_code == 200, r.text
+        assert r.json()["engineInstanceId"] == iid_a
+        doc = requests.get(st.base + "/status").json()
+        lc = doc["lifecycle"]
+        assert lc["instance"] == iid_a
+        assert lc["pinned"] == {iid_b: "manual"}
+        assert lc["rollbacks"] == {"manual": 1}
+        # the pinned instance must not be retained as a hedge/swap-back
+        # target, and no watch window may blame the restored last-good
+        assert lc["previous"] is None and lc["watch"] is None
+        assert requests.post(st.base + "/queries.json",
+                             json={"user": "u"},
+                             timeout=15).json()["tag"] == "one"
+
+
+def test_provisional_pin_unpublished_and_peer_snapshot(memory_storage):
+    """(a) A pin that is still PROVISIONAL (store-walk rollback in
+    flight) must not appear in the published status row — the
+    coordinator merges pins irreversibly, so a rollback that then finds
+    nothing older deployable would leak a permanent fleet-wide pin on
+    the only usable instance. (b) When the directive carries the
+    coordinator's peer snapshot, the replica consumes it (one read per
+    tick) and substitutes its own just-written row."""
+    from incubator_predictionio_tpu.workflow.create_server import (
+        EngineServer)
+
+    _train(memory_storage, "one")
+    server = EngineServer(lifecycle_engine.engine_factory(),
+                          engine_factory_name="lifecycle",
+                          storage=memory_storage,
+                          fleet_replica=0, fleet_replicas=2,
+                          fleet_sync_ms=200)
+    cur = server.instance.id
+    with server._lock:
+        server._pinned["ghost"] = "manual"
+        server._pins_provisional.add("ghost")
+    server._fleet_publish({})
+    row = model_artifact.read_fleet_doc(
+        memory_storage, model_artifact.fleet_row_id(GROUP, 0))
+    assert "ghost" not in row["pinned"], row
+    with server._lock:
+        server._pins_provisional.discard("ghost")
+    server._fleet_publish({})
+    row = model_artifact.read_fleet_doc(
+        memory_storage, model_artifact.fleet_row_id(GROUP, 0))
+    assert row["pinned"] == {"ghost": "manual"}
+
+    # peer snapshot: the stale copy of OUR row is replaced by the
+    # just-written one; the peer's row rides through untouched
+    server._fleet_publish({"peers": [
+        {"replica": 0, "instance": "stale-snapshot"},
+        {"replica": 1, "instance": "peer-inst"}]})
+    view = server._fleet_view
+    assert [p["replica"] for p in view["peers"]] == [0, 1]
+    assert view["peers"][0]["instance"] == cur
+    assert view["peers"][1]["instance"] == "peer-inst"
+
+
+def test_fleet_heals_from_all_pinned_via_canary(memory_storage):
+    """A rollback that finds NO unpinned instance served anywhere
+    leaves the directive instance unset — that state must not wedge
+    the fleet forever: the next deployable candidate (e.g. a healthy
+    retrain) is staged as a canary even without a reference instance,
+    and the promote path re-establishes the directive."""
+    iid_a = _train(memory_storage, "one")
+    coord = FleetCoordinator(memory_storage, 2, "lifecycle")
+    _write_row(memory_storage, 0, instance=iid_a)
+    _write_row(memory_storage, 1, instance=iid_a)
+    rec = coord.step()
+    assert rec["instance"] == iid_a
+    # the ONLY served instance gets pinned (post-promote watch breach
+    # with no resident previous anywhere): nothing unpinned to roll
+    # back to
+    _write_row(memory_storage, 0, instance=iid_a,
+               pinned={iid_a: "error-rate"})
+    rec = coord.step()
+    assert rec["instance"] is None and rec["state"] == "steady"
+    # a later healthy retrain must still deploy — staged as a canary
+    iid_b = _train(memory_storage, "two")
+    rec = coord.step()
+    assert rec["state"] == "canary" and rec["target"] == iid_b
+    assert rec["canaryReplica"] == 0
+    _write_row(memory_storage, 0, instance=iid_b, watchDone=True)
+    rec = coord.step()
+    assert rec["state"] == "steady" and rec["instance"] == iid_b
+
+
+def test_deploy_replicas_refuses_tls(monkeypatch, capsys):
+    """The splice front and its readiness probes are plaintext L4:
+    TLS-serving replicas would never probe ready and the /healthz peek
+    cannot see inside a ClientHello — refuse at deploy time with the
+    working deployment (TLS-terminating proxy in front) named."""
+    import incubator_predictionio_tpu.common as common
+    from incubator_predictionio_tpu.tools.commands.engine import (
+        deploy_cmd)
+
+    monkeypatch.setattr(common, "ssl_context_from_env",
+                        lambda: object())
+    assert deploy_cmd(["--replicas", "2"]) == 1
+    assert "plaintext L4" in capsys.readouterr().err
+
+
+def test_coordinator_epoch_fencing(memory_storage):
+    iid_a = _train(memory_storage, "one")
+    coord = FleetCoordinator(memory_storage, 1, "lifecycle")
+    _write_row(memory_storage, 0, instance=iid_a)
+    rec = coord.step()
+    assert rec["instance"] == iid_a
+    # a rival coordinator bumps the epoch past ours: our next write
+    # must ADOPT instead of clobbering (the fenced-writer idiom)
+    rival = {**rec, "epoch": rec["epoch"] + 5, "instance": "rival-inst"}
+    model_artifact.write_fleet_doc(
+        memory_storage, model_artifact.fleet_row_id(GROUP), rival)
+    iid_b = _train(memory_storage, "two")     # would normally stage
+    rec = coord.step()
+    # the step wanted to stage a canary for iid_b, but the write path
+    # detected the overtaken epoch and adopted the rival record
+    assert rec["instance"] == "rival-inst", rec
+    assert rec["epoch"] == rival["epoch"]
+    on_disk = model_artifact.read_fleet_doc(
+        memory_storage, model_artifact.fleet_row_id(GROUP))
+    assert on_disk["instance"] == "rival-inst"
+    del iid_b
+
+
+# ---------------------------------------------------------------------------
+# hardened front units: readiness skip, connect-refused retry, /healthz
+# ---------------------------------------------------------------------------
+
+def test_front_readiness_skip_and_healthz():
+    import asyncio
+
+    from incubator_predictionio_tpu.common.splice import FrontProxy
+
+    async def run():
+        hits = {0: 0, 1: 0}
+
+        def backend(idx):
+            async def handle(reader, writer):
+                hits[idx] += 1
+                await reader.read(65536)
+                writer.write(b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                             b"Connection: close\r\n\r\nok")
+                await writer.drain()
+                writer.close()
+            return handle
+
+        servers = []
+        ports = []
+        for i in range(2):
+            srv = await asyncio.start_server(backend(i), "127.0.0.1", 0)
+            servers.append(srv)
+            ports.append(srv.sockets[0].getsockname()[1])
+        front = FrontProxy(ports,
+                           healthz_provider=lambda: {"status": "alive",
+                                                     "n": 2})
+        await front.start("127.0.0.1", 0)
+        fport = front._server.sockets[0].getsockname()[1]
+
+        async def get(path):
+            r, w = await asyncio.open_connection("127.0.0.1", fport)
+            w.write(f"GET {path} HTTP/1.1\r\nHost: f\r\n"
+                    "Connection: close\r\n\r\n".encode())
+            await w.drain()
+            data = await r.read()
+            w.close()
+            return data
+
+        # /healthz answered by the FRONT itself, not a backend
+        body = await get("/healthz")
+        assert b"200 OK" in body
+        assert json.loads(body.split(b"\r\n\r\n", 1)[1])["n"] == 2
+        assert hits == {0: 0, 1: 0}
+
+        # a request line split across TCP segments ("GET /hea" + rest)
+        # is still answered by the front, never misrouted to a
+        # backend's replica-local /healthz
+        r, w = await asyncio.open_connection("127.0.0.1", fport)
+        w.write(b"GET /hea")
+        await w.drain()
+        await asyncio.sleep(0.05)
+        w.write(b"lthz HTTP/1.1\r\nHost: f\r\nConnection: close\r\n\r\n")
+        await w.drain()
+        body = await r.read()
+        w.close()
+        assert b"200 OK" in body
+        assert json.loads(body.split(b"\r\n\r\n", 1)[1])["n"] == 2
+        assert hits == {0: 0, 1: 0}
+
+        # not-ready backend skipped for new connections
+        front.set_ready(0, False)
+        for _ in range(4):
+            assert b"ok" in await get("/queries.json")
+        assert hits[0] == 0 and hits[1] == 4
+        assert front.ready_count() == 1
+
+        # connect-refused backend: retried onto the survivor within the
+        # same accept, even though the survivor is marked not-ready
+        front.set_ready(0, True)
+        front.set_ready(1, False)
+        servers[0].close()
+        await servers[0].wait_closed()
+        assert b"ok" in await get("/queries.json")
+        assert hits[1] == 5
+
+        await front.stop()
+        servers[1].close()
+        await servers[1].wait_closed()
+
+    asyncio.run(run())
+
+
+def test_front_connect_retry_budget():
+    """With ``connect_retry_s`` > 0, a window where EVERY backend
+    refuses the connect (all mid-relaunch, or accept queues full on a
+    starved host) costs the client a short wait, not a drop — the
+    front keeps retrying passes until a backend comes back within the
+    budget. With the default budget of 0 the same window drops the
+    client immediately (the event-server front's original behavior)."""
+    import asyncio
+
+    from incubator_predictionio_tpu.common.splice import FrontProxy
+
+    async def run():
+        async def handle(reader, writer):
+            await reader.read(65536)
+            writer.write(b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n"
+                         b"Connection: close\r\n\r\nok")
+            await writer.drain()
+            writer.close()
+
+        # reserve a port, but don't serve it yet: every connect refuses
+        import socket
+
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+
+        front = FrontProxy([port], connect_retry_s=3.0)
+        await front.start("127.0.0.1", 0)
+        fport = front._server.sockets[0].getsockname()[1]
+
+        async def get():
+            r, w = await asyncio.open_connection("127.0.0.1", fport)
+            w.write(b"GET /q HTTP/1.1\r\nHost: f\r\n"
+                    b"Connection: close\r\n\r\n")
+            await w.drain()
+            data = await r.read()
+            w.close()
+            return data
+
+        async def backend_up_later():
+            await asyncio.sleep(0.4)
+            return await asyncio.start_server(handle, "127.0.0.1", port)
+
+        t = asyncio.get_running_loop().create_task(backend_up_later())
+        body = await get()          # issued while NOTHING accepts
+        srv = await t
+        assert b"ok" in body, body
+        await front.stop()
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_fleet_marker_registered():
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    toml = (root / "pyproject.toml").read_text()
+    assert "fleet:" in toml

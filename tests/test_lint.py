@@ -284,6 +284,7 @@ def test_seeded_lock_discipline_sees_lambda_bodies(tmp_path):
                 self._adm_pending = 0
                 self._lock = threading.Lock()
                 self._pinned = {}
+                self._pins_provisional = set()
                 self._previous = None
                 self._rollbacks = {}
                 self._swap_count = 0
@@ -297,10 +298,10 @@ def test_seeded_lock_discipline_sees_lambda_bodies(tmp_path):
                 self._drain_stragglers = 0
             def collectors(self):
                 with self._adm_lock:
-                    return [lambda: self._adm_pending + 1]  # line 22
+                    return [lambda: self._adm_pending + 1]  # line 23
         """}, ["lock-discipline"])
     unguarded = [f for f in fs if "accessed outside" in f.message]
-    assert [(f.line,) for f in unguarded] == [(22,)]
+    assert [(f.line,) for f in unguarded] == [(23,)]
     assert not any("stale registry entry" in f.message for f in fs)
 
 
