@@ -1,0 +1,310 @@
+"""Benchmark: online fold-in freshness lag vs. event rate (ISSUE 13).
+
+Runs the REAL streaming-online-learning path end to end: a jax-free
+counting engine is trained and deployed behind the actual EngineServer
+with the fold-in loop armed (PIO_FOLDIN_MS), a producer appends rating
+events into the JSONL event log at a target rate, and every ~1 s it
+drops a MARKER user's first-ever event and measures the wall time
+until a live `/queries.json` answer reflects it (known=true) — the
+event→served freshness lag, which is what "online learning" buys.
+
+Same-run bracket discipline (the PR 8 precedent: this 2-core sandbox's
+CPU swings severalfold within a run, so absolutes are only comparable
+inside one process): every rate runs in the same process against its
+own fresh store, `host_loop_mops` rides along as the cross-host
+denominator, and the fold-in interval is printed next to the lags
+(the lag floor is ~interval/2 + publish cost by construction).
+
+Persists to BASELINE.json `published.measured_foldin_freshness`.
+
+Env: PIO_FBENCH_RATES ("20,100" events/sec), PIO_FBENCH_DURATION (6 s
+per rate), PIO_FBENCH_FOLDIN_MS (200).
+
+Also the engine + server module for its own subprocess
+(`python bench_foldin.py --server PORT`): both sides run as __main__,
+so pickled models round-trip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def host_calibration() -> float:
+    t0 = time.perf_counter()
+    s = 0
+    for i in range(2_000_000):
+        s += i
+    return 2.0 / (time.perf_counter() - t0)
+
+
+# -- the jax-free engine (importable from the subprocess as __main__) -----
+
+@dataclasses.dataclass
+class FoldinBenchModel:
+    scores: dict
+
+    def example_query(self):
+        return {"user": "golden"}
+
+
+def _mk_engine():
+    from incubator_predictionio_tpu.controller.algorithm import Algorithm
+    from incubator_predictionio_tpu.controller.datasource import DataSource
+    from incubator_predictionio_tpu.controller.engine import Engine
+
+    class BenchDataSource(DataSource):
+        def read_training(self, ctx):
+            s = ctx.get_storage()
+            app = (s.get_meta_data_apps().get_by_name(ctx.app_name)
+                   if ctx.app_name else None)
+            return list(s.get_l_events().find(app.id)) if app else []
+
+    class BenchAlgorithm(Algorithm):
+        def train(self, ctx, events):
+            scores = {}
+            for e in events:
+                if e.event == "rate" and e.entity_id:
+                    scores[e.entity_id] = scores.get(e.entity_id, 0.0) \
+                        + float(e.properties.get_or_else("rating", 1.0))
+            return FoldinBenchModel(scores)
+
+        def predict(self, model, query):
+            u = str(query["user"])
+            if u == "golden" or u in model.scores:
+                return {"user": u, "known": True,
+                        "score": float(model.scores.get(u, 0.0))}
+            return {"user": u, "known": False}
+
+        def fold_in(self, model, events, ctx, data_source_params=None):
+            scores = dict(model.scores)
+            changed = False
+            for e in events:
+                if e.get("event") == "rate" and e.get("entityId"):
+                    props = e.get("properties") or {}
+                    scores[str(e["entityId"])] = \
+                        scores.get(str(e["entityId"]), 0.0) \
+                        + float(props.get("rating", 1.0))
+                    changed = True
+            return FoldinBenchModel(scores) if changed else None
+
+        def prepare_model_for_persistence(self, model):
+            return model
+
+        def restore_model(self, stored, ctx):
+            return stored
+
+    return Engine(BenchDataSource, None, {"": BenchAlgorithm}, None)
+
+
+def _serve(port: int) -> int:
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+    logging.getLogger("aiohttp.access").setLevel(logging.ERROR)
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.workflow.create_server import (
+        EngineServer, run_engine_server)
+
+    server = EngineServer(_mk_engine(), engine_factory_name="foldbench",
+                          storage=Storage.instance())
+    run_engine_server(server, "127.0.0.1", port)
+    return 0
+
+
+# -- the driver ------------------------------------------------------------
+
+def _storage_env(tmp: str, foldin_ms: int) -> dict:
+    return {
+        **os.environ,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "JL",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": os.path.join(tmp, "meta.sqlite"),
+        "PIO_STORAGE_SOURCES_JL_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_JL_PATH": os.path.join(tmp, "events"),
+        "PIO_COMPILATION_CACHE": "0",
+        "JAX_PLATFORMS": "cpu",
+        "PIO_FOLDIN_MS": str(foldin_ms),
+        "PIO_METRICS": os.environ.get("PIO_METRICS", "1"),
+    }
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pct(a, p):
+    a = sorted(a)
+    return a[min(len(a) - 1, round(p / 100 * (len(a) - 1)))]
+
+
+def _run_rate(rate: float, duration: float, foldin_ms: int) -> dict:
+    import requests
+
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import App
+    from incubator_predictionio_tpu.data.storage.datamap import DataMap
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.workflow.context import WorkflowContext
+    from incubator_predictionio_tpu.workflow.core_workflow import run_train
+    from incubator_predictionio_tpu.controller.engine import EngineParams
+
+    tmp = tempfile.mkdtemp(prefix=f"foldbench_{int(rate)}_")
+    env = _storage_env(tmp, foldin_ms)
+    storage = Storage({k: v for k, v in env.items()
+                       if k.startswith("PIO_STORAGE")})
+    app_id = storage.get_meta_data_apps().insert(App(id=0, name="fb"))
+    le = storage.get_l_events()
+    le.insert(Event(event="rate", entity_type="user", entity_id="seed",
+                    properties=DataMap({"rating": 1.0})), app_id)
+    ctx = WorkflowContext(app_name="fb", storage=storage)
+    run_train(_mk_engine(),
+              EngineParams(data_source_params={"appName": "fb"},
+                           algorithm_params_list=[("", {})]),
+              ctx, engine_factory_name="foldbench")
+
+    port = _free_port()
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                             "--server", str(port)],
+                            env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                requests.get(base + "/status", timeout=2)
+                break
+            except requests.RequestException:
+                time.sleep(0.1)
+        else:
+            raise RuntimeError("bench server not ready")
+
+        interval = 1.0 / rate
+        t_end = time.monotonic() + duration
+        next_t = time.monotonic()
+        next_marker = time.monotonic() + 0.5
+        sent = 0
+        marker_i = 0
+        lags_ms: list[float] = []
+        pending = None      # (user, t_inserted)
+        while time.monotonic() < t_end:
+            now = time.monotonic()
+            if now >= next_t:
+                le.insert(Event(event="rate", entity_type="user",
+                                entity_id=f"filler{sent % 500}",
+                                properties=DataMap({"rating": 1.0})),
+                          app_id)
+                sent += 1
+                next_t += interval
+            if pending is None and now >= next_marker:
+                user = f"marker{marker_i}"
+                marker_i += 1
+                le.insert(Event(event="rate", entity_type="user",
+                                entity_id=user,
+                                properties=DataMap({"rating": 9.0})),
+                          app_id)
+                sent += 1
+                pending = (user, time.monotonic())
+            if pending is not None:
+                user, t0 = pending
+                try:
+                    doc = requests.post(
+                        base + "/queries.json", json={"user": user},
+                        timeout=5).json()
+                except requests.RequestException:
+                    doc = {}
+                if doc.get("known"):
+                    lags_ms.append((time.monotonic() - t0) * 1e3)
+                    pending = None
+                    next_marker = time.monotonic() + 0.5
+                elif time.monotonic() - t0 > 30:
+                    pending = None      # stuck marker: drop, move on
+                    next_marker = time.monotonic()
+            time.sleep(0.005)
+        doc = requests.get(base + "/status", timeout=5).json()
+        fold = doc.get("foldin") or {}
+        out = {
+            "offered_eps": rate,
+            "achieved_eps": round(sent / duration, 1),
+            "samples": len(lags_ms),
+            "freshness_p50_ms": round(_pct(lags_ms, 50), 1)
+            if lags_ms else None,
+            "freshness_p90_ms": round(_pct(lags_ms, 90), 1)
+            if lags_ms else None,
+            "publishes": fold.get("publishes"),
+            "events_folded": fold.get("events"),
+        }
+        proc.send_signal(__import__("signal").SIGTERM)
+        proc.wait(timeout=30)
+        return out
+    finally:
+        storage.close()
+        if proc.poll() is None:
+            proc.kill()
+        proc.communicate()
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--server":
+        return _serve(int(sys.argv[2]))
+    rates = [float(r) for r in
+             os.environ.get("PIO_FBENCH_RATES", "20,100").split(",")]
+    duration = float(os.environ.get("PIO_FBENCH_DURATION", "6"))
+    foldin_ms = int(os.environ.get("PIO_FBENCH_FOLDIN_MS", "200"))
+    mops = host_calibration()
+    log(f"[foldbench] host {mops:.1f} Mops, fold-in every {foldin_ms} "
+        f"ms, {duration:.0f}s per rate")
+    results = {"foldin_ms": foldin_ms, "host_loop_mops": round(mops, 1),
+               "rates": {}, "note": (
+                   "freshness lag = marker event append -> first served "
+                   "query reflecting it; floor ~ foldin_ms/2 + publish "
+                   "cost (full artifact serialize+validate per "
+                   "increment). Same-run bracket; absolutes are not "
+                   "comparable across runs on this host.")}
+    for rate in rates:
+        res = _run_rate(rate, duration, foldin_ms)
+        results["rates"][str(int(rate))] = res
+        log(f"[foldbench] rate {rate:.0f} ev/s: achieved "
+            f"{res['achieved_eps']} ev/s, freshness p50 "
+            f"{res['freshness_p50_ms']} ms, p90 "
+            f"{res['freshness_p90_ms']} ms over {res['samples']} "
+            f"marker(s), {res['publishes']} publish(es)")
+        print(json.dumps({
+            "metric": f"foldin freshness p50 at {rate:.0f} ev/s",
+            "value": res["freshness_p50_ms"], "unit": "ms",
+        }), flush=True)
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE.json")
+    try:
+        with open(base_path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {})[
+            "measured_foldin_freshness"] = results
+        with open(base_path, "w") as f:
+            json.dump(doc, f, indent=2)
+        log("[foldbench] persisted BASELINE.json "
+            "published.measured_foldin_freshness")
+    except Exception as e:  # noqa: BLE001
+        log(f"[foldbench] could not persist: {e}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

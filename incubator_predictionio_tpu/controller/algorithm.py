@@ -39,6 +39,22 @@ class Algorithm(AbstractDoer, Generic[PD, M, Q, P]):
         for eval throughput (reference: batchPredict)."""
         return [self.predict(model, q) for q in queries]
 
+    def fold_in(self, model: M, events, ctx, data_source_params=None):
+        """Optional streaming-online-learning hook (workflow/online.py;
+        docs/operations.md "Online learning"): fold a batch of NEW raw
+        events — wire-format dicts tailed from the partitioned event
+        log since the last increment — into a COPY of ``model``.
+
+        Contract: never mutate ``model`` (the original keeps serving
+        until the increment passes the swap validation gate); return
+        the updated copy, or None when this algorithm does not support
+        fold-in (the default) or the batch contains nothing it can
+        apply. ``data_source_params`` is the deployed instance's
+        data-source configuration (event names, entity types, feature
+        attributes) so the event → example mapping matches what
+        training read."""
+        return None
+
     def stage_model(self, prepared_data: PD):
         """Optional workload description for cost-based device placement
         (`pio train --device=auto`; workflow/placement.py): return a

@@ -151,6 +151,36 @@ class IdentityBiMap(BiMap):
         return [self.inverse(v) for v in values]
 
 
+def extend_bimap(bm: BiMap, keys: Iterable[str]):
+    """A NEW BiMap with ``keys`` appended after the existing indices
+    (first-seen order), for the streaming fold-in path (new users/items
+    arriving after training get matrix rows past the trained ones).
+    ``bm`` is never mutated — BiMaps are immutable by contract.
+
+    Returns ``(bimap, appended)``. An :class:`IdentityBiMap` extends
+    WITHOUT materializing (only when the new keys are exactly the next
+    consecutive ``str(n)..`` ids — anything else would force a
+    multi-GB dict at ALX scale, so those keys are refused: callers
+    skip the events and log)."""
+    new = []
+    seen = set()
+    for k in keys:
+        if k not in seen and k not in bm:
+            seen.add(k)
+            new.append(k)
+    if not new:
+        return bm, []
+    if isinstance(bm, IdentityBiMap):
+        n = len(bm)
+        if set(new) == {str(n + j) for j in range(len(new))}:
+            return IdentityBiMap(n + len(new)), new
+        return bm, []
+    fwd = bm.to_dict()
+    for k in new:
+        fwd[k] = len(fwd)
+    return BiMap(fwd), new
+
+
 class _IdentityKeys:
     """Reusable view over str(0..n) — matches dict_keys' re-iterability
     and len() (a one-shot generator would silently diverge)."""

@@ -47,6 +47,32 @@ def _to_us(t: Optional[_dt.datetime]) -> Optional[int]:
     return int(round((t - _EPOCH).total_seconds() * 1e6))
 
 
+def shard_paths(dirpath: str, app_id: int,
+                channel_id: Optional[int] = None) -> list[str]:
+    """Every on-disk shard of one (app, channel) event log, base log
+    first then partitions in index order — THE naming contract of the
+    partitioned layout (``events_<app>[_<chan>][.p<i>].jsonl``), shared
+    by the merged read view below and the log tailer
+    (data/api/log_tail.py) so the two can never disagree about what
+    files make up a log."""
+    suffix = f"_{channel_id}" if channel_id is not None else ""
+    base = os.path.join(dirpath, f"events_{app_id}{suffix}.jsonl")
+    paths = [base] if os.path.exists(base) else []
+    prefix = os.path.basename(base)[:-6] + ".p"
+    parts = []
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        names = []
+    for name in names:
+        if name.startswith(prefix) and name.endswith(".jsonl"):
+            mid = name[len(prefix):-6]
+            if mid.isdigit():
+                parts.append((int(mid), name))
+    paths.extend(os.path.join(dirpath, name) for _i, name in sorted(parts))
+    return paths
+
+
 class _LogScan:
     """Cached columnar scan of one log file, extended incrementally."""
 
@@ -343,26 +369,19 @@ class JSONLEvents(base.LEvents):
             return base
         return f"{base[:-6]}.p{self._partition}.jsonl"
 
+    @property
+    def events_dir(self) -> str:
+        """Directory holding this namespace's JSONL logs (the public
+        spelling of what `pio status` and the log tailer need — callers
+        should stop reaching for the private ``_dir``)."""
+        return self._dir
+
     def _read_paths(self, app_id: int, channel_id: Optional[int]) -> list:
         """Every shard of this (app, channel) log on disk, base first
         then partitions in index order — the merge order of the
-        partitioned read view."""
-        base = self._base_path(app_id, channel_id)
-        paths = [base] if os.path.exists(base) else []
-        prefix = os.path.basename(base)[:-6] + ".p"
-        parts = []
-        try:
-            names = os.listdir(self._dir)
-        except OSError:
-            names = []
-        for name in names:
-            if name.startswith(prefix) and name.endswith(".jsonl"):
-                mid = name[len(prefix):-6]
-                if mid.isdigit():
-                    parts.append((int(mid), name))
-        paths.extend(os.path.join(self._dir, name)
-                     for _i, name in sorted(parts))
-        return paths
+        partitioned read view (shared naming contract:
+        :func:`shard_paths`)."""
+        return shard_paths(self._dir, app_id, channel_id)
 
     def _state(self, path: str) -> _TableState:
         with self._meta:

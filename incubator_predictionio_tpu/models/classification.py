@@ -33,6 +33,8 @@ from ..data.store.p_event_store import PEventStore
 from ..ops.linear import (
     LogisticRegressionModel,
     NaiveBayesModel,
+    lr_sgd_steps,
+    nb_fold_in,
     train_logistic_regression,
     train_naive_bayes,
 )
@@ -114,6 +116,16 @@ class ClassifierModel:
     inner: object  # NaiveBayesModel | LogisticRegressionModel
     attribute_names: Sequence[str]
     label_values: np.ndarray
+    # Per-entity memory of the example a streamed fold-in increment
+    # last contributed (entityId -> (features tuple, class index)): a
+    # re-$set REPLACES that example in the NB sufficient statistics
+    # instead of stacking a duplicate. None on trained/legacy models
+    # (populated by the first increment). Entities that existed at
+    # TRAIN time are not individually recoverable from the aggregated
+    # training read, so their first streamed update adds one extra
+    # example — bounded, unlike the unbounded drift of re-counting
+    # every update.
+    foldin_seen: Optional[dict] = None
 
     def predict_label(self, features: np.ndarray) -> float:
         x = np.asarray(features, np.float32)[None, :]
@@ -150,6 +162,45 @@ def _wire_bytes(features: "np.ndarray") -> int:
     return features.nbytes
 
 
+#: Cap on ClassifierModel.foldin_seen — see the field comment.
+FOLDIN_SEEN_MAX = 100_000
+
+
+def _foldin_examples(events, data_source_params, model: ClassifierModel):
+    """New labeled examples from tailed $set events, mapped with the
+    SAME entity-type/attributes/label config training read. Only
+    COMPLETE events (every attribute + the label in one $set — the
+    template's import shape) fold in O(new events); partial property
+    updates would need a full aggregate replay and are skipped with a
+    debug note. Labels outside the trained class set are skipped too —
+    a new class needs a retrain (the model's output width is fixed)."""
+    dsp = dict(data_source_params or {})
+    entity_type = dsp.get("entity_type", dsp.get("entityType", "user"))
+    attrs = list(dsp.get("attributes") or model.attribute_names)
+    label = dsp.get("label", "plan")
+    label_of = {float(v): j for j, v in
+                enumerate(np.asarray(model.label_values, np.float64))}
+    latest: dict = {}
+    for e in events:
+        if not isinstance(e, dict) or e.get("event") != "$set":
+            continue
+        if e.get("entityType") != entity_type or not e.get("entityId"):
+            continue
+        props = e.get("properties") or {}
+        try:
+            x = [float(props[a]) for a in attrs]
+            y = label_of[float(props[label])]
+        except (KeyError, TypeError, ValueError):
+            continue    # partial $set or unseen label: skip (docstring)
+        latest[e["entityId"]] = (x, y)   # last $set per entity wins
+    if not latest:
+        return None, None, None
+    ids = list(latest)
+    xs = [latest[i][0] for i in ids]
+    ys = [latest[i][1] for i in ids]
+    return (ids, np.asarray(xs, np.float32), np.asarray(ys, np.int64))
+
+
 class NaiveBayesAlgorithm(Algorithm):
     params_cls = NaiveBayesParams
     params_aliases = {"lambda": "smoothing"}
@@ -178,6 +229,50 @@ class NaiveBayesAlgorithm(Algorithm):
             [float(query[a]) for a in model.attribute_names], np.float32
         )
         return {"label": model.predict_label(x)}
+
+    def fold_in(self, model: ClassifierModel, events, ctx,
+                data_source_params=None):
+        """EXACT incremental NB (ops.linear.nb_fold_in): the stored
+        sufficient statistics plus the new examples' counts rebuild
+        the log params exactly as a retrain on the updated example set
+        would — an entity a PRIOR increment added is REPLACED (its old
+        example's counts subtracted), not double-counted; see the
+        ``foldin_seen`` field note for train-time entities."""
+        ids, x, y = _foldin_examples(events, data_source_params, model)
+        if x is None:
+            return None
+        seen = dict(getattr(model, "foldin_seen", None) or {})
+        x_rm, y_rm = [], []
+        for eid in ids:
+            prev = seen.get(eid)
+            if prev is not None:
+                x_rm.append(prev[0])
+                y_rm.append(prev[1])
+        inner = nb_fold_in(model.inner, x, y,
+                           x_remove=np.asarray(x_rm, np.float32)
+                           if x_rm else None,
+                           y_remove=np.asarray(y_rm, np.int64)
+                           if y_rm else None)
+        if inner is None:
+            import logging
+
+            logging.getLogger("pio.foldin").warning(
+                "NB fold-in declined: model carries no sufficient "
+                "statistics (pre-upgrade blob) — retrain once to "
+                "enable online updates")
+            return None
+        for eid, xi, yi in zip(ids, x, y):
+            seen.pop(eid, None)   # re-insert = move to freshest
+            seen[eid] = (tuple(float(v) for v in xi), int(yi))
+        # bounded: the map rides inside every published artifact, so
+        # unbounded growth would inflate each increment's serialize/
+        # checksum/validate cost with the distinct-entity count.
+        # Evicted (oldest-updated) entities degrade to the train-time
+        # rule — their NEXT update adds one extra example once.
+        while len(seen) > FOLDIN_SEEN_MAX:
+            seen.pop(next(iter(seen)))
+        return ClassifierModel(inner, model.attribute_names,
+                               model.label_values, foldin_seen=seen)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -217,6 +312,22 @@ class LogisticRegressionAlgorithm(Algorithm):
         return ClassifierModel(model, pd.attribute_names, pd.label_values)
 
     predict = NaiveBayesAlgorithm.predict
+
+    def fold_in(self, model: ClassifierModel, events, ctx,
+                data_source_params=None):
+        """Online SGD (ops.linear.lr_sgd_steps): a few gradient steps
+        over the new examples nudge the warm weights — the streaming
+        approximation of the L-BFGS re-solve a retrain would run
+        (gradient steps are inherently additive; no per-entity
+        replacement bookkeeping applies)."""
+        _ids, x, y = _foldin_examples(events, data_source_params, model)
+        if x is None:
+            return None
+        inner = lr_sgd_steps(model.inner, x, y, reg=self.params.reg)
+        if inner is None:
+            return None
+        return ClassifierModel(inner, model.attribute_names,
+                               model.label_values)
 
 
 class ClassificationEngine(EngineFactory):

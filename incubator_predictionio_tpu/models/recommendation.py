@@ -31,9 +31,9 @@ from ..controller import (
     SanityCheck,
     Serving,
 )
-from ..data.storage.bimap import BiMap
+from ..data.storage.bimap import BiMap, extend_bimap
 from ..data.store.p_event_store import PEventStore
-from ..ops.als import ALSFactors, ALSParams, train_als
+from ..ops.als import ALSFactors, ALSParams, fold_in_factors, train_als
 from ..workflow.input_pipeline import pipeline_of
 from ..ops.sharded_topk import (
     serving_mesh_for,
@@ -341,6 +341,124 @@ class ALSAlgorithm(Algorithm):
                     ]
                 }
             )
+        return out
+
+    #: Proximal weight μ of the fold-in's ‖x − x_old‖² term: an
+    #: existing entity's current factor enters its re-solve as a
+    #: pseudo-observation of this strength, so one new event nudges a
+    #: long-history user instead of replacing them. New entities have a
+    #: zero anchor row — for them the solve degrades to the exact
+    #: cold-start ridge.
+    FOLD_IN_ANCHOR_WEIGHT = 1.0
+
+    def fold_in(self, model: ALSModel, events, ctx,
+                data_source_params=None) -> Optional[ALSModel]:
+        """Closed-form streaming fold-in (ops.als.fold_in_factors):
+        map new rate/buy events onto (user, item, rating) triples with
+        the SAME event-name/default-rating rules the data source
+        trains with, extend the id maps for unseen users/items, then
+        ridge-solve the touched item rows against fixed user factors
+        and the touched user rows against the updated item factors.
+        O(new events); the served model is never mutated."""
+        dsp = dict(data_source_params or {})
+        names = list(dsp.get("event_names") or dsp.get("eventNames")
+                     or DataSourceParams.event_names)
+        buy_rating = float(dsp.get("buy_rating",
+                                   dsp.get("buyRating",
+                                           DataSourceParams.buy_rating)))
+        triples: dict[tuple[str, str], float] = {}
+        for e in events:
+            if not isinstance(e, dict) or e.get("event") not in names:
+                continue
+            u, it = e.get("entityId"), e.get("targetEntityId")
+            if not u or not it:
+                continue
+            props = e.get("properties") or {}
+            try:
+                r = float(props["rating"])
+            except (KeyError, TypeError, ValueError):
+                r = buy_rating if e.get("event") == "buy" else 1.0
+            triples[(str(u), str(it))] = r  # last write wins, like upsert
+        if not triples:
+            return None
+        users, _new_u = extend_bimap(
+            model.users, (u for u, _ in triples))
+        items, _new_i = extend_bimap(
+            model.items, (i for _, i in triples))
+        # ids an IdentityBiMap could not extend (non-consecutive) drop
+        # out here via .get() returning None
+        coo = [(users.get(u), items.get(i), r)
+               for (u, i), r in triples.items()]
+        coo = [(ui, ii, r) for ui, ii, r in coo
+               if ui is not None and ii is not None]
+        if len(coo) < len(triples):
+            import logging
+
+            logging.getLogger("pio.foldin").warning(
+                "fold-in: skipped %d event(s) whose ids cannot extend "
+                "the identity catalog map", len(triples) - len(coo))
+        if not coo:
+            return None
+        k = model.factors.user_factors.shape[1]
+        uf = np.asarray(model.factors.user_factors, np.float32)
+        itf = np.asarray(model.factors.item_factors, np.float32)
+        if len(users) > uf.shape[0]:
+            uf = np.vstack([uf, np.zeros((len(users) - uf.shape[0], k),
+                                         np.float32)])
+        else:
+            uf = uf.copy()
+        if len(items) > itf.shape[0]:
+            itf = np.vstack([itf, np.zeros((len(items) - itf.shape[0], k),
+                                           np.float32)])
+        else:
+            itf = itf.copy()
+        p = self.params
+        kw = dict(reg=p.reg, lambda_scaling=p.lambda_scaling,
+                  implicit_prefs=p.implicit_prefs, alpha=p.alpha)
+
+        def touched(axis: int):
+            by: dict[int, tuple[list, list]] = {}
+            for ui, ii, r in coo:
+                row = ui if axis == 0 else ii
+                cp = ii if axis == 0 else ui
+                by.setdefault(row, ([], []))
+                by[row][0].append(cp)
+                by[row][1].append(r)
+            rows = sorted(by)
+            return (rows, [np.asarray(by[r][0], np.int64) for r in rows],
+                    [np.asarray(by[r][1], np.float32) for r in rows])
+
+        def mu_for(rows, n_trained: int) -> np.ndarray:
+            # the proximal anchor only means something for rows that
+            # HAD a factor: brand-new rows (appended past the old
+            # matrix) must solve the exact cold-start ridge, not a
+            # ridge stiffened by mu toward a meaningless zero anchor
+            return np.where(np.asarray(rows) < n_trained,
+                            np.float32(self.FOLD_IN_ANCHOR_WEIGHT),
+                            np.float32(0.0))
+
+        # items first against the (frozen) user side — a new item rated
+        # by existing users lands a real factor; then users against the
+        # UPDATED item side, so a new user's first event on a brand-new
+        # item still resolves both rows in one increment
+        n_u0 = model.factors.user_factors.shape[0]
+        n_i0 = model.factors.item_factors.shape[0]
+        i_rows, i_idx, i_val = touched(1)
+        itf[i_rows] = fold_in_factors(uf, i_idx, i_val,
+                                      anchor=itf[i_rows],
+                                      anchor_weight=mu_for(i_rows, n_i0),
+                                      **kw)
+        u_rows, u_idx, u_val = touched(0)
+        uf[u_rows] = fold_in_factors(itf, u_idx, u_val,
+                                     anchor=uf[u_rows],
+                                     anchor_weight=mu_for(u_rows, n_u0),
+                                     **kw)
+        out = ALSModel(
+            factors=ALSFactors(uf, itf, len(users), len(items)),
+            users=users, items=items)
+        # same serving layout as the live model; device catalog caches
+        # (_dev_items/_sharded_cat) stay None and re-warm at the gate
+        out.serving_mesh = model.serving_mesh
         return out
 
     def prepare_model_for_persistence(self, model: ALSModel):

@@ -1260,6 +1260,97 @@ def train_als_process_sharded(
     )
 
 
+def fold_in_factors(y, obs_idx, obs_val, *, reg: float,
+                    lambda_scaling: str = "plain",
+                    implicit_prefs: bool = False, alpha: float = 1.0,
+                    anchor=None, anchor_weight=1.0,
+                    yty=None) -> np.ndarray:
+    """Closed-form ridge fold-in: solve R rows against FIXED counterpart
+    factors ``y`` [n, k] (the ALX fold-in recipe, arxiv 2112.02194 —
+    one half-step of ALS for just the touched rows, with the opposite
+    side frozen). This is the math of the streaming online-learning
+    subsystem (workflow/online.py, docs/operations.md "Online
+    learning"): a brand-new user's factor from their first events is
+    EXACTLY what a full retrain would produce for them given the
+    current counterpart matrix.
+
+    ``obs_idx``: R arrays of counterpart row indices (one per solved
+    row); ``obs_val``: R matching float arrays of ratings. Rows ride
+    the same per-row normal equations as training (:func:`_grams_rows`
+    — zero-padded gather slots contribute nothing), then a batched
+    host solve: the systems are [k, k] and R is the handful of
+    entities a fold-in increment touches, so a device dispatch would
+    cost more than it saves.
+
+    ``anchor`` [R, k] adds a proximal term μ‖x − x_old‖² (μ =
+    ``anchor_weight``, scalar or per-row [R]): existing entities blend
+    new evidence into their current factor instead of forgetting their
+    history (the history itself is not re-read — O(new events), not
+    O(log)); rows whose anchor is a brand-new entity's zero row should
+    carry μ=0 so they solve the exact cold-start ridge.
+
+    Regularization mirrors training: ``lambda_scaling='nratings'``
+    scales λ by each row's (new-)rating count, ``'plain'`` uses λ as
+    is; ``implicit_prefs`` adds the shared YᵀY term with
+    confidence weights 1+α·r (Hu-Koren-Volinsky, matching
+    ``train_als``'s implicit mode against the same ratings).
+
+    Returns the solved rows, [R, k] float32.
+    """
+    y = np.asarray(y, np.float32)
+    n, k = y.shape
+    R = len(obs_idx)
+    if R == 0:
+        return np.zeros((0, k), np.float32)
+    C = max((len(ix) for ix in obs_idx), default=0)
+    if C == 0 or n == 0:
+        return (np.asarray(anchor, np.float32).reshape(R, k)
+                if anchor is not None else np.zeros((R, k), np.float32))
+    p = np.zeros((R, C, k), np.float32)
+    val = np.zeros((R, C), np.float32)
+    counts = np.zeros(R, np.float32)
+    for r, (ix, v) in enumerate(zip(obs_idx, obs_val)):
+        ix = np.asarray(ix, np.int64)
+        m = len(ix)
+        if m:
+            p[r, :m] = y[ix]
+            val[r, :m] = np.asarray(v, np.float32)
+            counts[r] = m
+    grams, rhs = _grams_rows(
+        jnp.asarray(p), jnp.asarray(val), implicit=implicit_prefs,
+        alpha=alpha, compute_dtype=jnp.float32)
+    grams = np.asarray(grams, np.float32)
+    rhs = np.asarray(rhs, np.float32)
+    if implicit_prefs:
+        # the shared YtY term is O(n·k²) over the WHOLE counterpart
+        # matrix — the one non-O(new events) piece of an implicit
+        # fold-in. Callers folding repeatedly against the same side
+        # can pass a precomputed/cached ``yty`` [k, k].
+        if yty is None:
+            yty = y.T @ y
+        grams = grams + np.asarray(yty, np.float32)[None, :, :]
+    lam = np.full(R, float(reg), np.float32)
+    if lambda_scaling == "nratings":
+        lam *= np.maximum(counts, 1.0)
+    # no anchor = no proximal term AT ALL: adding mu to the normal
+    # matrix without the matching rhs term would be phantom ridge
+    # silently shrinking every solution toward zero. anchor_weight may
+    # be per-row ([R]) — callers zero it for rows whose anchor is the
+    # meaningless zero row of a brand-new entity, keeping those at the
+    # exact cold-start ridge the contract promises.
+    if anchor is None:
+        mu = np.zeros(R, np.float32)
+    else:
+        mu = np.maximum(np.broadcast_to(
+            np.asarray(anchor_weight, np.float32), (R,)), 0.0)
+    a = grams + (lam + mu)[:, None, None] * np.eye(k, dtype=np.float32)
+    if anchor is not None:
+        rhs = rhs + mu[:, None] * np.asarray(anchor,
+                                             np.float32).reshape(R, k)
+    # batched [k, k] solves want an explicit trailing rhs column
+    return np.linalg.solve(a, rhs[..., None])[..., 0].astype(np.float32)
+
+
 def predict_rmse(factors: ALSFactors, user_idx, item_idx, rating) -> float:
     """Host-side RMSE over a COO triple (eval helper)."""
     x = factors.user_factors[np.asarray(user_idx)]

@@ -63,9 +63,83 @@ class NaiveBayesModel:
     log_prior: np.ndarray  # [C]
     log_likelihood: np.ndarray  # [C, D]
     n_classes: int
+    # Sufficient statistics, carried so the streaming fold-in
+    # (workflow/online.py) can fold new labeled examples in EXACTLY —
+    # NB's log params are a pure function of (feat, counts, smoothing),
+    # so counts + increments == a full retrain on old∪new. None on
+    # models persisted before these fields existed (fold-in then
+    # declines and asks for one retrain) and on col_scale (TF-IDF)
+    # trainings, where the scale itself shifts with new documents.
+    feat_counts: Optional[np.ndarray] = None   # [C, D] pre-smoothing
+    class_counts: Optional[np.ndarray] = None  # [C]
+    smoothing: float = 1.0
 
     def predict_log_joint(self, x: np.ndarray) -> np.ndarray:
         return x @ self.log_likelihood.T + self.log_prior  # [B, C]
+
+
+def nb_model_from_counts(feat: np.ndarray, counts: np.ndarray,
+                         n_classes: int, smoothing: float,
+                         keep_counts: bool = True) -> NaiveBayesModel:
+    """(class-feature sums, class counts) → NaiveBayesModel. THE one
+    construction every NB trainer and the fold-in path share, so the
+    smoothing/normalization math cannot drift between them."""
+    # arithmetic runs in the CALLER's dtype (f32 device stats, f64
+    # bincounts) so this refactor is bit-identical to the construction
+    # it replaced in each trainer
+    total = counts.sum()
+    log_prior = np.log((counts + 1e-12) / max(total, 1e-12))
+    num = feat + smoothing
+    log_likelihood = np.log(num) - np.log(num.sum(axis=1, keepdims=True))
+    return NaiveBayesModel(
+        log_prior=log_prior.astype(np.float32),
+        log_likelihood=log_likelihood.astype(np.float32),
+        n_classes=n_classes,
+        feat_counts=(np.asarray(feat, np.float32)
+                     if keep_counts else None),
+        class_counts=(np.asarray(counts, np.float32)
+                      if keep_counts else None),
+        smoothing=float(smoothing),
+    )
+
+
+def nb_fold_in(model: NaiveBayesModel, x: np.ndarray, y: np.ndarray,
+               x_remove=None, y_remove=None) -> Optional[NaiveBayesModel]:
+    """Exact incremental NB update: add the new examples' sufficient
+    statistics (and SUBTRACT ``x_remove``/``y_remove`` — the previous
+    example of an entity being re-labeled, so an update replaces
+    instead of double-counting) and rebuild the log params — bit-for-
+    bit what a retrain on the updated example set would produce
+    (integer-count features sum exactly in f32). Returns None when the
+    model carries no stored counts (legacy blob or col-scaled
+    training): the caller logs and waits for a retrain. Never mutates
+    ``model``."""
+    feat = getattr(model, "feat_counts", None)
+    counts = getattr(model, "class_counts", None)
+    if feat is None or counts is None:
+        return None
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int64)
+    if x.ndim != 2 or x.shape[1] != feat.shape[1] or len(x) != len(y):
+        return None
+
+    def stats(xs, ys):
+        onehot = np.zeros((len(ys), model.n_classes), np.float32)
+        onehot[np.arange(len(ys)), ys] = 1.0
+        return onehot.T @ xs, onehot.sum(axis=0)
+
+    f_add, c_add = stats(x, y)
+    feat = feat + f_add
+    counts = counts + c_add
+    if x_remove is not None and len(x_remove):
+        f_sub, c_sub = stats(np.asarray(x_remove, np.float32),
+                             np.asarray(y_remove, np.int64))
+        # clip: a corrupt removal record must never drive counts
+        # negative (log of a negative smoothed count is NaN)
+        feat = np.maximum(feat - f_sub, 0.0)
+        counts = np.maximum(counts - c_sub, 0.0)
+    return nb_model_from_counts(
+        feat, counts, model.n_classes, getattr(model, "smoothing", 1.0))
 
 
 def _nb_stats_body(x, y, w, n_classes: int):
@@ -197,15 +271,10 @@ def train_naive_bayes(
     if col_scale is not None:
         feat = feat * np.asarray(col_scale, np.float32)
 
-    total = counts.sum()
-    log_prior = np.log((counts + 1e-12) / max(total, 1e-12))
-    num = feat + smoothing
-    log_likelihood = np.log(num) - np.log(num.sum(axis=1, keepdims=True))
-    return NaiveBayesModel(
-        log_prior=log_prior.astype(np.float32),
-        log_likelihood=log_likelihood.astype(np.float32),
-        n_classes=n_classes,
-    )
+    # col-scaled (TF-IDF) stats are not fold-in-able: the scale itself
+    # moves with new documents, so stored counts would lie
+    return nb_model_from_counts(feat, counts, n_classes, smoothing,
+                                keep_counts=col_scale is None)
 
 
 @functools.partial(jax.jit, static_argnames=("n_classes", "n_features"))
@@ -355,15 +424,8 @@ def _nb_model_from_stats(feat, y, n_classes, smoothing, col_scale):
     if col_scale is not None:
         feat = feat * np.asarray(col_scale, np.float32)
     class_counts = np.bincount(y, minlength=n_classes).astype(np.float64)
-    total = class_counts.sum()
-    log_prior = np.log((class_counts + 1e-12) / max(total, 1e-12))
-    num = feat + smoothing
-    log_likelihood = np.log(num) - np.log(num.sum(axis=1, keepdims=True))
-    return NaiveBayesModel(
-        log_prior=log_prior.astype(np.float32),
-        log_likelihood=log_likelihood.astype(np.float32),
-        n_classes=n_classes,
-    )
+    return nb_model_from_counts(feat, class_counts, n_classes, smoothing,
+                                keep_counts=col_scale is None)
 
 
 def train_naive_bayes_coo_stream(
@@ -438,6 +500,36 @@ class LogisticRegressionModel:
         z = z - z.max(axis=-1, keepdims=True)
         e = np.exp(z)
         return e / e.sum(axis=-1, keepdims=True)
+
+
+def lr_sgd_steps(model: LogisticRegressionModel, x: np.ndarray,
+                 y: np.ndarray, *, reg: float = 0.0, lr: float = 0.05,
+                 epochs: int = 5) -> Optional[LogisticRegressionModel]:
+    """Online SGD on a COPY of an LR model: a few full-batch softmax
+    cross-entropy gradient steps over the NEW examples only — the
+    streaming fold-in update (workflow/online.py). Host numpy on
+    purpose: an increment is a handful of examples, and warm serving
+    weights only need a nudge toward them, not an L-BFGS re-solve.
+    Returns None on shape mismatch (feature count changed: retrain)."""
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int64)
+    w = np.array(model.weights, np.float32, copy=True)
+    b = np.array(model.intercept, np.float32, copy=True)
+    if x.ndim != 2 or x.shape[1] != w.shape[0] or len(x) != len(y) \
+            or not len(x):
+        return None
+    onehot = np.zeros((len(y), model.n_classes), np.float32)
+    onehot[np.arange(len(y)), y] = 1.0
+    for _ in range(max(1, int(epochs))):
+        z = x @ w + b
+        z -= z.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        p = e / e.sum(axis=1, keepdims=True)
+        g = (p - onehot) / len(y)
+        w -= lr * (x.T @ g + reg * w)
+        b -= lr * g.sum(axis=0)
+    return LogisticRegressionModel(weights=w, intercept=b,
+                                   n_classes=model.n_classes)
 
 
 @functools.partial(jax.jit, static_argnames=("n_classes",),

@@ -260,6 +260,14 @@ def deploy_cmd(args: list[str]) -> int:
                    help="poll for newer COMPLETED instances and hot-swap "
                         "them through the validated gate every N ms "
                         "(default $PIO_MODEL_REFRESH_MS, else 0 = off)")
+    p.add_argument("--online-foldin", action="store_true",
+                   help="streaming online learning: tail the app's "
+                        "event log and fold new events into the served "
+                        "model continuously, publishing each increment "
+                        "through the same validation gate + watch "
+                        "window as a retrain (interval $PIO_FOLDIN_MS, "
+                        "default 1000; with --replicas, replica 0 "
+                        "produces and the coordinator stages canaries)")
     p.add_argument("--rollback", action="store_true",
                    help="don't deploy: tell the engine server already "
                         "running at --ip/--port to roll back to its "
@@ -306,13 +314,20 @@ def _build_engine_server(ns):
     the fleet replica worker: a serving knob added here reaches both
     paths (two hand-synced kwarg blocks had already drifted once).
     `model_refresh_ms` is safe to pass in fleet mode — the replica
-    zeroes it itself (the coordinator owns refresh)."""
+    zeroes it itself (the coordinator owns refresh), and
+    `--online-foldin` reaches every replica too (only replica 0
+    produces; the rest stand by as failover producers)."""
+    from ...common import envknobs
     from ...workflow.create_server import EngineServer
 
     engine, params, factory, variant, _ = _load_engine(ns)
     app_name = dict(params.data_source_params).get("app_name") or dict(
         params.data_source_params
     ).get("appName", "")
+    # --online-foldin arms the loop at $PIO_FOLDIN_MS (default 1000);
+    # without the flag the env knob alone can still arm it
+    foldin_ms = (float(envknobs.env_int("PIO_FOLDIN_MS", 1000, lo=1))
+                 if getattr(ns, "online_foldin", False) else None)
     return EngineServer(
         engine,
         engine_factory_name=factory,
@@ -327,6 +342,7 @@ def _build_engine_server(ns):
         query_deadline_ms=ns.query_deadline_ms,
         drain_deadline_ms=ns.drain_deadline_ms,
         model_refresh_ms=ns.model_refresh_ms,
+        foldin_ms=foldin_ms,
     )
 
 

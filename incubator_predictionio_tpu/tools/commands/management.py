@@ -93,7 +93,7 @@ def status_cmd(args: list[str]) -> int:
               "durable ingestion)")
     # Partitioned event log health: per-shard sizes, lease holders
     # (stale-lease warnings), compaction recency, quarantine counts.
-    log_dir = getattr(s.get_l_events(), "_dir", None)
+    log_dir = getattr(s.get_l_events(), "events_dir", None)
     if log_dir is not None and os.path.isdir(log_dir):
         from ...data.api import event_log
 
@@ -102,6 +102,9 @@ def status_cmd(args: list[str]) -> int:
             print(f"[info] Event log: {len(health['logs'])} log file(s) "
                   f"in {log_dir}")
             _print_partition_health(health, log_dir)
+    # Online fold-in cursors: where each app's streaming-learning
+    # tailer stands, with the freshness-lag warn-marker.
+    _print_foldin_cursors(s)
     if ns.engine_url:
         _print_engine_overload(ns.engine_url)
     if ns.metrics:
@@ -158,15 +161,45 @@ def _print_engine_overload(url: str) -> None:
                               or lc.get("validateFailures")) else "[info]"
         pins = (", ".join(f"{i} ({r})" for i, r in sorted(pinned.items()))
                 or "none")
-        refresh = (f"every {lc.get('refreshMs'):.0f}ms "
-                   f"({lc.get('refreshSwaps')} swap(s))"
-                   if lc.get("refreshMs") else "off")
+        rms = lc.get("refreshMs")
+        if isinstance(rms, (int, float)) and rms:
+            refresh = (f"every {rms:.0f}ms "
+                       f"({lc.get('refreshSwaps')} swap(s))")
+        elif rms:
+            # e.g. "disabled(fleet)": the server refused the knob and
+            # says why — print the reason, not a misleading "off"
+            refresh = str(rms)
+        else:
+            refresh = "off"
         print(f"{marker}   lifecycle: previous {lc.get('previous')}, "
               f"swaps={lc.get('swaps')}, rollbacks={rollbacks} "
               f"{lc.get('rollbacks')}, "
               f"validateFailures={lc.get('validateFailures')}, "
               f"integrityFailures={integ or 0}, "
               f"refresh {refresh}, pinned: {pins}")
+    fi = doc.get("foldin")
+    if fi:
+        if not fi.get("enabled", True):
+            print(f"[warn]   fold-in: disabled — "
+                  f"{fi.get('disabledReason')}")
+        elif not fi.get("producer", True):
+            print("[info]   fold-in: standby (another replica is the "
+                  "fleet's producer)")
+        else:
+            lag = fi.get("lagSeconds")
+            interval_s = float(fi.get("ms") or 0) / 1000.0
+            stale = (lag is not None and interval_s > 0
+                     and lag > 2 * interval_s)
+            marker = "[warn]" if stale else "[info]"
+            print(f"{marker}   fold-in: every {fi.get('ms'):.0f}ms, "
+                  f"app {fi.get('app')!r}, cursor "
+                  f"{fi.get('cursorBytes')} byte(s), "
+                  f"{fi.get('events', 0)} event(s) folded, "
+                  f"{fi.get('publishes', 0)} increment(s) published, "
+                  "freshness lag "
+                  + (f"{lag:.1f}s" if lag is not None else "n/a")
+                  + (" — STALE (> 2x the fold-in interval; loop "
+                     "failing?)" if stale else ""))
     fleet = doc.get("fleet")
     if fleet:
         _print_fleet(fleet)
@@ -244,7 +277,7 @@ def wal_cmd(args: list[str]) -> int:
         if not rows:
             print("[info] No WAL segments on disk — nothing to replay.")
             s = Storage.instance()
-            log_dir = getattr(s.get_l_events(), "_dir", None)
+            log_dir = getattr(s.get_l_events(), "events_dir", None)
             if log_dir is not None and os.path.isdir(log_dir):
                 from ...data.api import event_log
 
@@ -280,7 +313,7 @@ def wal_cmd(args: list[str]) -> int:
         # the partitioned event log rides the same operator surface:
         # shard sizes, lease holders + epochs, compaction recency
         s = Storage.instance()
-        log_dir = getattr(s.get_l_events(), "_dir", None)
+        log_dir = getattr(s.get_l_events(), "events_dir", None)
         if log_dir is not None and os.path.isdir(log_dir):
             from ...data.api import event_log
 
@@ -374,16 +407,36 @@ def eventlog_cmd(args: list[str]) -> int:
         "fence", help="force-claim a partition lease past a held flock "
                       "(ONLY when the owner is wedged/unreachable)")
     p_fence.add_argument("--partition", type=int, required=True)
+    p_tail = sub.add_parser(
+        "tail", help="read events past a durable byte cursor (the "
+                     "online fold-in's read primitive, as a CLI): "
+                     "prints events as JSONL on stdout and the "
+                     "advanced cursor on stderr — feed it back via "
+                     "--from to resume")
+    p_tail.add_argument("--app", dest="app_name", default=None)
+    p_tail.add_argument("--appid", type=int, default=None)
+    p_tail.add_argument("--channel", default=None)
+    p_tail.add_argument("--from", dest="cursor", default=None,
+                        metavar="CURSOR",
+                        help="JSON cursor from a previous run (or "
+                             "'end' to position at the current log end "
+                             "and read nothing; default: read from the "
+                             "beginning)")
+    p_tail.add_argument("--limit", type=int, default=None,
+                        help="print at most N events (the cursor still "
+                             "advances past everything read)")
     ns = p.parse_args(args)
     from ...data.api import event_log
 
     s = Storage.instance()
     le = s.get_l_events()
-    log_dir = getattr(le, "_dir", None)
+    log_dir = getattr(le, "events_dir", None)
     if log_dir is None:
         print("[error] the configured event store is not a JSONL event "
               "log; `pio eventlog` applies to TYPE=JSONL", file=sys.stderr)
         return 1
+    if ns.sub == "tail":
+        return _eventlog_tail(s, log_dir, ns)
     if ns.sub == "compact":
         n = 0
         for name in sorted(os.listdir(log_dir)):
@@ -416,6 +469,112 @@ def eventlog_cmd(args: list[str]) -> int:
     # status
     _print_partition_health(event_log.partition_health(log_dir), log_dir)
     return 0
+
+
+def _eventlog_tail(s: Storage, log_dir: str, ns) -> int:
+    """`pio eventlog tail`: one read_since() pass over an app's shards
+    — events to stdout (JSONL, pipeable), cursor + accounting to
+    stderr so redirecting stdout captures only data."""
+    from ...data.api.log_tail import LogCursor, LogTailer
+
+    if ns.appid is None and not ns.app_name:
+        # the shared resolver's message names --app-name, which this
+        # subcommand spells --app — say the flag that actually exists
+        print("[error] provide --app <name> or --appid <id>",
+              file=sys.stderr)
+        return 1
+    app_id = _resolve_app_id(s, ns.appid, ns.app_name)
+    channel_id = None
+    if ns.channel:
+        chans = [c for c in s.get_meta_data_channels().get_by_appid(app_id)
+                 if c.name == ns.channel]
+        if not chans:
+            print(f"Channel {ns.channel!r} not found.", file=sys.stderr)
+            return 1
+        channel_id = chans[0].id
+    tailer = LogTailer(log_dir, app_id, channel_id)
+    cursor = None
+    if ns.cursor == "end":
+        cursor = tailer.end_cursor()
+    elif ns.cursor:
+        try:
+            cursor = LogCursor.from_json(json.loads(ns.cursor))
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"[error] --from is not a cursor: {e}", file=sys.stderr)
+            return 1
+    if ns.limit is None:
+        batch = tailer.read_since(cursor)
+        events, total, bytes_read = batch.events, len(batch.events), \
+            batch.bytes_read
+        final, snapshot_seeded, resets = batch.cursor, \
+            batch.snapshot_seeded, batch.resets
+    else:
+        # bounded pagination: read in 1 MiB chunks until the limit is
+        # met (or the log runs dry) instead of decoding a multi-GB
+        # backlog into memory to slice N events off the front
+        limit = max(0, ns.limit)
+        events, total, bytes_read, resets = [], 0, 0, 0
+        snapshot_seeded = False
+        final = cursor
+        while True:
+            batch = tailer.read_since(final, max_bytes=1 << 20)
+            final = batch.cursor
+            total += len(batch.events)
+            bytes_read += batch.bytes_read
+            resets += batch.resets
+            snapshot_seeded |= batch.snapshot_seeded
+            if len(events) < limit:
+                events.extend(batch.events[:limit - len(events)])
+            if batch.bytes_read == 0 or total >= limit:
+                break
+    for doc in events:
+        print(json.dumps(doc))
+    if ns.limit is not None and total > len(events):
+        print(f"[info] {total - len(events)} further "
+              "event(s) read but not printed (--limit); the cursor "
+              "below covers them", file=sys.stderr)
+    print(f"[info] {total} event(s), {bytes_read} "
+          f"byte(s) read across {len(final.shards)} shard(s)"
+          + (", seeded from a columnar snapshot"
+             if snapshot_seeded else "")
+          + (f", {resets} shard reset(s)" if resets else ""),
+          file=sys.stderr)
+    print(f"[info] cursor: {json.dumps(final.to_json())}",
+          file=sys.stderr)
+    return 0
+
+
+def _print_foldin_cursors(s: Storage) -> None:
+    """`pio status` rows for the online fold-in cursors: LSN, events
+    folded, and the freshness-lag line — warn-marked when the lag
+    exceeds 2x the fold-in interval (the loop is down, wedged, or
+    falling behind)."""
+    import time as _time
+
+    try:
+        from ...workflow import online
+
+        rows = online.cursor_docs(s)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return
+    now = _time.time()
+    for r in rows:
+        cursor = r.get("cursor") or {}
+        total = sum((cursor.get("shards") or {}).values())
+        interval_s = float(r.get("intervalMs") or 0) / 1000.0
+        anchor = r.get("caughtUpAt") or r.get("updatedAt") or now
+        lag = max(0.0, now - float(anchor))
+        stale = interval_s > 0 and lag > 2 * interval_s
+        marker = "[warn]" if stale else "[info]"
+        print(f"{marker} Online fold-in: app {r.get('app')!r} "
+              f"(group {r.get('group')}): cursor at {total} byte(s) "
+              f"across {len(cursor.get('shards') or {})} shard(s), "
+              f"{r.get('events', 0)} event(s) folded, "
+              f"{r.get('publishes', 0)} increment(s) published, "
+              f"freshness lag {lag:.1f}s"
+              + (f" — STALE (> 2x the {interval_s * 1000:.0f}ms "
+                 "fold-in interval; loop down or wedged?)"
+                 if stale else ""))
 
 
 def _print_partition_health(health: dict, log_dir: str) -> None:

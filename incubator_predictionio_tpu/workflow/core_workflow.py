@@ -135,6 +135,63 @@ def _run_train_follower(engine, engine_params, ctx, wp, gang_id: str) -> str:
     return gang_id
 
 
+def _capture_foldin_anchor(storage, ctx):
+    """(app_id, LogCursor) at the current event-log end, or None when
+    fold-in structurally cannot apply (non-JSONL store, no app).
+    Best-effort: training must never fail over its online-learning
+    bookkeeping."""
+    try:
+        from ..data.api.log_tail import LogTailer
+
+        le = storage.get_l_events()
+        events_dir = getattr(le, "events_dir", None)
+        if not events_dir or not ctx.app_name:
+            return None
+        app = storage.get_meta_data_apps().get_by_name(ctx.app_name)
+        if app is None:
+            return None
+        return app.id, LogTailer(events_dir, app.id).end_cursor()
+    except Exception:  # noqa: BLE001 — bookkeeping only
+        return None
+
+
+def _persist_foldin_anchor(storage, anchor, ctx, engine_factory_name,
+                           engine_variant) -> None:
+    """Seed the fold-in cursor row from a completed train — ONLY when
+    none exists yet: a live fold-in producer owns an existing row
+    (single-writer), and rewinding it under a running tailer would
+    re-fold everything since its last tick for nothing."""
+    if anchor is None:
+        return
+    try:
+        import time as _time
+
+        app_id, cursor = anchor
+        group = model_artifact.fleet_group(engine_factory_name,
+                                           engine_variant)
+        row_id = model_artifact.foldin_row_id(group, app_id)
+        if model_artifact.read_fleet_doc(storage, row_id) is not None:
+            return
+        model_artifact.write_fleet_doc(storage, row_id, {
+            "cursor": cursor.to_json(),
+            "group": group,
+            "appId": app_id,
+            "app": ctx.app_name,
+            "intervalMs": 0.0,
+            "updatedAt": _time.time(),
+            "caughtUpAt": None,
+            "events": 0,
+            "publishes": 0,
+            "anchor": "train",
+        })
+        log.info("fold-in cursor anchored at this train's read "
+                 "position (LSN %d) for app %r", cursor.total(),
+                 ctx.app_name)
+    except Exception:  # noqa: BLE001 — bookkeeping only
+        log.debug("could not persist the fold-in train anchor",
+                  exc_info=True)
+
+
 def run_train(
     engine: Engine,
     engine_params: EngineParams,
@@ -282,6 +339,15 @@ def run_train(
         instance_id = instances.insert(instance)
     ctx.engine_instance_id = instance_id
     log.info("EngineInstance %s RUNNING", instance_id)
+    # Online-learning anchor (docs/operations.md "Online learning"):
+    # capture the event log's position BEFORE the training read so the
+    # fold-in tailer's FIRST arm resumes from what this train covers —
+    # without it, events ingested between train and `pio deploy
+    # --online-foldin` startup fall into neither the trained model nor
+    # the tail. Captured here (pre-read) so the error direction is
+    # at-least-once: an event racing the read may be both trained AND
+    # folded, never silently dropped.
+    foldin_anchor = _capture_foldin_anchor(storage, ctx)
 
     if wp.checkpoint_every > 0 or wp.resume:
         from .checkpoint import CheckpointHook, instance_checkpoint_dir
@@ -343,6 +409,8 @@ def run_train(
             if ctx.checkpoint_hook is not None:
                 ctx.checkpoint_hook.delete_all()  # superseded by the model
                 ctx.checkpoint_hook = None
+        _persist_foldin_anchor(storage, foldin_anchor, ctx,
+                               engine_factory_name, engine_variant)
         log.info("EngineInstance %s COMPLETED", instance_id)
         return instance_id
     except Exception:

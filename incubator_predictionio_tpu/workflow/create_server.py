@@ -95,6 +95,7 @@ class EngineServer:
         swap_watch_ms: Optional[float] = None,
         swap_max_error_rate: Optional[float] = None,
         model_refresh_ms: Optional[float] = None,
+        foldin_ms: Optional[float] = None,
         fleet_replica: Optional[int] = None,
         fleet_replicas: Optional[int] = None,
         fleet_sync_ms: Optional[float] = None,
@@ -129,7 +130,7 @@ class EngineServer:
                                   swap_validate, swap_watch_ms,
                                   swap_max_error_rate, model_refresh_ms,
                                   fleet_replica, fleet_replicas,
-                                  fleet_sync_ms)
+                                  fleet_sync_ms, foldin_ms)
         # Probe marker secret: synthetic startup-probe traffic is
         # excluded from queryCount/feedback, so the marker must not be
         # spoofable — an external client sending a bare "X-Pio-Probe: 1"
@@ -183,6 +184,8 @@ class EngineServer:
             self.app.on_cleanup.append(self._stop_batcher)
         self.app.on_startup.append(self._start_refresher)
         self.app.on_cleanup.append(self._stop_refresher)
+        self.app.on_startup.append(self._start_foldin)
+        self.app.on_cleanup.append(self._stop_foldin)
         self.app.on_startup.append(self._start_fleet)
         self.app.on_cleanup.append(self._stop_fleet)
         self.app.on_startup.append(self._start_heartbeat)
@@ -195,7 +198,7 @@ class EngineServer:
                              swap_watch_ms=None, swap_max_error_rate=None,
                              model_refresh_ms=None, fleet_replica=None,
                              fleet_replicas=None,
-                             fleet_sync_ms=None) -> None:
+                             fleet_sync_ms=None, foldin_ms=None) -> None:
         """Admission control: the query path gets a DEDICATED bounded
         executor (query_conc workers) plus a bounded waiting budget
         (query_max_pending); offered load beyond conc+pending is shed
@@ -263,6 +266,19 @@ class EngineServer:
         self.model_refresh_ms = max(0.0, float(
             model_refresh_ms if model_refresh_ms is not None
             else _env_int("PIO_MODEL_REFRESH_MS", 0)))
+        # Streaming online fold-in (ROADMAP item 2; docs/operations.md
+        # "Online learning"): tail the deployed app's event log and
+        # fold new events into the live model continuously, publishing
+        # each increment through the same gate/watch/pin path as a
+        # retrain. 0 = off; `pio deploy --online-foldin` arms it.
+        self.foldin_ms = max(0.0, float(
+            foldin_ms if foldin_ms is not None
+            else _env_int("PIO_FOLDIN_MS", 0)))
+        self._foldin_task = None
+        # loop-confined (the _watch idiom): the runner ticks single-
+        # flight off-thread, and /status reads the last view snapshot
+        self._foldin_runner = None
+        self._foldin_view: Optional[dict] = None
         self._previous = None            # (deployment, instance) resident
         self._pinned: dict[str, str] = {}  # instance id → pin reason
         # pins mid-application (store-walk rollback in flight): honored
@@ -308,9 +324,18 @@ class EngineServer:
         self._fleet_view: Optional[dict] = None
         self._fleet_task = None
         self._hb_task = None
+        # why the operator's refresh knob "did nothing": surfaced on
+        # /status as refreshMs: "disabled(fleet)" instead of silently
+        # reporting 0 — a replica chasing the newest instance on its
+        # own would race the coordinator's staged canary
+        self._refresh_disabled: Optional[str] = None
         if self.fleet_mode and self.model_refresh_ms > 0:
-            log.info("fleet mode: PIO_MODEL_REFRESH_MS ignored — the "
-                     "fleet coordinator owns refresh (staged canary)")
+            log.warning(
+                "fleet mode: PIO_MODEL_REFRESH_MS=%.0f refused — the "
+                "fleet coordinator owns refresh (staged canary); "
+                "/status reports refreshMs: disabled(fleet)",
+                self.model_refresh_ms)
+            self._refresh_disabled = "fleet"
             self.model_refresh_ms = 0.0
 
     def _fleet_group(self) -> str:
@@ -560,6 +585,24 @@ class EngineServer:
             # rollback + swap-validation counters, refresh config
             "lifecycle": self.lifecycle_snapshot(),
         }
+        if self.foldin_ms > 0:
+            # online fold-in surface: cursor LSN, freshness lag,
+            # publish/rollback history (`pio status --engine-url`
+            # prints the freshness-lag line off this). lagSeconds is
+            # recomputed at READ time from the last caught-up anchor:
+            # the view snapshot freezes while a tick is WEDGED (hung
+            # storage), and serving its stale lag would disarm the
+            # staleness warn-marker in exactly that case
+            fv = self._foldin_view
+            if fv and fv.get("caughtUpAt"):
+                fv = {**fv, "lagSeconds": round(
+                    max(0.0, _time.time() - fv["caughtUpAt"]), 3)}
+            out["foldin"] = fv or {
+                "enabled": True, "ms": self.foldin_ms,
+                "producer": (not self.fleet_mode
+                             or self.fleet_replica == 0),
+                "events": 0, "publishes": 0, "lagSeconds": None,
+            }
         if self.fleet_mode:
             # store-fed fleet aggregation, cached by the sync loop (no
             # storage I/O on the status path): directive state, every
@@ -1291,7 +1334,12 @@ class EngineServer:
             "swaps": swaps,
             "validateFailures": validate_failures,
             "validate": self.swap_validate,
-            "refreshMs": self.model_refresh_ms,
+            # "disabled(fleet)" when the operator's knob was refused
+            # (the coordinator owns refresh) — a bare 0 here looked
+            # exactly like "never configured" and hid the reason
+            "refreshMs": (f"disabled({self._refresh_disabled})"
+                          if self._refresh_disabled
+                          else self.model_refresh_ms),
             "refreshSwaps": refresh_swaps,
             "watchMs": self.swap_watch_ms,
             "maxErrorRate": self.swap_max_error_rate,
@@ -1366,6 +1414,15 @@ class EngineServer:
             f"at {_dt.datetime.now(_dt.timezone.utc).isoformat()}; "
             f"{bad_inst.id} pinned until an operator reloads it "
             "explicitly")
+        try:
+            from . import online
+
+            # a poisoned fold-in rolling back counts on ITS family too,
+            # so operators can tell bad increments from bad retrains
+            if online.is_foldin_instance(bad_inst):
+                online.note_rollback(reason)
+        except Exception:  # noqa: BLE001 — accounting must not block it
+            pass
         log.warning("automatic rollback (%s): %s → %s; %s pinned",
                     reason, bad_inst.id, restored.id, bad_inst.id)
         return restored.id
@@ -1490,12 +1547,30 @@ class EngineServer:
 
     async def _refresh_once(self) -> None:
         candidate = await asyncio.to_thread(self._newer_candidate)
-        if candidate is None or self._reload_lock.locked():
+        if candidate is None:
             return
+        log.info("refresh: newer COMPLETED instance %s; validating "
+                 "hot swap", candidate.id)
+        if await self._publish_once("refresh") == "swapped":
+            with self._lock:
+                self._refresh_swaps += 1
+
+    async def _publish_once(self, source: str) -> str:
+        """THE publish-through-gate entry point — the ONE place a newer
+        COMPLETED instance becomes the served deployment outside an
+        operator /reload: validated load of the newest deployable
+        instance (skip-if-current), gate-refusal pin + degraded mode,
+        integrity-rejection pins, post-swap watch armed by the swap
+        itself. Shared by the continuous-refresh loop and the online
+        fold-in publisher (docs/operations.md "Online learning") so the
+        two paths cannot drift — duplicating the gate/watch/pin
+        sequence is exactly how they would. Returns "swapped" |
+        "current" | "busy" | "refused" | "error"."""
+        if self._reload_lock.locked():
+            return "busy"
         async with self._reload_lock:
-            log.info("refresh: newer COMPLETED instance %s; validating "
-                     "hot swap", candidate.id)
             rejected: list[tuple[str, str]] = []
+            result = "current"
             try:
                 swapped = await asyncio.to_thread(
                     self._load, None, True,
@@ -1505,24 +1580,31 @@ class EngineServer:
                     self._validate_failures += 1
                     self._pinned[e.instance_id] = "validate"
                 self._degraded_reason = (
-                    f"refresh: {e}; serving last-good model "
+                    f"{source}: {e}; serving last-good model "
                     f"({e.instance_id} pinned)")
-                log.warning("refresh swap refused: %s", e)
+                log.warning("%s swap refused: %s", source, e)
+                # a refused FOLD-IN increment counts on its family no
+                # matter which caller's gate caught it — the refresh
+                # loop can win the reload-lock race for an increment
+                # the fold-in tick committed a moment earlier
+                await asyncio.to_thread(self._count_foldin_refusal,
+                                        e.instance_id)
+                result = "refused"
             except Exception as e:  # noqa: BLE001 - stay on last-good
                 self._degraded_reason = (
-                    f"refresh reload failed at "
+                    f"{source} reload failed at "
                     f"{_dt.datetime.now(_dt.timezone.utc).isoformat()}: "
                     f"{e}; serving last-good model")
-                log.exception("refresh reload failed; continuing on "
-                              "last-good model")
+                log.exception("%s reload failed; continuing on "
+                              "last-good model", source)
+                result = "error"
             else:
                 if swapped:
-                    with self._lock:
-                        self._refresh_swaps += 1
+                    result = "swapped"
                 # the load SUCCEEDED — whether it swapped or confirmed
                 # the live instance is still the newest deployable, a
-                # degraded reason from an earlier transient refresh
-                # failure no longer describes reality
+                # degraded reason from an earlier transient failure no
+                # longer describes reality
                 self._degraded_reason = None
             # pin integrity-rejected candidates: a corrupt blob won't
             # heal, and without the pin every poll would re-walk (and
@@ -1530,8 +1612,125 @@ class EngineServer:
             for iid, kind in rejected:
                 with self._lock:
                     self._pinned.setdefault(iid, f"integrity:{kind}")
-                log.warning("refresh: pinned undeployable instance %s "
-                            "(%s)", iid, kind)
+                log.warning("%s: pinned undeployable instance %s "
+                            "(%s)", source, iid, kind)
+            return result
+
+    def _count_foldin_refusal(self, instance_id: str) -> None:
+        """Worker-thread classification of a gate-refused instance:
+        increments pio_foldin_rollbacks_total{validate} when the row
+        carries the fold-in provenance marker. Best-effort — metric
+        accounting must never fail a publish path."""
+        try:
+            from . import online
+
+            row = self.storage.get_meta_data_engine_instances().get(
+                instance_id)
+            if row is not None and online.is_foldin_instance(row):
+                online.note_rollback("validate")
+        except Exception:  # noqa: BLE001 — accounting only
+            log.debug("fold-in refusal classification failed",
+                      exc_info=True)
+
+    # -- streaming online fold-in (docs/operations.md "Online learning") --
+    async def _start_foldin(self, app) -> None:
+        if self.foldin_ms <= 0:
+            return
+        if self.fleet_mode and self.fleet_replica != 0:
+            # ONE producer per fleet: replica 0 commits increments and
+            # the coordinator canaries them to everyone (this replica
+            # included) — N replicas each folding the same events would
+            # race N duplicate instance rows into the store
+            log.info("fold-in: replica %d stands by — replica 0 is the "
+                     "fleet's fold-in producer", self.fleet_replica)
+            return
+        from . import online
+
+        runner = self._foldin_runner = online.FoldInRunner(
+            self.storage, self.engine_factory_name, self.engine_variant,
+            interval_ms=self.foldin_ms)
+        with self._lock:
+            instance = self.instance
+        if instance is not None:
+            # arm the cursor BEFORE the listen port opens: without a
+            # persisted cursor the tailer anchors at the log end, and
+            # anchoring on the first tick instead would skip events
+            # that land in the start→first-tick window
+            try:
+                await asyncio.to_thread(runner.arm, instance)
+            except Exception:  # noqa: BLE001 — first tick retries
+                log.exception("fold-in arm failed; first tick retries")
+        self._foldin_view = {**runner.view(), "producer": True}
+        self._foldin_task = asyncio.get_running_loop().create_task(
+            self._foldin_loop())
+
+    async def _stop_foldin(self, app) -> None:
+        task, self._foldin_task = self._foldin_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    async def _foldin_loop(self) -> None:
+        """Online fold-in (PIO_FOLDIN_MS > 0): tail the app's event
+        log, fold new events into a copy of the live models, commit the
+        increment as a new COMPLETED instance, and publish it through
+        the SAME gate as a retrain (fleet mode: leave publication to
+        the coordinator's staged canary). A failed tick is logged and
+        retried — the loop must never die, and the freshness-lag gauge
+        keeps growing until a tick lands."""
+        log.info("online fold-in loop armed (every %.0f ms%s)",
+                 self.foldin_ms,
+                 ", fleet producer" if self.fleet_mode else "")
+        while True:
+            await asyncio.sleep(self.foldin_ms / 1000.0)
+            try:
+                await self._foldin_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - tick errors never kill it
+                log.exception("fold-in tick failed; retrying next tick")
+
+    async def _foldin_once(self) -> None:
+        from . import online
+
+        with self._lock:
+            deployment, instance = self.deployment, self.instance
+            pinned = tuple(self._pinned)
+        if deployment is None or instance is None:
+            return
+        runner = self._foldin_runner
+        if runner is None:
+            runner = self._foldin_runner = online.FoldInRunner(
+                self.storage, self.engine_factory_name,
+                self.engine_variant, interval_ms=self.foldin_ms)
+        try:
+            view = await asyncio.to_thread(runner.run_once, deployment,
+                                           instance, pinned)
+        finally:
+            self._foldin_view = {**runner.view(), "producer": True}
+        produced = view.get("instance")
+        if self.fleet_mode:
+            if produced:
+                # the coordinator discovers the new COMPLETED row on
+                # its next tick and stages it as a CANARY; publishing
+                # locally would bypass the staged rollout (and be
+                # reverted by the next directive sync anyway)
+                log.info("fold-in: instance %s committed; awaiting the "
+                         "fleet coordinator's canary staging", produced)
+            return
+        if not produced and not view.get("pendingInstance"):
+            return
+        # produced this tick OR still pending from an earlier one (a
+        # busy gate / failed cursor persist must not strand a committed
+        # increment until the next event happens to arrive)
+        # gate refusals are classified + counted inside _publish_once
+        # (via the provenance marker), so refusals caught by the
+        # refresh loop's racing publish land on the same family
+        await self._publish_once("foldin")
+        self._foldin_view = {**runner.view(), "producer": True}
 
     def _newer_candidate(self):
         """Worker-thread poll: the newest non-pinned COMPLETED instance

@@ -273,6 +273,19 @@ def delete_model(storage, instance_id: str) -> None:
 #: ENGINE INSTANCES and never see these rows.
 FLEET_ROW_PREFIX = "__pio_fleet__"
 
+#: Reserved id prefix of the streaming fold-in cursor records
+#: (workflow/online.py): one row per (fleet group, app), single writer
+#: (the fold-in producer), same plain-JSON envelope-free shape as the
+#: fleet records above.
+FOLDIN_ROW_PREFIX = "__pio_foldin__"
+
+
+def foldin_row_id(group: str, app_id: int) -> str:
+    """Storage row id of one fold-in cursor record: the durable
+    LSN/byte cursor (plus freshness bookkeeping) the online-learning
+    tailer resumes from after a restart."""
+    return f"{FOLDIN_ROW_PREFIX}{group}__a{int(app_id)}"
+
 
 def newer_completed_instance(instances, engine_factory_name: str,
                              engine_variant: str, current,

@@ -1,0 +1,462 @@
+"""Streaming online learning: log-tailing fold-in with gated publish.
+
+ROADMAP item 2, the TensorFlow unified-train/serve argument (arxiv
+1605.08695) applied to this stack: PR 9 made model refresh *safe*
+(validation gate, post-swap watch, rollback + pin) and PR 12 made it
+*fleet-aware* (staged canary), but what refreshed was still a full
+retrain — a new user's first events did nothing until the next
+`pio train`. This module closes the gap incrementally:
+
+1. **Tail** the deployed app's partitioned event log through a durable
+   byte cursor (``data/api/log_tail.py`` — O(new bytes), colseg-seeded
+   cold reads, restart-resumable via a reserved Models-DAO row).
+2. **Fold** the new events into a COPY of the live models through each
+   algorithm's ``fold_in`` hook (closed-form per-user/per-item ridge
+   against fixed opposite-side factors for ALS — arxiv 2112.02194's
+   fold-in recipe on ``ops/als.py``'s gram/solve kernels; exact
+   count increments for NB; online SGD for LR).
+3. **Commit** the increment as a brand-new COMPLETED engine instance —
+   checksummed envelope via ``model_artifact.write_model``, provenance
+   (source instance, event count, LSN) in ``runtime_conf["foldin"]`` —
+   so the increment is indistinguishable from a retrain to every
+   consumer downstream.
+4. **Publish through the SAME gate as a retrain.** Single-server mode:
+   the engine server's shared publish-through-gate path (the PR 9
+   validate → swap → watch → rollback+pin sequence — one entry point,
+   ``EngineServer._publish_once``, shared with the refresh loop so the
+   two can never drift). Fleet mode: the producer (replica 0) only
+   commits the instance row; PR 12's coordinator discovers it as "a
+   newer COMPLETED instance" and stages it as a CANARY — a poisoned
+   fold-in burns one replica's watch window, pins, and the fleet never
+   serves it.
+
+Delivery semantics are **at-least-once**: the cursor commits AFTER the
+increment's instance row, so a crash anywhere in between re-folds the
+same events on restart (for ALS the proximal re-solve makes a
+double-fold a mild re-weighting, for NB a double-count — both bounded
+by one increment and strictly better than losing events; exactly-once
+would need a transactional store the DAO contract doesn't offer).
+While an increment's publication is DEFERRED (fleet canary staging, a
+busy local gate), the next increment CHAINS onto it instead of the
+served model — otherwise each increment would be built from the stale
+base and the earlier batches' events would vanish the moment the
+newest one publishes. A chain through a pinned link is dropped whole
+(poison containment: those batches are consumed, the next increment
+folds into the served last-good).
+
+Chaos surface: fault points ``foldin.read`` (before the tail read),
+``foldin.apply`` (before the fold), ``foldin.publish`` (after the
+model blob lands, before the COMPLETED stamp — ``crash`` mode here is
+the mid-publish SIGKILL the harness uses to prove cursor + store stay
+resumable). Telemetry: ``pio_foldin_events_total``,
+``pio_foldin_publishes_total``, ``pio_foldin_rollbacks_total{reason}``
+and the ``pio_foldin_freshness_lag_seconds`` gauge. All documented in
+docs/operations.md "Online learning".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import os
+import socket
+import time
+from typing import Optional
+
+from ..common import faultinject, telemetry
+from ..data.api.log_tail import LogCursor, LogTailer
+from ..data.storage.event import new_event_id
+from . import model_artifact
+from .context import WorkflowContext
+
+log = logging.getLogger("pio.foldin")
+
+__all__ = ["FoldInRunner", "cursor_docs", "is_foldin_instance",
+           "note_rollback"]
+
+_M_EVENTS = telemetry.registry().counter(
+    "pio_foldin_events_total",
+    "Events read from the log tail by the online fold-in loop").labels()
+_M_PUBLISHES = telemetry.registry().counter(
+    "pio_foldin_publishes_total",
+    "Fold-in increments committed as new COMPLETED engine "
+    "instances").labels()
+_M_ROLLBACKS = telemetry.registry().counter(
+    "pio_foldin_rollbacks_total",
+    "Fold-in increments refused or rolled back through the model "
+    "lifecycle (validate = gate refusal, error-rate = post-swap watch "
+    "breach, plus any manual/fleet pin reason)", ("reason",))
+_M_LAG = telemetry.registry().gauge(
+    "pio_foldin_freshness_lag_seconds",
+    "Seconds since the fold-in view last caught up with the event log "
+    "(grows while the loop is failing or falling behind)").labels()
+
+
+def is_foldin_instance(instance) -> bool:
+    """Whether this engine-instance row was produced by a fold-in
+    increment (the provenance marker `_commit_increment` writes)."""
+    try:
+        return bool((instance.runtime_conf or {}).get("foldin"))
+    except Exception:  # noqa: BLE001 — classification only
+        return False
+
+
+def note_rollback(reason: str) -> None:
+    """Count one fold-in increment refused/rolled back (called by the
+    engine server's gate + watch paths when the pinned instance carries
+    the fold-in provenance marker)."""
+    _M_ROLLBACKS.labels(reason).inc()
+
+
+class FoldInRunner:
+    """One app's fold-in producer. Owned by the engine server's fold-in
+    loop and driven from a worker thread (``asyncio.to_thread``) —
+    single-flight by construction (only the loop schedules it), so its
+    state needs no lock; the loop publishes a snapshot dict for
+    /status after every tick."""
+
+    def __init__(self, storage, engine_factory_name: str,
+                 engine_variant: str, interval_ms: float = 0.0):
+        self.storage = storage
+        self.engine_factory_name = engine_factory_name
+        self.engine_variant = engine_variant
+        self.interval_ms = float(interval_ms)
+        self.group = model_artifact.fleet_group(engine_factory_name,
+                                                engine_variant)
+        self._tailer: Optional[LogTailer] = None
+        self._cursor: Optional[LogCursor] = None
+        self._app_id: Optional[int] = None
+        self._app_name: Optional[str] = None
+        self._disabled: Optional[str] = None
+        self._caught_up_at: Optional[float] = None
+        self._events = 0
+        self._publishes = 0
+        self._last_instance: Optional[str] = None
+        self._last_error: Optional[str] = None
+        # increment chain: the last committed increment while its
+        # publication is still DEFERRED (fleet canary staging, a busy
+        # local gate). Folding every tick into the *served* models
+        # instead would base each increment on the stale pre-chain
+        # model and silently drop the earlier batches' events once the
+        # newest increment publishes. (tip_id, ancestor_ids, models):
+        # ancestor_ids = the original served base plus every superseded
+        # link — any of them legitimately serving means the chain is
+        # merely lagging publication, not invalidated.
+        self._pending: Optional[tuple] = None
+
+    # -- status surface ---------------------------------------------------
+    def view(self) -> dict:
+        now = time.time()
+        lag = (now - self._caught_up_at
+               if self._caught_up_at is not None else None)
+        return {
+            # raw anchor rides along so /status can recompute the lag
+            # at READ time: a wedged tick freezes this snapshot, and a
+            # frozen lagSeconds would hide exactly the wedge the
+            # staleness warn-marker exists for
+            "caughtUpAt": self._caught_up_at,
+            # a committed increment still awaiting publication: the
+            # fold-in loop retries its publish on EVERY tick (not just
+            # event-bearing ones — a busy gate on the last event before
+            # traffic goes quiet must not strand the increment)
+            "pendingInstance": (self._pending[0]
+                                if self._pending is not None else None),
+            "enabled": self._disabled is None,
+            "disabledReason": self._disabled,
+            "ms": self.interval_ms,
+            "group": self.group,
+            "app": self._app_name,
+            "appId": self._app_id,
+            "cursorBytes": (self._cursor.total()
+                            if self._cursor is not None else None),
+            "cursorShards": (len(self._cursor.shards)
+                             if self._cursor is not None else 0),
+            "cursorResets": (self._cursor.resets
+                             if self._cursor is not None else 0),
+            "events": self._events,
+            "publishes": self._publishes,
+            "lagSeconds": round(lag, 3) if lag is not None else None,
+            "lastInstance": self._last_instance,
+            "lastError": self._last_error,
+        }
+
+    # -- bootstrap --------------------------------------------------------
+    def arm(self, instance) -> bool:
+        """Eager arming at server startup (BEFORE the listen port
+        opens): the no-persisted-cursor case anchors at the log end,
+        and anchoring lazily on the first tick instead would silently
+        skip every event that lands in the start→first-tick window —
+        exactly the new-user cold-start events this subsystem exists
+        for. The armed cursor is persisted immediately: a crash inside
+        the very first tick must still find a durable position to
+        resume from."""
+        if not self._arm(instance):
+            return False
+        try:
+            self._persist_cursor(time.time())
+        except Exception:  # noqa: BLE001 — first tick re-persists
+            log.warning("fold-in: could not persist the armed cursor; "
+                        "first tick retries", exc_info=True)
+        return True
+
+    def _arm(self, instance) -> bool:
+        """Resolve the app + events dir + persisted cursor once (and
+        again whenever the served instance's app changes). False =
+        fold-in structurally unavailable on this deployment; the
+        reason lands on /status instead of a crash-looping tick."""
+        le = self.storage.get_l_events()
+        events_dir = getattr(le, "events_dir", None)
+        if not events_dir:
+            self._disabled = ("event store is not a JSONL event log "
+                              "(fold-in tails log files; TYPE=JSONL)")
+            return False
+        app_name = ((instance.env or {}).get("appName")
+                    or self._ds_params(instance).get("app_name")
+                    or self._ds_params(instance).get("appName") or "")
+        if not app_name:
+            self._disabled = ("deployed instance names no app "
+                             "(env.appName / data-source appName)")
+            return False
+        app = self.storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            self._disabled = f"app {app_name!r} is not registered"
+            return False
+        if self._app_id == app.id and self._tailer is not None:
+            return True
+        self._app_id, self._app_name = app.id, app_name
+        self._tailer = LogTailer(events_dir, app.id)
+        self._cursor = None
+        doc = model_artifact.read_fleet_doc(
+            self.storage, model_artifact.foldin_row_id(self.group,
+                                                       app.id))
+        if doc is not None:
+            try:
+                self._cursor = LogCursor.from_json(doc.get("cursor"))
+                log.info("fold-in resuming app %r at LSN %d (%d "
+                         "shard(s))", app_name, self._cursor.total(),
+                         len(self._cursor.shards))
+            except ValueError:
+                log.warning("fold-in cursor record for app %r is "
+                            "damaged; re-arming at the log end",
+                            app_name, exc_info=True)
+        if self._cursor is None:
+            # first arm: the deployed model was trained on everything
+            # already in the log — only FUTURE events are news
+            self._cursor = self._tailer.end_cursor()
+            log.info("fold-in armed for app %r at the current log end "
+                     "(LSN %d)", app_name, self._cursor.total())
+        self._disabled = None
+        return True
+
+    @staticmethod
+    def _ds_params(instance) -> dict:
+        try:
+            doc = json.loads(instance.data_source_params or "{}")
+            return doc if isinstance(doc, dict) else {}
+        except ValueError:
+            return {}
+
+    def _persist_cursor(self, now: float) -> None:
+        model_artifact.write_fleet_doc(
+            self.storage,
+            model_artifact.foldin_row_id(self.group, self._app_id),
+            {
+                "cursor": self._cursor.to_json(),
+                "group": self.group,
+                "appId": self._app_id,
+                "app": self._app_name,
+                "intervalMs": self.interval_ms,
+                "updatedAt": now,
+                "caughtUpAt": self._caught_up_at,
+                "events": self._events,
+                "publishes": self._publishes,
+                "pid": os.getpid(),
+            })
+
+    def _chain_base(self, instance, pinned) -> Optional[list]:
+        """Models the NEXT increment folds into, when the last one is
+        still awaiting publication — else None (fold into the served
+        deployment). Chain resolution per tick:
+
+        - served == last increment → published; chain done
+        - last increment pinned (gate refusal / watch rollback) → the
+          chain carried poison; drop it and fold into the served
+          last-good (self-heal; the poisoned batches are consumed —
+          exactly the retrain-poisoning containment semantics)
+        - served still == the chain's base → deferred (canary staging,
+          busy gate); keep chaining so earlier batches are not lost
+        - served moved somewhere else entirely (operator reload, a
+          racing retrain promoted, fleet rollback) → the chain's base
+          is stale; drop it with a warning (one-chain loss in a rare
+          race beats publishing increments of a superseded model)
+        """
+        pend = self._pending
+        if pend is None:
+            return None
+        pend_id, ancestors, models = pend
+        if instance.id == pend_id:
+            self._pending = None
+            return None
+        if pend_id in pinned or any(a in pinned for a in ancestors):
+            log.warning("fold-in: increment chain through %s carried a "
+                        "pinned link; dropping it and folding into the "
+                        "served last-good", pend_id)
+            self._pending = None
+            return None
+        if instance.id in ancestors:
+            # an ancestor link (or the original base) is serving: the
+            # chain is lagging publication — e.g. the coordinator just
+            # promoted an older link while we kept committing newer
+            # ones — keep chaining from the tip
+            return models
+        log.warning("fold-in: served instance moved to %s while "
+                    "increment %s awaited publication; resetting the "
+                    "chain onto the new deployment", instance.id,
+                    pend_id)
+        self._pending = None
+        return None
+
+    # -- one tick ---------------------------------------------------------
+    def run_once(self, deployment, instance, pinned=()) -> dict:
+        """Worker-thread tick: read → fold → commit → persist cursor.
+        Returns the /status view, with ``"instance"`` set when an
+        increment was committed (the caller decides how it publishes:
+        local gate vs fleet coordinator). ``pinned`` is the server's
+        current pin set — how the chain learns its last increment was
+        refused/rolled back. Raises on injected/storage faults — the
+        loop logs and retries next tick, and the lag gauge keeps
+        growing until a tick succeeds."""
+        try:
+            if not self._arm(instance):
+                return self.view()
+            faultinject.fault_point("foldin.read")
+            batch = self._tailer.read_since(self._cursor)
+            produced = None
+            if batch.events:
+                faultinject.fault_point("foldin.apply")
+                produced = self._fold_and_commit(deployment, instance,
+                                                 batch, set(pinned))
+            else:
+                # no new events: still resolve the chain so a promoted
+                # or pinned increment is observed promptly
+                self._chain_base(instance, set(pinned))
+            now = time.time()
+            # count events only once the cursor commits past them: a
+            # tick that faults at apply/publish re-reads the same
+            # batch next tick, and counting per read would inflate
+            # the counter by batch-size per retry
+            self._events += len(batch.events)
+            _M_EVENTS.inc(len(batch.events))
+            self._cursor = batch.cursor
+            self._caught_up_at = now
+            _M_LAG.set(0.0)
+            self._persist_cursor(now)
+            self._last_error = None
+            out = self.view()
+            if produced:
+                out["instance"] = produced
+            return out
+        except Exception as e:
+            self._last_error = str(e)
+            if self._caught_up_at is not None:
+                _M_LAG.set(time.time() - self._caught_up_at)
+            raise
+
+    def _fold_and_commit(self, deployment, instance, batch,
+                         pinned) -> Optional[str]:
+        ds_params = self._ds_params(instance)
+        ctx = WorkflowContext(app_name=self._app_name or "",
+                              storage=self.storage)
+        ctx.engine_instance_id = instance.id
+        chain = self._chain_base(instance, pinned)
+        if chain is not None:
+            base_models = chain
+            base_id = self._pending[0]
+            ancestors = self._pending[1] | {self._pending[0]}
+        else:
+            base_models = deployment.models
+            base_id = instance.id
+            ancestors = {instance.id}
+        new_models, changed = [], False
+        for (_name, algo), model in zip(deployment.algo_list,
+                                        base_models):
+            out = algo.fold_in(model, batch.events, ctx,
+                               data_source_params=ds_params)
+            new_models.append(model if out is None else out)
+            changed = changed or out is not None
+        if not changed:
+            return None
+        iid = self._commit_increment(instance, deployment.algo_list,
+                                     new_models, len(batch.events),
+                                     batch.cursor)
+        self._pending = (iid, ancestors, new_models)
+        self._publishes += 1
+        self._last_instance = iid
+        _M_PUBLISHES.inc()
+        log.info("fold-in: %d event(s) folded into %s -> new instance "
+                 "%s (LSN %d)", len(batch.events), base_id, iid,
+                 batch.cursor.total())
+        return iid
+
+    def _commit_increment(self, instance, algo_list, models,
+                          n_events: int, cursor: LogCursor) -> str:
+        """Persist one increment exactly like a retrain does: instance
+        row RUNNING → model blob (checksummed envelope, ``model.insert``
+        fault point inside) → ``foldin.publish`` fault point →
+        COMPLETED stamp. A SIGKILL before the stamp leaves a RUNNING
+        row no loader will ever serve, and the cursor (committed only
+        after this returns) re-folds the same events on restart."""
+        from .core_workflow import serialize_models
+
+        instances = self.storage.get_meta_data_engine_instances()
+        now = _dt.datetime.now(_dt.timezone.utc)
+        row = dataclasses.replace(
+            instance,
+            id=new_event_id(),
+            status="RUNNING",
+            start_time=now,
+            end_time=None,
+            runtime_conf={
+                **(instance.runtime_conf or {}),
+                "foldin": json.dumps({
+                    "of": instance.id,
+                    "events": n_events,
+                    "lsn": cursor.total(),
+                }),
+            },
+            env={**(instance.env or {}), "pid": str(os.getpid()),
+                 "host": socket.gethostname()},
+        )
+        instances.insert(row)
+        blob = serialize_models(algo_list, models)
+        model_artifact.write_model(self.storage, row.id, blob)
+        faultinject.fault_point("foldin.publish")
+        instances.update(row.with_status("COMPLETED", _dt.datetime.now(
+            _dt.timezone.utc)))
+        return row.id
+
+
+def cursor_docs(storage) -> list[dict]:
+    """Every persisted fold-in cursor record, for `pio status`: probe
+    the (fleet group × registered app) combinations the metadata knows
+    about — the DAO contract has no row scan, and these ids are
+    deterministic. Degrades to [] when any repository is unreachable
+    (a health surface must not crash)."""
+    out: list[dict] = []
+    try:
+        instances = storage.get_meta_data_engine_instances().get_all()
+        groups = {model_artifact.fleet_group(
+            i.engine_factory or i.engine_id, i.engine_variant)
+            for i in instances}
+        apps = storage.get_meta_data_apps().get_all()
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return out
+    for group in sorted(groups):
+        for app in apps:
+            doc = model_artifact.read_fleet_doc(
+                storage, model_artifact.foldin_row_id(group, app.id))
+            if doc is not None:
+                out.append({**doc, "app": doc.get("app") or app.name})
+    return out

@@ -1,0 +1,114 @@
+"""A tiny jax-free DASE engine for the online fold-in chaos harness
+(tests/test_online_foldin.py + tests/foldin_server.py).
+
+The model is a per-user score table learned from "rate" events; its
+``fold_in`` merges new events into a COPY — the minimal honest
+implementation of the streaming-online-learning contract
+(workflow/online.py), fast enough to e2e in tier-1.
+
+Poison arrives THROUGH THE DATA, which is exactly the production
+threat model for fold-in (a retrain is poisoned by bad code or bad
+hyperparameters; a fold-in is poisoned by bad events):
+
+- a ``poison-nan`` event makes the folded model carry a NaN weight —
+  the swap validation gate's nan_guard must refuse the increment
+- a ``poison-serve`` event makes the folded model pass the gate (the
+  golden query "golden" still answers, arrays finite) but raise on
+  every other user — the post-swap watch must roll it back
+
+Both the test process and the subprocess server import this module by
+name, so pickled models round-trip across processes."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from incubator_predictionio_tpu.controller.algorithm import Algorithm
+from incubator_predictionio_tpu.controller.datasource import DataSource
+from incubator_predictionio_tpu.controller.engine import Engine
+
+
+@dataclasses.dataclass
+class FoldinModel:
+    scores: dict           # user id -> accumulated rating
+    weights: np.ndarray    # finite unless nan-poisoned
+    poison: str = ""       # "" | "serve"
+
+    def example_query(self):
+        # the warm-up / probe / swap-gate golden-query protocol
+        return {"user": "golden"}
+
+
+class FoldinDataSource(DataSource):
+    def read_training(self, ctx):
+        s = ctx.get_storage()
+        app = (s.get_meta_data_apps().get_by_name(ctx.app_name)
+               if ctx.app_name else None)
+        return list(s.get_l_events().find(app.id)) if app else []
+
+
+class FoldinAlgorithm(Algorithm):
+    def train(self, ctx, events):
+        scores: dict = {}
+        for e in events:
+            if e.event == "rate" and e.entity_id:
+                r = float(e.properties.get_or_else("rating", 1.0))
+                scores[e.entity_id] = scores.get(e.entity_id, 0.0) + r
+        return FoldinModel(scores=scores, weights=np.ones(3))
+
+    def predict(self, model, query):
+        user = str(query["user"])
+        if model.poison == "serve" and user != "golden":
+            raise RuntimeError("poisoned fold-in: predict exploded")
+        if user == "golden" or user in model.scores:
+            return {"user": user, "known": True,
+                    "score": float(model.scores.get(user, 0.0)),
+                    "poison": model.poison}
+        return {"user": user, "known": False}
+
+    def fold_in(self, model, events, ctx, data_source_params=None):
+        scores = dict(model.scores)
+        weights = model.weights
+        poison = model.poison
+        changed = False
+        for e in events:
+            name = e.get("event")
+            uid = e.get("entityId")
+            if name == "poison-nan":
+                weights = np.array([1.0, float("nan")])
+                changed = True
+            elif name == "poison-serve":
+                poison = "serve"
+                changed = True
+            elif name == "rate" and uid:
+                props = e.get("properties") or {}
+                try:
+                    r = float(props.get("rating", 1.0))
+                except (TypeError, ValueError):
+                    r = 1.0
+                scores[str(uid)] = scores.get(str(uid), 0.0) + r
+                changed = True
+        if not changed:
+            return None
+        return FoldinModel(scores=scores, weights=weights, poison=poison)
+
+    # no jax: the pickled payload is the model itself
+    def prepare_model_for_persistence(self, model):
+        return model
+
+    def restore_model(self, stored, ctx):
+        return stored
+
+
+def engine_factory() -> Engine:
+    return Engine(FoldinDataSource, None, {"": FoldinAlgorithm}, None)
+
+
+def engine_params(app_name: str = "foldapp"):
+    from incubator_predictionio_tpu.controller.engine import EngineParams
+
+    return EngineParams(
+        data_source_params={"appName": app_name},
+        algorithm_params_list=[("", {})])

@@ -1,0 +1,42 @@
+"""Engine-server subprocess for the online fold-in e2e harness
+(tests/test_online_foldin.py).
+
+Runs the REAL `run_engine_server` against the storage configured in
+the inherited environment (SQLITE metadata/models + JSONL events),
+serving the jax-free fold-in engine (tests/foldin_engine.py) with the
+fold-in loop armed through the SAME knobs production uses
+(PIO_FOLDIN_MS, PIO_SWAP_WATCH_MS, PIO_SWAP_MAX_ERROR_RATE,
+PIO_FAULT_SPEC for the chaos runs).
+
+Usage: python foldin_server.py <port>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    import logging
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(levelname)s %(name)s %(message)s")
+    logging.getLogger("aiohttp.access").setLevel(logging.WARNING)
+    port = int(sys.argv[1])
+    import foldin_engine
+
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.workflow.create_server import (
+        EngineServer, run_engine_server)
+
+    server = EngineServer(foldin_engine.engine_factory(),
+                          engine_factory_name="foldin",
+                          storage=Storage.instance())
+    run_engine_server(server, "127.0.0.1", port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,900 @@
+"""Streaming online-learning chaos harness (ISSUE 13).
+
+A new user's first event must change what they are served within
+seconds — WITHOUT a retrain — and a poisoned fold-in must be exactly
+as survivable as a poisoned retrain:
+
+- the log tailer's durable byte cursor reads O(new bytes), survives
+  restarts, discovers new shards, seeds cold reads from colseg
+  snapshots and resets (counted) past log rewrites
+- ALS closed-form ridge fold-in matches the hand-solved normal
+  equations; NB fold-in is EXACTLY a retrain on old∪new; LR SGD moves
+  toward the new labels
+- the cold-start headline runs in-process AND as a REAL subprocess
+  server over SQLITE+JSONL (the e2e acceptance), with every client
+  query answered 200 while a gate-passing poisoned increment is
+  rolled back + pinned by the PR 9 watch path and a NaN increment is
+  refused by the validation gate
+- `foldin.publish:crash:1` SIGKILLs the producer mid-publish and the
+  restarted server resumes from the persisted cursor (at-least-once)
+- `foldin.read`/`foldin.apply` faults fail one tick, never the loop
+- fleet mode: replica 0 produces increments but never self-publishes
+  (the coordinator's canary owns rollout), non-0 replicas stand by,
+  and the refused PIO_MODEL_REFRESH_MS knob surfaces as
+  `refreshMs: disabled(fleet)`
+- `pio eventlog tail` and the `pio status` fold-in cursor lines
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import foldin_engine
+from incubator_predictionio_tpu.common import faultinject
+from incubator_predictionio_tpu.data.api.log_tail import (
+    LogCursor, LogTailer)
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import App
+from incubator_predictionio_tpu.data.storage.datamap import DataMap
+from incubator_predictionio_tpu.data.storage.event import Event
+from incubator_predictionio_tpu.workflow import model_artifact, online
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import run_train
+from incubator_predictionio_tpu.workflow.create_server import EngineServer
+
+from server_utils import ServerThread, free_port
+
+pytestmark = [pytest.mark.foldin, pytest.mark.chaos]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture()
+def chaos(monkeypatch):
+    def arm(spec):
+        monkeypatch.setenv("PIO_FAULT_SPEC", spec)
+        faultinject.reset()
+    yield arm
+    monkeypatch.delenv("PIO_FAULT_SPEC", raising=False)
+    faultinject.reset()
+
+
+def _mixed_storage(tmp_path):
+    """In-process storage shaped like production fold-in: memory
+    metadata/models + a real JSONL event log the tailer can read."""
+    return Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "JL",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_JL_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_JL_PATH": str(tmp_path / "events"),
+    })
+
+
+def _subprocess_env(tmp_path, **extra):
+    env = {
+        **os.environ,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "JL",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "meta.sqlite"),
+        "PIO_STORAGE_SOURCES_JL_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_JL_PATH": str(tmp_path / "events"),
+        # keep the jax-free subprocesses jax-free
+        "PIO_COMPILATION_CACHE": "0",
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.pop("PIO_FAULT_SPEC", None)
+    env.update(extra)
+    return env
+
+
+def _storage_for(env):
+    return Storage({k: v for k, v in env.items()
+                    if k.startswith("PIO_STORAGE")})
+
+
+def _mk_app(storage, name="foldapp") -> int:
+    return storage.get_meta_data_apps().insert(App(id=0, name=name))
+
+
+def _rate(le, app_id, user, item="i0", rating=1.0, event="rate"):
+    le.insert(Event(event=event, entity_type="user", entity_id=user,
+                    target_entity_type="item", target_entity_id=item,
+                    properties=DataMap({"rating": rating})), app_id)
+
+
+def _train(storage, app="foldapp"):
+    ctx = WorkflowContext(app_name=app, storage=storage)
+    iid = run_train(foldin_engine.engine_factory(),
+                    foldin_engine.engine_params(app), ctx,
+                    engine_factory_name="foldin")
+    time.sleep(0.002)   # strictly ordered start_times
+    return iid
+
+
+def _query(base, user, timeout=30):
+    return requests.post(base + "/queries.json", json={"user": user},
+                         timeout=timeout)
+
+
+def _wait(fn, deadline_s=15.0, interval=0.05):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+# ---------------------------------------------------------------------------
+# log tailer: durable cursor semantics
+# ---------------------------------------------------------------------------
+
+def test_cursor_incremental_reads_and_roundtrip(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    for i in range(3):
+        _rate(le, app_id, f"u{i}", rating=float(i))
+    tailer = LogTailer(le.events_dir, app_id)
+    b1 = tailer.read_since(None)
+    assert [e["entityId"] for e in b1.events] == ["u0", "u1", "u2"]
+    assert b1.cursor.total() == b1.bytes_read > 0
+    # O(new bytes): the next read sees only the new event
+    _rate(le, app_id, "newbie", rating=5.0)
+    b2 = tailer.read_since(b1.cursor)
+    assert [e["entityId"] for e in b2.events] == ["newbie"]
+    assert b2.bytes_read < b1.bytes_read
+    # durable round trip through JSON
+    again = LogCursor.from_json(json.loads(
+        json.dumps(b2.cursor.to_json())))
+    assert tailer.read_since(again).events == []
+    # caught-up lag is zero; behind-cursor lag counts the gap
+    assert tailer.lag_bytes(b2.cursor) == 0
+    assert tailer.lag_bytes(b1.cursor) == b2.bytes_read
+    # end_cursor skips everything so far
+    _rate(le, app_id, "後", rating=1.0)   # non-ascii survives the trip
+    end = tailer.end_cursor()
+    assert tailer.read_since(end).events == []
+    # damaged cursors surface loudly
+    with pytest.raises(ValueError):
+        LogCursor.from_json({"shards": "nope"})
+    # a tombstone append is not an event
+    eid = b1.events[0]["eventId"]
+    le.delete_batch([eid], app_id)
+    assert tailer.read_since(end).events == []
+    # bounded pagination: chunked reads cover exactly the same events
+    paged, cur = [], None
+    while True:
+        chunk = tailer.read_since(cur, max_bytes=300)
+        paged.extend(chunk.events)
+        cur = chunk.cursor
+        if chunk.bytes_read == 0:
+            break
+    assert [e["eventId"] for e in paged] == \
+        [e["eventId"] for e in tailer.read_since(None).events]
+
+
+def test_cursor_new_shard_snapshot_seed_and_rewrite_reset(tmp_path):
+    from incubator_predictionio_tpu.data.api import event_log
+    from incubator_predictionio_tpu.data.storage.jsonl import shard_paths
+
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    for i in range(4):
+        _rate(le, app_id, f"u{i}")
+    tailer = LogTailer(le.events_dir, app_id)
+    cur = tailer.read_since(None).cursor
+    # a NEW shard appears (a partitioned worker's log): discovered on
+    # the next poll and read from its beginning
+    base = shard_paths(le.events_dir, app_id)[0]
+    shard = base[:-6] + ".p0.jsonl"
+    doc = {"eventId": "e-shard", "event": "rate", "entityType": "user",
+           "entityId": "shardy", "targetEntityType": "item",
+           "targetEntityId": "i9", "properties": {"rating": 2.0},
+           "eventTime": "2026-01-01T00:00:00.000Z"}
+    with open(shard, "w") as f:
+        f.write(json.dumps(doc) + "\n")
+    b = tailer.read_since(cur)
+    assert [e["entityId"] for e in b.events] == ["shardy"]
+    cur = b.cursor
+    assert len(cur.shards) == 2
+    # cold reads seed from the committed colseg snapshot
+    assert event_log.compact_log(base) is not None
+    cold = LogTailer(le.events_dir, app_id).read_since(None)
+    assert cold.snapshot_seeded
+    assert [e["entityId"] for e in cold.events][:4] == \
+        ["u0", "u1", "u2", "u3"]
+    # a log REWRITE (tombstone compaction) shrinks a clean single-shard
+    # log: the cursor resets past it, counted, instead of mis-framing
+    # records mid-file
+    app2 = storage.get_meta_data_apps().insert(App(id=0, name="app2"))
+    for i in range(4):
+        _rate(le, app2, f"w{i}")
+    t2 = LogTailer(le.events_dir, app2)
+    b1 = t2.read_since(None)
+    le.delete_batch([b1.events[0]["eventId"]], app2)
+    le.compact(app2)
+    b2 = t2.read_since(b1.cursor)
+    assert b2.cursor.resets == 1
+    assert t2.read_since(b2.cursor).events == []
+
+
+# ---------------------------------------------------------------------------
+# fold-in math
+# ---------------------------------------------------------------------------
+
+def test_als_fold_in_matches_hand_solved_ridge():
+    from incubator_predictionio_tpu.controller.base import doer
+    from incubator_predictionio_tpu.data.storage.bimap import BiMap
+    from incubator_predictionio_tpu.models.recommendation import (
+        ALSAlgorithm, ALSModel)
+    from incubator_predictionio_tpu.ops.als import (
+        ALSFactors, fold_in_factors)
+
+    rng = np.random.default_rng(7)
+    k = 4
+    Y = rng.normal(size=(6, k)).astype(np.float32)
+    # kernel vs hand-built normal equations (new row, zero anchor)
+    out = fold_in_factors(
+        Y, [np.array([1, 3])], [np.array([5.0, 2.0], np.float32)],
+        reg=0.1, anchor=np.zeros((1, k)), anchor_weight=1.0)
+    ys = Y[[1, 3]]
+    ref = np.linalg.solve(
+        ys.T @ ys + (0.1 + 1.0) * np.eye(k, dtype=np.float32),
+        ys.T @ np.array([5.0, 2.0], np.float32))
+    assert np.allclose(out[0], ref, atol=1e-5)
+    # NO anchor = NO proximal term: the defaults must solve the plain
+    # ridge, not silently add a phantom +anchor_weight to the diagonal
+    bare = fold_in_factors(Y, [np.array([1, 3])],
+                           [np.array([5.0, 2.0], np.float32)], reg=0.1)
+    ref_bare = np.linalg.solve(
+        ys.T @ ys + 0.1 * np.eye(k, dtype=np.float32),
+        ys.T @ np.array([5.0, 2.0], np.float32))
+    assert np.allclose(bare[0], ref_bare, atol=1e-5)
+    # implicit mode carries the shared YtY + confidence weights
+    out_i = fold_in_factors(
+        Y, [np.array([1, 3])], [np.array([5.0, 2.0], np.float32)],
+        reg=0.1, implicit_prefs=True, alpha=2.0, anchor_weight=0.0)
+    cw = 1 + 2.0 * np.array([5.0, 2.0], np.float32)
+    a_i = Y.T @ Y + (ys * (cw - 1)[:, None]).T @ ys + 0.1 * np.eye(k)
+    assert np.allclose(out_i[0], np.linalg.solve(a_i, ys.T @ cw),
+                       atol=1e-4)
+
+    # template fold_in: new user appears, originals untouched
+    algo = doer(ALSAlgorithm, {"rank": k, "lambda": 0.1})
+    model = ALSModel(
+        factors=ALSFactors(rng.normal(size=(3, k)).astype(np.float32),
+                           Y, 3, 6),
+        users=BiMap.string_int([f"u{i}" for i in range(3)]),
+        items=BiMap.string_int([f"i{i}" for i in range(6)]))
+    events = [
+        {"event": "rate", "entityId": "newbie", "targetEntityId": "i1",
+         "properties": {"rating": 5.0}},
+        {"event": "buy", "entityId": "u0", "targetEntityId": "i2",
+         "properties": {}},
+        {"event": "view", "entityId": "u1", "targetEntityId": "i4",
+         "properties": {}},      # not an event_name: ignored
+    ]
+    m2 = algo.fold_in(model, events, None,
+                      data_source_params={"appName": "x"})
+    assert "newbie" in m2.users and len(m2.users) == 4
+    assert "newbie" not in model.users            # copy, not mutation
+    assert m2.factors.user_factors.shape == (4, k)
+    assert not np.allclose(m2.factors.item_factors[1], Y[1])
+    assert np.allclose(m2.factors.item_factors[5], Y[5])
+    # the NEW user's factor is the EXACT cold-start ridge against the
+    # updated item side — reg only, no proximal term toward the
+    # meaningless zero anchor of a row that never had a factor
+    y1 = m2.factors.item_factors[1]
+    exp = np.linalg.solve(
+        np.outer(y1, y1) + 0.1 * np.eye(k, dtype=np.float32), 5.0 * y1)
+    assert np.allclose(m2.factors.user_factors[m2.users("newbie")], exp,
+                       atol=1e-4)
+    # nothing applicable -> None
+    assert algo.fold_in(model, [{"event": "view", "entityId": "a",
+                                 "targetEntityId": "b"}], None) is None
+
+
+def test_nb_fold_in_exact_and_lr_sgd_moves():
+    from incubator_predictionio_tpu.controller.base import doer
+    from incubator_predictionio_tpu.models.classification import (
+        LogisticRegressionAlgorithm, NaiveBayesAlgorithm)
+    from incubator_predictionio_tpu.ops.linear import train_naive_bayes
+
+    rng = np.random.default_rng(3)
+    x_old = rng.integers(0, 4, size=(40, 3)).astype(np.float32)
+    y_old = rng.integers(0, 2, 40).astype(np.int32)
+    nb = doer(NaiveBayesAlgorithm, {"lambda": 1.0})
+    model = nb.train(None, __import__("types").SimpleNamespace(
+        features=x_old, labels=y_old,
+        attribute_names=("attr0", "attr1", "attr2"),
+        label_values=np.array([10.0, 20.0])))
+    events = [
+        {"event": "$set", "entityType": "user", "entityId": "e1",
+         "properties": {"attr0": 2, "attr1": 0, "attr2": 1,
+                        "plan": 20.0}},
+        {"event": "$set", "entityType": "user", "entityId": "e2",
+         "properties": {"attr0": 1, "attr1": 3, "attr2": 0,
+                        "plan": 10.0}},
+        {"event": "$set", "entityType": "user", "entityId": "partial",
+         "properties": {"attr0": 1}},                  # partial: skip
+        {"event": "$set", "entityType": "user", "entityId": "newcls",
+         "properties": {"attr0": 1, "attr1": 1, "attr2": 1,
+                        "plan": 99.0}},                # unseen label
+    ]
+    m2 = nb.fold_in(model, events, None, data_source_params={})
+    assert m2 is not None and m2 is not model
+    x_new = np.array([[2, 0, 1], [1, 3, 0]], np.float32)
+    y_new = np.array([1, 0], np.int32)
+    full = train_naive_bayes(np.vstack([x_old, x_new]),
+                             np.concatenate([y_old, y_new]), 2)
+    assert np.allclose(m2.inner.log_likelihood, full.log_likelihood,
+                       atol=1e-6)
+    assert np.allclose(m2.inner.log_prior, full.log_prior, atol=1e-6)
+    # a RE-$set of an entity a prior increment added REPLACES its
+    # example (counts subtracted then re-added), so repeated updates
+    # match a retrain on the UPDATED example set instead of stacking
+    # duplicates
+    relabel = [{"event": "$set", "entityType": "user", "entityId": "e1",
+                "properties": {"attr0": 2, "attr1": 0, "attr2": 1,
+                               "plan": 10.0}}]
+    m3 = nb.fold_in(m2, relabel, None, data_source_params={})
+    x_new2 = np.array([[2, 0, 1], [1, 3, 0]], np.float32)
+    y_new2 = np.array([0, 0], np.int32)   # e1 now labeled 10.0
+    full2 = train_naive_bayes(np.vstack([x_old, x_new2]),
+                              np.concatenate([y_old, y_new2]), 2)
+    assert np.allclose(m3.inner.log_likelihood, full2.log_likelihood,
+                       atol=1e-6)
+    assert np.allclose(m3.inner.log_prior, full2.log_prior, atol=1e-6)
+    # legacy model without stored counts declines cleanly
+    import dataclasses as dc
+
+    bare = dc.replace(model, inner=dc.replace(
+        model.inner, feat_counts=None, class_counts=None))
+    assert nb.fold_in(bare, events, None, data_source_params={}) is None
+
+    lr = doer(LogisticRegressionAlgorithm, {})
+    from incubator_predictionio_tpu.models.classification import (
+        ClassifierModel)
+    from incubator_predictionio_tpu.ops.linear import (
+        LogisticRegressionModel)
+
+    lrm = ClassifierModel(
+        LogisticRegressionModel(np.zeros((3, 2), np.float32),
+                                np.zeros(2, np.float32), 2),
+        ("attr0", "attr1", "attr2"), np.array([10.0, 20.0]))
+    m3 = lr.fold_in(lrm, events, None, data_source_params={})
+    assert m3 is not None
+    probs = m3.inner.predict_proba(np.array([[2, 0, 1]], np.float32))
+    assert probs[0, 1] > 0.5      # nudged toward the new 20.0 example
+
+
+# ---------------------------------------------------------------------------
+# in-process loop: cold start, poison (gate + watch), fault ticks
+# ---------------------------------------------------------------------------
+
+def _server(storage, **kw):
+    kw.setdefault("foldin_ms", 60)
+    kw.setdefault("swap_watch_ms", 60_000)
+    kw.setdefault("swap_max_error_rate", 0.3)
+    return EngineServer(foldin_engine.engine_factory(),
+                        engine_factory_name="foldin", storage=storage,
+                        **kw)
+
+
+def test_cold_start_user_served_within_seconds_in_process(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u0", rating=3.0)
+    trained = _train(storage)
+    # the TRAIN anchored the cursor at its read position, so an event
+    # landing in the train->deploy window is folded, not dropped
+    _rate(le, app_id, "gap-user", rating=7.0)
+    server = _server(storage)
+    with ServerThread(server.app) as st:
+        assert _query(st.base, "newbie").json() == {
+            "user": "newbie", "known": False}
+        gap = _wait(lambda: (lambda d: d if d.get("known") else None)(
+            _query(st.base, "gap-user").json()), 15)
+        assert gap and gap["score"] == 7.0
+        t0 = time.monotonic()
+        _rate(le, app_id, "newbie", "i1", rating=5.0)
+        doc = _wait(lambda: (lambda d: d if d.get("known") else None)(
+            _query(st.base, "newbie").json()), 15)
+        assert doc and doc["score"] == 5.0
+        assert time.monotonic() - t0 < 10.0
+        status = requests.get(st.base + "/status").json()
+        fold = status["foldin"]
+        assert fold["producer"] and fold["publishes"] >= 1
+        assert fold["events"] >= 1 and fold["lastInstance"]
+        # the increment is a real COMPLETED instance with provenance —
+        # and NOT a retrain (every new row carries the foldin marker)
+        rows = storage.get_meta_data_engine_instances().get_completed(
+            "foldin", "1", "default")
+        marked = [r for r in rows if r.id != trained]
+        assert marked and all(
+            json.loads(r.runtime_conf["foldin"])["of"]
+            for r in marked)
+        # cursor row persisted for `pio status` + restart resume
+        group = model_artifact.fleet_group("foldin", "default")
+        doc = model_artifact.read_fleet_doc(
+            storage, model_artifact.foldin_row_id(group, app_id))
+        assert doc and doc["cursor"]["shards"]
+
+
+def test_nan_poisoned_foldin_refused_by_gate(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u0")
+    _train(storage)
+    server = _server(storage)
+    with ServerThread(server.app) as st:
+        le.insert(Event(event="poison-nan", entity_type="sys",
+                        entity_id="x"), app_id)
+        lc = _wait(lambda: (lambda d: d if d["pinned"] else None)(
+            requests.get(st.base + "/status").json()["lifecycle"]), 15)
+        assert lc and list(lc["pinned"].values()) == ["validate"]
+        assert lc["validateFailures"] >= 1
+        # last-good keeps serving; the loop self-heals on later events
+        assert _query(st.base, "u0").status_code == 200
+        _rate(le, app_id, "fresh-user", rating=2.0)
+        doc = _wait(lambda: (lambda d: d if d.get("known") else None)(
+            _query(st.base, "fresh-user").json()), 15)
+        assert doc and doc["score"] == 2.0
+        metrics = requests.get(st.base + "/metrics").text
+        assert 'pio_foldin_rollbacks_total{reason="validate"} 1' \
+            in metrics
+
+
+def test_poisoned_foldin_rolls_back_via_watch_in_process(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u0")
+    good = _train(storage)
+    server = _server(storage)
+    stop = threading.Event()
+    codes: list = []
+    with ServerThread(server.app) as st:
+        def fire():
+            while not stop.is_set():
+                codes.append(_query(st.base, "u0").status_code)
+                time.sleep(0.01)
+
+        th = threading.Thread(target=fire)
+        th.start()
+        try:
+            le.insert(Event(event="poison-serve", entity_type="sys",
+                            entity_id="x"), app_id)
+            lc = _wait(lambda: (lambda d: d if d["rollbacks"] else None)(
+                requests.get(st.base + "/status").json()["lifecycle"]),
+                20)
+        finally:
+            stop.set()
+            th.join(30)
+        assert lc and lc["rollbacks"] == {"error-rate": 1}
+        assert "error-rate" in lc["pinned"].values()
+        assert lc["instance"] == good
+        # hedged onto last-good: clients never saw the poisoned model
+        assert codes and set(codes) == {200}, sorted(set(codes))
+        metrics = requests.get(st.base + "/metrics").text
+        assert 'pio_foldin_rollbacks_total{reason="error-rate"} 1' \
+            in metrics
+
+
+def test_foldin_read_apply_faults_fail_one_tick_not_the_loop(
+        tmp_path, chaos):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u0")
+    _train(storage)
+    # one read fault + one apply fault: two ticks burn, the third folds
+    chaos("foldin.read:fail:1;foldin.apply:fail:1")
+    server = _server(storage)
+    with ServerThread(server.app) as st:
+        _rate(le, app_id, "survivor", rating=4.0)
+        doc = _wait(lambda: (lambda d: d if d.get("known") else None)(
+            _query(st.base, "survivor").json()), 20)
+        assert doc and doc["score"] == 4.0
+        fold = requests.get(st.base + "/status").json()["foldin"]
+        assert fold["publishes"] >= 1
+        # faulted ticks re-read the batch but must not re-COUNT it:
+        # the one survivor event counts once, not once per retry
+        assert fold["events"] == 1, fold
+
+
+def test_foldin_disabled_on_non_jsonl_event_store(memory_storage):
+    app_id = _mk_app(memory_storage)
+    memory_storage.get_l_events().insert(
+        Event(event="rate", entity_type="user", entity_id="u0",
+              properties=DataMap({"rating": 1.0})), app_id)
+    _train(memory_storage)
+    server = _server(memory_storage, foldin_ms=40)
+    with ServerThread(server.app) as st:
+        fold = _wait(lambda: (lambda d: d if d and not d.get("enabled",
+                                                             True)
+                              else None)(
+            requests.get(st.base + "/status").json().get("foldin")), 10)
+        assert fold and "JSONL" in fold["disabledReason"]
+        assert _query(st.base, "u0").status_code == 200
+
+
+# ---------------------------------------------------------------------------
+# fleet routing + the refreshMs small fix
+# ---------------------------------------------------------------------------
+
+def test_fleet_producer_commits_but_coordinator_owns_publish(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u0")
+    trained = _train(storage)
+    server = _server(storage, fleet_replica=0, fleet_replicas=2,
+                     fleet_sync_ms=100)
+    with ServerThread(server.app) as st:
+        _rate(le, app_id, "newbie", rating=5.0)
+        # the increment lands in the store...
+        rows = _wait(lambda: [
+            r for r in storage.get_meta_data_engine_instances()
+            .get_completed("foldin", "1", "default")
+            if online.is_foldin_instance(r)], 15)
+        assert rows
+        # ...but the replica does NOT self-publish (no coordinator ran:
+        # no directive, so the served instance must stay the trained
+        # one — rollout is the canary's job)
+        time.sleep(0.3)
+        doc = requests.get(st.base + "/status").json()
+        assert doc["engineInstanceId"] == trained
+        assert doc["foldin"]["producer"] is True
+        # while publication is DEFERRED, the next increment CHAINS onto
+        # the previous one — the newest increment must contain BOTH
+        # batches, or promoting it would silently drop the first
+        n_before = len(rows)
+        _rate(le, app_id, "second", rating=2.0)
+        rows = _wait(lambda: (lambda rs: rs if len(rs) > n_before
+                              else None)([
+            r for r in storage.get_meta_data_engine_instances()
+            .get_completed("foldin", "1", "default")
+            if online.is_foldin_instance(r)]), 15)
+        assert rows
+        import pickle
+
+        newest = max(rows, key=lambda r: r.start_time)
+        payload = model_artifact.read_model(storage, newest.id)
+        scores = pickle.loads(payload)[0].scores
+        assert scores.get("newbie") == 5.0 and scores.get("second") == 2.0
+
+
+def test_fleet_standby_replica_does_not_produce(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u0")
+    _train(storage)
+    server = _server(storage, fleet_replica=1, fleet_replicas=2,
+                     fleet_sync_ms=100)
+    with ServerThread(server.app) as st:
+        _rate(le, app_id, "newbie")
+        time.sleep(0.5)
+        rows = [r for r in storage.get_meta_data_engine_instances()
+                .get_completed("foldin", "1", "default")
+                if online.is_foldin_instance(r)]
+        assert rows == []
+        fold = requests.get(st.base + "/status").json()["foldin"]
+        assert fold["producer"] is False
+
+
+def test_fleet_refresh_knob_refusal_is_explicit(tmp_path, capsys):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    _rate(storage.get_l_events(), app_id, "u0")
+    _train(storage)
+    server = EngineServer(foldin_engine.engine_factory(),
+                          engine_factory_name="foldin", storage=storage,
+                          fleet_replica=0, fleet_replicas=2,
+                          model_refresh_ms=5000)
+    assert server.model_refresh_ms == 0.0
+    lc = server.lifecycle_snapshot()
+    assert lc["refreshMs"] == "disabled(fleet)"
+    # ...and the operator surface prints the reason, not "off"
+    with ServerThread(server.app) as st:
+        from incubator_predictionio_tpu.tools.commands.management import (
+            _print_engine_overload)
+
+        _print_engine_overload(st.base)
+    out = capsys.readouterr().out
+    assert "disabled(fleet)" in out
+    # non-fleet servers still report the number
+    plain = EngineServer(foldin_engine.engine_factory(),
+                         engine_factory_name="foldin", storage=storage,
+                         model_refresh_ms=5000)
+    assert plain.lifecycle_snapshot()["refreshMs"] == 5000.0
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e: the acceptance headline + SIGKILL mid-publish
+# ---------------------------------------------------------------------------
+
+def _spawn_server(env, port):
+    return subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "foldin_server.py"),
+         str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _wait_ready(proc, base, deadline_s=90):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "server died: "
+                + proc.stdout.read().decode(errors="replace")[-3000:])
+        try:
+            return requests.get(base + "/status", timeout=2).json()
+        except requests.RequestException:
+            time.sleep(0.2)
+    raise AssertionError("server not ready")
+
+
+def test_cold_start_and_poisoned_foldin_e2e_subprocess(tmp_path):
+    """The acceptance headline in one REAL server over SQLITE+JSONL:
+    a brand-new user's first event is served (non-cold-start answer)
+    within seconds via fold-in — no retrain — then a gate-passing
+    poisoned increment auto-rolls back + pins through the PR 9 watch
+    path, with EVERY client query answered 200 throughout, and the
+    loop keeps folding afterwards (self-healing)."""
+    env = _subprocess_env(tmp_path, PIO_FOLDIN_MS="100",
+                          PIO_SWAP_WATCH_MS="30000",
+                          PIO_SWAP_MAX_ERROR_RATE="0.3")
+    storage = _storage_for(env)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u-seed", rating=3.0)
+    good = _train(storage)
+    n_instances_before = len(
+        storage.get_meta_data_engine_instances().get_all())
+
+    port = free_port()
+    proc = _spawn_server(env, port)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        doc = _wait_ready(proc, base)
+        assert doc["engineInstanceId"] == good
+        assert _query(base, "newbie").json()["known"] is False
+
+        stop = threading.Event()
+        codes: list = []
+
+        def client():
+            while not stop.is_set():
+                try:
+                    codes.append(_query(base, "u-seed",
+                                        timeout=10).status_code)
+                except requests.RequestException:
+                    if not stop.is_set():
+                        codes.append(-1)
+                time.sleep(0.02)
+
+        th = threading.Thread(target=client)
+        th.start()
+        try:
+            # --- cold start: first event -> served within seconds ---
+            t0 = time.monotonic()
+            _rate(le, app_id, "newbie", "i7", rating=5.0)
+            doc = _wait(lambda: (lambda d: d if d.get("known")
+                                 else None)(
+                _query(base, "newbie").json()), 20)
+            dt = time.monotonic() - t0
+            assert doc and doc["score"] == 5.0, doc
+            assert dt < 15.0, f"fold-in took {dt:.1f}s"
+            # --- poisoned increment: watch rollback + pin ---
+            le.insert(Event(event="poison-serve", entity_type="sys",
+                            entity_id="x"), app_id)
+            lc = _wait(lambda: (lambda d: d if d["rollbacks"]
+                                else None)(
+                requests.get(base + "/status",
+                             timeout=5).json()["lifecycle"]), 30, 0.1)
+            assert lc and lc["rollbacks"] == {"error-rate": 1}, lc
+            assert "error-rate" in lc["pinned"].values()
+            # --- self-heal: later events still fold + publish ---
+            _rate(le, app_id, "late-user", rating=2.0)
+            doc = _wait(lambda: (lambda d: d if d.get("known")
+                                 else None)(
+                _query(base, "late-user").json()), 20)
+            assert doc and doc["score"] == 2.0
+        finally:
+            stop.set()
+            th.join(30)
+        # every client query answered 200 through swap+rollback
+        assert codes and set(codes) == {200}, sorted(set(codes))
+        # freshness never required a retrain: no non-foldin instance
+        # beyond the seeded train
+        rows = storage.get_meta_data_engine_instances().get_all()
+        retrains = [r for r in rows
+                    if not online.is_foldin_instance(r)]
+        assert len(retrains) == n_instances_before
+        # operator surfaces: /status foldin block + `pio status` lines
+        doc = requests.get(base + "/status", timeout=5).json()
+        assert doc["foldin"]["publishes"] >= 2
+        metrics = requests.get(base + "/metrics", timeout=5).text
+        assert "pio_foldin_publishes_total" in metrics
+        assert 'pio_foldin_rollbacks_total{reason="error-rate"} 1' \
+            in metrics
+        proc.send_signal(__import__("signal").SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        storage.close()
+        if proc.poll() is None:
+            proc.kill()
+        proc.communicate()
+
+
+def test_sigkill_mid_publish_leaves_cursor_and_store_resumable(tmp_path):
+    """`foldin.publish:crash:1` SIGKILLs the producer after the model
+    blob lands but before the COMPLETED stamp. The store must show a
+    RUNNING orphan (never deployable), the cursor must NOT have
+    advanced past the batch, and a clean restart must re-fold the same
+    events and serve the user (at-least-once)."""
+    env = _subprocess_env(tmp_path, PIO_FOLDIN_MS="100",
+                          PIO_FAULT_SPEC="foldin.publish:crash:1")
+    storage = _storage_for(env)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u-seed")
+    good = _train(storage)
+
+    port = free_port()
+    proc = _spawn_server(env, port)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        _wait_ready(proc, base)
+        _rate(le, app_id, "newbie", rating=5.0)
+        assert proc.wait(timeout=60) in (-9, 137)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.communicate()
+    instances = storage.get_meta_data_engine_instances()
+    orphans = [r for r in instances.get_all() if r.status == "RUNNING"]
+    assert len(orphans) == 1 and online.is_foldin_instance(orphans[0])
+    assert instances.get_completed("foldin", "1", "default")[0].id \
+        == good
+    # cursor did not advance past the unconsumed batch
+    group = model_artifact.fleet_group("foldin", "default")
+    doc = model_artifact.read_fleet_doc(
+        storage, model_artifact.foldin_row_id(group, app_id))
+    assert doc is not None
+    tailer = LogTailer(le.events_dir, app_id)
+    assert tailer.lag_bytes(LogCursor.from_json(doc["cursor"])) > 0
+
+    # clean restart: resumes from the cursor, re-folds, serves
+    env2 = _subprocess_env(tmp_path, PIO_FOLDIN_MS="100")
+    port2 = free_port()
+    proc = _spawn_server(env2, port2)
+    base = f"http://127.0.0.1:{port2}"
+    try:
+        _wait_ready(proc, base)
+        doc = _wait(lambda: (lambda d: d if d.get("known") else None)(
+            _query(base, "newbie").json()), 20)
+        assert doc and doc["score"] == 5.0
+        proc.send_signal(__import__("signal").SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        storage.close()
+        if proc.poll() is None:
+            proc.kill()
+        proc.communicate()
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+def test_pio_eventlog_tail_cli(tmp_path, capsys, monkeypatch):
+    env = _subprocess_env(tmp_path)
+    for k, v in env.items():
+        if k.startswith("PIO_STORAGE"):
+            monkeypatch.setenv(k, v)
+    storage = Storage.reset_instance(
+        {k: v for k, v in env.items() if k.startswith("PIO_STORAGE")})
+    try:
+        app_id = _mk_app(storage)
+        le = storage.get_l_events()
+        _rate(le, app_id, "u0", rating=1.5)
+        _rate(le, app_id, "u1", rating=2.5)
+        from incubator_predictionio_tpu.tools.commands.management import (
+            eventlog_cmd)
+
+        assert eventlog_cmd(["tail", "--app", "foldapp"]) == 0
+        cap = capsys.readouterr()
+        events = [json.loads(line) for line in
+                  cap.out.strip().splitlines()]
+        assert [e["entityId"] for e in events] == ["u0", "u1"]
+        cursor_line = [ln for ln in cap.err.splitlines()
+                       if "cursor:" in ln][0]
+        cursor = cursor_line.split("cursor: ", 1)[1]
+        # resume from the printed cursor: only NEW events come out
+        _rate(le, app_id, "u2", rating=3.5)
+        assert eventlog_cmd(["tail", "--app", "foldapp",
+                             "--from", cursor]) == 0
+        cap = capsys.readouterr()
+        events = [json.loads(line) for line in
+                  cap.out.strip().splitlines()]
+        assert [e["entityId"] for e in events] == ["u2"]
+        # --from end reads nothing
+        assert eventlog_cmd(["tail", "--app", "foldapp",
+                             "--from", "end"]) == 0
+        assert capsys.readouterr().out.strip() == ""
+        # garbage cursor is a loud error, not a silent full re-read
+        assert eventlog_cmd(["tail", "--app", "foldapp",
+                             "--from", "{bad"]) == 1
+    finally:
+        Storage.reset_instance({
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+        })
+
+
+def test_pio_status_prints_foldin_cursor_with_staleness(tmp_path,
+                                                        capsys):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    _rate(storage.get_l_events(), app_id, "u0")
+    _train(storage)
+    group = model_artifact.fleet_group("foldin", "default")
+    now = time.time()
+    model_artifact.write_fleet_doc(
+        storage, model_artifact.foldin_row_id(group, app_id),
+        {"cursor": {"v": 1, "shards": {"events_1.jsonl": 120},
+                    "resets": 0},
+         "group": group, "appId": app_id, "app": "foldapp",
+         "intervalMs": 1000.0, "updatedAt": now, "caughtUpAt": now,
+         "events": 7, "publishes": 2})
+    from incubator_predictionio_tpu.tools.commands.management import (
+        _print_foldin_cursors)
+
+    _print_foldin_cursors(storage)
+    out = capsys.readouterr().out
+    assert "Online fold-in: app 'foldapp'" in out
+    assert "120 byte(s)" in out and "7 event(s) folded" in out
+    assert "[info]" in out and "STALE" not in out
+    # stale cursor (lag > 2x interval) flips the warn-marker
+    model_artifact.write_fleet_doc(
+        storage, model_artifact.foldin_row_id(group, app_id),
+        {"cursor": {"v": 1, "shards": {"events_1.jsonl": 120},
+                    "resets": 0},
+         "group": group, "appId": app_id, "app": "foldapp",
+         "intervalMs": 1000.0, "updatedAt": now - 60,
+         "caughtUpAt": now - 60, "events": 7, "publishes": 2})
+    _print_foldin_cursors(storage)
+    out = capsys.readouterr().out
+    assert "[warn]" in out and "STALE" in out
+
+
+def test_foldin_marker_registered():
+    import configparser
+
+    cfg = configparser.ConfigParser()
+    here = os.path.dirname(HERE)
+    with open(os.path.join(here, "pyproject.toml")) as f:
+        text = f.read()
+    assert "foldin:" in text
