@@ -31,6 +31,16 @@ identically in-process, across subprocesses, and in CI:
   class): the deterministic disk fault (``oserr:1:28`` = ENOSPC) used
   by the append-error shed tests, where the failure must classify as
   resource exhaustion rather than a torn connection.
+- ``at:MS[:SUBMODE[:PARAM]]`` — time-scheduled arming (the soak
+  driver's fault-timeline mode): instead of counting calls, the rule
+  arms a monotonic offset. The FIRST matching call at or after MS
+  milliseconds past plan arming fires SUBMODE (``fail`` by default;
+  ``crash``; ``latency`` with PARAM = seconds to sleep; ``oserr``
+  with PARAM = errno), then the rule is spent. The clock
+  starts when the plan is armed in THIS process: the first fault-point
+  consult that sees the current spec value (``reset()`` + a consult
+  re-arms it). ``ingest.commit:at:4000:crash`` = SIGKILL inside the
+  first group commit 4 s into serving, wherever that call lands.
 
 Counts are per-rule and deterministic: "fail first 2 calls" means
 exactly the first two matching calls in this process fail, then the
@@ -48,8 +58,8 @@ import threading
 import time
 from typing import Optional
 
-__all__ = ["InjectedFault", "fault_point", "stream_fault", "reset",
-           "active_spec"]
+__all__ = ["InjectedFault", "arm", "fault_point", "stream_fault",
+           "reset", "active_spec"]
 
 ENV_VAR = "PIO_FAULT_SPEC"
 
@@ -59,13 +69,46 @@ class InjectedFault(ConnectionError):
 
 
 class _Rule:
-    __slots__ = ("pattern", "mode", "remaining", "param")
+    __slots__ = ("pattern", "mode", "remaining", "param", "at_s",
+                 "submode")
 
-    def __init__(self, pattern: str, mode: str, count: int, param: float):
+    def __init__(self, pattern: str, mode: str, count: int, param: float,
+                 at_s: float = 0.0, submode: str = "fail"):
         self.pattern = pattern
         self.mode = mode
         self.remaining = count
         self.param = param
+        self.at_s = at_s          # "at" rules: offset past plan arming
+        self.submode = submode    # "at" rules: what fires at the offset
+
+
+_AT_SUBMODES = ("fail", "crash", "latency", "oserr")
+
+
+def _parse_at(raw: str, parts: list[str]) -> _Rule:
+    """``point:at:MS[:SUBMODE[:PARAM]]`` — monotonic-offset arming."""
+    try:
+        at_ms = float(parts[2])
+    except ValueError as e:
+        raise ValueError(f"{ENV_VAR}: bad offset in {raw!r}") from e
+    if at_ms < 0:
+        raise ValueError(f"{ENV_VAR}: negative offset in {raw!r}")
+    submode = parts[3].lower() if len(parts) > 3 else "fail"
+    if submode not in _AT_SUBMODES:
+        raise ValueError(
+            f"{ENV_VAR}: unknown at-submode {submode!r} in {raw!r} "
+            f"(want one of {'/'.join(_AT_SUBMODES)})")
+    param = 0.0
+    if len(parts) > 4:
+        try:
+            param = float(parts[4])
+        except ValueError as e:
+            raise ValueError(f"{ENV_VAR}: bad param in {raw!r}") from e
+    elif submode in ("latency", "oserr"):
+        raise ValueError(f"{ENV_VAR}: at-submode {submode!r} needs a "
+                         f"param ({raw!r})")
+    return _Rule(parts[0], "at", 1, param, at_s=at_ms / 1000.0,
+                 submode=submode)
 
 
 def _parse(spec: str) -> list[_Rule]:
@@ -80,6 +123,9 @@ def _parse(spec: str) -> list[_Rule]:
                 f"{ENV_VAR}: malformed rule {raw!r} "
                 "(want point:mode:count[:param])")
         pattern, mode, count = parts[0], parts[1].lower(), parts[2]
+        if mode == "at":
+            rules.append(_parse_at(raw, parts))
+            continue
         if mode not in ("fail", "latency", "drop", "crash", "oserr"):
             raise ValueError(f"{ENV_VAR}: unknown fault mode {mode!r}")
         try:
@@ -102,16 +148,19 @@ def _parse(spec: str) -> list[_Rule]:
 _lock = threading.Lock()
 _cached_spec: Optional[str] = None
 _rules: list[_Rule] = []
+_armed_at: float = 0.0   # monotonic instant the current plan armed
 
 
 def _active_rules() -> list[_Rule]:
     """Current rule set, re-parsed whenever the env value changes.
-    A changed value re-arms all counts (it is a NEW plan)."""
-    global _cached_spec, _rules
+    A changed value re-arms all counts (it is a NEW plan) and restarts
+    the ``at``-mode offset clock."""
+    global _cached_spec, _rules, _armed_at
     spec = os.environ.get(ENV_VAR, "")
     if spec != _cached_spec:
         _rules = _parse(spec)
         _cached_spec = spec
+        _armed_at = time.monotonic()
     return _rules
 
 
@@ -121,6 +170,17 @@ def reset() -> None:
     with _lock:
         _cached_spec = None
         _rules = []
+
+
+def arm() -> None:
+    """Parse the current plan NOW, starting the ``at``-mode offset
+    clock, instead of waiting for the first fault-point consult.
+    Servers call this at construction so scheduled offsets measure
+    from "server up", not "first request". No-op when chaos is off."""
+    if not os.environ.get(ENV_VAR):
+        return
+    with _lock:
+        _active_rules()
 
 
 def active_spec() -> str:
@@ -155,6 +215,29 @@ def fault_point(name: str) -> None:
             if rule.remaining <= 0 or rule.mode == "drop":
                 continue
             if not fnmatch.fnmatch(name, rule.pattern):
+                continue
+            if rule.mode == "at":
+                # time-scheduled arming: the first matching call at or
+                # past the offset fires the submode, earlier calls pass
+                # untouched (and never consume the rule)
+                if time.monotonic() - _armed_at < rule.at_s:
+                    continue
+                rule.remaining -= 1
+                if rule.submode == "crash":
+                    die = True
+                    break
+                if rule.submode == "fail":
+                    boom = InjectedFault(
+                        f"injected scheduled fault at {name!r} "
+                        f"({ENV_VAR})")
+                    break
+                if rule.submode == "oserr":
+                    boom = OSError(
+                        int(rule.param),
+                        f"injected scheduled disk fault at {name!r} "
+                        f"({ENV_VAR})")
+                    break
+                delay += rule.param          # latency
                 continue
             rule.remaining -= 1
             if rule.mode == "crash":

@@ -690,6 +690,14 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
     ports = [Supervisor._free_port() for _ in range(workers)]
     base_env = dict(os.environ)
     chaos = base_env.pop("PIO_EVENT_WORKER_FAULT_SPEC", None)
+    # per-partition chaos (the soak driver's fault timeline):
+    # PIO_EVENT_WORKER_FAULT_SPEC_<i> overrides the shared spec for
+    # worker i only — one worker can crash mid-commit while another
+    # sheds ENOSPC, instead of every worker dying at the same rule
+    per_worker_chaos = {
+        i: base_env.pop(f"PIO_EVENT_WORKER_FAULT_SPEC_{i}")
+        for i in range(workers)
+        if f"PIO_EVENT_WORKER_FAULT_SPEC_{i}" in base_env}
     base_env.pop("PIO_EVENT_WORKERS", None)
 
     def env_for(attempt: int, idx: int) -> dict:
@@ -701,8 +709,9 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
             ports[idx] = Supervisor._free_port()
         env = worker_env(idx, ports[idx],
                          wal_cfg.dir if wal_cfg.enabled else None)
-        if chaos and attempt == 0:
-            env["PIO_FAULT_SPEC"] = chaos
+        spec = per_worker_chaos.get(idx, chaos)
+        if spec and attempt == 0:
+            env["PIO_FAULT_SPEC"] = spec
         return env
 
     argv = [sys.executable, "-m",
@@ -729,7 +738,16 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
              sup.run_dir)
 
     async def front_main() -> None:
-        proxy = FrontProxy(ports)
+        from ...common import envknobs
+
+        # opt-in connect-retry budget (default 0 = the original
+        # one-pass drop): on a starved host a live worker's full
+        # accept queue REFUSES connects, and a respawning worker
+        # refuses until it rebinds — with a budget the front retries
+        # ~50ms-paced inside the same accept instead of dropping the
+        # client (the PR 12 fleet-front hardening, now reachable here)
+        proxy = FrontProxy(ports, connect_retry_s=envknobs.env_ms(
+            "PIO_EVENT_CONNECT_RETRY_MS", 0.0))
         await proxy.start(host, port)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()

@@ -34,7 +34,7 @@ from typing import Optional
 
 from aiohttp import web
 
-from ...common import envknobs, ssl_context_from_env, telemetry
+from ...common import envknobs, faultinject, ssl_context_from_env, telemetry
 from ...common.resilience import CircuitOpenError, retry_after_jitter
 from ...workflow.plugins import EventServerPluginContext
 from ..storage.base import AccessKey
@@ -62,6 +62,10 @@ class EventServer:
         enable_stats: bool = False,
         plugins: Optional[EventServerPluginContext] = None,
     ):
+        # start the PIO_FAULT_SPEC at-mode offset clock at "server
+        # constructing" so soak timelines schedule faults relative to
+        # worker start (no-op when chaos is off)
+        faultinject.arm()
         self.storage = storage or Storage.instance()
         self.stats = Stats() if enable_stats else None
         self.plugins = plugins or EventServerPluginContext()
@@ -391,7 +395,17 @@ class EventServer:
         access_key = await self._authorize(request)
         channel_id = await self._channel_id(request, access_key)
         raw = await request.read()
-        if self.ingest.ack_on_enqueue:
+        # per-request ack-mode override (X-Pio-Ack: enqueue|commit):
+        # both paths exist on the buffer regardless of the configured
+        # default, carry the same WAL durability-before-ack contract,
+        # and the soak's mixed flood interleaves them in one run
+        ack = request.headers.get("X-Pio-Ack", "").lower()
+        if ack and ack not in ("enqueue", "commit"):
+            return _json_error(
+                400, "X-Pio-Ack must be 'enqueue' or 'commit'")
+        ack_enqueue = (ack == "enqueue") if ack \
+            else self.ingest.ack_on_enqueue
+        if ack_enqueue:
             # fire-and-forget ack: validate inline (same canonical path
             # the group commit uses, so the modes cannot drift) so
             # 400/403 are still real, then respond once queued

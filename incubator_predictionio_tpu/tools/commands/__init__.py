@@ -7,7 +7,8 @@ import sys
 from typing import Callable
 
 _VERBS: dict[str, tuple[Callable[[list[str]], int], str]] = {}
-_MODULES = ("app", "engine", "management", "evaluation", "models", "lint")
+_MODULES = ("app", "engine", "management", "evaluation", "models", "lint",
+            "soak")
 _loaded = False
 
 

@@ -105,6 +105,8 @@ def status_cmd(args: list[str]) -> int:
     # Online fold-in cursors: where each app's streaming-learning
     # tailer stands, with the freshness-lag warn-marker.
     _print_foldin_cursors(s)
+    # Last production-day soak verdict (pio soak writes ./SOAK.json).
+    _print_soak_verdict()
     if ns.engine_url:
         _print_engine_overload(ns.engine_url)
     if ns.metrics:
@@ -118,6 +120,32 @@ def status_cmd(args: list[str]) -> int:
         sys.stdout.write(telemetry.render_all())
     print("[info] Your system is all ready to go.")
     return 0
+
+
+def _print_soak_verdict(path: str = "SOAK.json") -> None:
+    """One line summarizing the last soak scorecard in the cwd: the
+    operator sees at a glance whether production day last went green,
+    with the seed that replays it if it did not."""
+    import time as _time
+
+    from ...workflow.soak import read_scorecard
+
+    doc = read_scorecard(path)
+    if not doc or "verdict" not in doc:
+        return
+    ok = doc.get("verdict") == "PASS"
+    slos = doc.get("slos") or []
+    green = sum(1 for s in slos if s.get("ok"))
+    fired = sum(1 for f in (doc.get("faults") or []) if f.get("fired"))
+    age_h = (_time.time() - float(doc.get("startedAt") or 0)) / 3600.0
+    marker = "[info]" if ok else "[warn]"
+    extra = "" if ok else (
+        " — VIOLATED: "
+        + ", ".join(s["name"] for s in slos if not s.get("ok"))
+        + f"; replay with `pio soak --seed {doc.get('seed')}`")
+    print(f"{marker} Last soak ({path}): {doc.get('verdict')}, "
+          f"{green}/{len(slos)} SLO(s) green, {fired} fault(s) "
+          f"injected, seed {doc.get('seed')}, {age_h:.1f}h ago{extra}")
 
 
 def _print_engine_overload(url: str) -> None:

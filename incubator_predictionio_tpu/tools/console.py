@@ -87,6 +87,13 @@ def main(argv=None) -> int:
         from .lint.cli import main as lint_main
 
         return lint_main(argv[1:])
+    if argv[0] == "soak":
+        # the soak driver only builds argv for subprocesses (which do
+        # their own jax setup) — keep the driver process jax-free like
+        # lint so the scenario clock never pays a backend init
+        from .commands.soak import soak_cmd
+
+        return soak_cmd(argv[1:])
     # (the persistent XLA compilation cache is enabled lazily by
     # WorkflowContext — the chokepoint every compiling verb passes —
     # so metadata-only verbs never import jax for it)

@@ -80,16 +80,21 @@ def ingest_hot_path(project: Project) -> Iterable[Finding]:
 _BANNED_SUB = ("Popen", "run", "call", "check_call", "check_output")
 _BANNED_OS = ("fork", "forkpty", "spawnv", "spawnve", "spawnl", "spawnlp",
               "spawnvp", "posix_spawn", "execv", "execve")
+# the soak driver's whole job is launching the REAL topology (the
+# supervised fronts it spawns are themselves the supervisors); it only
+# ever builds argv for this repo's own console entry points
+_SPAWN_ALLOWED = ("parallel/supervisor.py", "workflow/soak.py")
 
 
 @rule("spawn-confinement",
       "parallel/ and workflow/ spawn processes only through "
-      "parallel/supervisor.py — a side-channel launch escapes liveness "
-      "monitoring, restart accounting and drain")
+      "parallel/supervisor.py (plus the soak scenario driver, whose "
+      "test subject IS the spawned topology) — a side-channel launch "
+      "escapes liveness monitoring, restart accounting and drain")
 def spawn_confinement(project: Project) -> Iterable[Finding]:
     for sub in ("parallel/", "workflow/"):
         for m in project.modules(sub):
-            if m.relpath == "parallel/supervisor.py" or m.tree is None:
+            if m.relpath in _SPAWN_ALLOWED or m.tree is None:
                 continue
             disp = project.display_path(m)
             for node in m.walk():

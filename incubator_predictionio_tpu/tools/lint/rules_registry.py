@@ -267,5 +267,136 @@ def metric_name_registry(project: Project) -> Iterable[Finding]:
                     "docs/ (operations.md metrics table)")
 
 
+# ---------------------------------------------------------------------------
+# soak registries: the scenario driver's SLO/fault contracts stay live
+# ---------------------------------------------------------------------------
+
+_SOAK_MODULE = "workflow/soak.py"
+
+
+def _module_const_strings(m: Module, name: str):
+    """String literals of a module-level ``NAME = (...)`` tuple/list
+    assignment: [(value, lineno)], or None when no such literal
+    assignment exists."""
+    for node in m.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return [(e.value, e.lineno) for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+    return None
+
+
+def _module_const_dict(m: Module, name: str):
+    """{key: (value, lineno)} of a module-level ``NAME = {...}`` dict
+    literal with string keys/values, or None when absent."""
+    for node in m.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, ast.Dict):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, str) \
+                        and isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    out[k.value] = (v.value, v.lineno)
+            return out
+    return None
+
+
+@rule("soak-slo-registry",
+      "every telemetry family the soak driver asserts SLOs/evidence "
+      "from (workflow/soak.py SLO_METRICS) is a documented metric "
+      "family — a renamed family must not silently blind the scorecard")
+def soak_slo_registry(project: Project) -> Iterable[Finding]:
+    m = project.module(_SOAK_MODULE)
+    if m is None or m.tree is None:
+        return
+    disp = project.display_path(m)
+    entries = _module_const_strings(m, "SLO_METRICS")
+    if entries is None:
+        yield Finding(
+            "soak-slo-registry", disp, 1,
+            "SLO_METRICS tuple literal not found in workflow/soak.py — "
+            "the soak SLO registry contract moved (rename breaks the "
+            "lint coverage, restore the literal)")
+        return
+    docs = project.docs()
+
+    def documented(name: str) -> bool:
+        probe = re.compile(rf"`{re.escape(name)}(?![a-z0-9_])")
+        return any(probe.search(text) for text in docs.values())
+
+    for name, line in entries:
+        if not _METRIC.match(name):
+            yield Finding(
+                "soak-slo-registry", disp, line,
+                f"soak SLO metric {name!r} breaks the pio_* family "
+                "naming convention")
+        elif not documented(name):
+            yield Finding(
+                "soak-slo-registry", disp, line,
+                f"soak SLO metric {name!r} is not a documented metric "
+                "family (docs/operations.md metrics tables) — the "
+                "scorecard would assert evidence from a family nobody "
+                "exports")
+
+
+def _armed_points(project: Project) -> set:
+    """Every fault-point literal named in a fault_point()/stream_fault()
+    call anywhere in the package (the armed set)."""
+    out: set = set()
+    for m in project.modules():
+        if m.tree is None:
+            continue
+        for node in m.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else ""
+            if name in ("fault_point", "stream_fault") and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                out.add(node.args[0].value)
+    return out
+
+
+@rule("soak-fault-registry",
+      "every spec fault the soak scheduler can inject "
+      "(workflow/soak.py FAULT_POINTS) names a fault point that is "
+      "actually armed by a fault_point() call in the repo — a timeline "
+      "aimed at a removed point would silently inject nothing")
+def soak_fault_registry(project: Project) -> Iterable[Finding]:
+    m = project.module(_SOAK_MODULE)
+    if m is None or m.tree is None:
+        return
+    disp = project.display_path(m)
+    mapping = _module_const_dict(m, "FAULT_POINTS")
+    if mapping is None:
+        yield Finding(
+            "soak-fault-registry", disp, 1,
+            "FAULT_POINTS dict literal not found in workflow/soak.py — "
+            "the soak fault registry contract moved (rename breaks the "
+            "lint coverage, restore the literal)")
+        return
+    armed = _armed_points(project)
+    for fault, (point, line) in sorted(mapping.items()):
+        if point not in armed:
+            yield Finding(
+                "soak-fault-registry", disp, line,
+                f"soak fault {fault!r} schedules fault point {point!r}, "
+                "which no fault_point()/stream_fault() call arms "
+                "anywhere — the scheduled rule would never fire")
+
+
 RULES = [knob_envknobs, knob_docs_sync, fault_point_registry,
-         metric_name_registry]
+         metric_name_registry, soak_slo_registry, soak_fault_registry]

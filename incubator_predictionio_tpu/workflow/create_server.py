@@ -100,6 +100,10 @@ class EngineServer:
         fleet_replicas: Optional[int] = None,
         fleet_sync_ms: Optional[float] = None,
     ):
+        # start the PIO_FAULT_SPEC at-mode offset clock at "server
+        # constructing", not "first query": soak timelines schedule
+        # faults relative to process start (no-op when chaos is off)
+        faultinject.arm()
         self.engine = engine
         self.engine_factory_name = engine_factory_name
         self.engine_variant = engine_variant

@@ -373,6 +373,14 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
     ports = [Supervisor._free_port() for _ in range(replicas)]
     base_env = dict(os.environ)
     chaos = base_env.pop("PIO_FLEET_WORKER_FAULT_SPEC", None)
+    # per-replica chaos (the soak driver's fault timeline):
+    # PIO_FLEET_WORKER_FAULT_SPEC_<i> overrides the shared spec for
+    # replica i only — a scheduled crash can target ONE replica
+    # instead of SIGKILLing the whole fleet at the same offset
+    per_replica_chaos = {
+        i: base_env.pop(f"PIO_FLEET_WORKER_FAULT_SPEC_{i}")
+        for i in range(replicas)
+        if f"PIO_FLEET_WORKER_FAULT_SPEC_{i}" in base_env}
     base_env.pop("PIO_QUERY_REPLICAS", None)
 
     def env_for(attempt: int, idx: int) -> dict:
@@ -385,8 +393,9 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
             "PIO_FLEET_REPLICAS": str(replicas),
             "PIO_QUERY_REPLICA_PORT": str(ports[idx]),
         }
-        if chaos and attempt == 0:
-            env["PIO_FAULT_SPEC"] = chaos
+        spec = per_replica_chaos.get(idx, chaos)
+        if spec and attempt == 0:
+            env["PIO_FAULT_SPEC"] = spec
         return env
 
     sup = Supervisor(list(worker_argv), replicas, env=base_env,

@@ -1,0 +1,111 @@
+"""Jax-free DASE engine for the production-day soak harness
+(tests/test_soak.py): the full scenario surface in one tiny engine.
+
+- ``train`` builds a per-user score table from "rate" events. A
+  PENDING ``poison-train`` control event (more poison-train than
+  ``antidote`` events in the log) yields a GATE-PASSING poisoned model:
+  the golden query answers, arrays are finite, but every other user's
+  predict raises — the post-swap watch must roll it back. The driver
+  inserts the antidote after triggering the poisoned retrain so later
+  retrains come up clean (consumed-once, like a fold-in cursor).
+- ``fold_in`` merges rate events into a COPY; ``poison-nan`` /
+  ``poison-serve`` ride the DATA exactly as in tests/foldin_engine.py
+  (gate refusal / watch rollback); ``poison-train``/``antidote`` are
+  train-side controls and are ignored here.
+
+Both the soak subprocesses (`pio train` / `pio deploy --engine-dir`)
+and the test process import this module by name (the template dir
+rides sys.path), so pickled models round-trip across processes."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from incubator_predictionio_tpu.controller.algorithm import Algorithm
+from incubator_predictionio_tpu.controller.datasource import DataSource
+from incubator_predictionio_tpu.controller.engine import Engine
+
+
+@dataclasses.dataclass
+class SoakModel:
+    scores: dict           # user id -> accumulated rating
+    weights: np.ndarray    # finite unless nan-poisoned
+    poison: str = ""       # "" | "serve"
+
+    def example_query(self):
+        # warm-up / probe / swap-gate golden-query protocol
+        return {"user": "golden"}
+
+
+class SoakDataSource(DataSource):
+    def read_training(self, ctx):
+        s = ctx.get_storage()
+        app = (s.get_meta_data_apps().get_by_name(ctx.app_name)
+               if ctx.app_name else None)
+        return list(s.get_l_events().find(app.id)) if app else []
+
+
+class SoakAlgorithm(Algorithm):
+    def train(self, ctx, events):
+        scores: dict = {}
+        n_poison = n_antidote = 0
+        for e in events:
+            if e.event == "rate" and e.entity_id:
+                r = float(e.properties.get_or_else("rating", 1.0))
+                scores[e.entity_id] = scores.get(e.entity_id, 0.0) + r
+            elif e.event == "poison-train":
+                n_poison += 1
+            elif e.event == "antidote":
+                n_antidote += 1
+        poison = "serve" if n_poison > n_antidote else ""
+        return SoakModel(scores=scores, weights=np.ones(3),
+                         poison=poison)
+
+    def predict(self, model, query):
+        user = str(query["user"])
+        if model.poison == "serve" and user != "golden":
+            raise RuntimeError("poisoned retrain: predict exploded")
+        if user == "golden" or user in model.scores:
+            return {"user": user, "known": True,
+                    "score": float(model.scores.get(user, 0.0))}
+        return {"user": user, "known": False}
+
+    def fold_in(self, model, events, ctx, data_source_params=None):
+        scores = dict(model.scores)
+        weights = model.weights
+        poison = model.poison
+        changed = False
+        for e in events:
+            name = e.get("event")
+            uid = e.get("entityId")
+            if name == "poison-nan":
+                weights = np.array([1.0, float("nan")])
+                changed = True
+            elif name == "poison-serve":
+                poison = "serve"
+                changed = True
+            elif name == "rate" and uid:
+                props = e.get("properties") or {}
+                try:
+                    r = float(props.get("rating", 1.0))
+                except (TypeError, ValueError):
+                    r = 1.0
+                scores[str(uid)] = scores.get(str(uid), 0.0) + r
+                changed = True
+            # poison-train / antidote are TRAIN-side controls: ignored
+        if not changed:
+            return None
+        return SoakModel(scores=scores, weights=weights, poison=poison)
+
+    # no jax: the pickled payload is the model itself
+    def prepare_model_for_persistence(self, model):
+        return model
+
+    def restore_model(self, stored, ctx):
+        return stored
+
+
+def engine_factory() -> Engine:
+    return Engine(SoakDataSource, None, {"": SoakAlgorithm}, None)

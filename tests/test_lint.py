@@ -643,3 +643,81 @@ def test_console_lint_verb_never_imports_jax():
     # the profile table names the flow rules: they RAN in that process
     assert "transitive-blocking-on-loop" in r.stderr
     assert "fault-point-coverage" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14: soak registry rules (SLO metrics documented, fault points armed)
+# ---------------------------------------------------------------------------
+
+def test_soak_slo_registry_seeded_violations(tmp_path):
+    files = {
+        "workflow/soak.py": '''
+            SLO_METRICS = (
+                "pio_documented_total",
+                "pio_ghost_family_total",
+                "BadName_total",
+            )
+            FAULT_POINTS = {}
+        ''',
+    }
+    docs = {"operations.md": "| `pio_documented_total` | counts |\n"}
+    fs = findings_for(tmp_path, files, ["soak-slo-registry"], docs)
+    msgs = [f.message for f in fs]
+    assert len(fs) == 2, msgs
+    assert any("pio_ghost_family_total" in m
+               and "not a documented metric family" in m for m in msgs)
+    assert any("BadName_total" in m and "naming convention" in m
+               for m in msgs)
+    # a renamed/removed registry literal is itself a finding, never a
+    # silent pass
+    fs = findings_for(
+        tmp_path / "renamed", {"workflow/soak.py": "OTHER = 1\n"},
+        ["soak-slo-registry"], docs)
+    assert len(fs) == 1 and "SLO_METRICS" in fs[0].message
+    # no soak module at all (seeded trees for other rules): clean
+    assert findings_for(tmp_path / "nosoak",
+                        {"workflow/other.py": "X = 1\n"},
+                        ["soak-slo-registry"], docs) == []
+
+
+def test_soak_fault_registry_seeded_violations(tmp_path):
+    files = {
+        "workflow/soak.py": '''
+            SLO_METRICS = ()
+            FAULT_POINTS = {
+                "worker_kill": "ingest.commit",
+                "ghost_fault": "nobody.arms",
+            }
+        ''',
+        "data/api/thing.py": '''
+            from ...common import faultinject
+
+            def commit():
+                faultinject.fault_point("ingest.commit")
+        ''',
+    }
+    fs = findings_for(tmp_path, files, ["soak-fault-registry"])
+    assert len(fs) == 1, [f.message for f in fs]
+    assert "ghost_fault" in fs[0].message
+    assert "nobody.arms" in fs[0].message
+    # the registry literal disappearing is a finding
+    fs = findings_for(
+        tmp_path / "renamed", {"workflow/soak.py": "SLO_METRICS = ()\n"},
+        ["soak-fault-registry"])
+    assert len(fs) == 1 and "FAULT_POINTS" in fs[0].message
+
+
+def test_spawn_confinement_still_fires_outside_the_soak_driver(tmp_path):
+    """The soak driver's spawn exemption must not widen the rule: any
+    OTHER workflow/ module spawning a process is still a finding."""
+    src = '''
+        import subprocess
+
+        def launch():
+            subprocess.Popen(["x"])
+    '''
+    fs = findings_for(tmp_path / "rogue", {"workflow/rogue.py": src},
+                      ["spawn-confinement"])
+    assert len(fs) == 1 and "rogue" in fs[0].path
+    assert findings_for(tmp_path / "driver", {"workflow/soak.py": src},
+                        ["spawn-confinement"]) == []

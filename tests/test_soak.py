@@ -1,0 +1,566 @@
+""""Production day" soak harness (ISSUE 14).
+
+The whole story under SLOs, exercised at three depths:
+
+- driver UNITS: the scenario planner is seed-deterministic, the
+  exactly-once ledger reconciliation and the SLO evaluator are pure —
+  every red path is proven against seeded-violation fixtures
+- the faultinject ``at:`` mode (time-scheduled arming) fires the right
+  submode at the right offset and rejects malformed rules
+- the tier-1 SMOKE soak runs the REAL subprocess topology scaled down
+  (1 event worker, single-process engine, 3 faults) through the full
+  SLO assertion path; the slow-marked HEADLINE runs the full fault
+  menu against the 2-worker + 2-replica fleet topology
+"""
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+import requests
+
+from incubator_predictionio_tpu.common import faultinject
+from incubator_predictionio_tpu.workflow import soak
+from incubator_predictionio_tpu.workflow.soak import (
+    FAULT_MENU, SoakConfig, evaluate_slos, plan_scenario,
+    reconcile_ledger)
+
+from server_utils import ServerThread
+
+pytestmark = [pytest.mark.soak, pytest.mark.chaos]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _template(tmp_path, app_name="soakapp"):
+    """A real engine template dir: soak_engine.py + engine.json, so
+    `pio train` / `pio deploy --engine-dir` load it like any other
+    template project."""
+    tpl = tmp_path / "template"
+    tpl.mkdir()
+    shutil.copy(os.path.join(HERE, "soak_engine.py"), tpl)
+    (tpl / "engine.json").write_text(json.dumps({
+        "id": "default",
+        "engineFactory": "soak_engine.engine_factory",
+        "datasource": {"params": {"appName": app_name}},
+        "algorithms": [{"name": "", "params": {}}],
+    }))
+    return str(tpl)
+
+
+# ---------------------------------------------------------------------------
+# faultinject: the at: (time-scheduled arming) mode
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def chaos(monkeypatch):
+    def arm(spec):
+        monkeypatch.setenv("PIO_FAULT_SPEC", spec)
+        faultinject.reset()
+        faultinject.arm()
+    yield arm
+    monkeypatch.delenv("PIO_FAULT_SPEC", raising=False)
+    faultinject.reset()
+
+
+def test_at_mode_fires_after_offset_then_is_spent(chaos):
+    chaos("a.b:at:60;c.d:at:40:oserr:28;e.f:at:0;g.h:at:30:latency:0.02")
+    # before the offsets: matching calls pass untouched and do NOT
+    # consume the rules
+    faultinject.fault_point("a.b")
+    faultinject.fault_point("c.d")
+    with pytest.raises(faultinject.InjectedFault):
+        faultinject.fault_point("e.f")          # offset 0: due now
+    time.sleep(0.08)
+    with pytest.raises(faultinject.InjectedFault):
+        faultinject.fault_point("a.b")          # default submode: fail
+    try:
+        faultinject.fault_point("c.d")
+        raise AssertionError("oserr submode did not fire")
+    except OSError as e:
+        assert e.errno == 28
+        assert not isinstance(e, faultinject.InjectedFault)
+    t0 = time.monotonic()
+    faultinject.fault_point("g.h")              # latency submode
+    assert time.monotonic() - t0 >= 0.015
+    # spent: every later call passes
+    for p in ("a.b", "c.d", "e.f", "g.h"):
+        faultinject.fault_point(p)
+
+
+def test_at_mode_clock_rearms_with_the_plan(chaos):
+    chaos("x.y:at:30")
+    faultinject.fault_point("x.y")              # not due yet
+    time.sleep(0.05)
+    chaos("x.y:at:30")                          # NEW plan: clock resets
+    faultinject.fault_point("x.y")              # not due again
+    time.sleep(0.05)
+    with pytest.raises(faultinject.InjectedFault):
+        faultinject.fault_point("x.y")
+
+
+def test_at_mode_rejects_malformed_rules(monkeypatch):
+    for bad in ("x:at:abc", "x:at:-5", "x:at:5:zap", "x:at:5:oserr",
+                "x:at:5:latency"):
+        monkeypatch.setenv("PIO_FAULT_SPEC", bad)
+        faultinject.reset()
+        with pytest.raises(ValueError):
+            faultinject.fault_point("x")
+    monkeypatch.delenv("PIO_FAULT_SPEC")
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# planner: seed determinism, crash assignment, topology-aware drops
+# ---------------------------------------------------------------------------
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("engine_dir", str(tmp_path / "nope"))
+    kw.setdefault("workdir", str(tmp_path / "wd"))
+    return SoakConfig(**kw)
+
+
+def test_plan_is_seed_deterministic(tmp_path):
+    a = plan_scenario(_cfg(tmp_path, seed=7))
+    b = plan_scenario(_cfg(tmp_path, seed=7))
+    c = plan_scenario(_cfg(tmp_path, seed=8))
+    assert [(f.name, f.at_s, f.target, f.spec) for f in a.faults] == \
+        [(f.name, f.at_s, f.target, f.spec) for f in b.faults]
+    assert a.app_weights == b.app_weights
+    assert a.user_weights == b.user_weights
+    assert [(f.name, f.at_s) for f in a.faults] != \
+        [(f.name, f.at_s) for f in c.faults]
+    # the resolved plan prints every fault with its offset + SLOs
+    text = a.describe()
+    for f in a.faults:
+        assert f.name in text
+    assert "SLOs:" in text and "fault timeline:" in text
+
+
+def test_plan_one_crash_rule_per_worker_and_replica_drop(tmp_path):
+    # 2 workers: worker_kill and compact_crash land on DIFFERENT
+    # workers (a first-launch process dies at its first crash rule)
+    plan = plan_scenario(_cfg(tmp_path, event_workers=2, replicas=2))
+    targets = {f.name: f.target for f in plan.faults}
+    assert targets["worker_kill"] != targets["compact_crash"]
+    specs = "\n".join(plan.worker_specs.values())
+    assert "ingest.commit:at:" in specs and ":crash" in specs
+    assert "compact.rename:at:" in specs
+    assert "jsonl.append:at:" in specs and ":oserr:28" in specs
+    assert plan.replica_specs and all(
+        "query.serve:at:" in s for s in plan.replica_specs.values())
+    # 1 worker: only ONE crash fault fits; the second drops loudly
+    p1 = plan_scenario(_cfg(tmp_path, event_workers=1, replicas=0))
+    names = [f.name for f in p1.faults]
+    assert "worker_kill" in names and "compact_crash" not in names
+    assert any("compact_crash dropped" in n for n in p1.notes)
+    # replicas < 2: replica_kill is dropped with a reason
+    assert "replica_kill" not in names
+    assert any("replica_kill dropped" in n for n in p1.notes)
+
+
+def test_plan_primary_app_comes_from_engine_json(tmp_path):
+    tpl = _template(tmp_path, app_name="myprimary")
+    plan = plan_scenario(_cfg(tmp_path, engine_dir=tpl, apps=3))
+    assert plan.app_names[0] == "myprimary"
+    assert len(plan.app_names) == 3
+
+
+# ---------------------------------------------------------------------------
+# ledger reconciliation (exactly-once census)
+# ---------------------------------------------------------------------------
+
+def test_reconcile_ledger_counts_lost_dup_ambiguous(tmp_path):
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import App
+    from incubator_predictionio_tpu.data.storage.datamap import DataMap
+    from incubator_predictionio_tpu.data.storage.event import Event
+
+    storage = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "JL",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_JL_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_JL_PATH": str(tmp_path / "events"),
+    })
+    app_id = storage.get_meta_data_apps().insert(App(0, "recapp"))
+    le = storage.get_l_events()
+
+    def put(marker, n=1):
+        for _ in range(n):
+            le.insert(Event(event="rate", entity_type="user",
+                            entity_id="u", target_entity_type="item",
+                            target_entity_id="i",
+                            properties=DataMap({"marker": marker})),
+                      app_id)
+
+    put("m-ok")
+    put("m-dup", 2)                  # landed twice: NEVER allowed
+    put("m-amb")                     # conn-error send that landed
+    ledger = soak._Ledger()
+    ledger.acked = [("recapp", "m-ok", "e1", "commit"),
+                    ("recapp", "m-dup", "e2", "enqueue"),
+                    ("recapp", "m-lost", "e3", "batch")]
+    ledger.unacked = [("recapp", "m-amb", "conn-error"),
+                      ("recapp", "m-gone", "conn-error")]
+    rec = reconcile_ledger(storage, ledger, {"recapp": app_id}, {})
+    assert rec["lostAckedCount"] == 1
+    assert rec["lostAcked"] == [("recapp", "m-lost")]
+    assert rec["duplicatedCount"] == 1
+    assert rec["duplicated"][0][:2] == ("recapp", "m-dup")
+    assert rec["ambiguousSends"] == 2 and rec["ambiguousLanded"] == 1
+    assert rec["walReplay"] is None  # WAL off in this env
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluator: a green fixture, then every red path seeded
+# ---------------------------------------------------------------------------
+
+def _green_fixture(tmp_path):
+    """Plan + observations for a fully green soak (full menu, 2+2
+    topology); each violation test perturbs exactly one input."""
+    cfg = _cfg(tmp_path, event_workers=2, replicas=2,
+               rollback_deadline_s=30.0)
+    plan = plan_scenario(cfg)
+    at = {f.name: f.at_s for f in plan.faults}
+    ledger = soak._Ledger()
+    ledger.acked = [("a", f"m{i}", f"e{i}", "commit") for i in range(10)]
+    ledger.ingest_codes = {201: 10}
+    ledger.query_codes = {200: 50}
+    ledger.latencies = [0.01 * i for i in range(1, 51)]
+    samples = soak._Samples()
+    samples.metric_max = {
+        'pio_ingest_append_errors_total{kind="enospc"}': 1.0,
+        'pio_foldin_rollbacks_total{reason="error-rate"}': 1.0,
+        'pio_fleet_rollbacks_total{reason="error-rate"}': 2.0,
+    }
+    samples.restarts = {"replica:1": 1}
+    samples.served = [(1.0, "iid-initial"), (at["good_retrain"] + 6,
+                                             "iid-good")]
+    samples.rollback_seen = [
+        (at["poison_foldin"] + 3, "fleet:iid-pf",
+         "directive pin error-rate"),
+        (at["poison_retrain"] + 7, "fleet:iid-pr",
+         "directive pin error-rate"),
+    ]
+    samples.foldin_publishes = 5
+    supervisor_doc = {"workers": [{"worker": 0, "restarts": 1},
+                                  {"worker": 1, "restarts": 1}]}
+    fault_log = [
+        {"name": "poison_foldin", "atS": at["poison_foldin"],
+         "firedAtS": at["poison_foldin"], "ok": True},
+        {"name": "good_retrain", "atS": at["good_retrain"],
+         "firedAtS": at["good_retrain"], "ok": True,
+         "instance": "iid-good"},
+        {"name": "poison_retrain", "atS": at["poison_retrain"],
+         "firedAtS": at["poison_retrain"], "ok": True,
+         "instance": "iid-poison"},
+    ]
+    reconciliation = {"ackedEvents": 10, "storeMarkers": 10,
+                      "lostAcked": [], "lostAckedCount": 0,
+                      "duplicated": [], "duplicatedCount": 0,
+                      "ambiguousSends": 0, "ambiguousLanded": 0,
+                      "walReplay": None}
+    freshness = {"finalLagS": 0.1, "boundS": 0.5}
+    drain = {"engine": 0, "eventserver": 0}
+    return dict(plan=plan, ledger=ledger, samples=samples,
+                reconciliation=reconciliation, freshness=freshness,
+                drain=drain, supervisor_doc=supervisor_doc,
+                fault_log=fault_log)
+
+
+def _eval(fx):
+    return evaluate_slos(fx["plan"], fx["ledger"], fx["samples"],
+                         fx["reconciliation"], fx["freshness"],
+                         fx["drain"], fx["supervisor_doc"],
+                         fx["fault_log"])
+
+
+def _slo(slos, name):
+    return next(s for s in slos if s["name"] == name)
+
+
+def test_slo_evaluator_green_fixture_passes(tmp_path):
+    slos, faults = _eval(_green_fixture(tmp_path))
+    bad = [s["name"] for s in slos if not s["ok"]]
+    assert not bad, (bad, slos)
+    assert all(f["evidence"] for f in faults), faults
+    assert len(faults) == 7
+
+
+def test_slo_acked_loss_and_duplicates_red(tmp_path):
+    fx = _green_fixture(tmp_path)
+    fx["reconciliation"]["lostAckedCount"] = 2
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "acked-event-loss")["ok"]
+    fx = _green_fixture(tmp_path)
+    fx["reconciliation"]["duplicatedCount"] = 1
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "acked-event-loss")["ok"]
+
+
+def test_slo_http_codes_red_on_500_anywhere(tmp_path):
+    fx = _green_fixture(tmp_path)
+    fx["ledger"].ingest_codes = {201: 9, 500: 1}
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "http-codes")["ok"]
+    fx = _green_fixture(tmp_path)
+    fx["ledger"].query_codes = {200: 49, 502: 1}
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "http-codes")["ok"]
+    # 503/504 are the overload contract, not violations
+    fx = _green_fixture(tmp_path)
+    fx["ledger"].ingest_codes = {201: 9, 503: 5}
+    fx["ledger"].query_codes = {200: 40, 503: 5, 504: 5}
+    slos, _ = _eval(fx)
+    assert _slo(slos, "http-codes")["ok"]
+
+
+def test_slo_p99_red_over_bound_and_red_with_no_accepts(tmp_path):
+    fx = _green_fixture(tmp_path)
+    fx["ledger"].latencies = [0.01] * 95 + [9.0] * 5
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "query-p99")["ok"]
+    fx = _green_fixture(tmp_path)
+    fx["ledger"].latencies = []          # zero accepted queries
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "query-p99")["ok"]
+
+
+def test_slo_rollback_window_red_paths(tmp_path):
+    # a missing observation fails
+    fx = _green_fixture(tmp_path)
+    fx["samples"].rollback_seen = fx["samples"].rollback_seen[:1]
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "rollback-window")["ok"]
+    # a too-late observation fails
+    fx = _green_fixture(tmp_path)
+    at = {f.name: f.at_s for f in fx["plan"].faults}
+    fx["samples"].rollback_seen[1] = (
+        at["poison_retrain"] + 31, "fleet:iid-pr", "late pin")
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "rollback-window")["ok"]
+    # ONE observation cannot satisfy BOTH poisons (keys consumed)
+    fx = _green_fixture(tmp_path)
+    fx["samples"].rollback_seen = [fx["samples"].rollback_seen[0]]
+    fx["fault_log"][2]["firedAtS"] = fx["fault_log"][0]["firedAtS"]
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "rollback-window")["ok"]
+
+
+def test_slo_freshness_red_when_stale_or_never_produced(tmp_path):
+    fx = _green_fixture(tmp_path)
+    fx["freshness"] = {"finalLagS": 2.0, "boundS": 0.5}
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "foldin-freshness")["ok"]
+    fx = _green_fixture(tmp_path)
+    fx["freshness"] = {"finalLagS": None, "boundS": 0.5}
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "foldin-freshness")["ok"]
+
+
+def test_slo_conn_errors_and_drain_red(tmp_path):
+    fx = _green_fixture(tmp_path)
+    fx["ledger"].ingest_conn_errors = 10 ** 6
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "conn-errors")["ok"]
+    fx = _green_fixture(tmp_path)
+    fx["drain"] = {"engine": 0, "eventserver": 1}
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "clean-drain")["ok"]
+    fx = _green_fixture(tmp_path)
+    fx["drain"] = {"engine": 0}          # one front never drained
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "clean-drain")["ok"]
+
+
+def test_slo_fault_evidence_red_per_fault_kind(tmp_path):
+    # missing ENOSPC counter
+    fx = _green_fixture(tmp_path)
+    del fx["samples"].metric_max[
+        'pio_ingest_append_errors_total{kind="enospc"}']
+    slos, faults = _eval(fx)
+    assert not _slo(slos, "fault-evidence")["ok"]
+    assert "enospc_shed" in _slo(slos, "fault-evidence")["value"]
+    # worker restart never observed
+    fx = _green_fixture(tmp_path)
+    fx["supervisor_doc"] = {"workers": [{"worker": 0, "restarts": 0},
+                                        {"worker": 1, "restarts": 1}]}
+    slos, _ = _eval(fx)
+    assert "worker_kill" in _slo(slos, "fault-evidence")["value"]
+    # replica restart never observed
+    fx = _green_fixture(tmp_path)
+    fx["samples"].restarts = {}
+    slos, _ = _eval(fx)
+    assert "replica_kill" in _slo(slos, "fault-evidence")["value"]
+    # good retrain completed but never observed serving
+    fx = _green_fixture(tmp_path)
+    fx["samples"].served = [(1.0, "iid-initial")]
+    slos, _ = _eval(fx)
+    assert "good_retrain" in _slo(slos, "fault-evidence")["value"]
+
+
+# ---------------------------------------------------------------------------
+# X-Pio-Ack: per-request ack-mode override on the event server
+# ---------------------------------------------------------------------------
+
+def test_x_pio_ack_header_overrides_server_default(memory_storage):
+    from incubator_predictionio_tpu.data.api.event_server import (
+        EventServer)
+    from incubator_predictionio_tpu.data.storage.base import (
+        AccessKey, App)
+
+    app_id = memory_storage.get_meta_data_apps().insert(App(0, "ackapp"))
+    key = memory_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+    server = EventServer(memory_storage)
+    assert not server.ingest.ack_on_enqueue      # default: commit
+    ev = {"event": "rate", "entityType": "user", "entityId": "u1",
+          "targetEntityType": "item", "targetEntityId": "i1"}
+    with ServerThread(server.app) as st:
+        url = f"{st.base}/events.json?accessKey={key}"
+        for mode in ("enqueue", "commit"):
+            r = requests.post(url, json=ev,
+                              headers={"X-Pio-Ack": mode}, timeout=10)
+            assert r.status_code == 201, (mode, r.text)
+        r = requests.post(url, json=ev,
+                          headers={"X-Pio-Ack": "later"}, timeout=10)
+        assert r.status_code == 400
+        assert "X-Pio-Ack" in r.json()["message"]
+        # enqueue-acked events still validate inline: a bad body is a
+        # real 400, not a silent drop behind the ack
+        r = requests.post(url, json={"event": ""},
+                          headers={"X-Pio-Ack": "enqueue"}, timeout=10)
+        assert r.status_code == 400
+    # both acked events landed exactly once
+    evs = list(memory_storage.get_l_events().find(app_id))
+    assert len(evs) == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces: --dry-run plan, pio status one-liner
+# ---------------------------------------------------------------------------
+
+def test_pio_soak_dry_run_prints_plan_without_launching(tmp_path,
+                                                        capsys):
+    from incubator_predictionio_tpu.tools.commands.soak import soak_cmd
+
+    tpl = _template(tmp_path)
+    rc = soak_cmd(["--engine-dir", tpl, "--dry-run", "--seed", "99",
+                   "--duration-s", "30"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fault timeline:" in out and "SLOs:" in out
+    assert "phases:" in out and "topology:" in out
+    assert "seed 99" in out and "soakapp" in out
+    assert "(dry run: nothing launched)" in out
+    # deterministic: the same seed prints the same timeline
+    soak_cmd(["--engine-dir", tpl, "--dry-run", "--seed", "99",
+              "--duration-s", "30"])
+    assert capsys.readouterr().out == out
+    # nothing was created in the scratch area of the plan
+    assert not (tmp_path / "wd").exists()
+
+
+def test_pio_status_soak_one_liner(tmp_path, capsys, monkeypatch):
+    from incubator_predictionio_tpu.tools.commands.management import (
+        _print_soak_verdict)
+
+    monkeypatch.chdir(tmp_path)
+    _print_soak_verdict()                       # no scorecard: silent
+    assert capsys.readouterr().out == ""
+    (tmp_path / "SOAK.json").write_text(json.dumps({
+        "verdict": "PASS", "seed": 77, "startedAt": time.time() - 3600,
+        "slos": [{"name": "acked-event-loss", "ok": True},
+                 {"name": "query-p99", "ok": True}],
+        "faults": [{"name": "worker_kill", "fired": True},
+                   {"name": "enospc_shed", "fired": True}]}))
+    _print_soak_verdict()
+    out = capsys.readouterr().out
+    assert "[info] Last soak" in out and "PASS" in out
+    assert "2/2 SLO(s) green" in out and "seed 77" in out
+    (tmp_path / "SOAK.json").write_text(json.dumps({
+        "verdict": "FAIL", "seed": 78, "startedAt": time.time(),
+        "slos": [{"name": "acked-event-loss", "ok": False},
+                 {"name": "query-p99", "ok": True}],
+        "faults": [{"name": "worker_kill", "fired": True}]}))
+    _print_soak_verdict()
+    out = capsys.readouterr().out
+    assert "[warn]" in out and "FAIL" in out
+    assert "VIOLATED: acked-event-loss" in out
+    assert "pio soak --seed 78" in out
+
+
+def test_soak_marker_registered():
+    with open(os.path.join(os.path.dirname(HERE),
+                           "pyproject.toml")) as f:
+        assert "soak:" in f.read()
+
+
+# ---------------------------------------------------------------------------
+# the REAL thing: smoke soak (tier-1) + headline (slow)
+# ---------------------------------------------------------------------------
+
+def _run(cfg):
+    plan = plan_scenario(cfg)
+    from incubator_predictionio_tpu.workflow.soak import run_soak
+
+    scorecard = run_soak(plan)
+    assert scorecard["verdict"] == "PASS", json.dumps(
+        {"slos": scorecard["slos"], "faults": scorecard["faults"],
+         "traffic": scorecard["traffic"],
+         "planNotes": scorecard["planNotes"]}, indent=1, default=str)
+    return scorecard
+
+
+def test_smoke_soak_scaled_down_topology_full_slo_path(tmp_path):
+    """The tier-1 acceptance: a REAL subprocess topology (partitioned
+    event server, single-process engine with refresh + fold-in) under
+    mixed zipfian load, with a scheduled ENOSPC, a poisoned fold-in
+    increment and a worker SIGKILL mid-commit — every SLO asserted,
+    scorecard persisted, exactly-once ledger reconciled."""
+    cfg = SoakConfig(
+        engine_dir=_template(tmp_path), workdir=str(tmp_path / "wd"),
+        seed=42, duration_s=14.0, event_workers=1, replicas=0, apps=2,
+        ingest_rps=12.0, query_rps=6.0,
+        faults=("enospc_shed", "poison_foldin", "worker_kill"),
+        foldin_ms=150.0, refresh_ms=400.0, swap_watch_ms=1500.0,
+        rollback_deadline_s=25.0, freshness_settle_s=15.0,
+        out_path=str(tmp_path / "SOAK.json"))
+    scorecard = _run(cfg)
+    assert scorecard["seed"] == 42
+    assert [f["name"] for f in scorecard["faults"]] == [
+        "enospc_shed", "poison_foldin", "worker_kill"]
+    assert all(f["fired"] and f["evidence"]
+               for f in scorecard["faults"])
+    t = scorecard["traffic"]
+    assert t["acked"] > 50 and t["acceptedQueries"] > 20
+    assert scorecard["reconciliation"]["ackedEvents"] == t["acked"]
+    # the scorecard landed on disk and reads back
+    on_disk = soak.read_scorecard(str(tmp_path / "SOAK.json"))
+    assert on_disk and on_disk["verdict"] == "PASS"
+    # the workdir was cleaned up (keep_workdir defaults off)
+    assert not (tmp_path / "wd").exists()
+
+
+@pytest.mark.slow
+def test_headline_soak_full_menu_fleet_topology(tmp_path):
+    """The acceptance headline: 2 fenced event workers + a 2-replica
+    engine fleet with staged canary + fold-in producer, full fault
+    menu (7 distinct faults incl. replica SIGKILL mid-flood, compaction
+    crash, poisoned retrain under a deploy freeze) — green scorecard,
+    zero acked loss, rollback windows held."""
+    cfg = SoakConfig(
+        engine_dir=_template(tmp_path), workdir=str(tmp_path / "wd"),
+        seed=20260804, duration_s=70.0, event_workers=2, replicas=2,
+        apps=3, ingest_rps=40.0, query_rps=16.0,
+        foldin_ms=250.0, swap_watch_ms=2500.0, fleet_sync_ms=200.0,
+        rollback_deadline_s=30.0, freshness_settle_s=20.0,
+        out_path=str(tmp_path / "SOAK.json"))
+    scorecard = _run(cfg)
+    fired = [f["name"] for f in scorecard["faults"] if f["fired"]]
+    assert len(fired) >= 5 and set(fired) == set(FAULT_MENU)
+    assert scorecard["traffic"]["acked"] > 500
