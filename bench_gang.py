@@ -147,10 +147,286 @@ def bench_stall(tmp) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# partition-feed bracket: feed-path A/B + training scale-out (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+FEED_EVENTS = 120_000
+FEED_SHARDS = 4
+FEED_USERS, FEED_ITEMS = 3000, 1500
+
+
+def _host_calibration() -> float:
+    """Single-thread Python Mops (bench_ingest's common denominator)."""
+    t0 = time.perf_counter()
+    s = 0
+    for i in range(2_000_000):
+        s += i
+    return 2.0 / (time.perf_counter() - t0)
+
+
+def _build_feed_workspace(tmp: str) -> dict:
+    """SQLITE metadata/models + a JSONL event log partitioned into
+    FEED_SHARDS shards, every shard compacted then appended past the
+    snapshot, plus the recommendation engine dir `pio train` loads."""
+    import numpy as np
+
+    from incubator_predictionio_tpu.data.api import event_log
+    from incubator_predictionio_tpu.data.storage.base import App
+    from incubator_predictionio_tpu.data.storage.datamap import DataMap
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
+    from incubator_predictionio_tpu.data.storage.registry import Storage
+
+    ws = os.path.join(tmp, "feed_ws")
+    os.makedirs(ws)
+    env = {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "JL",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": os.path.join(ws, "meta.sqlite"),
+        "PIO_STORAGE_SOURCES_JL_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_JL_PATH": os.path.join(ws, "events"),
+    }
+    storage = Storage(env)
+    storage.get_meta_data_apps().insert(App(id=1, name="feedbench"))
+    events_dir = storage.get_l_events().events_dir
+    rng = np.random.default_rng(20260804)
+    per = FEED_EVENTS // FEED_SHARDS
+    import datetime as dt
+
+    t0 = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc)
+    for part in range(FEED_SHARDS):
+        os.environ["PIO_EVENT_PARTITION"] = str(part)
+        st = JSONLEvents(events_dir)
+        u = rng.integers(0, FEED_USERS, per)
+        it = rng.integers(0, FEED_ITEMS, per)
+        r = rng.integers(1, 6, per)
+        # compacted prefix (90%) + uncovered tail (10%)
+        cut = int(per * 0.9)
+        for lo, hi in ((0, cut), (cut, per)):
+            st.insert_batch([
+                Event(event="rate", entity_type="user",
+                      entity_id=str(u[j]), target_entity_type="item",
+                      target_entity_id=str(it[j]),
+                      properties=DataMap({"rating": float(r[j])}),
+                      event_time=t0)
+                for j in range(lo, hi)], 1)
+            if lo == 0:
+                path = os.path.join(events_dir,
+                                    f"events_1.p{part}.jsonl")
+                assert event_log.compact_log(path)
+    os.environ.pop("PIO_EVENT_PARTITION", None)
+    engine_dir = os.path.join(ws, "engine")
+    os.makedirs(engine_dir)
+    with open(os.path.join(engine_dir, "engine.json"), "w") as f:
+        json.dump({
+            "id": "default",
+            "engineFactory": "incubator_predictionio_tpu.models."
+                             "recommendation.RecommendationEngine",
+            "datasource": {"params": {"appName": "feedbench"}},
+            "algorithms": [{"name": "", "params": {
+                "rank": 8, "numIterations": 4, "lambda": 0.05,
+                "seed": 5}}],
+        }, f)
+    return {"ws": ws, "env": env, "events_dir": events_dir,
+            "engine_dir": engine_dir}
+
+
+def bench_feed_ab(events_dir: str, rounds: int = 3) -> dict:
+    """Same-run A/B/C: per-gang-worker training-read cost of
+    (A) the partition-local colseg feed (this worker's shards only,
+    snapshot prefix + tail parse, no merge), vs (B) the merged view
+    (all shards, snapshot-seeded cold build + interning remap — what
+    every gang worker used to pay), vs (C) the merged view with the
+    snapshots hidden (pure JSON re-parse — the pre-compaction floor).
+    Workers=2: A scans half the shards; B/C always scan all of them."""
+    import shutil
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.data.api import partition_feed as pf
+    from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
+
+    def read_partition_feed() -> int:
+        total = 0
+        feed = pf.PartitionFeed(events_dir, 1, None, 0, 2)
+        for path in feed.shard_list():
+            shard = pf.scan_shard(path)
+            sr = pf.PartitionFeed.shard_ratings(shard, ["rate", "buy"])
+            total += len(sr.rating)
+        return total
+
+    def read_merged() -> int:
+        st = JSONLEvents(events_dir)   # fresh: a train process is cold
+        cols, rows = st.scan_columnar(1, None, ["rate", "buy"])
+        return int(rows.size)
+
+    manifests = [os.path.join(events_dir, n)
+                 for n in os.listdir(events_dir)
+                 if n.endswith(".manifest")]
+
+    def read_merged_json() -> int:
+        for m in manifests:   # hide the snapshots: force the re-parse
+            shutil.move(m, m + ".hide")
+        try:
+            return read_merged()
+        finally:
+            for m in manifests:
+                shutil.move(m + ".hide", m)
+
+    t_a, t_b, t_c = [], [], []
+    n_a = n_b = 0
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        n_a = read_partition_feed()
+        t_a.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        n_b = read_merged()
+        t_b.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        read_merged_json()
+        t_c.append(time.perf_counter() - t0)
+    out = {
+        "workers": 2,
+        "shards": FEED_SHARDS,
+        "events_total": FEED_EVENTS,
+        "events_this_worker": n_a,
+        "merged_rows": n_b,
+        "partition_feed_worker_ms": round(
+            float(np.median(t_a)) * 1000, 1),
+        "merged_view_worker_ms": round(float(np.median(t_b)) * 1000, 1),
+        "merged_json_reparse_worker_ms": round(
+            float(np.median(t_c)) * 1000, 1),
+        # within-round ratios, then median (host CPU swings within runs)
+        "speedup_vs_merged": round(float(np.median(
+            [b / a for a, b in zip(t_a, t_b)])), 2),
+        "speedup_vs_merged_json": round(float(np.median(
+            [c / a for a, c in zip(t_a, t_c)])), 2),
+    }
+    log(f"[gang-bench] feed A/B: {out}")
+    return out
+
+
+def _run_train(env: dict, engine_dir: str, num_workers: int,
+               tmp: str) -> float:
+    argv = [sys.executable, "-m",
+            "incubator_predictionio_tpu.tools.console", "train",
+            "--engine-dir", engine_dir]
+    if num_workers > 1:
+        argv += ["--num-workers", str(num_workers)]
+    run_env = {
+        **os.environ, **env,
+        "PIO_TRAIN_FEED": "partition",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(tmp, "xla_cache"),
+    }
+    run_env.pop("PIO_FAULT_SPEC", None)
+    t0 = time.perf_counter()
+    proc = __import__("subprocess").run(
+        argv, env=run_env, capture_output=True, text=True, timeout=900)
+    wall = time.perf_counter() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"train --num-workers {num_workers} rc={proc.returncode}: "
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    return wall
+
+
+def bench_feed_scaling(ws: dict, tmp: str, rounds: int = 2) -> dict:
+    """REAL `pio train --num-workers N` wall-clock, 1/2/4 workers,
+    same-run interleaved rounds; speedups are medians of WITHIN-round
+    ratios (PR 8 precedent — this host's CPU swings severalfold inside
+    one run)."""
+    import numpy as np
+
+    walls = {1: [], 2: [], 4: []}
+    _run_train(ws["env"], ws["engine_dir"], 1, tmp)  # compile warm-up
+    for rnd in range(rounds):
+        for n in (1, 2, 4):
+            w = _run_train(ws["env"], ws["engine_dir"], n, tmp)
+            walls[n].append(w)
+            log(f"[gang-bench] round {rnd} train x{n}: {w:.1f}s")
+    out = {"rounds": rounds}
+    for n in (1, 2, 4):
+        out[f"train_wall_s_{n}"] = round(float(np.median(walls[n])), 1)
+    for n in (2, 4):
+        out[f"speedup_{n}"] = round(float(np.median(
+            [w1 / wn for w1, wn in zip(walls[1], walls[n])])), 2)
+    if out["speedup_2"] < 1.0:
+        out["note"] = (
+            "end-to-end gang wall at bench scale is dominated by "
+            "per-process fixed costs (jax import + distributed init + "
+            "compile, ~10s each here) and per-iteration gloo "
+            "collectives, not by the data work the feed splits — the "
+            "ceiling control shows whether the HOST could overlap "
+            "processes; the feed A/B above is the per-worker axis "
+            "that scales with data volume")
+    return out
+
+
+def bench_feed_ceiling(ws: dict, tmp: str) -> dict:
+    """Host scale-out ceiling control: TWO fully independent
+    single-process trains run concurrently vs one alone — the best any
+    2-worker architecture could do on this host. 1.0 = two fit for
+    free; 0.5 = fully serialized cores."""
+    import concurrent.futures as cf
+    import shutil
+
+    import numpy as np
+
+    # a second, fully independent workspace (same data): concurrent
+    # trains must not share a sqlite file or an engine group
+    ws2 = os.path.join(tmp, "feed_ws2")
+    shutil.copytree(ws["ws"], ws2)
+    env2 = {**ws["env"],
+            "PIO_STORAGE_SOURCES_DB_PATH": os.path.join(
+                ws2, "meta.sqlite"),
+            "PIO_STORAGE_SOURCES_JL_PATH": os.path.join(ws2, "events")}
+    eng2 = os.path.join(ws2, "engine")
+
+    one = _run_train(ws["env"], ws["engine_dir"], 1, tmp)
+    t0 = time.perf_counter()
+    with cf.ThreadPoolExecutor(2) as pool:
+        f1 = pool.submit(_run_train, ws["env"], ws["engine_dir"], 1, tmp)
+        f2 = pool.submit(_run_train, env2, eng2, 1, tmp)
+        f1.result()
+        f2.result()
+    pair = time.perf_counter() - t0
+    out = {"one_train_s": round(one, 1),
+           "two_concurrent_trains_s": round(pair, 1),
+           "ceiling": round(float(np.median([one / pair])), 2)}
+    if out["ceiling"] < 0.9:
+        out["note"] = (
+            "host-limited: two independent trains cannot run "
+            "concurrently for free on this box — scale-out speedups "
+            "above are bounded by the host, not the architecture "
+            "(PR 3/8 precedent)")
+    log(f"[gang-bench] ceiling control: {out}")
+    return out
+
+
+def bench_feed(tmp: str) -> dict:
+    ws = _build_feed_workspace(tmp)
+    results = {
+        "events": FEED_EVENTS,
+        "shards": FEED_SHARDS,
+        "host_loop_mops": round(_host_calibration(), 1),
+        "feed_ab": bench_feed_ab(ws["events_dir"]),
+    }
+    if os.environ.get("PIO_GANG_BENCH_SCALING", "1") != "0":
+        results["scaling"] = bench_feed_scaling(ws, tmp)
+        results["host_scaleout_ceiling"] = bench_feed_ceiling(ws, tmp)
+    return results
+
+
 def main() -> int:
     import tempfile
 
     results = {"num_workers": 2, "n_iters": N_ITERS}
+    feed_results = None
     with tempfile.TemporaryDirectory(prefix="pio_gang_bench_") as tmp:
         t0 = time.time()
         log("[gang-bench] kill bracket ...")
@@ -158,21 +434,33 @@ def main() -> int:
         if os.environ.get("PIO_GANG_BENCH_STALL", "1") != "0":
             log("[gang-bench] stall bracket ...")
             results["stall"] = bench_stall(tmp)
+        if os.environ.get("PIO_GANG_BENCH_FEED", "1") != "0":
+            log("[gang-bench] partition-feed bracket ...")
+            t_feed = time.time()
+            feed_results = bench_feed(tmp)
+            feed_results["bench_seconds"] = round(time.time() - t_feed, 1)
         results["bench_seconds"] = round(time.time() - t0, 1)
 
-    # persist: BASELINE.json published bracket + the MULTICHIP file
+    # persist: BASELINE.json published brackets + the MULTICHIP file
     baseline_path = os.path.join(HERE, "BASELINE.json")
     try:
         with open(baseline_path) as f:
             doc = json.load(f)
         doc.setdefault("published", {})["measured_gang_recovery"] = results
+        if feed_results is not None:
+            doc["published"]["measured_gang_feed"] = feed_results
         with open(baseline_path, "w") as f:
             json.dump(doc, f, indent=2)
     except Exception as e:  # noqa: BLE001 - bench must still print
         log(f"[gang-bench] could not persist to BASELINE.json: {e}")
     with open(os.path.join(HERE, "MULTICHIP_gang.json"), "w") as f:
         json.dump({"metric": "gang supervised recovery (2 workers, "
-                             "sharded ALS, CPU gloo)", **results}, f,
+                             "sharded ALS, CPU gloo) + partition-local "
+                             "training feeds (1/2/4-worker bracket, "
+                             "feed-path A/B, ceiling control)",
+                   **results,
+                   **({"feed": feed_results}
+                      if feed_results is not None else {})}, f,
                   indent=2)
 
     print(json.dumps({
@@ -182,6 +470,11 @@ def main() -> int:
                   results["kill"]["recover_to_done_ms"]],
         **({"stall_detect_ms": results["stall"]["detect_stall_ms"]}
            if "stall" in results else {}),
+        **({"feed_speedup_vs_merged":
+            feed_results["feed_ab"]["speedup_vs_merged"],
+            "feed_speedup_vs_merged_json":
+            feed_results["feed_ab"]["speedup_vs_merged_json"]}
+           if feed_results is not None else {}),
     }))
     return 0
 

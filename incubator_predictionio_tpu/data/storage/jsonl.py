@@ -253,6 +253,118 @@ class _LogScan:
         return mask
 
 
+def scan_log_file(path: str) -> tuple[_LogScan, int, int]:
+    """One-shot scan of a single log shard — the partition-feed read
+    primitive (data/api/partition_feed.py): the committed colseg
+    snapshot covers its prefix with ZERO JSON parsing and only the
+    uncovered tail (bytes appended past the snapshot generation) is
+    decoded. Returns ``(scan, snapshot_bytes, tail_bytes)`` where the
+    byte split is the feed-path accounting the A/B bench and the
+    telemetry counters report. Unlike the cached ``_scan`` registry
+    this builds fresh state per call: training reads are episodic and
+    the caller (one gang worker per shard set) owns the lifetime."""
+    scan = _LogScan()
+    snap = _LogScan._try_snapshot(path)
+    snapshot_bytes = tail_bytes = 0
+    if snap is not None:
+        cols, covered = snap
+        scan.cols = cols
+        scan._merge_tombstones(scan.tombstones, cols)
+        scan.size = covered
+        snapshot_bytes = covered
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = 0
+    if size > scan.size:
+        with open(path, "rb") as f:
+            f.seek(scan.size)
+            tail = f.read()
+        cut = tail.rfind(b"\n") + 1  # complete lines only
+        if cut:
+            new = parse_events(tail[:cut])
+            if scan.cols is None:
+                scan.cols = new
+                scan._merge_tombstones(scan.tombstones, new)
+            else:
+                scan._extend(new)
+            scan.size += cut
+            tail_bytes = cut
+    if scan.cols is None:
+        scan.cols = parse_events(b"")
+    return scan, snapshot_bytes, tail_bytes
+
+
+def aggregate_replay(
+    cols: ColumnarEvents, rows: np.ndarray,
+    entity_type: Optional[str] = None,
+) -> dict[str, tuple[dict, int, int]]:
+    """$set/$unset/$delete replay over selected columnar rows →
+    ``{entity_id: (props, first_us, last_us)}`` with raw microsecond
+    times (``_TIME_ABSENT`` = the event carried none — callers decide
+    the "now" substitution). THE one replay implementation: the merged
+    read view (:meth:`JSONLEvents.aggregate_columnar`) and the
+    partition feed's per-shard aggregation share it, so the folding
+    semantics cannot drift. ``rows`` must already be filtered to the
+    $set/$unset/$delete selection."""
+    if rows.size == 0:
+        return {}
+    keep = cols.eid[rows] >= 0
+    if entity_type is not None:
+        et_table = cols.table(ColumnarEvents.TABLE_ETYPE)
+        try:
+            keep &= cols.etype[rows] == et_table.index(entity_type)
+        except ValueError:
+            return {}
+    rows = rows[keep]
+    ev_table = cols.table(ColumnarEvents.TABLE_EVENT)
+    codes = {n: ev_table.index(n)
+             for n in ("$set", "$unset", "$delete") if n in ev_table}
+    # ascending stable time order == sorted(find(), key=event_time),
+    # with absent times treated as "now" (sorts last, file order)
+    sort_t = cols.time_us[rows]
+    sort_t = np.where(sort_t == _TIME_ABSENT,
+                      np.iinfo(np.int64).max, sort_t)
+    rows = rows[np.argsort(sort_t, kind="stable")]
+
+    import json as _json
+
+    loads, raw = _json.loads, cols.raw
+    set_c = codes.get("$set", -1)
+    unset_c = codes.get("$unset", -2)
+    # hot loop over python scalars: tolist() beats per-element
+    # np.int64 indexing, and the props spans are sliced inline
+    ev_l = cols.event[rows].tolist()
+    eid_l = cols.eid[rows].tolist()
+    t_l = cols.time_us[rows].tolist()
+    span_l = cols.props[rows].tolist()
+    # replay keyed on interned entity codes; strings resolved once
+    state: dict[int, tuple[dict, int, int]] = {}
+    for e, c, t, (s0, e0) in zip(ev_l, eid_l, t_l, span_l):
+        if e == set_c:
+            d = loads(raw[s0:e0]) if s0 >= 0 else {}
+            got = state.get(c)
+            if got is not None:
+                props, first, _ = got
+                props.update(d)
+                state[c] = (props, first, t)
+            else:
+                state[c] = (d, t, t)
+        elif e == unset_c:
+            got = state.get(c)
+            if got is not None:
+                props, first, _ = got
+                if s0 >= 0:
+                    for k in loads(raw[s0:e0]):
+                        props.pop(k, None)
+                state[c] = (props, first, t)
+        else:  # $delete
+            state.pop(c, None)
+
+    eid_table = cols.table(ColumnarEvents.TABLE_EID)
+    return {eid_table[c]: v for c, v in state.items()}
+
+
 def _fsync_enabled() -> bool:
     from ...common import envknobs
 
@@ -851,59 +963,7 @@ class JSONLEvents(base.LEvents):
         cols, rows = self.scan_columnar(
             app_id, channel_id, ["$set", "$unset", "$delete"],
             start_time, until_time)
-        if rows.size == 0:
-            return {}
-        keep = cols.eid[rows] >= 0
-        if entity_type is not None:
-            et_table = cols.table(ColumnarEvents.TABLE_ETYPE)
-            try:
-                keep &= cols.etype[rows] == et_table.index(entity_type)
-            except ValueError:
-                return {}
-        rows = rows[keep]
-        ev_table = cols.table(ColumnarEvents.TABLE_EVENT)
-        codes = {n: ev_table.index(n)
-                 for n in ("$set", "$unset", "$delete") if n in ev_table}
-        # ascending stable time order == sorted(find(), key=event_time),
-        # with absent times treated as "now" (sorts last, file order)
-        sort_t = cols.time_us[rows]
-        sort_t = np.where(sort_t == _TIME_ABSENT,
-                          np.iinfo(np.int64).max, sort_t)
-        rows = rows[np.argsort(sort_t, kind="stable")]
-
-        import json as _json
-
-        loads, raw = _json.loads, cols.raw
-        set_c = codes.get("$set", -1)
-        unset_c = codes.get("$unset", -2)
-        # hot loop over python scalars: tolist() beats per-element
-        # np.int64 indexing, and the props spans are sliced inline
-        ev_l = cols.event[rows].tolist()
-        eid_l = cols.eid[rows].tolist()
-        t_l = cols.time_us[rows].tolist()
-        span_l = cols.props[rows].tolist()
-        # replay keyed on interned entity codes; strings resolved once
-        state: dict[int, tuple[dict, int, int]] = {}
-        for e, c, t, (s0, e0) in zip(ev_l, eid_l, t_l, span_l):
-            if e == set_c:
-                d = loads(raw[s0:e0]) if s0 >= 0 else {}
-                got = state.get(c)
-                if got is not None:
-                    props, first, _ = got
-                    props.update(d)
-                    state[c] = (props, first, t)
-                else:
-                    state[c] = (d, t, t)
-            elif e == unset_c:
-                got = state.get(c)
-                if got is not None:
-                    props, first, _ = got
-                    if s0 >= 0:
-                        for k in loads(raw[s0:e0]):
-                            props.pop(k, None)
-                    state[c] = (props, first, t)
-            else:  # $delete
-                state.pop(c, None)
+        state = aggregate_replay(cols, rows, entity_type)
 
         now = _dt.datetime.now(_dt.timezone.utc)
 
@@ -912,10 +972,9 @@ class JSONLEvents(base.LEvents):
                 return now
             return _EPOCH + _dt.timedelta(microseconds=us)
 
-        eid_table = cols.table(ColumnarEvents.TABLE_EID)
         out = {
-            eid_table[c]: PropertyMap(props, us_dt(first), us_dt(last))
-            for c, (props, first, last) in state.items()
+            eid: PropertyMap(props, us_dt(first), us_dt(last))
+            for eid, (props, first, last) in state.items()
         }
         if required:
             req = set(required)

@@ -36,7 +36,9 @@ from ..ops.linear import (
     lr_sgd_steps,
     nb_fold_in,
     train_logistic_regression,
+    train_logistic_regression_process_local,
     train_naive_bayes,
+    train_naive_bayes_process_local,
 )
 from ..workflow.input_pipeline import pipeline_of as _pipeline_of
 
@@ -47,9 +49,18 @@ class TrainingData(SanityCheck):
     labels: np.ndarray  # [N] int32
     attribute_names: Sequence[str]
     label_values: np.ndarray  # class index → original label value
+    #: True when features/labels hold only THIS gang worker's strided
+    #: entity slice (workflow/train_feed.py) while label_values is the
+    #: allgathered GLOBAL class vocabulary — trainers must all-reduce.
+    partition_local: bool = False
+    #: gang-wide labeled-entity count (== len(features) when not
+    #: partition-local).
+    n_global: int = -1
 
     def sanity_check(self):
-        assert len(self.features) > 0, "no labeled entities found"
+        n = (self.n_global if self.partition_local
+             else len(self.features))
+        assert n > 0, "no labeled entities found"
         assert len(self.features) == len(self.labels)
 
 
@@ -71,6 +82,23 @@ class ClassificationDataSource(DataSource):
     def read_training(self, ctx) -> TrainingData:
         p: DataSourceParams = self.params
         app_name = p.app_name or ctx.app_name
+        storage = ctx.get_storage()
+        from ..workflow import train_feed
+
+        if train_feed.partition_feed_active(storage):
+            # gang data plane: per-partition $set replays allgathered
+            # as derived aggregates; this worker keeps its strided
+            # entity slice for the data-parallel trainers
+            feats, y, label_values, n_global = \
+                train_feed.partition_examples(
+                    app_name, p.entity_type, list(p.attributes),
+                    p.label, storage=storage,
+                    channel_name=ctx.channel_name)
+            return TrainingData(
+                features=feats, labels=y,
+                attribute_names=tuple(p.attributes),
+                label_values=label_values,
+                partition_local=True, n_global=n_global)
         props = PEventStore.aggregate_properties(
             app_name,
             p.entity_type,
@@ -216,12 +244,21 @@ class NaiveBayesAlgorithm(Algorithm):
                           host_bytes=pd.features.nbytes, cpu_passes=1.0)
 
     def train(self, ctx, pd: PreparedData) -> ClassifierModel:
-        model = train_naive_bayes(
-            pd.features, pd.labels, n_classes=len(pd.label_values),
-            smoothing=self.params.smoothing,
-            mesh=ctx.get_mesh() if ctx else None,
-            pipeline=_pipeline_of(ctx),
-        )
+        if getattr(pd, "partition_local", False):
+            # partition-local gang feed: stats psum across the gang
+            model = train_naive_bayes_process_local(
+                pd.features, pd.labels,
+                n_classes=len(pd.label_values),
+                smoothing=self.params.smoothing,
+                mesh=ctx.get_mesh() if ctx else None,
+            )
+        else:
+            model = train_naive_bayes(
+                pd.features, pd.labels, n_classes=len(pd.label_values),
+                smoothing=self.params.smoothing,
+                mesh=ctx.get_mesh() if ctx else None,
+                pipeline=_pipeline_of(ctx),
+            )
         return ClassifierModel(model, pd.attribute_names, pd.label_values)
 
     def predict(self, model: ClassifierModel, query: dict) -> dict:
@@ -303,12 +340,22 @@ class LogisticRegressionAlgorithm(Algorithm):
                           cpu_passes=iters * 10.0)
 
     def train(self, ctx, pd: PreparedData) -> ClassifierModel:
-        model = train_logistic_regression(
-            pd.features, pd.labels, n_classes=len(pd.label_values),
-            reg=self.params.reg, max_iters=self.params.max_iters,
-            mesh=ctx.get_mesh() if ctx else None,
-            pipeline=_pipeline_of(ctx),
-        )
+        if getattr(pd, "partition_local", False):
+            # partition-local gang feed: per-step gradient psum across
+            # the gang (synchronous data parallelism)
+            model = train_logistic_regression_process_local(
+                pd.features, pd.labels,
+                n_classes=len(pd.label_values),
+                reg=self.params.reg, max_iters=self.params.max_iters,
+                mesh=ctx.get_mesh() if ctx else None,
+            )
+        else:
+            model = train_logistic_regression(
+                pd.features, pd.labels, n_classes=len(pd.label_values),
+                reg=self.params.reg, max_iters=self.params.max_iters,
+                mesh=ctx.get_mesh() if ctx else None,
+                pipeline=_pipeline_of(ctx),
+            )
         return ClassifierModel(model, pd.attribute_names, pd.label_values)
 
     predict = NaiveBayesAlgorithm.predict

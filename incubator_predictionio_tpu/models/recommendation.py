@@ -33,7 +33,10 @@ from ..controller import (
 )
 from ..data.storage.bimap import BiMap, extend_bimap
 from ..data.store.p_event_store import PEventStore
-from ..ops.als import ALSFactors, ALSParams, fold_in_factors, train_als
+from ..ops.als import (
+    ALSFactors, ALSParams, fold_in_factors, train_als,
+    train_als_partition_local,
+)
 from ..workflow.input_pipeline import pipeline_of
 from ..ops.sharded_topk import (
     serving_mesh_for,
@@ -55,9 +58,19 @@ class TrainingData(SanityCheck):
     rating: np.ndarray
     users: BiMap
     items: BiMap
+    #: True when the triple holds only THIS gang worker's event-log
+    #: partitions (workflow/train_feed.py) while users/items are the
+    #: allgathered GLOBAL maps — the trainer must then all-reduce
+    #: instead of assuming the local data is complete.
+    partition_local: bool = False
 
     def sanity_check(self):
-        assert len(self.user_idx) > 0, "no rating events found"
+        if self.partition_local:
+            # a worker's own partitions can legitimately be empty; the
+            # GLOBAL vocabulary says whether the app has data at all
+            assert len(self.users) > 0, "no rating events found"
+        else:
+            assert len(self.user_idx) > 0, "no rating events found"
         assert len(self.user_idx) == len(self.item_idx) == len(self.rating)
 
 
@@ -128,12 +141,28 @@ class RecommendationDataSource(DataSource):
     def read_training(self, ctx) -> TrainingData:
         p: DataSourceParams = self.params
         app_name = p.app_name or ctx.app_name
+        storage = ctx.get_storage()
+        from ..workflow import train_feed
+
+        if train_feed.partition_feed_active(storage):
+            # gang data plane: this worker scans ONLY its event-log
+            # partitions (colseg snapshots + tail parse); the id maps
+            # are allgathered once — no merged-view fan-in
+            u, i, r, users, items = train_feed.partition_ratings(
+                app_name,
+                event_names=list(p.event_names),
+                event_default_ratings={"buy": p.buy_rating},
+                storage=storage,
+                channel_name=ctx.channel_name,
+            )
+            return TrainingData(u, i, r, users, items,
+                                partition_local=True)
         # "buy" events carry no rating property → template assigns one.
         u, i, r, users, items = PEventStore.find_ratings(
             app_name,
             event_names=list(p.event_names),
             event_default_ratings={"buy": p.buy_rating},
-            storage=ctx.get_storage(),
+            storage=storage,
             channel_name=ctx.channel_name,
         )
         return TrainingData(u, i, r, users, items)
@@ -224,20 +253,37 @@ class ALSAlgorithm(Algorithm):
 
     def train(self, ctx, pd: PreparedData) -> ALSModel:
         validate_serving_mode(self.params.sharded_serving)  # before the expensive run
-        factors = train_als(
-            pd.user_idx, pd.item_idx, pd.rating,
-            n_users=len(pd.users), n_items=len(pd.items),
-            params=self.als_params(self.params),
-            mesh=ctx.get_mesh() if ctx else None,
-            checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
-            resume=bool(ctx and ctx.workflow_params.resume),
-            nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
-            nan_guard_stage=getattr(ctx, "stage_label", "algorithm[als]"),
-            # bench.py measures the real product path by planting a
-            # timings dict on the context; absent in normal training.
-            timings=getattr(ctx, "bench_timings", None),
-            pipeline=pipeline_of(ctx),
-        )
+        if getattr(pd, "partition_local", False):
+            # partition-local gang feed: the triple is this worker's
+            # events only — all-reduce the per-row normal equations
+            # (falls back to the slab trainer when single-process)
+            factors = train_als_partition_local(
+                pd.user_idx, pd.item_idx, pd.rating,
+                n_users=len(pd.users), n_items=len(pd.items),
+                params=self.als_params(self.params),
+                mesh=ctx.get_mesh() if ctx else None,
+                checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
+                resume=bool(ctx and ctx.workflow_params.resume),
+                nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
+                nan_guard_stage=getattr(ctx, "stage_label",
+                                        "algorithm[als]"),
+            )
+        else:
+            factors = train_als(
+                pd.user_idx, pd.item_idx, pd.rating,
+                n_users=len(pd.users), n_items=len(pd.items),
+                params=self.als_params(self.params),
+                mesh=ctx.get_mesh() if ctx else None,
+                checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
+                resume=bool(ctx and ctx.workflow_params.resume),
+                nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
+                nan_guard_stage=getattr(ctx, "stage_label",
+                                        "algorithm[als]"),
+                # bench.py measures the real product path by planting a
+                # timings dict on the context; absent in normal training.
+                timings=getattr(ctx, "bench_timings", None),
+                pipeline=pipeline_of(ctx),
+            )
         model = ALSModel(factors=factors, users=pd.users, items=pd.items)
         model.serving_mesh = serving_mesh_for(
             ctx, len(pd.items), self.params.rank, self.params.sharded_serving)

@@ -24,7 +24,9 @@ import numpy as np
 from ..controller import Algorithm, DataSource, Engine, EngineFactory, Params, SanityCheck
 from ..data.storage.bimap import BiMap
 from ..data.store.p_event_store import PEventStore
-from ..ops.als import ALSFactors, ALSParams, train_als
+from ..ops.als import (
+    ALSFactors, ALSParams, train_als, train_als_partition_local,
+)
 from ..workflow.input_pipeline import pipeline_of
 from ..ops.sharded_topk import (
     serving_mesh_for,
@@ -44,9 +46,16 @@ class TrainingData(SanityCheck):
     users: BiMap
     items: BiMap
     item_categories: dict[str, set[str]]  # item id → categories
+    #: True when the triple holds only THIS gang worker's event-log
+    #: partitions (workflow/train_feed.py); users/items are the global
+    #: allgathered maps and the trainer must all-reduce.
+    partition_local: bool = False
 
     def sanity_check(self):
-        assert len(self.user_idx) > 0, "no view events found"
+        if self.partition_local:
+            assert len(self.users) > 0, "no view events found"
+        else:
+            assert len(self.user_idx) > 0, "no view events found"
 
 
 PreparedData = TrainingData
@@ -66,16 +75,39 @@ class SimilarProductDataSource(DataSource):
     def read_training(self, ctx) -> TrainingData:
         p: DataSourceParams = self.params
         app_name = p.app_name or ctx.app_name
+        storage = ctx.get_storage()
+        from ..workflow import train_feed
+
+        if train_feed.partition_feed_active(storage):
+            # gang data plane (workflow/train_feed.py): view events
+            # stream partition-local; the category metadata is the
+            # same allgathered property merge the classifiers use —
+            # one shared shard scan feeds BOTH extractions
+            feed_ctx = train_feed.open_feed(app_name, storage,
+                                            ctx.channel_name)
+            u, i, r, users, items = train_feed.partition_ratings(
+                app_name, event_names=list(p.event_names),
+                rating_from_props=False, storage=storage,
+                channel_name=ctx.channel_name, feed_ctx=feed_ctx)
+            cats = {
+                item_id: set(c)
+                for item_id, props in train_feed.partition_properties(
+                    app_name, p.item_entity_type, storage=storage,
+                    channel_name=ctx.channel_name,
+                    feed_ctx=feed_ctx).items()
+                if (c := props.get("categories"))}
+            return TrainingData(u, i, r, users, items, cats,
+                                partition_local=True)
         u, i, r, users, items = PEventStore.find_ratings(
             app_name,
             event_names=list(p.event_names),
             rating_from_props=False,
-            storage=ctx.get_storage(),
+            storage=storage,
             channel_name=ctx.channel_name,
         )
         cats: dict[str, set[str]] = {}
         for item_id, pm in PEventStore.aggregate_properties(
-            app_name, p.item_entity_type, storage=ctx.get_storage()
+            app_name, p.item_entity_type, storage=storage
         ).items():
             c = pm.get_opt("categories")
             if c:
@@ -169,22 +201,31 @@ class SimilarProductAlgorithm(Algorithm):
     def train(self, ctx, pd: PreparedData) -> SimilarProductModel:
         p = self.params
         validate_serving_mode(p.sharded_serving)  # before the expensive run
-        factors = train_als(
-            pd.user_idx, pd.item_idx, pd.rating,
-            n_users=len(pd.users), n_items=len(pd.items),
-            params=ALSParams(
-                rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
-                implicit_prefs=True, alpha=p.alpha,
-                seed=p.seed if p.seed is not None else 3,
-                compute_dtype=p.compute_dtype, chunk_tiles=p.chunk_tiles,
-            ),
+        als_params = ALSParams(
+            rank=p.rank, num_iterations=p.num_iterations, reg=p.reg,
+            implicit_prefs=True, alpha=p.alpha,
+            seed=p.seed if p.seed is not None else 3,
+            compute_dtype=p.compute_dtype, chunk_tiles=p.chunk_tiles,
+        )
+        common = dict(
             mesh=ctx.get_mesh() if ctx else None,
             checkpoint_hook=getattr(ctx, "checkpoint_hook", None),
             resume=bool(ctx and ctx.workflow_params.resume),
             nan_guard=bool(ctx and ctx.workflow_params.nan_guard),
-            nan_guard_stage=getattr(ctx, "stage_label", "algorithm[als]"),
-            pipeline=pipeline_of(ctx),
+            nan_guard_stage=getattr(ctx, "stage_label",
+                                    "algorithm[als]"),
         )
+        if getattr(pd, "partition_local", False):
+            # partition-local gang feed: gram all-reduce trainer
+            factors = train_als_partition_local(
+                pd.user_idx, pd.item_idx, pd.rating,
+                n_users=len(pd.users), n_items=len(pd.items),
+                params=als_params, **common)
+        else:
+            factors = train_als(
+                pd.user_idx, pd.item_idx, pd.rating,
+                n_users=len(pd.users), n_items=len(pd.items),
+                params=als_params, pipeline=pipeline_of(ctx), **common)
         model = SimilarProductModel(factors, pd.items, pd.item_categories)
         model.serving_mesh = serving_mesh_for(
             ctx, len(pd.items), p.rank, p.sharded_serving)

@@ -1260,6 +1260,416 @@ def train_als_process_sharded(
     )
 
 
+#: Cap on one fused gather→gram chunk's [CH, k, k] f32 outer-product
+#: slab in the partition-local trainer (the analog of _FUSED_SLAB_BYTES
+#: for the event-COO layout).
+_DP_CHUNK_BYTES = 64 * 1024 * 1024
+
+#: Checkpoint-fingerprint seed of the partition-local (event-sharded)
+#: layout — distinct from the slab layout's _LAYOUT_TAG so a snapshot
+#: written by one trainer is rejected deterministically by the other
+#: even when the factor shapes coincide.
+_DP_LAYOUT_TAG = 0x70_10_10_01
+
+
+def _dp_chunk(e_pad: int, k: int) -> int:
+    """Events per fused gram chunk: bounded so the [CH, k, k] f32
+    outer-product slab stays under _DP_CHUNK_BYTES."""
+    ch = max(512, _DP_CHUNK_BYTES // max(k * k * 4, 1))
+    return min(ch, max(e_pad, 1))
+
+
+def _make_dp_train_fn(mesh: Mesh, params: ALSParams, n_u_pad: int,
+                      n_i_pad: int, e_pad: int):
+    """Build the jitted partition-local (data-parallel) ALS loop.
+
+    Layout: the EVENT COO is sharded over the data axis (each gang
+    worker supplies only its partitions' events — arbitrary rows, any
+    order); factor matrices are replicated. One half-step computes
+    per-row normal-equation partials from the local events
+    (segment-sum of per-entry outer products — :func:`_grams_rows`
+    linearity is exactly why partition-partial grams are sound), then
+    **all-reduces the grams/rhs over the mesh** (the ALX replicated-
+    grams recipe, arxiv 2112.02194), solves each device's own factor
+    ROW BLOCK, and all-gathers the solved blocks back to a replicated
+    factor matrix. The only collectives are the gram psum and the
+    factor all-gather — no raw events ever cross the mesh. HBM bound:
+    O(n_rows·k²) for the replicated normal equations per device; the
+    slab trainer (:func:`train_als`) remains the path for models past
+    that bound.
+    """
+    params, _ = _resolve_params(mesh, params)
+    cd = jnp.bfloat16 if params.compute_dtype == "bfloat16" else jnp.float32
+    implicit = params.implicit_prefs
+    alpha = params.alpha
+    nratings = params.lambda_scaling == "nratings"
+    mesh_platform = mesh.devices.flat[0].platform
+    if MODEL_AXIS in mesh.axis_names:
+        raise ValueError(
+            "the partition-local feed trainer shards factor blocks over "
+            "the data axis only; 2-D (d, m) ALX meshes need the slab "
+            "trainer (train_als / train_als_process_sharded)")
+    d_size = mesh.shape[DATA_AXIS]
+    k = params.rank
+    rps_u = n_u_pad // d_size
+    rps_i = n_i_pad // d_size
+    ch = _dp_chunk(e_pad, k)
+    assert e_pad % ch == 0, (e_pad, ch)
+    n_ch = e_pad // ch
+    eye = np.eye(k, dtype=np.float32)
+
+    def lam_of(counts, reg):
+        lam = (reg * jnp.maximum(counts, 1.0) if nratings
+               else jnp.full(counts.shape, reg, jnp.float32))
+        return lam + jnp.where(counts == 0, 1e-6, 0.0)
+
+    def local_loop(n_iters, reg, x0, y0, u_loc, i_loc, r_loc, w_loc):
+        # per-row GLOBAL observation counts (for nratings λ and the
+        # zero-row conditioning), one psum each, computed once
+        cnt_u = jax.lax.psum(
+            jax.ops.segment_sum(w_loc, u_loc, num_segments=n_u_pad),
+            DATA_AXIS)
+        cnt_i = jax.lax.psum(
+            jax.ops.segment_sum(w_loc, i_loc, num_segments=n_i_pad),
+            DATA_AXIS)
+        lam_u, lam_i = lam_of(cnt_u, reg), lam_of(cnt_i, reg)
+
+        def half(y, rows, cols, lam, rps, n_pad):
+            y_cd = y.astype(cd)
+            yty = (jnp.einsum("nk,nm->km", y_cd, y_cd,
+                              preferred_element_type=jnp.float32)
+                   if implicit
+                   else jnp.zeros((k, k), jnp.float32))
+            if implicit:
+                # Hu-Koren-Volinsky per-entry weights (same algebra as
+                # _grams_rows' explicit-value implicit mode)
+                gw = alpha * r_loc * w_loc
+                bw = (1.0 + alpha * r_loc) * w_loc
+            else:
+                gw = w_loc
+                bw = r_loc * w_loc
+
+            def chunk(c, acc):
+                g_acc, b_acc = acc
+                sl = lambda a: jax.lax.dynamic_slice_in_dim(  # noqa: E731
+                    a, c * ch, ch)
+                cc, rr = sl(cols), sl(rows)
+                p = jnp.take(y_cd, cc, axis=0)          # [CH, k]
+                outer = jnp.einsum(
+                    "ek,em->ekm", p * sl(gw)[:, None].astype(cd), p,
+                    preferred_element_type=jnp.float32)
+                rhs = jnp.einsum(
+                    "ek,e->ek", p, sl(bw).astype(cd),
+                    preferred_element_type=jnp.float32)
+                return (g_acc + jax.ops.segment_sum(
+                            outer, rr, num_segments=n_pad),
+                        b_acc + jax.ops.segment_sum(
+                            rhs, rr, num_segments=n_pad))
+
+            g0 = jnp.zeros((n_pad, k, k), jnp.float32)
+            b0 = jnp.zeros((n_pad, k), jnp.float32)
+            grams, rhs = jax.lax.fori_loop(0, n_ch, chunk, (g0, b0))
+            # replicated grams across the mesh (ALX): partition
+            # partials sum to the full normal equations
+            grams = jax.lax.psum(grams, DATA_AXIS)
+            rhs = jax.lax.psum(rhs, DATA_AXIS)
+            idx = jax.lax.axis_index(DATA_AXIS)
+            a_blk = jax.lax.dynamic_slice_in_dim(grams, idx * rps, rps)
+            b_blk = jax.lax.dynamic_slice_in_dim(rhs, idx * rps, rps)
+            lam_blk = jax.lax.dynamic_slice_in_dim(lam, idx * rps, rps)
+            if implicit:
+                a_blk = a_blk + yty[None, :, :]
+            a_blk = a_blk + lam_blk[:, None, None] * eye
+            x_blk = batched_spd_solve(a_blk, b_blk, vma=(DATA_AXIS,),
+                                      platform=mesh_platform)
+            # factor blocks sharded over the data axis re-assemble to
+            # the replicated matrix the next half-step gathers from
+            return jax.lax.all_gather(
+                x_blk.astype(jnp.float32), DATA_AXIS, axis=0,
+                tiled=True)
+
+        def body(_, carry):
+            x, y = carry
+            x = half(y, u_loc, i_loc, lam_u, rps_u, n_u_pad)
+            y = half(x, i_loc, u_loc, lam_i, rps_i, n_i_pad)
+            return (x, y)
+
+        return jax.lax.fori_loop(0, n_iters, body, (x0, y0))
+
+    rep = P()
+    row1 = P(DATA_AXIS)
+    fn = shard_map(
+        local_loop, mesh=mesh,
+        in_specs=(rep, rep, rep, rep, row1, row1, row1, row1),
+        out_specs=(rep, rep))
+    in_shardings = tuple(
+        NamedSharding(mesh, s)
+        for s in (rep, rep, rep, rep, row1, row1, row1, row1))
+    fitted = jax.jit(fn, in_shardings=in_shardings,
+                     out_shardings=(NamedSharding(mesh, rep),) * 2)
+    return fitted, in_shardings
+
+
+_dp_fn_cache: dict = {}
+
+
+def _cached_dp_train_fn(mesh: Mesh, params: ALSParams, n_u_pad: int,
+                        n_i_pad: int, e_pad: int):
+    key = (
+        tuple(id(d) for d in mesh.devices.flat), mesh.axis_names,
+        # lambda_scaling is non-shaping for the SLAB trainer (λ arrives
+        # as data) but the dp kernel computes λ in-graph from counts —
+        # the branch is baked into the executable, so it must key it
+        _executable_params_key(params), params.lambda_scaling,
+        n_u_pad, n_i_pad, e_pad,
+        jax.process_count(),
+    )
+    hit = _dp_fn_cache.get(key)
+    if hit is None:
+        hit = _make_dp_train_fn(mesh, params, n_u_pad, n_i_pad, e_pad)
+        if len(_dp_fn_cache) > 8:
+            _dp_fn_cache.clear()
+        _dp_fn_cache[key] = hit
+    return hit
+
+
+def train_als_partition_local(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    rating: np.ndarray,
+    n_users: int,
+    n_items: int,
+    params: ALSParams,
+    mesh: Optional[Mesh] = None,
+    checkpoint_hook=None,
+    resume: bool = False,
+    nan_guard: bool = False,
+    nan_guard_stage: str = "algorithm[als]",
+    force_dp: bool = False,
+) -> ALSFactors:
+    """ALS over PARTITION-LOCAL events: each gang process passes only
+    the (user, item, rating) triple its event-log partitions hold —
+    any rows, any order, already mapped to GLOBAL indices via the
+    allgathered id vocabularies (workflow/train_feed.py). Unlike
+    :func:`train_als_process_sharded` there is no row-ownership
+    contract on the input: per-row normal equations are linear in
+    per-event contributions, so partition partials all-reduce to the
+    exact full-data equations (see :func:`_make_dp_train_fn`).
+
+    Single-process calls fall back to :func:`train_als` (the data is
+    complete locally, and the slab trainer is the faster single-host
+    path); ``force_dp=True`` runs the data-parallel kernel anyway —
+    the math-parity tests rely on it.
+
+    ``checkpoint_hook``/``resume``/``nan_guard``: same contracts as
+    the other trainers (chunked dispatch through one traced-n_iters
+    executable, gang beats after every dispatch, allgathered drain at
+    chunk boundaries, per-iteration finite probe under nan_guard).
+    """
+    mesh = mesh or default_mesh()
+    if jax.process_count() == 1 and not force_dp:
+        return train_als(user_idx, item_idx, rating, n_users, n_items,
+                         params, mesh=mesh,
+                         checkpoint_hook=checkpoint_hook, resume=resume,
+                         nan_guard=nan_guard,
+                         nan_guard_stage=nan_guard_stage)
+    d_size, m_size = _mesh_dims(mesh)
+    if m_size != 1:
+        raise ValueError(
+            "partition-local training needs a 1-D data mesh (factor "
+            "blocks shard over 'd'); unset PIO_MESH_SHAPE's model axis")
+    n_proc = jax.process_count()
+    if d_size % n_proc:
+        raise ValueError(
+            f"data axis size {d_size} is not divisible by {n_proc} "
+            "processes")
+    n_local_devs = d_size // n_proc
+    # The jit signature must agree across the gang: no per-process
+    # auto-detection (a worker whose partitions happen to be all-ones
+    # must not compile a different program than its peers).
+    if params.binary_ratings is None:
+        params = dataclasses.replace(params, binary_ratings=False)
+
+    u = np.asarray(user_idx, np.int64)
+    i = np.asarray(item_idx, np.int64)
+    r = np.asarray(rating, np.float32)
+    if u.size and (u.min() < 0 or u.max() >= n_users):
+        raise ValueError("user_idx outside [0, n_users)")
+    if i.size and (i.min() < 0 or i.max() >= n_items):
+        raise ValueError("item_idx outside [0, n_items)")
+
+    def roundup(n, m):
+        return max(m, -(-n // m) * m)
+
+    n_u_pad = roundup(n_users, d_size)
+    n_i_pad = roundup(n_items, d_size)
+
+    from jax.experimental import multihost_utils
+
+    def agather(v):
+        if n_proc == 1:
+            return np.asarray([v])
+        return np.asarray(
+            multihost_utils.process_allgather(np.int32(v))).reshape(-1)
+
+    # per-DEVICE event capacity: the max over the gang, so every shard
+    # carries the same (padded) event count and the jit signature is
+    # identical everywhere
+    e_dev = int(agather(-(-max(u.size, 1) // n_local_devs)).max())
+    ch = _dp_chunk(e_dev, params.rank)
+    e_dev = roundup(e_dev, ch)
+    e_local = e_dev * n_local_devs
+
+    def pad_to(a, fill=0):
+        out = np.full(e_local, fill, a.dtype)
+        out[:a.size] = a
+        return out
+
+    u_loc = pad_to(u.astype(np.int32))
+    i_loc = pad_to(i.astype(np.int32))
+    r_loc = pad_to(r)
+    w_loc = pad_to(np.ones(u.size, np.float32))
+
+    fn, in_shardings = _cached_dp_train_fn(mesh, params, n_u_pad,
+                                           n_i_pad, e_dev)
+
+    k = params.rank
+    rng = np.random.default_rng(params.seed)
+    x0 = np.zeros((n_u_pad, k), np.float32)
+    y0 = np.zeros((n_i_pad, k), np.float32)
+    # same per-row init values as _fresh_init (global row order, same
+    # seed) so the partition-fed gang tracks a merged-feed train_als
+    # run row for row
+    x0[:n_users] = (rng.standard_normal((n_users, k))
+                    / np.sqrt(k)).astype(np.float32)
+    y0[:n_items] = (rng.standard_normal((n_items, k))
+                    / np.sqrt(k)).astype(np.float32)
+
+    fingerprint = None
+    if checkpoint_hook is not None:
+        import zlib
+
+        local_fp = zlib.crc32(
+            r.tobytes(),
+            zlib.crc32(i.tobytes(),
+                       zlib.crc32(u.tobytes(), _DP_LAYOUT_TAG)))
+        if n_proc > 1:
+            all_fp = np.asarray(multihost_utils.process_allgather(
+                np.int64(local_fp))).reshape(-1)
+        else:
+            all_fp = np.asarray([local_fp], np.int64)
+        fingerprint = zlib.crc32(
+            all_fp.tobytes(),
+            zlib.crc32(np.int64(n_users).tobytes(),
+                       zlib.crc32(np.int64(n_items).tobytes(),
+                                  _DP_LAYOUT_TAG)))
+
+    start_iter = 0
+    rx0 = ry0 = None
+    if checkpoint_hook is not None and resume:
+        from ..workflow.checkpoint import CheckpointIncompatibleError
+
+        step = checkpoint_hook.latest_step()
+        if step is not None and step < params.num_iterations:
+            start_iter, tree = checkpoint_hook.restore(step)
+            rx = np.asarray(tree["user_factors"])
+            ry = np.asarray(tree["item_factors"])
+            if rx.shape != x0.shape or ry.shape != y0.shape or \
+                    int(np.asarray(tree.get("fingerprint", -1))) \
+                    != fingerprint:
+                raise CheckpointIncompatibleError(
+                    "checkpoint does not match the current partition-"
+                    "local layout/data — retrain from scratch")
+            rx0, ry0 = rx, ry
+    if rx0 is not None:
+        x0, y0 = rx0, ry0
+
+    def _rep(host, sharding):
+        if n_proc == 1:
+            return np.asarray(host)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    def _sharded(host, sharding):
+        if n_proc == 1:
+            return host
+        return jax.make_array_from_process_local_data(
+            sharding, host, (host.shape[0] * n_proc,))
+
+    reg = np.float32(params.reg)
+    gx = _rep(x0, in_shardings[2])
+    gy = _rep(y0, in_shardings[3])
+    ev_args = tuple(
+        _sharded(a, s) for a, s in zip(
+            (u_loc, i_loc, r_loc, w_loc), in_shardings[4:]))
+
+    def dispatch(n, x, y):
+        return fn(np.int32(n), reg, x, y, *ev_args)
+
+    chunk = (checkpoint_hook.every_n
+             if checkpoint_hook is not None and checkpoint_hook.enabled
+             else 0)
+
+    def save(it, x, y):
+        checkpoint_hook.save(
+            it, {"user_factors": np.asarray(jax.device_get(x)),
+                 "item_factors": np.asarray(jax.device_get(y)),
+                 "fingerprint": np.int64(fingerprint)})
+
+    if nan_guard:
+        from ..common.nan_guard import NaNGuardError
+
+        finite_probe = jax.jit(
+            lambda a, b: jnp.isfinite(a).all() & jnp.isfinite(b).all())
+        x, y = gx, gy
+        for it in range(start_iter, params.num_iterations):
+            fault_point("train.sweep")
+            x, y = dispatch(1, x, y)
+            gang.beat()  # after the dispatch: sweep 1 includes compile
+            if not bool(jax.device_get(finite_probe(x, y))):
+                raise NaNGuardError(
+                    f"stage: {nan_guard_stage}, iteration {it + 1}: "
+                    "non-finite factors (check input ratings for "
+                    "NaN/Inf or raise the regularization)")
+            done = it + 1
+            saved = False
+            if chunk and done % chunk == 0 \
+                    and done < params.num_iterations:
+                save(done, x, y)
+                saved = True
+                gang.beat()
+            if done < params.num_iterations \
+                    and gang.drain_requested_global():
+                if chunk and not saved:
+                    save(done, x, y)
+                raise gang.GangDrainRequested(done)
+    elif chunk and params.num_iterations - start_iter > chunk:
+        x, y = gx, gy
+        it = start_iter
+        while it < params.num_iterations:
+            fault_point("train.sweep")
+            n = min(chunk, params.num_iterations - it)
+            x, y = dispatch(n, x, y)
+            gang.beat()
+            it += n
+            if it < params.num_iterations:
+                save(it, x, y)
+                gang.beat()  # a save (manager init, barriers) is slow too
+                if gang.drain_requested_global():
+                    raise gang.GangDrainRequested(it)
+    else:
+        fault_point("train.sweep")
+        x, y = dispatch(params.num_iterations - start_iter, gx, gy)
+        gang.beat()
+    x, y = jax.device_get((x, y))
+    return ALSFactors(
+        user_factors=np.asarray(x)[:n_users],
+        item_factors=np.asarray(y)[:n_items],
+        n_users=n_users,
+        n_items=n_items,
+    )
+
+
 def fold_in_factors(y, obs_idx, obs_val, *, reg: float,
                     lambda_scaling: str = "plain",
                     implicit_prefs: bool = False, alpha: float = 1.0,

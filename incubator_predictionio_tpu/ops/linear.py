@@ -701,3 +701,122 @@ def train_logistic_regression(
         intercept=np.asarray(b, np.float32),
         n_classes=n_classes,
     )
+
+
+# ---------------------------------------------------------------------------
+# partition-local (process-sharded) entry points — SparkNet-style
+# synchronous data parallelism (arxiv 1511.06051) over the gang mesh
+# ---------------------------------------------------------------------------
+
+
+def _assemble_process_shards(x: np.ndarray, y: np.ndarray,
+                             mesh: Mesh):
+    """Assemble each gang process's LOCAL example block into global
+    row-sharded arrays: rows are padded (mask 0) to the gang-wide
+    per-device maximum so every process compiles the identical
+    program, then stitched with ``make_array_from_process_local_data``.
+    Row ownership is irrelevant — both consumers reduce with psum'd
+    sums that zero-mask rows contribute nothing to. Returns
+    ``(xp, yp, maskp, n_global)`` with ``n_global`` the gang-wide real
+    example count (the loss normalizer).
+
+    No wire narrowing here on purpose: the narrow dtype is a function
+    of the LOCAL block, and per-process dtype disagreement would
+    compile divergent programs across the gang.
+    """
+    from jax.experimental import multihost_utils
+
+    n_proc = jax.process_count()
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    if n_dev % n_proc:
+        raise ValueError(
+            f"{n_dev} devices do not divide {n_proc} processes")
+    local_devs = n_dev // n_proc
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.int32)
+    n_local = x.shape[0]
+
+    def agather(v):
+        return np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray(v, np.int32))).reshape(-1)
+
+    per_dev = int(agather(-(-max(n_local, 1) // local_devs)).max())
+    n_global = int(agather(n_local).sum())
+    rows_local = per_dev * local_devs
+
+    def pad_block(a):
+        out = np.zeros((rows_local,) + a.shape[1:], a.dtype)
+        out[:n_local] = a
+        return out
+
+    xl = pad_block(x)
+    yl = pad_block(y)
+    ml = pad_block(np.ones(n_local, np.float32))
+    shard2 = NamedSharding(mesh, P(DATA_AXIS, None))
+    shard1 = NamedSharding(mesh, P(DATA_AXIS))
+
+    def to_global(a, sh):
+        if n_proc == 1:
+            return fast_put(a, sh)
+        return jax.make_array_from_process_local_data(
+            sh, a, (a.shape[0] * n_proc,) + a.shape[1:])
+
+    return (to_global(xl, shard2), to_global(yl, shard1),
+            to_global(ml, shard1), n_global)
+
+
+def train_naive_bayes_process_local(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    smoothing: float = 1.0,
+    mesh: Optional[Mesh] = None,
+) -> NaiveBayesModel:
+    """NB where each gang process holds only ITS event-log partitions'
+    examples (workflow/train_feed.py). Sufficient statistics are pure
+    sums, so the psum XLA inserts for the row-sharded one-hot matmul
+    IS the cross-partition reduction — the result is exactly the
+    single-process model over the union (integer counts sum exactly in
+    f32). ``n_classes`` must be the gang-agreed GLOBAL class count
+    (the label vocabulary is allgathered by the feed orchestrator)."""
+    mesh = mesh or default_mesh()
+    if jax.process_count() == 1:
+        return train_naive_bayes(x, y, n_classes, smoothing=smoothing,
+                                 mesh=mesh)
+    xp, yp, wp, _n = _assemble_process_shards(x, y, mesh)
+    feat, counts = jax.device_get(_nb_stats(xp, yp, wp, n_classes))
+    return nb_model_from_counts(feat, counts, n_classes, smoothing)
+
+
+def train_logistic_regression_process_local(
+    x: np.ndarray,
+    y: np.ndarray,
+    n_classes: int,
+    reg: float = 0.0,
+    max_iters: int = 100,
+    tol: float = 1e-6,
+    mesh: Optional[Mesh] = None,
+) -> LogisticRegressionModel:
+    """LR over partition-local example blocks: the SAME jitted L-BFGS
+    (:func:`_lr_fit`) the single-process path runs — its loss/grad
+    sums are row-sharded psums, so feeding each process its own
+    partitions' rows (mask-padded to a common shape) yields
+    synchronous data-parallel training with gradients all-reduced
+    every step (SparkNet, arxiv 1511.06051). The loss normalizer is
+    the gang-wide example count."""
+    mesh = mesh or default_mesh()
+    if jax.process_count() == 1:
+        return train_logistic_regression(
+            x, y, n_classes, reg=reg, max_iters=max_iters, tol=tol,
+            mesh=mesh)
+    xp, yp, maskp, n_global = _assemble_process_shards(x, y, mesh)
+    params = _lr_fit(xp, yp, maskp, jnp.float32(n_global),
+                     jnp.float32(reg), jnp.float32(tol),
+                     jnp.int32(max_iters), n_classes)
+    w, b = jax.device_get(params)
+    return LogisticRegressionModel(
+        weights=np.asarray(w, np.float32),
+        intercept=np.asarray(b, np.float32),
+        n_classes=n_classes,
+    )

@@ -80,6 +80,14 @@ def train_cmd(args: list[str]) -> int:
                         "(liveness + heartbeat monitoring, automatic "
                         "checkpoint gang-restart; default $PIO_NUM_WORKERS, "
                         "else 1 = in-process)")
+    p.add_argument("--feed", choices=("partition", "merged"), default=None,
+                   help="training data plane: 'partition' = each gang "
+                        "worker reads only its event-log partitions "
+                        "(colseg snapshot scans, id maps allgathered; "
+                        "the gang default), 'merged' = every worker "
+                        "reads the merged view (the pre-partition-feed "
+                        "behavior; default $PIO_TRAIN_FEED, else "
+                        "'partition' for gangs / 'merged' in-process)")
     ns = p.parse_args(args)
 
     from ...common import envknobs
@@ -87,7 +95,14 @@ def train_cmd(args: list[str]) -> int:
     num_workers = (ns.num_workers if ns.num_workers is not None
                    else envknobs.env_int("PIO_NUM_WORKERS", 1, lo=1))
     supervised_worker = envknobs.env_flag("PIO_GANG_WORKER", False)
+    if ns.feed:
+        # explicit flag wins over env, for this process AND (via
+        # inherited env) every gang worker it spawns
+        os.environ["PIO_TRAIN_FEED"] = ns.feed
     if num_workers > 1 and not supervised_worker:
+        # gang default: the partitioned event log IS the training data
+        # plane (workflow/train_feed.py); merged stays one flag away
+        os.environ.setdefault("PIO_TRAIN_FEED", "partition")
         return _train_supervised(args, ns, num_workers)
     from ...parallel.distributed import initialize_distributed
 

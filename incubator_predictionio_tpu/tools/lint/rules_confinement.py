@@ -272,6 +272,48 @@ def query_dispatch_gate(project: Project) -> Iterable[Finding]:
                       "_dispatch_query")
 
 
+#: merged-view scan entries + shard-file access primitives banned on
+#: the training path (see train_feed_confinement)
+_FEED_BANNED_REFS = ("_merged_scan", "shard_paths", "scan_log_file")
+_FEED_BANNED_CALLS = ("find_batches",)
+
+
+@rule("train-feed-confinement",
+      "training-path modules under workflow/ and ops/ must not read "
+      "events through the merged JSON view (_merged_scan / "
+      "find_batches) or touch shard files directly (shard_paths / "
+      "scan_log_file) — the partition-feed reader API "
+      "(data/api/partition_feed.py) is the one sanctioned shard "
+      "access, so gang training provably reads zero merged bytes")
+def train_feed_confinement(project: Project) -> Iterable[Finding]:
+    for sub in ("workflow/", "ops/"):
+        for m in project.modules(sub):
+            if m.tree is None:
+                continue
+            disp = project.display_path(m)
+            for node in m.walk():
+                name = None
+                if isinstance(node, ast.Attribute):
+                    name = node.attr
+                elif isinstance(node, ast.Name):
+                    name = node.id
+                if name in _FEED_BANNED_REFS:
+                    yield Finding(
+                        "train-feed-confinement", disp, node.lineno,
+                        f"{name} referenced on the training path — "
+                        "read events via data/api/partition_feed.py "
+                        "(or the row-level store APIs), never the "
+                        "merged scan or raw shard files")
+                if isinstance(node, ast.Call) \
+                        and _call_name(node) in _FEED_BANNED_CALLS:
+                    yield Finding(
+                        "train-feed-confinement", disp, node.lineno,
+                        f"{_call_name(node)}() on the training path — "
+                        "the merged-view batch scan bypasses the "
+                        "partition feed; use "
+                        "data/api/partition_feed.py")
+
+
 RULES = [ingest_hot_path, spawn_confinement, resilient_urlopen,
          wal_suffix_confinement, no_adhoc_counters, models_dao_confinement,
-         query_dispatch_gate]
+         query_dispatch_gate, train_feed_confinement]

@@ -1090,8 +1090,26 @@ class EngineServer:
             # Inside a post-swap watch window: count the failure against
             # the NEW model (rolling back past the error-rate threshold)
             # and hedge this query onto the retained last-good model so
-            # the client still gets its answer.
-            hedged = await self._watched_failure(deployment, query, dl)
+            # the client still gets its answer. The hedge's OWN
+            # overload/deadline outcomes keep their 503/504 verdicts —
+            # before this mapping they fell into the bare return below
+            # as the canary's raw 500 (the 1-in-~12 seed-5 soak red).
+            try:
+                hedged = await self._watched_failure(deployment, query,
+                                                     dl)
+            except AdmissionShed as e2:
+                with self._adm_lock:
+                    self._shed_count += 1
+                return web.json_response(
+                    {"message": f"query shed: {e2}"}, status=503,
+                    headers={"Retry-After":
+                             str(retry_after_jitter(
+                                 e2.retry_after_base))})
+            except deadline.DeadlineExceeded as e2:
+                with self._adm_lock:
+                    self._deadline_count += 1
+                return web.json_response({"message": str(e2)},
+                                         status=504)
             if hedged is None:
                 return web.json_response({"message": str(e)}, status=500)
             result = hedged
@@ -1440,14 +1458,35 @@ class EngineServer:
         rolling back past the error-rate threshold. Either way the
         client gets the hedged answer instead of the canary's 500.
         Returns the hedged result, or None (caller answers the
-        original error)."""
+        original error). Overload/deadline failures of the HEDGE
+        dispatch itself (:class:`AdmissionShed`,
+        :class:`deadline.DeadlineExceeded`) PROPAGATE — they are the
+        server's state, not the canary's, so the caller must answer
+        503/504, never convert them into the canary's raw 500 (the
+        soak's seed-5 leak), and they never count against the watch."""
         w = self._watch
-        if w is None:
-            return None
         with self._lock:
             live_dep = self.deployment
             prev = self._previous
             cur = self.instance
+        if w is None:
+            # No watch — but if the deployment this query failed on is
+            # no longer the live one, a rollback (which clears the
+            # watch) or a swap landed while the query was in flight:
+            # its failure is stale evidence, and the client deserves
+            # the LIVE model's answer, not the retired model's 500.
+            # This is the post-rollback straggler leg of the seed-5
+            # soak's raw-500 leak.
+            if live_dep is not None and live_dep is not deployment:
+                try:
+                    return await self._dispatch_query(live_dep, query,
+                                                      dl, direct=True)
+                except (AdmissionShed, deadline.DeadlineExceeded):
+                    raise
+                except Exception:  # noqa: BLE001 - original error stands
+                    log.exception("retry on live model failed")
+                    return None
+            return None
         # prune an expired or superseded window BEFORE hedging: outside
         # the watch the client must get the live model's real error,
         # not a silent answer from a long-superseded previous model
@@ -1466,6 +1505,8 @@ class EngineServer:
             try:
                 return await self._dispatch_query(live_dep, query, dl,
                                                   direct=True)
+            except (AdmissionShed, deadline.DeadlineExceeded):
+                raise   # server state, not the canary's error — 503/504
             except Exception:  # noqa: BLE001 - original error stands
                 log.exception("retry on restored model failed")
                 return None
@@ -1476,6 +1517,10 @@ class EngineServer:
             # the LIVE (canary) deployment, defeating the hedge
             result = await self._dispatch_query(prev[0], query, dl,
                                                 direct=True)
+        except (AdmissionShed, deadline.DeadlineExceeded):
+            # the hedge ran out of budget/capacity: NOT evidence against
+            # either model — surface the overload verdict (503/504)
+            raise
         except Exception:  # noqa: BLE001 - query fails on BOTH models
             log.exception("hedged retry on last-good model failed too; "
                           "not counting against the new model")

@@ -1,0 +1,390 @@
+"""Gang training feeds: partition-local reads orchestrated over the
+gang's collective substrate.
+
+``pio train --num-workers N`` runs N processes that all used to read
+the SAME merged event view (N× decode + N× memory of the full log).
+With the partition feed armed (``PIO_TRAIN_FEED=partition`` — the gang
+default, ``--feed merged`` opts out), gang worker *i* reads ONLY the
+event-log shards assigned to it (``data/api/partition_feed`` — shard
+*j* of the canonical order belongs to worker ``j mod N``), as
+sequential colseg-snapshot scans with tail-only JSON parsing, and the
+gang agrees on the global view by allgathering *derived* artifacts —
+never raw events — over the same gloo/ICI substrate training already
+runs its collectives on:
+
+1. **tombstone ids** (so every worker applies the merged view's
+   id-global delete rule to its own partitions),
+2. **entity-id vocabularies** (per-partition first-seen lists, merged
+   in worker-then-shard order into ONE deterministic global BiMap —
+   every process computes the identical mapping), or, for the
+   classification family,
+3. **per-entity property aggregates** (per-shard $set replays merged
+   by last-update order).
+
+The mapped partition-local COO then trains through
+``ops.als.train_als_partition_local`` (replicated-gram all-reduce,
+factor blocks sharded over the mesh) and the classification examples
+through ``ops.linear.train_*_process_local`` (SparkNet-style
+synchronous data parallelism) — see those docstrings for the math.
+
+Shard scans of one worker overlap via ``workflow.input_pipeline.
+prefetch`` (decode of shard N+1 runs while shard N extracts).
+
+Fallback: a storage whose event backend is not the JSONL log (no
+``events_dir``) has no partitions to feed from — the merged read stays
+in effect, warned once. The decision is a pure function of the storage
+config, so every gang process falls back (or not) together.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..common import envknobs
+from ..data.api import partition_feed as pfeed
+from ..data.storage.bimap import BiMap
+
+log = logging.getLogger("pio.trainfeed")
+
+__all__ = [
+    "feed_identity", "feed_mode", "partition_examples",
+    "partition_feed_active", "partition_ratings",
+]
+
+_TIME_ABSENT = np.iinfo(np.int64).min
+
+
+def feed_mode() -> str:
+    """Resolved PIO_TRAIN_FEED: '' (unset → merged), 'merged', or
+    'partition'."""
+    raw = envknobs.env_str("PIO_TRAIN_FEED", "").strip().lower()
+    if raw and raw not in ("partition", "merged"):
+        log.warning("PIO_TRAIN_FEED=%r: expected partition/merged; "
+                    "using merged", raw)
+        return "merged"
+    return raw
+
+
+def feed_identity() -> tuple[int, int]:
+    """(worker, num_workers) of this training process — the gang
+    wiring the supervisor provides (PIO_PROCESS_ID /
+    PIO_NUM_PROCESSES); (0, 1) outside a gang, i.e. one worker owns
+    every shard."""
+    n = envknobs.env_int("PIO_NUM_PROCESSES", 1, lo=1)
+    w = envknobs.env_int("PIO_PROCESS_ID", 0, lo=0)
+    if w >= n:
+        raise ValueError(
+            f"PIO_PROCESS_ID={w} outside the gang size {n}")
+    return w, n
+
+
+def partition_feed_active(storage) -> bool:
+    """Whether training reads should feed partition-local. True only
+    when the knob says so AND the event backend is the JSONL log
+    (anything else has no shard files — merged semantics are all there
+    is). Pure function of env + storage config: every gang process
+    agrees."""
+    if feed_mode() != "partition":
+        return False
+    le = storage.get_l_events()
+    if getattr(le, "events_dir", None) is None:
+        log.warning(
+            "PIO_TRAIN_FEED=partition but the event backend (%s) is "
+            "not the JSONL log; falling back to the merged read",
+            type(le).__name__)
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# gang exchange (derived artifacts only — never raw events)
+# ---------------------------------------------------------------------------
+
+
+def _allgather_payload(doc) -> list:
+    """Allgather one JSON-serializable payload per gang process; returns
+    the list in process order (identity for single-process runs). Rides
+    the jax.distributed substrate the gang already holds open — two
+    int32/uint8 allgathers (sizes, then padded bytes)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return [doc]
+    from jax.experimental import multihost_utils
+
+    blob = np.frombuffer(
+        json.dumps(doc, separators=(",", ":")).encode("utf-8"),
+        np.uint8)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.int32(blob.size))).reshape(-1)
+    padded = np.zeros(int(sizes.max()) if sizes.size else 0, np.uint8)
+    padded[:blob.size] = blob
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(sizes.size, -1)
+    return [
+        json.loads(bytes(gathered[p, :int(sizes[p])]).decode("utf-8"))
+        for p in range(sizes.size)
+    ]
+
+
+def _scan_assigned(feed: "pfeed.PartitionFeed") -> list:
+    """Scan this worker's shards, decode overlapped through the input
+    pipeline's prefetch workers (the native parse releases the GIL)."""
+    from .input_pipeline import PipelineConfig, prefetch
+
+    cfg = PipelineConfig.from_env()
+    paths = feed.shard_list()
+    if cfg.mode == "off" or len(paths) <= 1:
+        return [pfeed.scan_shard(p) for p in paths]
+    return list(prefetch(paths, pfeed.scan_shard,
+                         workers=cfg.workers,
+                         lookahead=max(2, cfg.depth)))
+
+
+def _resolve(app_name, storage, channel_name):
+    from ..data.store.p_event_store import _resolve_app
+
+    return _resolve_app(app_name, storage, channel_name)
+
+
+def open_feed(app_name: str, storage=None,
+              channel_name: Optional[str] = None) -> tuple:
+    """Scan this worker's assigned shards ONCE and run the tombstone
+    exchange: ``(feed, shards, global_tombstones)``. A template whose
+    read needs BOTH the rating feed and a property aggregate (e.g.
+    similar-product: view events + item categories) passes the result
+    as ``feed_ctx`` to both calls so the shard decode and the
+    tombstone allgather are not paid twice. Collective: every gang
+    process must call this (and the subsequent extractions) in the
+    same order."""
+    s, app_id, channel_id = _resolve(app_name, storage, channel_name)
+    le = s.get_l_events()
+    worker, num_workers = feed_identity()
+    feed = pfeed.PartitionFeed(le.events_dir, app_id, channel_id,
+                               worker, num_workers)
+    shards = _scan_assigned(feed)
+    tombs = _allgather_payload(feed.local_tombstones(shards))
+    return feed, shards, frozenset(t for part in tombs for t in part)
+
+
+# ---------------------------------------------------------------------------
+# ratings (ALS family)
+# ---------------------------------------------------------------------------
+
+
+def partition_ratings(
+    app_name: str,
+    event_names: Optional[Sequence[str]] = None,
+    rating_from_props: bool = True,
+    default_rating: float = 1.0,
+    event_default_ratings: Optional[dict] = None,
+    storage=None,
+    channel_name: Optional[str] = None,
+    start_time=None,
+    until_time=None,
+    feed_ctx: Optional[tuple] = None,
+):
+    """Partition-local mirror of ``PEventStore.find_ratings``: returns
+    ``(u, i, r, users, items)`` where the COO triple holds ONLY this
+    worker's partitions' events, already mapped onto the allgathered
+    GLOBAL id maps (identical ``users``/``items`` BiMaps on every gang
+    process; built in worker-then-shard first-seen order, so the index
+    assignment differs from the merged read's time-sorted order — the
+    maps, the event multiset and the trained factors per id are what
+    match). ``feed_ctx`` (an :func:`open_feed` result) shares one shard
+    scan + tombstone exchange with other extractions of the same
+    read."""
+    worker, num_workers = feed_identity()
+    feed, shards, global_tombs = (
+        feed_ctx if feed_ctx is not None
+        else open_feed(app_name, storage, channel_name))
+    s_us = pfeed.to_epoch_us(start_time)
+    u_us = pfeed.to_epoch_us(until_time)
+    user_ids: list = []
+    item_ids: list = []
+    u_index: dict = {}
+    i_index: dict = {}
+    u_parts, i_parts, r_parts = [], [], []
+    for shard in shards:
+        sr = pfeed.PartitionFeed.shard_ratings(
+            shard, event_names, global_tombs,
+            rating_from_props=rating_from_props,
+            default_rating=default_rating,
+            event_default_ratings=event_default_ratings,
+            start_us=s_us, until_us=u_us)
+
+        def remap(ids, index, store):
+            lut = np.empty(len(ids), np.int32)
+            for j, eid in enumerate(ids):
+                code = index.get(eid)
+                if code is None:
+                    code = index[eid] = len(store)
+                    store.append(eid)
+                lut[j] = code
+            return lut
+
+        lut_u = remap(sr.user_ids, u_index, user_ids)
+        lut_i = remap(sr.item_ids, i_index, item_ids)
+        if len(sr.u):
+            u_parts.append(lut_u[sr.u])
+            i_parts.append(lut_i[sr.i])
+            r_parts.append(sr.rating)
+    u_loc = (np.concatenate(u_parts) if u_parts
+             else np.empty(0, np.int32))
+    i_loc = (np.concatenate(i_parts) if i_parts
+             else np.empty(0, np.int32))
+    r_loc = (np.concatenate(r_parts) if r_parts
+             else np.empty(0, np.float32))
+    # exchange 2: per-worker vocabularies → ONE deterministic global
+    # BiMap (worker order, first seen wins)
+    vocabs = _allgather_payload({"u": user_ids, "i": item_ids})
+    users = BiMap.string_int(
+        uid for part in vocabs for uid in part["u"])
+    items = BiMap.string_int(
+        iid for part in vocabs for iid in part["i"])
+    if len(user_ids):
+        glut_u = np.fromiter((users(x) for x in user_ids), np.int32,
+                             count=len(user_ids))
+        glut_i = np.fromiter((items(x) for x in item_ids), np.int32,
+                             count=len(item_ids))
+        u_loc = glut_u[u_loc]
+        i_loc = glut_i[i_loc]
+    log.info(
+        "partition feed: worker %d/%d read %d shard(s), %d local "
+        "rating event(s); global vocab %d users / %d items",
+        worker, num_workers, len(shards), len(r_loc), len(users),
+        len(items))
+    return u_loc, i_loc, r_loc, users, items
+
+
+# ---------------------------------------------------------------------------
+# labeled examples (NB/LR family)
+# ---------------------------------------------------------------------------
+
+
+def partition_examples(
+    app_name: str,
+    entity_type: str,
+    attributes: Sequence[str],
+    label: str,
+    storage=None,
+    channel_name: Optional[str] = None,
+):
+    """Partition-local mirror of the classification read
+    (``aggregate_properties`` → labeled example matrix): per-shard
+    $set replays are allgathered as per-ENTITY partial aggregates
+    (derived batches, not raw events) and merged by last-update order,
+    so every gang process computes the identical global entity table,
+    label vocabulary and example order — then each takes its strided
+    slice (entity ``j mod N`` → worker ``j``) for the data-parallel
+    NB/LR trainers. Returns ``(features, labels, label_values,
+    n_entities)`` with the LOCAL example block and the GLOBAL label
+    vocabulary/entity count.
+
+    Exactness contract: identical to the merged read whenever each
+    entity's property events live in one partition (the import shape —
+    one $set per entity trivially qualifies). Cross-partition
+    interleaved partial updates of ONE entity resolve by whole-map
+    last-write order, and a $delete only erases $sets in its own
+    partition — the documented feed caveats."""
+    merged = partition_properties(app_name, entity_type,
+                                  storage=storage,
+                                  channel_name=channel_name)
+    worker, num_workers = feed_identity()
+    features, y_local, label_values, kept = _examples_from_map(
+        merged, attributes, label, worker, num_workers)
+    log.info(
+        "partition feed: worker %d/%d holds %d of %d labeled "
+        "entit(ies), %d class(es)", worker, num_workers,
+        len(features), kept, len(label_values))
+    return features, y_local, label_values, kept
+
+
+def partition_properties(
+    app_name: str,
+    entity_type: str,
+    storage=None,
+    channel_name: Optional[str] = None,
+    feed_ctx: Optional[tuple] = None,
+) -> dict:
+    """Partition-local mirror of ``aggregate_properties`` →
+    ``{entity_id: props}``: the same per-shard replay + allgathered
+    merge as :func:`partition_examples`, without the example-matrix
+    shaping — for templates that read serving metadata (e.g. item
+    categories) alongside the rating feed. Every gang process returns
+    the identical map. ``feed_ctx`` (an :func:`open_feed` result)
+    shares one shard scan + tombstone exchange with other extractions
+    of the same read."""
+    feed, shards, global_tombs = (
+        feed_ctx if feed_ctx is not None
+        else open_feed(app_name, storage, channel_name))
+    my_positions = feed.canonical_positions()
+    local = []
+    for shard in shards:
+        rep = pfeed.PartitionFeed.shard_properties(
+            shard, entity_type, global_tombs)
+        local.append((my_positions.get(shard.path, -1), {
+            eid: [props, int(first), int(last)]
+            for eid, (props, first, last) in rep.items()}))
+    return _merge_property_parts(_allgather_payload(local))
+
+
+def _merge_property_parts(gathered) -> dict:
+    """{entity: merged props} from every worker's per-shard property
+    replays (``gathered`` = list over workers of ``[(canonical shard
+    position, {entity: [props, first_us, last_us]}), ...]``): per
+    entity, partial maps apply in ascending last-update order (absent
+    times sort last — the replay's "now" rule), ties broken by
+    canonical shard position, so every process computes the identical
+    merge regardless of which worker gathered what."""
+    by_entity: dict = {}
+    for part in gathered:
+        for pos, rep in part:
+            for eid, (props, first, last) in rep.items():
+                by_entity.setdefault(eid, []).append(
+                    (int(last), int(pos), props))
+    big = np.iinfo(np.int64).max
+    merged: dict = {}
+    for eid, pieces in by_entity.items():
+        pieces.sort(key=lambda p: (
+            big if p[0] == _TIME_ABSENT else p[0], p[1]))
+        props: dict = {}
+        for _last, _pos, piece in pieces:
+            props.update(piece)
+        merged[eid] = props
+    return merged
+
+
+def _examples_from_map(merged: dict, attributes: Sequence[str],
+                       label: str, worker: int, num_workers: int):
+    """Global entity map → (this worker's strided example block, the
+    GLOBAL label vocabulary, the global kept-entity count). Entities
+    sort by id so every worker sees the same order; the label
+    vocabulary covers ALL kept entities (np.unique — sorted, identical
+    everywhere) while the feature rows are the worker's
+    ``kept_index % num_workers == worker`` slice."""
+    required = set(attributes) | {label}
+    feats, labels, kept = [], [], 0
+    for eid in sorted(merged):
+        props = merged[eid]
+        if not required.issubset(props):
+            continue
+        if kept % num_workers == worker:
+            feats.append([float(props[a]) for a in attributes])
+        else:
+            feats.append(None)
+        labels.append(props[label])  # global label vocab needs all
+        kept += 1
+    label_values, y_all = np.unique(np.asarray(labels),
+                                    return_inverse=True)
+    mine = [j for j, f in enumerate(feats) if f is not None]
+    features = np.asarray([feats[j] for j in mine], np.float32)
+    if features.size == 0:
+        features = features.reshape(0, len(attributes))
+    y_local = np.asarray(y_all).reshape(-1)[mine].astype(np.int32)
+    return features, y_local, label_values, kept

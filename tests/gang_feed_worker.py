@@ -1,0 +1,122 @@
+"""One supervised gang worker for the partition-feed chaos/parity
+harness (tests/test_partition_feed.py): runs the REAL training
+workflow (`run_train` — leader/follower paths, gang instance pinning)
+against a PREPARED partitioned event log, with the merged JSON view
+POISONED so any read through it fails loudly.
+
+The supervisor provides the gang wiring (PIO_COORDINATOR_ADDRESS /
+PIO_NUM_PROCESSES / PIO_PROCESS_ID / PIO_GANG_INSTANCE_ID / ...); the
+test provides the storage env (SQLITE metadata+models, JSONL events)
+and PIO_TRAIN_FEED=partition.
+
+Usage: gang_feed_worker.py <out_dir>
+
+Trains, via the real templates:
+1. recommendation (sharded ALS off the partition feed), gang id as
+   pinned;
+2. classification/NaiveBayes (data-parallel stats), gang id + "-cls";
+and directly: LR process-local over the partition examples (worker 0
+writes lr.npz).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from incubator_predictionio_tpu.parallel.distributed import (  # noqa: E402
+    initialize_distributed,
+)
+from incubator_predictionio_tpu.parallel.supervisor import (  # noqa: E402
+    ENV_GANG_INSTANCE_ID,
+    install_worker_signal_handlers,
+)
+
+initialize_distributed()
+install_worker_signal_handlers()
+
+import numpy as np  # noqa: E402
+
+# POISON the merged view BEFORE anything reads events: the acceptance
+# contract — gang training reads ZERO bytes through the merged JSON
+# view (the partition feed is the only sanctioned read).
+from incubator_predictionio_tpu.data.storage import jsonl as _jsonl  # noqa: E402
+
+
+def _no_merged_scan(self, *a, **kw):
+    raise AssertionError(
+        "merged-view scan reached from gang training — the partition "
+        "feed must be the training data plane")
+
+
+_jsonl.JSONLEvents._merged_scan = _no_merged_scan
+
+from incubator_predictionio_tpu.controller.engine import EngineParams  # noqa: E402
+from incubator_predictionio_tpu.data.storage.registry import Storage  # noqa: E402
+from incubator_predictionio_tpu.models.classification import (  # noqa: E402
+    ClassificationEngine,
+)
+from incubator_predictionio_tpu.models.recommendation import (  # noqa: E402
+    RecommendationEngine,
+)
+from incubator_predictionio_tpu.ops.linear import (  # noqa: E402
+    train_logistic_regression_process_local,
+)
+from incubator_predictionio_tpu.workflow import train_feed  # noqa: E402
+from incubator_predictionio_tpu.workflow.context import WorkflowContext  # noqa: E402
+from incubator_predictionio_tpu.workflow.core_workflow import run_train  # noqa: E402
+
+
+def main() -> int:
+    out_dir = sys.argv[1]
+    storage = Storage.instance()
+    assert train_feed.partition_feed_active(storage), \
+        "partition feed must be armed for this harness"
+
+    # 1) recommendation: sharded ALS straight off the partition feed
+    ctx = WorkflowContext(app_name="feedapp", storage=storage)
+    rec_params = EngineParams(
+        data_source_params={"appName": "feedapp",
+                            "eventNames": ["rate", "buy"]},
+        algorithm_params_list=[("", {
+            "rank": 4, "numIterations": 6, "lambda": 0.05, "seed": 5})],
+    )
+    rec_id = run_train(RecommendationEngine().apply(), rec_params, ctx,
+                       engine_factory_name="feedrec")
+
+    # 2) classification / NB: data-parallel sufficient stats (a second
+    # gang-pinned instance — the supervisor pinned ONE id, derive a
+    # sibling for the second job)
+    base_gang = os.environ.get(ENV_GANG_INSTANCE_ID)
+    if base_gang:
+        os.environ[ENV_GANG_INSTANCE_ID] = base_gang + "-cls"
+    ctx2 = WorkflowContext(app_name="feedapp", storage=storage)
+    cls_params = EngineParams(
+        data_source_params={"appName": "feedapp"},
+        algorithm_params_list=[("naive", {"lambda": 0.7})],
+    )
+    cls_id = run_train(ClassificationEngine().apply(), cls_params, ctx2,
+                       engine_factory_name="feedcls")
+
+    # 3) LR process-local directly over the partition examples
+    feats, y, label_values, _n = train_feed.partition_examples(
+        "feedapp", "user", ["attr0", "attr1", "attr2"], "plan",
+        storage=storage)
+    lr = train_logistic_regression_process_local(
+        feats, y, n_classes=len(label_values), reg=0.01, max_iters=40)
+
+    if jax.process_index() == 0:
+        np.savez(os.path.join(out_dir, "lr.npz"),
+                 weights=lr.weights, intercept=lr.intercept,
+                 label_values=np.asarray(label_values))
+        with open(os.path.join(out_dir, "ids.txt"), "w") as f:
+            f.write(f"{rec_id}\n{cls_id}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,6 +56,14 @@ class LifecycleAlgorithm(Algorithm):
         user = query["user"]
         if model.mode == "poison" and user != "golden":
             raise RuntimeError("poisoned model: predict exploded")
+        # per-query latency knob for the watch/hedge race tests (a
+        # poison model raises BEFORE sleeping, so a canary failure
+        # spends none of the budget while a hedge can spend all of it)
+        delay = float(query.get("sleepS", 0) or 0)
+        if delay:
+            import time
+
+            time.sleep(delay)
         return {"user": user, "tag": model.tag,
                 "score": float(model.weights[0])}
 

@@ -111,6 +111,7 @@ def test_2d_mesh_rows_not_divisible():
         out.user_factors, ref.user_factors, rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_2d_mesh_matches_replicated_large():
     """Replicated-vs-2-D parity at 20k users × 3k items × ~400k nnz —
     a size where every shard's MODEL_AXIS ownership window spans many

@@ -707,6 +707,40 @@ def test_soak_fault_registry_seeded_violations(tmp_path):
     assert len(fs) == 1 and "FAULT_POINTS" in fs[0].message
 
 
+def test_seeded_train_feed_confinement(tmp_path):
+    """Training-path modules (workflow/ + ops/) may not read events
+    through the merged view or touch shard files directly; the same
+    code OUTSIDE the training path (data/api — where the partition
+    feed itself lives) is clean."""
+    src = '''
+        def read(store, app):
+            scan = store._merged_scan(app, None, [])
+            for b in store.find_batches(app):
+                pass
+            return scan
+    '''
+    fs = findings_for(tmp_path / "wf", {"workflow/rogue_read.py": src},
+                      ["train-feed-confinement"])
+    assert len(fs) == 2
+    assert any("_merged_scan" in f.message for f in fs)
+    assert any("find_batches" in f.message for f in fs)
+    shard_src = '''
+        from ..data.storage.jsonl import scan_log_file, shard_paths
+
+        def feed(d, app):
+            return [scan_log_file(p) for p in shard_paths(d, app)]
+    '''
+    fs = findings_for(tmp_path / "ops", {"ops/rogue_feed.py": shard_src},
+                      ["train-feed-confinement"])
+    assert len(fs) >= 2
+    assert {m for f in fs for m in ("shard_paths", "scan_log_file")
+            if m in f.message} == {"shard_paths", "scan_log_file"}
+    # the reader API itself (data/api/) is outside the rule's scope
+    assert findings_for(
+        tmp_path / "api", {"data/api/partition_feed.py": shard_src},
+        ["train-feed-confinement"]) == []
+
+
 def test_spawn_confinement_still_fires_outside_the_soak_driver(tmp_path):
     """The soak driver's spawn exemption must not widen the rule: any
     OTHER workflow/ module spawning a process is still a finding."""

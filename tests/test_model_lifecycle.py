@@ -582,6 +582,85 @@ def test_auto_rollback_on_error_rate_in_process(memory_storage):
         assert r.status_code == 200 and r.json()["engineInstanceId"] == iid1
 
 
+def test_watch_straggler_after_rollback_served_not_500(memory_storage):
+    """The seed-5 soak's raw-500 leak, leg 1 (regression): a query
+    dispatched to the poisoned canary BEFORE the error-rate rollback
+    whose failure lands AFTER it (the rollback cleared the watch and
+    dropped the previous deployment) must be retried on the restored
+    live model — not answered with the retired canary's raw 500."""
+    _train(memory_storage, "one")
+    server = EngineServer(lifecycle_engine.engine_factory(),
+                          engine_factory_name="lifecycle",
+                          storage=memory_storage,
+                          swap_watch_ms=60_000,
+                          swap_max_error_rate=0.3)
+    bad = _train(memory_storage, "bad", mode="poison")
+    with ServerThread(server.app) as st:
+        r = requests.get(st.base + "/reload")
+        assert r.status_code == 200 and r.json()["engineInstanceId"] == bad
+        # trip the rollback with two fast failing queries (hedged 200s)
+        fast = [_post(st.base, f"u{i}") for i in range(2)]
+        assert [r.status_code for r in fast] == [200, 200], \
+            [r.text for r in fast]
+        lc = requests.get(st.base + "/status").json()["lifecycle"]
+        assert lc["rollbacks"] == {"error-rate": 1}
+        # the straggler condition, deterministically: a failure lands
+        # attributed to a deployment that is NO LONGER the live one,
+        # with the watch already cleared by the rollback — before the
+        # fix, _watched_failure returned None here and the client got
+        # the retired canary's raw 500
+        import asyncio
+
+        class _RetiredCanary:
+            def query(self, q):
+                raise RuntimeError("late canary failure")
+
+        fut = asyncio.run_coroutine_threadsafe(
+            server._watched_failure(_RetiredCanary(), {"user": "s"},
+                                    None), st._loop)
+        out = fut.result(timeout=30)
+        assert out is not None and out["tag"] == "one", out
+        # and end-to-end: fresh traffic serves 200 from last-good
+        r2 = _post(st.base, "u-after")
+        assert r2.status_code == 200 and r2.json()["tag"] == "one"
+
+
+def test_hedge_overrun_answers_504_not_500(memory_storage):
+    """The seed-5 soak's raw-500 leak, leg 2 (regression): when the
+    HEDGE dispatch itself runs out of deadline budget, the client gets
+    the overload verdict (504) — not the canary's raw 500 — and the
+    overrun never counts against the watch window."""
+    _train(memory_storage, "one")
+    server = EngineServer(lifecycle_engine.engine_factory(),
+                          engine_factory_name="lifecycle",
+                          storage=memory_storage,
+                          swap_watch_ms=60_000,
+                          swap_max_error_rate=0.3)
+    bad = _train(memory_storage, "bad", mode="poison")
+    with ServerThread(server.app) as st:
+        r = requests.get(st.base + "/reload")
+        assert r.status_code == 200 and r.json()["engineInstanceId"] == bad
+        # the canary raises instantly (poison checks before sleeping);
+        # the hedge lands on last-good which sleeps past the remaining
+        # budget → the hedge dispatch raises DeadlineExceeded
+        r = requests.post(
+            st.base + "/queries.json",
+            json={"user": "u-slow", "sleepS": 2.0},
+            headers={"X-Pio-Deadline-Ms": "700"}, timeout=30)
+        assert r.status_code == 504, (r.status_code, r.text)
+        status = requests.get(st.base + "/status").json()
+        assert status["overload"]["deadlineExceeded"] >= 1
+        # the overrun was the server's verdict, not canary evidence:
+        # no rollback happened and the canary stays live
+        lc = status["lifecycle"]
+        assert lc["instance"] == bad
+        assert lc["rollbacks"] == {}
+        # the watch counted at most the hedge-skipped nothing: a plain
+        # failing query afterwards still hedges to 200
+        r2 = _post(st.base, "u-after")
+        assert r2.status_code == 200 and r2.json()["tag"] == "one"
+
+
 # ---------------------------------------------------------------------------
 # subprocess e2e: poisoned retrain auto-rolls back under live fire
 # ---------------------------------------------------------------------------

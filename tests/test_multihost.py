@@ -84,7 +84,14 @@ def _mh_data():
     return u, i, r, n_users, n_items
 
 
-@pytest.mark.parametrize("mode", ["full", "sharded", "sharded-ones"])
+@pytest.mark.parametrize("mode", [
+    # "full" (every process holds the whole dataset — the merged-feed
+    # gang path) is slow-marked for the tier-1 wall budget (PR 15): the
+    # sharded variants keep the 2-process parity contract tier-1, and
+    # the partition-feed gang e2e (tests/test_partition_feed.py) now
+    # covers multi-process training through the product read path.
+    pytest.param("full", marks=pytest.mark.slow),
+    "sharded", "sharded-ones"])
 def test_two_process_training_matches_single_process(tmp_path, mode):
     """mode="full": every worker holds the whole dataset (shared-store
     reads). mode="sharded": each worker ingests ONLY the event ranges it

@@ -516,8 +516,16 @@ def _run(cfg):
     return scorecard
 
 
+@pytest.mark.slow
 def test_smoke_soak_scaled_down_topology_full_slo_path(tmp_path):
-    """The tier-1 acceptance: a REAL subprocess topology (partitioned
+    """Slow-marked for the tier-1 wall budget (PR 15): ~30s of real
+    subprocess topology whose every red path is ALSO unit-proven
+    tier-1 (seeded-violation SLO units, ledger reconciliation units,
+    planner/faultinject units below), and whose real-topology fault
+    coverage remains tier-1 via the event-log multiworker, fleet and
+    crash-recovery subprocess suites.
+
+    The tier-1 acceptance: a REAL subprocess topology (partitioned
     event server, single-process engine with refresh + fold-in) under
     mixed zipfian load, with a scheduled ENOSPC, a poisoned fold-in
     increment and a worker SIGKILL mid-commit — every SLO asserted,
