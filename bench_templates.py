@@ -11,6 +11,13 @@ by a synthetic DataSource, as in bench.py):
   4. Text-Classification (TF-IDF → NaiveBayes, 20-newsgroups scale)
   5. Universal Recommender (CCO/LLR multi-event cross-occurrence)
 
+plus the formerly unbenchmarked template trio (ROADMAP item 1 rider —
+bench parity with the big five):
+
+  6. E-Commerce (implicit ALS + serve-time filtering model build)
+  7. Complementary-Purchase (basket-windowed CCO/LLR)
+  8. Vanilla (weighted-popularity segment-sum, the scaffold engine)
+
 Timing protocol: Engine.train runs twice; the reported number is the
 SECOND (warm) run's wall time — every jitted program is already
 compiled, so this measures steady-state product-path throughput
@@ -21,8 +28,9 @@ Completion barriers are device_get-based (remote-PJRT tunnel safe).
 Prints ONE JSON line per config and records results into
 BASELINE.json.published (measured_tpu_* keys).
 
-Env: PIO_BENCH_TEMPLATES=classification,similar_product,text,ur
-     (default: all), PIO_BENCH_FORCE_CPU=1 for harness smoke tests.
+Env: PIO_BENCH_TEMPLATES=classification,similar_product,text,ur,
+     ecommerce,complementary,vanilla (default: all),
+     PIO_BENCH_FORCE_CPU=1 for harness smoke tests.
 """
 
 from __future__ import annotations
@@ -212,12 +220,133 @@ def bench_ur():
     return _engine_train_twice(engine, ep, n_events, "universal-recommender") + (n_events,)
 
 
+def bench_ecommerce():
+    """Config 6: the e-commerce template — implicit ALS at the
+    similar-product scale (100k users, 20k items, 5M view/buy events,
+    rank 32 × 10 iterations) THROUGH ECommerceAlgorithm, which also
+    builds the serve-time filter state (category index hooks, event-
+    store handle) on top of the factor solve."""
+    from incubator_predictionio_tpu.controller.datasource import DataSource
+    from incubator_predictionio_tpu.controller.engine import Engine, EngineParams
+    from incubator_predictionio_tpu.data.storage.bimap import BiMap
+    from incubator_predictionio_tpu.models.ecommerce import ECommerceAlgorithm
+    from incubator_predictionio_tpu.models.similar_product import TrainingData
+
+    nnz = int(os.environ.get("PIO_BENCH_ECOM_NNZ", 5_000_000))
+    n_users = max(100, min(100_000, nnz // 50))
+    n_items = max(50, min(20_000, nnz // 250))
+    rng = np.random.default_rng(6)
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = (n_items * rng.random(nnz) ** 2).astype(np.int32)
+    i = np.minimum(i, n_items - 1)
+    r = np.ones(nnz, np.float32)
+
+    class DS(DataSource):
+        def read_training(self, ctx):
+            return TrainingData(
+                u, i, r,
+                BiMap({str(j): j for j in range(n_users)}),
+                BiMap({str(j): j for j in range(n_items)}),
+                {},
+            )
+
+    engine = Engine(data_source_class=DS,
+                    algorithm_class_map={"ecomm": ECommerceAlgorithm})
+    ep = EngineParams.from_json({"algorithms": [{"name": "ecomm", "params": {
+        "appName": "bench", "rank": 32, "numIterations": 10,
+        "lambda": 0.01, "alpha": 1.0,
+    }}]})
+    return _engine_train_twice(engine, ep, nnz, "ecommerce") + (nnz,)
+
+
+def bench_complementary():
+    """Config 7: basket-windowed CCO — 200k shoppers, 10k items, 2M buy
+    events spread over 30 days (≈10 buys/shopper → multiple sessions
+    each at the 1h window). Times the whole pipeline: vectorized basket
+    formation + striped LLR co-occurrence + top-k indicators."""
+    from incubator_predictionio_tpu.controller.datasource import DataSource
+    from incubator_predictionio_tpu.controller.engine import Engine, EngineParams
+    from incubator_predictionio_tpu.data.storage.bimap import BiMap
+    from incubator_predictionio_tpu.models.complementary_purchase import (
+        ComplementaryAlgorithm, TrainingData,
+    )
+
+    nnz = int(os.environ.get("PIO_BENCH_CP_NNZ", 2_000_000))
+    n_shoppers = max(100, min(200_000, nnz // 10))
+    n_items = max(50, min(10_000, nnz // 200))
+    rng = np.random.default_rng(7)
+    u = rng.integers(0, n_shoppers, nnz).astype(np.int32)
+    i = (n_items * rng.random(nnz) ** 2).astype(np.int32)
+    i = np.minimum(i, n_items - 1)
+    t = rng.integers(0, 30 * 86_400 * 1_000_000, nnz, dtype=np.int64)
+
+    class DS(DataSource):
+        def read_training(self, ctx):
+            return TrainingData(
+                u, i, t,
+                BiMap({str(j): j for j in range(n_shoppers)}),
+                BiMap({str(j): j for j in range(n_items)}),
+            )
+
+    engine = Engine(data_source_class=DS,
+                    algorithm_class_map={"cooccurrence": ComplementaryAlgorithm})
+    ep = EngineParams.from_json({"algorithms": [{"name": "cooccurrence",
+                                                 "params": {
+        "basketWindowSecs": 3600, "maxCorrelatorsPerItem": 20,
+        "minLLR": 0.0,
+    }}]})
+    return _engine_train_twice(engine, ep, nnz, "complementary-purchase") + (nnz,)
+
+
+def bench_vanilla():
+    """Config 8: the vanilla scaffold's weighted-popularity engine —
+    10M weighted events over 100k items, one jitted segment-sum. The
+    floor any template author starts from; dispatch-dominated on an
+    accelerator, so the number mostly measures product-path overhead
+    around a single reduction."""
+    import sys as _sys
+
+    tmpl = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "templates", "vanilla")
+    if tmpl not in _sys.path:
+        _sys.path.insert(0, tmpl)
+    import vanilla_engine as ve
+    from incubator_predictionio_tpu.controller.datasource import DataSource
+    from incubator_predictionio_tpu.controller.engine import Engine, EngineParams
+    from incubator_predictionio_tpu.data.storage.bimap import BiMap
+
+    nnz = int(os.environ.get("PIO_BENCH_VAN_NNZ", 10_000_000))
+    n_users = max(100, min(100_000, nnz // 100))
+    n_items = max(50, min(100_000, nnz // 100))
+    rng = np.random.default_rng(8)
+    u = rng.integers(0, n_users, nnz).astype(np.int32)
+    i = (n_items * rng.random(nnz) ** 2).astype(np.int32)
+    i = np.minimum(i, n_items - 1)
+    w = rng.random(nnz).astype(np.float32) * 4 + 1
+
+    class DS(DataSource):
+        def read_training(self, ctx):
+            return ve.TrainingData(
+                u, i, w, BiMap({str(j): j for j in range(n_items)}))
+
+    engine = Engine(data_source_class=DS,
+                    algorithm_class_map={"popularity": ve.PopularityAlgorithm})
+    ep = EngineParams.from_json({"algorithms": [{"name": "popularity",
+                                                 "params": {
+        "ratingWeight": 1.0,
+    }}]})
+    return _engine_train_twice(engine, ep, nnz, "vanilla") + (nnz,)
+
+
 BENCHES = {
     "classification": lambda: bench_classification("naive"),
     "classification_lr": lambda: bench_classification("lr"),
     "similar_product": bench_similar_product,
     "text": bench_text,
     "ur": bench_ur,
+    "ecommerce": bench_ecommerce,
+    "complementary": bench_complementary,
+    "vanilla": bench_vanilla,
 }
 
 #: CPU/TPU crossover ladders (VERDICT r3 weak #3): run the sweep once

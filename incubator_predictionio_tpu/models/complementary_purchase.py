@@ -78,6 +78,36 @@ class ComplementaryDataSource(DataSource):
                             ).astype(np.int32),
             batch.event_time_us[keep], users, items)
 
+    def read_eval(self, ctx):
+        """K-fold basket-completion split for `pio eval`
+        (models/template_evals.py): each held-out buy becomes a fold
+        query made of the shopper's OTHER training-fold items — the
+        held-out item must surface as their complement."""
+        from ..e2.cross_validation import k_fold_indices
+
+        td = self.read_training(ctx)
+        folds = []
+        for train_sel, test_sel in k_fold_indices(
+                len(td.user_idx), k=3, seed=0):
+            train = TrainingData(
+                td.user_idx[train_sel], td.item_idx[train_sel],
+                td.time_us[train_sel], td.users, td.items)
+            basket_items: dict[int, list[str]] = {}
+            for j in np.nonzero(train_sel)[0]:
+                basket_items.setdefault(int(td.user_idx[j]), []).append(
+                    td.items.inverse(int(td.item_idx[j])))
+            queries = []
+            for j in np.nonzero(test_sel)[0]:
+                rest = basket_items.get(int(td.user_idx[j]))
+                if not rest:
+                    continue   # nothing to query from: cold shopper
+                queries.append((
+                    {"items": sorted(set(rest))[:8], "num": 10},
+                    {"item": td.items.inverse(int(td.item_idx[j]))},
+                ))
+            folds.append((train, None, queries))
+        return folds
+
 
 def form_baskets(user_idx: np.ndarray, time_us: np.ndarray,
                  window_us: int) -> np.ndarray:

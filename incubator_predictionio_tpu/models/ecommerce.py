@@ -47,6 +47,34 @@ class ECommerceDataSourceParams(SPDataSourceParams):
 class ECommerceDataSource(SimilarProductDataSource):
     params_cls = ECommerceDataSourceParams
 
+    def read_eval(self, ctx):
+        """K-fold split for `pio eval` (models/template_evals.py):
+        each held-out (user, item) interaction becomes a fold query.
+        ``unseenOnly`` is off for eval queries — the seen-item filter
+        would exclude exactly the interaction being graded."""
+        from ..e2.cross_validation import k_fold_indices
+        from .similar_product import TrainingData as SPTrainingData
+
+        td = self.read_training(ctx)
+        folds = []
+        for train_sel, test_sel in k_fold_indices(
+                len(td.user_idx), k=3, seed=0):
+            train = SPTrainingData(
+                td.user_idx[train_sel], td.item_idx[train_sel],
+                td.rating[train_sel], td.users, td.items,
+                td.item_categories,
+            )
+            queries = [
+                (
+                    {"user": td.users.inverse(int(td.user_idx[j])),
+                     "num": 10, "unseenOnly": False},
+                    {"item": td.items.inverse(int(td.item_idx[j]))},
+                )
+                for j in np.nonzero(test_sel)[0]
+            ]
+            folds.append((train, None, queries))
+        return folds
+
 
 @dataclasses.dataclass
 class ECommerceModel(ShardedCatalogServing):

@@ -283,6 +283,15 @@ def deploy_cmd(args: list[str]) -> int:
                         "window as a retrain (interval $PIO_FOLDIN_MS, "
                         "default 1000; with --replicas, replica 0 "
                         "produces and the coordinator stages canaries)")
+    p.add_argument("--quality-eval", action="store_true",
+                   help="continuous quality evaluation: shadow-score a "
+                        "sampled slice of live queries against held-out "
+                        "next events tailed from the app's log, and roll "
+                        "a significant canary-vs-last-good ranking "
+                        "regression back through the same watch/pin "
+                        "path as an error-rate breach (sample rate "
+                        "$PIO_QUALITY_SAMPLE, default 0.01 with this "
+                        "flag; thresholds via PIO_QUALITY_*)")
     p.add_argument("--rollback", action="store_true",
                    help="don't deploy: tell the engine server already "
                         "running at --ip/--port to roll back to its "
@@ -343,6 +352,12 @@ def _build_engine_server(ns):
     # without the flag the env knob alone can still arm it
     foldin_ms = (float(envknobs.env_int("PIO_FOLDIN_MS", 1000, lo=1))
                  if getattr(ns, "online_foldin", False) else None)
+    # --quality-eval arms the shadow scorer at $PIO_QUALITY_SAMPLE
+    # (default 1% with the flag); same pattern — the env knob alone can
+    # still arm it
+    quality_sample = (envknobs.env_float("PIO_QUALITY_SAMPLE", 0.01,
+                                         lo=0.0, hi=1.0)
+                      if getattr(ns, "quality_eval", False) else None)
     return EngineServer(
         engine,
         engine_factory_name=factory,
@@ -358,6 +373,7 @@ def _build_engine_server(ns):
         drain_deadline_ms=ns.drain_deadline_ms,
         model_refresh_ms=ns.model_refresh_ms,
         foldin_ms=foldin_ms,
+        quality_sample=quality_sample,
     )
 
 

@@ -228,9 +228,38 @@ def _print_engine_overload(url: str) -> None:
                   + (f"{lag:.1f}s" if lag is not None else "n/a")
                   + (" — STALE (> 2x the fold-in interval; loop "
                      "failing?)" if stale else ""))
+    q = doc.get("quality")
+    if q:
+        _print_quality(q)
     fleet = doc.get("fleet")
     if fleet:
         _print_fleet(fleet)
+
+
+def _print_quality(q: dict) -> None:
+    """One quality line off /status: sampling rate, graded-sample
+    counts, the live NDCG@k and the last-good delta, plus the open
+    watch — a ranking regression is visible from `pio status
+    --engine-url` without scraping /metrics."""
+    if not q.get("enabled", True):
+        print(f"[warn]   quality: disabled — {q.get('disabledReason')}")
+        return
+    live = q.get("live") or {}
+    deltas = q.get("deltas") or {}
+    watch = q.get("watch")
+    breached = bool(q.get("breached"))
+    marker = "[warn]" if breached else "[info]"
+    watching = (f", watching {watch.get('instance')} "
+                f"({watch.get('remainingMs', 0):.0f}ms left)"
+                if watch else "")
+    print(f"{marker}   quality: sampling {q.get('sample', 0) * 100:.1f}% "
+          f"(k={q.get('k')}), {q.get('sampled', 0)} sampled / "
+          f"{q.get('scored', 0)} graded / {q.get('expired', 0)} expired, "
+          f"ndcg {live.get('ndcg', 0):.3f} over {live.get('n', 0)} "
+          f"sample(s), last-good delta {deltas.get('ndcg', 0):+.3f}"
+          f"{watching}"
+          + (" — BREACHED (quality rollback armed/fired)"
+             if breached else ""))
 
 
 def _print_fleet(fleet: dict) -> None:

@@ -50,7 +50,13 @@ def soak_cmd(args: list[str]) -> int:
                    help="'full', 'none', or a comma list from the "
                         "menu: enospc_shed, poison_foldin, "
                         "worker_kill, replica_kill, good_retrain, "
-                        "compact_crash, poison_retrain")
+                        "compact_crash, poison_retrain, "
+                        "poison_quality")
+    p.add_argument("--quality-sample", type=float, default=1.0,
+                   help="shadow-scorer sampling rate armed on the "
+                        "deployed engine (0 disables the quality "
+                        "vertical; the quality-regression SLO row "
+                        "then only asserts the rollback leg)")
     p.add_argument("--p99-ms", type=float, default=4000.0)
     p.add_argument("--rollback-deadline-s", type=float, default=30.0)
     p.add_argument("--foldin-ms", type=float, default=250.0)
@@ -92,6 +98,7 @@ def soak_cmd(args: list[str]) -> int:
         ingest_rps=ns.ingest_rps,
         query_rps=ns.query_rps,
         faults=_parse_faults(ns.faults),
+        quality_sample=max(0.0, min(1.0, ns.quality_sample)),
         p99_ms=ns.p99_ms,
         rollback_deadline_s=ns.rollback_deadline_s,
         foldin_ms=ns.foldin_ms,

@@ -99,6 +99,7 @@ class EngineServer:
         fleet_replica: Optional[int] = None,
         fleet_replicas: Optional[int] = None,
         fleet_sync_ms: Optional[float] = None,
+        quality_sample: Optional[float] = None,
     ):
         # start the PIO_FAULT_SPEC at-mode offset clock at "server
         # constructing", not "first query": soak timelines schedule
@@ -134,7 +135,8 @@ class EngineServer:
                                   swap_validate, swap_watch_ms,
                                   swap_max_error_rate, model_refresh_ms,
                                   fleet_replica, fleet_replicas,
-                                  fleet_sync_ms, foldin_ms)
+                                  fleet_sync_ms, foldin_ms,
+                                  quality_sample)
         # Probe marker secret: synthetic startup-probe traffic is
         # excluded from queryCount/feedback, so the marker must not be
         # spoofable — an external client sending a bare "X-Pio-Probe: 1"
@@ -190,6 +192,8 @@ class EngineServer:
         self.app.on_cleanup.append(self._stop_refresher)
         self.app.on_startup.append(self._start_foldin)
         self.app.on_cleanup.append(self._stop_foldin)
+        self.app.on_startup.append(self._start_quality)
+        self.app.on_cleanup.append(self._stop_quality)
         self.app.on_startup.append(self._start_fleet)
         self.app.on_cleanup.append(self._stop_fleet)
         self.app.on_startup.append(self._start_heartbeat)
@@ -202,7 +206,8 @@ class EngineServer:
                              swap_watch_ms=None, swap_max_error_rate=None,
                              model_refresh_ms=None, fleet_replica=None,
                              fleet_replicas=None,
-                             fleet_sync_ms=None, foldin_ms=None) -> None:
+                             fleet_sync_ms=None, foldin_ms=None,
+                             quality_sample=None) -> None:
         """Admission control: the query path gets a DEDICATED bounded
         executor (query_conc workers) plus a bounded waiting budget
         (query_max_pending); offered load beyond conc+pending is shed
@@ -283,6 +288,39 @@ class EngineServer:
         # flight off-thread, and /status reads the last view snapshot
         self._foldin_runner = None
         self._foldin_view: Optional[dict] = None
+        # Continuous quality evaluation (ROADMAP item 1's guardrail;
+        # docs/operations.md "Continuous quality evaluation"): sample a
+        # slice of live queries, shadow-replay them on the retained
+        # last-good deployment, grade BOTH against held-out next events
+        # tailed from the app's log partitions, and feed a significant
+        # canary-vs-last-good regression into the SAME rollback path as
+        # an error-rate breach (reason "quality"). 0 = off; `pio deploy
+        # --quality-eval` arms it.
+        self.quality_sample = min(1.0, max(0.0, float(
+            quality_sample if quality_sample is not None
+            else envknobs.env_float("PIO_QUALITY_SAMPLE", 0.0,
+                                    lo=0.0, hi=1.0))))
+        self.quality_k = max(1, _env_int("PIO_QUALITY_K", 10))
+        self.quality_min_samples = max(1, _env_int(
+            "PIO_QUALITY_MIN_SAMPLES", 20))
+        self.quality_max_drop = envknobs.env_float(
+            "PIO_QUALITY_MAX_DROP", 0.2, lo=0.0)
+        # labels are the user's NEXT events, so the quality watch
+        # usually outlives the error watch; 0 = inherit the error
+        # watch's window
+        self.quality_watch_ms = max(0.0, float(
+            _env_int("PIO_QUALITY_WATCH_MS", 0))) or self.swap_watch_ms
+        self.quality_resolve_ms = max(0.0, float(
+            _env_int("PIO_QUALITY_RESOLVE_MS", 2000)))
+        self.quality_ms = max(50.0, float(
+            _env_int("PIO_QUALITY_MS", 500)))
+        self._quality_task = None
+        # loop-confined (the _watch idiom): offer() appends from the
+        # request path, the loop ticks single-flight off-thread, and
+        # /status reads the last view snapshot
+        self._quality_runner = None
+        self._quality_view: Optional[dict] = None
+        self._quality_watch = None   # active post-swap quality watch
         self._previous = None            # (deployment, instance) resident
         self._pinned: dict[str, str] = {}  # instance id → pin reason
         # pins mid-application (store-walk rollback in flight): honored
@@ -493,6 +531,16 @@ class EngineServer:
                     "until": _time.monotonic() + self.swap_watch_ms / 1e3,
                     "total": 0, "errors": 0, "instance": instance.id,
                 }
+            if (swapped and self.quality_sample > 0
+                    and self.quality_watch_ms > 0):
+                # quality watch rides every swap alongside the error
+                # watch: while it is open, a canary-vs-last-good NDCG
+                # breach from the shadow scorer rolls this swap back
+                self._quality_watch = {
+                    "until": (_time.monotonic()
+                              + self.quality_watch_ms / 1e3),
+                    "instance": instance.id,
+                }
         log.info("deployed engine instance %s", instance.id)
         return True
 
@@ -607,6 +655,23 @@ class EngineServer:
                              or self.fleet_replica == 0),
                 "events": 0, "publishes": 0, "lagSeconds": None,
             }
+        if self.quality_sample > 0:
+            # continuous-quality surface: sampling/scoring counters,
+            # windowed live metrics, last-good deltas, holdout cursor
+            # (`pio status --engine-url` prints the quality line off
+            # this)
+            qw = self._quality_watch
+            out["quality"] = {
+                **(self._quality_view or {
+                    "enabled": True, "sample": self.quality_sample,
+                    "sampled": 0, "scored": 0}),
+                "watchMs": self.quality_watch_ms,
+                "watch": ({"instance": qw["instance"],
+                           "remainingMs": round(max(
+                               0.0, (qw["until"] - _time.monotonic())
+                               * 1e3), 1)}
+                          if qw is not None else None),
+            }
         if self.fleet_mode:
             # store-fed fleet aggregation, cached by the sync loop (no
             # storage I/O on the status path): directive state, every
@@ -678,11 +743,12 @@ class EngineServer:
         rb = telemetry.GaugeFamily(
             "pio_engine_rollbacks_total",
             "Deployment rollbacks to the retained previous model, by "
-            "reason (error-rate = automatic post-swap watch, manual = "
-            "/rollback)", ("reason",))
-        # always expose the automatic-rollback row so dashboards can
-        # alert on its first increment, plus any reasons already seen
-        for reason in sorted({"error-rate", *lc["rollbacks"]}):
+            "reason (error-rate = automatic post-swap watch, quality = "
+            "shadow-scorer breach, manual = /rollback)", ("reason",))
+        # always expose the automatic-rollback rows so dashboards can
+        # alert on their first increment, plus any reasons already seen
+        for reason in sorted({"error-rate", "quality",
+                              *lc["rollbacks"]}):
             rb.labels(reason).set(lc["rollbacks"].get(reason, 0))
         fams.append(rb)
         for name, help_, value in (
@@ -1055,6 +1121,13 @@ class EngineServer:
             result = await self._dispatch_query(deployment, query, dl)
             if self._watch is not None and self._is_live(deployment):
                 self._note_watch(ok=True)
+            if (self._quality_runner is not None
+                    and self._is_live(deployment)):
+                # shadow-scorer sampling: one RNG draw on the hot path;
+                # sampled queries cost one ranking extraction + an
+                # atomic deque append (scored off-loop by the quality
+                # tick, never here)
+                self._quality_runner.offer(query, result)
         except AdmissionShed as e:
             with self._adm_lock:
                 self._shed_count += 1
@@ -1425,6 +1498,9 @@ class EngineServer:
             self._previous = None
             restored = self.instance
         self._watch = None
+        # the bad instance's quality watch dies with it — the restored
+        # model is the last-good baseline, not a canary
+        self._quality_watch = None
         with self._lock:
             # setdefault: a fleet-directed rollback arrives AFTER the
             # coordinator already recorded the real pin reason (e.g.
@@ -1781,6 +1857,95 @@ class EngineServer:
         await self._publish_once("foldin")
         self._foldin_view = {**runner.view(), "producer": True}
 
+    # -- continuous quality evaluation (docs/operations.md
+    # "Continuous quality evaluation") ------------------------------------
+    async def _start_quality(self, app) -> None:
+        if self.quality_sample <= 0:
+            return
+        from . import quality
+
+        self._quality_runner = quality.QualityShadow(
+            self.storage, sample=self.quality_sample,
+            k=self.quality_k, min_samples=self.quality_min_samples,
+            max_drop=self.quality_max_drop,
+            resolve_ms=self.quality_resolve_ms)
+        self._quality_view = self._quality_runner.view()
+        self._quality_task = asyncio.get_running_loop().create_task(
+            self._quality_loop())
+
+    async def _stop_quality(self, app) -> None:
+        task, self._quality_task = self._quality_task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    async def _quality_loop(self) -> None:
+        """Shadow scoring (PIO_QUALITY_SAMPLE > 0): replay sampled live
+        queries against the retained last-good deployment, grade both
+        against held-out next events tailed from the app's log
+        partitions, and roll a quality-watch breach back through the
+        SAME path as an error-rate breach (reason "quality"). A failed
+        tick is logged and retried — the loop must never die."""
+        log.info("quality shadow loop armed (sample %.3f, every %.0f "
+                 "ms, watch %.0f ms, min %d samples, max ndcg drop "
+                 "%.3f)", self.quality_sample, self.quality_ms,
+                 self.quality_watch_ms, self.quality_min_samples,
+                 self.quality_max_drop)
+        while True:
+            await asyncio.sleep(self.quality_ms / 1000.0)
+            try:
+                await self._quality_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - tick errors never kill it
+                log.exception("quality tick failed; retrying next tick")
+
+    async def _quality_once(self) -> None:
+        runner = self._quality_runner
+        if runner is None:
+            return
+        with self._lock:
+            deployment, instance = self.deployment, self.instance
+            prev = self._previous
+        if deployment is None or instance is None:
+            return
+        qw = self._quality_watch
+        if qw is not None and (instance.id != qw["instance"]
+                               or _time.monotonic() > qw["until"]):
+            # superseded by a newer swap/rollback, or closed clean —
+            # only clear OUR snapshot (the _note_watch idiom): a
+            # concurrent _load may have armed the NEW swap's watch
+            if self._quality_watch is qw:
+                if instance.id == qw["instance"]:
+                    log.info("quality watch for %s closed clean",
+                             qw["instance"])
+                self._quality_watch = None
+            qw = None
+        prev_dep = prev[0] if prev is not None else None
+        try:
+            view = await asyncio.to_thread(runner.run_once, deployment,
+                                           instance, prev_dep)
+        finally:
+            self._quality_view = runner.view()
+        if not view.get("breach") or qw is None:
+            return
+        with self._lock:
+            live = self.instance
+        if (self._quality_watch is qw and live is not None
+                and live.id == qw["instance"]):
+            self._quality_watch = None
+            restored = self._rollback_to_previous("quality")
+            if restored:
+                log.warning(
+                    "quality watch breach on %s (ndcg drop %.4f > "
+                    "%.4f over %d graded samples): rolled back to %s",
+                    qw["instance"], view["deltas"].get("ndcg", 0.0),
+                    self.quality_max_drop,
+                    view.get("live", {}).get("n", 0), restored)
+
     def _newer_candidate(self):
         """Worker-thread poll: the newest non-pinned COMPLETED instance
         strictly newer than the live one, or None when up to date (the
@@ -1956,9 +2121,17 @@ class EngineServer:
         with self._adm_lock:
             draining = self._draining
         w = self._watch
-        watch_done = (w is None or cur is None
-                      or w.get("instance") != cur.id
-                      or _time.monotonic() > w["until"])
+        qw = self._quality_watch
+        # the coordinator treats the quality watch EXACTLY like the
+        # error watch: a canary promotes only once BOTH windows close
+        # clean (a ranking-degrading canary must not be promoted while
+        # its labels are still arriving)
+        watch_done = ((w is None or cur is None
+                       or w.get("instance") != cur.id
+                       or _time.monotonic() > w["until"])
+                      and (qw is None or cur is None
+                           or qw.get("instance") != cur.id
+                           or _time.monotonic() > qw["until"]))
         group = self._fleet_group()
         status = {
             "replica": self.fleet_replica,

@@ -21,6 +21,10 @@ scrapers of both ``/metrics`` endpoints) and the stores:
 - **zero non-{200,503,504}** HTTP responses (201 is ingest's 200)
 - **accepted-query p99** under a bound
 - **rollback within the watch window** for every poisoned publish
+- **quality regression rolled back** — the shadow scorer graded real
+  traffic, and a gate-passing, non-erroring, ranking-degrading
+  publish (``poison_quality``) was rolled back with an explicit
+  ``quality`` pin inside the window
 - **fold-in freshness lag** under ``freshness_factor`` × the fold-in
   interval once traffic quiesces
 - **clean drain** — both fronts exit 0 on SIGTERM
@@ -74,6 +78,8 @@ SLO_METRICS = (
     "pio_foldin_publishes_total",
     "pio_foldin_rollbacks_total",
     "pio_foldin_freshness_lag_seconds",
+    "pio_engine_quality_samples_total",
+    "pio_engine_quality_breaches_total",
 )
 
 # spec-armed scenario faults → the fault POINT their PIO_FAULT_SPEC
@@ -96,6 +102,8 @@ FAULT_MENU = (
     "good_retrain",     # ordinary retrain → staged rollout/hot swap
     "compact_crash",    # SIGKILL inside a compaction rename
     "poison_retrain",   # gate-passing poisoned retrain → watch rollback
+    "poison_quality",   # poison-rank event → non-erroring ranking
+    #                     degradation; the QUALITY watch rolls it back
 )
 
 # where each fault lands inside the wall budget (fractions): rollback-
@@ -108,7 +116,15 @@ _FAULT_WINDOWS = {
     "good_retrain": (0.45, 0.55),
     "compact_crash": (0.50, 0.60),
     "poison_retrain": (0.58, 0.66),
+    # last: the degraded chain stays refused until the wall ends, so
+    # nothing downstream should depend on fresh promotions
+    "poison_quality": (0.66, 0.74),
 }
+
+# catalog size for the zipfian item popularity the floods rate against:
+# ranking popular items first is MEASURABLY better than ranking them
+# last, which is what gives the shadow scorer its NDCG signal
+_ITEMS = 50
 
 
 # ---------------------------------------------------------------------------
@@ -139,6 +155,11 @@ class SoakConfig:
     refresh_ms: float = 500.0     # single-process refresh poll
     swap_watch_ms: float = 2500.0
     swap_max_error_rate: float = 0.3
+    # shadow scorer: every query sampled; the quality watch outlives
+    # the error watch so the resolve pipeline (labels tail in, samples
+    # age past the resolve window) fits inside it on a starved host
+    quality_sample: float = 1.0
+    quality_watch_ms: float = 6000.0
     fleet_sync_ms: float = 200.0
     compact_interval_ms: float = 2000.0
     faults: tuple = FAULT_MENU
@@ -177,6 +198,7 @@ class SoakPlan:
     app_names: list
     app_weights: list            # zipfian popularity over apps
     user_weights: list
+    item_weights: list           # zipfian item popularity (NDCG signal)
     faults: list                 # [FaultAction]
     worker_specs: dict           # worker idx -> joined spec string
     replica_specs: dict          # replica idx -> joined spec string
@@ -265,6 +287,7 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
     app_names = [primary] + [f"soak_a{i}" for i in range(1, cfg.apps)]
     app_weights = _zipf_weights(cfg.apps, cfg.zipf_s, rng)
     user_weights = _zipf_weights(cfg.users, cfg.zipf_s, rng)
+    item_weights = _zipf_weights(_ITEMS, cfg.zipf_s, rng)
     notes: list = []
     faults: list = []
 
@@ -346,6 +369,14 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
                 name, "train", at_s, target=app_names[0],
                 detail="poison-train event + retrain → gate passes, "
                        "watch rolls back + pins fleet-wide"))
+        elif name == "poison_quality":
+            faults.append(FaultAction(
+                name, "event", at_s, target=app_names[0],
+                detail="poison-rank event → gate-passing, NON-erroring "
+                       "increment that ranks worst-first; only the "
+                       "quality watch can catch it (reason `quality`). "
+                       "No antidote: the poison rides ONE event, "
+                       "consumed once by the fold-in cursor"))
 
     kills = sum(1 for f in faults if "kill" in f.name
                 or f.name == "compact_crash")
@@ -357,6 +388,11 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
         "query-p99": f"accepted p99 <= {cfg.p99_ms:.0f}ms",
         "rollback-window": "every poisoned publish rolled back within "
                            f"{cfg.rollback_deadline_s:.0f}s",
+        "quality-regression": (
+            f"shadow scorer sampled live traffic "
+            f"({cfg.quality_sample:.0%} of queries) and every quality "
+            "poison was rolled back with reason `quality` within "
+            f"{cfg.rollback_deadline_s:.0f}s"),
         "foldin-freshness": "settled lag <= "
                             f"{cfg.freshness_factor:.1f}x fold-in "
                             f"interval ({cfg.foldin_ms:.0f}ms)",
@@ -365,8 +401,12 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
         "clean-drain": "both fronts exit 0 on SIGTERM inside "
                        f"{cfg.drain_timeout_s:.0f}s",
     }
+    notes.append("observations are scraped through quiesce: rollback "
+                 "pins and fault evidence landing after the wall "
+                 "budget (starved-host double-load) still count")
     return SoakPlan(cfg=cfg, app_names=app_names,
                     app_weights=app_weights, user_weights=user_weights,
+                    item_weights=item_weights,
                     faults=faults, worker_specs=worker_specs,
                     replica_specs=replica_specs, notes=notes, slos=slos,
                     conn_budget=conn_budget)
@@ -485,6 +525,10 @@ class SoakRunner:
         # COMPLETED wins" means sustained freshness starves retrains);
         # background apps and ALL queries continue at full rate
         self.pause_primary = threading.Event()
+        # the scraper outlives `stop`: it keeps observing through
+        # quiesce so rollback pins / fault evidence that land after
+        # the wall budget (starved-host double-load) still count
+        self.scrape_stop = threading.Event()
         self.procs: dict = {}
         self.logs: dict = {}
         self.app_ids: dict = {}
@@ -518,6 +562,13 @@ class SoakRunner:
             "PIO_COMPACT_MIN_BYTES": "1",
             "PIO_FOLDIN_MS": f"{cfg.foldin_ms:.0f}",
             "PIO_SWAP_WATCH_MS": f"{cfg.swap_watch_ms:.0f}",
+            # shadow-scored serving: sample everything, small minimum
+            # so the thin-traffic gate still clears inside one watch
+            "PIO_QUALITY_SAMPLE": f"{cfg.quality_sample}",
+            "PIO_QUALITY_WATCH_MS": f"{cfg.quality_watch_ms:.0f}",
+            "PIO_QUALITY_MIN_SAMPLES": "5",
+            "PIO_QUALITY_RESOLVE_MS": "400",
+            "PIO_QUALITY_MS": "100",
             "PIO_SWAP_MAX_ERROR_RATE": f"{cfg.swap_max_error_rate}",
             "PIO_FLEET_SYNC_MS": f"{cfg.fleet_sync_ms:.0f}",
             "PIO_FLEET_READY_MS": "150",
@@ -697,6 +748,14 @@ class SoakRunner:
     def _pick(self, rng: random.Random, names: list, weights: list):
         return rng.choices(names, weights=weights, k=1)[0]
 
+    def _pick_item(self, rng: random.Random) -> int:
+        # zipfian item popularity: the floods concentrate their ratings
+        # on a head of popular items, so a ranking that puts the head
+        # first scores measurably better than one that buries it — the
+        # signal the quality watch grades poison_quality against
+        return rng.choices(range(_ITEMS),
+                           weights=self.plan.item_weights, k=1)[0]
+
     def _ingest_loop(self, idx: int, rate: float) -> None:
         """Open-loop single/batch ingest at ``rate``/s, zipfian over
         apps and users, alternating enqueue/commit acks. Failures are
@@ -740,7 +799,7 @@ class SoakRunner:
                     marker = self._next_marker(idx)
                     markers.append(marker)
                     events.append(self._event_json(
-                        f"u{user}", rng.randrange(50), marker, rng))
+                        f"u{user}", self._pick_item(rng), marker, rng))
                 try:
                     r = sess.post(
                         f"{base}/batch/events.json?accessKey={key}",
@@ -783,7 +842,8 @@ class SoakRunner:
                     r = sess.post(
                         f"{base}/events.json?accessKey={key}",
                         json=self._event_json(
-                            f"u{user}", rng.randrange(50), marker, rng),
+                            f"u{user}", self._pick_item(rng), marker,
+                            rng),
                         headers={"X-Pio-Ack": mode}, timeout=12)
                 except requests.RequestException:
                     sess.close()
@@ -862,7 +922,7 @@ class SoakRunner:
     def _scrape_loop(self) -> None:
         ev_base = f"http://127.0.0.1:{self.event_port}"
         en_base = f"http://127.0.0.1:{self.engine_port}"
-        while not self.stop.wait(1.0):
+        while not self.scrape_stop.wait(1.0):
             self._scrape_once(ev_base, en_base)
         self._scrape_once(ev_base, en_base)     # final sample
 
@@ -885,7 +945,7 @@ class SoakRunner:
                 self.samples.served.append((t_off, iid))
         lc = doc.get("lifecycle") or {}
         for inst, reason in (lc.get("pinned") or {}).items():
-            if reason in ("error-rate", "validate") \
+            if reason in ("error-rate", "validate", "quality") \
                     or reason.startswith("integrity"):
                 self.samples.note_rollback(
                     t_off, f"lifecycle:{inst}", f"pinned {reason}")
@@ -937,6 +997,8 @@ class SoakRunner:
                          "ok": True}
                 if f.name == "poison_foldin":
                     self._insert_control(f.target, "poison-serve")
+                elif f.name == "poison_quality":
+                    self._insert_control(f.target, "poison-rank")
                 elif f.name == "good_retrain":
                     entry["instance"], t_pub = self._retrain_frozen(
                         "good_retrain")
@@ -1027,9 +1089,11 @@ class SoakRunner:
                     if final_lag <= bound_s:
                         break
             time.sleep(0.3)
-        # let a watch window opened by the last publishes close
-        time.sleep(min(2.0, cfg.swap_watch_ms / 1000.0))
-        self._scrape_once(f"http://127.0.0.1:{self.event_port}", en_base)
+        # let a watch window opened by the last publishes close — the
+        # QUALITY watch is the longest one, and the scrape loop is
+        # still running, so late rollback pins are still observed
+        time.sleep(min(6.0, max(cfg.swap_watch_ms,
+                                cfg.quality_watch_ms) / 1000.0 + 0.5))
         return {"finalLagS": final_lag, "boundS": bound_s}
 
     def _drain(self) -> dict:
@@ -1085,9 +1149,10 @@ class SoakRunner:
         self._launch_engine()
         self._wait_ready()
 
-        threads = [threading.Thread(target=self._scrape_loop,
-                                    daemon=True, name="soak-scrape"),
-                   threading.Thread(target=self._fault_loop,
+        scrape_t = threading.Thread(target=self._scrape_loop,
+                                    daemon=True, name="soak-scrape")
+        scrape_t.start()
+        threads = [threading.Thread(target=self._fault_loop,
                                     daemon=True, name="soak-faults")]
         n_ing = 2 if cfg.ingest_rps > 25 else 1
         for i in range(n_ing):
@@ -1109,6 +1174,8 @@ class SoakRunner:
         for t in threads:
             t.join(45)
         freshness = self._quiesce()
+        self.scrape_stop.set()
+        scrape_t.join(20)
         drain = self._drain()
         supervisor_doc = self._event_supervisor_doc()
         reconciliation = reconcile_ledger(self.storage(), self.ledger,
@@ -1276,7 +1343,8 @@ def evaluate_slos(plan: SoakPlan, ledger: _Ledger, samples: _Samples,
     # rollback observation after it, within the bound (one observation
     # cannot satisfy two poisons — keys are consumed)
     poisons = sorted((f for f in fault_log
-                      if f["name"] in ("poison_foldin", "poison_retrain")
+                      if f["name"] in ("poison_foldin", "poison_retrain",
+                                       "poison_quality")
                       and f.get("ok")),
                      key=lambda f: f.get("firedAtS", 0.0))
     with samples.lock:
@@ -1367,6 +1435,13 @@ def evaluate_slos(plan: SoakPlan, ledger: _Ledger, samples: _Samples,
                 or metric_at_least(
                     'pio_engine_rollbacks_total{reason="error-rate"}'))
             ev["detail"] = "fleet/engine rollback counter >= 1"
+        elif f.name == "poison_quality":
+            ev["evidence"] = (
+                metric_at_least("pio_engine_quality_breaches_total")
+                or metric_at_least(
+                    'pio_engine_rollbacks_total{reason="quality"}'))
+            ev["detail"] = "quality breach / quality-reason rollback " \
+                           "counter >= 1"
         elif f.name == "good_retrain":
             entry = fired_by_name.get("good_retrain")
             with samples.lock:
@@ -1381,6 +1456,42 @@ def evaluate_slos(plan: SoakPlan, ledger: _Ledger, samples: _Samples,
                             "retrain completed but its instance was "
                             "never observed serving")
         fault_rows.append(ev)
+
+    # -- quality SLO: the scorer graded relevance, not just uptime ---------
+    # two legs: (a) the shadow scorer actually sampled live traffic
+    # (armed but never sampling = a dead scorer grading nothing), and
+    # (b) every fired quality poison has a rollback observation whose
+    # pin reason is EXPLICITLY `quality` within the window — an
+    # error-rate pin does not count, the poison never errors
+    q_poisons = [f for f in poisons if f["name"] == "poison_quality"]
+    q_consumed: set = set()
+    q_rows = []
+    ok_q = True
+    for f in q_poisons:
+        fired = float(f.get("firedAtS", 0.0))
+        matched = None
+        for t_off, key, detail in rollbacks:
+            if key in q_consumed or t_off < fired - 1.0 \
+                    or "quality" not in detail:
+                continue
+            delta = t_off - fired
+            if delta <= cfg.rollback_deadline_s:
+                q_consumed.add(key)
+                matched = {"key": key, "detail": detail,
+                           "afterS": round(delta, 1)}
+            break
+        q_rows.append({"fault": f["name"], "firedAtS": fired,
+                       "observed": matched})
+        if matched is None:
+            ok_q = False
+    armed = cfg.quality_sample > 0
+    scorer_live = (not armed) or metric_at_least(
+        "pio_engine_quality_samples_total")
+    slo("quality-regression", ok_q and scorer_live,
+        {"sampled": scorer_live, "rollbacks": q_rows},
+        plan.slos.get("quality-regression"),
+        f"{len(q_poisons)} quality poison(s) fired; scorer "
+        + ("sampled live traffic" if scorer_live else "NEVER sampled"))
 
     missing = [r["name"] for r in fault_rows
                if r["fired"] and not r.get("evidence", True)]

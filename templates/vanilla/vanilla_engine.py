@@ -31,6 +31,10 @@ from incubator_predictionio_tpu.controller import (
     DataSource,
     Engine,
     EngineFactory,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
+    OptionAverageMetric,
     Params,
     SanityCheck,
     Serving,
@@ -69,6 +73,29 @@ class VanillaDataSource(DataSource):
             channel_name=ctx.channel_name,
         )
         return TrainingData(u, i, r, items)
+
+    def read_eval(self, ctx):
+        """K-fold split for `pio eval` — the scaffold ships the whole
+        authorship surface, evaluation included: each held-out event's
+        item is the relevance label for a plain top-N query."""
+        from incubator_predictionio_tpu.e2.cross_validation import (
+            k_fold_indices,
+        )
+
+        td = self.read_training(ctx)
+        folds = []
+        for train_sel, test_sel in k_fold_indices(
+                len(td.item_idx), k=3, seed=0):
+            train = TrainingData(
+                td.user_idx[train_sel], td.item_idx[train_sel],
+                td.weight[train_sel], td.items)
+            queries = [
+                ({"num": 10},
+                 {"item": td.items.inverse(int(td.item_idx[j]))})
+                for j in np.nonzero(test_sel)[0]
+            ]
+            folds.append((train, None, queries))
+        return folds
 
 
 @dataclasses.dataclass
@@ -140,3 +167,49 @@ class VanillaEngine(EngineFactory):
             algorithm_class_map={"popularity": PopularityAlgorithm},
             serving_class=VanillaServing,
         )
+
+
+# -- evaluation (`pio eval vanilla_engine.VanillaEvaluation
+#    vanilla_engine.ParamsList --engine-dir <here>`) ----------------------
+#
+# The metric kernel is the continuous quality evaluator's
+# (incubator_predictionio_tpu.ops.eval) — the leaderboard number is
+# directly comparable to the live pio_engine_quality_metric gauge.
+
+class NDCGAtK(OptionAverageMetric):
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    def header(self) -> str:
+        return f"NDCG@{self.k}"
+
+    def calculate_unit(self, q, p, a):
+        from incubator_predictionio_tpu.ops import eval as evalops
+
+        items = [str(s["item"]) for s in p.get("itemScores", [])]
+        if not items or a.get("item") is None:
+            return None
+        m = evalops.ranking_metrics([items], [{str(a["item"])}], self.k)
+        return float(m["ndcg"]) if m["n"] else None
+
+
+class VanillaEvaluation(Evaluation):
+    def __init__(self):
+        self.engine = VanillaEngine()()
+        self.metric = NDCGAtK(k=10)
+        self.metrics = (NDCGAtK(k=5),)
+
+
+class ParamsList(EngineParamsGenerator):
+    """ratingWeight sweep: how much a rating outweighs a view/buy."""
+
+    def __init__(self, app_name: str = ""):
+        ds = {"params": ({"appName": app_name} if app_name else {})}
+        self.engine_params_list = [
+            EngineParams.from_json({
+                "datasource": ds,
+                "algorithms": [{"name": "popularity",
+                                "params": {"ratingWeight": w}}],
+            })
+            for w in (0.5, 1.0, 2.0)
+        ]

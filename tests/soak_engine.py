@@ -1,17 +1,25 @@
 """Jax-free DASE engine for the production-day soak harness
 (tests/test_soak.py): the full scenario surface in one tiny engine.
 
-- ``train`` builds a per-user score table from "rate" events. A
-  PENDING ``poison-train`` control event (more poison-train than
-  ``antidote`` events in the log) yields a GATE-PASSING poisoned model:
-  the golden query answers, arrays are finite, but every other user's
-  predict raises — the post-swap watch must roll it back. The driver
-  inserts the antidote after triggering the poisoned retrain so later
-  retrains come up clean (consumed-once, like a fold-in cursor).
+- ``train`` builds a per-user score table AND a per-item popularity
+  table from "rate" events; predict ranks the catalog by popularity
+  (``itemScores``), which is what the shadow scorer grades against
+  held-out next events. A PENDING ``poison-train`` control event (more
+  poison-train than ``antidote`` events in the log) yields a
+  GATE-PASSING poisoned model: the golden query answers, arrays are
+  finite, but every other user's predict raises — the post-swap watch
+  must roll it back. The driver inserts the antidote after triggering
+  the poisoned retrain so later retrains come up clean (consumed-once,
+  like a fold-in cursor).
+- ``poison-rank`` (train or fold-in) is the QUALITY threat: the model
+  stays gate-passing and NON-erroring but ranks the catalog
+  worst-first — only the shadow scorer's NDCG delta can catch it.
+  ``rank-antidote`` out-dates it on the train side.
 - ``fold_in`` merges rate events into a COPY; ``poison-nan`` /
-  ``poison-serve`` ride the DATA exactly as in tests/foldin_engine.py
-  (gate refusal / watch rollback); ``poison-train``/``antidote`` are
-  train-side controls and are ignored here.
+  ``poison-serve`` / ``poison-rank`` ride the DATA exactly as in
+  tests/foldin_engine.py (gate refusal / watch rollback / quality
+  rollback); ``poison-train``/``antidote`` are train-side controls
+  and are ignored here.
 
 Both the soak subprocesses (`pio train` / `pio deploy --engine-dir`)
 and the test process import this module by name (the template dir
@@ -28,15 +36,31 @@ from incubator_predictionio_tpu.controller.datasource import DataSource
 from incubator_predictionio_tpu.controller.engine import Engine
 
 
+TOP_K = 10
+
+
 @dataclasses.dataclass
 class SoakModel:
     scores: dict           # user id -> accumulated rating
     weights: np.ndarray    # finite unless nan-poisoned
-    poison: str = ""       # "" | "serve"
+    poison: str = ""       # "" | "serve" | "rank"
+    items: dict = dataclasses.field(default_factory=dict)
+    #                      # item id -> accumulated popularity mass
 
     def example_query(self):
         # warm-up / probe / swap-gate golden-query protocol
         return {"user": "golden"}
+
+    def ranking(self):
+        """Top-K catalog ranking. "rank"-poisoned models rank
+        worst-first: every entry is a real item with a finite score
+        (gates pass, nothing errors) — the ranking is just WRONG."""
+        worst_first = self.poison == "rank"
+        ranked = sorted(self.items.items(),
+                        key=lambda kv: (kv[1] if worst_first
+                                        else -kv[1], kv[0]))
+        return [{"item": i, "score": float(s)}
+                for i, s in ranked[:TOP_K]]
 
 
 class SoakDataSource(DataSource):
@@ -50,30 +74,45 @@ class SoakDataSource(DataSource):
 class SoakAlgorithm(Algorithm):
     def train(self, ctx, events):
         scores: dict = {}
-        n_poison = n_antidote = 0
+        items: dict = {}
+        n_poison = n_antidote = n_rank = n_rank_anti = 0
         for e in events:
             if e.event == "rate" and e.entity_id:
                 r = float(e.properties.get_or_else("rating", 1.0))
                 scores[e.entity_id] = scores.get(e.entity_id, 0.0) + r
+                if e.target_entity_id:
+                    it = str(e.target_entity_id)
+                    items[it] = items.get(it, 0.0) + r
             elif e.event == "poison-train":
                 n_poison += 1
             elif e.event == "antidote":
                 n_antidote += 1
-        poison = "serve" if n_poison > n_antidote else ""
+            elif e.event == "poison-rank":
+                n_rank += 1
+            elif e.event == "rank-antidote":
+                n_rank_anti += 1
+        poison = ""
+        if n_rank > n_rank_anti:
+            poison = "rank"
+        if n_poison > n_antidote:
+            poison = "serve"        # erroring poison dominates
         return SoakModel(scores=scores, weights=np.ones(3),
-                         poison=poison)
+                         poison=poison, items=items)
 
     def predict(self, model, query):
         user = str(query["user"])
         if model.poison == "serve" and user != "golden":
             raise RuntimeError("poisoned retrain: predict exploded")
-        if user == "golden" or user in model.scores:
-            return {"user": user, "known": True,
-                    "score": float(model.scores.get(user, 0.0))}
-        return {"user": user, "known": False}
+        out = {"user": user, "known": user == "golden"
+               or user in model.scores,
+               "itemScores": model.ranking()}
+        if out["known"]:
+            out["score"] = float(model.scores.get(user, 0.0))
+        return out
 
     def fold_in(self, model, events, ctx, data_source_params=None):
         scores = dict(model.scores)
+        items = dict(model.items)
         weights = model.weights
         poison = model.poison
         changed = False
@@ -86,6 +125,11 @@ class SoakAlgorithm(Algorithm):
             elif name == "poison-serve":
                 poison = "serve"
                 changed = True
+            elif name == "poison-rank":
+                # the quality threat: nothing errors, the gate passes,
+                # the ranking is simply wrong from here on
+                poison = "rank"
+                changed = True
             elif name == "rate" and uid:
                 props = e.get("properties") or {}
                 try:
@@ -93,11 +137,15 @@ class SoakAlgorithm(Algorithm):
                 except (TypeError, ValueError):
                     r = 1.0
                 scores[str(uid)] = scores.get(str(uid), 0.0) + r
+                tid = e.get("targetEntityId")
+                if tid:
+                    items[str(tid)] = items.get(str(tid), 0.0) + r
                 changed = True
             # poison-train / antidote are TRAIN-side controls: ignored
         if not changed:
             return None
-        return SoakModel(scores=scores, weights=weights, poison=poison)
+        return SoakModel(scores=scores, weights=weights, poison=poison,
+                         items=items)
 
     # no jax: the pickled payload is the model itself
     def prepare_model_for_persistence(self, model):

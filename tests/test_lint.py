@@ -707,6 +707,60 @@ def test_soak_fault_registry_seeded_violations(tmp_path):
     assert len(fs) == 1 and "FAULT_POINTS" in fs[0].message
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 16: the quality vertical is inside the registries' reach
+# ---------------------------------------------------------------------------
+
+def test_seeded_quality_metric_family_coverage(tmp_path):
+    """metric-name-registry covers `pio_engine_quality_*`: the
+    family's registrations red without their docs rows and go clean
+    with them — so docs/operations.md's quality table is enforced, not
+    decorative."""
+    src = """
+        from . import telemetry
+        B = telemetry.registry().counter(
+            "pio_engine_quality_breaches_total", "breach verdicts")
+        M = telemetry.registry().gauge(
+            "pio_engine_quality_metric", "live quality", ("metric",))
+        """
+    fs = findings_for(tmp_path, {"common/qualmetrics.py": src},
+                      ["metric-name-registry"],
+                      docs={"operations.md": "no rows here\n"})
+    assert len(fs) == 2, [f.message for f in fs]
+    assert all("is not documented" in f.message for f in fs)
+    assert findings_for(
+        tmp_path / "docd", {"common/qualmetrics.py": src},
+        ["metric-name-registry"],
+        docs={"operations.md":
+              "| `pio_engine_quality_breaches_total` | counter |\n"
+              "| `pio_engine_quality_metric` | gauge |\n"}) == []
+
+
+def test_seeded_quality_slo_row_coverage(tmp_path):
+    """soak-slo-registry covers the quality-regression SLO row's
+    evidence families: dropping one of its docs rows is a finding, so
+    the scorecard cannot assert evidence nothing documents."""
+    files = {"workflow/soak.py": '''
+        SLO_METRICS = (
+            "pio_engine_quality_samples_total",
+            "pio_engine_quality_breaches_total",
+        )
+        FAULT_POINTS = {}
+    '''}
+    assert findings_for(
+        tmp_path, files, ["soak-slo-registry"],
+        {"operations.md":
+         "| `pio_engine_quality_samples_total` | counter |\n"
+         "| `pio_engine_quality_breaches_total` | counter |\n"}) == []
+    fs = findings_for(
+        tmp_path / "red", files, ["soak-slo-registry"],
+        {"operations.md":
+         "| `pio_engine_quality_samples_total` | counter |\n"})
+    assert len(fs) == 1, [f.message for f in fs]
+    assert "pio_engine_quality_breaches_total" in fs[0].message
+    assert "not a documented metric family" in fs[0].message
+
+
 def test_seeded_train_feed_confinement(tmp_path):
     """Training-path modules (workflow/ + ops/) may not read events
     through the merged view or touch shard files directly; the same

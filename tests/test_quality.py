@@ -1,0 +1,566 @@
+"""Continuous quality evaluation (ISSUE 16): shadow-scored serving
+with quality-triggered rollback.
+
+- metric kernels (ops/eval.py) against hand-computed MAP@k / NDCG@k /
+  AUC reference values, window accumulation, and the
+  canary-vs-last-good verdict (min-sample gate, threshold edge)
+- the holdout tailer (data/api/holdout.py) arms at the CURRENT log
+  end, groups next events per user, skips $-property writes, and
+  bounds memory on both axes
+- QualityShadow seeded-degradation units: a worst-first live leg
+  against a popular-first shadow leg breaches exactly once per window;
+  thin traffic is gated; a served-instance change resets the window
+  and expires pending samples
+- the acceptance e2e IN PROCESS through the REAL quality watch: a
+  gate-passing, NON-erroring, ranking-degrading publish — fold-in
+  increment AND retrain variants — is rolled back with reason
+  ``quality`` while every client query stays 200, and the pinned
+  instance stays refused until a clean retrain self-heals the loop
+"""
+
+import json
+import threading
+import time
+import types
+
+import pytest
+import requests
+
+import soak_engine
+from incubator_predictionio_tpu.controller.engine import EngineParams
+from incubator_predictionio_tpu.data.api.holdout import HoldoutTailer
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import App
+from incubator_predictionio_tpu.data.storage.datamap import DataMap
+from incubator_predictionio_tpu.data.storage.event import Event
+from incubator_predictionio_tpu.ops import eval as evalops
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import run_train
+from incubator_predictionio_tpu.workflow.create_server import EngineServer
+from incubator_predictionio_tpu.workflow.quality import (
+    QualityShadow, extract_ranking)
+
+from server_utils import ServerThread
+
+pytestmark = [pytest.mark.quality, pytest.mark.chaos]
+
+APP = "qualapp"
+
+
+def _mixed_storage(tmp_path):
+    return Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "JL",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_JL_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_JL_PATH": str(tmp_path / "events"),
+    })
+
+
+def _mk_app(storage, name=APP) -> int:
+    return storage.get_meta_data_apps().insert(App(id=0, name=name))
+
+
+def _rate(le, app_id, user, item, rating=1.0, event="rate"):
+    le.insert(Event(event=event, entity_type="user", entity_id=user,
+                    target_entity_type="item", target_entity_id=item,
+                    properties=DataMap({"rating": rating})), app_id)
+
+
+def _wait(fn, deadline_s=20.0, interval=0.05):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+# ---------------------------------------------------------------------------
+# metric kernels: hand-computed reference values
+# ---------------------------------------------------------------------------
+
+def test_ranking_metrics_reference_values():
+    # ranked [a, b, c], relevant {a, c}:
+    #   AP@3   = (1/1 + 2/3) / 2                      = 0.833333
+    #   NDCG@3 = (1/log2(2) + 1/log2(4)) / (1 + 1/log2(3)) = 0.919721
+    #   AUC    = relevant-above-irrelevant pairs: (a,b) yes, (c,b) no
+    m = evalops.ranking_metrics([["a", "b", "c"]], [{"a", "c"}], 3)
+    assert m["n"] == 1 and m["n_auc"] == 1
+    assert abs(m["map"] - 5.0 / 6.0) < 1e-5
+    assert abs(m["ndcg"] - 0.9197207) < 1e-5
+    assert abs(m["auc"] - 0.5) < 1e-5
+
+
+def test_ranking_metrics_perfect_and_disjoint_lists():
+    perfect = evalops.ranking_metrics([["a", "b"]], [{"a", "b"}], 2)
+    assert perfect["map"] == pytest.approx(1.0, abs=1e-6)
+    assert perfect["ndcg"] == pytest.approx(1.0, abs=1e-6)
+    # an all-relevant list carries no (rel, irrel) pairs: no AUC sample
+    assert perfect["n_auc"] == 0
+    miss = evalops.ranking_metrics([["x", "y"]], [{"a"}], 2)
+    assert miss["map"] == pytest.approx(0.0, abs=1e-6)
+    assert miss["ndcg"] == pytest.approx(0.0, abs=1e-6)
+    assert miss["n_auc"] == 0
+    # empty label sets are invalid samples, not zeros
+    empty = evalops.ranking_metrics([["a"]], [set()], 2)
+    assert empty["n"] == 0
+
+
+def test_ranking_metrics_truncates_to_k():
+    # beyond-k positions must not score: relevant item at position 3
+    # with k=2 is a miss
+    m = evalops.ranking_metrics([["x", "y", "a"]], [{"a"}], 2)
+    assert m["map"] == pytest.approx(0.0, abs=1e-6)
+    assert m["ndcg"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_metric_window_accumulates_weighted_means():
+    w = evalops.MetricWindow()
+    w.add(evalops.ranking_metrics([["a", "b"]], [{"a"}], 2))
+    w.add(evalops.ranking_metrics(
+        [["x", "y"], ["p", "q"]], [{"y"}, {"p"}], 2))
+    means = w.means()
+    assert means["n"] == 3
+    # per-sample AP: 1.0, 0.5, 1.0 → mean 2.5/3
+    assert means["map"] == pytest.approx(2.5 / 3.0, abs=1e-5)
+    w.reset()
+    assert w.means()["n"] == 0
+
+
+def test_quality_verdict_threshold_and_min_sample_gate():
+    good = {"map": 0.9, "ndcg": 0.9, "auc": 0.8, "n": 10, "n_auc": 8}
+    bad = {"map": 0.2, "ndcg": 0.3, "auc": 0.5, "n": 10, "n_auc": 8}
+    breach, deltas = evalops.quality_verdict(
+        bad, good, min_samples=5, max_drop=0.2)
+    assert breach and deltas["ndcg"] == pytest.approx(0.6)
+    # at-threshold is NOT a breach (strict >)
+    edge = dict(bad, ndcg=0.7)
+    breach, deltas = evalops.quality_verdict(
+        edge, good, min_samples=5, max_drop=0.2)
+    assert not breach and deltas["ndcg"] == pytest.approx(0.2)
+    # the min-sample gate kills a thin-window verdict on EITHER side
+    thin = dict(bad, n=4)
+    assert not evalops.quality_verdict(
+        thin, good, min_samples=5, max_drop=0.2)[0]
+    assert not evalops.quality_verdict(
+        bad, dict(good, n=4), min_samples=5, max_drop=0.2)[0]
+
+
+# ---------------------------------------------------------------------------
+# holdout tailer: held-out next events as labels
+# ---------------------------------------------------------------------------
+
+def test_holdout_arms_at_log_end_and_pairs_next_events(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _rate(le, app_id, "u0", "history")   # predates the tailer
+    t = HoldoutTailer(le.events_dir, app_id)
+    assert t.poll() == 0
+    assert t.labels_for("u0") == frozenset()
+    # future events are labels, grouped per acting user
+    _rate(le, app_id, "u0", "i1")
+    _rate(le, app_id, "u0", "i2")
+    _rate(le, app_id, "u1", "i1")
+    # property writes and target-less events carry no relevance signal
+    le.insert(Event(event="$set", entity_type="user", entity_id="u0",
+                    target_entity_type="item", target_entity_id="i9",
+                    properties=DataMap({"a": 1})), app_id)
+    le.insert(Event(event="poison-rank", entity_type="sys",
+                    entity_id="x"), app_id)
+    assert t.poll() == 3
+    assert t.labels_for("u0") == frozenset({"i1", "i2"})
+    assert t.labels_for("u1") == frozenset({"i1"})
+    assert t.labels_for("stranger") == frozenset()
+    v = t.view()
+    assert v["labelEvents"] == 3 and v["labelUsers"] == 2
+    assert v["events"] == 5 and v["cursorBytes"] > 0
+
+
+def test_holdout_memory_bounds_lru_users_and_label_caps(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    t = HoldoutTailer(le.events_dir, app_id, max_users=2,
+                      max_labels_per_user=3)
+    for i in range(5):
+        _rate(le, app_id, "busy", f"i{i}")
+    _rate(le, app_id, "a", "x")
+    _rate(le, app_id, "b", "y")
+    t.poll()
+    # per-user cap keeps the RECENT actions
+    assert t.labels_for("busy") == frozenset({"i3", "i4"}) \
+        or t.labels_for("busy") == frozenset()
+    # max_users=2: "busy" (oldest) was evicted by a+b
+    assert t.labels_for("a") == frozenset({"x"})
+    assert t.labels_for("b") == frozenset({"y"})
+    assert t.view()["labelUsers"] == 2
+
+
+def test_extract_ranking_shapes():
+    assert extract_ranking({"itemScores": [
+        {"item": "a", "score": 1.0}, {"item": 2, "score": 0.5},
+    ]}) == ["a", "2"]
+    assert extract_ranking({"score": 4.0}) is None       # scalar answer
+    assert extract_ranking({"itemScores": []}) is None
+    assert extract_ranking({"itemScores": [{"score": 1.0}]}) is None
+    assert extract_ranking("nope") is None
+
+
+# ---------------------------------------------------------------------------
+# QualityShadow: seeded degradation, gates, window lifecycle
+# ---------------------------------------------------------------------------
+
+GOOD = [f"g{i}" for i in range(5)]      # popular-first: labels hit g0
+BAD = list(reversed(GOOD))              # worst-first: g0 dead last
+
+
+class _Serving:
+    def supplement(self, q):
+        return q
+
+    def serve(self, q, predictions):
+        return predictions[0]
+
+
+class _RankAlgo:
+    def __init__(self, ranked):
+        self.ranked = ranked
+
+    def predict(self, model, query):
+        return {"itemScores": [{"item": i, "score": float(-n)}
+                               for n, i in enumerate(self.ranked)]}
+
+
+def _dep(ranked):
+    return types.SimpleNamespace(serving=_Serving(),
+                                 algo_list=[("", _RankAlgo(ranked))],
+                                 models=[None])
+
+
+def _inst(iid):
+    return types.SimpleNamespace(id=iid, env={"appName": APP},
+                                 data_source_params="{}")
+
+
+def _prediction(ranked):
+    return {"itemScores": [{"item": i, "score": 1.0} for i in ranked]}
+
+
+def _shadow(storage, **kw):
+    kw.setdefault("sample", 1.0)
+    kw.setdefault("k", 5)
+    kw.setdefault("min_samples", 3)
+    kw.setdefault("max_drop", 0.2)
+    kw.setdefault("resolve_ms", 30)
+    return QualityShadow(storage, **kw)
+
+
+def test_shadow_breach_on_seeded_degradation_latches_once(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    qs = _shadow(storage)
+    inst = _inst("bad-1")
+    view = qs.run_once(None, inst, None)
+    assert view["enabled"] and "holdout" in view
+    users = ["u1", "u2", "u3", "u4"]
+    for u in users:
+        qs.offer({"user": u}, _prediction(BAD))
+    le = storage.get_l_events()
+    for u in users:                      # every user touches g0 next
+        _rate(le, app_id, u, "g0")
+    time.sleep(0.06)                     # age past the resolve window
+    view = qs.run_once(None, inst, _dep(GOOD))
+    assert view["breach"] is True and view["breached"] is True
+    assert view["scored"] == 4
+    assert view["live"]["ndcg"] < 0.5 < view["shadow"]["ndcg"]
+    assert view["deltas"]["ndcg"] > 0.2
+    # latched: ONE breach verdict per window
+    assert qs.run_once(None, inst, _dep(GOOD))["breach"] is False
+
+
+def test_shadow_min_sample_gate_blocks_thin_windows(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    qs = _shadow(storage, min_samples=3)
+    inst = _inst("bad-1")
+    qs.run_once(None, inst, None)
+    le = storage.get_l_events()
+    for u in ("u1", "u2"):               # only 2 graded samples
+        qs.offer({"user": u}, _prediction(BAD))
+        _rate(le, app_id, u, "g0")
+    time.sleep(0.06)
+    view = qs.run_once(None, inst, _dep(GOOD))
+    assert view["scored"] == 2 and view["deltas"]["ndcg"] > 0.2
+    assert view["breach"] is False and view["breached"] is False
+
+
+def test_shadow_window_resets_on_instance_change(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    _mk_app(storage)
+    qs = _shadow(storage)
+    qs.run_once(None, _inst("inst-1"), None)
+    qs.offer({"user": "u1"}, _prediction(BAD))
+    qs.run_once(None, _inst("inst-1"), None)   # intake → pending
+    view = qs.run_once(None, _inst("inst-2"), None)
+    # pending samples graded a model that no longer serves: expired
+    assert view["instance"] == "inst-2"
+    assert view["expired"] == 1 and view["pending"] == 0
+    assert view["breached"] is False
+
+
+def test_shadow_unlabeled_samples_expire(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    _mk_app(storage)
+    qs = _shadow(storage, resolve_ms=20)
+    inst = _inst("inst-1")
+    qs.run_once(None, inst, None)
+    qs.offer({"user": "ghost"}, _prediction(BAD))  # user never acts
+    time.sleep(0.12)                     # past resolve * expire factor
+    view = qs.run_once(None, inst, None)
+    assert view["expired"] == 1 and view["scored"] == 0
+
+
+def test_shadow_offer_filters_unsampleable_queries(tmp_path):
+    storage = _mixed_storage(tmp_path)
+    _mk_app(storage)
+    qs = _shadow(storage)
+    qs.offer({"user": "u"}, {"score": 4.0})        # no ranking
+    qs.offer({"nouser": 1}, _prediction(GOOD))     # no acting entity
+    qs.offer("raw", _prediction(GOOD))             # non-dict query
+    assert qs.view()["sampled"] == 0
+    off = _shadow(storage, sample=0.0)
+    off.offer({"user": "u"}, _prediction(GOOD))    # sampling disabled
+    assert off.view()["sampled"] == 0
+
+
+def test_shadow_disabled_without_jsonl_event_log():
+    storage = Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+    })
+    _mk_app(storage)
+    qs = _shadow(storage)
+    view = qs.run_once(None, _inst("inst-1"), None)
+    assert view["enabled"] is False
+    assert "JSONL" in view["disabledReason"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: gate-passing, NON-erroring, ranking-degrading
+# publishes roll back through the REAL quality watch
+# ---------------------------------------------------------------------------
+
+CATALOG = [f"i{n:02d}" for n in range(12)]   # popularity descending
+
+
+def _seed_catalog(le, app_id):
+    # per-item popularity mass: i00 strongly dominant, so the good
+    # model's top-k leads with i00 and the worst-first poison's top-10
+    # (of 12) EXCLUDES it entirely
+    for n, item in enumerate(CATALOG):
+        _rate(le, app_id, "seeder", item, rating=float(len(CATALOG) - n))
+
+
+def _train(storage, app=APP):
+    ctx = WorkflowContext(app_name=app, storage=storage)
+    iid = run_train(
+        soak_engine.engine_factory(),
+        EngineParams(data_source_params={"appName": app},
+                     algorithm_params_list=[("", {})]),
+        ctx, engine_factory_name="qualsoak")
+    time.sleep(0.002)   # strictly ordered start_times
+    return iid
+
+
+def _server(storage, **kw):
+    kw.setdefault("quality_sample", 1.0)
+    kw.setdefault("swap_watch_ms", 60_000)
+    kw.setdefault("swap_max_error_rate", 0.9)
+    return EngineServer(soak_engine.engine_factory(),
+                        engine_factory_name="qualsoak",
+                        storage=storage, **kw)
+
+
+@pytest.fixture()
+def quality_knobs(monkeypatch):
+    # fast-cadence quality loop: resolve samples in ~150ms, breach
+    # after 3 graded samples, watch open long enough to always catch
+    monkeypatch.setenv("PIO_QUALITY_MIN_SAMPLES", "3")
+    monkeypatch.setenv("PIO_QUALITY_RESOLVE_MS", "150")
+    monkeypatch.setenv("PIO_QUALITY_MS", "60")
+    monkeypatch.setenv("PIO_QUALITY_WATCH_MS", "60000")
+
+
+def _query(base, user, timeout=30):
+    return requests.post(base + "/queries.json", json={"user": user},
+                         timeout=timeout)
+
+
+def _pump(base, stop, codes):
+    users = ["u0", "u1", "u2", "u3"]
+    n = 0
+    while not stop.is_set():
+        codes.append(_query(base, users[n % len(users)]).status_code)
+        n += 1
+        time.sleep(0.01)
+
+
+def _await_quality_armed(base):
+    return _wait(lambda: (lambda q: q if q and q.get("holdout")
+                          else None)(
+        requests.get(base + "/status").json().get("quality")), 20)
+
+
+def _feed_labels(le, app_id, stop):
+    # the users' NEXT actions all touch the most popular item — "view"
+    # is label-bearing for the holdout tailer but a no-op for fold_in,
+    # so feeding labels never publishes a fresh increment (which would
+    # reset the quality window under test)
+    while not stop.is_set():
+        for u in ("u0", "u1", "u2", "u3"):
+            _rate(le, app_id, u, "i00", event="view")
+        time.sleep(0.1)
+
+
+def _run_degradation_watch(storage, app_id, server, poison_swap):
+    """Drive live traffic + labels while `poison_swap` publishes the
+    degraded model; return (lifecycle, codes, metrics_text)."""
+    le = storage.get_l_events()
+    stop = threading.Event()
+    codes: list = []
+    with ServerThread(server.app) as st:
+        assert _await_quality_armed(st.base), "quality scorer never armed"
+        threads = [
+            threading.Thread(target=_pump, args=(st.base, stop, codes)),
+            threading.Thread(target=_feed_labels,
+                             args=(le, app_id, stop)),
+        ]
+        for t in threads:
+            t.start()
+        try:
+            poison_swap(st)
+            lc = _wait(lambda: (lambda d: d if d["rollbacks"] else None)(
+                requests.get(st.base + "/status").json()["lifecycle"]),
+                30)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30)
+        status = requests.get(st.base + "/status").json()
+        metrics = requests.get(st.base + "/metrics").text
+    return lc, codes, status, metrics
+
+
+def _metric_value(metrics_text, needle):
+    for line in metrics_text.splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+@pytest.mark.foldin
+def test_poisoned_foldin_quality_rollback_in_process(
+        tmp_path, quality_knobs):
+    """Fold-in variant: a poison-rank increment passes the validation
+    gate, errors on NOTHING, and degrades only the ranking — the
+    quality watch alone rolls it back, clients at 200 throughout."""
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _seed_catalog(le, app_id)
+    good = _train(storage)
+    server = _server(storage, foldin_ms=60)
+
+    def poison_swap(st):
+        le.insert(Event(event="poison-rank", entity_type="sys",
+                        entity_id="x"), app_id)
+        swapped = _wait(lambda: (lambda d: d if d != good else None)(
+            requests.get(st.base + "/status").json()
+            .get("engineInstanceId")), 20)
+        assert swapped, "poisoned increment never swapped in"
+
+    lc, codes, status, metrics = _run_degradation_watch(
+        storage, app_id, server, poison_swap)
+    assert lc and lc["rollbacks"] == {"quality": 1}
+    assert "quality" in lc["pinned"].values()
+    assert lc["instance"] == good
+    # non-erroring by construction: every client query answered 200
+    assert codes and set(codes) == {200}, sorted(set(codes))
+    q = status["quality"]
+    assert q["sampled"] > 0 and q["holdout"]["labelEvents"] > 0
+    assert _metric_value(
+        metrics, 'pio_engine_rollbacks_total{reason="quality"}') >= 1
+    assert _metric_value(
+        metrics, "pio_engine_quality_breaches_total") >= 1
+
+
+@pytest.mark.lifecycle
+def test_poisoned_retrain_quality_rollback_and_self_heal_in_process(
+        tmp_path, quality_knobs):
+    """Retrain variant: a rank-poisoned RETRAIN (not an increment)
+    passes the gate, is picked up by the refresh loop, breaches the
+    quality watch, and is rolled back + pinned — then a clean retrain
+    (rank-antidote) is adopted past the pin: the loop self-heals."""
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    le = storage.get_l_events()
+    _seed_catalog(le, app_id)
+    good = _train(storage)
+    server = _server(storage, model_refresh_ms=100)
+    bad: dict = {}
+
+    def poison_swap(st):
+        le.insert(Event(event="poison-rank", entity_type="sys",
+                        entity_id="x"), app_id)
+        bad["iid"] = _train(storage)
+        swapped = _wait(lambda: (lambda d: d if d == bad["iid"]
+                                 else None)(
+            requests.get(st.base + "/status").json()
+            .get("engineInstanceId")), 20)
+        assert swapped, "poisoned retrain never swapped in"
+
+    le_holder = storage.get_l_events()
+    stop = threading.Event()
+    codes: list = []
+    with ServerThread(server.app) as st:
+        assert _await_quality_armed(st.base), "quality scorer never armed"
+        threads = [
+            threading.Thread(target=_pump, args=(st.base, stop, codes)),
+            threading.Thread(target=_feed_labels,
+                             args=(le_holder, app_id, stop)),
+        ]
+        for t in threads:
+            t.start()
+        try:
+            poison_swap(st)
+            lc = _wait(lambda: (lambda d: d if d["rollbacks"] else None)(
+                requests.get(st.base + "/status").json()["lifecycle"]),
+                30)
+            assert lc and lc["rollbacks"] == {"quality": 1}
+            assert lc["instance"] == good
+            assert lc["pinned"].get(bad["iid"]) == "quality"
+            # self-heal: the antidote out-dates the poison, the clean
+            # retrain is newer than the PINNED one and gets adopted
+            le_holder.insert(Event(event="rank-antidote",
+                                   entity_type="sys", entity_id="x"),
+                             app_id)
+            clean = _train(storage)
+            healed = _wait(lambda: (lambda d: d if d == clean else None)(
+                requests.get(st.base + "/status").json()
+                .get("engineInstanceId")), 20)
+            assert healed, "clean retrain never adopted past the pin"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(30)
+        metrics = requests.get(st.base + "/metrics").text
+    assert codes and set(codes) == {200}, sorted(set(codes))
+    assert _metric_value(
+        metrics, 'pio_engine_rollbacks_total{reason="quality"}') >= 1

@@ -236,6 +236,8 @@ def _green_fixture(tmp_path):
         'pio_ingest_append_errors_total{kind="enospc"}': 1.0,
         'pio_foldin_rollbacks_total{reason="error-rate"}': 1.0,
         'pio_fleet_rollbacks_total{reason="error-rate"}': 2.0,
+        "pio_engine_quality_samples_total": 40.0,
+        "pio_engine_quality_breaches_total": 1.0,
     }
     samples.restarts = {"replica:1": 1}
     samples.served = [(1.0, "iid-initial"), (at["good_retrain"] + 6,
@@ -245,6 +247,8 @@ def _green_fixture(tmp_path):
          "directive pin error-rate"),
         (at["poison_retrain"] + 7, "fleet:iid-pr",
          "directive pin error-rate"),
+        (at["poison_quality"] + 4, "fleet:iid-pq",
+         "directive pin quality"),
     ]
     samples.foldin_publishes = 5
     supervisor_doc = {"workers": [{"worker": 0, "restarts": 1},
@@ -258,6 +262,8 @@ def _green_fixture(tmp_path):
         {"name": "poison_retrain", "atS": at["poison_retrain"],
          "firedAtS": at["poison_retrain"], "ok": True,
          "instance": "iid-poison"},
+        {"name": "poison_quality", "atS": at["poison_quality"],
+         "firedAtS": at["poison_quality"], "ok": True},
     ]
     reconciliation = {"ackedEvents": 10, "storeMarkers": 10,
                       "lostAcked": [], "lostAckedCount": 0,
@@ -288,7 +294,7 @@ def test_slo_evaluator_green_fixture_passes(tmp_path):
     bad = [s["name"] for s in slos if not s["ok"]]
     assert not bad, (bad, slos)
     assert all(f["evidence"] for f in faults), faults
-    assert len(faults) == 7
+    assert len(faults) == 8
 
 
 def test_slo_acked_loss_and_duplicates_red(tmp_path):
@@ -336,11 +342,16 @@ def test_slo_rollback_window_red_paths(tmp_path):
     fx["samples"].rollback_seen = fx["samples"].rollback_seen[:1]
     slos, _ = _eval(fx)
     assert not _slo(slos, "rollback-window")["ok"]
-    # a too-late observation fails
+    # too-late observations fail (every post-foldin pin arrives past
+    # the deadline, so neither retrain-poison can match anything)
     fx = _green_fixture(tmp_path)
     at = {f.name: f.at_s for f in fx["plan"].faults}
-    fx["samples"].rollback_seen[1] = (
-        at["poison_retrain"] + 31, "fleet:iid-pr", "late pin")
+    fx["samples"].rollback_seen = [
+        fx["samples"].rollback_seen[0],
+        (at["poison_retrain"] + 100, "fleet:iid-pr", "late pin"),
+        (at["poison_quality"] + 100, "fleet:iid-pq",
+         "late directive pin quality"),
+    ]
     slos, _ = _eval(fx)
     assert not _slo(slos, "rollback-window")["ok"]
     # ONE observation cannot satisfy BOTH poisons (keys consumed)
@@ -375,6 +386,46 @@ def test_slo_conn_errors_and_drain_red(tmp_path):
     fx["drain"] = {"engine": 0}          # one front never drained
     slos, _ = _eval(fx)
     assert not _slo(slos, "clean-drain")["ok"]
+
+
+def test_slo_quality_regression_red_paths(tmp_path):
+    # an error-rate pin does NOT satisfy the quality row: the poison
+    # never errors, so only an explicit `quality` pin proves the
+    # shadow scorer (not the error watch) caught it
+    fx = _green_fixture(tmp_path)
+    fx["samples"].rollback_seen = [
+        (t, k, d.replace("quality", "error-rate"))
+        for t, k, d in fx["samples"].rollback_seen]
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "quality-regression")["ok"]
+    # the generic rollback-window row stays green on ANY pin — the
+    # quality row is the one that distinguishes the reason
+    assert _slo(slos, "rollback-window")["ok"]
+    # an armed scorer that never sampled is a dead scorer: red even
+    # with the rollback leg green
+    fx = _green_fixture(tmp_path)
+    del fx["samples"].metric_max["pio_engine_quality_samples_total"]
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "quality-regression")["ok"]
+    # a quality pin past the deadline fails the window
+    fx = _green_fixture(tmp_path)
+    at = {f.name: f.at_s for f in fx["plan"].faults}
+    fx["samples"].rollback_seen = [
+        (t, k, d) for t, k, d in fx["samples"].rollback_seen
+        if "quality" not in d
+    ] + [(at["poison_quality"] + 31, "fleet:iid-pq",
+          "directive pin quality")]
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "quality-regression")["ok"]
+
+
+def test_slo_quality_fault_evidence_red_without_breach_counter(
+        tmp_path):
+    fx = _green_fixture(tmp_path)
+    del fx["samples"].metric_max["pio_engine_quality_breaches_total"]
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "fault-evidence")["ok"]
+    assert "poison_quality" in _slo(slos, "fault-evidence")["value"]
 
 
 def test_slo_fault_evidence_red_per_fault_kind(tmp_path):

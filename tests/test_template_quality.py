@@ -1,0 +1,285 @@
+"""Template-trio parity tests (ROADMAP item 1 rider, PR 16): the
+formerly under-tested templates — e-commerce, complementary-purchase,
+and the vanilla scaffold — reach tier-1 + eval parity with the big
+five, with the continuous-quality machinery (ops/eval.py) as the
+acceptance harness: each template's ranking is graded with the SAME
+kernel the shadow scorer uses live, against a degraded (reversed)
+variant, and the canary-vs-last-good verdict must separate them.
+
+Three layers:
+- vanilla in-process workflow (train → persist → reload → query),
+  closing the gap where the scaffold only had a subprocess checkout
+  test (test_standalone_template.py);
+- quality-harness acceptance per template: MetricWindow + quality_verdict
+  say "no breach" for template-vs-itself and "breach" for a
+  rank-reversed canary over the same queries/labels;
+- `pio eval` end-to-end for the three new Evaluation classes
+  (models/template_evals.py + the vanilla template's own).
+"""
+
+import datetime as dt
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.controller import EngineParams
+from incubator_predictionio_tpu.data.storage import App, DataMap, Event
+from incubator_predictionio_tpu.ops.eval import (
+    MetricWindow,
+    quality_verdict,
+    ranking_metrics,
+)
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import (
+    load_deployment,
+    run_train,
+)
+from incubator_predictionio_tpu.workflow.evaluation_workflow import (
+    run_evaluation,
+)
+
+pytestmark = pytest.mark.quality
+
+T0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+_VANILLA_DIR = str(Path(__file__).resolve().parent.parent
+                   / "templates" / "vanilla")
+
+
+def _vanilla():
+    if _VANILLA_DIR not in sys.path:
+        sys.path.insert(0, _VANILLA_DIR)
+    import vanilla_engine
+    return vanilla_engine
+
+
+def _mk_app(storage, name):
+    app_id = storage.get_meta_data_apps().insert(App(0, name))
+    storage.get_l_events().init(app_id)
+    return app_id
+
+
+def _ts(i):
+    return T0 + dt.timedelta(seconds=i)
+
+
+def _seed_grouped_views(storage, app_name, n_users=40):
+    """Users view items only inside their own half of the catalog →
+    the other group's items are known-irrelevant labels."""
+    app_id = _mk_app(storage, app_name)
+    le = storage.get_l_events()
+    rng = np.random.default_rng(3)
+    events = []
+    for u in range(n_users):
+        lo, hi = (0, 10) if u % 2 == 0 else (10, 20)
+        for _ in range(12):
+            events.append(
+                Event("view", "user", str(u), "item",
+                      f"i{rng.integers(lo, hi)}", event_time=_ts(len(events))))
+    le.insert_batch(events, app_id)
+    return app_id
+
+
+def _seed_baskets(storage, app_name, n_sessions=60):
+    """Alternating fixed combos + one noise item per session: the combo
+    partners are each other's complements."""
+    app_id = _mk_app(storage, app_name)
+    le = storage.get_l_events()
+    rng = np.random.default_rng(4)
+    events = []
+    for s in range(n_sessions):
+        base = T0 + dt.timedelta(hours=3 * s)
+        combo = ["burger", "bun", "ketchup"] if s % 2 else ["pasta", "sauce"]
+        for j, item in enumerate(combo + [f"n{rng.integers(20)}"]):
+            events.append(Event("buy", "user", f"s{s}", "item", item,
+                                DataMap(), base + dt.timedelta(minutes=j)))
+    le.insert_batch(events, app_id)
+    return app_id
+
+
+def _seed_popularity(storage, app_name, n_items=12):
+    """Item j rated by (n_items - j) distinct users → strictly
+    decreasing popularity i0 > i1 > ..."""
+    app_id = _mk_app(storage, app_name)
+    le = storage.get_l_events()
+    events = []
+    for j in range(n_items):
+        for u in range(n_items - j):
+            events.append(Event("view", "user", f"u{u}", "item", f"i{j}",
+                                event_time=_ts(len(events))))
+    le.insert_batch(events, app_id)
+    return app_id
+
+
+def _train(engine, params_json, ctx, name):
+    ep = EngineParams.from_json(params_json)
+    iid = run_train(engine, ep, ctx, engine_factory_name=name)
+    dep, _, _ = load_deployment(
+        engine, iid, WorkflowContext(storage=ctx.get_storage()),
+        engine_factory_name=name)
+    return dep
+
+
+def _assert_quality_harness_separates(samples, k=10, min_samples=3):
+    """The shadow scorer's verdict machinery over (ranked, labels)
+    pairs: identical windows never breach; a rank-reversed canary over
+    the same labels does."""
+    good, bad = MetricWindow(), MetricWindow()
+    for ranked, labels in samples:
+        good.add(ranking_metrics([ranked], [labels], k))
+        bad.add(ranking_metrics([list(reversed(ranked))], [labels], k))
+    assert good.means()["n"] >= min_samples, "harness needs graded samples"
+
+    breach, deltas = quality_verdict(
+        good.means(), good.means(), min_samples=min_samples, max_drop=0.05)
+    assert not breach and deltas["ndcg"] == 0.0
+
+    breach, deltas = quality_verdict(
+        bad.means(), good.means(), min_samples=min_samples, max_drop=0.05)
+    assert breach, f"reversed ranking not flagged: {deltas}"
+    assert deltas["ndcg"] > 0.05
+
+
+# -- vanilla: in-process workflow parity -----------------------------------
+
+
+def test_vanilla_template_workflow(memory_storage):
+    ve = _vanilla()
+    _seed_popularity(memory_storage, "vanapp")
+    ctx = WorkflowContext(app_name="vanapp", storage=memory_storage)
+    dep = _train(ve.VanillaEngine()(), {
+        "datasource": {"params": {"appName": "vanapp"}},
+        "algorithms": [{"name": "popularity", "params": {"ratingWeight": 1.0}}],
+    }, ctx, "vanilla")
+    r = dep.query({"num": 5})
+    items = [s["item"] for s in r["itemScores"]]
+    assert items == ["i0", "i1", "i2", "i3", "i4"], items
+    scores = [s["score"] for s in r["itemScores"]]
+    assert scores == sorted(scores, reverse=True)
+    # wire-format parity with the recommendation quickstart
+    assert set(r) == {"itemScores"}
+    assert set(r["itemScores"][0]) == {"item", "score"}
+
+
+# -- quality harness as template acceptance --------------------------------
+
+
+def test_ecommerce_quality_harness(memory_storage):
+    from incubator_predictionio_tpu.models.ecommerce import ECommerceEngine
+
+    _seed_grouped_views(memory_storage, "ecqapp")
+    ctx = WorkflowContext(app_name="ecqapp", storage=memory_storage)
+    dep = _train(ECommerceEngine()(), {
+        "datasource": {"params": {"appName": "ecqapp"}},
+        "algorithms": [{"name": "ecomm",
+                        "params": {"appName": "ecqapp", "rank": 8,
+                                   "numIterations": 10}}],
+    }, ctx, "ecq")
+    samples = []
+    for u in ("0", "2", "4", "1", "3", "5"):
+        labels = ({f"i{j}" for j in range(10)} if int(u) % 2 == 0
+                  else {f"i{j}" for j in range(10, 20)})
+        r = dep.query({"user": u, "num": 10, "unseenOnly": False})
+        ranked = [s["item"] for s in r["itemScores"]]
+        assert ranked
+        samples.append((ranked, labels))
+    _assert_quality_harness_separates(samples)
+
+
+def test_complementary_quality_harness(memory_storage):
+    from incubator_predictionio_tpu.models.complementary_purchase import (
+        ComplementaryPurchaseEngine,
+    )
+
+    _seed_baskets(memory_storage, "cpqapp")
+    ctx = WorkflowContext(app_name="cpqapp", storage=memory_storage)
+    dep = _train(ComplementaryPurchaseEngine()(), {
+        "datasource": {"params": {"appName": "cpqapp"}},
+        "algorithms": [{"name": "cooccurrence", "params": {"minLLR": 0.0}}],
+    }, ctx, "cpq")
+    cases = [
+        (["burger"], {"bun", "ketchup"}),
+        (["bun"], {"burger", "ketchup"}),
+        (["pasta"], {"sauce"}),
+        (["burger", "bun"], {"ketchup"}),
+        (["sauce"], {"pasta"}),
+    ]
+    samples = []
+    for basket, labels in cases:
+        r = dep.query({"items": basket, "num": 6})
+        ranked = [s["item"] for s in r["itemScores"]]
+        assert ranked, f"no complements for {basket}"
+        assert ranked[0] in labels, (basket, ranked)
+        samples.append((ranked, labels))
+    _assert_quality_harness_separates(samples, k=6)
+
+
+def test_vanilla_quality_harness(memory_storage):
+    ve = _vanilla()
+    _seed_popularity(memory_storage, "vanqapp")
+    ctx = WorkflowContext(app_name="vanqapp", storage=memory_storage)
+    dep = _train(ve.VanillaEngine()(), {
+        "datasource": {"params": {"appName": "vanqapp"}},
+        "algorithms": [{"name": "popularity", "params": {}}],
+    }, ctx, "vanq")
+    ranked = [s["item"] for s in dep.query({"num": 8})["itemScores"]]
+    # every "user" holds out the head of the popularity order
+    samples = [(ranked, {"i0", "i1", "i2"}) for _ in range(4)]
+    _assert_quality_harness_separates(samples, k=8)
+
+
+# -- `pio eval` parity: the three new Evaluation classes -------------------
+
+
+def _assert_eval_result(res, iid, n_params):
+    assert res.metric_header.startswith("NDCG@")
+    assert len(res.all_results) == n_params
+    assert res.best_score == max(s for _, s, _ in res.all_results)
+    assert 0.0 < res.best_score <= 1.0
+    assert iid
+
+
+def test_ecommerce_evaluation(memory_storage):
+    from incubator_predictionio_tpu.models.template_evals import (
+        ECommerceEvaluation, ECommerceParamsList,
+    )
+
+    _seed_grouped_views(memory_storage, "eceapp")
+    ctx = WorkflowContext(app_name="eceapp", storage=memory_storage)
+    gen = ECommerceParamsList("eceapp")
+    assert len(gen.engine_params_list) == 4
+    gen.engine_params_list = gen.engine_params_list[:2]  # keep the test fast
+    res, iid = run_evaluation(ECommerceEvaluation(), gen, ctx,
+                              evaluation_name="ECommerceEvaluation",
+                              generator_name="ECommerceParamsList")
+    _assert_eval_result(res, iid, 2)
+
+
+def test_complementary_evaluation(memory_storage):
+    from incubator_predictionio_tpu.models.template_evals import (
+        ComplementaryEvaluation, ComplementaryParamsList,
+    )
+
+    _seed_baskets(memory_storage, "cpeapp")
+    ctx = WorkflowContext(app_name="cpeapp", storage=memory_storage)
+    gen = ComplementaryParamsList("cpeapp")
+    assert len(gen.engine_params_list) == 4
+    gen.engine_params_list = gen.engine_params_list[:2]
+    res, iid = run_evaluation(ComplementaryEvaluation(), gen, ctx,
+                              evaluation_name="ComplementaryEvaluation",
+                              generator_name="ComplementaryParamsList")
+    _assert_eval_result(res, iid, 2)
+    # combo partners are recoverable: basket completion beats chance
+    assert res.best_score > 0.3, res.all_results
+
+
+def test_vanilla_evaluation(memory_storage):
+    ve = _vanilla()
+    _seed_popularity(memory_storage, "vaneapp", n_items=12)
+    ctx = WorkflowContext(app_name="vaneapp", storage=memory_storage)
+    gen = ve.ParamsList("vaneapp")
+    res, iid = run_evaluation(ve.VanillaEvaluation(), gen, ctx,
+                              evaluation_name="VanillaEvaluation",
+                              generator_name="ParamsList")
+    _assert_eval_result(res, iid, 3)
