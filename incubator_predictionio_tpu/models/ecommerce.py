@@ -25,13 +25,11 @@ from ..data.store.p_event_store import PEventStore
 from ..data.storage.bimap import BiMap
 from ..ops.als import ALSFactors, ALSParams, train_als
 from ..workflow.input_pipeline import pipeline_of
-from ..ops.sharded_topk import (
+from ._sharded_serving import (
+    ShardedCatalogServing,
     serving_mesh_for,
-    sharded_top_k_items,
     validate_serving_mode,
 )
-from ..ops.topk import top_k_items
-from ._sharded_serving import ShardedCatalogServing
 from ._filters import CategoryIndex, build_exclude_mask
 from .similar_product import (
     SimilarProductDataSource,
@@ -148,16 +146,11 @@ class ECommerceModel(ShardedCatalogServing):
             self.items, self.category_index(), categories,
             white_list, black_list, extra_excluded_items=extra,
         )
-        if self.serving_mesh is not None:
-            scores, idx = sharded_top_k_items(
-                self.factors.user_factors[uidx], self.sharded_catalog(),
-                num, exclude=exclude,
-            )
-        else:
-            scores, idx = top_k_items(
-                self.factors.user_factors[uidx], self.device_item_factors(),
-                num, exclude=exclude,
-            )
+        # business-rule mask applied per-shard BEFORE each partial
+        # top-k (ShardedCatalog contract) — filtered items never
+        # inflate the candidate merge
+        scores, idx = self.catalog().top_k(
+            self.factors.user_factors[uidx], num, exclude=exclude)
         return [
             (self.items.inverse(int(j)), float(s))
             for s, j in zip(scores, idx)

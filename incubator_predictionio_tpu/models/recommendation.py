@@ -38,14 +38,11 @@ from ..ops.als import (
     train_als_partition_local,
 )
 from ..workflow.input_pipeline import pipeline_of
-from ..ops.sharded_topk import (
+from ._sharded_serving import (
+    ShardedCatalogServing,
     serving_mesh_for,
-    sharded_batch_top_k,
-    sharded_top_k_items,
     validate_serving_mode,
 )
-from ..ops.topk import batch_top_k, top_k_items
-from ._sharded_serving import ShardedCatalogServing
 
 
 # -- data types ------------------------------------------------------------
@@ -109,14 +106,10 @@ class ALSModel(ShardedCatalogServing):
         uidx = self.users.get(user)
         if uidx is None:
             return []
-        if self.serving_mesh is not None:
-            scores, idx = sharded_top_k_items(
-                self.factors.user_factors[uidx], self.sharded_catalog(), num
-            )
-        else:
-            scores, idx = top_k_items(
-                self.factors.user_factors[uidx], self.device_item_factors(), num
-            )
+        # one call whatever the layout (mesh / host-sharded / flat) —
+        # the ShardedCatalog facade owns the dispatch
+        scores, idx = self.catalog().top_k(
+            self.factors.user_factors[uidx], num)
         return [
             (self.items.inverse(int(i)), float(s))
             for s, i in zip(scores, idx)
@@ -367,11 +360,7 @@ class ALSAlgorithm(Algorithm):
         num = max(int(q.get("num", 10)) for q in queries)
         # device-resident factors (cached) — passing the host array would
         # re-upload the full catalog matrix on every serving micro-batch
-        if model.serving_mesh is not None:
-            scores, idx = sharded_batch_top_k(
-                uvecs, model.sharded_catalog(), num)
-        else:
-            scores, idx = batch_top_k(uvecs, model.device_item_factors(), num)
+        scores, idx = model.catalog().batch_top_k(uvecs, num)
         out = []
         for j, (q, ok) in enumerate(zip(queries, known)):
             if not ok:

@@ -28,13 +28,12 @@ from ..ops.als import (
     ALSFactors, ALSParams, train_als, train_als_partition_local,
 )
 from ..workflow.input_pipeline import pipeline_of
-from ..ops.sharded_topk import (
+from ..ops.topk import normalize_rows
+from ._sharded_serving import (
+    ShardedCatalogServing,
     serving_mesh_for,
-    sharded_similar_items,
     validate_serving_mode,
 )
-from ..ops.topk import normalize_rows, similar_items
-from ._sharded_serving import ShardedCatalogServing
 from ._filters import CategoryIndex, build_exclude_mask
 
 
@@ -160,14 +159,7 @@ class SimilarProductModel(ShardedCatalogServing):
         )
         exclude[idxs] = True  # never return the query items themselves
         qvecs = self.factors.item_factors[idxs]
-        if self.serving_mesh is not None:
-            scores, idx = sharded_similar_items(
-                qvecs, self.sharded_catalog(), num, exclude=exclude
-            )
-        else:
-            scores, idx = similar_items(
-                qvecs, self.device_item_factors(), num, exclude=exclude
-            )
+        scores, idx = self.catalog().similar(qvecs, num, exclude=exclude)
         return [
             (self.items.inverse(int(j)), float(s))
             for s, j in zip(scores, idx)

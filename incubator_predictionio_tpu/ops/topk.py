@@ -29,6 +29,16 @@ def _topk_scores(user_vec, item_factors, exclude_mask, k: int):
     return jax.lax.top_k(scores, k)
 
 
+@functools.lru_cache(maxsize=None)
+def _no_exclude_mask(n_items: int):
+    """Device-resident all-False mask, one per catalog size. Building
+    `jnp.zeros((n_items,), bool)` per query cost ~0.2 ms of eager
+    dispatch + transfer on the CPU-local hot path (ISSUE 17 profile) —
+    for a mask that never changes. Same jit cache key, same executable,
+    so answers stay bitwise identical."""
+    return jax.device_put(np.zeros((n_items,), dtype=bool))
+
+
 def top_k_items(user_vec, item_factors, k: int, exclude=None):
     """Returns (scores[k], indices[k]) as host numpy arrays.
 
@@ -37,11 +47,12 @@ def top_k_items(user_vec, item_factors, k: int, exclude=None):
     """
     n_items = item_factors.shape[0]
     if exclude is None:
-        exclude = jnp.zeros((n_items,), dtype=bool)
+        exclude = _no_exclude_mask(n_items)
     k = min(int(k), n_items)
-    out = _topk_scores(
-        jnp.asarray(user_vec), jnp.asarray(item_factors), jnp.asarray(exclude), k
-    )
+    # arguments go to the jitted kernel RAW: jit's C++ dispatch commits
+    # them to device far cheaper than eager jnp.asarray per query
+    # (measured ~0.4 ms/query of lax_numpy/bind machinery saved)
+    out = _topk_scores(user_vec, item_factors, exclude, k)
     # Single host transfer: through a remote-PJRT tunnel each device_get is
     # a round-trip, so fetching (scores, idx) together halves query latency.
     return jax.device_get(out)
@@ -90,9 +101,7 @@ def batch_top_k(user_vecs, item_factors, k: int):
     # executables per bucket instead of compiling one per distinct value.
     kp = bucket_k(k, item_factors.shape[0])
     user_vecs = pad_batch_pow2(user_vecs)
-    scores, idx = jax.device_get(
-        _batch_topk(jnp.asarray(user_vecs), jnp.asarray(item_factors), kp)
-    )
+    scores, idx = jax.device_get(_batch_topk(user_vecs, item_factors, kp))
     return scores[:b, :k], idx[:b, :k]
 
 

@@ -57,6 +57,16 @@ def soak_cmd(args: list[str]) -> int:
                         "deployed engine (0 disables the quality "
                         "vertical; the quality-regression SLO row "
                         "then only asserts the rollback leg)")
+    p.add_argument("--catalog-items", type=int, default=None,
+                   help="item universe the floods rate against "
+                        "(default 50; raise it for a large-catalog "
+                        "scenario — the zipf head keeps the quality "
+                        "signal, and catalogs past the host-shard "
+                        "threshold serve through the sharded path)")
+    p.add_argument("--query-cache", type=int, default=None, metavar="N",
+                   help="served-result cache entries per engine "
+                        "process (default 256; 0 disables the cache "
+                        "and the cache-freshness SLO row reports it)")
     p.add_argument("--p99-ms", type=float, default=4000.0)
     p.add_argument("--rollback-deadline-s", type=float, default=30.0)
     p.add_argument("--foldin-ms", type=float, default=250.0)
@@ -87,6 +97,11 @@ def soak_cmd(args: list[str]) -> int:
         workdir = os.path.join(tempfile.gettempdir(), "pio_soak_dry")
     else:
         workdir = tempfile.mkdtemp(prefix="pio_soak_")
+    serving_kw = {}
+    if ns.catalog_items is not None:
+        serving_kw["catalog_items"] = max(1, ns.catalog_items)
+    if ns.query_cache is not None:
+        serving_kw["query_cache_size"] = max(0, ns.query_cache)
     cfg = SoakConfig(
         engine_dir=os.path.abspath(ns.engine_dir),
         workdir=workdir,
@@ -106,6 +121,7 @@ def soak_cmd(args: list[str]) -> int:
         keep_workdir=ns.keep_workdir or bool(ns.workdir),
         out_path=os.path.abspath(ns.out) if ns.out else None,
         baseline_key=ns.baseline_key,
+        **serving_kw,
     )
     plan = plan_scenario(cfg)
     if ns.dry_run:

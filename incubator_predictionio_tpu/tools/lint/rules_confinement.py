@@ -272,6 +272,49 @@ def query_dispatch_gate(project: Project) -> Iterable[Finding]:
                       "_dispatch_query")
 
 
+#: the one models/ module allowed to touch ops.sharded_topk internals
+_SHARDED_TOPK_FACADE = "models/_sharded_serving.py"
+
+
+@rule("sharded-topk-confinement",
+      "template code under models/ touches ops.sharded_topk internals "
+      "only through the models/_sharded_serving.py facade — the "
+      "mesh/host/flat layout choice (and its bit-identity contract) "
+      "lives in exactly one place")
+def sharded_topk_confinement(project: Project) -> Iterable[Finding]:
+    for m in project.modules("models/"):
+        if m.relpath == _SHARDED_TOPK_FACADE or m.tree is None:
+            continue
+        disp = project.display_path(m)
+        for node in m.walk():
+            if isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if (mod == "sharded_topk" or mod.endswith(".sharded_topk")
+                        or any(a.name == "sharded_topk"
+                               for a in node.names)):
+                    yield Finding(
+                        "sharded-topk-confinement", disp, node.lineno,
+                        "import from ops.sharded_topk outside the "
+                        "_sharded_serving facade — score through "
+                        "ShardedCatalog/ShardedIndicators instead")
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.endswith("sharded_topk"):
+                        yield Finding(
+                            "sharded-topk-confinement", disp, node.lineno,
+                            "import of ops.sharded_topk outside the "
+                            "_sharded_serving facade — score through "
+                            "ShardedCatalog/ShardedIndicators instead")
+            elif (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "sharded_topk"):
+                yield Finding(
+                    "sharded-topk-confinement", disp, node.lineno,
+                    f"sharded_topk.{node.attr} referenced outside the "
+                    "_sharded_serving facade — score through "
+                    "ShardedCatalog/ShardedIndicators instead")
+
+
 #: merged-view scan entries + shard-file access primitives banned on
 #: the training path (see train_feed_confinement)
 _FEED_BANNED_REFS = ("_merged_scan", "shard_paths", "scan_log_file")
@@ -316,4 +359,5 @@ def train_feed_confinement(project: Project) -> Iterable[Finding]:
 
 RULES = [ingest_hot_path, spawn_confinement, resilient_urlopen,
          wal_suffix_confinement, no_adhoc_counters, models_dao_confinement,
-         query_dispatch_gate, train_feed_confinement]
+         query_dispatch_gate, sharded_topk_confinement,
+         train_feed_confinement]

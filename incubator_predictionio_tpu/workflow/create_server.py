@@ -14,8 +14,10 @@ JSON parse → host gather → one device dispatch → one host fetch.
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import contextvars
+import copy
 import datetime as _dt
 import hmac
 import json
@@ -47,6 +49,146 @@ def _env_int(name: str, default: int) -> int:
     (a typo'd env var must not crash a deploy). Float spellings like
     ``"1e3"`` are accepted. One shared implementation: common/envknobs."""
     return envknobs.env_int(name, default, float_ok=True)
+
+
+# query-cache telemetry is process-wide monotonic (counters survive a
+# server object being rebuilt in-process, like the fold-in counters)
+_M_CACHE_HITS = telemetry.registry().counter(
+    "pio_query_cache_hits_total",
+    "Queries answered from the served-result cache without a model "
+    "dispatch").labels()
+_M_CACHE_MISSES = telemetry.registry().counter(
+    "pio_query_cache_misses_total",
+    "Cache-armed queries that had to run a model dispatch (entry "
+    "absent, expired, or invalidated)").labels()
+_M_CACHE_INVALIDATIONS = telemetry.registry().counter(
+    "pio_query_cache_invalidations_total",
+    "Query-cache invalidation events by trigger: foldin = targeted "
+    "per-user eviction from an increment's freshness footprint; swap "
+    "= full flush on any other model swap; rollback = full flush "
+    "when a rollback restores the previous model", ("reason",))
+
+
+class QueryResultCache:
+    """Per-user served-result cache (``PIO_QUERY_CACHE_SIZE`` > 0 arms
+    it). Keyed on (user, canonical query fingerprint): a byte-identical
+    repeat of a query within the TTL is answered without touching the
+    model — at a zipfian user mix the hot heads collapse onto cache
+    hits and the sharded million-item dispatch only runs for the tail.
+
+    Freshness contract (docs/serving.md "Million-item catalogs"):
+
+    - a fold-in increment going live evicts exactly the users its
+      freshness footprint names (the ``users`` list online.py writes
+      into ``runtime_conf["foldin"]``) — a fold-in touching a user's
+      rows MUST invalidate that user, and does;
+    - any other swap (retrain, operator reload, an increment without
+      an attributable footprint or of a different lineage) flushes
+      everything;
+    - a rollback flushes everything — the restored model must never
+      answer with results the rolled-back model computed;
+    - the TTL bounds staleness against serve-time event-log reads
+      (e.g. the e-commerce seen-items filter) that no swap observes.
+
+    Entries store a deep copy and hits return a deep copy: results
+    flow through after_query plugins that may mutate them in place.
+    Thread-safe (its own lock): lookups run on the event loop while
+    swap invalidation arrives from reload worker threads."""
+
+    def __init__(self, max_entries: int, ttl_s: float):
+        self.max_entries = int(max_entries)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        # key → (expires_monotonic, result); insertion order doubles
+        # as LRU order (move_to_end on hit)
+        self._entries: collections.OrderedDict = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidated_entries = 0
+        self.invalidations = 0
+        # bumped by every invalidation: an in-flight dispatch that
+        # started before a swap must not re-insert its (stale) result
+        # after the invalidation ran — put() drops generation-mismatched
+        # inserts, so "zero stale serves" holds without a lock spanning
+        # the whole dispatch
+        self.generation = 0
+
+    @staticmethod
+    def key_for(query) -> tuple:
+        """(user-or-None, canonical JSON fingerprint). The fingerprint
+        is computed on the post-``before_query`` plugin form, so two
+        spellings a plugin canonicalizes share one entry."""
+        user = query.get("user") if isinstance(query, dict) else None
+        fp = json.dumps(query, sort_keys=True, separators=(",", ":"),
+                        default=str)
+        return (None if user is None else str(user), fp)
+
+    def get(self, key: tuple):
+        now = _time.monotonic()
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None and ent[0] > now:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                _M_CACHE_HITS.inc()
+                return copy.deepcopy(ent[1])
+            if ent is not None:
+                del self._entries[key]  # expired
+            self.misses += 1
+        _M_CACHE_MISSES.inc()
+        return None
+
+    def put(self, key: tuple, result, generation: Optional[int] = None
+            ) -> None:
+        entry = (_time.monotonic() + self.ttl_s, copy.deepcopy(result))
+        with self._lock:
+            if generation is not None and generation != self.generation:
+                return  # an invalidation ran mid-dispatch: result stale
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def invalidate_users(self, users) -> int:
+        """Targeted eviction: drop every entry keyed to one of
+        ``users``. Userless entries (similarity queries) survive — a
+        fold-in re-solves only user rows against fixed item-side
+        state, which userless queries score exclusively."""
+        users = {str(u) for u in users}
+        with self._lock:
+            doomed = [k for k in self._entries if k[0] in users]
+            for k in doomed:
+                del self._entries[k]
+            self.invalidated_entries += len(doomed)
+            self.invalidations += 1
+            self.generation += 1
+        _M_CACHE_INVALIDATIONS.labels("foldin").inc()
+        return len(doomed)
+
+    def flush(self, reason: str) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self.invalidated_entries += n
+            self.invalidations += 1
+            self.generation += 1
+        _M_CACHE_INVALIDATIONS.labels(reason).inc()
+        return n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "maxEntries": self.max_entries,
+                "ttlMs": round(self.ttl_s * 1e3, 3),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "invalidatedEntries": self.invalidated_entries,
+            }
 
 
 class AdmissionShed(Exception):
@@ -100,6 +242,8 @@ class EngineServer:
         fleet_replicas: Optional[int] = None,
         fleet_sync_ms: Optional[float] = None,
         quality_sample: Optional[float] = None,
+        query_cache_size: Optional[int] = None,
+        query_cache_ttl_ms: Optional[float] = None,
     ):
         # start the PIO_FAULT_SPEC at-mode offset clock at "server
         # constructing", not "first query": soak timelines schedule
@@ -136,7 +280,9 @@ class EngineServer:
                                   swap_max_error_rate, model_refresh_ms,
                                   fleet_replica, fleet_replicas,
                                   fleet_sync_ms, foldin_ms,
-                                  quality_sample)
+                                  quality_sample,
+                                  query_cache_size=query_cache_size,
+                                  query_cache_ttl_ms=query_cache_ttl_ms)
         # Probe marker secret: synthetic startup-probe traffic is
         # excluded from queryCount/feedback, so the marker must not be
         # spoofable — an external client sending a bare "X-Pio-Probe: 1"
@@ -207,7 +353,8 @@ class EngineServer:
                              model_refresh_ms=None, fleet_replica=None,
                              fleet_replicas=None,
                              fleet_sync_ms=None, foldin_ms=None,
-                             quality_sample=None) -> None:
+                             quality_sample=None, query_cache_size=None,
+                             query_cache_ttl_ms=None) -> None:
         """Admission control: the query path gets a DEDICATED bounded
         executor (query_conc workers) plus a bounded waiting budget
         (query_max_pending); offered load beyond conc+pending is shed
@@ -314,6 +461,22 @@ class EngineServer:
             _env_int("PIO_QUALITY_RESOLVE_MS", 2000)))
         self.quality_ms = max(50.0, float(
             _env_int("PIO_QUALITY_MS", 500)))
+        # Served-result cache (0 = off, the default): identical
+        # queries within the TTL are answered without a model dispatch.
+        # Freshness is invalidation-driven (fold-in footprint / swap /
+        # rollback — see QueryResultCache); the TTL only bounds
+        # staleness the model lifecycle can't observe.
+        self.query_cache_size = max(0, int(
+            query_cache_size if query_cache_size is not None
+            else _env_int("PIO_QUERY_CACHE_SIZE", 0)))
+        self.query_cache_ttl_ms = max(0.0, float(
+            query_cache_ttl_ms if query_cache_ttl_ms is not None
+            else _env_int("PIO_QUERY_CACHE_TTL_MS", 10_000)))
+        self._query_cache = (
+            QueryResultCache(self.query_cache_size,
+                             self.query_cache_ttl_ms / 1e3)
+            if self.query_cache_size > 0 and self.query_cache_ttl_ms > 0
+            else None)
         self._quality_task = None
         # loop-confined (the _watch idiom): offer() appends from the
         # request path, the loop ticks single-flight off-thread, and
@@ -541,8 +704,46 @@ class EngineServer:
                               + self.quality_watch_ms / 1e3),
                     "instance": instance.id,
                 }
+        if swapped and self._query_cache is not None:
+            # freshness-correct cache across the swap: an increment
+            # whose fold-in marker proves it descends from what we were
+            # serving AND names the users it touched evicts exactly
+            # those users; anything else flushes the whole cache
+            users = self._foldin_footprint(instance, prev_inst)
+            if users is None:
+                n = self._query_cache.flush("swap")
+                log.info("query cache: flushed %d entrie(s) on swap "
+                         "to %s", n, instance.id)
+            else:
+                n = self._query_cache.invalidate_users(users)
+                log.info("query cache: fold-in %s evicted %d entrie(s) "
+                         "for %d touched user(s)", instance.id, n,
+                         len(users))
         log.info("deployed engine instance %s", instance.id)
         return True
+
+    @staticmethod
+    def _foldin_footprint(instance, prev_inst) -> Optional[list]:
+        """The incoming instance's targeted-invalidation user list, or
+        None when only a full flush is safe. Targeted eviction needs
+        BOTH halves of the marker online.py writes: ``users`` (the rows
+        the increment chain re-solved) and ``bases`` containing the
+        instance this server was actually serving — an increment of
+        some other lineage changed an unknown amount of state."""
+        try:
+            raw = (instance.runtime_conf or {}).get("foldin")
+            if not raw or prev_inst is None:
+                return None
+            doc = json.loads(raw) if isinstance(raw, str) else raw
+            users = doc.get("users")
+            bases = doc.get("bases")
+            if not isinstance(users, list):
+                return None
+            if not isinstance(bases, list) or prev_inst.id not in bases:
+                return None
+            return users
+        except Exception:  # noqa: BLE001 — on any doubt, full flush
+            return None
 
     def _validate_swap(self, deployment, instance) -> None:
         """Swap gate (PIO_SWAP_VALIDATE, default on): nan_guard over
@@ -655,6 +856,11 @@ class EngineServer:
                              or self.fleet_replica == 0),
                 "events": 0, "publishes": 0, "lagSeconds": None,
             }
+        if self._query_cache is not None:
+            # served-result cache surface: occupancy, hit/miss and
+            # invalidation accounting (`pio status --engine-url` and
+            # the soak scorecard's freshness assertion read this)
+            out["queryCache"] = self._query_cache.snapshot()
         if self.quality_sample > 0:
             # continuous-quality surface: sampling/scoring counters,
             # windowed live metrics, last-good deltas, holdout cursor
@@ -1117,6 +1323,19 @@ class EngineServer:
         except Exception as e:  # noqa: BLE001
             log.exception("before_query plugin failed")
             return web.json_response({"message": str(e)}, status=500)
+        cache = self._query_cache
+        ckey = None
+        cgen = 0
+        if cache is not None and "X-Pio-Probe" not in request.headers:
+            # probe traffic bypasses the cache BOTH ways: the latency
+            # probe must measure the real dispatch path, and synthetic
+            # queries must not pollute hit/miss accounting. The key is
+            # the post-plugin query — see QueryResultCache.key_for.
+            ckey = QueryResultCache.key_for(query)
+            cgen = cache.generation
+            cached = cache.get(ckey)
+            if cached is not None:
+                return await self._finish_query(request, query, cached)
         try:
             result = await self._dispatch_query(deployment, query, dl)
             if self._watch is not None and self._is_live(deployment):
@@ -1128,6 +1347,14 @@ class EngineServer:
                 # atomic deque append (scored off-loop by the quality
                 # tick, never here)
                 self._quality_runner.offer(query, result)
+            if ckey is not None:
+                # only CLEAN dispatch results are cached — the hedged
+                # path below (watch-window failure answered by the
+                # retained last-good model) never inserts, so a cache
+                # hit is always the live model's own answer; the
+                # generation guard drops the insert if a swap
+                # invalidated mid-dispatch
+                cache.put(ckey, result, cgen)
         except AdmissionShed as e:
             with self._adm_lock:
                 self._shed_count += 1
@@ -1186,6 +1413,15 @@ class EngineServer:
             if hedged is None:
                 return web.json_response({"message": str(e)}, status=500)
             result = hedged
+        return await self._finish_query(request, query, result)
+
+    async def _finish_query(self, request: web.Request, query,
+                            result) -> web.Response:
+        """Shared response tail for dispatched AND cache-hit results:
+        after_query plugin, probe-marker accounting bypass, query
+        count, feedback self-log. A cache hit goes through the same
+        plugin + feedback path as a dispatch — only the model call is
+        skipped."""
         try:
             result = self.plugins.after_query(query, result)
         except KeyError as e:
@@ -1501,6 +1737,11 @@ class EngineServer:
         # the bad instance's quality watch dies with it — the restored
         # model is the last-good baseline, not a canary
         self._quality_watch = None
+        if self._query_cache is not None:
+            # every cached result was computed by the model we just
+            # rolled away from; the restored model must answer fresh
+            n = self._query_cache.flush("rollback")
+            log.info("query cache: flushed %d entrie(s) on rollback", n)
         with self._lock:
             # setdefault: a fleet-directed rollback arrives AFTER the
             # coordinator already recorded the real pin reason (e.g.

@@ -19,7 +19,15 @@ retrain — a new user's first events did nothing until the next
    checksummed envelope via ``model_artifact.write_model``, provenance
    (source instance, event count, LSN) in ``runtime_conf["foldin"]`` —
    so the increment is indistinguishable from a retrain to every
-   consumer downstream.
+   consumer downstream. The marker also carries the increment's
+   **freshness footprint** for the serving-side query cache: ``bases``
+   (every ancestor instance id the chain folded through) and ``users``
+   (the user entity ids whose rows this chain re-solved — present only
+   when the batches were attributable to specific users). The engine
+   server uses them at swap time to invalidate exactly the touched
+   users' cached results instead of flushing the whole cache; any
+   batch whose effect can't be pinned to users (non-user events, or
+   more than the cap) omits ``users`` and forces the full flush.
 4. **Publish through the SAME gate as a retrain.** Single-server mode:
    the engine server's shared publish-through-gate path (the PR 9
    validate → swap → watch → rollback+pin sequence — one entry point,
@@ -92,6 +100,35 @@ _M_LAG = telemetry.registry().gauge(
     "pio_foldin_freshness_lag_seconds",
     "Seconds since the fold-in view last caught up with the event log "
     "(grows while the loop is failing or falling behind)").labels()
+
+
+# Targeted cache invalidation gives up past this many distinct users
+# per increment chain: the flush costs one cold query per cached user,
+# the marker row stays bounded.
+_USER_FOOTPRINT_CAP = 512
+# Chain-ancestry list cap in the marker (a chain this deep means the
+# gate has been stuck for hundreds of ticks; full flush is fine).
+_BASES_CAP = 64
+
+
+def _touched_users(events) -> Optional[set]:
+    """The user entity ids whose model rows this batch folds into, or
+    None when the batch's effect cannot be attributed to specific
+    users — any non-user-entity event (e.g. an item $set that could
+    shift every user's results) or more distinct users than the cap.
+    None tells the serving cache to flush instead of invalidating
+    narrowly; a wrongly-narrow answer here would serve stale results,
+    so unknown always degrades to the safe full flush."""
+    users: set = set()
+    for e in events:  # wire-format dicts (log_tail.TailBatch.events)
+        if not isinstance(e, dict):
+            return None
+        if e.get("entityType") != "user" or not e.get("entityId"):
+            return None
+        users.add(str(e["entityId"]))
+        if len(users) > _USER_FOOTPRINT_CAP:
+            return None
+    return users
 
 
 def is_foldin_instance(instance) -> bool:
@@ -295,7 +332,7 @@ class FoldInRunner:
         pend = self._pending
         if pend is None:
             return None
-        pend_id, ancestors, models = pend
+        pend_id, ancestors, models, _users = pend
         if instance.id == pend_id:
             self._pending = None
             return None
@@ -375,10 +412,12 @@ class FoldInRunner:
             base_models = chain
             base_id = self._pending[0]
             ancestors = self._pending[1] | {self._pending[0]}
+            prev_users = self._pending[3]
         else:
             base_models = deployment.models
             base_id = instance.id
             ancestors = {instance.id}
+            prev_users: Optional[set] = set()
         new_models, changed = [], False
         for (_name, algo), model in zip(deployment.algo_list,
                                         base_models):
@@ -388,10 +427,18 @@ class FoldInRunner:
             changed = changed or out is not None
         if not changed:
             return None
+        # freshness footprint is CUMULATIVE over a deferral chain: the
+        # increment that finally publishes carries every user any link
+        # re-solved, or None the moment any link was unattributable
+        batch_users = _touched_users(batch.events)
+        users = (None if batch_users is None or prev_users is None
+                 else prev_users | batch_users)
+        if users is not None and len(users) > _USER_FOOTPRINT_CAP:
+            users = None
         iid = self._commit_increment(instance, deployment.algo_list,
                                      new_models, len(batch.events),
-                                     batch.cursor)
-        self._pending = (iid, ancestors, new_models)
+                                     batch.cursor, ancestors, users)
+        self._pending = (iid, ancestors, new_models, users)
         self._publishes += 1
         self._last_instance = iid
         _M_PUBLISHES.inc()
@@ -401,7 +448,9 @@ class FoldInRunner:
         return iid
 
     def _commit_increment(self, instance, algo_list, models,
-                          n_events: int, cursor: LogCursor) -> str:
+                          n_events: int, cursor: LogCursor,
+                          ancestors: set,
+                          users: Optional[set]) -> str:
         """Persist one increment exactly like a retrain does: instance
         row RUNNING → model blob (checksummed envelope, ``model.insert``
         fault point inside) → ``foldin.publish`` fault point →
@@ -412,6 +461,17 @@ class FoldInRunner:
 
         instances = self.storage.get_meta_data_engine_instances()
         now = _dt.datetime.now(_dt.timezone.utc)
+        marker = {
+            "of": instance.id,
+            "events": n_events,
+            "lsn": cursor.total(),
+        }
+        if len(ancestors) <= _BASES_CAP:
+            # missing bases ⇒ the serving cache can't prove the swap is
+            # a pure fold-in of what it was serving ⇒ full flush (safe)
+            marker["bases"] = sorted(ancestors)
+        if users is not None:
+            marker["users"] = sorted(users)
         row = dataclasses.replace(
             instance,
             id=new_event_id(),
@@ -420,11 +480,7 @@ class FoldInRunner:
             end_time=None,
             runtime_conf={
                 **(instance.runtime_conf or {}),
-                "foldin": json.dumps({
-                    "of": instance.id,
-                    "events": n_events,
-                    "lsn": cursor.total(),
-                }),
+                "foldin": json.dumps(marker),
             },
             env={**(instance.env or {}), "pid": str(os.getpid()),
                  "host": socket.gethostname()},

@@ -80,6 +80,9 @@ SLO_METRICS = (
     "pio_foldin_freshness_lag_seconds",
     "pio_engine_quality_samples_total",
     "pio_engine_quality_breaches_total",
+    "pio_query_cache_hits_total",
+    "pio_query_cache_misses_total",
+    "pio_query_cache_invalidations_total",
 )
 
 # spec-armed scenario faults → the fault POINT their PIO_FAULT_SPEC
@@ -160,6 +163,17 @@ class SoakConfig:
     # age past the resolve window) fits inside it on a starved host
     quality_sample: float = 1.0
     quality_watch_ms: float = 6000.0
+    # million-item serving (ISSUE 17): queries run with the served-
+    # result cache armed and the host-shard threshold set, so the
+    # kill/poison timeline fires AGAINST cached results — the
+    # cache-freshness SLO row asserts rollbacks never left stale
+    # entries serving. catalog_items widens the item universe the
+    # floods rate against (zipf keeps the popularity head, so the
+    # shadow scorer's NDCG signal survives a large catalog).
+    catalog_items: int = _ITEMS
+    query_cache_size: int = 256
+    query_cache_ttl_ms: float = 30000.0
+    serve_shard_items: int = 131072
     fleet_sync_ms: float = 200.0
     compact_interval_ms: float = 2000.0
     faults: tuple = FAULT_MENU
@@ -227,6 +241,11 @@ class SoakPlan:
             f"{cfg.enqueue_frac:.0%} enqueue-acked), queries "
             f"{cfg.query_rps:.0f}/s with "
             f"{cfg.query_deadline_ms:.0f}ms deadlines",
+            f"  serving: {cfg.catalog_items} items (host shards past "
+            f"{cfg.serve_shard_items} rows); result cache "
+            + (f"{cfg.query_cache_size} entries, TTL "
+               f"{cfg.query_cache_ttl_ms:.0f}ms" if cfg.query_cache_size
+               else "off"),
             "  phases: workspace+train -> launch+ready -> "
             f"{cfg.duration_s:.0f}s mixed load under faults -> "
             f"quiesce (freshness settle <= {cfg.freshness_settle_s:.0f}s)"
@@ -287,7 +306,8 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
     app_names = [primary] + [f"soak_a{i}" for i in range(1, cfg.apps)]
     app_weights = _zipf_weights(cfg.apps, cfg.zipf_s, rng)
     user_weights = _zipf_weights(cfg.users, cfg.zipf_s, rng)
-    item_weights = _zipf_weights(_ITEMS, cfg.zipf_s, rng)
+    item_weights = _zipf_weights(max(1, cfg.catalog_items), cfg.zipf_s,
+                                 rng)
     notes: list = []
     faults: list = []
 
@@ -400,6 +420,12 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
                        "(kill-window TCP reality)",
         "clean-drain": "both fronts exit 0 on SIGTERM inside "
                        f"{cfg.drain_timeout_s:.0f}s",
+        "cache-freshness": (
+            f"armed result cache ({cfg.query_cache_size} entries, TTL "
+            f"{cfg.query_cache_ttl_ms:.0f}ms) saw traffic and every "
+            "rollback observation was covered by a cache invalidation "
+            "event — no stale cached results after rollback"
+            if cfg.query_cache_size > 0 else "cache disabled"),
     }
     notes.append("observations are scraped through quiesce: rollback "
                  "pins and fault evidence landing after the wall "
@@ -459,6 +485,7 @@ class _Samples:
         self.foldin_lag: list = []    # (t_off_s, lag_seconds)
         self.foldin_publishes = 0
         self.restarts: dict = {}      # "replica:<i>" -> max restarts
+        self.query_cache: dict = {}   # /status queryCache counters, max
         self._rollback_keys: set = set()
 
     def note_metrics(self, text: str) -> None:
@@ -570,6 +597,12 @@ class SoakRunner:
             "PIO_QUALITY_RESOLVE_MS": "400",
             "PIO_QUALITY_MS": "100",
             "PIO_SWAP_MAX_ERROR_RATE": f"{cfg.swap_max_error_rate}",
+            # million-item serving: cache + host-shard threshold armed
+            # so the fault timeline fires against cached results (the
+            # cache-freshness SLO row grades the invalidation contract)
+            "PIO_QUERY_CACHE_SIZE": f"{cfg.query_cache_size:d}",
+            "PIO_QUERY_CACHE_TTL_MS": f"{cfg.query_cache_ttl_ms:.0f}",
+            "PIO_SERVE_SHARD_ITEMS": f"{cfg.serve_shard_items:d}",
             "PIO_FLEET_SYNC_MS": f"{cfg.fleet_sync_ms:.0f}",
             "PIO_FLEET_READY_MS": "150",
             # starved-host slack: mid-relaunch workers/replicas and
@@ -753,7 +786,7 @@ class SoakRunner:
         # on a head of popular items, so a ranking that puts the head
         # first scores measurably better than one that buries it — the
         # signal the quality watch grades poison_quality against
-        return rng.choices(range(_ITEMS),
+        return rng.choices(range(len(self.plan.item_weights)),
                            weights=self.plan.item_weights, k=1)[0]
 
     def _ingest_loop(self, idx: int, rate: float) -> None:
@@ -954,6 +987,19 @@ class SoakRunner:
         for inst, reason in (directive.get("pinned") or {}).items():
             self.samples.note_rollback(
                 t_off, f"fleet:{inst}", f"directive pin {reason}")
+        qc = doc.get("queryCache")
+        if isinstance(qc, dict):
+            # counters are monotonic per replica; keyed max() mirrors
+            # note_metrics (fleet scrapes splice to ONE replica per
+            # connection, so this is a lower bound across the fleet)
+            with self.samples.lock:
+                for key in ("hits", "misses", "invalidations",
+                            "invalidatedEntries", "evictions",
+                            "entries"):
+                    v = qc.get(key)
+                    if isinstance(v, (int, float)):
+                        self.samples.query_cache[key] = max(
+                            self.samples.query_cache.get(key, 0), v)
         fold = doc.get("foldin") or {}
         if fold.get("producer") and fold.get("enabled", True):
             lag = fold.get("lagSeconds")
@@ -1202,6 +1248,8 @@ class SoakRunner:
                 "queryP99Ms": round(_pct(self.ledger.latencies, 99)
                                     * 1000, 1),
             }
+        with self.samples.lock:
+            query_cache = dict(self.samples.query_cache)
         scorecard = {
             "v": 1,
             "verdict": verdict,
@@ -1220,6 +1268,7 @@ class SoakRunner:
             "faults": faults,
             "traffic": traffic,
             "freshness": freshness,
+            "queryCache": query_cache,
             "drainRc": drain,
             "reconciliation": {k: v for k, v in reconciliation.items()
                                if k != "perMarker"},
@@ -1402,6 +1451,36 @@ def evaluate_slos(plan: SoakPlan, ledger: _Ledger, samples: _Samples,
     def metric_at_least(prefix: str, n: float = 1) -> bool:
         return any(v >= n for k, v in metric_max.items()
                    if k.startswith(prefix))
+
+    # -- cache freshness: rollbacks must not leave stale results -----------
+    # Two legs: (a) the armed served-result cache saw real traffic —
+    # an armed cache that never counted a hit or miss is a dead cache
+    # nobody exercised; (b) every rollback observation is covered by
+    # at least one cache invalidation EVENT apiece — the flush the
+    # swap/rollback path owes the cache, so a kill/poison fault cannot
+    # keep serving the rolled-back model's cached answers.
+    def metric_total(family: str) -> float:
+        return sum(v for k, v in metric_max.items()
+                   if k == family or k.startswith(family + "{"))
+
+    with samples.lock:
+        qc = dict(samples.query_cache)
+    hits = max(metric_total("pio_query_cache_hits_total"),
+               float(qc.get("hits", 0)))
+    misses = max(metric_total("pio_query_cache_misses_total"),
+                 float(qc.get("misses", 0)))
+    inv = max(metric_total("pio_query_cache_invalidations_total"),
+              float(qc.get("invalidations", 0)))
+    cache_armed = cfg.query_cache_size > 0
+    ok_cache = (not cache_armed) or (
+        hits + misses >= 1 and inv >= len(rollbacks))
+    slo("cache-freshness", ok_cache,
+        {"hits": hits, "misses": misses, "invalidations": inv,
+         "rollbacks": len(rollbacks)},
+        plan.slos.get("cache-freshness"),
+        (f"{len(rollbacks)} rollback observation(s) vs {inv:.0f} cache"
+         f" invalidation event(s), {hits + misses:.0f} lookups"
+         if cache_armed else "cache disabled (query_cache_size=0)"))
 
     fired_by_name = {f["name"]: f for f in fault_log}
     fault_rows = []

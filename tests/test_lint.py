@@ -809,3 +809,69 @@ def test_spawn_confinement_still_fires_outside_the_soak_driver(tmp_path):
     assert len(fs) == 1 and "rogue" in fs[0].path
     assert findings_for(tmp_path / "driver", {"workflow/soak.py": src},
                         ["spawn-confinement"]) == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: million-item serving (sharded top-k facade + query cache)
+# ---------------------------------------------------------------------------
+
+def test_seeded_sharded_topk_confinement(tmp_path):
+    """Template code under models/ may not reach ops.sharded_topk
+    directly — the _sharded_serving facade is the single place the
+    mesh/host/flat layout choice (and its bit-identity contract)
+    lives. The facade itself is exempt; ops/ code is out of scope."""
+    rogue = '''
+        from ..ops.sharded_topk import host_sharded_top_k_items
+        from ..ops import sharded_topk
+
+        def score(vec, cat, k):
+            sharded_topk.put_host_sharded_catalog(cat, 64)
+            return host_sharded_top_k_items(vec, cat, k)
+    '''
+    fs = findings_for(tmp_path, {"models/rogue_template.py": rogue},
+                      ["sharded-topk-confinement"])
+    assert len(fs) == 3, [f.message for f in fs]
+    assert all("_sharded_serving facade" in f.message for f in fs)
+    assert any("sharded_topk.put_host_sharded_catalog" in f.message
+               for f in fs)
+    # the facade is the ONE legal home
+    assert findings_for(
+        tmp_path / "facade", {"models/_sharded_serving.py": rogue},
+        ["sharded-topk-confinement"]) == []
+    # ops/ implements the kernels; the rule scopes to models/ only
+    assert findings_for(
+        tmp_path / "ops", {"ops/other_kernels.py": rogue},
+        ["sharded-topk-confinement"]) == []
+
+
+def test_seeded_query_cache_metric_family_coverage(tmp_path):
+    """metric-name-registry covers `pio_query_cache_*`: the families
+    red without their docs rows and go clean with them, and a
+    non-`_total` cache counter is a convention finding."""
+    src = """
+        from . import telemetry
+        H = telemetry.registry().counter(
+            "pio_query_cache_hits_total", "cache hits")
+        I = telemetry.registry().counter(
+            "pio_query_cache_invalidations_total", "by trigger",
+            ("reason",))
+        B = telemetry.registry().counter(
+            "pio_query_cache_evictions", "no _total suffix")
+        """
+    docs = {"operations.md":
+            "| `pio_query_cache_hits_total` | counter |\n"
+            "| `pio_query_cache_invalidations_total` | counter |\n"}
+    fs = findings_for(tmp_path, {"common/cachemetrics.py": src},
+                      ["metric-name-registry"], docs=docs)
+    assert len(fs) == 2, [f.message for f in fs]  # convention + undocumented
+    assert any("must end in _total" in f.message for f in fs)
+    assert any("'pio_query_cache_evictions' is not documented"
+               in f.message for f in fs)
+    fs = findings_for(
+        tmp_path / "red", {"common/cachemetrics.py": src.replace(
+            'B = telemetry.registry().counter(\n'
+            '            "pio_query_cache_evictions", "no _total suffix")',
+            "")},
+        ["metric-name-registry"], docs={"operations.md": "no rows\n"})
+    assert len(fs) == 2, [f.message for f in fs]
+    assert all("is not documented" in f.message for f in fs)

@@ -1,0 +1,312 @@
+"""Served-result cache correctness (ISSUE 17 satellite 3).
+
+The freshness contract under test (docs/serving.md "Million-item
+catalogs"):
+
+- a fold-in increment touching user u invalidates EXACTLY u's entries
+  (userless entries survive; other users keep serving from cache)
+- any other swap and every rollback flush everything — the restored /
+  new model never answers with a result the old model computed
+- the generation guard drops a stale insert racing an invalidation
+- zero stale serves: after any of the above the next byte-identical
+  query is recomputed on the live model, asserted end-to-end over HTTP
+"""
+
+import json
+import time
+import types
+
+import pytest
+import requests
+
+import foldin_engine
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import App
+from incubator_predictionio_tpu.data.storage.datamap import DataMap
+from incubator_predictionio_tpu.data.storage.event import Event
+from incubator_predictionio_tpu.workflow import online
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import run_train
+from incubator_predictionio_tpu.workflow.create_server import (
+    EngineServer,
+    QueryResultCache,
+)
+
+from server_utils import ServerThread
+
+pytestmark = [pytest.mark.foldin]
+
+
+# ---------------------------------------------------------------------------
+# QueryResultCache unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_cache_key_is_canonical_and_user_scoped():
+    k1 = QueryResultCache.key_for({"user": "a", "num": 3})
+    k2 = QueryResultCache.key_for({"num": 3, "user": "a"})
+    assert k1 == k2                       # key order canonicalized
+    assert k1[0] == "a"
+    k3 = QueryResultCache.key_for({"items": ["i1"], "num": 3})
+    assert k3[0] is None                  # userless (similarity) entry
+    assert k1 != QueryResultCache.key_for({"user": "a", "num": 4})
+
+
+def test_cache_hit_miss_lru_and_copy_isolation():
+    c = QueryResultCache(2, ttl_s=60.0)
+    ka = QueryResultCache.key_for({"user": "a"})
+    kb = QueryResultCache.key_for({"user": "b"})
+    kc = QueryResultCache.key_for({"user": "c"})
+    assert c.get(ka) is None and c.misses == 1
+    c.put(ka, {"itemScores": [{"item": "i", "score": 1.0}]})
+    got = c.get(ka)
+    assert got == {"itemScores": [{"item": "i", "score": 1.0}]}
+    # hits hand out copies: an after_query plugin mutating the result
+    # in place must not corrupt the cached entry
+    got["itemScores"].clear()
+    assert c.get(ka)["itemScores"], "cached entry mutated through a hit"
+    # bounded LRU: inserting past max_entries evicts the oldest
+    c.put(kb, {"v": "b"})
+    c.put(kc, {"v": "c"})
+    assert c.get(ka) is None and c.evictions == 1
+    snap = c.snapshot()
+    assert snap["entries"] == 2 and snap["maxEntries"] == 2
+    assert snap["hits"] == 2 and snap["evictions"] == 1
+
+
+def test_cache_ttl_expires_entries():
+    c = QueryResultCache(8, ttl_s=0.05)
+    k = QueryResultCache.key_for({"user": "a"})
+    c.put(k, {"v": 1})
+    assert c.get(k) == {"v": 1}
+    time.sleep(0.08)
+    assert c.get(k) is None
+    assert c.snapshot()["entries"] == 0
+
+
+def test_cache_targeted_invalidation_is_exact():
+    """Fold-in touching user a evicts EXACTLY a's entries: other users
+    and userless (similarity) entries survive."""
+    c = QueryResultCache(16, ttl_s=60.0)
+    c.put(QueryResultCache.key_for({"user": "a", "num": 1}), {"v": 1})
+    c.put(QueryResultCache.key_for({"user": "a", "num": 2}), {"v": 2})
+    c.put(QueryResultCache.key_for({"user": "b", "num": 1}), {"v": 3})
+    c.put(QueryResultCache.key_for({"items": ["i1"]}), {"v": 4})
+    assert c.invalidate_users(["a"]) == 2
+    assert c.get(QueryResultCache.key_for({"user": "a", "num": 1})) is None
+    assert c.get(QueryResultCache.key_for({"user": "b", "num": 1})) == {
+        "v": 3}
+    assert c.get(QueryResultCache.key_for({"items": ["i1"]})) == {"v": 4}
+    # ONE invalidation event, two invalidated entries
+    snap = c.snapshot()
+    assert snap["invalidations"] == 1 and snap["invalidatedEntries"] == 2
+    # full flush clears the survivors too
+    assert c.flush("swap") == 2
+    assert c.snapshot()["entries"] == 0
+    assert c.snapshot()["invalidations"] == 2
+
+
+def test_cache_generation_guard_drops_stale_insert():
+    """A dispatch that began before an invalidation must not re-insert
+    its (old-model) result afterwards."""
+    c = QueryResultCache(8, ttl_s=60.0)
+    k = QueryResultCache.key_for({"user": "a"})
+    gen = c.generation          # dispatch starts: old model
+    c.flush("swap")             # swap invalidates mid-flight
+    c.put(k, {"v": "stale"}, gen)
+    assert c.get(k) is None, "stale insert survived the generation guard"
+    # a dispatch that began AFTER the invalidation inserts normally
+    c.put(k, {"v": "fresh"}, c.generation)
+    assert c.get(k) == {"v": "fresh"}
+
+
+# ---------------------------------------------------------------------------
+# freshness footprint: marker producer + consumer
+# ---------------------------------------------------------------------------
+
+
+def _inst(iid, marker=None):
+    return types.SimpleNamespace(
+        id=iid,
+        runtime_conf={} if marker is None else {"foldin": marker})
+
+
+def test_foldin_footprint_requires_users_and_lineage():
+    prev = _inst("base")
+    mk = lambda **kw: json.dumps({"of": "base", "events": 1, "lsn": 1, **kw})
+    fp = EngineServer._foldin_footprint
+    # both halves present + lineage matches → targeted eviction list
+    assert fp(_inst("inc", mk(bases=["base"], users=["u1", "u2"])),
+              prev) == ["u1", "u2"]
+    # no users list (non-user events in the batch / over the cap) → flush
+    assert fp(_inst("inc", mk(bases=["base"])), prev) is None
+    # lineage mismatch: increment of some other serving line → flush
+    assert fp(_inst("inc", mk(bases=["other"], users=["u1"])), prev) is None
+    assert fp(_inst("inc", mk(users=["u1"])), prev) is None
+    # not a fold-in at all (retrain / operator reload) → flush
+    assert fp(_inst("inc"), prev) is None
+    assert fp(_inst("inc", mk(bases=["base"], users=["u1"])), None) is None
+    # dict-form marker (already parsed) accepted too
+    assert fp(types.SimpleNamespace(
+        id="inc",
+        runtime_conf={"foldin": {"of": "base", "bases": ["base"],
+                                 "users": ["u9"]}}), prev) == ["u9"]
+    # malformed marker → flush, never raise
+    assert fp(_inst("inc", "}{"), prev) is None
+
+
+def test_touched_users_footprint_from_wire_events():
+    ev = lambda et, eid: {"entityType": et, "entityId": eid, "event": "rate"}
+    assert online._touched_users([ev("user", "a"), ev("user", "b"),
+                                  ev("user", "a")]) == {"a", "b"}
+    # any non-user event makes the batch unattributable → None (flush)
+    assert online._touched_users([ev("user", "a"), ev("item", "i1")]) is None
+    assert online._touched_users([ev("user", "")]) is None
+    assert online._touched_users([{"event": "rate"}]) is None
+    # over the footprint cap the marker would be unboundedly large → None
+    big = [ev("user", f"u{i}") for i in range(online._USER_FOOTPRINT_CAP + 1)]
+    assert online._touched_users(big) is None
+    assert online._touched_users([]) == set()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over HTTP: swap flush, rollback flush, fold-in targeting
+# ---------------------------------------------------------------------------
+
+
+def _mixed_storage(tmp_path):
+    return Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "JL",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_JL_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_JL_PATH": str(tmp_path / "events"),
+    })
+
+
+def _mk_app(storage, name="cacheapp") -> int:
+    return storage.get_meta_data_apps().insert(App(id=0, name=name))
+
+
+def _rate(storage, app_id, user, rating):
+    storage.get_l_events().insert(
+        Event(event="rate", entity_type="user", entity_id=user,
+              target_entity_type="item", target_entity_id="i0",
+              properties=DataMap({"rating": rating})), app_id)
+
+
+def _train(storage, app="cacheapp"):
+    ctx = WorkflowContext(app_name=app, storage=storage)
+    iid = run_train(foldin_engine.engine_factory(),
+                    foldin_engine.engine_params(app), ctx,
+                    engine_factory_name="foldin")
+    time.sleep(0.002)
+    return iid
+
+
+def _query(base, user):
+    r = requests.post(base + "/queries.json", json={"user": user},
+                      timeout=30)
+    assert r.status_code == 200, r.text
+    return r.json()
+
+
+def _cache_status(base):
+    return requests.get(base + "/status").json()["queryCache"]
+
+
+def _wait(fn, deadline_s=20.0, interval=0.05):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        last = fn()
+        if last:
+            return last
+        time.sleep(interval)
+    return last
+
+
+def test_server_cache_swap_and_rollback_flush_no_stale_serves(tmp_path):
+    """Retrain swap flushes; rollback flushes; the long TTL would serve
+    any surviving stale entry, so a fresh answer proves the flush."""
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    _rate(storage, app_id, "u1", 5.0)
+    iid1 = _train(storage)
+    server = EngineServer(
+        foldin_engine.engine_factory(), engine_factory_name="foldin",
+        storage=storage, query_cache_size=32,
+        query_cache_ttl_ms=300_000)   # TTL ≫ test: staleness WOULD show
+    with ServerThread(server.app) as st:
+        assert _query(st.base, "u1")["score"] == 5.0   # miss → cached
+        assert _query(st.base, "u1")["score"] == 5.0   # hit
+        snap = _cache_status(st.base)
+        assert snap["hits"] == 1 and snap["misses"] == 1
+        assert snap["entries"] == 1
+
+        # retrain with more data: same query must answer differently
+        _rate(storage, app_id, "u1", 5.0)
+        iid2 = _train(storage)
+        r = requests.get(st.base + "/reload")
+        assert r.status_code == 200
+        assert r.json()["engineInstanceId"] == iid2 != iid1
+        # a non-fold-in swap flushed everything: the cached 5.0 is gone
+        assert _query(st.base, "u1")["score"] == 10.0
+        snap = _cache_status(st.base)
+        assert snap["invalidations"] >= 1
+
+        # rollback to iid1: full flush — the restored model must never
+        # serve the 10.0 the rolled-back model computed
+        inv_before = snap["invalidations"]
+        r = requests.post(st.base + "/rollback")
+        assert r.status_code == 200
+        assert r.json()["engineInstanceId"] == iid1
+        assert _query(st.base, "u1")["score"] == 5.0
+        snap = _cache_status(st.base)
+        assert snap["invalidations"] > inv_before
+
+
+def test_server_foldin_invalidates_exactly_touched_user(tmp_path):
+    """A fold-in increment touching uA evicts uA's entry within
+    seconds (TTL is minutes — a stale serve would stall the wait),
+    while uB keeps serving from cache."""
+    storage = _mixed_storage(tmp_path)
+    app_id = _mk_app(storage)
+    _rate(storage, app_id, "uA", 3.0)
+    _rate(storage, app_id, "uB", 4.0)
+    trained = _train(storage)
+    server = EngineServer(
+        foldin_engine.engine_factory(), engine_factory_name="foldin",
+        storage=storage, foldin_ms=60, swap_watch_ms=60_000,
+        swap_max_error_rate=0.3, query_cache_size=32,
+        query_cache_ttl_ms=300_000)
+    with ServerThread(server.app) as st:
+        assert _query(st.base, "uA")["score"] == 3.0
+        assert _query(st.base, "uB")["score"] == 4.0
+        assert _cache_status(st.base)["entries"] == 2
+
+        # fold in one event for uA only
+        _rate(storage, app_id, "uA", 2.0)
+        doc = _wait(lambda: (lambda d: d if d["score"] == 5.0 else None)(
+            _query(st.base, "uA")))
+        assert doc and doc["score"] == 5.0, \
+            "stale cached result outlived the fold-in touching uA"
+
+        # the increment's marker carries the freshness footprint
+        rows = storage.get_meta_data_engine_instances().get_completed(
+            "foldin", "1", "default")
+        markers = [json.loads(r.runtime_conf["foldin"])
+                   for r in rows if r.id != trained
+                   and (r.runtime_conf or {}).get("foldin")]
+        assert markers
+        assert any(m.get("users") == ["uA"] and trained in m.get("bases", [])
+                   for m in markers)
+
+        # uB's entry SURVIVED the targeted invalidation: next query is
+        # a cache hit (and still the correct pre-fold-in answer)
+        snap = _cache_status(st.base)
+        assert snap["invalidations"] >= 1
+        hits_before = snap["hits"]
+        assert _query(st.base, "uB")["score"] == 4.0
+        assert _cache_status(st.base)["hits"] == hits_before + 1

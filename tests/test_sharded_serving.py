@@ -349,3 +349,187 @@ def test_big_catalog_demo_smoke(monkeypatch):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.main() == 0
+
+
+# -- host-sharded (stacked-scan) kernel identity ----------------------------
+# ISSUE 17: PIO_SERVE_SHARD_ITEMS stacks the catalog [S, rows, rank] on
+# ONE device and scans a per-shard partial top-k; exactness contract is
+# the same as the mesh path — bitwise identical on the matvec/similarity
+# paths, identical indices (scores ≤2 ULP) on the batched gemm path.
+
+from incubator_predictionio_tpu.models._sharded_serving import (  # noqa: E402
+    ShardedCatalog,
+    ShardedIndicators,
+)
+from incubator_predictionio_tpu.ops.llr import (  # noqa: E402
+    Indicators,
+    score_user,
+)
+from incubator_predictionio_tpu.ops.sharded_topk import (  # noqa: E402
+    host_sharded_batch_top_k,
+    host_sharded_score_user,
+    host_sharded_similar_items,
+    host_sharded_top_k_items,
+    put_host_sharded_catalog,
+    put_host_sharded_indicators,
+)
+from incubator_predictionio_tpu.ops.topk import normalize_rows  # noqa: E402
+
+
+def _rows_for(n_items: int, shards: int) -> int:
+    return -(-n_items // shards)
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4])
+def test_host_sharded_single_query_bit_identical(catalog, shards):
+    cat = put_host_sharded_catalog(catalog, _rows_for(len(catalog), shards))
+    assert cat.n_shards == shards
+    rng = np.random.default_rng(11)
+    for k in (1, 10, 37):
+        uv = rng.normal(size=(catalog.shape[1],)).astype(np.float32)
+        s0, i0 = top_k_items(uv, catalog, k)
+        s1, i1 = host_sharded_top_k_items(uv, cat, k)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(s0, s1)  # bitwise
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_host_sharded_exclude_bit_identical(catalog, shards):
+    cat = put_host_sharded_catalog(catalog, _rows_for(len(catalog), shards))
+    rng = np.random.default_rng(12)
+    uv = rng.normal(size=(catalog.shape[1],)).astype(np.float32)
+    exclude = rng.random(len(catalog)) < 0.5
+    s0, i0 = top_k_items(uv, catalog, 10, exclude=exclude)
+    s1, i1 = host_sharded_top_k_items(uv, cat, 10, exclude=exclude)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+    assert not exclude[np.asarray(i1)].any()
+
+
+def test_host_sharded_all_filtered_shard(catalog):
+    """An entirely business-rule-excluded shard contributes only -inf
+    fillers and the merge still reproduces the unsharded answer."""
+    rows = _rows_for(len(catalog), 4)
+    cat = put_host_sharded_catalog(catalog, rows)
+    rng = np.random.default_rng(13)
+    uv = rng.normal(size=(catalog.shape[1],)).astype(np.float32)
+    exclude = np.zeros(len(catalog), bool)
+    exclude[rows:2 * rows] = True  # shard 1 fully suppressed
+    s0, i0 = top_k_items(uv, catalog, 10, exclude=exclude)
+    s1, i1 = host_sharded_top_k_items(uv, cat, 10, exclude=exclude)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+def test_host_sharded_k_larger_than_shard_rows(catalog):
+    """k > rows-per-shard: per-shard partials are clamped to the shard
+    and the merge still assembles the exact global top-k."""
+    cat = put_host_sharded_catalog(catalog, 7)  # 144 shards of 7 rows
+    rng = np.random.default_rng(14)
+    uv = rng.normal(size=(catalog.shape[1],)).astype(np.float32)
+    s0, i0 = top_k_items(uv, catalog, 50)
+    s1, i1 = host_sharded_top_k_items(uv, cat, 50)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+def test_host_sharded_duplicate_scores_tie_break(catalog):
+    """Duplicate scores across shard boundaries: the two-key merge sort
+    must reproduce lax.top_k's tie order (lowest global index first)."""
+    items = np.ones((64, 4), np.float32)  # every item scores identically
+    uv = np.ones(4, np.float32)
+    for shards in (2, 4):
+        cat = put_host_sharded_catalog(items, _rows_for(64, shards))
+        s0, i0 = top_k_items(uv, items, 9)
+        s1, i1 = host_sharded_top_k_items(uv, cat, 9)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(s0, s1)
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_host_sharded_similarity_bit_identical(catalog, shards):
+    normed = normalize_rows(catalog)
+    cat = put_host_sharded_catalog(normed, _rows_for(len(catalog), shards))
+    rng = np.random.default_rng(15)
+    qvecs = catalog[rng.integers(0, len(catalog), size=3)]
+    exclude = np.zeros(len(catalog), bool)
+    exclude[:5] = True
+    s0, i0 = similar_items(qvecs, normed, 10, exclude=exclude)
+    s1, i1 = host_sharded_similar_items(qvecs, cat, 10, exclude=exclude)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+
+
+@pytest.mark.parametrize("shards", [2, 4])
+def test_host_sharded_batch_identical_selection(catalog, shards):
+    cat = put_host_sharded_catalog(catalog, _rows_for(len(catalog), shards))
+    rng = np.random.default_rng(16)
+    uvecs = rng.normal(size=(5, catalog.shape[1])).astype(np.float32)
+    s0, i0 = batch_top_k(uvecs, catalog, 10)
+    s1, i1 = host_sharded_batch_top_k(uvecs, cat, 10)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_allclose(s0, s1, rtol=0, atol=4e-6)  # gemm ULPs
+
+
+def _toy_indicators(rng, n_items: int, kc: int = 6) -> Indicators:
+    idx = rng.integers(-1, n_items, size=(n_items, kc)).astype(np.int32)
+    score = rng.random((n_items, kc)).astype(np.float32)
+    return Indicators(idx=idx, score=score)
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4])
+def test_host_sharded_ur_score_user_bit_identical(shards):
+    """Universal-recommender scoring: the per-type correlator tables
+    shard the same way and the merged answer is bitwise identical
+    (row-wise einsum reduction is row-count-invariant)."""
+    rng = np.random.default_rng(17)
+    n_items = 101
+    rows = _rows_for(n_items, shards)
+    inds = {"view": _toy_indicators(rng, n_items),
+            "buy": _toy_indicators(rng, n_items, kc=3)}
+    membership = {n: (rng.random(n_items) < 0.3).astype(np.float32)
+                  for n in inds}
+    boost = np.where(rng.random(n_items) < 0.1, 2.0, 1.0).astype(np.float32)
+    exclude = rng.random(n_items) < 0.2
+    plain = [(inds[n], membership[n], b)
+             for n, b in (("view", 1.0), ("buy", 2.0))]
+    s0, i0 = score_user(plain, 10, exclude=exclude, item_boost=boost)
+    sharded = [(put_host_sharded_indicators(inds[n], rows), membership[n], b)
+               for n, b in (("view", 1.0), ("buy", 2.0))]
+    s1, i1 = host_sharded_score_user(sharded, 10, n_items,
+                                     exclude, boost)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
+
+
+def test_sharded_catalog_facade_layout_selection(catalog, monkeypatch):
+    """ShardedCatalog picks flat with the knob unset, host when the
+    knob is smaller than the vocabulary, flat when it is not."""
+    monkeypatch.delenv("PIO_SERVE_SHARD_ITEMS", raising=False)
+    assert ShardedCatalog(catalog).layout == "flat"
+    monkeypatch.setenv("PIO_SERVE_SHARD_ITEMS", "100")
+    cat = ShardedCatalog(catalog)
+    assert cat.layout == "host" and cat.n_shards == 11
+    s0, i0 = top_k_items(np.ones(catalog.shape[1], np.float32), catalog, 10)
+    s1, i1 = cat.top_k(np.ones(catalog.shape[1], np.float32), 10)
+    np.testing.assert_array_equal(i0, i1)
+    np.testing.assert_array_equal(s0, s1)
+    monkeypatch.setenv("PIO_SERVE_SHARD_ITEMS", str(len(catalog) + 1))
+    assert ShardedCatalog(catalog).layout == "flat"
+
+
+def test_sharded_indicators_facade_layout_selection(monkeypatch):
+    rng = np.random.default_rng(18)
+    inds = {"view": _toy_indicators(rng, 40)}
+    monkeypatch.delenv("PIO_SERVE_SHARD_ITEMS", raising=False)
+    assert ShardedIndicators(inds, 40).layout == "flat"
+    monkeypatch.setenv("PIO_SERVE_SHARD_ITEMS", "16")
+    si = ShardedIndicators(inds, 40)
+    assert si.layout == "host"
+    m = (rng.random(40) < 0.4).astype(np.float32)
+    s0, i0 = score_user([(inds["view"], m, 1.0)], 5,
+                        exclude=None, item_boost=None)
+    s1, i1 = si.score_user([("view", m, 1.0)], 5,
+                           exclude=None, item_boost=None)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))

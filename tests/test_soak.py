@@ -238,6 +238,11 @@ def _green_fixture(tmp_path):
         'pio_fleet_rollbacks_total{reason="error-rate"}': 2.0,
         "pio_engine_quality_samples_total": 40.0,
         "pio_engine_quality_breaches_total": 1.0,
+        "pio_query_cache_hits_total": 30.0,
+        "pio_query_cache_misses_total": 20.0,
+        'pio_query_cache_invalidations_total{reason="foldin"}': 9.0,
+        'pio_query_cache_invalidations_total{reason="swap"}': 2.0,
+        'pio_query_cache_invalidations_total{reason="rollback"}': 3.0,
     }
     samples.restarts = {"replica:1": 1}
     samples.served = [(1.0, "iid-initial"), (at["good_retrain"] + 6,
@@ -417,6 +422,53 @@ def test_slo_quality_regression_red_paths(tmp_path):
           "directive pin quality")]
     slos, _ = _eval(fx)
     assert not _slo(slos, "quality-regression")["ok"]
+
+
+def test_slo_cache_freshness_red_paths(tmp_path):
+    # fewer cache invalidation events than observed rollbacks means
+    # some rollback left its cached results serving (ISSUE 17: kill/
+    # poison faults must not serve stale cached results)
+    fx = _green_fixture(tmp_path)
+    for k in list(fx["samples"].metric_max):
+        if k.startswith("pio_query_cache_invalidations_total"):
+            del fx["samples"].metric_max[k]
+    fx["samples"].metric_max[
+        'pio_query_cache_invalidations_total{reason="rollback"}'] = 2.0
+    slos, _ = _eval(fx)
+    row = _slo(slos, "cache-freshness")
+    assert not row["ok"]
+    assert row["value"]["invalidations"] == 2.0
+    assert row["value"]["rollbacks"] == 3
+    # an armed cache that never counted a hit or miss is a dead cache:
+    # red even with the invalidation leg green
+    fx = _green_fixture(tmp_path)
+    del fx["samples"].metric_max["pio_query_cache_hits_total"]
+    del fx["samples"].metric_max["pio_query_cache_misses_total"]
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "cache-freshness")["ok"]
+    # the /status queryCache scrape is an alternate evidence channel:
+    # counters missing from /metrics but present in the status block
+    # (kill windows can drop either scrape) still satisfy both legs
+    fx = _green_fixture(tmp_path)
+    for k in list(fx["samples"].metric_max):
+        if k.startswith("pio_query_cache"):
+            del fx["samples"].metric_max[k]
+    fx["samples"].query_cache = {"hits": 12, "misses": 4,
+                                 "invalidations": 5}
+    slos, _ = _eval(fx)
+    assert _slo(slos, "cache-freshness")["ok"]
+    # a disarmed cache (query_cache_size=0) passes vacuously — there
+    # is nothing to keep fresh, and the row says so
+    cfg = _cfg(tmp_path, event_workers=2, replicas=2,
+               rollback_deadline_s=30.0, query_cache_size=0)
+    fx = _green_fixture(tmp_path)
+    fx["plan"] = plan_scenario(cfg)
+    for k in list(fx["samples"].metric_max):
+        if k.startswith("pio_query_cache"):
+            del fx["samples"].metric_max[k]
+    slos, _ = _eval(fx)
+    row = _slo(slos, "cache-freshness")
+    assert row["ok"] and "disabled" in row["detail"]
 
 
 def test_slo_quality_fault_evidence_red_without_breach_counter(
