@@ -18,6 +18,10 @@ write — measuring:
   (ISSUE 8); persisted as `measured_ingest_multiworker`
 - compacted-scan timing: a cold columnar-snapshot load vs the JSON
   re-parse of the same log (`measured_eventlog_scan`)
+- windowed-feed timing (ISSUE 18): a `--window` cold read over a log
+  with three sealed time-disjoint generations + a fresh tail vs the
+  full-log scan, same run — the generation-skip (zero-decode) win
+  (`measured_windowed_feed`)
 
 against the JSONL event log (the training-fast-path store of record)
 by default; PIO_INGEST_BACKEND=SQLITE|MEMORY switches. Ack semantics
@@ -552,6 +556,104 @@ def run_compacted_scan_bench(n_events: int = 60_000) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_windowed_feed_bench(n_sealed: int = 60_000,
+                            n_tail: int = 2_000) -> dict:
+    """Windowed training read vs the full-log cold scan, same run, same
+    log: three sealed generations a month apart in event time plus a
+    fresh uncompacted tail. A `--window` read skips disjoint
+    generations by their manifest event-time bounds alone — zero
+    snapshot bytes decoded — so training on the tail does not pay for
+    the cold sealed bytes (ISSUE 18). Rounds are interleaved
+    full/tail/1-gen so host drift hits every arm equally; the reported
+    speedups are medians of WITHIN-round ratios."""
+    import datetime as dt
+    import shutil
+
+    from incubator_predictionio_tpu.data.api import event_log
+    from incubator_predictionio_tpu.data.storage.event import Event
+    from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
+
+    months = [dt.datetime(2026, m, 1, tzinfo=dt.timezone.utc)
+              for m in (1, 3, 5, 6)]
+
+    def tev(k, base):
+        # ev(k) pins eventTime to one instant; windowed reads need real
+        # event-time spread (within a day — generations stay disjoint).
+        e = ev(k)
+        e["eventTime"] = (base + dt.timedelta(
+            seconds=(k * 137) % 86_400)).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+        return e
+
+    per = max(1, n_sealed // 3)
+    tmp = tempfile.mkdtemp(prefix="pio_window_")
+    try:
+        path = os.path.join(tmp, "events_1.jsonl")
+        for base in months[:3]:  # three sealed, time-disjoint generations
+            le = JSONLEvents(tmp)
+            le.insert_batch([Event.from_json(tev(i, base))
+                             for i in range(per)], 1)
+            le.close()
+            assert event_log.compact_log(path) is not None
+        le = JSONLEvents(tmp)
+        le.insert_batch([Event.from_json(tev(i, months[3]))
+                         for i in range(n_tail)], 1)  # uncompacted tail
+        le.close()
+        size = os.path.getsize(path)
+
+        def cold_seconds(start, expect) -> float:
+            # fresh store per timing: the windowed chain cache is
+            # per-instance, so every arm is a true cold read
+            t0 = time.perf_counter()
+            fresh = JSONLEvents(tmp)
+            cols, rows = fresh.scan_columnar(1, start_time=start)
+            assert len(rows) == expect, (len(rows), expect)
+            return time.perf_counter() - t0
+
+        brackets = {
+            "full": (None, 3 * per + n_tail),
+            "window_tail": (months[3] - dt.timedelta(days=2),
+                            n_tail),
+            "window_1gen": (months[2] - dt.timedelta(days=2),
+                            per + n_tail),
+        }
+        # one instrumented tail read first: prove the win is generation
+        # skip (manifest bounds, zero decode), not cache warmth
+        skips0 = event_log._M_WINDOW_SKIPS.value()
+        cold_seconds(*brackets["window_tail"])
+        tail_skips = event_log._M_WINDOW_SKIPS.value() - skips0
+
+        rounds = int(os.environ.get("PIO_WINDOW_ROUNDS", "5"))
+        times: dict = {k: [] for k in brackets}
+        for _ in range(rounds):
+            for k, (start, expect) in brackets.items():
+                times[k].append(cold_seconds(start, expect))
+        med = {k: float(np.median(v)) for k, v in times.items()}
+        ratio = {k: float(np.median([f / w for f, w in
+                                     zip(times["full"], times[k])]))
+                 for k in ("window_tail", "window_1gen")}
+        out = {
+            "events": 3 * per + n_tail,
+            "sealed_generations": 3,
+            "tail_events": n_tail,
+            "log_bytes": size,
+            "full_scan_s": round(med["full"], 4),
+            "window_tail_s": round(med["window_tail"], 4),
+            "window_1gen_s": round(med["window_1gen"], 4),
+            "speedup_tail": round(ratio["window_tail"], 2),
+            "speedup_1gen": round(ratio["window_1gen"], 2),
+            "tail_generations_skipped": int(tail_skips),
+        }
+        log(f"[ingest] windowed feed: {out['events']} events in 3 sealed "
+            f"generations + {n_tail} tail; full {med['full'] * 1e3:.0f}ms, "
+            f"tail-window {med['window_tail'] * 1e3:.0f}ms "
+            f"({out['speedup_tail']}x, {tail_skips} generations skipped), "
+            f"1-gen window {med['window_1gen'] * 1e3:.0f}ms "
+            f"({out['speedup_1gen']}x)")
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> int:
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tests"))
@@ -689,6 +791,12 @@ def main() -> int:
     results_scan = run_compacted_scan_bench(
         int(os.environ.get("PIO_INGEST_SCAN_N", "60000")))
 
+    # windowed feed vs full-log scan (ISSUE 18: event-time windows)
+    results_window = run_windowed_feed_bench(
+        int(os.environ.get("PIO_INGEST_WINDOW_N", "60000")),
+        int(os.environ.get("PIO_INGEST_WINDOW_TAIL", "2000")))
+    results_window["host_loop_mops"] = round(mops, 1)
+
     for conc in concs:
         on = by_mode["on"]["sweep"][conc]["events_per_sec"]
         off = by_mode["off"]["sweep"][conc]["events_per_sec"]
@@ -700,7 +808,8 @@ def main() -> int:
             f"({on:,.0f} -> {wal:,.0f} ev/s)")
 
     modes = [("group_on", results_on), ("group_off", results_off),
-             ("wal_on", results_wal), ("eventlog_scan", results_scan)]
+             ("wal_on", results_wal), ("eventlog_scan", results_scan),
+             ("windowed_feed", results_window)]
     if results_mw is not None:
         modes.append(("multiworker", results_mw))
     for mode, res in modes:
@@ -724,6 +833,13 @@ def main() -> int:
         pub[f"measured_ingest_{backend.lower()}_nogroup"] = results_off
         pub[f"measured_ingest_{backend.lower()}_wal"] = results_wal
         pub["measured_eventlog_scan"] = results_scan
+        pub["measured_windowed_feed"] = results_window
+        pub["measured_windowed_feed_note"] = (
+            "cold scan_columnar over one JSONL log: 3 sealed generations "
+            "(Jan/Mar/May 2026) + fresh tail; window arms skip disjoint "
+            "generations by manifest event-time bounds (zero decode). "
+            "speedup_* = median of within-round full/window ratios, "
+            "interleaved rounds; normalize across hosts by host_loop_mops")
         if results_mw is not None:
             pub["measured_ingest_multiworker"] = results_mw
         with open(base_path, "w") as f:

@@ -39,6 +39,20 @@ reference platform leaned on:
   about by ``pio status``; the partition keeps serving from the
   surviving JSONL bytes.
 
+- **Event-time generations & tiered retention.** Compaction seals the
+  newly covered byte range as its OWN generation (manifest schema v2
+  keeps the whole chain), stamped with the range's event-time bounds
+  ``[minEventUs, maxEventUs]``. Windowed reads (``pio train --window
+  90d``) skip disjoint generations by manifest bounds alone — zero
+  snapshot decode — while :func:`retire_expired` (``PIO_EVENT_RETENTION``
+  / ``pio eventlog retire``) moves fully-expired prefix generations to a
+  quarantine-style ``retired/`` tier and :func:`archive_generation` /
+  :func:`restore_generation` stream sealed generations to a cold storage
+  source with a checksum-verified round-trip. All transitions use the
+  compaction commit discipline (shadow write → fsync → atomic rename →
+  manifest commit): a SIGKILL at any fault point leaves the previous
+  tier state serving.
+
 - **Resource-exhaustion degradation.** ENOSPC-class append failures
   flip the partition into *shed mode* (503 + jittered Retry-After, the
   breaker discipline of ``common/resilience.py``) instead of letting a
@@ -74,7 +88,7 @@ from typing import Optional
 
 import numpy as np
 
-from ...common import telemetry
+from ...common import envknobs, telemetry
 from ...common.faultinject import fault_point
 from ...common.splice import FrontProxy
 from .ingest_buffer import IngestOverloadError
@@ -83,9 +97,11 @@ from .ingest_wal import QUARANTINE_DIR, quarantine_path
 log = logging.getLogger("pio.eventlog")
 
 __all__ = [
-    "Lease", "PartitionFencedError", "PartitionHeldError",
-    "claim_partition", "compact_log", "lease_info", "load_snapshot",
-    "partition_health", "run_partitioned_event_server", "scrub_log_dir",
+    "ArchivedGenerationError", "Lease", "PartitionFencedError",
+    "PartitionHeldError", "archive_generation", "claim_partition",
+    "compact_log", "lease_info", "load_chain", "load_snapshot",
+    "parse_floor", "partition_health", "restore_generation",
+    "retire_expired", "run_partitioned_event_server", "scrub_log_dir",
 ]
 
 _M_SNAP_LOADS = telemetry.registry().counter(
@@ -95,10 +111,36 @@ _M_SNAP_LOADS = telemetry.registry().counter(
 _M_COMPACTIONS = telemetry.registry().counter(
     "pio_eventlog_compactions_total",
     "Event-log compaction passes that committed a new snapshot").labels()
+_M_RETIRED = telemetry.registry().counter(
+    "pio_eventlog_retired_generations_total",
+    "Fully-expired generations moved to the retired tier by "
+    "PIO_EVENT_RETENTION / pio eventlog retire").labels()
+_M_ARCHIVED = telemetry.registry().counter(
+    "pio_eventlog_archived_generations_total",
+    "Sealed generations streamed to the cold archive source with a "
+    "verified round-trip").labels()
+_M_RESTORED = telemetry.registry().counter(
+    "pio_eventlog_restored_generations_total",
+    "Archived generations restored to the hot tier (operator command "
+    "or restore-on-demand)").labels()
+_M_WINDOW_SKIPS = telemetry.registry().counter(
+    "pio_train_window_generations_skipped_total",
+    "Whole generations skipped by manifest event-time bounds during a "
+    "windowed read — zero snapshot bytes decoded").labels()
 
 SNAPSHOT_VERSION = 1
+MANIFEST_VERSION = 2
 MANIFEST_SUFFIX = ".manifest"
 TAIL_PROBE_LEN = 4096
+#: quarantine-style subdirectory retired generations move INTO (never
+#: unlinked in place); only this module may reference it — enforced by
+#: the wal-suffix-confinement lint rule
+RETIRED_DIR = "retired"
+#: Models-DAO namespace on the cold source archived blobs land in;
+#: same confinement rule as RETIRED_DIR
+ARCHIVE_NAMESPACE = "pio_eventlog_archive"
+#: sentinel the native codec stores for rows without an eventTime
+_TIME_ABSENT_US = int(np.iinfo(np.int64).min)
 
 
 # ---------------------------------------------------------------------------
@@ -355,24 +397,119 @@ def _tail_probe(buf: bytes, covered: int) -> dict:
             "crc32": zlib.crc32(buf[off:covered])}
 
 
-def compact_log(log_path: str, min_new_bytes: int = 0) -> Optional[dict]:
-    """Compact one JSONL event log into a columnar snapshot.
+def _generations(manifest: dict) -> list:
+    """The manifest's generation chain, oldest first. A legacy (v1)
+    manifest — one snapshot covering everything, no event-time bounds —
+    normalizes to a single UNBOUNDED entry: it is always loaded (never
+    window-skipped), never retired, and ``pio eventlog status`` warns
+    about it until the next compaction seals a bounded generation."""
+    gens = manifest.get("generations")
+    if isinstance(gens, list) and gens:
+        return gens
+    return [{
+        "generation": int(manifest.get("generation", 1)),
+        "file": manifest.get("file"),
+        "start": 0,
+        "end": int(manifest.get("covered", 0)),
+        "events": manifest.get("events"),
+        "crc32": manifest.get("crc32"),
+        "minEventUs": None,
+        "maxEventUs": None,
+        "untimedRows": None,
+        "tombstones": None,
+        "dupIds": None,
+        "dupComplete": False,
+        "tier": "hot",
+        "legacy": True,
+    }]
 
-    Additive and lock-free: the snapshot covers the first ``covered``
-    bytes (the complete-line prefix at read time); concurrent appends
-    only ever extend the file past ``covered`` and are picked up as the
-    normal incremental tail parse. Commit protocol (each step leaves a
-    recoverable state — SIGKILL anywhere yields either the old
-    snapshot or the new one, complete):
+
+def _gen_skippable(entry: dict, start_us, until_us) -> bool:
+    """May a windowed read drop this generation without decoding it?
+
+    Only when the manifest PROVES equivalence to the row filter: the
+    entry carries real bounds metadata (not legacy, and its
+    cross-generation duplicate-id set was complete at seal time) and
+    its timed rows are disjoint from ``[start_us, until_us)``. An entry
+    with no timed rows at all is always skippable — the row filter
+    drops untimed rows from every bounded window."""
+    if entry.get("legacy") or not entry.get("dupComplete", False):
+        return False
+    if entry.get("tombstones") is None or entry.get("dupIds") is None:
+        return False
+    lo, hi = entry.get("minEventUs"), entry.get("maxEventUs")
+    if lo is None or hi is None:
+        return True
+    if start_us is not None and hi < start_us:
+        return True
+    if until_us is not None and lo >= until_us:
+        return True
+    return False
+
+
+def _dup_ids(dirpath: str, chain: list, cols) -> tuple:
+    """``(sorted duplicate ids, complete?)`` for a generation being
+    sealed: the explicit event-ids it shares with any EARLIER
+    non-retired generation. A windowed read that skips this generation
+    replays these as keep-last kills, so dedup against skipped rows
+    stays bit-identical to the full scan. When an earlier generation's
+    id table is unreadable locally (archived, or a racing gc), the set
+    is marked incomplete and the new generation is simply never
+    skipped — conservative, never wrong."""
+    from ...native import ColumnarEvents
+
+    new_ids = set(cols.table(ColumnarEvents.TABLE_EVENT_ID))
+    if not new_ids:
+        return [], True
+    dups, complete = set(), True
+    for entry in chain:
+        if entry.get("tier") == "retired":
+            continue  # retired rows never appear in any scan
+        path = os.path.join(dirpath, entry.get("file") or "")
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                ids = json.loads(bytes(z["table_5"]).decode("utf-8"))
+        except Exception:  # noqa: BLE001 — archived/missing/corrupt
+            complete = False
+            continue
+        dups.update(new_ids.intersection(ids))
+    return sorted(dups), complete
+
+
+def _commit_manifest(log_path: str, manifest: dict) -> None:
+    """Shadow-write + fsync + atomic-rename the manifest — the commit
+    record every tier transition shares."""
+    mtmp = _manifest_path(log_path) + ".tmp"
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(mtmp, _manifest_path(log_path))
+    _fsync_dir(os.path.dirname(log_path) or ".")
+
+
+def compact_log(log_path: str, min_new_bytes: int = 0) -> Optional[dict]:
+    """Compact one JSONL event log into a columnar snapshot generation.
+
+    Additive and lock-free: each pass seals ONLY the newly covered
+    byte range ``[prev_covered, covered)`` as its own generation and
+    appends it to the manifest's generation chain (schema v2) — prior
+    generations' files are untouched, so a pass parses and serializes
+    just the new bytes. Each entry records the range's event-time
+    bounds, its tombstone ids, and the explicit event-ids it duplicates
+    from earlier generations: everything a windowed read needs to skip
+    a disjoint generation without decoding it. Commit protocol (each
+    step leaves a recoverable state — SIGKILL anywhere yields either
+    the old chain or the new one, complete):
 
     1. write ``<log>.g<N>.colseg.tmp`` (shadow file), fsync
     2. atomic-rename to ``<log>.g<N>.colseg``, fsync dir
     3. write + fsync + atomic-rename the manifest (the COMMIT record:
-       it names exactly one generation)
-    4. garbage-collect superseded generations and stray ``.tmp`` files
+       it names the exact generation chain)
+    4. garbage-collect unreferenced snapshot files and stray ``.tmp``
 
     Returns the committed manifest, or None when the log has grown less
-    than ``min_new_bytes`` past the current snapshot."""
+    than ``min_new_bytes`` past the current chain."""
     from ...native import parse_events
 
     try:
@@ -382,14 +519,17 @@ def compact_log(log_path: str, min_new_bytes: int = 0) -> Optional[dict]:
         return None
     covered = buf.rfind(b"\n") + 1  # complete lines only
     prev = _read_manifest(log_path)
-    gen = 1
+    chain: list = []
+    prev_covered, gen = 0, 1
     if prev is not None:
-        if covered < int(prev.get("covered", 0)) + max(1, min_new_bytes):
+        chain = [dict(e) for e in _generations(prev)]
+        prev_covered = int(prev.get("covered", 0))
+        if covered < prev_covered + max(1, min_new_bytes):
             return None
         gen = int(prev.get("generation", 0)) + 1
     elif covered == 0:
         return None
-    cols = parse_events(buf[:covered])
+    cols = parse_events(buf[prev_covered:covered])
     blob = _serialize_cols(cols)
     dirpath = os.path.dirname(log_path) or "."
     base = os.path.basename(log_path)
@@ -403,15 +543,36 @@ def compact_log(log_path: str, min_new_bytes: int = 0) -> Optional[dict]:
     os.replace(tmp, os.path.join(dirpath, snap_name))
     _fsync_dir(dirpath)
     fault_point("compact.rename")
+    timed = cols.time_us[cols.time_us != _TIME_ABSENT_US]
+    dup_ids, dup_complete = _dup_ids(dirpath, chain, cols)
+    entry = {
+        "generation": gen,
+        "file": snap_name,
+        "start": prev_covered,
+        "end": covered,
+        "events": len(cols),
+        "crc32": zlib.crc32(blob),
+        "minEventUs": int(timed.min()) if timed.size else None,
+        "maxEventUs": int(timed.max()) if timed.size else None,
+        "untimedRows": int(len(cols) - timed.size),
+        "tombstones": list(cols.tombstones),
+        "dupIds": dup_ids,
+        "dupComplete": dup_complete,
+        "tier": "hot",
+    }
+    chain.append(entry)
     manifest = {
-        "version": SNAPSHOT_VERSION,
+        "version": MANIFEST_VERSION,
+        # top-level keys describe the NEWEST generation plus chain
+        # totals — the shape v1 consumers (tests, bench, status) read
         "generation": gen,
         "file": snap_name,
         "covered": covered,
-        "events": len(cols),
-        "crc32": zlib.crc32(blob),
+        "events": sum(int(e.get("events") or 0) for e in chain),
+        "crc32": entry["crc32"],
         "tailProbe": _tail_probe(buf, covered),
         "compactedAt": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+        "generations": chain,
     }
     mtmp = _manifest_path(log_path) + ".tmp"
     with open(mtmp, "w") as f:
@@ -422,20 +583,32 @@ def compact_log(log_path: str, min_new_bytes: int = 0) -> Optional[dict]:
     os.replace(mtmp, _manifest_path(log_path))
     _fsync_dir(dirpath)
     _M_COMPACTIONS.inc()
-    _gc_generations(dirpath, base, keep=snap_name)
-    log.info("compacted %s: generation %d, %d event(s), %d byte(s) "
+    _gc_generations(dirpath, base,
+                    keep={e["file"] for e in chain
+                          if e.get("file") and e.get("tier") != "archived"})
+    log.info("compacted %s: generation %d, %d new event(s), %d byte(s) "
              "covered", log_path, gen, len(cols), covered)
     return manifest
 
 
-def _gc_generations(dirpath: str, base: str, keep: str) -> None:
-    """Remove superseded snapshot generations and stray shadow files
-    of one log (post-commit: nothing references them)."""
+def _gc_generations(dirpath: str, base: str, keep) -> None:
+    """Remove snapshot files the committed manifest no longer
+    references, plus stray shadow files (post-commit: nothing
+    references them).
+
+    ``keep`` is the full SET of file names still referenced by the
+    chain — every hot generation, and retired entries whose move into
+    ``retired/`` may still be pending after a crash. Keying the sweep
+    on a single name would collect live chain members (and an exact-name
+    set also shuts the near-miss door: ``.g1`` vs ``.g11`` share a
+    prefix but never an entry)."""
+    if isinstance(keep, str):
+        keep = {keep}
     prefix = base + ".g"
     for name in os.listdir(dirpath):
         if not name.startswith(prefix):
             continue
-        if name == keep:
+        if name in keep:
             continue
         if name.endswith(".colseg") or name.endswith(".tmp"):
             try:
@@ -458,10 +631,15 @@ def _discard_stale(log_path: str, manifest: Optional[dict]) -> None:
     if (current is not None and manifest is not None
             and current.get("generation") != manifest.get("generation")):
         return  # a newer commit raced in: it owns the manifest now
-    for p in ([_manifest_path(log_path)]
-              + ([os.path.join(os.path.dirname(log_path) or ".",
-                               manifest["file"])]
-                 if manifest and manifest.get("file") else [])):
+    dirpath = os.path.dirname(log_path) or "."
+    doomed = [_manifest_path(log_path)]
+    if manifest is not None:
+        # every hot chain file describes the replaced log; retired
+        # files and archived blobs are left alone (quarantine-style)
+        doomed += [os.path.join(dirpath, e["file"])
+                   for e in _generations(manifest)
+                   if e.get("file") and e.get("tier", "hot") == "hot"]
+    for p in doomed:
         try:
             os.remove(p)
         except OSError:
@@ -483,36 +661,118 @@ def _remove_manifest_if(log_path: str, manifest: dict) -> None:
         pass
 
 
-def load_snapshot(log_path: str):
-    """Load the committed snapshot of one log, fully verified.
+class ArchivedGenerationError(RuntimeError):
+    """A read needs a generation whose snapshot lives only on the cold
+    archive source (and restore-on-demand is off). Names the
+    generations so the operator knows exactly what to
+    ``pio eventlog restore``."""
 
-    Returns ``(ColumnarEvents, covered_bytes)`` or None. A CORRUPT
-    snapshot (CRC mismatch against the manifest commit record, or a
-    blob that fails to decode) is quarantined — moved aside, counted,
-    warned — and the caller falls back to the JSON parse: corruption
-    degrades speed, never availability and never replay. A STALE
-    snapshot (the log shrank or its covered prefix changed — a rewrite,
-    not bit rot) is silently discarded and rebuilt by the next
-    compaction pass."""
+    def __init__(self, log_path: str, generations: list):
+        self.log_path = log_path
+        self.generations = list(generations)
+        gens = ", ".join(str(g) for g in self.generations)
+        super().__init__(
+            f"generation(s) {gens} of {log_path!r} are archived; run "
+            f"`pio eventlog restore` or set "
+            f"PIO_EVENT_RESTORE_ON_DEMAND=1")
+
+
+def parse_floor(log_path: str) -> int:
+    """First byte offset of the log still in the hot view: the byte
+    after the contiguous RETIRED prefix of the generation chain. JSON
+    fallback parses (snapshot missing/corrupt) must start here, not at
+    byte 0 — re-parsing retired bytes would resurrect expired data."""
+    manifest = _read_manifest(log_path)
+    if manifest is None:
+        return 0
+    floor = 0
+    for entry in _generations(manifest):
+        if entry.get("tier") != "retired":
+            break
+        floor = int(entry.get("end", floor))
+    return floor
+
+
+def _truncate_chain(log_path: str, manifest: dict, bad_gen: int) -> None:
+    """Self-heal a chain whose generation ``bad_gen`` failed to load:
+    keep the verified prefix (entries sealed before it), drop it and
+    everything after — the next compaction pass re-seals the dropped
+    byte range. Generation-guarded like :func:`_discard_stale`. With no
+    loadable prefix the manifest is removed outright (the v1
+    behavior)."""
+    current = _read_manifest(log_path)
+    if (current is not None
+            and current.get("generation") != manifest.get("generation")):
+        return
+    kept = [e for e in _generations(manifest)
+            if int(e.get("generation", 0)) < bad_gen]
+    if not kept:
+        try:
+            os.remove(_manifest_path(log_path))
+        except OSError:
+            pass
+        return
+    last = kept[-1]
+    covered = int(last.get("end", 0))
+    try:
+        with open(log_path, "rb") as f:
+            buf = f.read(covered)
+        probe = _tail_probe(buf, covered)
+    except OSError:
+        probe = manifest.get("tailProbe")
+    try:
+        _commit_manifest(log_path, {
+            "version": MANIFEST_VERSION,
+            "generation": int(last.get("generation", 0)),
+            "file": last.get("file"),
+            "covered": covered,
+            "events": sum(int(e.get("events") or 0) for e in kept),
+            "crc32": last.get("crc32"),
+            "tailProbe": probe,
+            "compactedAt": manifest.get("compactedAt"),
+            "generations": kept,
+        })
+    except OSError:  # pragma: no cover — degraded disk; next pass heals
+        pass
+
+
+def load_chain(log_path: str, start_us=None, until_us=None,
+               on_archived: str = "raise", storage=None) -> Optional[dict]:
+    """Load the committed generation chain of one log, fully verified,
+    optionally windowed by event time.
+
+    Returns ``{"pieces", "covered", "floor", "skipped", "decodedBytes",
+    "generations"}`` or None (no chain / stale — caller falls back to
+    the JSON parse from :func:`parse_floor`). ``pieces`` is an ordered
+    list the consumer folds into one scan:
+
+    - ``("cols", ColumnarEvents, entry)`` — a decoded generation;
+    - ``("skip", entry)`` — a generation PROVEN disjoint from the
+      window by its manifest bounds: zero bytes read, zero decoded.
+      The entry carries the tombstone ids and duplicate-id kills the
+      consumer must still apply for bit-identity with a full scan;
+    - ``("gap", entry)`` — an archived generation under
+      ``on_archived="parse"``: the consumer re-parses the log bytes
+      ``[start, end)`` (correct, just slower — serving paths use this
+      so archival never breaks availability).
+
+    ``on_archived`` picks the policy for an archived generation the
+    window actually needs: ``"raise"`` (windowed trains —
+    :class:`ArchivedGenerationError` names the generation; flipped to a
+    restore by ``PIO_EVENT_RESTORE_ON_DEMAND``) or ``"parse"``.
+
+    Corruption handling is per-generation: a CRC-mismatched or
+    undecodable snapshot is quarantined and the chain self-truncates to
+    the verified prefix (:func:`_truncate_chain`); a STALE chain (log
+    shrank / tail probe mismatch) is discarded whole. Either way the
+    caller falls back to the JSON parse — speed degrades, availability
+    and replay never do."""
     manifest = _read_manifest(log_path)
     if manifest is None:
         return None
-    dirpath = os.path.dirname(log_path) or "."
-    snap_path = os.path.join(dirpath, manifest.get("file") or "")
-    try:
-        covered = int(manifest["covered"])
-        with open(snap_path, "rb") as f:
-            blob = f.read()
-    except (OSError, KeyError, TypeError, ValueError):
-        _discard_stale(log_path, manifest)
-        return None
-    if zlib.crc32(blob) != manifest.get("crc32"):
-        quarantine_path(snap_path, "colseg")
-        _remove_manifest_if(log_path, manifest)
-        log.warning("snapshot of %s failed CRC; quarantined — scans "
-                    "fall back to the JSON parse", log_path)
-        return None
-    # the snapshot must describe THIS log: size still covers it and the
+    chain = _generations(manifest)
+    covered = int(manifest.get("covered", 0))
+    # the chain must describe THIS log: size still covers it and the
     # last bytes of the covered prefix match the recorded probe
     try:
         if os.path.getsize(log_path) < covered:
@@ -526,16 +786,408 @@ def load_snapshot(log_path: str):
     except (OSError, KeyError, TypeError, ValueError):
         _discard_stale(log_path, manifest)
         return None
-    try:
-        cols = _deserialize_cols(blob)
-    except Exception:  # noqa: BLE001 — any decode failure = corrupt
-        quarantine_path(snap_path, "colseg")
-        _remove_manifest_if(log_path, manifest)
-        log.exception("snapshot of %s failed to decode; quarantined",
-                      log_path)
-        return None
+    dirpath = os.path.dirname(log_path) or "."
+    windowed = start_us is not None or until_us is not None
+    pieces: list = []
+    floor = 0
+    skipped = decoded = 0
+    for entry in chain:
+        if entry.get("tier") == "retired":
+            if not pieces and not skipped:
+                floor = int(entry.get("end", floor))
+            continue
+        if windowed and _gen_skippable(entry, start_us, until_us):
+            pieces.append(("skip", entry))
+            skipped += 1
+            continue
+        if entry.get("tier") == "archived":
+            if envknobs.env_flag("PIO_EVENT_RESTORE_ON_DEMAND", False):
+                restore_generation(log_path,
+                                   int(entry.get("generation", 0)),
+                                   storage=storage)
+                # the restored file now sits in the hot dir under the
+                # same name/crc — fall through and load it
+            elif on_archived == "parse":
+                pieces.append(("gap", entry))
+                continue
+            else:
+                raise ArchivedGenerationError(
+                    log_path, [entry.get("generation")])
+        snap_path = os.path.join(dirpath, entry.get("file") or "")
+        try:
+            with open(snap_path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            # a hot chain member is missing: treat as corruption of
+            # that generation — keep the verified prefix, re-seal later
+            _truncate_chain(log_path, manifest,
+                            int(entry.get("generation", 0)))
+            log.warning("generation %s of %s is missing; chain "
+                        "truncated to the verified prefix",
+                        entry.get("generation"), log_path)
+            return None
+        if zlib.crc32(blob) != entry.get("crc32"):
+            quarantine_path(snap_path, "colseg")
+            _truncate_chain(log_path, manifest,
+                            int(entry.get("generation", 0)))
+            log.warning("generation %s of %s failed CRC; quarantined — "
+                        "scans fall back to the JSON parse",
+                        entry.get("generation"), log_path)
+            return None
+        try:
+            cols = _deserialize_cols(blob)
+        except Exception:  # noqa: BLE001 — any decode failure = corrupt
+            quarantine_path(snap_path, "colseg")
+            _truncate_chain(log_path, manifest,
+                            int(entry.get("generation", 0)))
+            log.exception("generation %s of %s failed to decode; "
+                          "quarantined", entry.get("generation"),
+                          log_path)
+            return None
+        pieces.append(("cols", cols, entry))
+        decoded += len(blob)
+    if skipped:
+        _M_WINDOW_SKIPS.inc(skipped)
     _M_SNAP_LOADS.inc()
-    return cols, covered
+    return {"pieces": pieces, "covered": covered, "floor": floor,
+            "skipped": skipped, "decodedBytes": decoded,
+            "generations": chain}
+
+
+def load_snapshot(log_path: str):
+    """Load the full committed snapshot view of one log, verified.
+
+    Returns ``(ColumnarEvents, covered_bytes)`` or None (caller falls
+    back to the JSON parse). Multi-generation chains merge in order
+    through the scan merger, archived generations read through via the
+    log bytes (``on_archived="parse"`` — serving never breaks on
+    archival), and retired generations are excluded — ``covered`` still
+    reports the full committed prefix, so incremental tail parses
+    resume at the right byte."""
+    from ...native import parse_events
+    from ..storage.jsonl import _LogScan
+
+    got = load_chain(log_path, on_archived="parse")
+    if got is None:
+        return None
+    pieces = got["pieces"]
+    only = [p for p in pieces if p[0] == "cols"]
+    if len(pieces) == 1 and len(only) == 1:
+        return only[0][1], got["covered"]
+    scan = _LogScan()
+    for piece in pieces:
+        if piece[0] == "cols":
+            cols = piece[1]
+        else:  # "gap": archived — re-parse its log byte range
+            entry = piece[1]
+            try:
+                with open(log_path, "rb") as f:
+                    f.seek(int(entry.get("start", 0)))
+                    raw = f.read(int(entry.get("end", 0))
+                                 - int(entry.get("start", 0)))
+            except OSError:
+                return None
+            cols = parse_events(raw)
+        if scan.cols is None:
+            scan.cols = cols
+            scan._merge_tombstones(scan.tombstones, cols)
+        else:
+            scan._extend(cols)
+    if scan.cols is None:
+        scan.cols = parse_events(b"")
+    return scan.cols, got["covered"]
+
+
+# ---------------------------------------------------------------------------
+# tiered retention: retired/ tier + cold archive source
+# ---------------------------------------------------------------------------
+
+def retention_ttl_us() -> Optional[int]:
+    """The ``PIO_EVENT_RETENTION`` TTL in microseconds, or None when
+    retention is off (unset/malformed — a typo must never expire
+    data)."""
+    from ...common import train_window
+
+    return train_window.parse_duration_us(
+        envknobs.env_str("PIO_EVENT_RETENTION", ""))
+
+
+def _retirable(entry: dict, cutoff_us: int) -> bool:
+    """A generation may retire only when EVERY row in it is provably
+    expired: bounded (non-legacy) metadata, no untimed rows (an absent
+    eventTime means "now" — never expired), and its newest timed row
+    older than the cutoff."""
+    if entry.get("legacy"):
+        return False
+    if int(entry.get("untimedRows") or 0) != 0:
+        return False
+    hi = entry.get("maxEventUs")
+    if hi is None:
+        # no timed rows AND no untimed rows: an empty generation —
+        # safe to retire (nothing to lose)
+        return int(entry.get("events") or 0) == 0
+    return int(hi) < cutoff_us
+
+
+def _sweep_retired(dirpath: str, chain: list) -> int:
+    """Move every tier=retired entry's snapshot file that still sits in
+    the hot directory into ``retired/`` (quarantine-style: renamed,
+    never unlinked). Idempotent — the convergence half of
+    :func:`retire_expired`, re-run after any crash."""
+    moved = 0
+    rdir = os.path.join(dirpath, RETIRED_DIR)
+    for entry in chain:
+        if entry.get("tier") != "retired" or not entry.get("file"):
+            continue
+        src = os.path.join(dirpath, entry["file"])
+        if not os.path.exists(src):
+            continue
+        os.makedirs(rdir, exist_ok=True)
+        try:
+            os.replace(src, os.path.join(rdir, entry["file"]))
+            moved += 1
+        except OSError:  # pragma: no cover — racing sweep is fine
+            continue
+    if moved:
+        _fsync_dir(rdir)
+        _fsync_dir(dirpath)
+    return moved
+
+
+def retire_expired(log_path: str, ttl_us: Optional[int] = None,
+                   now_us: Optional[int] = None) -> Optional[dict]:
+    """Move fully-expired generations of one log to the retired tier.
+
+    TTL comes from ``ttl_us`` or the ``PIO_EVENT_RETENTION`` knob; with
+    neither set this only runs the convergence sweep (finishing any
+    crashed earlier pass). Only a contiguous PREFIX of the chain ever
+    retires: a retired generation's tombstones and duplicate ids stop
+    being replayed, which is exactly correct when no earlier live rows
+    remain for them to act on — an expired generation sitting behind a
+    live one keeps serving until the prefix catches up.
+
+    Commit protocol (the compaction discipline): the manifest marking
+    the entries ``tier="retired"`` is shadow-written, fsynced and
+    atomically renamed — the COMMIT record (``retire.rename`` is the
+    crash point just before it lands). Only after the commit do the
+    snapshot files move into ``retired/`` (never unlinked in place);
+    a crash between commit and move leaves strays the next pass
+    sweeps. Readers exclude retired entries by tier, and JSON fallback
+    parses start at :func:`parse_floor` — the log's own bytes are NOT
+    rewritten (append handles stay valid), so retirement reclaims the
+    decoded view, not the raw JSONL.
+
+    Returns ``{"retired", "generations", "floor", "swept"}`` or None
+    (no manifest)."""
+    manifest = _read_manifest(log_path)
+    if manifest is None:
+        return None
+    dirpath = os.path.dirname(log_path) or "."
+    chain = [dict(e) for e in _generations(manifest)]
+    if ttl_us is None:
+        ttl_us = retention_ttl_us()
+    newly: list = []
+    if ttl_us is not None:
+        now = now_us if now_us is not None else int(
+            _dt.datetime.now(_dt.timezone.utc).timestamp() * 1e6)
+        cutoff = now - ttl_us
+        for entry in chain:
+            if entry.get("tier") == "retired":
+                continue  # already-retired prefix
+            if entry.get("tier") != "archived" \
+                    and _retirable(entry, cutoff):
+                newly.append(entry)
+                continue
+            break  # first live generation ends the retirable prefix
+    if newly:
+        stamp = _dt.datetime.now(_dt.timezone.utc).isoformat()
+        for entry in newly:
+            entry["tier"] = "retired"
+            entry["retiredAt"] = stamp
+        committed = dict(manifest)
+        committed["generations"] = chain
+        mtmp = _manifest_path(log_path) + ".tmp"
+        with open(mtmp, "w") as f:
+            json.dump(committed, f)
+            f.flush()
+            os.fsync(f.fileno())
+        fault_point("retire.rename")
+        os.replace(mtmp, _manifest_path(log_path))
+        _fsync_dir(dirpath)
+        _M_RETIRED.inc(len(newly))
+        log.info("retired %d generation(s) of %s (event-time TTL)",
+                 len(newly), log_path)
+    swept = _sweep_retired(dirpath, chain)
+    return {"retired": len(newly),
+            "generations": [int(e.get("generation", 0)) for e in newly],
+            "floor": parse_floor(log_path), "swept": swept}
+
+
+def _archive_models(storage=None):
+    """(Models DAO on the cold source, source name). The source comes
+    from ``PIO_EVENT_ARCHIVE_SOURCE`` and resolves through the storage
+    registry — any configured backend (localfs/s3/hdfs) can be the
+    cold tier."""
+    source = envknobs.env_str("PIO_EVENT_ARCHIVE_SOURCE", "",
+                              lower=False)
+    if not source:
+        raise RuntimeError(
+            "PIO_EVENT_ARCHIVE_SOURCE is not set: name the storage "
+            "source (PIO_STORAGE_SOURCES_<NAME>_*) archived event-log "
+            "generations should stream to")
+    if storage is None:
+        from ..storage.registry import Storage
+
+        storage = Storage.instance()
+    return storage._client_for_source(source).models(
+        ARCHIVE_NAMESPACE), source
+
+
+def archive_generation(log_path: str, generation: int,
+                       storage=None) -> dict:
+    """Stream one sealed hot generation to the cold archive source.
+
+    Protocol — every step before the manifest commit leaves the hot
+    state untouched and serving:
+
+    1. read + CRC-verify the local snapshot (corruption is never
+       archived);
+    2. put the blob on the cold source (``archive.put``) under
+       ``<log basename>.g<N>``;
+    3. read it BACK and CRC-verify — the round-trip proof;
+    4. commit the manifest marking the entry ``tier="archived"``
+       (``archive.manifest`` precedes the rename);
+    5. only after the commit, unlink the local file (the archived copy
+       is now the record; a crash before this leaves a stray the next
+       call or compaction gc converges).
+
+    Returns the updated entry. Raises on an unknown/retired
+    generation, a missing archive source, or any verification
+    failure."""
+    from ..storage import base as storage_base
+
+    manifest = _read_manifest(log_path)
+    if manifest is None:
+        raise ValueError(f"no committed manifest for {log_path!r}")
+    dirpath = os.path.dirname(log_path) or "."
+    chain = [dict(e) for e in _generations(manifest)]
+    entry = next((e for e in chain
+                  if int(e.get("generation", -1)) == int(generation)),
+                 None)
+    if entry is None:
+        raise ValueError(
+            f"{log_path!r} has no generation {generation}")
+    snap_path = os.path.join(dirpath, entry.get("file") or "")
+    if entry.get("tier") == "retired":
+        raise ValueError(
+            f"generation {generation} of {log_path!r} is retired; "
+            "only hot generations archive")
+    models, source = _archive_models(storage)
+    blob_id = f"{os.path.basename(log_path)}.g{int(generation)}"
+    if entry.get("tier") == "archived":
+        # converge a crashed earlier run: the commit landed, the local
+        # unlink may not have
+        try:
+            os.remove(snap_path)
+        except OSError:
+            pass
+        return entry
+    with open(snap_path, "rb") as f:
+        blob = f.read()
+    if zlib.crc32(blob) != entry.get("crc32"):
+        raise RuntimeError(
+            f"generation {generation} of {log_path!r} fails CRC "
+            "locally; refusing to archive a corrupt snapshot (run "
+            "`pio eventlog scrub`)")
+    fault_point("archive.put")
+    models.insert(storage_base.Model(id=blob_id, models=blob))
+    got = models.get(blob_id)
+    if got is None or zlib.crc32(got.models) != entry.get("crc32"):
+        raise RuntimeError(
+            f"round-trip verification failed archiving generation "
+            f"{generation} of {log_path!r} to source {source!r}; "
+            "the hot copy remains authoritative")
+    entry["tier"] = "archived"
+    entry["archive"] = {
+        "source": source, "id": blob_id,
+        "archivedAt": _dt.datetime.now(_dt.timezone.utc).isoformat(),
+    }
+    committed = dict(manifest)
+    committed["generations"] = chain
+    mtmp = _manifest_path(log_path) + ".tmp"
+    with open(mtmp, "w") as f:
+        json.dump(committed, f)
+        f.flush()
+        os.fsync(f.fileno())
+    fault_point("archive.manifest")
+    os.replace(mtmp, _manifest_path(log_path))
+    _fsync_dir(dirpath)
+    try:
+        os.remove(snap_path)
+    except OSError:  # pragma: no cover — gc converges later
+        pass
+    _M_ARCHIVED.inc()
+    log.info("archived generation %d of %s to source %s", generation,
+             log_path, source)
+    return entry
+
+
+def restore_generation(log_path: str, generation: int,
+                       storage=None) -> dict:
+    """Fetch one archived generation back to the hot tier, verified.
+
+    The blob is CRC-checked against the manifest entry (the archived
+    copy must be checksum-identical to what left), shadow-written +
+    fsynced + atomically renamed into the hot directory FIRST, and only
+    then does the manifest commit flip the entry back to
+    ``tier="hot"`` — a crash in between leaves a stray file the next
+    restore (or compaction gc) handles, never a manifest pointing at
+    nothing."""
+    manifest = _read_manifest(log_path)
+    if manifest is None:
+        raise ValueError(f"no committed manifest for {log_path!r}")
+    dirpath = os.path.dirname(log_path) or "."
+    chain = [dict(e) for e in _generations(manifest)]
+    entry = next((e for e in chain
+                  if int(e.get("generation", -1)) == int(generation)),
+                 None)
+    if entry is None:
+        raise ValueError(
+            f"{log_path!r} has no generation {generation}")
+    if entry.get("tier") != "archived":
+        return entry  # already hot (converged) or retired (no-op)
+    models, _source = _archive_models(storage)
+    blob_id = (entry.get("archive") or {}).get("id") or (
+        f"{os.path.basename(log_path)}.g{int(generation)}")
+    got = models.get(blob_id)
+    if got is None:
+        raise RuntimeError(
+            f"archived blob {blob_id!r} for generation {generation} of "
+            f"{log_path!r} is missing from the archive source")
+    if zlib.crc32(got.models) != entry.get("crc32"):
+        raise RuntimeError(
+            f"archived blob {blob_id!r} fails CRC against the manifest "
+            f"for generation {generation} of {log_path!r}; refusing to "
+            "restore a corrupt copy")
+    snap_path = os.path.join(dirpath, entry.get("file") or "")
+    tmp = snap_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(got.models)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, snap_path)
+    _fsync_dir(dirpath)
+    entry["tier"] = "hot"
+    entry.pop("archive", None)
+    entry["restoredAt"] = _dt.datetime.now(
+        _dt.timezone.utc).isoformat()
+    committed = dict(manifest)
+    committed["generations"] = chain
+    _commit_manifest(log_path, committed)
+    _M_RESTORED.inc()
+    log.info("restored generation %d of %s from the archive source",
+             generation, log_path)
+    return entry
 
 
 def remove_artifacts(log_path: str) -> None:
@@ -545,19 +1197,27 @@ def remove_artifacts(log_path: str) -> None:
     and app-data deletion must not silently retain it on disk."""
     dirpath = os.path.dirname(log_path) or "."
     base = os.path.basename(log_path)
-    try:
-        names = os.listdir(dirpath)
-    except OSError:
-        return
-    for name in names:
-        if (name == base + MANIFEST_SUFFIX
-                or (name.startswith(base + ".g")
-                    and (name.endswith(".colseg")
-                         or name.endswith(".tmp")))):
-            try:
-                os.remove(os.path.join(dirpath, name))
-            except OSError:
-                pass
+
+    def sweep(d: str) -> None:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return
+        for name in names:
+            if (name == base + MANIFEST_SUFFIX
+                    or (name.startswith(base + ".g")
+                        and (name.endswith(".colseg")
+                             or name.endswith(".tmp")))):
+                try:
+                    os.remove(os.path.join(d, name))
+                except OSError:
+                    pass
+
+    sweep(dirpath)
+    # retired-tier copies are full columnar data too: app deletion must
+    # not silently retain them (archived blobs live on the cold source
+    # and are the operator's to purge — `pio eventlog` names them)
+    sweep(os.path.join(dirpath, RETIRED_DIR))
 
 
 def scrub_log_dir(dirpath: str) -> dict:
@@ -619,6 +1279,19 @@ def partition_health(events_dir: str) -> dict:
             size = os.path.getsize(path)
         except OSError:
             size = 0
+        gens = []
+        if manifest is not None:
+            for e in _generations(manifest):
+                gens.append({
+                    "generation": e.get("generation"),
+                    "tier": e.get("tier", "hot"),
+                    "bytes": (int(e.get("end", 0))
+                              - int(e.get("start", 0))),
+                    "events": e.get("events"),
+                    "minEventUs": e.get("minEventUs"),
+                    "maxEventUs": e.get("maxEventUs"),
+                    "legacy": bool(e.get("legacy")),
+                })
         out["logs"].append({
             "log": name,
             "partition": partition,
@@ -627,7 +1300,16 @@ def partition_health(events_dir: str) -> dict:
             "lastCompaction": (manifest or {}).get("compactedAt"),
             "compactedEvents": (manifest or {}).get("events"),
             "compactedBytes": (manifest or {}).get("covered"),
+            "generations": gens,
+            "retiredBytes": sum(g["bytes"] for g in gens
+                                if g["tier"] == "retired"),
         })
+    out["retiredGenerations"] = sum(
+        1 for row in out["logs"] for g in row["generations"]
+        if g["tier"] == "retired")
+    out["archivedGenerations"] = sum(
+        1 for row in out["logs"] for g in row["generations"]
+        if g["tier"] == "archived")
     return out
 
 

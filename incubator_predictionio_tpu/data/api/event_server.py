@@ -253,6 +253,14 @@ class EventServer:
                         event_log.compact_log,
                         os.path.join(log_dir, name),
                         self._compact_min_bytes)
+                    # retention rides the compaction cadence: with
+                    # PIO_EVENT_RETENTION set this tombstones fully-
+                    # expired generations; without it, only the
+                    # convergence sweep runs (finishing a crashed
+                    # earlier retire pass)
+                    await asyncio.to_thread(
+                        event_log.retire_expired,
+                        os.path.join(log_dir, name))
             except Exception:  # noqa: BLE001 — compaction must not die
                 log.exception("background compaction pass failed")
 

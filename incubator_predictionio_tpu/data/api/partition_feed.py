@@ -79,6 +79,10 @@ _M_TAIL_BYTES = telemetry.registry().counter(
     "pio_train_feed_tail_bytes_total",
     "Feed bytes JSON-parsed past the snapshot generation (uncovered "
     "tails)").labels()
+_M_WINDOW_ROWS = telemetry.registry().counter(
+    "pio_train_window_rows_filtered_total",
+    "Rows dropped by the event-time window's row-wise filter in "
+    "boundary generations and uncovered tails").labels()
 
 
 def assigned_shards(events_dir: str, app_id: int,
@@ -113,10 +117,17 @@ class FeedShard:
     tail_bytes: int = 0
 
 
-def scan_shard(path: str) -> FeedShard:
-    """Scan ONE shard the feed way: colseg snapshot prefix + tail-only
-    JSON parse (``jsonl.scan_log_file``)."""
-    scan, snap_b, tail_b = scan_log_file(path)
+def scan_shard(path: str, start_us: Optional[int] = None,
+               until_us: Optional[int] = None) -> FeedShard:
+    """Scan ONE shard the feed way: colseg generations + tail-only
+    JSON parse (``jsonl.scan_log_file``). With an event-time window,
+    generations the manifest proves disjoint are skipped whole — each
+    gang worker skips its OWN shards' cold generations without ever
+    decoding them. ``tombstone_ids`` stays the shard's REAL deletes
+    (including ones replayed from skipped generations) — the id-global
+    exchange payload; keep-last kills from skipped generations are
+    shard-local and never gossip."""
+    scan, snap_b, tail_b = scan_log_file(path, start_us, until_us)
     _M_SHARDS.inc()
     if snap_b:
         _M_SNAP_BYTES.inc(snap_b)
@@ -209,12 +220,16 @@ class PartitionFeed:
             table = cols.table(cols.TABLE_EVENT)
             codes = [table.index(n) for n in event_names if n in table]
             mask &= np.isin(cols.event, np.asarray(codes, np.int32))
-        if start_us is not None:
-            mask &= (cols.time_us != _TIME_ABSENT) & \
-                (cols.time_us >= start_us)
-        if until_us is not None:
-            mask &= (cols.time_us != _TIME_ABSENT) & \
-                (cols.time_us < until_us)
+        if start_us is not None or until_us is not None:
+            tmask = cols.time_us != _TIME_ABSENT
+            if start_us is not None:
+                tmask &= cols.time_us >= start_us
+            if until_us is not None:
+                tmask &= cols.time_us < until_us
+            dropped = int((mask & ~tmask).sum())
+            if dropped:
+                _M_WINDOW_ROWS.inc(dropped)
+            mask &= tmask
         rows = np.nonzero(mask)[0]
         return rows[np.argsort(cols.time_us[rows], kind="stable")]
 

@@ -83,6 +83,14 @@ class _LogScan:
         # time the tombstone was appended). Deletes are positional: only
         # records BEFORE the tombstone die; a later re-insert is live.
         self.tombstones: dict[str, int] = {}
+        # eventId string → kill position replayed from a generation a
+        # windowed read SKIPPED: the skipped generation holds a later
+        # duplicate of the id, so every earlier record must die exactly
+        # as keep-last dedup would have killed it in the full scan.
+        # Kept apart from `tombstones` because these are NOT deletes —
+        # the partition feed must not gossip them as id-global
+        # tombstones to other shards.
+        self.skip_kills: dict[str, int] = {}
         # Incrementally-built string → interned-code index per table (the
         # tables are append-only, so only new suffixes need indexing; the
         # same dicts serve point lookups AND _extend's code remapping).
@@ -117,6 +125,7 @@ class _LogScan:
             size = os.path.getsize(path)
         except OSError:
             self.size, self.cols, self.tombstones = 0, None, {}
+            self.skip_kills = {}
             self._reset_indexes()
             return
         if self.cols is not None and size == self.size:
@@ -141,6 +150,7 @@ class _LogScan:
             cols, covered = snap
             self.cols = cols
             self.tombstones = {}
+            self.skip_kills = {}
             self._merge_tombstones(self.tombstones, cols)
             self._reset_indexes()
             self.size = covered
@@ -151,10 +161,17 @@ class _LogScan:
                 self._extend(parse_events(tail))
                 self.size = size
             return
+        # retention-aware fallback: the JSON parse must start at the
+        # byte after the retired-generation prefix, or expired data
+        # would resurrect through the slow path
+        floor = _parse_floor(path)
         with open(path, "rb") as f:
+            if floor:
+                f.seek(floor)
             buf = f.read()
         self.cols = parse_events(buf)
         self.tombstones = {}
+        self.skip_kills = {}
         self._merge_tombstones(self.tombstones, self.cols)
         self._reset_indexes()
         self.size = size
@@ -169,6 +186,29 @@ class _LogScan:
             return event_log.load_snapshot(path)
         except Exception:  # noqa: BLE001 — cache layer, fall back
             return None
+
+    def _absorb(self, cols: ColumnarEvents) -> None:
+        """Fold one parsed/decoded piece onto the end of this scan."""
+        if self.cols is None:
+            self.cols = cols
+            self._merge_tombstones(self.tombstones, cols)
+        else:
+            self._extend(cols)
+
+    def _absorb_skip(self, entry: dict) -> None:
+        """Fold a generation a windowed read skipped WITHOUT decoding:
+        its manifest entry carries everything the effective view needs
+        from it — the tombstone ids it appended (real deletes, applied
+        at the current end so every earlier record of the id dies, just
+        as the full scan's positional replay would) and the explicit
+        ids it duplicates from earlier generations (keep-last dedup
+        kills, tracked separately so they never masquerade as
+        deletes)."""
+        n = len(self.cols) if self.cols is not None else 0
+        for tid in entry.get("tombstones") or ():
+            self.tombstones[tid] = max(self.tombstones.get(tid, -1), n)
+        for tid in entry.get("dupIds") or ():
+            self.skip_kills[tid] = max(self.skip_kills.get(tid, -1), n)
 
     def _extend(self, new: ColumnarEvents) -> None:
         old = self.cols
@@ -236,15 +276,21 @@ class _LogScan:
             keep[n - 1 - first_in_rev] = True
             keep |= ids < 0  # records without ids are never deduped
             mask &= keep
-        if self.tombstones:
+        if self.tombstones or self.skip_kills:
             index = self.eid_index()
             n_codes = len(cols.table(ColumnarEvents.TABLE_EVENT_ID))
             last_ts = np.full(n_codes + 1, -1, np.int64)
             # Snapshot: a concurrent delete_batch may grow the dict.
-            for tid, pos in list(self.tombstones.items()):
+            # skip_kills replay keep-last dedup against records that
+            # live only in window-skipped generations; positionally
+            # they kill exactly like tombstones, so one pass serves.
+            kills = list(self.tombstones.items())
+            if self.skip_kills:
+                kills += list(self.skip_kills.items())
+            for tid, pos in kills:
                 code = index.get(tid)
                 if code is not None:
-                    last_ts[code] = pos
+                    last_ts[code] = max(last_ts[code], pos)
             # A record dies iff some tombstone for its id was appended
             # after it (record index < tombstone position).
             safe_ids = np.where(ids >= 0, ids, n_codes)
@@ -253,25 +299,88 @@ class _LogScan:
         return mask
 
 
-def scan_log_file(path: str) -> tuple[_LogScan, int, int]:
+def _parse_floor(path: str) -> int:
+    """Byte offset JSON fallback parses must start at (after the
+    retired-generation prefix); 0 when the chain layer is unavailable.
+    Owned by event_log.py — this is only the safe accessor."""
+    try:
+        from ..api import event_log
+
+        return event_log.parse_floor(path)
+    except Exception:  # noqa: BLE001 — cache layer, fall back
+        return 0
+
+
+def _try_chain(path: str, start_us: Optional[int],
+               until_us: Optional[int]):
+    """Windowed chain load for the TRAIN read paths, or None (caller
+    falls back to the floor-aware JSON parse). An archived generation
+    the window actually needs is the one failure that must NOT degrade
+    silently: the named-generation error (or its restore-on-demand
+    flip) propagates to the trainer."""
+    try:
+        from ..api import event_log
+    except Exception:  # noqa: BLE001 — cache layer, fall back
+        return None
+    try:
+        return event_log.load_chain(
+            path, start_us, until_us,
+            on_archived=("raise" if (start_us is not None
+                                     or until_us is not None)
+                         else "parse"))
+    except event_log.ArchivedGenerationError:
+        raise
+    except Exception:  # noqa: BLE001 — cache layer, fall back
+        return None
+
+
+def _fold_chain(scan: _LogScan, path: str, chain: dict) -> int:
+    """Fold a ``load_chain`` result into ``scan``; returns the covered
+    byte count (where the tail parse resumes)."""
+    for piece in chain["pieces"]:
+        kind = piece[0]
+        if kind == "cols":
+            scan._absorb(piece[1])
+        elif kind == "skip":
+            scan._absorb_skip(piece[1])
+        else:  # "gap": archived generation — re-parse its log bytes
+            entry = piece[1]
+            start = int(entry.get("start", 0))
+            try:
+                with open(path, "rb") as f:
+                    f.seek(start)
+                    raw = f.read(int(entry.get("end", 0)) - start)
+            except OSError:
+                raw = b""
+            scan._absorb(parse_events(raw))
+    return int(chain["covered"])
+
+
+def scan_log_file(path: str, start_us: Optional[int] = None,
+                  until_us: Optional[int] = None
+                  ) -> tuple[_LogScan, int, int]:
     """One-shot scan of a single log shard — the partition-feed read
     primitive (data/api/partition_feed.py): the committed colseg
-    snapshot covers its prefix with ZERO JSON parsing and only the
-    uncovered tail (bytes appended past the snapshot generation) is
-    decoded. Returns ``(scan, snapshot_bytes, tail_bytes)`` where the
-    byte split is the feed-path accounting the A/B bench and the
-    telemetry counters report. Unlike the cached ``_scan`` registry
-    this builds fresh state per call: training reads are episodic and
-    the caller (one gang worker per shard set) owns the lifetime."""
+    generations cover their prefix with ZERO JSON parsing and only the
+    uncovered tail (bytes appended past the newest generation) is
+    decoded. With an event-time window ``[start_us, until_us)``,
+    generations the manifest proves disjoint are skipped whole — zero
+    bytes read, zero decoded — and their tombstone/duplicate metadata
+    replayed, so the scan (after the caller's row-wise time filter)
+    stays bit-identical to a filtered full scan. Returns
+    ``(scan, snapshot_bytes, tail_bytes)`` where the byte split is the
+    feed-path accounting the A/B bench and the telemetry counters
+    report. Unlike the cached ``_scan`` registry this builds fresh
+    state per call: training reads are episodic and the caller (one
+    gang worker per shard set) owns the lifetime."""
     scan = _LogScan()
-    snap = _LogScan._try_snapshot(path)
     snapshot_bytes = tail_bytes = 0
-    if snap is not None:
-        cols, covered = snap
-        scan.cols = cols
-        scan._merge_tombstones(scan.tombstones, cols)
-        scan.size = covered
-        snapshot_bytes = covered
+    chain = _try_chain(path, start_us, until_us)
+    if chain is not None:
+        scan.size = _fold_chain(scan, path, chain)
+        snapshot_bytes = scan.size
+    else:
+        scan.size = _parse_floor(path)
     try:
         size = os.path.getsize(path)
     except OSError:
@@ -282,12 +391,7 @@ def scan_log_file(path: str) -> tuple[_LogScan, int, int]:
             tail = f.read()
         cut = tail.rfind(b"\n") + 1  # complete lines only
         if cut:
-            new = parse_events(tail[:cut])
-            if scan.cols is None:
-                scan.cols = new
-                scan._merge_tombstones(scan.tombstones, new)
-            else:
-                scan._extend(new)
+            scan._absorb(parse_events(tail[:cut]))
             scan.size += cut
             tail_bytes = cut
     if scan.cols is None:
@@ -468,6 +572,11 @@ class JSONLEvents(base.LEvents):
         self._partition = int(part) if part.isdigit() else None
         # merged-view cache: (app, chan) -> ((paths, sizes), _LogScan)
         self._merged: dict = {}
+        # one-shot windowed views: (app, chan) -> (cache key, _LogScan).
+        # Kept OUT of the incremental caches above — those must stay
+        # the full view; a windowed build skips whole generations and
+        # can never be extended into an unwindowed answer.
+        self._windowed: dict = {}
 
     # -- paths ------------------------------------------------------------
     def _base_path(self, app_id: int, channel_id: Optional[int]) -> str:
@@ -502,13 +611,25 @@ class JSONLEvents(base.LEvents):
                 state = self._tables[path] = _TableState()
             return state
 
-    def _scan(self, app_id: int, channel_id: Optional[int]) -> _LogScan:
+    def _scan(self, app_id: int, channel_id: Optional[int],
+              window: Optional[tuple] = None) -> _LogScan:
         path = self._path(app_id, channel_id)
         read_paths = self._read_paths(app_id, channel_id)
         if read_paths and read_paths != [path]:
             # other shards exist (multi-worker layout, or an operator
             # reading a partitioned dir): serve the merged view
-            return self._merged_scan(app_id, channel_id, read_paths)
+            return self._merged_scan(app_id, channel_id, read_paths,
+                                     window)
+        if window is not None:
+            with self._meta:
+                cached = self._scans.get(path)
+            if cached is None or cached.cols is None:
+                # cold windowed read: a one-shot chain load that skips
+                # out-of-window generations outright. A WARM cache is
+                # already decoded — the row filter is free there, so it
+                # is served below as usual.
+                return self._windowed_scan((app_id, channel_id), [path],
+                                           window)
         state = self._state(path)
         with self._meta:
             scan = self._scans.setdefault(path, _LogScan())
@@ -516,8 +637,66 @@ class JSONLEvents(base.LEvents):
             scan.refresh(path)
             return scan
 
+    def _windowed_scan(self, key: tuple, paths: list,
+                       window: tuple) -> _LogScan:
+        """One-shot windowed view over a log's shards: per shard, the
+        generation chain loads WITH the event-time window so disjoint
+        generations are skipped whole (zero decode) — only boundary
+        generations and the uncovered tails are materialized, and the
+        caller's row-wise time filter does the rest. Cached per
+        (app, channel) keyed on (paths, window, sizes): training reads
+        are episodic, one slot suffices, and any append invalidates.
+        Multi-shard delete semantics match the merged view
+        (id-global)."""
+        sizes = []
+        for p in paths:
+            try:
+                sizes.append(os.path.getsize(p))
+            except OSError:
+                sizes.append(0)
+        ck = (tuple(paths), tuple(window), tuple(sizes))
+        with self._meta:
+            got = self._windowed.get(key)
+            if got is not None and got[0] == ck:
+                return got[1]
+        start_us, until_us = window
+        scan = _LogScan()
+        consumed = 0
+        for p in paths:
+            chain = _try_chain(p, start_us, until_us)
+            if chain is not None:
+                start = _fold_chain(scan, p, chain)
+            else:
+                start = _parse_floor(p)
+            try:
+                with open(p, "rb") as f:
+                    f.seek(start)
+                    buf = f.read()
+            except OSError:
+                buf = b""
+            cut = buf.rfind(b"\n") + 1
+            if cut:
+                scan._absorb(parse_events(buf[:cut]))
+            consumed += start + cut
+        if scan.cols is None:
+            scan.cols = parse_events(b"")
+        scan.size = consumed
+        if len(paths) > 1:
+            # id-global deletes across shards, exactly like the merged
+            # view: every tombstone (including those replayed from
+            # skipped generations) pins to the end of this view
+            n = len(scan.cols)
+            for tid in scan.cols.tombstones:
+                scan.tombstones[tid] = n
+            for tid in list(scan.tombstones):
+                scan.tombstones[tid] = n
+        with self._meta:
+            self._windowed[key] = (ck, scan)
+        return scan
+
     def _merged_scan(self, app_id: int, channel_id: Optional[int],
-                     paths: list) -> _LogScan:
+                     paths: list, window: Optional[tuple] = None
+                     ) -> _LogScan:
         """Merged view over every shard of one log, extended
         incrementally.
 
@@ -537,6 +716,16 @@ class JSONLEvents(base.LEvents):
         deleted explicit eventId is NOT supported here — the delete
         wins. Single-log deployments keep exact positional semantics."""
         key = (app_id, channel_id)
+        if window is not None:
+            with self._meta:
+                probe = self._merged.get(key)
+                warm = (probe is not None
+                        and probe.get("parsed") is not None
+                        and probe["paths"] == tuple(paths))
+            if not warm:
+                # cold windowed read: build the one-shot skipping view
+                # instead of decoding every generation into the cache
+                return self._windowed_scan(key, paths, window)
         with self._meta:
             entry = self._merged.get(key)
             if entry is not None and entry["paths"] != tuple(paths):
@@ -588,11 +777,14 @@ class JSONLEvents(base.LEvents):
                         scan._extend(cols)
 
                 for p in paths:
-                    start = 0
                     snap = _LogScan._try_snapshot(p)
                     if snap is not None:
                         snap_cols, start = snap[0], snap[1]
                         merge_piece(snap_cols)
+                    else:
+                        # no usable snapshot: JSON-parse, but never
+                        # below the retired-generation floor
+                        start = _parse_floor(p)
                     try:
                         with open(p, "rb") as f:
                             f.seek(start)
@@ -911,8 +1103,16 @@ class JSONLEvents(base.LEvents):
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
     ) -> tuple[ColumnarEvents, np.ndarray]:
-        """(columns, selected-row indices) for the training read path."""
-        scan = self._scan(app_id, channel_id)
+        """(columns, selected-row indices) for the training read path.
+
+        A time-bounded request threads its window down to the scan
+        layer, where a cold read skips whole out-of-window generations
+        by manifest bounds (zero decode); the row filter below then
+        makes the result bit-identical to filtering the full view."""
+        s_us, u_us = _to_us(start_time), _to_us(until_time)
+        window = ((s_us, u_us)
+                  if s_us is not None or u_us is not None else None)
+        scan = self._scan(app_id, channel_id, window)
         cols = scan.cols
         if cols is None:
             empty = parse_events(b"")
@@ -922,7 +1122,6 @@ class JSONLEvents(base.LEvents):
             table = cols.table(ColumnarEvents.TABLE_EVENT)
             codes = [table.index(n) for n in event_names if n in table]
             mask = mask & np.isin(cols.event, np.asarray(codes, np.int32))
-        s_us, u_us = _to_us(start_time), _to_us(until_time)
         if s_us is not None:
             mask = mask & (cols.time_us != _TIME_ABSENT) & (cols.time_us >= s_us)
         if u_us is not None:

@@ -91,7 +91,18 @@ class PEventStore:
         (workflow/input_pipeline.prefetch) — decode of chunk N+1
         overlaps featurize/upload of chunk N instead of the whole scan
         materializing first. Concatenating the chunks reproduces
-        find_batch exactly."""
+        find_batch exactly.
+
+        A training read that passes no time range fills it from the
+        ambient training window (``pio train --window`` /
+        ``PIO_TRAIN_WINDOW``); explicit bounds are never
+        overridden."""
+        from ...common import train_window
+
+        start, until = train_window.apply_window(
+            kwargs.get("start_time"), kwargs.get("until_time"))
+        if start is not None or until is not None:
+            kwargs = dict(kwargs, start_time=start, until_time=until)
         events = PEventStore.find(
             app_name, event_names=event_names, storage=storage, **kwargs
         )
@@ -170,7 +181,15 @@ class PEventStore:
         ``event_default_ratings`` assigns a rating to events of a given
         name when properties carry none (e.g. the quickstart template's
         implicit "buy" → 4.0).
+
+        When neither ``start_time`` nor ``until_time`` is given the
+        ambient training window (``pio train --window`` /
+        ``PIO_TRAIN_WINDOW``) applies; explicit bounds win.
         """
+        from ...common import train_window
+
+        start_time, until_time = train_window.apply_window(
+            start_time, until_time)
         s, app_id, channel_id = _resolve_app(app_name, storage, channel_name)
         pe = s.get_p_events()
         if hasattr(pe, "scan_columnar"):

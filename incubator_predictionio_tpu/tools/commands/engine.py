@@ -88,6 +88,12 @@ def train_cmd(args: list[str]) -> int:
                         "reads the merged view (the pre-partition-feed "
                         "behavior; default $PIO_TRAIN_FEED, else "
                         "'partition' for gangs / 'merged' in-process)")
+    p.add_argument("--window", default=None, metavar="DUR",
+                   help="train on events from the last DUR only "
+                        "(90d/12h/30m/45s): windowed reads skip whole "
+                        "sealed log generations by their manifest "
+                        "event-time bounds without decoding them "
+                        "(default $PIO_TRAIN_WINDOW)")
     ns = p.parse_args(args)
 
     from ...common import envknobs
@@ -99,6 +105,20 @@ def train_cmd(args: list[str]) -> int:
         # explicit flag wins over env, for this process AND (via
         # inherited env) every gang worker it spawns
         os.environ["PIO_TRAIN_FEED"] = ns.feed
+    if ns.window:
+        from ...common import train_window
+
+        dur = train_window.parse_duration_us(ns.window)
+        if dur is None:
+            print(f"[error] --window {ns.window!r}: expected a duration "
+                  "like 90d, 12h, 30m, or 45s", file=sys.stderr)
+            return 1
+        os.environ["PIO_TRAIN_WINDOW"] = ns.window
+        # Resolve the duration to an absolute bound ONCE here so every
+        # gang worker inherits the identical microsecond cut instead of
+        # re-anchoring at its own clock.
+        os.environ.setdefault("PIO_TRAIN_WINDOW_START_US",
+                              str(train_window.now_us() - dur))
     if num_workers > 1 and not supervised_worker:
         # gang default: the partitioned event log IS the training data
         # plane (workflow/train_feed.py); merged stays one flag away

@@ -102,6 +102,14 @@ def status_cmd(args: list[str]) -> int:
             print(f"[info] Event log: {len(health['logs'])} log file(s) "
                   f"in {log_dir}")
             _print_partition_health(health, log_dir)
+            ttl = envknobs.env_str("PIO_EVENT_RETENTION", "")
+            print("[info] Retention: "
+                  + (f"event-time TTL {ttl}" if ttl
+                     else "off (PIO_EVENT_RETENTION unset)")
+                  + f"; {health.get('retiredGenerations', 0)} retired / "
+                  f"{health.get('archivedGenerations', 0)} archived "
+                  "generation(s) — `pio eventlog status` for per-"
+                  "generation bounds")
     # Online fold-in cursors: where each app's streaming-learning
     # tailer stands, with the freshness-lag warn-marker.
     _print_foldin_cursors(s)
@@ -464,6 +472,28 @@ def eventlog_cmd(args: list[str]) -> int:
         "fence", help="force-claim a partition lease past a held flock "
                       "(ONLY when the owner is wedged/unreachable)")
     p_fence.add_argument("--partition", type=int, required=True)
+    p_retire = sub.add_parser(
+        "retire", help="move fully-expired generations (event-time "
+                       "TTL) to the retired/ tier; without --ttl or "
+                       "$PIO_EVENT_RETENTION only the convergence "
+                       "sweep runs (finishes a crashed earlier pass)")
+    p_retire.add_argument("--ttl", default=None, metavar="DUR",
+                          help="retention TTL (90d/12h/30m/45s); "
+                               "default $PIO_EVENT_RETENTION")
+    p_archive = sub.add_parser(
+        "archive", help="stream one sealed generation to the cold "
+                        "archive source named by "
+                        "$PIO_EVENT_ARCHIVE_SOURCE (round-trip "
+                        "CRC-verified before the local copy goes)")
+    p_archive.add_argument("--log", required=True, metavar="NAME",
+                           help="log file name as printed by "
+                                "`pio eventlog status`")
+    p_archive.add_argument("--generation", type=int, required=True)
+    p_restore = sub.add_parser(
+        "restore", help="fetch an archived generation back to the hot "
+                        "tier (checksum-verified against the manifest)")
+    p_restore.add_argument("--log", required=True, metavar="NAME")
+    p_restore.add_argument("--generation", type=int, required=True)
     p_tail = sub.add_parser(
         "tail", help="read events past a durable byte cursor (the "
                      "online fold-in's read primitive, as a CLI): "
@@ -523,8 +553,56 @@ def eventlog_cmd(args: list[str]) -> int:
                  "be refused on its next write)" if lease.forced else ""))
         lease.release()
         return 0
+    if ns.sub == "retire":
+        ttl_us = None
+        if ns.ttl:
+            from ...common import train_window
+
+            ttl_us = train_window.parse_duration_us(ns.ttl)
+            if ttl_us is None:
+                print(f"[error] --ttl {ns.ttl!r}: expected a duration "
+                      "like 90d, 12h, 30m, or 45s", file=sys.stderr)
+                return 1
+        elif event_log.retention_ttl_us() is None:
+            print("[info] No TTL (--ttl / $PIO_EVENT_RETENTION unset): "
+                  "running the convergence sweep only")
+        retired = swept = 0
+        for name in sorted(os.listdir(log_dir)):
+            if not name.endswith(".jsonl"):
+                continue
+            r = event_log.retire_expired(
+                os.path.join(log_dir, name), ttl_us=ttl_us)
+            if r is None:
+                continue
+            if r["retired"] or r["swept"]:
+                print(f"[info] {name}: {r['retired']} generation(s) "
+                      f"retired {r['generations']}, {r['swept']} "
+                      f"file(s) swept, parse floor {r['floor']}")
+            retired += r["retired"]
+            swept += r["swept"]
+        print(f"[info] Retired {retired} generation(s) ({swept} "
+              f"snapshot file(s) swept to retired/) in {log_dir}")
+        return 0
+    if ns.sub in ("archive", "restore"):
+        path = os.path.join(log_dir, ns.log)
+        fn = (event_log.archive_generation if ns.sub == "archive"
+              else event_log.restore_generation)
+        try:
+            entry = fn(path, ns.generation, storage=s)
+        except Exception as e:  # noqa: BLE001 — operator-facing
+            print(f"[error] {ns.sub} failed: {e}", file=sys.stderr)
+            return 1
+        arch = entry.get("archive") or {}
+        print(f"[info] {ns.log} generation {ns.generation}: "
+              f"tier {entry.get('tier')}"
+              + (f" (source {arch.get('source')}, blob "
+                 f"{arch.get('id')})"
+                 if entry.get("tier") == "archived" else ""))
+        return 0
     # status
-    _print_partition_health(event_log.partition_health(log_dir), log_dir)
+    health = event_log.partition_health(log_dir)
+    _print_partition_health(health, log_dir)
+    _print_generation_tiers(health)
     return 0
 
 
@@ -655,6 +733,34 @@ def _print_partition_health(health: dict, log_dir: str) -> None:
         print(f"[warn]   {health['quarantinedFiles']} quarantined "
               f"file(s) in {os.path.join(log_dir, 'quarantine')} — "
               "corrupt segments kept for forensics")
+
+
+def _print_generation_tiers(health: dict) -> None:
+    """`pio eventlog status` detail rows: one line per sealed
+    generation with its event-time bounds, tier, and size — the
+    operator's view of what a windowed read can skip and what
+    retention may retire next. Unbounded legacy (v1) entries are
+    warn-marked: they predate time-bounded manifests, so windowed
+    reads always decode them and retention never retires them."""
+    import datetime as _dt
+
+    def day(us):
+        return _dt.datetime.fromtimestamp(
+            us / 1e6, _dt.timezone.utc).strftime("%Y-%m-%d")
+
+    for row in health["logs"]:
+        for g in row["generations"]:
+            if g["legacy"]:
+                print(f"[warn]     {row['log']} g{g['generation']}: "
+                      "UNBOUNDED (legacy v1 manifest — recompact after "
+                      "new appends to seal time-bounded generations)")
+                continue
+            span = ("no timed rows" if g["minEventUs"] is None
+                    else f"{day(g['minEventUs'])} .. "
+                         f"{day(g['maxEventUs'])}")
+            print(f"[info]     {row['log']} g{g['generation']}: "
+                  f"[{span}] tier={g['tier']}, {g['bytes']} byte(s), "
+                  f"{g['events']} event(s)")
 
 
 @verb("storageserver", "host this node's storage over HTTP (:7072)")

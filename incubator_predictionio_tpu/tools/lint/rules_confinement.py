@@ -148,12 +148,18 @@ def resilient_urlopen(project: Project) -> Iterable[Finding]:
 
 _WAL_SUFFIXES = (".wal", ".colseg", ".manifest")
 _WAL_ALLOWED = ("data/api/event_log.py", "data/api/ingest_wal.py")
+#: tiered-retention artifact names (the retired/ subdir and the cold
+#: archive namespace) — exact string constants only, so prose in
+#: docstrings never trips the rule; the tier lifecycle (retire sweep,
+#: archive round-trip CRC, restore commit order) lives in event_log.py
+_TIER_LITERALS = ("retired", "pio_eventlog_archive")
 
 
 @rule("wal-suffix-confinement",
       "only event_log.py/ingest_wal.py may open .wal/.colseg/.manifest "
-      "artifacts — touching them elsewhere forks segment lifecycle "
-      "(leases, quarantine, manifest commits)")
+      "artifacts or the retired/archive tier paths — touching them "
+      "elsewhere forks segment lifecycle (leases, quarantine, manifest "
+      "commits, tier moves)")
 def wal_suffix_confinement(project: Project) -> Iterable[Finding]:
     for sub in ("data/", "workflow/"):
         for m in project.modules(sub):
@@ -161,13 +167,20 @@ def wal_suffix_confinement(project: Project) -> Iterable[Finding]:
                 continue
             disp = project.display_path(m)
             for node in m.walk():
-                if (isinstance(node, ast.Constant)
-                        and isinstance(node.value, str)
-                        and node.value.endswith(_WAL_SUFFIXES)):
+                if not (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    continue
+                if node.value.endswith(_WAL_SUFFIXES):
                     yield Finding(
                         "wal-suffix-confinement", disp, node.lineno,
                         f"segment/manifest suffix {node.value!r} "
                         "referenced outside event_log.py/ingest_wal.py")
+                elif node.value in _TIER_LITERALS:
+                    yield Finding(
+                        "wal-suffix-confinement", disp, node.lineno,
+                        f"retention-tier artifact name {node.value!r} "
+                        "referenced outside event_log.py — retire/"
+                        "archive/restore only through its tier API")
 
 
 _COUNTERISH = ("count", "counter", "stat", "stats", "metric")

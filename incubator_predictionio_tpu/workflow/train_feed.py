@@ -132,16 +132,24 @@ def _allgather_payload(doc) -> list:
     ]
 
 
-def _scan_assigned(feed: "pfeed.PartitionFeed") -> list:
+def _scan_assigned(feed: "pfeed.PartitionFeed",
+                   start_us: Optional[int] = None,
+                   until_us: Optional[int] = None) -> list:
     """Scan this worker's shards, decode overlapped through the input
-    pipeline's prefetch workers (the native parse releases the GIL)."""
+    pipeline's prefetch workers (the native parse releases the GIL).
+    With an event-time window, each shard scan skips the generations
+    its manifest proves disjoint — the worker never decodes its own
+    cold shards."""
     from .input_pipeline import PipelineConfig, prefetch
+
+    def scan_one(p: str):
+        return pfeed.scan_shard(p, start_us, until_us)
 
     cfg = PipelineConfig.from_env()
     paths = feed.shard_list()
     if cfg.mode == "off" or len(paths) <= 1:
-        return [pfeed.scan_shard(p) for p in paths]
-    return list(prefetch(paths, pfeed.scan_shard,
+        return [scan_one(p) for p in paths]
+    return list(prefetch(paths, scan_one,
                          workers=cfg.workers,
                          lookahead=max(2, cfg.depth)))
 
@@ -153,21 +161,25 @@ def _resolve(app_name, storage, channel_name):
 
 
 def open_feed(app_name: str, storage=None,
-              channel_name: Optional[str] = None) -> tuple:
+              channel_name: Optional[str] = None,
+              start_us: Optional[int] = None,
+              until_us: Optional[int] = None) -> tuple:
     """Scan this worker's assigned shards ONCE and run the tombstone
     exchange: ``(feed, shards, global_tombstones)``. A template whose
     read needs BOTH the rating feed and a property aggregate (e.g.
     similar-product: view events + item categories) passes the result
     as ``feed_ctx`` to both calls so the shard decode and the
-    tombstone allgather are not paid twice. Collective: every gang
-    process must call this (and the subsequent extractions) in the
-    same order."""
+    tombstone allgather are not paid twice — such a SHARED context must
+    stay unwindowed (property replay needs full history; the rating
+    extraction's row filter still applies its window). Collective:
+    every gang process must call this (and the subsequent extractions)
+    in the same order."""
     s, app_id, channel_id = _resolve(app_name, storage, channel_name)
     le = s.get_l_events()
     worker, num_workers = feed_identity()
     feed = pfeed.PartitionFeed(le.events_dir, app_id, channel_id,
                                worker, num_workers)
-    shards = _scan_assigned(feed)
+    shards = _scan_assigned(feed, start_us, until_us)
     tombs = _allgather_payload(feed.local_tombstones(shards))
     return feed, shards, frozenset(t for part in tombs for t in part)
 
@@ -198,13 +210,26 @@ def partition_ratings(
     maps, the event multiset and the trained factors per id are what
     match). ``feed_ctx`` (an :func:`open_feed` result) shares one shard
     scan + tombstone exchange with other extractions of the same
-    read."""
+    read.
+
+    Windowing: an all-``None`` time range fills from the ambient
+    training window (``pio train --window`` / ``PIO_TRAIN_WINDOW`` —
+    ``common/train_window.py``), and when this call opens its OWN feed
+    the window threads down to the shard scans, where whole
+    out-of-window generations are skipped by manifest bounds. A shared
+    ``feed_ctx`` was scanned unwindowed, so there the window is
+    row-filter only — same result, no skip."""
+    from ..common import train_window
+
     worker, num_workers = feed_identity()
-    feed, shards, global_tombs = (
-        feed_ctx if feed_ctx is not None
-        else open_feed(app_name, storage, channel_name))
+    start_time, until_time = train_window.apply_window(start_time,
+                                                       until_time)
     s_us = pfeed.to_epoch_us(start_time)
     u_us = pfeed.to_epoch_us(until_time)
+    feed, shards, global_tombs = (
+        feed_ctx if feed_ctx is not None
+        else open_feed(app_name, storage, channel_name,
+                       start_us=s_us, until_us=u_us))
     user_ids: list = []
     item_ids: list = []
     u_index: dict = {}

@@ -1,0 +1,799 @@
+"""Event-time windowed reads + tiered log retention (ISSUE 18).
+
+Acceptance (data/api/event_log.py, data/storage/jsonl.py,
+data/api/partition_feed.py):
+- compaction stamps every sealed generation with event-time bounds
+  (manifest v2) while keeping the v1 top-level keys;
+- a windowed read skips whole generations by manifest bounds alone —
+  zero snapshot decode, skip counter bumped — and stays BIT-IDENTICAL
+  to row-filtering the full scan, including tombstones and keep-last
+  duplicate kills replayed from skipped generations;
+- the windowed gang feed (1/2/3 workers) unions to the merged-view
+  read under every window shape;
+- `retire_expired` moves only the provably-expired contiguous prefix
+  to the retired/ tier with the shadow-write -> fsync -> atomic-rename
+  commit discipline: killed (fail and REAL SIGKILL) at the
+  `retire.rename` fault point it leaves the prior state serving and a
+  rerun converges;
+- `archive_generation`/`restore_generation` round-trip a sealed
+  generation through the cold storage source checksum-verified, crash
+  at `archive.put`/`archive.manifest` leaves the hot copy
+  authoritative, and a windowed train needing an archived generation
+  fails with a named-generation error (or restores on demand);
+- legacy v1 manifests load unbounded: never window-skipped, never
+  retired, warned about in health;
+- `_gc_generations` keys on exact file names (g1 vs g11 near-miss).
+"""
+
+import datetime as dt
+import os
+import signal
+import subprocess
+import sys
+import zlib
+
+import numpy as np  # noqa: F401 — parity with sibling suites
+import pytest
+
+from incubator_predictionio_tpu.common import faultinject
+from incubator_predictionio_tpu.data.api import event_log
+from incubator_predictionio_tpu.data.api import partition_feed as pfeed
+from incubator_predictionio_tpu.data.storage.base import App
+from incubator_predictionio_tpu.data.storage.datamap import DataMap
+from incubator_predictionio_tpu.data.storage.event import Event
+from incubator_predictionio_tpu.data.storage.jsonl import JSONLEvents
+from incubator_predictionio_tpu.data.storage.registry import Storage
+from incubator_predictionio_tpu.data.store import p_event_store as pstore
+from incubator_predictionio_tpu.data.store.p_event_store import PEventStore
+
+pytestmark = [pytest.mark.partition, pytest.mark.chaos]
+
+APP = 1
+UTC = dt.timezone.utc
+
+JAN = dt.datetime(2026, 1, 10, tzinfo=UTC)
+MAR = dt.datetime(2026, 3, 10, tzinfo=UTC)
+MAY = dt.datetime(2026, 5, 10, tzinfo=UTC)
+JUN = dt.datetime(2026, 6, 20, tzinfo=UTC)
+
+Y25 = dt.datetime(2025, 1, 1, tzinfo=UTC)
+FEB1 = dt.datetime(2026, 2, 1, tzinfo=UTC)
+APR1 = dt.datetime(2026, 4, 1, tzinfo=UTC)
+JUN1 = dt.datetime(2026, 6, 1, tzinfo=UTC)
+MAR_MID = MAR + dt.timedelta(days=2)  # strictly inside the Mar span
+# the partitioned shards hold 20 events each (~1.8 days of spread), so
+# their straddle cut sits earlier to land inside every shard's Mar gen
+MAR_MID_FEED = MAR + dt.timedelta(days=1)
+
+
+def _us(d: dt.datetime) -> int:
+    return pfeed.to_epoch_us(d)
+
+
+def _at(base: dt.datetime, k: int) -> dt.datetime:
+    # deterministic spread over a few days inside the generation's month
+    return base + dt.timedelta(minutes=(k * 137) % (4 * 24 * 60))
+
+
+def _rate(user, item, rating, when, event="rate", eid=None):
+    return Event(event=event, entity_type="user", entity_id=str(user),
+                 target_entity_type="item", target_entity_id=str(item),
+                 properties=DataMap({"rating": float(rating)}
+                                    if rating is not None else {}),
+                 event_time=when, event_id=eid)
+
+
+@pytest.fixture(autouse=True)
+def _clean_knobs(monkeypatch):
+    for k in ("PIO_TRAIN_WINDOW", "PIO_TRAIN_WINDOW_START_US",
+              "PIO_TRAIN_WINDOW_UNTIL_US", "PIO_EVENT_RETENTION",
+              "PIO_EVENT_ARCHIVE_SOURCE", "PIO_EVENT_RESTORE_ON_DEMAND",
+              "PIO_FAULT_SPEC"):
+        monkeypatch.delenv(k, raising=False)
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _env(tmp_path) -> dict:
+    return {
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "JL",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+        "PIO_STORAGE_SOURCES_JL_TYPE": "JSONL",
+        "PIO_STORAGE_SOURCES_JL_PATH": str(tmp_path / "events"),
+        "PIO_STORAGE_SOURCES_COLD_TYPE": "LOCALFS",
+        "PIO_STORAGE_SOURCES_COLD_PATH": str(tmp_path / "cold"),
+    }
+
+
+def _fresh_storage(env: dict) -> Storage:
+    """A COLD read view: new Storage => new JSONL cache state, so
+    windowed requests route through the generation-skipping chain load
+    instead of row-filtering a warm decoded cache."""
+    s = Storage(env)
+    s.get_meta_data_apps().insert(App(id=APP, name="winapp"))
+    return s
+
+
+@pytest.fixture()
+def win_env(tmp_path):
+    return _env(tmp_path)
+
+
+def _seed_generations(env: dict) -> str:
+    """Three sealed generations (Jan/Mar/May 2026) + an uncompacted
+    June tail in ONE log. The May generation carries the two replay
+    hazards a skipped generation must still honor: a keep-last
+    re-insert of a Jan event id and a tombstone whose victim lives in
+    the Jan generation."""
+    s = _fresh_storage(env)
+    le = s.get_l_events()
+    log = os.path.join(le.events_dir, "events_1.jsonl")
+    jan = [_rate(k % 23, k % 17, 1 + k % 5, _at(JAN, k)) for k in range(40)]
+    jan.append(_rate("dupu", "dupi", 2, _at(JAN, 40), eid="dup-jan"))
+    jan.append(_rate("delu", "deli", 3, _at(JAN, 41), eid="del-jan"))
+    le.insert_batch(jan, APP)
+    assert event_log.compact_log(log)
+    le.insert_batch([_rate(k % 19, k % 13, 1 + k % 5, _at(MAR, k))
+                     for k in range(40)], APP)
+    assert event_log.compact_log(log)
+    may = [_rate(k % 21, k % 11, 1 + k % 5, _at(MAY, k)) for k in range(40)]
+    may.append(_rate("dupu", "dupi", 5, _at(MAY, 40), eid="dup-jan"))
+    le.insert_batch(may, APP)
+    le.delete_batch(["del-jan"], APP)
+    assert event_log.compact_log(log)
+    le.insert_batch([_rate(300 + j, 400 + j, 3, _at(JUN, j))
+                     for j in range(12)], APP)
+    return log
+
+
+def _row_triples(env, start=None, until=None):
+    """Reference triples via the ROW path: full decode + row-wise
+    filter (LEvents.find never threads a window into the chain load),
+    then the shared ratings_matrix extraction."""
+    s = _fresh_storage(env)
+    batch = PEventStore.find_batch(
+        "winapp", event_names=["rate"], storage=s,
+        start_time=start, until_time=until)
+    u, i, r, users, items = pstore.ratings_matrix(batch)
+    return [(users.inverse(int(a)), items.inverse(int(b)), float(c))
+            for a, b, c in zip(u, i, r)]
+
+
+def _fast_triples(env, start=None, until=None, storage=None):
+    """Triples via the columnar fast path on a COLD view — a windowed
+    request here goes through the generation-skipping chain load."""
+    s = storage if storage is not None else _fresh_storage(env)
+    u, i, r, users, items = PEventStore.find_ratings(
+        "winapp", event_names=["rate"], storage=s,
+        start_time=start, until_time=until)
+    return [(users.inverse(int(a)), items.inverse(int(b)), float(c))
+            for a, b, c in zip(u, i, r)]
+
+
+# ---------------------------------------------------------------------------
+# manifest v2: time-bounded generations
+# ---------------------------------------------------------------------------
+
+def test_compaction_stamps_event_time_bounds(win_env):
+    log = _seed_generations(win_env)
+    m = event_log._read_manifest(log)
+    assert m["version"] == event_log.MANIFEST_VERSION
+    gens = m["generations"]
+    assert [g["generation"] for g in gens] == [1, 2, 3]
+    months = (JAN, MAR, MAY)
+    for g, base in zip(gens, months):
+        assert g["tier"] == "hot" and not g.get("legacy")
+        assert g["untimedRows"] == 0 and g["dupComplete"] is True
+        lo, hi = g["minEventUs"], g["maxEventUs"]
+        assert _us(base) <= lo <= hi < _us(base + dt.timedelta(days=5))
+    # the skipped-generation replay metadata landed where it must
+    assert "dup-jan" in gens[2]["dupIds"]
+    assert "del-jan" in gens[2]["tombstones"]
+    assert gens[0]["dupIds"] == [] and gens[0]["tombstones"] == []
+    # v1 top-level keys still describe the newest generation (readers
+    # from before the chain format keep working)
+    assert m["generation"] == 3 and m["file"] == gens[-1]["file"]
+    assert m["covered"] == gens[-1]["end"]
+    assert m["crc32"] == gens[-1]["crc32"]
+    assert m["events"] == sum(g["events"] for g in gens)
+
+
+# ---------------------------------------------------------------------------
+# windowed reads: bit-identity + zero decode
+# ---------------------------------------------------------------------------
+
+WINDOWS = [
+    ("all", Y25, None, 0),
+    ("from-april", APR1, None, 2),        # skips Jan + Mar whole
+    ("straddle-march", MAR_MID, None, 1),  # Mar is a boundary gen
+    ("jan-only", None, FEB1, 2),          # skips Mar + May whole
+    ("mid", FEB1, APR1, 2),               # skips Jan + May whole
+    ("tail-only", JUN1, None, 3),         # skips every sealed gen
+    ("empty", None, Y25, 3),
+]
+
+
+@pytest.mark.parametrize("name,start,until,expect_skips",
+                         WINDOWS, ids=[w[0] for w in WINDOWS])
+def test_windowed_fast_path_bit_identical_to_row_filter(
+        win_env, name, start, until, expect_skips):
+    _seed_generations(win_env)
+    ref = _row_triples(win_env, start, until)
+    before = event_log._M_WINDOW_SKIPS.value()
+    got = _fast_triples(win_env, start, until)
+    skipped = event_log._M_WINDOW_SKIPS.value() - before
+    assert got == ref, name
+    if start is not None or until is not None:
+        assert skipped == expect_skips, name
+    if name == "empty":
+        assert got == []
+    if name == "from-april":
+        assert len(got) > 12  # May gen + tail actually decoded
+
+
+def test_jan_window_applies_kills_from_skipped_may_generation(win_env):
+    """The hard bit-identity case: the window covers ONLY January, the
+    May generation is skipped whole — but its sealed tombstone
+    ('del-jan') and keep-last duplicate id ('dup-jan') must still kill
+    the superseded January copies, exactly like the row path's global
+    dedup-then-filter."""
+    _seed_generations(win_env)
+    got = _fast_triples(win_env, None, FEB1)
+    users = {u for u, _, _ in got}
+    assert "delu" not in users, "tombstone from a skipped gen ignored"
+    assert "dupu" not in users, "keep-last kill from a skipped gen ignored"
+    assert got == _row_triples(win_env, None, FEB1)
+
+
+def test_tail_only_window_decodes_zero_snapshot_bytes(
+        win_env, monkeypatch):
+    log = _seed_generations(win_env)
+    calls = {"n": 0}
+    real = event_log._deserialize_cols
+
+    def counting(blob):
+        calls["n"] += 1
+        return real(blob)
+
+    monkeypatch.setattr(event_log, "_deserialize_cols", counting)
+    fresh = JSONLEvents(os.path.dirname(log))
+    cols, rows = fresh.scan_columnar(APP, None, ["rate"], JUN1, None)
+    assert calls["n"] == 0, "a tail-only window decoded a snapshot"
+    assert len(rows) == 12  # exactly the June tail
+    # and the chain itself reports the skip accounting
+    got = event_log.load_chain(log, _us(JUN1), None)
+    assert got["skipped"] == 3 and got["decodedBytes"] == 0
+    assert all(p[0] == "skip" for p in got["pieces"])
+    assert calls["n"] == 0
+
+
+def test_ambient_window_env_equals_explicit_bounds(win_env, monkeypatch):
+    _seed_generations(win_env)
+    ref = _fast_triples(win_env, APR1, None)
+    monkeypatch.setenv("PIO_TRAIN_WINDOW_START_US", str(_us(APR1)))
+    assert _fast_triples(win_env) == ref
+    # explicit bounds are never overridden by the ambient window
+    assert _fast_triples(win_env, None, FEB1) == \
+        _row_triples(win_env, None, FEB1)
+    monkeypatch.delenv("PIO_TRAIN_WINDOW_START_US")
+    # a malformed duration degrades to the full scan (never a crash,
+    # never a silently-wrong cut)
+    monkeypatch.setenv("PIO_TRAIN_WINDOW", "ninety-days")
+    assert _fast_triples(win_env) == _row_triples(win_env)
+
+
+def test_train_cmd_rejects_malformed_window():
+    from incubator_predictionio_tpu.tools.commands.engine import train_cmd
+
+    assert train_cmd(["--window", "bogus"]) == 1
+    assert "PIO_TRAIN_WINDOW" not in os.environ
+
+
+# ---------------------------------------------------------------------------
+# windowed gang feed: union == merged view, per worker count
+# ---------------------------------------------------------------------------
+
+def _store_for_partition(events_dir, partition, monkeypatch):
+    if partition is None:
+        monkeypatch.delenv("PIO_EVENT_PARTITION", raising=False)
+    else:
+        monkeypatch.setenv("PIO_EVENT_PARTITION", str(partition))
+    st = JSONLEvents(events_dir)
+    monkeypatch.delenv("PIO_EVENT_PARTITION", raising=False)
+    return st
+
+
+def _seed_partitioned(env: dict, monkeypatch) -> str:
+    """Base + p0 + p1 shards, each with Jan/Mar/May sealed generations
+    and a June tail; one cross-partition delete whose tombstone is
+    SEALED inside p1's May generation (replayed when that generation is
+    skipped) and one recorded in a tail; one within-shard keep-last
+    duplicate."""
+    s = _fresh_storage(env)
+    events_dir = s.get_l_events().events_dir
+    victims = {}
+    for part in (None, 0, 1):
+        st = _store_for_partition(events_dir, part, monkeypatch)
+        salt = 0 if part is None else part + 1
+        name = ("events_1.jsonl" if part is None
+                else f"events_1.p{part}.jsonl")
+        shard = os.path.join(events_dir, name)
+        for base_t in (JAN, MAR, MAY):
+            evs = [_rate((k * 7 + salt) % 23, (k * 5 + salt) % 17,
+                         1 + (k + salt) % 5, _at(base_t, k + salt))
+                   for k in range(20)]
+            if part == 0 and base_t is JAN:
+                evs.append(_rate("xdel", "xi", 2, _at(JAN, 50),
+                                 eid="del-x"))
+                evs.append(_rate("ydel", "yi", 4, _at(JAN, 51),
+                                 eid="del-y"))
+            if part == 1 and base_t is JAN:
+                evs.append(_rate("pdup", "pdi", 1, _at(JAN, 52),
+                                 eid="dup-p1"))
+            if part == 1 and base_t is MAY:
+                evs.append(_rate("pdup", "pdi", 5, _at(MAY, 52),
+                                 eid="dup-p1"))
+            st.insert_batch(evs, APP)
+            if part == 1 and base_t is MAY:
+                # cross-partition delete sealed INSIDE p1's May gen:
+                # the victim's rows live in p0's Jan gen
+                st.delete_batch(["del-y"], APP)
+            assert event_log.compact_log(shard)
+        st.insert_batch([_rate(800 + salt * 10 + j, 900 + j, 3,
+                               _at(JUN, j + salt)) for j in range(6)], APP)
+    # cross-partition delete in an (always-parsed) tail
+    st1 = _store_for_partition(events_dir, 1, monkeypatch)
+    st1.delete_batch(["del-x"], APP)
+    return events_dir
+
+
+def _feed_triples(events_dir, num_workers, start=None, until=None):
+    s_us = None if start is None else _us(start)
+    u_us = None if until is None else _us(until)
+    per_worker, tombs = [], set()
+    for w in range(num_workers):
+        feed = pfeed.PartitionFeed(events_dir, APP, None, w, num_workers)
+        shards = [pfeed.scan_shard(p, s_us, u_us)
+                  for p in feed.shard_list()]
+        tombs |= set(feed.local_tombstones(shards))
+        per_worker.append(shards)
+    out = []
+    for shards in per_worker:
+        for shard in shards:
+            sr = pfeed.PartitionFeed.shard_ratings(
+                shard, ["rate"], frozenset(tombs),
+                start_us=s_us, until_us=u_us)
+            for j in range(len(sr.rating)):
+                out.append((sr.user_ids[int(sr.u[j])],
+                            sr.item_ids[int(sr.i[j])],
+                            float(sr.rating[j])))
+    return sorted(out)
+
+
+def test_windowed_feed_union_equals_merged_view(win_env, monkeypatch):
+    events_dir = _seed_partitioned(win_env, monkeypatch)
+    for name, start, until in [
+            ("full", None, None), ("from-april", APR1, None),
+            ("jan-only", None, FEB1), ("straddle", MAR_MID_FEED, None),
+            ("tail-only", JUN1, None)]:
+        ref = sorted(_row_triples(win_env, start, until))
+        assert ref or name == "never", name
+        for n in (1, 2, 3):
+            got = _feed_triples(events_dir, n, start, until)
+            assert got == ref, f"{name} num_workers={n}"
+    # the jan-only window must have killed both cross-partition delete
+    # victims AND the skipped-May keep-last duplicate
+    jan = _feed_triples(events_dir, 2, None, FEB1)
+    users = {u for u, _, _ in jan}
+    assert not users & {"xdel", "ydel", "pdup"}
+
+
+def test_windowed_feed_skips_whole_generations_and_counts_rows(
+        win_env, monkeypatch):
+    events_dir = _seed_partitioned(win_env, monkeypatch)
+    calls = {"n": 0}
+    real = event_log._deserialize_cols
+
+    def counting(blob):
+        calls["n"] += 1
+        return real(blob)
+
+    monkeypatch.setattr(event_log, "_deserialize_cols", counting)
+    skips_before = event_log._M_WINDOW_SKIPS.value()
+    got = _feed_triples(events_dir, 2, JUN1, None)
+    assert calls["n"] == 0, "tail-only feed decoded a snapshot"
+    assert event_log._M_WINDOW_SKIPS.value() - skips_before == 9
+    assert len(got) == 18  # 3 shards x 6 tail events
+    # a straddling window row-filters the boundary generation (and the
+    # tails) and says so in the telemetry counter
+    rows_before = pfeed._M_WINDOW_ROWS.value()
+    _feed_triples(events_dir, 2, MAR_MID_FEED, None)
+    assert pfeed._M_WINDOW_ROWS.value() > rows_before
+
+
+# ---------------------------------------------------------------------------
+# retention: retire_expired + crash safety
+# ---------------------------------------------------------------------------
+
+NOW = dt.datetime(2026, 8, 1, tzinfo=UTC)
+TTL_150D = 150 * 86400 * 1_000_000  # cutoff ~2026-03-04: only Jan expires
+
+
+def test_retire_moves_only_expired_prefix(win_env):
+    log = _seed_generations(win_env)
+    # post-retire view must equal the pre-retire view cut at the TTL
+    # boundary (every gen-1 row is older than every surviving row)
+    ref = _row_triples(win_env, FEB1, None)
+    res = event_log.retire_expired(log, ttl_us=TTL_150D,
+                                   now_us=_us(NOW))
+    assert res["retired"] == 1 and res["generations"] == [1]
+    assert res["floor"] > 0 and res["swept"] == 1
+    m = event_log._read_manifest(log)
+    tiers = [g["tier"] for g in m["generations"]]
+    assert tiers == ["retired", "hot", "hot"]
+    retired_dir = os.path.join(os.path.dirname(log),
+                               event_log.RETIRED_DIR)
+    assert m["generations"][0]["file"] in os.listdir(retired_dir)
+    assert not os.path.exists(
+        os.path.join(os.path.dirname(log), m["generations"][0]["file"]))
+    assert _row_triples(win_env) == ref
+    assert _fast_triples(win_env) == ref
+    # health reporting: the dir rolls up the retired generation
+    health = event_log.partition_health(os.path.dirname(log))
+    assert health["retiredGenerations"] == 1
+    assert health["logs"][0]["retiredBytes"] > 0
+    # idempotent: a second pass retires nothing and sweeps nothing new
+    res2 = event_log.retire_expired(log, ttl_us=TTL_150D,
+                                    now_us=_us(NOW))
+    assert res2["retired"] == 0 and res2["swept"] == 0
+
+
+def test_retire_without_ttl_only_sweeps(win_env):
+    log = _seed_generations(win_env)
+    ref = _row_triples(win_env)
+    res = event_log.retire_expired(log)
+    assert res is not None and res["retired"] == 0
+    assert _row_triples(win_env) == ref
+
+
+def test_retire_crash_at_rename_leaves_prior_state_then_converges(
+        win_env, monkeypatch):
+    log = _seed_generations(win_env)
+    full = _row_triples(win_env)
+    monkeypatch.setenv("PIO_FAULT_SPEC", "retire.rename:fail:1")
+    faultinject.reset()
+    with pytest.raises(Exception):
+        event_log.retire_expired(log, ttl_us=TTL_150D, now_us=_us(NOW))
+    monkeypatch.delenv("PIO_FAULT_SPEC")
+    faultinject.reset()
+    # nothing committed: every generation still hot, full view serves
+    m = event_log._read_manifest(log)
+    assert all(g["tier"] == "hot" for g in m["generations"])
+    assert _row_triples(win_env) == full
+    # clean rerun converges
+    res = event_log.retire_expired(log, ttl_us=TTL_150D,
+                                   now_us=_us(NOW))
+    assert res["retired"] == 1 and res["swept"] == 1
+    assert _row_triples(win_env) == _fast_triples(win_env)
+
+
+def _seed_relative(env: dict):
+    """Generations placed relative to the REAL clock (the subprocess
+    `--ttl 90d` cuts against wall time): one ~200 days old, one ~50
+    days old, a fresh tail."""
+    s = _fresh_storage(env)
+    le = s.get_l_events()
+    log = os.path.join(le.events_dir, "events_1.jsonl")
+    now = dt.datetime.now(UTC)
+    old = now - dt.timedelta(days=200)
+    mid = now - dt.timedelta(days=50)
+    le.insert_batch([_rate(k, k % 7, 2, old + dt.timedelta(minutes=k))
+                     for k in range(30)], APP)
+    assert event_log.compact_log(log)
+    le.insert_batch([_rate(k, k % 7, 4, mid + dt.timedelta(minutes=k))
+                     for k in range(30)], APP)
+    assert event_log.compact_log(log)
+    le.insert_batch([_rate(900 + k, k, 3,
+                           now - dt.timedelta(days=1)
+                           + dt.timedelta(minutes=k))
+                     for k in range(5)], APP)
+    return log
+
+
+def test_retire_sigkill_converges_via_cli(win_env):
+    log = _seed_relative(win_env)
+    env = {**os.environ, **win_env,
+           "PIO_FAULT_SPEC": "retire.rename:crash:1"}
+    cmd = [sys.executable, "-m",
+           "incubator_predictionio_tpu.tools.console",
+           "eventlog", "retire", "--ttl", "90d"]
+    proc = subprocess.run(cmd, env=env, capture_output=True, timeout=120)
+    assert proc.returncode in (-signal.SIGKILL, 137), \
+        (proc.returncode, proc.stdout, proc.stderr)
+    # the commit never landed: all generations hot, all 65 events serve
+    m = event_log._read_manifest(log)
+    assert all(g["tier"] == "hot" for g in m["generations"])
+    fresh = JSONLEvents(os.path.dirname(log))
+    assert len(list(fresh.find(APP))) == 65
+    # rerun WITHOUT the fault: converges
+    env.pop("PIO_FAULT_SPEC")
+    proc2 = subprocess.run(cmd, env=env, capture_output=True, timeout=120)
+    assert proc2.returncode == 0, proc2.stderr
+    m2 = event_log._read_manifest(log)
+    assert [g["tier"] for g in m2["generations"]] == ["retired", "hot"]
+    assert m2["generations"][0]["file"] in os.listdir(
+        os.path.join(os.path.dirname(log), event_log.RETIRED_DIR))
+    assert event_log.parse_floor(log) > 0
+    fresh2 = JSONLEvents(os.path.dirname(log))
+    assert len(list(fresh2.find(APP))) == 35
+
+
+def test_retire_cli_rejects_malformed_ttl(win_env):
+    _seed_relative(win_env)
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "incubator_predictionio_tpu.tools.console",
+         "eventlog", "retire", "--ttl", "fortnight"],
+        env={**os.environ, **win_env}, capture_output=True, timeout=120)
+    assert proc.returncode == 1
+    assert b"expected a duration" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# cold archival: round trip + crash safety + windowed-train contract
+# ---------------------------------------------------------------------------
+
+def test_archive_round_trip_checksum_verified(win_env, monkeypatch):
+    log = _seed_generations(win_env)
+    full = _row_triples(win_env)
+    storage = _fresh_storage(win_env)
+    monkeypatch.setenv("PIO_EVENT_ARCHIVE_SOURCE", "COLD")
+    m0 = event_log._read_manifest(log)
+    g1 = m0["generations"][0]
+    local = os.path.join(os.path.dirname(log), g1["file"])
+    entry = event_log.archive_generation(log, 1, storage=storage)
+    assert entry["tier"] == "archived"
+    assert entry["archive"]["source"] == "COLD"
+    assert entry["archive"]["id"] == "events_1.jsonl.g1"
+    assert not os.path.exists(local), "local copy must go after commit"
+    # UNWINDOWED serving reads through the archived generation (gap
+    # parse of the log bytes) — archival never breaks availability
+    assert _row_triples(win_env) == full
+    # a windowed train that NEEDS the archived generation fails with a
+    # named-generation error...
+    with pytest.raises(event_log.ArchivedGenerationError) as ei:
+        _fast_triples(win_env, None, FEB1)
+    assert ei.value.generations == [1]
+    assert "pio eventlog restore" in str(ei.value)
+    # ...but one that can SKIP it proceeds untouched
+    assert _fast_triples(win_env, APR1, None) == \
+        _row_triples(win_env, APR1, None)
+    # health rollup
+    health = event_log.partition_health(os.path.dirname(log))
+    assert health["archivedGenerations"] == 1
+    # restore: checksum-identical file back in the hot dir
+    entry2 = event_log.restore_generation(log, 1, storage=storage)
+    assert entry2["tier"] == "hot"
+    with open(local, "rb") as f:
+        assert zlib.crc32(f.read()) == g1["crc32"]
+    assert _fast_triples(win_env, None, FEB1) == \
+        _row_triples(win_env, None, FEB1)
+
+
+def test_restore_on_demand_knob_reads_through(win_env, monkeypatch):
+    log = _seed_generations(win_env)
+    storage = _fresh_storage(win_env)
+    monkeypatch.setenv("PIO_EVENT_ARCHIVE_SOURCE", "COLD")
+    event_log.archive_generation(log, 1, storage=storage)
+    monkeypatch.setenv("PIO_EVENT_RESTORE_ON_DEMAND", "1")
+    got = event_log.load_chain(log, None, _us(FEB1), storage=storage)
+    assert got is not None
+    kinds = [p[0] for p in got["pieces"]]
+    assert kinds[0] == "cols", "gen 1 was not restored + decoded"
+    m = event_log._read_manifest(log)
+    assert m["generations"][0]["tier"] == "hot"
+
+
+def test_archive_crash_points_leave_hot_copy_then_converge(
+        win_env, monkeypatch):
+    log = _seed_generations(win_env)
+    full = _row_triples(win_env)
+    storage = _fresh_storage(win_env)
+    monkeypatch.setenv("PIO_EVENT_ARCHIVE_SOURCE", "COLD")
+    g1 = event_log._read_manifest(log)["generations"][0]
+    local = os.path.join(os.path.dirname(log), g1["file"])
+    for point in ("archive.put", "archive.manifest"):
+        monkeypatch.setenv("PIO_FAULT_SPEC", f"{point}:fail:1")
+        faultinject.reset()
+        with pytest.raises(Exception):
+            event_log.archive_generation(log, 1, storage=storage)
+        monkeypatch.delenv("PIO_FAULT_SPEC")
+        faultinject.reset()
+        # the hot copy stays authoritative after every failure
+        assert os.path.exists(local), point
+        m = event_log._read_manifest(log)
+        assert m["generations"][0]["tier"] == "hot", point
+        assert _row_triples(win_env) == full, point
+    # clean rerun converges (re-put is idempotent)
+    entry = event_log.archive_generation(log, 1, storage=storage)
+    assert entry["tier"] == "archived" and not os.path.exists(local)
+    # converged call on an already-archived generation is a no-op
+    entry2 = event_log.archive_generation(log, 1, storage=storage)
+    assert entry2["tier"] == "archived"
+
+
+def test_archive_sigkill_and_cli_round_trip(win_env):
+    log = _seed_generations(win_env)
+    g1 = event_log._read_manifest(log)["generations"][0]
+    local = os.path.join(os.path.dirname(log), g1["file"])
+    env = {**os.environ, **win_env,
+           "PIO_EVENT_ARCHIVE_SOURCE": "COLD",
+           "PIO_FAULT_SPEC": "archive.put:crash:1"}
+    cmd = [sys.executable, "-m",
+           "incubator_predictionio_tpu.tools.console",
+           "eventlog", "archive", "--log", "events_1.jsonl",
+           "--generation", "1"]
+    proc = subprocess.run(cmd, env=env, capture_output=True, timeout=120)
+    assert proc.returncode in (-signal.SIGKILL, 137), \
+        (proc.returncode, proc.stdout, proc.stderr)
+    assert os.path.exists(local), "SIGKILL before put lost the hot copy"
+    m = event_log._read_manifest(log)
+    assert m["generations"][0]["tier"] == "hot"
+    # rerun without the fault: archived, local gone
+    env.pop("PIO_FAULT_SPEC")
+    proc2 = subprocess.run(cmd, env=env, capture_output=True, timeout=120)
+    assert proc2.returncode == 0, proc2.stderr
+    assert b"tier archived" in proc2.stdout
+    assert not os.path.exists(local)
+    # restore via the CLI: file back, checksum-identical
+    proc3 = subprocess.run(
+        [sys.executable, "-m",
+         "incubator_predictionio_tpu.tools.console",
+         "eventlog", "restore", "--log", "events_1.jsonl",
+         "--generation", "1"],
+        env=env, capture_output=True, timeout=120)
+    assert proc3.returncode == 0, proc3.stderr
+    with open(local, "rb") as f:
+        assert zlib.crc32(f.read()) == g1["crc32"]
+
+
+# ---------------------------------------------------------------------------
+# legacy v1 manifests: unbounded, never skipped, never retired
+# ---------------------------------------------------------------------------
+
+def test_legacy_v1_manifest_loads_unbounded(win_env):
+    log = _seed_generations(win_env)
+    m = event_log._read_manifest(log)
+    # a v1 manifest named ONE snapshot covering its committed prefix —
+    # rebuild that shape around generation 1 and drop the v2 keys
+    g1 = m["generations"][0]
+    with open(log, "rb") as f:
+        buf = f.read(g1["end"])
+    legacy = {"generation": g1["generation"], "file": g1["file"],
+              "covered": g1["end"], "events": g1["events"],
+              "crc32": g1["crc32"],
+              "tailProbe": event_log._tail_probe(buf, g1["end"]),
+              "compactedAt": m["compactedAt"]}
+    event_log._commit_manifest(log, legacy)
+    for g in m["generations"][1:]:
+        os.remove(os.path.join(os.path.dirname(log), g["file"]))
+    ref = _row_triples(win_env)
+    # unwindowed serving works off the legacy snapshot + JSON tail
+    assert event_log.load_snapshot(log) is not None
+    # a windowed read decodes it (NEVER bounds-skips a legacy entry)
+    got = event_log.load_chain(log, _us(JUN1), None)
+    assert got["skipped"] == 0
+    assert [p[0] for p in got["pieces"]] == ["cols"]
+    assert _fast_triples(win_env, JUN1, None) == \
+        _row_triples(win_env, JUN1, None)
+    assert _row_triples(win_env) == ref
+    # retention never touches it, no matter how old
+    res = event_log.retire_expired(log, ttl_us=1,
+                                   now_us=_us(NOW))
+    assert res["retired"] == 0
+    # health marks it so `pio eventlog status` can warn
+    health = event_log.partition_health(os.path.dirname(log))
+    gens = health["logs"][0]["generations"]
+    assert len(gens) == 1 and gens[0]["legacy"] is True
+    assert gens[0]["minEventUs"] is None
+
+
+def test_eventlog_status_prints_tiers_and_legacy_warning(win_env):
+    log = _seed_generations(win_env)
+    event_log.retire_expired(log, ttl_us=TTL_150D, now_us=_us(NOW))
+    env = {**os.environ, **win_env}
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "incubator_predictionio_tpu.tools.console",
+         "eventlog", "status"],
+        env=env, capture_output=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout.decode()
+    assert "tier=retired" in out and "tier=hot" in out
+    assert "2026-01" in out  # human-readable event-time bounds
+    assert "UNBOUNDED" not in out
+    # break the manifest down to v1: status must warn about the
+    # unbounded legacy generation
+    m = event_log._read_manifest(log)
+    legacy = {k: m[k] for k in ("generation", "file", "covered",
+                                "events", "crc32", "tailProbe",
+                                "compactedAt")}
+    event_log._commit_manifest(log, legacy)
+    proc2 = subprocess.run(
+        [sys.executable, "-m",
+         "incubator_predictionio_tpu.tools.console",
+         "eventlog", "status"],
+        env=env, capture_output=True, timeout=120)
+    assert proc2.returncode == 0, proc2.stderr
+    out2 = proc2.stdout.decode()
+    assert "[warn]" in out2 and "UNBOUNDED" in out2
+
+
+# ---------------------------------------------------------------------------
+# gc regression: exact-name keying (g1 vs g11)
+# ---------------------------------------------------------------------------
+
+def test_gc_generations_keys_on_exact_names(tmp_path):
+    d = str(tmp_path)
+    base = "events_1.jsonl"
+
+    def put(*names):
+        for n in names:
+            with open(os.path.join(d, n), "w") as f:
+                f.write("x")
+
+    g1 = base + ".g1.colseg"
+    g11 = base + ".g11.colseg"
+    other = "events_1.p0.jsonl.g1.colseg"
+    put(g1, g11, base + ".g2.colseg.tmp", other)
+    event_log._gc_generations(d, base, {g1})
+    left = set(os.listdir(d))
+    assert g1 in left, "kept generation was collected"
+    assert g11 not in left, "g11 survived a keep={g1} sweep (prefix " \
+        "near-miss)"
+    assert base + ".g2.colseg.tmp" not in left, "stray shadow survived"
+    assert other in left, "another log's generation was collected"
+    # the mirror-image near-miss: keeping g11 must not collect it when
+    # g1 is the garbage
+    put(g1, g11)
+    event_log._gc_generations(d, base, {g11})
+    left = set(os.listdir(d))
+    assert g11 in left and g1 not in left
+    # legacy call shape: a bare string keep still works
+    put(g1)
+    event_log._gc_generations(d, base, g11)
+    left = set(os.listdir(d))
+    assert g11 in left and g1 not in left
+
+
+# ---------------------------------------------------------------------------
+# retention floor: JSON fallback never resurrects retired bytes
+# ---------------------------------------------------------------------------
+
+def test_json_fallback_parses_from_retention_floor(win_env):
+    log = _seed_generations(win_env)
+    event_log.retire_expired(log, ttl_us=TTL_150D, now_us=_us(NOW))
+    ref = _row_triples(win_env)  # post-retire view (no Jan rows)
+    # corrupt the newest hot generation: the chain self-truncates and
+    # the read falls back to the JSON parse — which must start at the
+    # retention floor, NOT byte 0
+    m = event_log._read_manifest(log)
+    snap = os.path.join(os.path.dirname(log),
+                        m["generations"][-1]["file"])
+    with open(snap, "r+b") as f:
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    got = _row_triples(win_env)
+    users = {u for u, _, _ in got}
+    assert "delu" not in users and "dupu" in users
+    # Jan-generation rows stay gone: user codes 17..22 only exist in
+    # the Jan batch (k % 23 over 40 events reaches 22; Mar uses % 19,
+    # May % 21)
+    assert not users & {"21", "22"}, "retired rows were resurrected"
+    # everything the retired tier did NOT own is still served
+    assert sorted(got) == sorted(ref)

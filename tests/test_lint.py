@@ -421,6 +421,47 @@ def test_seeded_metric_name_registry(tmp_path):
     assert not any("pio_seeded_ctxvar" in m for m in msgs)
 
 
+def test_seeded_tier_literal_confinement(tmp_path):
+    """The retention-tier extension of wal-suffix-confinement: the
+    retired/ dir name and the cold-archive namespace are exact-match
+    string constants only event_log.py may spell."""
+    fs = findings_for(tmp_path, {
+        "data/storage/side.py":
+            'TIER = "retired"\nNS = "pio_eventlog_archive"\n',
+        # the allowed home: the tier lifecycle's own module
+        "data/api/event_log.py":
+            'RETIRED_DIR = "retired"\n'
+            'ARCHIVE_NAMESPACE = "pio_eventlog_archive"\n',
+        # prose mentioning the word is NOT an artifact reference
+        "data/storage/prose.py":
+            '"""Rows from a generation retired last week."""\nX = 1\n',
+    }, ["wal-suffix-confinement"])
+    assert sorted((f.path.endswith("side.py"), f.line) for f in fs) == \
+        [(True, 1), (True, 2)]
+    assert all("retention-tier artifact name" in f.message for f in fs)
+    assert any("'retired'" in f.message for f in fs)
+    assert any("'pio_eventlog_archive'" in f.message for f in fs)
+
+
+def test_seeded_window_metric_family_registry(tmp_path):
+    """The windowed-read metric families go through the same doc-driven
+    registry: an undocumented pio_train_window_* family is a finding,
+    a documented one is not."""
+    docs = {"operations.md":
+            "| `pio_train_window_generations_skipped_total` | counter "
+            "|\n"}
+    fs = findings_for(tmp_path, {"common/winmetrics.py": """
+        from . import telemetry
+        A = telemetry.registry().counter(
+            "pio_train_window_generations_skipped_total", "documented")
+        B = telemetry.registry().counter(
+            "pio_train_window_rows_filtered_total", "not in the docs")
+        """}, ["metric-name-registry"], docs=docs)
+    assert len(fs) == 1
+    assert "'pio_train_window_rows_filtered_total' is not documented" \
+        in fs[0].message
+
+
 def test_seeded_parse_error_is_a_finding(tmp_path):
     project = make_project(tmp_path, {"data/api/broken.py": "def f(:\n"})
     result = run_lint(project, ALL_RULES)
