@@ -318,6 +318,16 @@ def deploy_cmd(args: list[str]) -> int:
                         "previous deployment, then exit (against a "
                         "fleet front this is a FLEET rollback — the "
                         "pin propagates to every replica)")
+    p.add_argument("--multitenant", action="store_true",
+                   help="serve EVERY registered app from this process: "
+                        "queries route by access key (accessKey param / "
+                        "X-Pio-Access-Key) or app name (X-Pio-App) to a "
+                        "per-app model cache holding "
+                        "$PIO_TENANT_MAX_RESIDENT (default 8) resident "
+                        "deployments (LRU; lazy load on first query), "
+                        "each tenant with its own validation gate, "
+                        "watch/rollback/pin lifecycle, fold-in cursor "
+                        "and admission budget ($PIO_TENANT_MAX_PENDING)")
     p.add_argument("--replicas", type=int, default=None, metavar="N",
                    help="serve as a fleet of N supervised engine-server "
                         "processes behind an L4 splice front with a "
@@ -378,6 +388,12 @@ def _build_engine_server(ns):
     quality_sample = (envknobs.env_float("PIO_QUALITY_SAMPLE", 0.01,
                                          lo=0.0, hi=1.0)
                       if getattr(ns, "quality_eval", False) else None)
+    # --multitenant arms the mux at $PIO_TENANT_MAX_RESIDENT (default 8
+    # resident deployments); same pattern — the env knob alone can
+    # still arm it
+    tenant_max_resident = (
+        envknobs.env_int("PIO_TENANT_MAX_RESIDENT", 8, lo=1)
+        if getattr(ns, "multitenant", False) else None)
     return EngineServer(
         engine,
         engine_factory_name=factory,
@@ -394,6 +410,7 @@ def _build_engine_server(ns):
         model_refresh_ms=ns.model_refresh_ms,
         foldin_ms=foldin_ms,
         quality_sample=quality_sample,
+        tenant_max_resident=tenant_max_resident,
     )
 
 
@@ -460,9 +477,18 @@ def _deploy_fleet(args: list[str], ns, replicas: int) -> int:
     print(f"[info] Engine fleet: {replicas} replica(s) behind "
           f"{ns.ip}:{ns.port} (staged canary rollout; front /healthz "
           "aggregates liveness)")
+    # with the tenant mux armed, every replica serves N apps but the
+    # fleet COORDINATOR stages rollouts for the default app only: an
+    # unconfined candidate walk would promote some tenant's fold-in
+    # increment fleet-wide as the default deployment
+    fleet_app = ""
+    if (getattr(ns, "multitenant", False)
+            or envknobs.env_int("PIO_TENANT_MAX_RESIDENT", 0, lo=0) > 0):
+        ds = (engine_json.get("datasource") or {}).get("params") or {}
+        fleet_app = ds.get("appName") or ds.get("app_name") or ""
     return run_fleet(worker_argv, replicas, ns.ip, ns.port,
                      engine_factory_name=factory,
-                     engine_variant=variant)
+                     engine_variant=variant, app_name=fleet_app)
 
 
 def _deploy_replica_worker(ns) -> int:

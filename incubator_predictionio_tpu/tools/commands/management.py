@@ -239,9 +239,51 @@ def _print_engine_overload(url: str) -> None:
     q = doc.get("quality")
     if q:
         _print_quality(q)
+    tenants = doc.get("tenants")
+    if tenants:
+        _print_tenants(tenants)
     fleet = doc.get("fleet")
     if fleet:
         _print_fleet(fleet)
+
+
+def _print_tenants(t: dict) -> None:
+    """Per-tenant table off /status (multi-tenant serving): residency,
+    cursor lag, pins, shed rate — one row per app, warn-marked when a
+    tenant is pinned/degraded. A poisoned tenant must be visible from
+    one `pio status --engine-url` while its healthy neighbors read
+    [info]."""
+    print(f"[info]   tenants: {t.get('resident')}/{t.get('maxResident')}"
+          f" resident of {t.get('known')} known, "
+          f"{t.get('evictions')} eviction(s), "
+          f"{t.get('coldLoads')} cold load(s), per-tenant budget "
+          f"{t.get('maxPending')}")
+    for row in t.get("tenants") or []:
+        pinned = row.get("pinned") or {}
+        flags = []
+        if pinned:
+            flags.append("pinned=" + ",".join(
+                f"{i} ({r})" for i, r in sorted(pinned.items())))
+        if row.get("degraded"):
+            flags.append(f"DEGRADED: {row['degraded']}")
+        if row.get("watch"):
+            flags.append("watching")
+        queries = int(row.get("queries") or 0)
+        shed = int(row.get("shed") or 0)
+        offered = queries + shed
+        shed_pct = (100.0 * shed / offered) if offered else 0.0
+        lag = row.get("cursorLagS")
+        rollbacks = sum((row.get("rollbacks") or {}).values())
+        marker = ("[warn]" if (pinned or row.get("degraded")
+                               or rollbacks) else "[info]")
+        print(f"{marker}     {row.get('app')}: "
+              + ("resident" if row.get("resident") else "evicted")
+              + f", instance {row.get('instance')}, "
+              f"{queries} query(ies), shed {shed} ({shed_pct:.1f}%), "
+              f"rollbacks={rollbacks}, cursor lag "
+              + (f"{lag:.1f}s" if isinstance(lag, (int, float))
+                 else "n/a")
+              + (f" [{'; '.join(flags)}]" if flags else ""))
 
 
 def _print_quality(q: dict) -> None:

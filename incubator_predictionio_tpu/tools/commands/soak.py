@@ -63,6 +63,17 @@ def soak_cmd(args: list[str]) -> int:
                         "scenario — the zipf head keeps the quality "
                         "signal, and catalogs past the host-shard "
                         "threshold serve through the sharded path)")
+    p.add_argument("--tenant-apps", type=int, default=0, metavar="N",
+                   help="arm the multi-tenant serving scenario: "
+                        "serve N apps through ONE engine process "
+                        "behind the tenant mux (zipfian per-tenant "
+                        "traffic, per-tenant SLO rows); 0 keeps the "
+                        "classic single-app topology")
+    p.add_argument("--tenant-max-resident", type=int, default=0,
+                   metavar="N",
+                   help="resident-model LRU bound for --tenant-apps "
+                        "(default: half the app count, min 2 — below "
+                        "the app count so the soak observes evictions)")
     p.add_argument("--query-cache", type=int, default=None, metavar="N",
                    help="served-result cache entries per engine "
                         "process (default 256; 0 disables the cache "
@@ -114,6 +125,8 @@ def soak_cmd(args: list[str]) -> int:
         query_rps=ns.query_rps,
         faults=_parse_faults(ns.faults),
         quality_sample=max(0.0, min(1.0, ns.quality_sample)),
+        tenant_apps=max(0, ns.tenant_apps),
+        tenant_max_resident=max(0, ns.tenant_max_resident),
         p99_ms=ns.p99_ms,
         rollback_deadline_s=ns.rollback_deadline_s,
         foldin_ms=ns.foldin_ms,

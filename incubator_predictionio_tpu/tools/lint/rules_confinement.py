@@ -241,6 +241,52 @@ def models_dao_confinement(project: Project) -> Iterable[Finding]:
                     "read models via model_artifact.read_model")
 
 
+#: the resident-cache internals only workflow/multitenant.py may touch:
+#: the LRU ordered dict and the eviction victim scan. Everything else
+#: goes through TenantMux's public surface (admit/ensure_loaded/
+#: release/...), because the public surface is where the isolation
+#: guarantees live — refcounted eviction ("never drop a tenant
+#: mid-query"), per-tenant pins, the admission budget.
+_TENANT_INTERNALS = ("_resident_lru", "_evict_victim")
+
+
+@rule("tenant-confinement",
+      "only workflow/multitenant.py touches the multi-tenant "
+      "resident-cache internals (_resident_lru / _evict_victim) — a "
+      "side-channel cache touch skips the eviction refcount and the "
+      "per-tenant pin/budget isolation")
+def tenant_confinement(project: Project) -> Iterable[Finding]:
+    chokepoint = project.module("workflow/multitenant.py")
+    if chokepoint is None or chokepoint.tree is None:
+        return  # scoped scan without the mux module
+    if not any(
+            isinstance(n, (ast.Attribute, ast.Name))
+            and getattr(n, "attr", getattr(n, "id", None))
+            == "_resident_lru" for n in chokepoint.walk()):
+        yield Finding(
+            "tenant-confinement", project.display_path(chokepoint), 1,
+            "resident-cache chokepoint (_resident_lru in "
+            "workflow/multitenant.py) not found — renamed? The "
+            "confinement guard has nothing to protect")
+        return
+    for m in project.modules(""):
+        if m.relpath == "workflow/multitenant.py" or m.tree is None:
+            continue
+        disp = project.display_path(m)
+        for node in m.walk():
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Name):
+                name = node.id
+            if name in _TENANT_INTERNALS:
+                yield Finding(
+                    "tenant-confinement", disp, node.lineno,
+                    f"{name} outside workflow/multitenant.py — go "
+                    "through TenantMux's public surface "
+                    "(admit/ensure_loaded/release/snapshot)")
+
+
 @rule("query-dispatch-gate",
       "engine-server handlers route query compute only through the "
       "admission gate (_dispatch_query) — direct executor dispatch "
@@ -372,5 +418,5 @@ def train_feed_confinement(project: Project) -> Iterable[Finding]:
 
 RULES = [ingest_hot_path, spawn_confinement, resilient_urlopen,
          wal_suffix_confinement, no_adhoc_counters, models_dao_confinement,
-         query_dispatch_gate, sharded_topk_confinement,
-         train_feed_confinement]
+         tenant_confinement, query_dispatch_gate,
+         sharded_topk_confinement, train_feed_confinement]

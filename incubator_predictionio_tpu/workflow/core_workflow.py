@@ -443,6 +443,7 @@ def load_deployment(
     engine_variant: str = "default",
     exclude_ids=(),
     on_reject=None,
+    app_name: Optional[str] = None,
 ):
     """Load a trained instance for serving (reference: CreateServer /
     MasterActor prepareDeployment). instance_id None → latest
@@ -457,7 +458,10 @@ def load_deployment(
     instance so callers (the refresh loop) can pin them instead of
     re-walking the same corpse every poll. An EXPLICIT instance_id
     never walks back: the operator asked for that version, so a
-    failure surfaces as an error."""
+    failure surfaces as an error. ``app_name`` confines the candidate
+    walk to ONE app's instances (the instances namespace is per
+    factory/variant, not per app — multi-tenant serving interleaves
+    every app's rows in one completed list)."""
     ctx = ctx or WorkflowContext()
     storage = ctx.get_storage()
     instances = storage.get_meta_data_engine_instances()
@@ -466,14 +470,22 @@ def load_deployment(
         candidates = instances.get_completed(
             engine_factory_name or "engine", "1", engine_variant
         )
+        if app_name is not None:
+            candidates = [
+                c for c in candidates
+                if model_artifact.instance_app_name(c) == app_name]
         if not candidates:
             raise RuntimeError(
-                "No COMPLETED engine instance found; run `pio train` first"
+                "No COMPLETED engine instance found"
+                + (f" for app {app_name!r}" if app_name else "")
+                + "; run `pio train` first"
             )
         candidates = [c for c in candidates if c.id not in excluded]
         if not candidates:
             raise RuntimeError(
-                "Every COMPLETED engine instance is pinned (rolled back "
+                "Every COMPLETED engine instance "
+                + (f"for app {app_name!r} " if app_name else "")
+                + "is pinned (rolled back "
                 "or failed validation); train a fresh instance or reload "
                 "one explicitly")
     else:

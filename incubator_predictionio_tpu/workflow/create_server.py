@@ -71,10 +71,12 @@ _M_CACHE_INVALIDATIONS = telemetry.registry().counter(
 
 class QueryResultCache:
     """Per-user served-result cache (``PIO_QUERY_CACHE_SIZE`` > 0 arms
-    it). Keyed on (user, canonical query fingerprint): a byte-identical
-    repeat of a query within the TTL is answered without touching the
-    model — at a zipfian user mix the hot heads collapse onto cache
-    hits and the sharded million-item dispatch only runs for the tail.
+    it). Keyed on (user, canonical query fingerprint, app): a
+    byte-identical repeat of a query within the TTL is answered without
+    touching the model — at a zipfian user mix the hot heads collapse
+    onto cache hits and the sharded million-item dispatch only runs for
+    the tail. The app component keeps tenants' entries disjoint
+    (multi-tenant serving shares ONE cache across every resident app).
 
     Freshness contract (docs/serving.md "Million-item catalogs"):
 
@@ -115,14 +117,22 @@ class QueryResultCache:
         self.generation = 0
 
     @staticmethod
-    def key_for(query) -> tuple:
-        """(user-or-None, canonical JSON fingerprint). The fingerprint
-        is computed on the post-``before_query`` plugin form, so two
-        spellings a plugin canonicalizes share one entry."""
+    def key_for(query, app: Optional[str] = None) -> tuple:
+        """(user-or-None, canonical JSON fingerprint, app-or-None). The
+        fingerprint is computed on the post-``before_query`` plugin
+        form, so two spellings a plugin canonicalizes share one entry.
+        The app component is the tenant-isolation dimension: without
+        it, two apps' identical (user, query) pairs would SHARE an
+        entry — tenant B served tenant A's cached result, and tenant
+        A's fold-in invalidation leaving B's stale alias behind. The
+        server passes its tenant's app on every lookup/insert; app=None
+        (a bare single-tenant deploy, pre-multi-tenant callers) is its
+        own namespace and never collides with a named app's."""
         user = query.get("user") if isinstance(query, dict) else None
         fp = json.dumps(query, sort_keys=True, separators=(",", ":"),
                         default=str)
-        return (None if user is None else str(user), fp)
+        return (None if user is None else str(user), fp,
+                None if app is None else str(app))
 
     def get(self, key: tuple):
         now = _time.monotonic()
@@ -151,20 +161,40 @@ class QueryResultCache:
                 self._entries.popitem(last=False)
                 self.evictions += 1
 
-    def invalidate_users(self, users) -> int:
+    def invalidate_users(self, users, app: Optional[str] = None) -> int:
         """Targeted eviction: drop every entry keyed to one of
         ``users``. Userless entries (similarity queries) survive — a
         fold-in re-solves only user rows against fixed item-side
-        state, which userless queries score exclusively."""
+        state, which userless queries score exclusively. With ``app``,
+        only that tenant's entries are touched: tenant A's fold-in
+        footprint naming user "u1" must not evict (or miss) app B's
+        "u1", who is a different person under a different model."""
         users = {str(u) for u in users}
+        app = None if app is None else str(app)
         with self._lock:
-            doomed = [k for k in self._entries if k[0] in users]
+            doomed = [k for k in self._entries
+                      if k[0] in users and (app is None or k[2] == app)]
             for k in doomed:
                 del self._entries[k]
             self.invalidated_entries += len(doomed)
             self.invalidations += 1
             self.generation += 1
         _M_CACHE_INVALIDATIONS.labels("foldin").inc()
+        return len(doomed)
+
+    def flush_app(self, app: str, reason: str) -> int:
+        """Drop every entry of ONE tenant (its rollback / unfootprinted
+        swap); every other tenant's entries — and their hit rates —
+        survive untouched."""
+        app = str(app)
+        with self._lock:
+            doomed = [k for k in self._entries if k[2] == app]
+            for k in doomed:
+                del self._entries[k]
+            self.invalidated_entries += len(doomed)
+            self.invalidations += 1
+            self.generation += 1
+        _M_CACHE_INVALIDATIONS.labels(reason).inc()
         return len(doomed)
 
     def flush(self, reason: str) -> int:
@@ -244,6 +274,8 @@ class EngineServer:
         quality_sample: Optional[float] = None,
         query_cache_size: Optional[int] = None,
         query_cache_ttl_ms: Optional[float] = None,
+        tenant_max_resident: Optional[int] = None,
+        tenant_max_pending: Optional[int] = None,
     ):
         # start the PIO_FAULT_SPEC at-mode offset clock at "server
         # constructing", not "first query": soak timelines schedule
@@ -282,7 +314,9 @@ class EngineServer:
                                   fleet_sync_ms, foldin_ms,
                                   quality_sample,
                                   query_cache_size=query_cache_size,
-                                  query_cache_ttl_ms=query_cache_ttl_ms)
+                                  query_cache_ttl_ms=query_cache_ttl_ms,
+                                  tenant_max_resident=tenant_max_resident,
+                                  tenant_max_pending=tenant_max_pending)
         # Probe marker secret: synthetic startup-probe traffic is
         # excluded from queryCount/feedback, so the marker must not be
         # spoofable — an external client sending a bare "X-Pio-Probe: 1"
@@ -311,6 +345,11 @@ class EngineServer:
             self._fleet_bootstrap_load()
         else:
             self._load(instance_id)
+        if self.tenant_max_resident > 0:
+            from . import multitenant
+
+            self._tenants = multitenant.TenantMux(
+                self, self.tenant_max_resident, self.tenant_max_pending)
 
         self.app = web.Application(
             middlewares=[telemetry.trace_middleware()])
@@ -354,7 +393,9 @@ class EngineServer:
                              fleet_replicas=None,
                              fleet_sync_ms=None, foldin_ms=None,
                              quality_sample=None, query_cache_size=None,
-                             query_cache_ttl_ms=None) -> None:
+                             query_cache_ttl_ms=None,
+                             tenant_max_resident=None,
+                             tenant_max_pending=None) -> None:
         """Admission control: the query path gets a DEDICATED bounded
         executor (query_conc workers) plus a bounded waiting budget
         (query_max_pending); offered load beyond conc+pending is shed
@@ -477,6 +518,23 @@ class EngineServer:
                              self.query_cache_ttl_ms / 1e3)
             if self.query_cache_size > 0 and self.query_cache_ttl_ms > 0
             else None)
+        # Multi-tenant serving (docs/operations.md "Multi-tenant
+        # serving"): > 0 arms the tenant multiplexer — requests routed
+        # by access key / X-Pio-App to an LRU cache of that many
+        # resident per-app deployments, each tenant with its own
+        # lifecycle/fold-in/admission state. 0 = off (single-tenant,
+        # the default); `pio deploy --multitenant` arms it.
+        self.tenant_max_resident = max(0, int(
+            tenant_max_resident if tenant_max_resident is not None
+            else _env_int("PIO_TENANT_MAX_RESIDENT", 0)))
+        # One tenant's in-flight + queued budget, deliberately below
+        # the process cap so a hot app sheds while cold apps serve.
+        self.tenant_max_pending = max(1, int(
+            tenant_max_pending if tenant_max_pending is not None
+            else _env_int("PIO_TENANT_MAX_PENDING", 32)))
+        # built in __init__ once storage + the default load are up
+        # (skeleton servers built via __new__ stay single-tenant)
+        self._tenants = None
         self._quality_task = None
         # loop-confined (the _watch idiom): offer() appends from the
         # request path, the loop ticks single-flight off-thread, and
@@ -546,8 +604,13 @@ class EngineServer:
     def _fleet_group(self) -> str:
         from . import model_artifact
 
+        # PIO_FLEET_APP (set by the fleet front when the tenant mux is
+        # armed) scopes the directive record to the DEFAULT app so the
+        # coordinator and every replica agree on the same group name
+        app = envknobs.env_str("PIO_FLEET_APP", "")
         return model_artifact.fleet_group(self.engine_factory_name,
-                                          self.engine_variant)
+                                          self.engine_variant,
+                                          app or None)
 
     @staticmethod
     def _new_compile_families():
@@ -710,12 +773,17 @@ class EngineServer:
             # serving AND names the users it touched evicts exactly
             # those users; anything else flushes the whole cache
             users = self._foldin_footprint(instance, prev_inst)
+            capp = self._cache_app()
             if users is None:
-                n = self._query_cache.flush("swap")
+                # an unfootprinted swap invalidates the DEFAULT app's
+                # entries; with the mux armed other tenants' entries
+                # are theirs (their own lifecycles invalidate them)
+                n = (self._query_cache.flush("swap") if capp is None
+                     else self._query_cache.flush_app(capp, "swap"))
                 log.info("query cache: flushed %d entrie(s) on swap "
                          "to %s", n, instance.id)
             else:
-                n = self._query_cache.invalidate_users(users)
+                n = self._query_cache.invalidate_users(users, app=capp)
                 log.info("query cache: fold-in %s evicted %d entrie(s) "
                          "for %d touched user(s)", instance.id, n,
                          len(users))
@@ -861,6 +929,12 @@ class EngineServer:
             # invalidation accounting (`pio status --engine-url` and
             # the soak scorecard's freshness assertion read this)
             out["queryCache"] = self._query_cache.snapshot()
+        if self._tenants is not None:
+            # multi-tenant surface: LRU occupancy/evictions plus one
+            # row per tenant — residency, pins, watch, shed/rollback
+            # counters, fold-in cursor lag (`pio status --engine-url`
+            # prints the per-tenant table off this)
+            out["tenants"] = self._tenants.snapshot()
         if self.quality_sample > 0:
             # continuous-quality surface: sampling/scoring counters,
             # windowed live metrics, last-good deltas, holdout cursor
@@ -1300,6 +1374,10 @@ class EngineServer:
             query = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"message": "invalid JSON body"}, status=400)
+        if self._tenants is not None:
+            routed = await self._route_tenant_query(request, query)
+            if routed is not None:
+                return routed
         with self._lock:
             deployment = self.deployment
         if deployment is None:
@@ -1331,7 +1409,7 @@ class EngineServer:
             # probe must measure the real dispatch path, and synthetic
             # queries must not pollute hit/miss accounting. The key is
             # the post-plugin query — see QueryResultCache.key_for.
-            ckey = QueryResultCache.key_for(query)
+            ckey = QueryResultCache.key_for(query, self._cache_app())
             cgen = cache.generation
             cached = cache.get(ckey)
             if cached is not None:
@@ -1413,6 +1491,166 @@ class EngineServer:
             if hedged is None:
                 return web.json_response({"message": str(e)}, status=500)
             result = hedged
+        return await self._finish_query(request, query, result)
+
+    # -- multi-tenant routing (docs/operations.md "Multi-tenant
+    # serving"; the mux itself lives in workflow/multitenant.py) -------
+
+    def _default_app_name(self) -> str:
+        """The app the process's default deployment serves — anonymous
+        requests and this app's keyed requests share the classic
+        single-tenant path (and its cache/lifecycle/fold-in state)."""
+        from . import model_artifact
+
+        with self._lock:
+            inst = self.instance
+        name = (model_artifact.instance_app_name(inst)
+                if inst is not None else "")
+        return name or (self.feedback_app_name or "")
+
+    def _cache_app(self) -> Optional[str]:
+        """Cache-key app component for the DEFAULT query path: None
+        while single-tenant (the pre-multi-tenant key shape), the
+        default app's name once the mux is armed — the default tenant's
+        entries must be app-scoped like everyone else's, or an
+        anonymous hit could alias a named tenant's miss."""
+        if self._tenants is None:
+            return None
+        return self._default_app_name() or None
+
+    def _tenant_cache_invalidate(self, app: str,
+                                 users=None) -> None:
+        """Mux callback: invalidate ONE tenant's served-result cache
+        entries — by fold-in freshness footprint when attributable,
+        else the whole tenant. Never the neighbors: that asymmetry is
+        the reason cache keys carry the app component at all."""
+        cache = self._query_cache
+        if cache is None:
+            return
+        if users:
+            n = cache.invalidate_users(users, app=app)
+        else:
+            n = cache.flush_app(app, "tenant")
+        if n:
+            log.info("tenant %r: invalidated %d cached result(s)",
+                     app, n)
+
+    async def _route_tenant_query(self, request: web.Request, query):
+        """Route a query to its tenant, or return None for the classic
+        default path (anonymous requests and the default app's own
+        key). A BAD credential is 401/404 — never a silent fallthrough
+        that would serve the default app's model under another
+        tenant's key."""
+        from . import multitenant
+
+        mux = self._tenants
+        try:
+            app = mux.resolve_app(request)
+        except multitenant.UnknownTenant as e:
+            return web.json_response({"message": str(e)}, status=401)
+        if app is None or app == self._default_app_name():
+            return None
+        dl = self._request_deadline(request)
+        # same contract as the default path: plugin hooks run OUTSIDE
+        # the per-tenant watch accounting
+        try:
+            query = self.plugins.before_query(query)
+        except KeyError as e:
+            return web.json_response(
+                {"message": f"missing query field {e.args[0]!r}"},
+                status=400)
+        except Exception as e:  # noqa: BLE001
+            log.exception("before_query plugin failed")
+            return web.json_response({"message": str(e)}, status=500)
+        try:
+            state = mux.admit(app)
+        except multitenant.UnknownTenant as e:
+            return web.json_response({"message": str(e)}, status=404)
+        except AdmissionShed as e:
+            # the TENANT's budget refused (its own counter); the
+            # process-wide gate still guards the dispatch below
+            return web.json_response(
+                {"message": f"query shed: {e}"}, status=503,
+                headers={"Retry-After":
+                         str(retry_after_jitter(e.retry_after_base))})
+        try:
+            # admit→release brackets the whole query: the refcount it
+            # holds is what "eviction never drops a tenant mid-query"
+            # means mechanically
+            return await self._tenant_query(request, state, query, dl)
+        finally:
+            mux.release(state)
+
+    async def _tenant_query(self, request: web.Request, state, query,
+                            dl) -> web.Response:
+        """One admitted tenant query: lazy load, app-scoped cache,
+        dispatch through the PROCESS admission gate, per-tenant watch
+        accounting with the rollback-and-answer hedge."""
+        mux = self._tenants
+        try:
+            await asyncio.to_thread(mux.ensure_loaded, state)
+        except Exception as e:  # noqa: BLE001 — nothing deployable for
+            # THIS app (never trained / every instance pinned): the
+            # tenant is unavailable, the process is healthy → 503
+            log.warning("tenant %r load failed: %s", state.name, e)
+            return web.json_response(
+                {"message": f"tenant {state.name!r}: {e}"}, status=503,
+                headers={"Retry-After": str(retry_after_jitter(2.0))})
+        cache = self._query_cache
+        ckey = None
+        cgen = 0
+        if cache is not None and "X-Pio-Probe" not in request.headers:
+            ckey = QueryResultCache.key_for(query, state.name)
+            cgen = cache.generation
+            cached = cache.get(ckey)
+            if cached is not None:
+                return await self._finish_query(request, query, cached)
+        deployment = state.deployment
+        try:
+            result = await self._dispatch_query(deployment, query, dl)
+            mux.note_result(state, ok=True)
+            if ckey is not None:
+                cache.put(ckey, result, cgen)
+        except AdmissionShed as e:
+            with self._adm_lock:
+                self._shed_count += 1
+            return web.json_response(
+                {"message": f"query shed: {e}"}, status=503,
+                headers={"Retry-After":
+                         str(retry_after_jitter(e.retry_after_base))})
+        except deadline.DeadlineExceeded as e:
+            with self._adm_lock:
+                self._deadline_count += 1
+            # compute-stage overruns count against the tenant's OWN
+            # watch (same stage taxonomy as the default path)
+            if (e.stage not in ("admission", "executor pickup",
+                                "batch queue", "queued")
+                    and mux.note_result(state, ok=False)):
+                await asyncio.to_thread(mux.rollback_tenant, state,
+                                        "error-rate")
+            return web.json_response({"message": str(e)}, status=504)
+        except KeyError as e:
+            return web.json_response(
+                {"message": f"missing query field {e.args[0]!r}"},
+                status=400)
+        except Exception as e:  # noqa: BLE001 — per-tenant watch+hedge
+            log.exception("tenant %r query failed", state.name)
+            restored = None
+            if mux.note_result(state, ok=False):
+                # watch breach: pin + roll back THIS tenant alone
+                restored = await asyncio.to_thread(
+                    mux.rollback_tenant, state, "error-rate")
+            if restored is not None:
+                # the tenant analogue of the watch hedge: answer the
+                # triggering query on the restored deployment
+                try:
+                    result = await self._dispatch_query(
+                        restored, query, dl, direct=True)
+                except Exception:  # noqa: BLE001 — original verdict
+                    return web.json_response({"message": str(e)},
+                                             status=500)
+                return await self._finish_query(request, query, result)
+            return web.json_response({"message": str(e)}, status=500)
         return await self._finish_query(request, query, result)
 
     async def _finish_query(self, request: web.Request, query,
@@ -2062,6 +2300,12 @@ class EngineServer:
     async def _foldin_once(self) -> None:
         from . import online
 
+        if self._tenants is not None:
+            # per-tenant fold-in rides the same clock: each resident
+            # tenant's runner reads its OWN durable cursor row and its
+            # increments publish through that tenant's gate + watch;
+            # per-tenant failures are contained inside the tick
+            await asyncio.to_thread(self._tenants.foldin_tick)
         with self._lock:
             deployment, instance = self.deployment, self.instance
             pinned = tuple(self._pinned)
@@ -2197,10 +2441,14 @@ class EngineServer:
         with self._lock:
             cur = self.instance
             pinned = set(self._pinned)
+        # with the tenant mux armed the DEFAULT path refreshes within
+        # its own app only — a tenant's fold-in increment is newer but
+        # must never hot-swap in as the default deployment
+        app = self._cache_app() if self._tenants is not None else None
         return model_artifact.newer_completed_instance(
             self.storage.get_meta_data_engine_instances(),
             self.engine_factory_name, self.engine_variant, cur,
-            exclude=pinned)
+            exclude=pinned, app_name=app)
 
     # -- replica fleet (store-mediated staged rollout) ---------------------
     def _fleet_bootstrap_load(self) -> None:

@@ -121,7 +121,8 @@ class FleetCoordinator:
     def __init__(self, storage, replicas: int,
                  engine_factory_name: str,
                  engine_variant: str = "default",
-                 sync_ms: float = 1000.0):
+                 sync_ms: float = 1000.0,
+                 app_name: str = ""):
         from . import model_artifact
 
         self._ma = model_artifact
@@ -129,8 +130,13 @@ class FleetCoordinator:
         self.replicas = max(1, int(replicas))
         self.engine_factory_name = engine_factory_name
         self.engine_variant = engine_variant
-        self.group = model_artifact.fleet_group(engine_factory_name,
-                                                engine_variant)
+        # an app-scoped coordinator (multi-tenant fleets) keys its
+        # directive/status rows per app and stages only that app's
+        # instances — two apps' rollouts can never fence each other
+        self.app_name = str(app_name or "")
+        self.group = model_artifact.fleet_group(
+            engine_factory_name, engine_variant,
+            self.app_name or None)
         # a status row older than this is a dead/wedged replica's — it
         # must neither block a promote forever nor vote on adoption
         # (the shared rule: `pio status` uses the same one)
@@ -180,7 +186,8 @@ class FleetCoordinator:
         return self._ma.newer_completed_instance(
             self.storage.get_meta_data_engine_instances(),
             self.engine_factory_name, self.engine_variant,
-            self.rec["instance"], exclude=self.rec["pinned"])
+            self.rec["instance"], exclude=self.rec["pinned"],
+            app_name=self.app_name or None)
 
     # -- the state machine -------------------------------------------------
     def step(self) -> dict:
@@ -351,7 +358,8 @@ class FleetCoordinator:
 def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
               port: int, *, engine_factory_name: str,
               engine_variant: str = "default",
-              run_dir: Optional[str] = None) -> int:
+              run_dir: Optional[str] = None,
+              app_name: str = "") -> int:
     """Blocking entry for ``pio deploy --replicas N``: spawn N
     supervised replica processes, splice client connections to them,
     and run the staged-rollout coordinator.
@@ -382,6 +390,10 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
         for i in range(replicas)
         if f"PIO_FLEET_WORKER_FAULT_SPEC_{i}" in base_env}
     base_env.pop("PIO_QUERY_REPLICAS", None)
+    if app_name:
+        # replicas must derive the SAME app-scoped directive group as
+        # this coordinator (create_server._fleet_group reads this)
+        base_env["PIO_FLEET_APP"] = app_name
 
     def env_for(attempt: int, idx: int) -> dict:
         if attempt > 0:
@@ -404,7 +416,7 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
                      run_dir=run_dir)
     coordinator = FleetCoordinator(
         Storage.instance(), replicas, engine_factory_name,
-        engine_variant, sync_ms=sync_ms)
+        engine_variant, sync_ms=sync_ms, app_name=app_name)
     sup_done = threading.Event()
     outcome = {}
 

@@ -287,21 +287,45 @@ def foldin_row_id(group: str, app_id: int) -> str:
     return f"{FOLDIN_ROW_PREFIX}{group}__a{int(app_id)}"
 
 
+def instance_app_name(instance) -> str:
+    """The app an engine-instance row is bound to, or "". The ONE
+    app-binding rule the multi-tenant walk-back, the fold-in tailer and
+    the per-app fleet all share: ``env["appName"]`` (stamped by
+    ``run_train`` from the training context) wins; the data-source
+    params' ``appName``/``app_name`` is the fallback for rows trained
+    before the env stamp existed."""
+    try:
+        name = (instance.env or {}).get("appName")
+        if name:
+            return str(name)
+        doc = json.loads(instance.data_source_params or "{}")
+        if isinstance(doc, dict):
+            return str(doc.get("appName") or doc.get("app_name") or "")
+    except Exception:  # noqa: BLE001 — unparseable row binds nowhere
+        pass
+    return ""
+
+
 def newer_completed_instance(instances, engine_factory_name: str,
                              engine_variant: str, current,
-                             exclude=()):
+                             exclude=(), app_name: Optional[str] = None):
     """Newest COMPLETED instance not in ``exclude`` and strictly newer
     than ``current`` (an instance row, an instance id, or None), else
     None. The ONE definition of "a newer deployable candidate" — the
     fleet coordinator's rollout staging and the engine server's refresh
     poll must never disagree about what "newer" means (an instances-DAO
     helper, but it lives here with the other fleet/lifecycle protocol
-    pieces both sides already import)."""
+    pieces both sides already import). With ``app_name`` the candidate
+    walk is confined to ONE app's instances — the instances namespace
+    is (factory, version, variant), NOT app-keyed, so a multi-tenant
+    store interleaves every app's rows in one completed list."""
     done = instances.get_completed(
         engine_factory_name or "engine", "1", engine_variant)
     cur_row = (instances.get(current) if isinstance(current, str)
                else current)
     for c in done:
+        if app_name is not None and instance_app_name(c) != app_name:
+            continue
         if c.id in exclude:
             continue
         if cur_row is not None and (
@@ -320,13 +344,20 @@ def fleet_fresh_s(sync_ms: float) -> float:
     return max(10.0, float(sync_ms) / 1000.0 * 5)
 
 
-def fleet_group(engine_factory_name: str, engine_variant: str) -> str:
+def fleet_group(engine_factory_name: str, engine_variant: str,
+                app_name: Optional[str] = None) -> str:
     """Canonical fleet group id — the ONE definition both sides of the
     store protocol derive row keys from. A coordinator and its replicas
     computing this independently (and drifting) would silently split
     the fleet: directives written under one key, polled under another,
-    with no error anywhere (missing rows read as None)."""
-    return f"{engine_factory_name or 'engine'}::{engine_variant}"
+    with no error anywhere (missing rows read as None). An app-scoped
+    coordinator (multi-tenant serving) appends its app dimension so
+    per-app directive/cursor rows can never collide with the default
+    group's — "::" cannot appear in a registered app name's slot
+    without changing the key, and the bare group never ends in the
+    ``::app=`` marker."""
+    group = f"{engine_factory_name or 'engine'}::{engine_variant}"
+    return group if not app_name else f"{group}::app={app_name}"
 
 
 def fleet_row_id(group: str, replica: Optional[int] = None) -> str:

@@ -155,11 +155,18 @@ class FoldInRunner:
     /status after every tick."""
 
     def __init__(self, storage, engine_factory_name: str,
-                 engine_variant: str, interval_ms: float = 0.0):
+                 engine_variant: str, interval_ms: float = 0.0,
+                 app_name: str = ""):
         self.storage = storage
         self.engine_factory_name = engine_factory_name
         self.engine_variant = engine_variant
         self.interval_ms = float(interval_ms)
+        # ``app_name`` pins a multi-tenant runner to ITS tenant: the
+        # served instance must bind to that app (a mis-stamped row is a
+        # structural disable, never a silent cross-tenant fold-in). The
+        # cursor row id already carries the app id, so each tenant's
+        # runner resumes its own durable cursor under the shared group.
+        self.app_name = str(app_name or "")
         self.group = model_artifact.fleet_group(engine_factory_name,
                                                 engine_variant)
         self._tailer: Optional[LogTailer] = None
@@ -249,12 +256,15 @@ class FoldInRunner:
             self._disabled = ("event store is not a JSONL event log "
                               "(fold-in tails log files; TYPE=JSONL)")
             return False
-        app_name = ((instance.env or {}).get("appName")
-                    or self._ds_params(instance).get("app_name")
-                    or self._ds_params(instance).get("appName") or "")
+        app_name = model_artifact.instance_app_name(instance)
         if not app_name:
             self._disabled = ("deployed instance names no app "
                              "(env.appName / data-source appName)")
+            return False
+        if self.app_name and app_name != self.app_name:
+            self._disabled = (
+                f"served instance binds to app {app_name!r}, not this "
+                f"runner's tenant {self.app_name!r}")
             return False
         app = self.storage.get_meta_data_apps().get_by_name(app_name)
         if app is None:

@@ -83,6 +83,9 @@ SLO_METRICS = (
     "pio_query_cache_hits_total",
     "pio_query_cache_misses_total",
     "pio_query_cache_invalidations_total",
+    "pio_tenant_shed_total",
+    "pio_tenant_evictions_total",
+    "pio_tenant_rollbacks_total",
 )
 
 # spec-armed scenario faults → the fault POINT their PIO_FAULT_SPEC
@@ -174,6 +177,17 @@ class SoakConfig:
     query_cache_size: int = 256
     query_cache_ttl_ms: float = 30000.0
     serve_shard_items: int = 131072
+    # multi-tenant serving (ISSUE 19): tenant_apps > 0 widens the app
+    # universe to that many apps, trains EVERY app its own instance,
+    # arms the engine's tenant mux (PIO_TENANT_MAX_RESIDENT) and
+    # routes the query flood zipfian across all apps via X-Pio-App —
+    # the `tenant-isolation` SLO row grades per-tenant availability
+    # (a hot tenant's shed never reds a cold tenant's row) and that
+    # the resident LRU actually churned. tenant_max_resident 0 = auto:
+    # half the apps, min 2 — always smaller than the app count, so
+    # evictions are guaranteed load-bearing, not incidental.
+    tenant_apps: int = 0
+    tenant_max_resident: int = 0
     fleet_sync_ms: float = 200.0
     compact_interval_ms: float = 2000.0
     faults: tuple = FAULT_MENU
@@ -246,6 +260,10 @@ class SoakPlan:
             + (f"{cfg.query_cache_size} entries, TTL "
                f"{cfg.query_cache_ttl_ms:.0f}ms" if cfg.query_cache_size
                else "off"),
+            *([f"  tenants: mux armed — {len(self.app_names)} apps "
+               f"through one process, {_tenant_resident(cfg)} resident "
+               "(X-Pio-App routed, per-app instances trained up front)"]
+              if cfg.tenant_apps else []),
             "  phases: workspace+train -> launch+ready -> "
             f"{cfg.duration_s:.0f}s mixed load under faults -> "
             f"quiesce (freshness settle <= {cfg.freshness_settle_s:.0f}s)"
@@ -289,6 +307,13 @@ def _conn_budget(cfg: SoakConfig, kills: int) -> int:
     return 20 + per_kill * max(1, kills)
 
 
+def _tenant_resident(cfg: SoakConfig) -> int:
+    """The resolved PIO_TENANT_MAX_RESIDENT bound (0 = mux off)."""
+    if cfg.tenant_apps <= 0:
+        return 0
+    return cfg.tenant_max_resident or max(2, cfg.tenant_apps // 2)
+
+
 def _zipf_weights(n: int, s: float, rng: random.Random) -> list:
     w = [1.0 / (i + 1) ** s for i in range(n)]
     rng.shuffle(w)
@@ -303,8 +328,10 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
     rng = random.Random(cfg.seed)
     primary = cfg.primary_app or _engine_json_app(cfg.engine_dir) \
         or "soak_a0"
-    app_names = [primary] + [f"soak_a{i}" for i in range(1, cfg.apps)]
-    app_weights = _zipf_weights(cfg.apps, cfg.zipf_s, rng)
+    n_apps = max(cfg.apps, cfg.tenant_apps) if cfg.tenant_apps \
+        else cfg.apps
+    app_names = [primary] + [f"soak_a{i}" for i in range(1, n_apps)]
+    app_weights = _zipf_weights(n_apps, cfg.zipf_s, rng)
     user_weights = _zipf_weights(cfg.users, cfg.zipf_s, rng)
     item_weights = _zipf_weights(max(1, cfg.catalog_items), cfg.zipf_s,
                                  rng)
@@ -427,6 +454,18 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
             "event — no stale cached results after rollback"
             if cfg.query_cache_size > 0 else "cache disabled"),
     }
+    if cfg.tenant_apps:
+        bound = _tenant_resident(cfg)
+        slos["tenant-isolation"] = (
+            f"every offered tenant answered 200 ({n_apps} apps through "
+            f"ONE engine process, X-Pio-App routed); a hot tenant's "
+            f"503 shed never reds a cold tenant's row; resident LRU "
+            f"bound {bound} < {n_apps} apps → evictions observed")
+        notes.append(
+            f"multi-tenant: {n_apps} apps, PIO_TENANT_MAX_RESIDENT="
+            f"{bound}; the query flood's first sweep visits every app "
+            "in order (guaranteed coverage + LRU churn), then goes "
+            "zipfian")
     notes.append("observations are scraped through quiesce: rollback "
                  "pins and fault evidence landing after the wall "
                  "budget (starved-host double-load) still count")
@@ -456,6 +495,7 @@ class _Ledger:
         self.query_conn_errors = 0
         self.sent = 0
         self.violations: list = []    # first N non-contract responses
+        self.tenant_codes: dict = {}  # app -> {code: n} (mux runs)
 
     _OK = {"ingest": (201, 503), "query": (200, 503, 504)}
 
@@ -470,6 +510,14 @@ class _Ledger:
                 self.violations.append(
                     {"table": table, "code": code,
                      "atS": round(t_off, 1), "body": body[:300]})
+
+    def tenant_code(self, app: str, code: int) -> None:
+        """Per-tenant response census (multi-tenant runs): the
+        tenant-isolation SLO grades each app's OWN availability off
+        this, so one hot tenant's shed cannot red a cold tenant."""
+        with self.lock:
+            d = self.tenant_codes.setdefault(app, {})
+            d[code] = d.get(code, 0) + 1
 
 
 class _Samples:
@@ -486,6 +534,7 @@ class _Samples:
         self.foldin_publishes = 0
         self.restarts: dict = {}      # "replica:<i>" -> max restarts
         self.query_cache: dict = {}   # /status queryCache counters, max
+        self.tenants: dict = {}       # /status tenants doc, latest
         self._rollback_keys: set = set()
 
     def note_metrics(self, text: str) -> None:
@@ -615,6 +664,11 @@ class SoakRunner:
             "PIO_COMPILATION_CACHE": "0",
             "JAX_PLATFORMS": "cpu",
         }
+        if cfg.tenant_apps:
+            # tenant mux armed in every engine process (fleet replicas
+            # inherit): one process serves the whole app universe with
+            # the resident LRU smaller than it
+            env["PIO_TENANT_MAX_RESIDENT"] = str(_tenant_resident(cfg))
         for k in ("PIO_FAULT_SPEC", "PIO_EVENT_WORKER_FAULT_SPEC",
                   "PIO_FLEET_WORKER_FAULT_SPEC"):
             env.pop(k, None)
@@ -681,12 +735,12 @@ class SoakRunner:
         except Exception:  # noqa: BLE001 — post-mortem best effort
             return "<no output>"
 
-    def _train(self, label: str) -> str:
+    def _train(self, label: str, engine_dir: Optional[str] = None) -> str:
         """One `pio train` subprocess against the workspace; returns
         the COMPLETED instance id parsed from its output."""
         out = subprocess.run(
             self._console_argv("train", "--engine-dir",
-                               self.cfg.engine_dir),
+                               engine_dir or self.cfg.engine_dir),
             env=self._base_env(), capture_output=True, text=True,
             timeout=300)
         if out.returncode != 0:
@@ -700,6 +754,36 @@ class SoakRunner:
                 f"{out.stdout[-2000:]}")
         self.instances[label] = m.group(1)
         return m.group(1)
+
+    def _tenant_engine_dir(self, app: str) -> str:
+        """A per-app copy of the engine template with the datasource
+        appName swapped: `pio train` against it stamps env.appName =
+        the tenant, which is what the mux's app-filtered candidate
+        walk routes on. Same factory, same variant — every tenant's
+        instances live in ONE metadata namespace, disambiguated by the
+        app binding alone."""
+        dst = os.path.join(self.cfg.workdir, "engines", app)
+        if not os.path.isdir(dst):
+            shutil.copytree(self.cfg.engine_dir, dst)
+            path = os.path.join(dst, "engine.json")
+            with open(path) as f:
+                doc = json.load(f)
+            params = doc.setdefault("datasource", {}).setdefault(
+                "params", {})
+            params.pop("app_name", None)
+            params["appName"] = app
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return dst
+
+    def _train_tenants(self) -> None:
+        """One instance per non-primary app, BEFORE the primary's
+        initial train — the primary stays the newest COMPLETED row, so
+        the deploy's default load picks it and every other app is
+        served only through the mux."""
+        for app in self.plan.app_names[1:]:
+            self._train(f"tenant:{app}",
+                        engine_dir=self._tenant_engine_dir(app))
 
     def _launch_event_server(self) -> None:
         env = self._base_env()
@@ -921,6 +1005,8 @@ class SoakRunner:
         sess = requests.Session()
         period = 1.0 / rate
         nxt = time.monotonic()
+        apps = self.plan.app_names
+        n = 0
         while not self.stop.is_set():
             nxt += period * (0.5 + rng.random())
             delay = nxt - time.monotonic()
@@ -931,12 +1017,23 @@ class SoakRunner:
                 nxt = time.monotonic()
             user = rng.choices(range(cfg.users),
                                weights=self.plan.user_weights, k=1)[0]
+            headers = {"X-Pio-Deadline-Ms":
+                       f"{cfg.query_deadline_ms:.0f}"}
+            app = None
+            if cfg.tenant_apps:
+                # first sweep visits every app in order — guaranteed
+                # per-tenant coverage AND forced LRU churn (the sweep
+                # is wider than the resident bound) — then zipfian
+                app = (apps[(idx + n) % len(apps)] if n < len(apps)
+                       else self._pick(rng, apps,
+                                       self.plan.app_weights))
+                headers["X-Pio-App"] = app
+            n += 1
             t0 = time.monotonic()
             try:
                 r = sess.post(
                     base + "/queries.json", json={"user": f"u{user}"},
-                    headers={"X-Pio-Deadline-Ms":
-                             f"{cfg.query_deadline_ms:.0f}"},
+                    headers=headers,
                     timeout=max(15.0, cfg.query_deadline_ms / 1000 + 5))
             except requests.RequestException:
                 sess.close()
@@ -946,6 +1043,8 @@ class SoakRunner:
                 continue
             self.ledger.code("query", r.status_code,
                              time.monotonic() - self.t0, r.text)
+            if app is not None:
+                self.ledger.tenant_code(app, r.status_code)
             if r.status_code == 200:
                 with self.ledger.lock:
                     self.ledger.latencies.append(time.monotonic() - t0)
@@ -987,6 +1086,25 @@ class SoakRunner:
         for inst, reason in (directive.get("pinned") or {}).items():
             self.samples.note_rollback(
                 t_off, f"fleet:{inst}", f"directive pin {reason}")
+        tn = doc.get("tenants")
+        if isinstance(tn, dict):
+            with self.samples.lock:
+                # eviction counter is monotonic per process; keep the
+                # freshest snapshot (fleet scrapes splice to ONE
+                # replica per connection — a lower bound, like the
+                # cache counters below)
+                if (tn.get("evictions", 0)
+                        >= self.samples.tenants.get("evictions", 0)):
+                    self.samples.tenants = tn
+            # a mux tenant's own rollback pin is a rollback
+            # observation like any lifecycle/directive pin — a poison
+            # landing on a resident tenant must still satisfy the
+            # rollback-window row
+            for row in tn.get("tenants") or []:
+                for inst, reason in (row.get("pinned") or {}).items():
+                    self.samples.note_rollback(
+                        t_off, f"tenant:{row.get('app')}:{inst}",
+                        f"tenant {row.get('app')} pin {reason}")
         qc = doc.get("queryCache")
         if isinstance(qc, dict):
             # counters are monotonic per replica; keyed max() mirrors
@@ -1190,6 +1308,8 @@ class SoakRunner:
         started = time.time()
         mops = _host_loop_mops()
         self._setup_workspace()
+        if cfg.tenant_apps:
+            self._train_tenants()
         self._train("initial")
         self._launch_event_server()
         self._launch_engine()
@@ -1250,6 +1370,7 @@ class SoakRunner:
             }
         with self.samples.lock:
             query_cache = dict(self.samples.query_cache)
+            tenant_snap = dict(self.samples.tenants)
         scorecard = {
             "v": 1,
             "verdict": verdict,
@@ -1263,12 +1384,15 @@ class SoakRunner:
                 "apps": plan.app_names,
                 "foldinMs": cfg.foldin_ms,
                 "watchMs": cfg.swap_watch_ms,
+                "tenantApps": cfg.tenant_apps,
+                "tenantMaxResident": _tenant_resident(cfg),
             },
             "slos": slos,
             "faults": faults,
             "traffic": traffic,
             "freshness": freshness,
             "queryCache": query_cache,
+            "tenants": tenant_snap if cfg.tenant_apps else None,
             "drainRc": drain,
             "reconciliation": {k: v for k, v in reconciliation.items()
                                if k != "perMarker"},
@@ -1481,6 +1605,56 @@ def evaluate_slos(plan: SoakPlan, ledger: _Ledger, samples: _Samples,
         (f"{len(rollbacks)} rollback observation(s) vs {inv:.0f} cache"
          f" invalidation event(s), {hits + misses:.0f} lookups"
          if cache_armed else "cache disabled (query_cache_size=0)"))
+
+    # -- tenant isolation: per-tenant availability + LRU churn -------------
+    # One row per app, graded on that app's OWN evidence alone: a row
+    # reds only when ITS tenant was offered traffic and never answered
+    # a 200, or answered outside the contract — a hot tenant burning
+    # its admission budget (503 shed) can never red a cold neighbor.
+    # The mux must also have actually churned: with the resident bound
+    # below the app count, zero evictions means the LRU was never
+    # exercised and "N apps through one process" was not proven.
+    if cfg.tenant_apps:
+        with ledger.lock:
+            tcodes = {a: dict(c)
+                      for a, c in ledger.tenant_codes.items()}
+        with samples.lock:
+            tsnap = dict(samples.tenants)
+        bound = _tenant_resident(cfg)
+        rows = []
+        ok_t = True
+        for app in plan.app_names:
+            codes = tcodes.get(app, {})
+            offered = sum(codes.values())
+            accepted = codes.get(200, 0)
+            bad = {c: n for c, n in codes.items()
+                   if c not in (200, 503, 504)}
+            row_ok = (offered == 0) or (accepted >= 1 and not bad)
+            rows.append({"app": app, "ok": row_ok, "offered": offered,
+                         "accepted": accepted,
+                         "shed": codes.get(503, 0),
+                         "timeout": codes.get(504, 0), "bad": bad})
+            ok_t = ok_t and row_ok
+        unoffered = [r["app"] for r in rows if r["offered"] == 0]
+        # the query loops' opening sweep visits every app, so an
+        # unoffered tenant means the sweep never ran — red
+        ok_t = ok_t and not unoffered
+        evictions = tsnap.get("evictions")
+        churn_ok = (len(plan.app_names) <= bound
+                    or (evictions or 0) >= 1)
+        slo("tenant-isolation", ok_t and churn_ok,
+            {"perTenant": rows, "evictions": evictions,
+             "resident": tsnap.get("resident"),
+             "maxResident": tsnap.get("maxResident"),
+             "coldLoads": tsnap.get("coldLoads")},
+            plan.slos.get("tenant-isolation"),
+            f"{len(rows)} tenant row(s), "
+            f"{sum(r['accepted'] for r in rows)} accepted, "
+            f"{sum(r['shed'] for r in rows)} shed; "
+            + (f"{evictions} eviction(s), {tsnap.get('resident')}/"
+               f"{tsnap.get('maxResident')} resident"
+               if tsnap else "no tenants snapshot scraped")
+            + (f"; never offered: {unoffered}" if unoffered else ""))
 
     fired_by_name = {f["name"]: f for f in fault_log}
     fault_rows = []

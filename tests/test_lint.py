@@ -231,6 +231,41 @@ def test_seeded_models_dao_confinement(tmp_path):
     assert len(fs) == 1 and fs[0].path.endswith("sneaky.py")
 
 
+def test_seeded_tenant_confinement(tmp_path):
+    fs = findings_for(tmp_path, {
+        "workflow/sneaky.py": """
+            def peek(server):
+                # reaching into the mux's LRU skips the eviction
+                # refcount and the per-tenant pin isolation
+                return server._tenants._resident_lru.popitem()
+            """,
+        "workflow/multitenant.py": """
+            import collections
+            class TenantMux:
+                def __init__(self):
+                    self._resident_lru = collections.OrderedDict()
+                def _evict_victim(self):
+                    return None
+            """,
+    }, ["tenant-confinement"])
+    assert len(fs) == 1 and fs[0].path.endswith("sneaky.py")
+    assert "_resident_lru outside workflow/multitenant.py" in fs[0].message
+
+
+def test_seeded_tenant_chokepoint_rename_is_caught(tmp_path):
+    """Renaming the LRU attr in the chokepoint module must surface as a
+    finding, not silently disarm the guard."""
+    fs = findings_for(tmp_path, {
+        "workflow/multitenant.py": """
+            class TenantMux:
+                def __init__(self):
+                    self._lru = {}
+            """,
+    }, ["tenant-confinement"])
+    assert len(fs) == 1
+    assert "chokepoint" in fs[0].message and "renamed?" in fs[0].message
+
+
 def test_seeded_query_dispatch_gate(tmp_path):
     fs = findings_for(tmp_path, {"workflow/create_server.py": """
         import asyncio

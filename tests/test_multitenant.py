@@ -1,0 +1,439 @@
+"""Multi-tenant engine serving (ISSUE 19).
+
+The acceptance headline: ONE engine process serves 32 apps with a
+resident-model LRU smaller than the app count (evictions observed),
+every tenant answers 200 after a lazy reload, and a poisoned tenant's
+watch-breach pins/rolls back THAT app alone while every other tenant
+stays 200 — proven in-process (TenantMux unit semantics) AND in a REAL
+subprocess engine server.
+
+Isolation contracts under test:
+
+- routing: app header/param wins, access key resolves through the
+  AccessKeys repository, a BAD key is 401 — never a fallthrough to the
+  default tenant
+- resident cache: LRU-bounded by PIO_TENANT_MAX_RESIDENT, eviction
+  skips busy tenants (refcount), pins survive eviction
+- admission: PIO_TENANT_MAX_PENDING sheds a hot app 503 while a cold
+  tenant admits
+- lifecycle: a gate-passing poisoned instance trips the per-tenant
+  watch, is pinned, and the walk-back restores the tenant's previous
+  good instance — the neighbors never notice
+"""
+
+import os
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+import requests
+
+import lifecycle_engine
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+from incubator_predictionio_tpu.workflow import multitenant
+from incubator_predictionio_tpu.workflow.context import WorkflowContext
+from incubator_predictionio_tpu.workflow.core_workflow import run_train
+from incubator_predictionio_tpu.workflow.create_server import (
+    AdmissionShed,
+    EngineServer,
+)
+
+from server_utils import free_port
+
+pytestmark = [pytest.mark.multitenant]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _mem_storage():
+    return Storage({
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+    })
+
+
+def _train(storage, app, tag=None, mode="good"):
+    ctx = WorkflowContext(app_name=app, storage=storage)
+    iid = run_train(lifecycle_engine.engine_factory(),
+                    lifecycle_engine.engine_params(tag or app, mode),
+                    ctx, engine_factory_name="lifecycle")
+    time.sleep(0.002)  # strictly ordered start_times
+    return iid
+
+
+def _mk_app(storage, name):
+    return storage.get_meta_data_apps().insert(App(id=0, name=name))
+
+
+def _request(headers=None, query=None):
+    return types.SimpleNamespace(headers=headers or {},
+                                 query=query or {})
+
+
+def _server(storage, max_resident=2, max_pending=32, **kw):
+    return EngineServer(lifecycle_engine.engine_factory(),
+                        engine_factory_name="lifecycle",
+                        storage=storage,
+                        tenant_max_resident=max_resident,
+                        tenant_max_pending=max_pending, **kw)
+
+
+# ---------------------------------------------------------------------------
+# TenantMux unit semantics (in-process, real server + real storage)
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_app_routing_order_and_bad_key():
+    storage = _mem_storage()
+    app_id = _mk_app(storage, "tenant-a")
+    storage.get_meta_data_access_keys().insert(
+        AccessKey(key="KEY-A", appid=app_id, events=[]))
+    _train(storage, "default-app")
+    srv = _server(storage)
+    mux = srv._tenants
+    assert mux is not None
+    # app header / param name the tenant directly
+    assert mux.resolve_app(_request({"X-Pio-App": "tenant-a"})) \
+        == "tenant-a"
+    assert mux.resolve_app(_request(query={"app": "tenant-a"})) \
+        == "tenant-a"
+    # the app name wins over a key naming someone else
+    assert mux.resolve_app(_request(
+        {"X-Pio-App": "other"}, {"accessKey": "KEY-A"})) == "other"
+    # access key resolves through the AccessKeys repository (both
+    # carriers), and the result is TTL-cached
+    assert mux.resolve_app(_request(query={"accessKey": "KEY-A"})) \
+        == "tenant-a"
+    assert mux.resolve_app(_request({"X-Pio-Access-Key": "KEY-A"})) \
+        == "tenant-a"
+    assert "KEY-A" in mux._keys
+    # anonymous → default app (classic single-tenant path)
+    assert mux.resolve_app(_request()) is None
+    # a BAD key raises — never a fallthrough to the default tenant
+    with pytest.raises(multitenant.UnknownTenant):
+        mux.resolve_app(_request(query={"accessKey": "NO-SUCH-KEY"}))
+    # an unregistered app name is refused at admission (→ 404)
+    with pytest.raises(multitenant.UnknownTenant):
+        mux.admit("never-registered")
+
+
+def test_lru_eviction_bound_and_pins_survive_eviction():
+    storage = _mem_storage()
+    for name in ("t0", "t1", "t2"):
+        _mk_app(storage, name)
+        _train(storage, name)
+    _train(storage, "default-app")
+    srv = _server(storage, max_resident=2)
+    mux = srv._tenants
+
+    def query_once(app):
+        state = mux.admit(app)
+        try:
+            mux.ensure_loaded(state)
+            assert state.deployment is not None
+        finally:
+            mux.release(state)
+        return state
+
+    query_once("t0")
+    query_once("t1")
+    snap = mux.snapshot()
+    assert snap["resident"] == 2 and snap["evictions"] == 0
+    # loading t2 past the bound evicts the LRU tenant (t0)
+    s2 = query_once("t2")
+    snap = mux.snapshot()
+    assert snap["resident"] == 2 and snap["evictions"] == 1
+    rows = {r["app"]: r for r in snap["tenants"]}
+    assert not rows["t0"]["resident"] and rows["t2"]["resident"]
+    # the evicted tenant kept its lifecycle state but dropped the model
+    row0 = rows["t0"]
+    assert row0["instance"] is None and row0["loads"] == 1
+    # pins survive eviction: seed one on the RESIDENT t2, evict it via
+    # t0's reload, and check the parked row still carries it
+    s2.pinned["dead-beef"] = "validate"
+    query_once("t0")            # t1 was refreshed? no: LRU order t1, t2
+    snap = mux.snapshot()
+    rows = {r["app"]: r for r in snap["tenants"]}
+    assert rows["t0"]["resident"] and rows["t0"]["loads"] == 2
+    evicted = [a for a in ("t1", "t2") if not rows[a]["resident"]]
+    assert len(evicted) == 1 and evicted == ["t1"]
+    assert rows["t2"]["pinned"] == {"dead-beef": "validate"}
+    # ... and the eviction debt math adds up
+    assert snap["evictions"] == 2 and snap["coldLoads"] == 4
+
+
+def test_eviction_never_drops_a_tenant_mid_query():
+    storage = _mem_storage()
+    for name in ("busy", "b", "c"):
+        _mk_app(storage, name)
+        _train(storage, name)
+    _train(storage, "default-app")
+    srv = _server(storage, max_resident=2)
+    mux = srv._tenants
+    # "busy" holds an in-flight query (admit without release)
+    held = mux.admit("busy")
+    mux.ensure_loaded(held)
+    for name in ("b", "c"):
+        st = mux.admit(name)
+        mux.ensure_loaded(st)
+        mux.release(st)
+    rows = {r["app"]: r for r in mux.snapshot()["tenants"]}
+    # the LRU-oldest tenant is busy → the scan skipped it; "b" paid
+    assert rows["busy"]["resident"] and held.deployment is not None
+    assert not rows["b"]["resident"] and rows["c"]["resident"]
+    # the debt is collected at release: "busy" is now evictable, and
+    # the bound holds
+    mux.release(held)
+    snap = mux.snapshot()
+    assert snap["resident"] <= 2
+
+
+def test_per_tenant_admission_budget_sheds_hot_app_only():
+    storage = _mem_storage()
+    for name in ("hot", "cold"):
+        _mk_app(storage, name)
+        _train(storage, name)
+    _train(storage, "default-app")
+    srv = _server(storage, max_resident=4, max_pending=2)
+    mux = srv._tenants
+    a = mux.admit("hot")
+    b = mux.admit("hot")
+    with pytest.raises(AdmissionShed) as ei:
+        mux.admit("hot")
+    assert ei.value.reason == "tenant"
+    # the COLD tenant's budget is untouched: it admits fine
+    c = mux.admit("cold")
+    rows = {r["app"]: r for r in mux.snapshot()["tenants"]}
+    assert rows["hot"]["shed"] == 1 and rows["cold"]["shed"] == 0
+    for st in (a, b, c):
+        mux.release(st)
+    # budget freed: the hot app admits again
+    mux.release(mux.admit("hot"))
+
+
+def test_poisoned_tenant_rolls_back_alone_in_process():
+    """A gate-passing poisoned swap trips ONE tenant's watch; the
+    rollback restores ITS previous resident deployment instantly and
+    pins the bad instance — the neighbor tenant never notices."""
+    storage = _mem_storage()
+    for name in ("victim", "bystander"):
+        _mk_app(storage, name)
+        _train(storage, name)
+    _train(storage, "default-app")
+    srv = _server(storage, max_resident=4,
+                  swap_watch_ms=60_000, swap_max_error_rate=0.3)
+    mux = srv._tenants
+    for name in ("victim", "bystander"):
+        st = mux.admit(name)
+        mux.ensure_loaded(st)
+        mux.release(st)
+    victim = mux.admit("victim")
+    mux.release(victim)
+    good = victim.instance.id
+    # a NEWER poisoned instance (passes the golden-query gate) swaps in
+    # through the tenant's own publish path
+    bad = _train(storage, "victim", tag="victim-poison", mode="poison")
+    with victim.lock:
+        mux._load_tenant_locked(victim, bad)
+    assert victim.instance.id == bad
+    assert victim.previous is not None      # retained for the rollback
+    # golden queries pass, regular users explode → watch accounting
+    assert mux.note_result(victim, ok=True) is False
+    assert mux.note_result(victim, ok=False) is False   # errors=1: no trip
+    assert mux.note_result(victim, ok=False) is True    # errors=2: trip
+    restored = mux.rollback_tenant(victim, "error-rate")
+    assert restored is not None
+    assert victim.instance.id == good
+    assert victim.pinned == {bad: "error-rate"}
+    assert victim.rollbacks == {"error-rate": 1}
+    # the bystander tenant is untouched
+    rows = {r["app"]: r for r in mux.snapshot()["tenants"]}
+    assert rows["bystander"]["pinned"] == {}
+    assert rows["bystander"]["rollbacks"] == {}
+    assert rows["bystander"]["instance"] is not None
+    # a reload cannot re-pick the pinned poison: the walk skips it
+    evicted_then = mux.admit("victim")
+    mux.release(evicted_then)
+    assert evicted_then.instance.id == good
+
+
+# ---------------------------------------------------------------------------
+# subprocess e2e: the acceptance headline
+# ---------------------------------------------------------------------------
+
+N_APPS = 32
+MAX_RESIDENT = 6
+
+
+def _sqlite_env(tmp_path, **extra):
+    env = {
+        **os.environ,
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "DB",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "DB",
+        "PIO_STORAGE_SOURCES_DB_TYPE": "SQLITE",
+        "PIO_STORAGE_SOURCES_DB_PATH": str(tmp_path / "meta.sqlite"),
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "MEMORY",
+        "PIO_COMPILATION_CACHE": "0",
+        "JAX_PLATFORMS": "cpu",
+    }
+    env.pop("PIO_FAULT_SPEC", None)
+    env.update(extra)
+    return env
+
+
+def _storage_for(env):
+    return Storage({k: v for k, v in env.items()
+                    if k.startswith("PIO_STORAGE")})
+
+
+def _q(base, app, user, **kw):
+    return requests.post(base + "/queries.json", json={"user": user},
+                         headers={"X-Pio-App": app}, timeout=30, **kw)
+
+
+def test_32_apps_one_process_evictions_poison_isolated(tmp_path):
+    """One REAL subprocess serves 32 apps with 6 resident slots:
+    every app answers 200 (lazy load), evictions are observed, an
+    evicted tenant answers again after one reload, a bad access key is
+    401, and a poisoned tenant rolls back alone — all while a neighbor
+    keeps answering 200."""
+    env = _sqlite_env(tmp_path,
+                      PIO_TENANT_MAX_RESIDENT=str(MAX_RESIDENT),
+                      PIO_SWAP_WATCH_MS="60000",
+                      PIO_SWAP_MAX_ERROR_RATE="0.3")
+    storage = _storage_for(env)
+    apps = [f"app{i:02d}" for i in range(N_APPS)]
+    iids = {}
+    for name in apps:
+        app_id = _mk_app(storage, name)
+        storage.get_meta_data_access_keys().insert(
+            AccessKey(key=f"KEY-{name}", appid=app_id, events=[]))
+        iids[name] = _train(storage, name)
+    # the LAST trained app is the process's default deployment: its
+    # header-routed queries take the classic path (still 200)
+    default_app = apps[-1]
+
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "lifecycle_server.py"),
+         str(port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    "server died: "
+                    + proc.stdout.read().decode(errors="replace")[-3000:])
+            try:
+                requests.get(base + "/status", timeout=2)
+                break
+            except requests.RequestException:
+                time.sleep(0.2)
+        else:
+            raise AssertionError("server not ready")
+
+        # every tenant answers 200 on its FIRST query (lazy load), and
+        # each answers with ITS OWN model (the per-app tag round-trips)
+        for name in apps:
+            r = _q(base, name, "golden")
+            assert r.status_code == 200, (name, r.text)
+            assert r.json()["tag"] == name
+        doc = requests.get(base + "/status", timeout=5).json()
+        t = doc["tenants"]
+        assert t["maxResident"] == MAX_RESIDENT
+        assert t["resident"] <= MAX_RESIDENT
+        assert t["evictions"] >= N_APPS - 1 - MAX_RESIDENT, t
+        assert t["known"] >= N_APPS - 1    # default app rides classic
+
+        # an EVICTED tenant (app00 is LRU-oldest) answers after one
+        # lazy reload
+        rows = {r["app"]: r for r in t["tenants"]}
+        assert not rows["app00"]["resident"]
+        r = _q(base, "app00", "golden")
+        assert r.status_code == 200 and r.json()["tag"] == "app00"
+
+        # access-key routing end-to-end; a bad key is 401, never the
+        # default tenant's answer
+        r = requests.post(base + "/queries.json?accessKey=KEY-app01",
+                          json={"user": "golden"}, timeout=30)
+        assert r.status_code == 200 and r.json()["tag"] == "app01"
+        r = requests.post(base + "/queries.json?accessKey=WRONG",
+                          json={"user": "golden"}, timeout=30)
+        assert r.status_code == 401, r.text
+
+        # ---- poison ONE tenant -------------------------------------
+        poison_app = "app03"
+        bad_iid = _train(storage, poison_app,
+                         tag=f"{poison_app}-poison", mode="poison")
+        # app03 was evicted long ago: its next lazy load picks the
+        # newest instance — the poison — which PASSES the golden gate
+        r = _q(base, poison_app, "golden")
+        assert r.status_code == 200
+        assert r.json()["tag"] == f"{poison_app}-poison"
+        # first regular-user failure: not yet a breach (errors < 2)
+        assert _q(base, poison_app, "u1").status_code == 500
+        # second failure trips the watch; the rollback walk-back
+        # restores the good instance and the HEDGE answers THIS query
+        r = _q(base, poison_app, "u2")
+        assert r.status_code == 200, r.text
+        assert r.json()["tag"] == poison_app
+
+        doc = requests.get(base + "/status", timeout=5).json()
+        rows = {r["app"]: r for r in doc["tenants"]["tenants"]}
+        row = rows[poison_app]
+        assert row["pinned"].get(bad_iid) == "error-rate"
+        assert row["rollbacks"] == {"error-rate": 1}
+        assert row["instance"] == iids[poison_app]
+        # the rollback pinned THAT app alone: nobody else is pinned,
+        # degraded or rolled back
+        for name, other in rows.items():
+            if name == poison_app:
+                continue
+            assert other["pinned"] == {}, name
+            assert other["rollbacks"] == {}, name
+            assert other["degraded"] is None, name
+        # ... and the neighbors (resident AND evicted) still serve 200
+        for name in ("app00", "app01", "app10", "app30", default_app):
+            r = _q(base, name, "golden")
+            assert r.status_code == 200 and r.json()["tag"] == name
+        # the poisoned tenant stays on the restored instance
+        r = _q(base, poison_app, "u-after")
+        assert r.status_code == 200 and r.json()["tag"] == poison_app
+
+        # per-tenant telemetry made it to /metrics
+        metrics = requests.get(base + "/metrics", timeout=5).text
+        assert ('pio_tenant_rollbacks_total{app="%s"} 1' % poison_app
+                in metrics)
+        assert "pio_tenant_evictions_total" in metrics
+
+        # `pio status --engine-url` renders the per-tenant table with
+        # the warn marker on the pinned tenant
+        from incubator_predictionio_tpu.tools.commands.management import (
+            _print_engine_overload)
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            _print_engine_overload(base)
+        out = buf.getvalue()
+        assert "tenants:" in out
+        warn_lines = [ln for ln in out.splitlines()
+                      if poison_app in ln and "[warn]" in ln]
+        assert warn_lines, out
+        assert any("rollbacks=" in ln for ln in warn_lines)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        storage.close()

@@ -120,6 +120,64 @@ def test_cache_generation_guard_drops_stale_insert():
     assert c.get(k) == {"v": "fresh"}
 
 
+def test_cache_key_includes_app_id():
+    """ISSUE 19 fix: the same byte-identical query under two tenants
+    must key to DIFFERENT entries — tenant A's fold-in invalidation can
+    never serve tenant B a stale result (or vice versa)."""
+    q = {"user": "a", "num": 3}
+    kA = QueryResultCache.key_for(q, "app-A")
+    kB = QueryResultCache.key_for(q, "app-B")
+    kNone = QueryResultCache.key_for(q)
+    assert kA != kB and kA != kNone and kB != kNone
+    # user stays at index 0 (targeted invalidation contract unchanged)
+    assert kA[0] == "a" and kB[0] == "a"
+    # canonicalization still holds per app
+    assert kA == QueryResultCache.key_for({"num": 3, "user": "a"}, "app-A")
+    c = QueryResultCache(8, ttl_s=60.0)
+    c.put(kA, {"v": "A"})
+    assert c.get(kB) is None, "cross-tenant cache hit"
+    assert c.get(kA) == {"v": "A"}
+
+
+def test_cache_user_invalidation_is_app_scoped():
+    """invalidate_users(users, app=...) evicts only that tenant's
+    entries for those users; the same user under another tenant keeps
+    serving from cache."""
+    c = QueryResultCache(16, ttl_s=60.0)
+    kA = QueryResultCache.key_for({"user": "u", "num": 1}, "app-A")
+    kB = QueryResultCache.key_for({"user": "u", "num": 1}, "app-B")
+    c.put(kA, {"v": "A"})
+    c.put(kB, {"v": "B"})
+    assert c.invalidate_users(["u"], app="app-A") == 1
+    assert c.get(kA) is None
+    assert c.get(kB) == {"v": "B"}, \
+        "tenant A's fold-in evicted tenant B's entry"
+    # appless invalidation (single-tenant path) still sweeps by user only
+    assert c.invalidate_users(["u"]) == 1
+    assert c.get(kB) is None
+
+
+def test_cache_flush_app_evicts_one_tenant_only():
+    """A tenant rollback/swap flushes exactly that tenant's entries."""
+    c = QueryResultCache(16, ttl_s=60.0)
+    kA1 = QueryResultCache.key_for({"user": "u", "num": 1}, "app-A")
+    kA2 = QueryResultCache.key_for({"items": ["i1"]}, "app-A")
+    kB = QueryResultCache.key_for({"user": "u", "num": 1}, "app-B")
+    c.put(kA1, {"v": 1})
+    c.put(kA2, {"v": 2})
+    c.put(kB, {"v": 3})
+    gen = c.generation
+    assert c.flush_app("app-A", "tenant") == 2
+    assert c.get(kA1) is None and c.get(kA2) is None
+    assert c.get(kB) == {"v": 3}
+    # the generation guard covers app flushes too: an insert racing the
+    # flush (old-model result for tenant A) is dropped
+    c.put(kA1, {"v": "stale"}, gen)
+    assert c.get(kA1) is None
+    snap = c.snapshot()
+    assert snap["invalidations"] >= 1
+
+
 # ---------------------------------------------------------------------------
 # freshness footprint: marker producer + consumer
 # ---------------------------------------------------------------------------

@@ -168,6 +168,28 @@ def test_plan_primary_app_comes_from_engine_json(tmp_path):
     assert len(plan.app_names) == 3
 
 
+def test_plan_tenant_apps_widens_universe_and_adds_slo_row(tmp_path):
+    # tenant_apps widens the app universe past --apps and arms the
+    # tenant-isolation row with an auto resident bound BELOW the app
+    # count (so evictions are load-bearing, not incidental)
+    plan = plan_scenario(_cfg(tmp_path, apps=3, tenant_apps=8))
+    assert len(plan.app_names) == 8
+    assert "tenant-isolation" in plan.slos
+    assert "PIO_TENANT_MAX_RESIDENT=4" in " ".join(plan.notes)
+    text = plan.describe()
+    assert "tenants: mux armed" in text and "8 apps" in text
+    assert soak._tenant_resident(plan.cfg) == 4
+    # explicit bound wins; min-2 floor for tiny universes
+    assert soak._tenant_resident(
+        _cfg(tmp_path, tenant_apps=8, tenant_max_resident=5)) == 5
+    assert soak._tenant_resident(_cfg(tmp_path, tenant_apps=3)) == 2
+    # off: classic plan keeps the classic surface
+    p0 = plan_scenario(_cfg(tmp_path, apps=3))
+    assert len(p0.app_names) == 3
+    assert "tenant-isolation" not in p0.slos
+    assert "mux armed" not in p0.describe()
+
+
 # ---------------------------------------------------------------------------
 # ledger reconciliation (exactly-once census)
 # ---------------------------------------------------------------------------
@@ -219,11 +241,11 @@ def test_reconcile_ledger_counts_lost_dup_ambiguous(tmp_path):
 # SLO evaluator: a green fixture, then every red path seeded
 # ---------------------------------------------------------------------------
 
-def _green_fixture(tmp_path):
+def _green_fixture(tmp_path, **cfg_kw):
     """Plan + observations for a fully green soak (full menu, 2+2
     topology); each violation test perturbs exactly one input."""
     cfg = _cfg(tmp_path, event_workers=2, replicas=2,
-               rollback_deadline_s=30.0)
+               rollback_deadline_s=30.0, **cfg_kw)
     plan = plan_scenario(cfg)
     at = {f.name: f.at_s for f in plan.faults}
     ledger = soak._Ledger()
@@ -506,6 +528,82 @@ def test_slo_fault_evidence_red_per_fault_kind(tmp_path):
     assert "good_retrain" in _slo(slos, "fault-evidence")["value"]
 
 
+def _tenant_fixture(tmp_path, **cfg_kw):
+    """A green multi-tenant fixture: 6 apps through one mux-armed
+    process, every app offered traffic and answering, LRU churned."""
+    cfg_kw.setdefault("tenant_apps", 6)
+    fx = _green_fixture(tmp_path, **cfg_kw)
+    for app in fx["plan"].app_names:
+        fx["ledger"].tenant_codes[app] = {200: 5, 503: 1}
+    fx["samples"].tenants = {"evictions": 7, "resident": 3,
+                             "maxResident": 3, "coldLoads": 13}
+    return fx
+
+
+def test_slo_tenant_isolation_green_and_absent_when_off(tmp_path):
+    slos, _ = _eval(_green_fixture(tmp_path))
+    assert not any(s["name"] == "tenant-isolation" for s in slos)
+    slos, _ = _eval(_tenant_fixture(tmp_path))
+    row = _slo(slos, "tenant-isolation")
+    assert row["ok"], row
+    assert len(row["value"]["perTenant"]) == 6
+    assert row["value"]["evictions"] == 7
+
+
+def test_slo_tenant_hot_shed_never_reds_a_cold_neighbor(tmp_path):
+    # the satellite contract verbatim: a hot tenant burning its
+    # admission budget (503 shed storm) stays within ITS row's
+    # contract and the cold tenant's row never reds
+    fx = _tenant_fixture(tmp_path)
+    hot, cold = fx["plan"].app_names[0], fx["plan"].app_names[1]
+    fx["ledger"].tenant_codes[hot] = {200: 2, 503: 400}
+    fx["ledger"].tenant_codes[cold] = {200: 3}
+    slos, _ = _eval(fx)
+    assert _slo(slos, "tenant-isolation")["ok"]
+    # but a 500 reds the offending tenant's OWN row — and only it
+    fx = _tenant_fixture(tmp_path)
+    fx["ledger"].tenant_codes[hot] = {200: 4, 500: 1}
+    slos, _ = _eval(fx)
+    row = _slo(slos, "tenant-isolation")
+    assert not row["ok"]
+    rows = {r["app"]: r for r in row["value"]["perTenant"]}
+    assert not rows[hot]["ok"] and rows[hot]["bad"] == {500: 1}
+    assert all(r["ok"] for a, r in rows.items() if a != hot)
+
+
+def test_slo_tenant_unoffered_or_starved_tenant_reds(tmp_path):
+    # the query loops' opening sweep guarantees coverage: an app that
+    # was NEVER offered traffic means the sweep never ran — red
+    fx = _tenant_fixture(tmp_path)
+    missing = fx["plan"].app_names[-1]
+    del fx["ledger"].tenant_codes[missing]
+    slos, _ = _eval(fx)
+    row = _slo(slos, "tenant-isolation")
+    assert not row["ok"] and missing in row["detail"]
+    # offered but NEVER answered a 200 (all shed): that tenant's
+    # availability row reds
+    fx = _tenant_fixture(tmp_path)
+    fx["ledger"].tenant_codes[fx["plan"].app_names[2]] = {503: 9}
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "tenant-isolation")["ok"]
+
+
+def test_slo_tenant_churn_red_without_evictions(tmp_path):
+    # resident bound below the app count + zero evictions = the LRU
+    # was never exercised; "N apps through one process" is unproven
+    fx = _tenant_fixture(tmp_path)
+    fx["samples"].tenants = {"evictions": 0, "resident": 3,
+                             "maxResident": 3, "coldLoads": 6}
+    slos, _ = _eval(fx)
+    assert not _slo(slos, "tenant-isolation")["ok"]
+    # bound >= app count: nothing to evict, the churn leg is vacuous
+    fx = _tenant_fixture(tmp_path, tenant_max_resident=6)
+    fx["samples"].tenants = {"evictions": 0, "resident": 6,
+                             "maxResident": 6, "coldLoads": 6}
+    slos, _ = _eval(fx)
+    assert _slo(slos, "tenant-isolation")["ok"]
+
+
 # ---------------------------------------------------------------------------
 # X-Pio-Ack: per-request ack-mode override on the event server
 # ---------------------------------------------------------------------------
@@ -566,6 +664,20 @@ def test_pio_soak_dry_run_prints_plan_without_launching(tmp_path,
     assert capsys.readouterr().out == out
     # nothing was created in the scratch area of the plan
     assert not (tmp_path / "wd").exists()
+
+
+def test_pio_soak_dry_run_tenant_flags(tmp_path, capsys):
+    from incubator_predictionio_tpu.tools.commands.soak import soak_cmd
+
+    tpl = _template(tmp_path)
+    rc = soak_cmd(["--engine-dir", tpl, "--dry-run", "--seed", "7",
+                   "--duration-s", "30", "--tenant-apps", "8",
+                   "--tenant-max-resident", "3"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tenants: mux armed" in out and "3 resident" in out
+    assert "PIO_TENANT_MAX_RESIDENT=3" in out
+    assert "tenant-isolation" in out
 
 
 def test_pio_status_soak_one_liner(tmp_path, capsys, monkeypatch):
@@ -655,6 +767,45 @@ def test_smoke_soak_scaled_down_topology_full_slo_path(tmp_path):
     assert on_disk and on_disk["verdict"] == "PASS"
     # the workdir was cleaned up (keep_workdir defaults off)
     assert not (tmp_path / "wd").exists()
+
+
+@pytest.mark.slow
+@pytest.mark.multitenant
+def test_multitenant_soak_per_tenant_slo_rows(tmp_path):
+    """ISSUE 19 acceptance (soak leg): one mux-armed engine process
+    serves the whole app universe — per-app instances trained up
+    front, zipfian X-Pio-App traffic after a guaranteed-coverage
+    sweep, resident LRU churning below the app count, and a poisoned
+    fold-in rolled back while EVERY tenant's availability row stays
+    green."""
+    # fold-in slower than the watch can trip: successive increments
+    # each re-arm (supersede) the watch, and once a SECOND poisoned
+    # increment is live the hedge's differential diagnosis (previous
+    # also explodes) stops counting errors — the first poisoned
+    # window must see >= 2 hedge-confirmed errors before the next
+    # increment lands, so the primary needs real traffic share
+    # (seed 45: 46% zipf weight) and a fold-in period of ~1.2s
+    cfg = SoakConfig(
+        engine_dir=_template(tmp_path), workdir=str(tmp_path / "wd"),
+        seed=45, duration_s=16.0, event_workers=1, replicas=0,
+        apps=2, tenant_apps=5, ingest_rps=12.0, query_rps=12.0,
+        faults=("enospc_shed", "poison_foldin"),
+        quality_sample=0.0,
+        foldin_ms=1200.0, refresh_ms=300.0, swap_watch_ms=2500.0,
+        rollback_deadline_s=25.0, freshness_settle_s=15.0,
+        out_path=str(tmp_path / "SOAK.json"))
+    scorecard = _run(cfg)
+    assert scorecard["topology"]["tenantApps"] == 5
+    assert scorecard["topology"]["tenantMaxResident"] == 2
+    row = next(s for s in scorecard["slos"]
+               if s["name"] == "tenant-isolation")
+    per = row["value"]["perTenant"]
+    assert len(per) == 5
+    assert all(r["offered"] >= 1 and r["accepted"] >= 1 for r in per)
+    # the LRU actually churned: 4 mux tenants through 2 resident slots
+    assert (row["value"]["evictions"] or 0) >= 1
+    # the scorecard keeps the scraped tenants table for post-mortems
+    assert scorecard["tenants"]["maxResident"] == 2
 
 
 @pytest.mark.slow
