@@ -123,6 +123,14 @@ class FrontProxy:
         # assumed ready, so fronts that never probe behave exactly as
         # before the hardening
         self._ready: dict[int, bool] = {}
+        # draining marks: a backend the owner is INTENTIONALLY taking
+        # out of rotation (elastic scale-down). Unlike not-ready — which
+        # still admits the backend on the all-else-refused fallback
+        # pass — a draining backend gets NO new connections at all: its
+        # in-flight splices finish, and clients reconnect to survivors.
+        # A freed slot keeps its index with ``worker_ports[idx] = None``
+        # so slot identity stays stable across scale cycles.
+        self._draining: dict[int, bool] = {}
         self._rr = 0
         self._server: Optional[asyncio.AbstractServer] = None
         # live connection tasks: stop() must be able to cut idle
@@ -137,9 +145,36 @@ class FrontProxy:
     def is_ready(self, idx: int) -> bool:
         return self._ready.get(idx, True)
 
+    def set_draining(self, idx: int, draining: bool) -> None:
+        self._draining[idx] = bool(draining)
+
+    def is_draining(self, idx: int) -> bool:
+        return self._draining.get(idx, False)
+
+    def set_backend(self, idx: int, port: Optional[int]) -> None:
+        """Assign (or free, with ``None``) the backend slot ``idx``,
+        extending the slot list as needed — the elastic owner's hook.
+        Freeing a slot clears its readiness/draining marks so a later
+        occupant starts with the unprobed defaults."""
+        while len(self.worker_ports) <= idx:
+            self.worker_ports.append(None)
+        self.worker_ports[idx] = port
+        if port is None:
+            self._ready.pop(idx, None)
+            self._draining.pop(idx, None)
+
+    def _routable(self, idx: int) -> bool:
+        return (self.worker_ports[idx] is not None
+                and not self._draining.get(idx, False))
+
+    def active_count(self) -> int:
+        """Slots holding a routable (assigned, not draining) backend."""
+        return sum(1 for i in range(len(self.worker_ports))
+                   if self._routable(i))
+
     def ready_count(self) -> int:
-        n = len(self.worker_ports)
-        return sum(1 for i in range(n) if self._ready.get(i, True))
+        return sum(1 for i in range(len(self.worker_ports))
+                   if self._routable(i) and self._ready.get(i, True))
 
     async def _connect_backend(self):
         loop = asyncio.get_running_loop()
@@ -147,12 +182,16 @@ class FrontProxy:
                     if self.connect_retry_s > 0 else None)
         while True:
             n = len(self.worker_ports)
-            # two passes: ready backends first, then everyone — a fleet
-            # with zero ready replicas still routes (a draining-but-
-            # alive replica answering 503s beats a refused connect)
+            # two passes: ready backends first, then every ROUTABLE one
+            # — a fleet with zero ready replicas still routes (a
+            # not-ready-but-alive replica answering 503s beats a
+            # refused connect). Draining and freed slots are excluded
+            # from BOTH passes: drain means no new connections, period.
             for ready_only in (True, False):
                 for i in range(n):
                     j = (self._rr + i) % n
+                    if not self._routable(j):
+                        continue
                     if ready_only and not self._ready.get(j, True):
                         continue
                     try:
@@ -162,7 +201,8 @@ class FrontProxy:
                         continue
                     self._rr = (j + 1) % n
                     return r, w
-                if all(self._ready.get(i, True) for i in range(n)):
+                if all(self._ready.get(i, True) for i in range(n)
+                       if self._routable(i)):
                     break  # second pass would retry the identical set
             if deadline is None or loop.time() >= deadline:
                 return None

@@ -99,9 +99,10 @@ log = logging.getLogger("pio.eventlog")
 __all__ = [
     "ArchivedGenerationError", "Lease", "PartitionFencedError",
     "PartitionHeldError", "archive_generation", "claim_partition",
-    "compact_log", "lease_info", "load_chain", "load_snapshot",
-    "parse_floor", "partition_health", "restore_generation",
-    "retire_expired", "run_partitioned_event_server", "scrub_log_dir",
+    "compact_log", "front_info_path", "lease_info", "load_chain",
+    "load_snapshot", "parse_floor", "partition_health",
+    "restore_generation", "retire_expired",
+    "run_partitioned_event_server", "scrub_log_dir",
 ]
 
 _M_SNAP_LOADS = telemetry.registry().counter(
@@ -1338,6 +1339,15 @@ def worker_env(idx: int, port: int, wal_dir: Optional[str]) -> dict:
     return env
 
 
+def front_info_path() -> str:
+    """Where a running partitioned front advertises itself (pid, ports,
+    live worker count, scale-target file) for ``pio eventserver scale``
+    and ``pio status``."""
+    from ..storage.registry import base_dir
+
+    return os.path.join(base_dir(), "eventserver_front.json")
+
+
 def run_partitioned_event_server(host: str, port: int, workers: int,
                                  enable_stats: bool = False) -> int:
     """Blocking entry for ``pio eventserver --workers N``: spawn N
@@ -1346,7 +1356,22 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
 
     Chaos hook: ``PIO_EVENT_WORKER_FAULT_SPEC`` is applied as each
     worker's ``PIO_FAULT_SPEC`` on the FIRST launch only — a restarted
-    worker comes up clean, so an injected crash can't relaunch-loop."""
+    worker comes up clean, so an injected crash can't relaunch-loop.
+
+    **Runtime rescale** (elastic topology): ``pio eventserver scale N``
+    writes the target into the front's scale file and SIGHUPs it (a
+    bare SIGHUP re-reads the file too). Scale-up adds workers at the
+    next free partition indices through the supervisor's dynamic
+    membership. Scale-down always retires the HIGHEST indices so
+    partitions stay dense: the front stops routing new connections to
+    the departing worker, the worker's own SIGTERM path drains its
+    group commits and releases its partition lease, and the front then
+    claims the orphaned lease with an epoch bump (structurally fencing
+    any wedged straggler writer — the PR 8 fence semantics), replays
+    the partition's WAL subdir (the exactly-once safety net for acked
+    events a crashed drain left uncommitted), and PARKS the lease until
+    a future scale-up hands it — released, for a fresh claim — to the
+    newcomer. The orphaned shard stays readable via the merged view."""
     from . import ingest_wal
     from ...parallel.supervisor import Supervisor
 
@@ -1369,7 +1394,7 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
         except Exception:  # noqa: BLE001 — serve; operator replays
             log.exception("root WAL recovery failed; run `pio wal "
                           "replay` once storage is healthy")
-    ports = [Supervisor._free_port() for _ in range(workers)]
+    ports: list = [Supervisor._free_port() for _ in range(workers)]
     base_env = dict(os.environ)
     chaos = base_env.pop("PIO_EVENT_WORKER_FAULT_SPEC", None)
     # per-partition chaos (the soak driver's fault timeline):
@@ -1419,6 +1444,74 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
              "on ports %s (run dir %s)", host, port, workers, ports,
              sup.run_dir)
 
+    # runtime-rescale state (all mutated on the front's event loop):
+    # live partition indices, indices mid-retirement, and the orphaned
+    # partition leases the front holds parked after a scale-down
+    live: set = set(range(workers))
+    retiring: set = set()
+    parked: dict = {}
+    scale_path = os.path.join(sup.run_dir, "scale_target")
+    info_path = front_info_path()
+    le_dir = None
+    try:
+        from ..storage.registry import Storage as _Storage
+
+        le_dir = getattr(_Storage.instance().get_l_events(), "_dir", None)
+    except Exception:  # noqa: BLE001 — non-JSONL store: no leases
+        log.debug("event store has no JSONL dir; lease handoff off",
+                  exc_info=True)
+
+    def publish_info() -> None:
+        doc = {"pid": os.getpid(), "host": host, "port": port,
+               "workers": sorted(live), "retiring": sorted(retiring),
+               "parkedPartitions": sorted(parked),
+               "scaleFile": scale_path, "runDir": sup.run_dir}
+        tmp = info_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, info_path)
+        except OSError:  # pragma: no cover — basedir ripped out
+            log.debug("could not publish front info", exc_info=True)
+
+    def read_scale_target() -> Optional[int]:
+        try:
+            with open(scale_path) as f:
+                return max(1, int(f.read().strip()))
+        except (OSError, ValueError):
+            return None
+
+    def adopt_partition(idx: int) -> None:
+        """Post-retirement handoff: claim the orphan's lease (epoch
+        bump fences any straggler) and replay its WAL subdir — every
+        acked event lands exactly once even when the drain died
+        mid-commit. The lease stays parked on the front."""
+        if le_dir is not None and idx not in parked:
+            try:
+                parked[idx] = claim_partition(le_dir, idx)
+            except PartitionHeldError:
+                # the dead worker's flock is gone with it; a HELD flock
+                # here means a wedged straggler — fence past it, the
+                # epoch bump stops its next write group cold
+                parked[idx] = claim_partition(le_dir, idx, force=True)
+        if wal_cfg.enabled:
+            pdir = os.path.join(wal_cfg.dir, f"p{idx}")
+            if os.path.isdir(pdir):
+                try:
+                    from ..storage.registry import Storage as _S
+
+                    pcfg = ingest_wal.WalConfig(
+                        enabled=True, fsync=wal_cfg.fsync, dir=pdir,
+                        segment_bytes=wal_cfg.segment_bytes)
+                    rec = ingest_wal.recover(_S.instance(), pcfg)
+                    if rec["replayed"] or rec["deduped"]:
+                        log.info("rebalance replayed %d WAL event(s) "
+                                 "from partition %d (%d deduped)",
+                                 rec["replayed"], idx, rec["deduped"])
+                except Exception:  # noqa: BLE001 — operator replays
+                    log.exception("partition %d WAL replay failed; run "
+                                  "`pio wal replay` when healthy", idx)
+
     async def front_main() -> None:
         from ...common import envknobs
 
@@ -1432,6 +1525,7 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
             "PIO_EVENT_CONNECT_RETRY_MS", 0.0))
         await proxy.start(host, port)
         stop = asyncio.Event()
+        rescale = asyncio.Event()
         loop = asyncio.get_running_loop()
         import signal as _signal
         for sig in (_signal.SIGTERM, _signal.SIGINT):
@@ -1439,6 +1533,71 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
                 loop.add_signal_handler(sig, stop.set)
             except (NotImplementedError, RuntimeError):  # pragma: no cover
                 pass
+        try:
+            loop.add_signal_handler(_signal.SIGHUP, rescale.set)
+        except (NotImplementedError, RuntimeError,
+                AttributeError):  # pragma: no cover — non-POSIX
+            pass
+        await asyncio.to_thread(publish_info)
+
+        def apply_target(target: int) -> None:
+            # dense partitions: grow at the lowest free index, shrink
+            # from the top — writes route to each worker's OWN shard,
+            # so membership is purely "which indices are live"
+            current = sorted(live)
+            while len(live) - len(retiring) < target:
+                idx = 0
+                while idx in live:
+                    idx += 1
+                lease = parked.pop(idx, None)
+                if lease is not None:
+                    # hand the parked lease to the newcomer: release,
+                    # and its startup claim bumps the epoch again
+                    lease.release()
+                while len(ports) <= idx:
+                    ports.append(None)
+                ports[idx] = Supervisor._free_port()
+                proxy.set_backend(idx, ports[idx])
+                live.add(idx)
+                sup.add_worker(idx)
+                log.info("rescale: worker %d spawning (target %d)",
+                         idx, target)
+            victims = [i for i in current if i not in retiring]
+            while len(live) - len(retiring) > target and victims:
+                idx = victims.pop()  # highest live index
+                proxy.set_draining(idx, True)
+                retiring.add(idx)
+                sup.retire_worker(idx)
+                log.info("rescale: worker %d draining (target %d)",
+                         idx, target)
+
+        async def rescale_loop() -> None:
+            while True:
+                if retiring:
+                    await asyncio.sleep(0.1)
+                else:
+                    await rescale.wait()
+                rescale.clear()
+                for idx in sorted(retiring, reverse=True):
+                    if sup.worker_pid(idx) is None \
+                            and not sup.is_retiring(idx):
+                        # booked out: drained, lease released — adopt
+                        await asyncio.to_thread(adopt_partition, idx)
+                        proxy.set_backend(idx, None)
+                        ports[idx] = None
+                        retiring.discard(idx)
+                        live.discard(idx)
+                        log.info("rescale: worker %d retired; "
+                                 "partition lease parked on the front",
+                                 idx)
+                        await asyncio.to_thread(publish_info)
+                target = await asyncio.to_thread(read_scale_target)
+                if target is not None \
+                        and target != len(live) - len(retiring):
+                    apply_target(target)
+                    await asyncio.to_thread(publish_info)
+
+        rescaler = loop.create_task(rescale_loop())
         # the front lives exactly as long as its workers: a supervisor
         # that gave up (restart budget exhausted) must take the front
         # down rather than keep accepting connections nothing can serve
@@ -1447,10 +1606,26 @@ def run_partitioned_event_server(host: str, port: int, workers: int,
                 await asyncio.wait_for(stop.wait(), timeout=0.25)
             except asyncio.TimeoutError:
                 pass
+        rescaler.cancel()
+        try:
+            await rescaler
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
         await proxy.stop()
         sup.request_stop()
 
-    asyncio.run(front_main())
+    try:
+        asyncio.run(front_main())
+    finally:
+        for lease in parked.values():
+            try:
+                lease.release()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        try:
+            os.unlink(info_path)
+        except OSError:
+            pass
     sup_done.wait(timeout=60)
     t.join(timeout=5)
     state = outcome.get("state", "drained")

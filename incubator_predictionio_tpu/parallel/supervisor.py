@@ -408,6 +408,18 @@ class Supervisor:
         self._workers: list[_Worker] = []
         self._stop = threading.Event()
         self._attempt = 0
+        # dynamic membership (restart_scope="worker" only): add/retire
+        # requests land here from any thread and are applied by the
+        # supervision loop itself, so every spawn happens on the
+        # SUPERVISOR thread — pdeathsig binds to the spawning thread,
+        # and a late-added worker must share the initial workers'
+        # parent-death contract, not a shorter-lived caller's
+        self._membership_lock = threading.Lock()
+        self._membership_cmds: list[tuple[str, int]] = []
+        # idx -> monotonic SIGKILL deadline; a retiring worker is
+        # EXPECTED to exit, so the any-exit-is-failure service rule and
+        # the stall detector both skip it
+        self._retiring: dict[int, float] = {}
         os.makedirs(self.run_dir, exist_ok=True)
 
     # -- plumbing ----------------------------------------------------------
@@ -439,6 +451,121 @@ class Supervisor:
     def worker_pids(self) -> list[Optional[int]]:
         return [w.proc.pid if w.proc.poll() is None else None
                 for w in self._workers]
+
+    def _worker_by_idx(self, idx: int) -> Optional[_Worker]:
+        for w in self._workers:
+            if w.idx == idx:
+                return w
+        return None
+
+    def worker_pid(self, idx: int) -> Optional[int]:
+        """Keyed pid lookup — positional ``worker_pids()`` stops being
+        meaningful once dynamic membership leaves index gaps."""
+        w = self._worker_by_idx(idx)
+        if w is None or w.proc.poll() is not None:
+            return None
+        return w.proc.pid
+
+    def live_worker_indices(self) -> list[int]:
+        """Indices on the books and not mid-retirement."""
+        return sorted(w.idx for w in self._workers
+                      if w.idx not in self._retiring)
+
+    def is_retiring(self, idx: int) -> bool:
+        return idx in self._retiring
+
+    # -- dynamic membership (service scope) --------------------------------
+
+    def add_worker(self, idx: Optional[int] = None) -> int:
+        """Enqueue a NEW service worker at slot ``idx`` (lowest free
+        slot when None); returns the slot. The spawn itself happens on
+        the supervision thread at its next sweep — same heartbeat
+        registration, restart budget, and parent-death arming as a
+        launch-time worker. Thread-safe; ``restart_scope='worker'``
+        only (a gang's size is its collective's world size)."""
+        if self.restart_scope != "worker":
+            raise RuntimeError("dynamic membership requires "
+                               "restart_scope='worker'")
+        with self._membership_lock:
+            taken = {w.idx for w in self._workers}
+            taken.update(i for op, i in self._membership_cmds
+                         if op == "add")
+            if idx is None:
+                idx = 0
+                while idx in taken:
+                    idx += 1
+            elif idx in taken:
+                raise ValueError(f"worker {idx} is already on the books")
+            self._membership_cmds.append(("add", int(idx)))
+        return int(idx)
+
+    def retire_worker(self, idx: int) -> None:
+        """Enqueue a graceful retirement of worker ``idx``: the
+        supervision thread SIGTERMs it (the worker's normal drain
+        path), exempts it from failure detection, and books it out
+        when it exits — SIGKILL only past the drain budget. Thread-
+        safe; ``restart_scope='worker'`` only."""
+        if self.restart_scope != "worker":
+            raise RuntimeError("dynamic membership requires "
+                               "restart_scope='worker'")
+        with self._membership_lock:
+            self._membership_cmds.append(("retire", int(idx)))
+
+    def _apply_membership(self) -> None:
+        """Drain queued add/retire commands (supervision thread)."""
+        with self._membership_lock:
+            cmds, self._membership_cmds = self._membership_cmds, []
+        for op, idx in cmds:
+            if op == "add":
+                if self._worker_by_idx(idx) is not None:
+                    continue  # raced a concurrent add of the same slot
+                while len(self.worker_restarts) <= idx:
+                    self.worker_restarts.append(0)
+                self._workers.append(
+                    self._spawn_worker(idx, None, resume=False, attempt=0))
+                self._event("workerAdded", worker=idx)
+                log.info("service worker %d added (now %d on the books)",
+                         idx, len(self._workers))
+            else:
+                w = self._worker_by_idx(idx)
+                if w is None or idx in self._retiring:
+                    continue
+                self._retiring[idx] = (time.monotonic()
+                                       + self.config.drain_ms / 1000.0)
+                if w.proc.poll() is None:
+                    try:
+                        w.proc.send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+                self._event("workerRetireStart", worker=idx)
+                log.info("service worker %d retiring (drain budget "
+                         "%.1fs)", idx, self.config.drain_ms / 1000.0)
+
+    def _reap_retiring(self) -> None:
+        """Book out retiring workers that exited; SIGKILL past the
+        drain deadline (supervision thread)."""
+        if not self._retiring:
+            return
+        now = time.monotonic()
+        for idx in list(self._retiring):
+            w = self._worker_by_idx(idx)
+            if w is None:
+                del self._retiring[idx]
+                continue
+            rc = w.proc.poll()
+            if rc is None and now >= self._retiring[idx]:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+                w.proc.wait()
+                rc = w.proc.poll()
+            if rc is not None:
+                self._workers.remove(w)
+                del self._retiring[idx]
+                self._event("workerRetired", worker=idx, rc=rc)
+                log.info("service worker %d retired (rc %s, %d still "
+                         "on the books)", idx, rc, len(self._workers))
 
     # -- gang lifecycle ----------------------------------------------------
 
@@ -542,6 +669,7 @@ class Supervisor:
                 "alive": alive,
                 "returncode": w.proc.poll(),
                 "heartbeatAgeMs": age,
+                "retiring": w.idx in self._retiring,
                 "restarts": (self.worker_restarts[w.idx]
                              if w.idx < len(self.worker_restarts) else 0),
                 "log": w.log_path,
@@ -606,6 +734,8 @@ class Supervisor:
         cfg = self.config
         now = time.monotonic()
         for w in self._workers:
+            if w.idx in self._retiring:
+                continue  # an exit is the POINT of retirement
             rc = w.proc.poll()
             if rc is not None:
                 return {"reason": "exit", "worker": w.idx, "rc": rc}
@@ -642,12 +772,14 @@ class Supervisor:
                 self.state = DRAINED
                 self._publish(0.0)
                 log.info("service drained cleanly (%d worker(s))",
-                         cfg.num_workers)
+                         len(self._workers))
                 return DRAINED
+            self._apply_membership()
+            self._reap_retiring()
             failure = self._check_service_failure()
             if failure is not None:
                 idx = failure["worker"]
-                bad = self._workers[idx]
+                bad = self._worker_by_idx(idx)
                 log.warning("service worker %d failed (%s); relaunching "
                             "it. log tail:\n%s", idx, failure,
                             self._tail(bad))
@@ -660,6 +792,8 @@ class Supervisor:
                     bad.proc.wait()
                 restarts_c, *_ = _metrics()
                 restarts_c.labels(failure["reason"]).inc()
+                while len(per_worker_restarts) <= idx:
+                    per_worker_restarts.append(0)
                 per_worker_restarts[idx] += 1
                 self.restarts += 1
                 if per_worker_restarts[idx] > cfg.max_restarts:
@@ -683,9 +817,9 @@ class Supervisor:
                 if self._stop.wait(delay):
                     continue
                 self._attempt = per_worker_restarts[idx]
-                self._workers[idx] = self._spawn_worker(
-                    idx, None, resume=False,
-                    attempt=per_worker_restarts[idx])
+                self._workers[self._workers.index(bad)] = \
+                    self._spawn_worker(idx, None, resume=False,
+                                       attempt=per_worker_restarts[idx])
                 self._publish(1.0)
             now = time.monotonic()
             if now - last_publish >= 1.0:

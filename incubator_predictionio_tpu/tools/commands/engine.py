@@ -328,11 +328,16 @@ def deploy_cmd(args: list[str]) -> int:
                         "each tenant with its own validation gate, "
                         "watch/rollback/pin lifecycle, fold-in cursor "
                         "and admission budget ($PIO_TENANT_MAX_PENDING)")
-    p.add_argument("--replicas", type=int, default=None, metavar="N",
+    p.add_argument("--replicas", default=None, metavar="N|auto",
                    help="serve as a fleet of N supervised engine-server "
                         "processes behind an L4 splice front with a "
                         "staged canary rollout (default "
-                        "$PIO_QUERY_REPLICAS, else 0 = single process)")
+                        "$PIO_QUERY_REPLICAS, else 0 = single process). "
+                        "'auto' arms elastic mode: the fleet starts at "
+                        "$PIO_FLEET_MIN_REPLICAS and sizes itself "
+                        "within [$PIO_FLEET_MIN_REPLICAS, "
+                        "$PIO_FLEET_MAX_REPLICAS] from live shed/queue "
+                        "telemetry (workflow/elastic.py)")
     p.add_argument("--replica-worker", action="store_true",
                    help=argparse.SUPPRESS)  # internal: fleet replica
     ns = p.parse_args(args)
@@ -350,10 +355,20 @@ def deploy_cmd(args: list[str]) -> int:
 
     if ns.replica_worker:
         return _deploy_replica_worker(ns)
-    replicas = (ns.replicas if ns.replicas is not None
-                else envknobs.env_int("PIO_QUERY_REPLICAS", 0, lo=0))
-    if replicas >= 1:
-        return _deploy_fleet(args, ns, replicas)
+    raw = (str(ns.replicas) if ns.replicas is not None
+           else envknobs.env_str("PIO_QUERY_REPLICAS", "0"))
+    elastic = raw.strip().lower() == "auto"
+    if elastic:
+        replicas = 0  # run_fleet starts at the operator floor
+    else:
+        try:
+            replicas = max(0, int(raw))
+        except ValueError:
+            print(f"[error] --replicas expects an integer or 'auto', "
+                  f"got {raw!r}", file=sys.stderr)
+            return 1
+    if replicas >= 1 or elastic:
+        return _deploy_fleet(args, ns, replicas, elastic)
     from ...workflow.create_server import run_engine_server
 
     server = _build_engine_server(ns)
@@ -432,13 +447,14 @@ def _strip_replicas(args: list[str]) -> list[str]:
     return out
 
 
-def _deploy_fleet(args: list[str], ns, replicas: int) -> int:
+def _deploy_fleet(args: list[str], ns, replicas: int,
+                  elastic: bool = False) -> int:
     """`pio deploy --replicas N` front: the fleet coordinator + splice
     front (workflow/fleet.py) supervising N `--replica-worker` copies
     of this exact command. The front never imports the engine module
     (factory/variant names come straight from engine.json), so it stays
     light while the replicas carry the models."""
-    from ...common import ssl_context_from_env
+    from ...common import envknobs, ssl_context_from_env
     from ...workflow.fleet import run_fleet
 
     if ssl_context_from_env() is not None:
@@ -474,9 +490,15 @@ def _deploy_fleet(args: list[str], ns, replicas: int) -> int:
               "fleet on an older version, roll back to it (`pio models "
               "rollback --engine-url <front>`) so the newer instance "
               "is pinned", file=sys.stderr)
-    print(f"[info] Engine fleet: {replicas} replica(s) behind "
-          f"{ns.ip}:{ns.port} (staged canary rollout; front /healthz "
-          "aggregates liveness)")
+    if elastic:
+        print(f"[info] Engine fleet: elastic replicas behind "
+              f"{ns.ip}:{ns.port} (autoscaler armed; bounds from "
+              "PIO_FLEET_MIN/MAX_REPLICAS, staged canary rollout, "
+              "front /healthz aggregates liveness + scaler state)")
+    else:
+        print(f"[info] Engine fleet: {replicas} replica(s) behind "
+              f"{ns.ip}:{ns.port} (staged canary rollout; front "
+              "/healthz aggregates liveness)")
     # with the tenant mux armed, every replica serves N apps but the
     # fleet COORDINATOR stages rollouts for the default app only: an
     # unconfined candidate walk would promote some tenant's fold-in
@@ -488,7 +510,8 @@ def _deploy_fleet(args: list[str], ns, replicas: int) -> int:
         fleet_app = ds.get("appName") or ds.get("app_name") or ""
     return run_fleet(worker_argv, replicas, ns.ip, ns.port,
                      engine_factory_name=factory,
-                     engine_variant=variant, app_name=fleet_app)
+                     engine_variant=variant, app_name=fleet_app,
+                     elastic=elastic)
 
 
 def _deploy_replica_worker(ns) -> int:

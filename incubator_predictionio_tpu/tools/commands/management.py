@@ -245,6 +245,47 @@ def _print_engine_overload(url: str) -> None:
     fleet = doc.get("fleet")
     if fleet:
         _print_fleet(fleet)
+    _print_autoscaler(base)
+
+
+def _fetch_json(url: str, timeout: float = 5.0) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def _print_autoscaler(base: str) -> None:
+    """The elastic-fleet line off the FRONT's /healthz (the front
+    intercepts it; a single-process server or a fixed fleet has no
+    `elastic` dict and prints nothing): current/target/min/max, the
+    last acted decision with reason + age, and what the loop is saying
+    right now."""
+    try:
+        doc = _fetch_json(f"{base}/healthz", timeout=3.0)
+    except Exception:  # noqa: BLE001 — no front: nothing to print
+        return
+    el = (doc or {}).get("elastic")
+    if not el:
+        return
+    import time as _time
+
+    acted = el.get("decisions") or []
+    last = acted[-1] if acted else None
+    if last:
+        age = max(0.0, _time.time() - float(last.get("at") or 0))
+        last_s = (f"last decision {last.get('direction')} "
+                  f"({last.get('reason')}) {age:.1f}s ago")
+    else:
+        last_s = "no scale actions yet"
+    now_d = el.get("lastDecision") or {}
+    holding = now_d.get("direction", "hold")
+    gates = now_d.get("gates") or []
+    print(f"[info]   autoscaler: {el.get('actual')} active / target "
+          f"{el.get('target')} (min {el.get('min')}, max "
+          f"{el.get('max')}), {last_s}; now {holding}"
+          + (f" ({now_d.get('reason')})" if now_d.get("reason") else "")
+          + (f" gated by {','.join(gates)}" if gates else ""))
 
 
 def _print_tenants(t: dict) -> None:
@@ -445,10 +486,61 @@ def wal_cmd(args: list[str]) -> int:
     return 0
 
 
+def _eventserver_scale(args: list[str]) -> int:
+    """`pio eventserver scale N` — retarget a RUNNING partitioned
+    event-server front to N workers.  Writes the scale-target file the
+    front advertised at startup (atomic replace) and sends SIGHUP; the
+    front rebalances partition ownership through the lease/fence/epoch
+    protocol (drain + release on the way down, claim-with-epoch-bump
+    on the way up), so every acked event stays exactly-once."""
+    import signal as _signal
+
+    p = argparse.ArgumentParser(prog="pio eventserver scale")
+    p.add_argument("workers", type=int,
+                   help="new worker count (>= 1); partitions above the "
+                        "target drain and park on the front, scale-up "
+                        "hands them back to fresh workers")
+    ns = p.parse_args(args)
+    from ...data.api.event_log import front_info_path
+
+    info = front_info_path()
+    try:
+        with open(info, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        print(f"[error] no running partitioned event-server front found "
+              f"({info} missing) — start one with "
+              f"`pio eventserver --workers N`", file=sys.stderr)
+        return 1
+    target = max(1, ns.workers)
+    scale_file = doc.get("scaleFile")
+    if not scale_file:
+        print("[error] front info file has no scaleFile entry (stale "
+              "front from an older build?)", file=sys.stderr)
+        return 1
+    tmp = scale_file + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(str(target))
+    os.replace(tmp, scale_file)
+    try:
+        os.kill(int(doc["pid"]), _signal.SIGHUP)
+    except (OSError, KeyError, TypeError, ValueError) as e:
+        print(f"[error] could not signal the front "
+              f"(pid {doc.get('pid')}): {e}", file=sys.stderr)
+        return 1
+    print(f"[info] scale target {target} written; front "
+          f"(pid {doc['pid']}) signaled — workers now "
+          f"{sorted(doc.get('workers') or [])}, rebalance in progress "
+          f"(watch `pio eventlog status` for lease movement)")
+    return 0
+
+
 @verb("eventserver", "start the Event Server (REST ingestion, :7070)")
 def eventserver_cmd(args: list[str]) -> int:
     from ...common import envknobs
 
+    if args and args[0] == "scale":
+        return _eventserver_scale(args[1:])
     p = argparse.ArgumentParser(prog="pio eventserver")
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=7070)
@@ -484,6 +576,111 @@ def eventserver_cmd(args: list[str]) -> int:
     from ...data.api.event_server import run_event_server
 
     run_event_server(ns.ip, ns.port, enable_stats=ns.stats)
+    return 0
+
+
+@verb("fleet", "inspect the elastic serving fleet (plan = dry-run)")
+def fleet_cmd(args: list[str]) -> int:
+    """`pio fleet plan --engine-url URL` — ask "what would the
+    autoscaler do right now?" without changing anything.  Against an
+    elastic front it replays the front's own last telemetry scrape
+    through the same pure decision function the live loop uses;
+    against a fixed fleet it scrapes each backend's /status locally
+    and evaluates $PIO_SCALE_* / $PIO_FLEET_*_REPLICAS from this
+    process's environment."""
+    p = argparse.ArgumentParser(prog="pio fleet")
+    sub = p.add_subparsers(dest="sub", required=True)
+    p_plan = sub.add_parser(
+        "plan", help="print the scaling decision the current telemetry "
+                     "implies — dry run, nothing is changed")
+    p_plan.add_argument("--engine-url",
+                        default=envknobs.env_str(
+                            "PIO_ENGINE_URL", "", lower=False) or None,
+                        help="fleet front base URL (defaults to "
+                             "$PIO_ENGINE_URL)")
+    ns = p.parse_args(args)
+    if not ns.engine_url:
+        print("[error] pio fleet plan needs --engine-url (or "
+              "$PIO_ENGINE_URL)", file=sys.stderr)
+        return 1
+    return _fleet_plan(ns.engine_url)
+
+
+def _fleet_plan(url: str) -> int:
+    from ...workflow import elastic as el
+
+    base = url if "://" in url else f"http://{url}"
+    base = base.rstrip("/")
+    try:
+        doc = _fetch_json(f"{base}/healthz", timeout=5.0)
+    except Exception as e:  # noqa: BLE001 - operator-facing error path
+        print(f"[error] could not fetch {base}/healthz: {e}",
+              file=sys.stderr)
+        return 1
+    eld = (doc or {}).get("elastic")
+    backends = (doc or {}).get("backends") or []
+    sample_fields = ("slot", "alive", "ready", "draining",
+                     "pending", "pending_limit", "shed_delta")
+    if eld and eld.get("samples"):
+        # elastic front: replay its own last scrape + live config
+        cfgd = eld.get("config") or {}
+        cfg = el.ElasticConfig(**{
+            k: cfgd[k] for k in (
+                "min_replicas", "max_replicas", "up_threshold",
+                "down_threshold", "hysteresis_ticks", "cooldown_ms",
+                "tick_ms") if k in cfgd})
+        samples = [el.ReplicaSample(**{k: s[k] for k in sample_fields
+                                       if k in s})
+                   for s in eld["samples"]]
+        source = "front's last telemetry scrape"
+    else:
+        # fixed fleet (or plain server): scrape each backend locally
+        cfg = el.ElasticConfig.from_env(
+            default_min=1, default_max=max(1, len(backends)) or 1)
+        samples = []
+        for b in backends:
+            s = el.ReplicaSample(
+                slot=int(b.get("replica") or 0),
+                alive=bool(b.get("alive")),
+                ready=bool(b.get("ready")),
+                draining=bool(b.get("draining")))
+            port = b.get("port")
+            if port:
+                try:
+                    sdoc = _fetch_json(
+                        f"http://127.0.0.1:{port}/status", timeout=2.0)
+                    ov = sdoc.get("overload") or {}
+                    s.pending = int(ov.get("pending") or 0)
+                    s.pending_limit = int(ov.get("pendingLimit") or 0)
+                except Exception:  # noqa: BLE001 - backend may be down
+                    pass
+            samples.append(s)
+        source = "local backend /status scrape + this environment"
+    if not samples:
+        print(f"[error] {base}/healthz reported no fleet backends — "
+              f"is this a fleet front?", file=sys.stderr)
+        return 1
+    d = el.plan(samples, cfg)
+    print(f"[info] fleet plan @ {base} ({source}):")
+    print(f"[info]   replicas: {d.actual} active, bounds "
+          f"[{cfg.min_replicas}, {cfg.max_replicas}]; utilization "
+          f"{d.utilization:.2f} (up >= {cfg.up_threshold:.2f}, down <= "
+          f"{cfg.down_threshold:.2f}), shed +{d.shed_delta}")
+    if d.direction == "up":
+        print(f"[info]   would scale UP ({d.reason}) -> "
+              f"{d.target} replica(s)")
+    elif d.direction == "down":
+        print(f"[info]   would drain replica {d.slot} ({d.reason}) -> "
+              f"{d.target} replica(s)")
+    else:
+        print(f"[info]   would hold ({d.reason})")
+    if eld:
+        now_d = eld.get("lastDecision") or {}
+        gates = now_d.get("gates") or []
+        if gates:
+            print(f"[info]   live loop currently gated by "
+                  f"{','.join(gates)} — a raw signal may act later")
+    print("[info]   dry run only — nothing was changed")
     return 0
 
 

@@ -43,6 +43,22 @@ def soak_cmd(args: list[str]) -> int:
     p.add_argument("--replicas", type=int, default=2,
                    help="engine fleet size (0 = single process with "
                         "--model-refresh-ms)")
+    p.add_argument("--elastic", action="store_true",
+                   help="deploy the engine fleet with --replicas auto "
+                        "and arm the RAMP phase: offered query load "
+                        "steps 10x up (~30%% of the wall budget) and "
+                        "back down (~65%%), grading scale-up-within-"
+                        "bound and drain-on-quiet SLO rows")
+    p.add_argument("--elastic-max", type=int, default=3,
+                   help="PIO_FLEET_MAX_REPLICAS for --elastic "
+                        "(floor is 1)")
+    p.add_argument("--scale-up-bound-s", type=float, default=30.0,
+                   help="scale-up-within-bound SLO bound: a replica "
+                        "beyond the floor must be READY this soon "
+                        "after the load step")
+    p.add_argument("--scale-down-bound-s", type=float, default=45.0,
+                   help="drain-on-quiet SLO bound: fleet back at the "
+                        "floor this soon after the step-down")
     p.add_argument("--apps", type=int, default=3)
     p.add_argument("--ingest-rps", type=float, default=50.0)
     p.add_argument("--query-rps", type=float, default=20.0)
@@ -127,6 +143,10 @@ def soak_cmd(args: list[str]) -> int:
         quality_sample=max(0.0, min(1.0, ns.quality_sample)),
         tenant_apps=max(0, ns.tenant_apps),
         tenant_max_resident=max(0, ns.tenant_max_resident),
+        elastic=ns.elastic,
+        elastic_max=max(2, ns.elastic_max),
+        scale_up_bound_s=ns.scale_up_bound_s,
+        scale_down_bound_s=ns.scale_down_bound_s,
         p99_ms=ns.p99_ms,
         rollback_deadline_s=ns.rollback_deadline_s,
         foldin_ms=ns.foldin_ms,

@@ -416,7 +416,60 @@ def train_feed_confinement(project: Project) -> Iterable[Finding]:
                         "data/api/partition_feed.py")
 
 
+#: the elastic-topology scale entry points: supervisor dynamic
+#: membership (add_worker/retire_worker) and the coordinator's fenced
+#: scale-directive writes (apply_scale/set_replicas). Only the elastic
+#: control loop (workflow/fleet.py hosts it; workflow/elastic.py is the
+#: pure decision function), the event-tier rescaler (data/api/
+#: event_log.py) and the supervisor itself may call them — a side-
+#: channel scale call skips drain-before-SIGTERM ordering, the
+#: epoch-fenced decision log, and readiness withdrawal.
+_SCALE_ENTRY_POINTS = ("add_worker", "retire_worker",
+                       "apply_scale", "set_replicas")
+_SCALE_ALLOWED = ("workflow/elastic.py", "workflow/fleet.py",
+                  "data/api/event_log.py", "parallel/supervisor.py")
+
+
+@rule("scale-directive-confinement",
+      "only the elastic control loop (workflow/elastic.py + the fleet "
+      "coordinator in workflow/fleet.py), the event-tier rescaler and "
+      "the supervisor may call scale entry points (add_worker/"
+      "retire_worker) or write scale directive rows (apply_scale/"
+      "set_replicas) — a side-channel scale call skips drain ordering, "
+      "readiness withdrawal and the fenced decision log")
+def scale_directive_confinement(project: Project) -> Iterable[Finding]:
+    chokepoint = project.module("workflow/fleet.py")
+    if chokepoint is None or chokepoint.tree is None:
+        return  # scoped scan without the fleet module
+    if not any(isinstance(n, ast.Call)
+               and _call_name(n) == "apply_scale"
+               for n in chokepoint.walk()):
+        yield Finding(
+            "scale-directive-confinement",
+            project.display_path(chokepoint), 1,
+            "scale chokepoint (apply_scale in workflow/fleet.py) not "
+            "found — renamed? The confinement guard has nothing to "
+            "protect")
+        return
+    for m in project.modules(""):
+        if m.relpath in _SCALE_ALLOWED or m.tree is None:
+            continue
+        disp = project.display_path(m)
+        for node in m.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name in _SCALE_ENTRY_POINTS:
+                yield Finding(
+                    "scale-directive-confinement", disp, node.lineno,
+                    f"{name}() outside the elastic control loop — "
+                    "scale only via the autoscaler (workflow/"
+                    "elastic.py decisions applied by workflow/"
+                    "fleet.py) or `pio eventserver scale`")
+
+
 RULES = [ingest_hot_path, spawn_confinement, resilient_urlopen,
          wal_suffix_confinement, no_adhoc_counters, models_dao_confinement,
          tenant_confinement, query_dispatch_gate,
-         sharded_topk_confinement, train_feed_confinement]
+         sharded_topk_confinement, train_feed_confinement,
+         scale_directive_confinement]

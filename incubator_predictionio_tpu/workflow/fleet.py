@@ -147,6 +147,12 @@ class FleetCoordinator:
         # adopted epoch, or a superseded incumbent (whose fence check
         # is strictly `>`) would never detect us and both coordinators
         # would keep committing at the same epoch indefinitely
+        # scaling decisions queued by the elastic controller (event-loop
+        # thread) for the next fenced directive commit on the coordinator
+        # thread — `scale-directive-confinement`: this queue and the
+        # elastic controller are the ONLY writers of the scale payload
+        self._scale_lock = threading.Lock()
+        self._scale_queue: list[dict] = []
         self._adopt(model_artifact.read_fleet_doc(
             storage, model_artifact.fleet_row_id(self.group)) or {})
         self._dirty = True
@@ -162,9 +168,28 @@ class FleetCoordinator:
             "canaryReplica": on_disk.get("canaryReplica"),
             "lastGood": on_disk.get("lastGood"),
             "pinned": dict(on_disk.get("pinned") or {}),
+            "scale": dict(on_disk.get("scale") or {}),
         }
         self._epoch_base = self.rec["epoch"]
         self._dirty = False
+
+    # -- elastic topology --------------------------------------------------
+    def set_replicas(self, n: int) -> None:
+        """Widen the slot range the coordinator reads status rows over
+        (scale entry point — callers confined by
+        `scale-directive-confinement`). High-water only: a retired
+        slot's stale row already ages out of `_rows` via `fresh_s`, and
+        shrinking the range would hide a straggler's pin."""
+        self.replicas = max(self.replicas, int(n))
+
+    def apply_scale(self, decision: dict) -> None:
+        """Queue an acted scaling decision for the next fenced
+        directive commit (scale entry point — callers confined by
+        `scale-directive-confinement`). Thread-safe: the elastic loop
+        runs on the front's event loop, the commit on the coordinator
+        thread."""
+        with self._scale_lock:
+            self._scale_queue.append(dict(decision))
 
     # -- storage views -----------------------------------------------------
     def _rows(self) -> dict[int, dict]:
@@ -206,6 +231,22 @@ class FleetCoordinator:
                     log.warning("fleet: replica %s pinned %s (%s); "
                                 "propagating", row.get("replica"), iid,
                                 reason)
+        # 1b. commit queued scaling decisions: each acted decision is a
+        #     STATE TRANSITION of the directive record (epoch bump
+        #     through the fenced write below), carrying a bounded
+        #     decision log for `pio status` / the front's /healthz
+        with self._scale_lock:
+            pending_scale, self._scale_queue = self._scale_queue, []
+        if pending_scale:
+            scale = dict(rec.get("scale") or {})
+            decisions = list(scale.get("decisions") or [])
+            for d in pending_scale:
+                if d.get("target") is not None:
+                    scale["target"] = d["target"]
+                decisions.append(d)
+            scale["decisions"] = decisions[-16:]
+            rec["scale"] = scale
+            self._dirty = True
         # 2. canary resolution
         if rec["state"] == "canary":
             if rec["target"] in rec["pinned"]:
@@ -322,7 +363,8 @@ class FleetCoordinator:
         # coordinator's record, replacing the dict `rec` aliases
         rec = self.rec
         state_g.set(1.0 if rec["state"] == "canary" else 0.0)
-        return {**rec, "pinned": dict(rec["pinned"])}
+        return {**rec, "pinned": dict(rec["pinned"]),
+                "scale": dict(rec.get("scale") or {})}
 
     def _write(self, peers=None) -> None:
         """Epoch-fenced directive commit: bump past the last epoch WE
@@ -359,7 +401,8 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
               port: int, *, engine_factory_name: str,
               engine_variant: str = "default",
               run_dir: Optional[str] = None,
-              app_name: str = "") -> int:
+              app_name: str = "",
+              elastic: bool = False) -> int:
     """Blocking entry for ``pio deploy --replicas N``: spawn N
     supervised replica processes, splice client connections to them,
     and run the staged-rollout coordinator.
@@ -369,26 +412,57 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
     its jax-free server script); the supervisor adds the fleet identity
     env (``PIO_FLEET_REPLICA``, ``PIO_FLEET_REPLICAS``,
     ``PIO_QUERY_REPLICA_PORT``) per worker. Spawning stays confined to
-    ``parallel/supervisor.py``."""
+    ``parallel/supervisor.py``.
+
+    ``elastic=True`` (``pio deploy --replicas auto``) arms the
+    autoscaler (``workflow/elastic.py``): the fleet starts at
+    ``PIO_FLEET_MIN_REPLICAS`` (or the explicit ``replicas`` clamped
+    into the [min, max] envelope; pass ``replicas <= 0`` for "start at
+    the floor"), and the front's elastic loop scrapes every replica's
+    ``/status`` each ``PIO_SCALE_TICK_MS``, growing the fleet through
+    the supervisor's :meth:`~..parallel.supervisor.Supervisor.add_worker`
+    and shrinking it by draining the least-loaded ready replica
+    (routing withdrawn FIRST, then the supervisor's graceful
+    retirement). Replica identity is slot-based: a drained slot frees
+    its index, a scale-up reuses the lowest free one."""
     from ..data.storage.registry import Storage
     from ..parallel.supervisor import Supervisor
 
-    replicas = max(1, int(replicas))
+    ecfg = None
+    if elastic:
+        from .elastic import (ElasticConfig, ElasticController,
+                              ReplicaSample, sample_status)
+
+        ecfg = ElasticConfig.from_env(
+            default_min=max(1, int(replicas)) if replicas > 0 else 1)
+        if replicas <= 0:
+            replicas = ecfg.min_replicas
+        replicas = min(max(int(replicas), ecfg.min_replicas),
+                       ecfg.max_replicas)
+    else:
+        replicas = max(1, int(replicas))
     sync_ms = envknobs.env_float("PIO_FLEET_SYNC_MS", 1000.0, lo=50.0)
     ready_ms = envknobs.env_float("PIO_FLEET_READY_MS", 500.0, lo=50.0)
     connect_retry_ms = envknobs.env_ms(
         "PIO_FLEET_CONNECT_RETRY_MS", 1000.0, lo_ms=0.0)
-    ports = [Supervisor._free_port() for _ in range(replicas)]
+    # slot-indexed ports: None marks a freed slot (elastic scale-down);
+    # a later scale-up reassigns the slot with a fresh port
+    ports: list[Optional[int]] = [Supervisor._free_port()
+                                  for _ in range(replicas)]
     base_env = dict(os.environ)
     chaos = base_env.pop("PIO_FLEET_WORKER_FAULT_SPEC", None)
     # per-replica chaos (the soak driver's fault timeline):
     # PIO_FLEET_WORKER_FAULT_SPEC_<i> overrides the shared spec for
     # replica i only — a scheduled crash can target ONE replica
     # instead of SIGKILLing the whole fleet at the same offset
-    per_replica_chaos = {
-        i: base_env.pop(f"PIO_FLEET_WORKER_FAULT_SPEC_{i}")
-        for i in range(replicas)
-        if f"PIO_FLEET_WORKER_FAULT_SPEC_{i}" in base_env}
+    _chaos_prefix = "PIO_FLEET_WORKER_FAULT_SPEC_"
+    per_replica_chaos = {}
+    for key in [k for k in base_env if k.startswith(_chaos_prefix)]:
+        try:
+            per_replica_chaos[int(key[len(_chaos_prefix):])] = \
+                base_env.pop(key)
+        except ValueError:
+            pass
     base_env.pop("PIO_QUERY_REPLICAS", None)
     if app_name:
         # replicas must derive the SAME app-scoped directive group as
@@ -441,26 +515,40 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
     # loop-confined snapshots the /healthz provider reads (the
     # coordinator's own dict mutates on a worker thread)
     last_rec: dict = {"rec": dict(coordinator.rec)}
+    # allocated slots (live or draining); the elastic loop is the only
+    # mutator, so the other loops can iterate a sorted copy freely
+    slots: set[int] = set(range(replicas))
+    draining_slots: set[int] = set()
+    elastic_state: dict = {"target": replicas, "lastDecision": None}
 
     def healthz() -> dict:
         rec = last_rec["rec"]
-        pids = sup.worker_pids()
         backends = []
-        for i in range(replicas):
+        for i in sorted(slots):
+            pid = sup.worker_pid(i)
             backends.append({
                 "replica": i,
                 "port": ports[i] if i < len(ports) else None,
-                "pid": pids[i] if i < len(pids) else None,
-                "alive": (pids[i] is not None) if i < len(pids) else False,
-                "ready": front.is_ready(i),
+                "pid": pid,
+                "alive": pid is not None,
+                "ready": front.is_ready(i) and not front.is_draining(i),
+                "draining": front.is_draining(i),
                 "restarts": (sup.worker_restarts[i]
                              if i < len(sup.worker_restarts) else 0),
             })
-        return {
+        active = [i for i in sorted(slots) if not front.is_draining(i)]
+        # target vs actual (not the launch-time N): a mid-scale fleet
+        # reads as "2 of target 3 active, 2 ready" rather than
+        # degraded, and a DRAINING replica is reported as such — an
+        # intentional drain is not a dead backend
+        doc = {
             "status": "alive",
             "group": coordinator.group,
-            "replicas": replicas,
+            "replicas": elastic_state["target"],
+            "targetReplicas": elastic_state["target"],
+            "activeReplicas": len(active),
             "readyReplicas": front.ready_count(),
+            "drainingReplicas": sorted(draining_slots),
             "state": rec.get("state"),
             "instance": rec.get("instance"),
             "target": rec.get("target"),
@@ -470,6 +558,20 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
             "backends": backends,
             "runDir": sup.run_dir,
         }
+        if ecfg is not None:
+            last = elastic_state["lastDecision"]
+            doc["elastic"] = {
+                "enabled": True,
+                "min": ecfg.min_replicas,
+                "max": ecfg.max_replicas,
+                "target": elastic_state["target"],
+                "actual": len(active),
+                "config": ecfg.to_json(),
+                "lastDecision": last,
+                "decisions": list(controller.decisions[-5:]),
+                "samples": list(elastic_state.get("samples") or ()),
+            }
+        return doc
 
     front = FrontProxy(ports, healthz_provider=healthz,
                        connect_retry_s=connect_retry_ms / 1000.0)
@@ -487,12 +589,16 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
             # probe concurrently: one wedged replica (accepts but never
             # answers — exactly the heartbeat-stall window before the
             # supervisor kills it) must cost the pass ONE probe timeout,
-            # not serialize every other replica's mark stale behind it
+            # not serialize every other replica's mark stale behind it.
+            # Draining slots are skipped (their not-ready mark is
+            # intentional and already set) and freed slots have no port.
+            idxs = [i for i in sorted(slots)
+                    if i < len(ports) and ports[i] is not None
+                    and not front.is_draining(i)]
             marks = await asyncio.gather(
-                *(probe_ready("127.0.0.1", ports[i])
-                  for i in range(replicas)),
+                *(probe_ready("127.0.0.1", ports[i]) for i in idxs),
                 return_exceptions=True)
-            for i, ok in enumerate(marks):
+            for i, ok in zip(idxs, marks):
                 front.set_ready(i, ok is True)
             ready_g.set(float(front.ready_count()))
             await asyncio.sleep(ready_ms / 1000.0)
@@ -506,6 +612,105 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
                 log.exception("fleet coordinator step failed; retrying")
             await asyncio.sleep(sync_ms / 1000.0)
 
+    if ecfg is not None:
+        controller = ElasticController(ecfg)
+        prev_shed: dict[int, int] = {}
+
+        async def scrape_samples() -> list:
+            idxs = [i for i in sorted(slots)
+                    if i < len(ports) and ports[i] is not None]
+            docs = await asyncio.gather(
+                *(sample_status("127.0.0.1", ports[i]) for i in idxs),
+                return_exceptions=True)
+            samples = []
+            for i, doc in zip(idxs, docs):
+                drng = front.is_draining(i)
+                s = ReplicaSample(
+                    slot=i, alive=sup.worker_pid(i) is not None,
+                    ready=front.is_ready(i) and not drng, draining=drng)
+                if isinstance(doc, dict):
+                    ov = doc.get("overload") or {}
+                    s.pending = int(ov.get("pending") or 0)
+                    s.pending_limit = int(ov.get("pendingLimit") or 0)
+                    shed_total = int(ov.get("shed") or 0)
+                    prev = prev_shed.get(i)
+                    s.shed_delta = (max(0, shed_total - prev)
+                                    if prev is not None else 0)
+                    prev_shed[i] = shed_total
+                samples.append(s)
+            return samples
+
+        def do_scale_up() -> int:
+            # lowest free slot — slot identity is stable, so the
+            # coordinator's status rows and the front's readiness
+            # marks never alias across scale cycles
+            idx = 0
+            while idx in slots:
+                idx += 1
+            while len(ports) <= idx:
+                ports.append(None)
+            ports[idx] = Supervisor._free_port()
+            slots.add(idx)
+            front.set_backend(idx, ports[idx])
+            front.set_ready(idx, False)
+            coordinator.set_replicas(idx + 1)
+            sup.add_worker(idx)
+            return idx
+
+        def do_scale_down(slot: int) -> None:
+            # ordering is the lossless-drain contract: routing is
+            # withdrawn FIRST (draining excludes the slot from BOTH
+            # connect passes), THEN the supervisor SIGTERMs it — the
+            # replica finishes its in-flight queries and cuts
+            # keep-alives on its own graceful drain path, and clients
+            # reconnect through the front to the survivors
+            front.set_ready(slot, False)
+            front.set_draining(slot, True)
+            draining_slots.add(slot)
+            sup.retire_worker(slot)
+
+        def reap_drained() -> None:
+            for i in sorted(draining_slots):
+                if sup.worker_pid(i) is None and not sup.is_retiring(i):
+                    # booked out by the supervisor: the slot is free
+                    front.set_backend(i, None)
+                    ports[i] = None
+                    slots.discard(i)
+                    draining_slots.discard(i)
+                    prev_shed.pop(i, None)
+                    log.info("elastic: slot %d released", i)
+
+        async def elastic_loop() -> None:
+            while True:
+                try:
+                    reap_drained()
+                    samples = await scrape_samples()
+                    decision = controller.observe(samples)
+                    elastic_state["samples"] = [s.to_json()
+                                                for s in samples]
+                    elastic_state["lastDecision"] = decision.to_json()
+                    if decision.direction == "up":
+                        idx = do_scale_up()
+                        entry = controller.record_action(decision)
+                        entry["slot"] = idx
+                        elastic_state["target"] = decision.target
+                        coordinator.apply_scale(entry)
+                        log.info("elastic: scale-up (%s) -> replica %d "
+                                 "spawning, target %d", decision.reason,
+                                 idx, decision.target)
+                    elif decision.direction == "down":
+                        do_scale_down(decision.slot)
+                        entry = controller.record_action(decision)
+                        elastic_state["target"] = decision.target
+                        coordinator.apply_scale(entry)
+                        log.info("elastic: scale-down (%s) -> replica "
+                                 "%d draining, target %d",
+                                 decision.reason, decision.slot,
+                                 decision.target)
+                except Exception:  # noqa: BLE001 — retried next tick
+                    log.exception("elastic tick failed; retrying")
+                await asyncio.sleep(ecfg.tick_ms / 1000.0)
+
     async def front_main() -> None:
         await front.start(host, port)
         stop = asyncio.Event()
@@ -518,6 +723,8 @@ def run_fleet(worker_argv: Sequence[str], replicas: int, host: str,
                 pass
         tasks = [loop.create_task(ready_loop()),
                  loop.create_task(coord_loop())]
+        if ecfg is not None:
+            tasks.append(loop.create_task(elastic_loop()))
         # the front lives exactly as long as its replicas: a supervisor
         # that gave up must take the front down rather than keep
         # accepting connections nothing can serve

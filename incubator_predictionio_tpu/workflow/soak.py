@@ -86,6 +86,7 @@ SLO_METRICS = (
     "pio_tenant_shed_total",
     "pio_tenant_evictions_total",
     "pio_tenant_rollbacks_total",
+    "pio_fleet_scale_events_total",
 )
 
 # spec-armed scenario faults → the fault POINT their PIO_FAULT_SPEC
@@ -188,6 +189,21 @@ class SoakConfig:
     # evictions are guaranteed load-bearing, not incidental.
     tenant_apps: int = 0
     tenant_max_resident: int = 0
+    # elastic topology (ISSUE 20): elastic=True deploys the engine
+    # with `--replicas auto` and arms a RAMP phase — offered query
+    # load steps ramp_factor× up at ~30% of the wall budget and back
+    # down at ~65% — so the autoscaler is graded under a real load
+    # step, both directions: `scale-up-within-bound` (a new replica
+    # READY within scale_up_bound_s of the step) and `drain-on-quiet`
+    # (fleet back at the floor within scale_down_bound_s of the load
+    # going away, drained with zero non-{200,503,504})
+    elastic: bool = False
+    ramp_factor: float = 10.0
+    ramp_up_frac: float = 0.30
+    ramp_down_frac: float = 0.65
+    scale_up_bound_s: float = 30.0
+    scale_down_bound_s: float = 45.0
+    elastic_max: int = 3          # PIO_FLEET_MAX_REPLICAS (min is 1)
     fleet_sync_ms: float = 200.0
     compact_interval_ms: float = 2000.0
     faults: tuple = FAULT_MENU
@@ -234,6 +250,8 @@ class SoakPlan:
     slos: dict                   # name -> bound (threshold snapshot)
     conn_budget: int = 0         # resolved once; the evaluator asserts
     #                              the SAME bound the dry run printed
+    ramp: Optional[dict] = None  # elastic load step: {upAtS, downAtS,
+    #                              factor, min, max}
 
     def describe(self) -> str:
         """The resolved scenario, human-readable (``--dry-run``)."""
@@ -244,7 +262,9 @@ class SoakPlan:
             f"  topology: event server --workers {cfg.event_workers} "
             "(WAL on, compaction every "
             f"{cfg.compact_interval_ms:.0f}ms); engine "
-            + (f"fleet --replicas {cfg.replicas}" if cfg.replicas
+            + (f"fleet --replicas auto [1, {max(2, cfg.elastic_max)}]"
+               if cfg.elastic
+               else f"fleet --replicas {cfg.replicas}" if cfg.replicas
                else "single process")
             + f", fold-in every {cfg.foldin_ms:.0f}ms, watch "
               f"{cfg.swap_watch_ms:.0f}ms",
@@ -346,11 +366,14 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
     for f in cfg.faults:
         if f not in FAULT_MENU:
             notes.append(f"unknown fault {f!r} dropped")
-    if "replica_kill" in requested and cfg.replicas < 2:
+    if "replica_kill" in requested and (cfg.replicas < 2 or cfg.elastic):
         requested.remove("replica_kill")
         notes.append("replica_kill dropped: needs --replicas >= 2 "
                      "(a 0/1-replica deploy has no survivor to serve "
-                     "through the kill)")
+                     "through the kill)" if not cfg.elastic else
+                     "replica_kill dropped: elastic membership is "
+                     "dynamic — a launch-time spec cannot target a "
+                     "slot the autoscaler owns")
 
     # spec faults are grouped per target process; a first-launch
     # process dies at its FIRST crash rule (restarts come up clean), so
@@ -466,6 +489,30 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
             f"{bound}; the query flood's first sweep visits every app "
             "in order (guaranteed coverage + LRU churn), then goes "
             "zipfian")
+    ramp = None
+    if cfg.elastic:
+        ramp = {
+            "upAtS": round(cfg.duration_s * cfg.ramp_up_frac, 1),
+            "downAtS": round(cfg.duration_s * cfg.ramp_down_frac, 1),
+            "factor": cfg.ramp_factor,
+            "min": 1,
+            "max": max(2, cfg.elastic_max),
+        }
+        slos["scale-up-within-bound"] = (
+            f"a replica beyond the floor READY within "
+            f"{cfg.scale_up_bound_s:.0f}s of the {cfg.ramp_factor:.0f}x "
+            f"load step at t+{ramp['upAtS']:.0f}s")
+        slos["drain-on-quiet"] = (
+            f"fleet back at the floor ({ramp['min']}) within "
+            f"{cfg.scale_down_bound_s:.0f}s of the step-down at "
+            f"t+{ramp['downAtS']:.0f}s — drained, never killed "
+            "(non-{200,503,504} already reds http-codes)")
+        notes.append(
+            f"elastic: --replicas auto, bounds [1, {ramp['max']}]; the "
+            "query flood multiplies its offered rate by "
+            f"{cfg.ramp_factor:.0f} between t+{ramp['upAtS']:.0f}s and "
+            f"t+{ramp['downAtS']:.0f}s; PIO_QUERY_MAX_PENDING is "
+            "pinned low so the step is visible as utilization")
     notes.append("observations are scraped through quiesce: rollback "
                  "pins and fault evidence landing after the wall "
                  "budget (starved-host double-load) still count")
@@ -474,7 +521,7 @@ def plan_scenario(cfg: SoakConfig) -> SoakPlan:
                     item_weights=item_weights,
                     faults=faults, worker_specs=worker_specs,
                     replica_specs=replica_specs, notes=notes, slos=slos,
-                    conn_budget=conn_budget)
+                    conn_budget=conn_budget, ramp=ramp)
 
 
 # ---------------------------------------------------------------------------
@@ -533,6 +580,7 @@ class _Samples:
         self.foldin_lag: list = []    # (t_off_s, lag_seconds)
         self.foldin_publishes = 0
         self.restarts: dict = {}      # "replica:<i>" -> max restarts
+        self.fleet_size: list = []    # (t_off_s, active, ready, target)
         self.query_cache: dict = {}   # /status queryCache counters, max
         self.tenants: dict = {}       # /status tenants doc, latest
         self._rollback_keys: set = set()
@@ -611,6 +659,9 @@ class SoakRunner:
         self.access_keys: dict = {}
         self.instances: dict = {}     # label -> instance id
         self.fault_log: list = []     # scheduler's fired actions
+        # elastic ramp: the query loops multiply their offered rate by
+        # this each tick (the ramp thread steps it factor× up/down)
+        self.rate_mult = 1.0
         self.event_port = _free_port()
         self.engine_port = _free_port()
         self.t0 = 0.0                 # traffic start (monotonic)
@@ -669,6 +720,17 @@ class SoakRunner:
             # inherit): one process serves the whole app universe with
             # the resident LRU smaller than it
             env["PIO_TENANT_MAX_RESIDENT"] = str(_tenant_resident(cfg))
+        if cfg.elastic:
+            # elastic fleet: small pending limit so the ramp's load
+            # step reads as utilization (pending/pendingLimit) fast;
+            # quick ticks so detect→spawn fits the scale-up bound on a
+            # 2-core host
+            env["PIO_FLEET_MIN_REPLICAS"] = "1"
+            env["PIO_FLEET_MAX_REPLICAS"] = str(max(2, cfg.elastic_max))
+            env["PIO_QUERY_MAX_PENDING"] = "8"
+            env["PIO_SCALE_TICK_MS"] = "200"
+            env["PIO_SCALE_COOLDOWN_MS"] = "1500"
+            env["PIO_SCALE_HYSTERESIS_TICKS"] = "2"
         for k in ("PIO_FAULT_SPEC", "PIO_EVENT_WORKER_FAULT_SPEC",
                   "PIO_FLEET_WORKER_FAULT_SPEC"):
             env.pop(k, None)
@@ -801,7 +863,9 @@ class SoakRunner:
             "deploy", "--engine-dir", cfg.engine_dir,
             "--ip", "127.0.0.1", "--port", str(self.engine_port),
             "--online-foldin")
-        if cfg.replicas:
+        if cfg.elastic:
+            argv += ["--replicas", "auto"]
+        elif cfg.replicas:
             for r, spec in self.plan.replica_specs.items():
                 env[f"PIO_FLEET_WORKER_FAULT_SPEC_{r}"] = spec
             argv += ["--replicas", str(cfg.replicas)]
@@ -840,7 +904,12 @@ class SoakRunner:
                 pass
             try:
                 if not en_ok:
-                    if self.cfg.replicas:
+                    if self.cfg.elastic:
+                        doc = self._http("GET", en_base + "/healthz",
+                                         timeout=2).json()
+                        # the floor is enough: the ramp grows the rest
+                        en_ok = (doc.get("readyReplicas") or 0) >= 1
+                    elif self.cfg.replicas:
                         doc = self._http("GET", en_base + "/healthz",
                                          timeout=2).json()
                         en_ok = (doc.get("readyReplicas")
@@ -1003,11 +1072,11 @@ class SoakRunner:
         rng = random.Random(cfg.seed * 2000 + idx)
         base = f"http://127.0.0.1:{self.engine_port}"
         sess = requests.Session()
-        period = 1.0 / rate
         nxt = time.monotonic()
         apps = self.plan.app_names
         n = 0
         while not self.stop.is_set():
+            period = 1.0 / (rate * max(0.01, self.rate_mult))
             nxt += period * (0.5 + rng.random())
             delay = nxt - time.monotonic()
             if delay > 0:
@@ -1029,10 +1098,21 @@ class SoakRunner:
                                        self.plan.app_weights))
                 headers["X-Pio-App"] = app
             n += 1
+            body: dict = {"user": f"u{user}"}
+            if cfg.elastic:
+                # each query holds its admission slot ~50ms: capacity
+                # becomes conc/holdS per replica, so the ramp's 10x
+                # step builds real queue depth (a microsecond-answer
+                # engine reads as quiet at ANY offered rate); the
+                # nonce keeps each query cache-unique — a result-cache
+                # hit answers before admission, so a zipfian flood
+                # served from cache would be invisible to the scaler
+                body["holdS"] = 0.05
+                body["nonce"] = f"{idx}-{n}"
             t0 = time.monotonic()
             try:
                 r = sess.post(
-                    base + "/queries.json", json={"user": f"u{user}"},
+                    base + "/queries.json", json=body,
                     headers=headers,
                     timeout=max(15.0, cfg.query_deadline_ms / 1000 + 5))
             except requests.RequestException:
@@ -1048,6 +1128,25 @@ class SoakRunner:
             if r.status_code == 200:
                 with self.ledger.lock:
                     self.ledger.latencies.append(time.monotonic() - t0)
+
+    def _ramp_loop(self) -> None:
+        """Elastic load step: multiply the offered query rate by
+        ``ramp_factor`` at ``upAtS``, back to 1x at ``downAtS`` — the
+        autoscaler's detect→spawn→ready and drain-on-quiet brackets
+        are graded against these two instants."""
+        ramp = self.plan.ramp
+        if not ramp:
+            return
+        for at_s, mult in ((ramp["upAtS"], ramp["factor"]),
+                           (ramp["downAtS"], 1.0)):
+            delay = at_s - (time.monotonic() - self.t0)
+            if delay > 0 and self.stop.wait(delay):
+                return
+            self.rate_mult = mult
+            self.fault_log.append({
+                "name": "ramp", "ok": True,
+                "firedAtS": round(time.monotonic() - self.t0, 1),
+                "detail": f"offered query rate x{mult:g}"})
 
     # -- scraper -----------------------------------------------------------
 
@@ -1127,7 +1226,7 @@ class SoakRunner:
                 self.samples.foldin_publishes = max(
                     self.samples.foldin_publishes,
                     int(fold.get("publishes") or 0))
-        if self.cfg.replicas:
+        if self.cfg.replicas or self.cfg.elastic:
             try:
                 h = self._http("GET", en_base + "/healthz",
                                timeout=4).json()
@@ -1139,6 +1238,12 @@ class SoakRunner:
                     self.samples.restarts[k] = max(
                         self.samples.restarts.get(k, 0),
                         int(b.get("restarts") or 0))
+                if self.cfg.elastic:
+                    self.samples.fleet_size.append((
+                        round(t_off, 1),
+                        int(h.get("activeReplicas") or 0),
+                        int(h.get("readyReplicas") or 0),
+                        int(h.get("targetReplicas") or 0)))
 
     # -- fault scheduler ---------------------------------------------------
 
@@ -1320,12 +1425,22 @@ class SoakRunner:
         scrape_t.start()
         threads = [threading.Thread(target=self._fault_loop,
                                     daemon=True, name="soak-faults")]
+        if plan.ramp:
+            threads.append(threading.Thread(
+                target=self._ramp_loop, daemon=True, name="soak-ramp"))
         n_ing = 2 if cfg.ingest_rps > 25 else 1
         for i in range(n_ing):
             threads.append(threading.Thread(
                 target=self._ingest_loop, args=(i, cfg.ingest_rps / n_ing),
                 daemon=True, name=f"soak-ingest-{i}"))
         n_q = 2 if cfg.query_rps > 15 else 1
+        if plan.ramp:
+            # the ramp must be able to SATURATE: a synchronous client
+            # lane holds ONE query in flight, so replica queue depth
+            # is bounded by the fan-out — 16 lanes let the 10x step
+            # push the floor replica past the scale-up threshold,
+            # then spread thin once the fleet grows
+            n_q = 16
         for i in range(n_q):
             threads.append(threading.Thread(
                 target=self._query_loop, args=(i, cfg.query_rps / n_q),
@@ -1386,6 +1501,8 @@ class SoakRunner:
                 "watchMs": cfg.swap_watch_ms,
                 "tenantApps": cfg.tenant_apps,
                 "tenantMaxResident": _tenant_resident(cfg),
+                "elastic": cfg.elastic,
+                "ramp": plan.ramp,
             },
             "slos": slos,
             "faults": faults,
@@ -1561,6 +1678,43 @@ def evaluate_slos(plan: SoakPlan, ledger: _Ledger, samples: _Samples,
     slo("clean-drain",
         all(rc == 0 for rc in drain.values()) and len(drain) == 2,
         drain, 0, "SIGTERM drain exit codes (engine, eventserver)")
+
+    # -- elastic topology: the fleet sized itself under the ramp -----------
+    # two rows, one per direction of the load step. Graded purely from
+    # the scraped /healthz fleet-size series, so seeded fixtures
+    # unit-test both red paths (never grew / never came back down).
+    if cfg.elastic and plan.ramp:
+        up_at = float(plan.ramp["upAtS"])
+        down_at = float(plan.ramp["downAtS"])
+        floor = int(plan.ramp["min"])
+        with samples.lock:
+            sizes = list(samples.fleet_size)
+            scale_events = sum(
+                v for k, v in samples.metric_max.items()
+                if k.startswith("pio_fleet_scale_events_total"))
+        grew = [t for t, _active, ready, _target in sizes
+                if t >= up_at and ready > floor]
+        up_delta = round(grew[0] - up_at, 1) if grew else None
+        slo("scale-up-within-bound",
+            up_delta is not None and up_delta <= cfg.scale_up_bound_s,
+            up_delta, cfg.scale_up_bound_s,
+            f"{len(sizes)} fleet-size sample(s); first >{floor}-ready "
+            f"observation "
+            + (f"{up_delta}s after the step" if grew
+               else "never seen after the step")
+            + f"; scale events {scale_events:.0f}")
+        shrunk = [t for t, active, _ready, _target in sizes
+                  if t >= down_at and active <= floor]
+        down_delta = round(shrunk[0] - down_at, 1) if shrunk else None
+        slo("drain-on-quiet",
+            down_delta is not None
+            and down_delta <= cfg.scale_down_bound_s,
+            down_delta, cfg.scale_down_bound_s,
+            f"first back-at-floor ({floor}) observation "
+            + (f"{down_delta}s after the step-down" if shrunk
+               else "never seen after the step-down")
+            + " — draining replicas finish in-flight work "
+              "(non-{200,503,504} reds http-codes)")
 
     # -- per-fault evidence ------------------------------------------------
     with samples.lock:

@@ -3,7 +3,9 @@
 readiness poller, staged-rollout coordinator — over jax-free
 tests/fleet_server.py replicas.
 
-Usage: python fleet_front.py <port> <replicas>
+Usage: python fleet_front.py <port> <replicas> [elastic]
+(a literal third arg "elastic" turns the autoscaler loop on; replica
+count then seeds the floor via PIO_FLEET_MIN_REPLICAS or defaults)
 """
 
 import os
@@ -23,10 +25,11 @@ def main() -> int:
     replicas = int(sys.argv[2])
     from incubator_predictionio_tpu.workflow.fleet import run_fleet
 
+    elastic = len(sys.argv) > 3 and sys.argv[3] == "elastic"
     worker_argv = [sys.executable, os.path.join(HERE, "fleet_server.py")]
     return run_fleet(worker_argv, replicas, "127.0.0.1", port,
                      engine_factory_name="lifecycle",
-                     engine_variant="default")
+                     engine_variant="default", elastic=elastic)
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ rides sys.path), so pickled models round-trip across processes."""
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -100,6 +101,12 @@ class SoakAlgorithm(Algorithm):
                          poison=poison, items=items)
 
     def predict(self, model, query):
+        # elastic soak: each query may hold its admission slot for a
+        # beat (capped) — a microsecond answer never builds a queue,
+        # so the ramp's load step would be invisible to the autoscaler
+        hold = float(query.get("holdS") or 0.0)
+        if hold > 0:
+            time.sleep(min(hold, 0.5))
         user = str(query["user"])
         if model.poison == "serve" and user != "golden":
             raise RuntimeError("poisoned retrain: predict exploded")

@@ -1,0 +1,304 @@
+"""Benchmark: elastic-fleet DECISION LATENCY (ISSUE 20's demonstrable axis).
+
+On a 2-core host more replicas do NOT mean more throughput, so the
+honest number for the autoscaler is not QPS — it is how fast the
+control loop closes each bracket:
+
+- **detect -> spawn -> ready**: flood starts; first acted ``up``
+  decision (detect, stamped by the controller itself) and first
+  ``/healthz`` poll showing the spawned replica READY (the full
+  supervisor-spawn + readiness-probe path).
+- **drain-on-quiet -> released**: flood stops; last acted ``down``
+  decision and first poll showing the fleet back at the floor with no
+  replica still draining (slot freed, not dead).
+
+Both brackets run against the REAL stack: `run_fleet` (supervisor,
+splice front, readiness poller, elastic loop) over jax-free
+tests/fleet_server.py replicas with the lifecycle engine, exactly the
+tentpole e2e topology from tests/test_elastic.py.
+
+The CEILING CONTROL runs in the same process under the same flood: a
+second fleet pinned at ``min == max`` cannot spawn, so its first
+``at-max`` hold isolates the detection machinery alone (tick + scrape +
+hysteresis, zero spawn cost). The elastic bracket minus the control is
+the true spawn+ready cost.
+
+Results print as one JSON line and persist to BASELINE.json under
+``published.measured_elastic_decision``.
+
+Run on a QUIET host: ``python tools/elastic_bench.py``
+(``--no-persist`` to skip the BASELINE write).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.join(REPO, "tests")
+sys.path.insert(0, REPO)
+sys.path.insert(0, TESTS)
+
+import requests  # noqa: E402  (baked into the image)
+
+FLOOD_THREADS = 20
+SLEEP_S = 0.25          # keeps each accepted query resident in the
+                        # replica so the admission queue reads occupied
+POLL_S = 0.1
+
+# the same damped knobs the tentpole e2e pins: tiny admission queue so
+# the flood reads as shed/utilization within a tick or two, 2 agreeing
+# ticks so one noisy between-burst snapshot cannot flap the fleet
+KNOBS = {
+    "PIO_QUERY_MAX_PENDING": "2",
+    "PIO_SCALE_TICK_MS": "100",
+    "PIO_SCALE_COOLDOWN_MS": "1000",
+    "PIO_SCALE_HYSTERESIS_TICKS": "2",
+    "PIO_SCALE_DOWN_THRESHOLD": "0.1",
+}
+
+
+def log(msg: str) -> None:
+    print(f"[elastic-bench] {msg}", flush=True)
+
+
+class Front:
+    """One fleet_front.py subprocess with its /healthz poller."""
+
+    def __init__(self, env: dict, replicas: int, tag: str):
+        from server_utils import free_port
+
+        self.port = free_port()
+        self.base = f"http://127.0.0.1:{self.port}"
+        self.log_path = os.path.join(
+            tempfile.gettempdir(), f"elastic_bench_{tag}_{self.port}.log")
+        self._log = open(self.log_path, "wb")
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.join(TESTS, "fleet_front.py"),
+             str(self.port), str(replicas), "elastic"],
+            env=env, stdout=self._log, stderr=subprocess.STDOUT)
+
+    def healthz(self) -> dict:
+        try:
+            return requests.get(self.base + "/healthz", timeout=5).json()
+        except requests.RequestException:
+            return {}
+
+    def wait(self, pred, deadline_s: float, what: str) -> dict:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            doc = self.healthz()
+            if doc and pred(doc):
+                return doc
+            time.sleep(POLL_S)
+        raise RuntimeError(f"timed out waiting for {what} "
+                           f"(log: {self.log_path})")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        self._log.close()
+
+
+class Flood:
+    """Open-loop query flood; collects http codes, never raises."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.codes: list = []
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._run, args=(i,),
+                                          daemon=True)
+                         for i in range(FLOOD_THREADS)]
+
+    def _run(self, idx: int) -> None:
+        n = 0
+        while not self._stop.is_set():
+            n += 1
+            try:
+                r = requests.post(self.base + "/queries.json",
+                                  json={"user": f"b{idx}-{n}",
+                                        "sleepS": SLEEP_S},
+                                  timeout=20)
+                self.codes.append(r.status_code)
+            except requests.RequestException:
+                pass
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(30)
+
+    def code_counts(self) -> dict:
+        out: dict = {}
+        for c in self.codes:
+            out[str(c)] = out.get(str(c), 0) + 1
+        return out
+
+
+def bench_elastic(env: dict) -> dict:
+    """detect->spawn->ready and drain-on-quiet->released brackets."""
+    front = Front(env, 1, "elastic")
+    try:
+        front.wait(lambda h: h.get("readyReplicas") == 1, 60,
+                   "floor replica ready")
+        flood = Flood(front.base)
+        t_flood = time.time()
+        flood.start()
+        try:
+            grown = front.wait(
+                lambda h: h.get("readyReplicas", 0) >= 2, 60,
+                "scale-up to 2 ready replicas")
+            t_ready = time.time()
+        finally:
+            t_quiet = time.time()
+            flood.stop()
+        ups = [d for d in grown["elastic"]["decisions"]
+               if d["direction"] == "up"]
+        detect_s = ups[0]["at"] - t_flood
+        shrunk = front.wait(
+            lambda h: (h.get("activeReplicas") == 1
+                       and not h.get("drainingReplicas")), 90,
+            "drain back to the floor")
+        t_released = time.time()
+        downs = [d for d in shrunk["elastic"]["decisions"]
+                 if d["direction"] == "down"]
+        bad = sorted({c for c in flood.codes if c not in (200, 503, 504)})
+        if bad:
+            raise RuntimeError(f"non-contract responses during the "
+                               f"bracket: {bad}")
+        front.stop()
+        if front.proc.returncode != 0:
+            raise RuntimeError(f"front exited rc={front.proc.returncode} "
+                               f"(log: {front.log_path})")
+        return {
+            "scale_up": {
+                "detect_s": round(detect_s, 3),
+                "ready_s": round(t_ready - t_flood, 3),
+                "reason": ups[0]["reason"],
+            },
+            "drain": {
+                "detect_s": round(downs[-1]["at"] - t_quiet, 3),
+                "released_s": round(t_released - t_quiet, 3),
+                "reason": downs[-1]["reason"],
+            },
+            "flood_codes": flood.code_counts(),
+        }
+    finally:
+        front.stop()
+
+
+def bench_ceiling(env: dict) -> dict:
+    """Control: fleet pinned at min == max under the same flood — the
+    first ``at-max`` hold isolates detect cost (no spawn possible)."""
+    env = dict(env, PIO_FLEET_MIN_REPLICAS="2",
+               PIO_FLEET_MAX_REPLICAS="2")
+    front = Front(env, 2, "ceiling")
+    try:
+        front.wait(lambda h: h.get("readyReplicas") == 2, 90,
+                   "pinned fleet ready")
+        flood = Flood(front.base)
+        t_flood = time.time()
+        flood.start()
+        try:
+            held = front.wait(
+                lambda h: (h.get("elastic", {}).get("lastDecision")
+                           or {}).get("reason") == "at-max", 30,
+                "at-max hold under flood")
+            t_hold = time.time()
+        finally:
+            flood.stop()
+        assert held["readyReplicas"] == 2, "control fleet changed size"
+        front.stop()
+        return {
+            "detect_s": round(t_hold - t_flood, 3),
+            "replicas": 2,
+            "flood_codes": flood.code_counts(),
+        }
+    finally:
+        front.stop()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--no-persist", action="store_true",
+                   help="print the JSON line only; skip BASELINE.json")
+    ns = p.parse_args()
+
+    from test_fleet import _sqlite_env, _storage_for, _train
+
+    workdir = Path(tempfile.mkdtemp(prefix="elastic_bench_"))
+    env = _sqlite_env(workdir, PIO_FLEET_MIN_REPLICAS="1",
+                      PIO_FLEET_MAX_REPLICAS="2", **KNOBS)
+    log(f"workspace {workdir}")
+    _train(_storage_for(env), "one")
+
+    log("bracket 1/2: elastic fleet (floor 1, max 2) under flood")
+    elastic = bench_elastic(env)
+    log(f"  up: detect {elastic['scale_up']['detect_s']}s "
+        f"({elastic['scale_up']['reason']}), "
+        f"ready {elastic['scale_up']['ready_s']}s; "
+        f"drain: detect {elastic['drain']['detect_s']}s, "
+        f"released {elastic['drain']['released_s']}s")
+    log("bracket 2/2: ceiling control (pinned at max) under flood")
+    ceiling = bench_ceiling(env)
+    log(f"  at-max hold {ceiling['detect_s']}s (detect machinery alone)")
+
+    spawn_cost = round(elastic["scale_up"]["ready_s"]
+                       - ceiling["detect_s"], 3)
+    result = {
+        "knobs": {
+            "min_replicas": 1, "max_replicas": 2,
+            "tick_ms": int(KNOBS["PIO_SCALE_TICK_MS"]),
+            "cooldown_ms": int(KNOBS["PIO_SCALE_COOLDOWN_MS"]),
+            "hysteresis_ticks": int(KNOBS["PIO_SCALE_HYSTERESIS_TICKS"]),
+            "down_threshold": float(KNOBS["PIO_SCALE_DOWN_THRESHOLD"]),
+            "query_max_pending": int(KNOBS["PIO_QUERY_MAX_PENDING"]),
+        },
+        "flood": {"threads": FLOOD_THREADS, "sleep_s": SLEEP_S},
+        "elastic": elastic,
+        "ceiling_control": ceiling,
+        "spawn_ready_cost_s": spawn_cost,
+        "note": "2-core host: decision latency is the axis, not QPS — "
+                "more replicas add no throughput here. ceiling_control "
+                "pins min==max so its at-max hold is detect cost alone; "
+                "elastic ready_s minus that is the spawn+ready cost.",
+    }
+    print(json.dumps({"measured_elastic_decision": result}))
+
+    if not ns.no_persist:
+        base = os.path.join(REPO, "BASELINE.json")
+        try:
+            with open(base) as f:
+                doc = json.load(f)
+            doc.setdefault("published", {})[
+                "measured_elastic_decision"] = result
+            with open(base, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+            log("persisted published.measured_elastic_decision "
+                "-> BASELINE.json")
+        except (OSError, ValueError) as e:
+            log(f"could not persist to BASELINE: {e}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
